@@ -1,0 +1,24 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Tests never touch TPU hardware (mirrors the reference's rule that no test
+touches NVML — SURVEY.md §4). Must run before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from walkai_nos_tpu.tpu.tiling import known_tilings  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_geometry_overrides():
+    yield
+    known_tilings.clear_known_geometries()

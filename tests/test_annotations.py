@@ -1,0 +1,153 @@
+"""Annotation codec tests (reference: `pkg/gpu/annotation_test.go`, 449 LoC)."""
+
+import pytest
+
+from walkai_nos_tpu.tpu.annotations import (
+    AnnotationParseError,
+    SpecAnnotation,
+    StatusAnnotation,
+    parse_node_annotations,
+    parse_spec_annotation,
+    parse_status_annotation,
+    spec_annotations_from_node_partitioning,
+    spec_matches_status,
+    status_annotations_to_geometry,
+)
+from walkai_nos_tpu.tpu.device import DeviceStatus
+
+
+class TestSpecAnnotation:
+    def test_round_trip(self):
+        a = SpecAnnotation(mesh_index=0, profile="2x2", quantity=2)
+        assert a.key == "nos.walkai.io/spec-tpu-0-2x2"
+        assert a.value == "2"
+        assert parse_spec_annotation(a.key, a.value) == a
+
+    @pytest.mark.parametrize(
+        "key,value",
+        [
+            ("nos.walkai.io/spec-tpu-0-2x2", "nan"),
+            ("nos.walkai.io/spec-tpu-x-2x2", "1"),
+            ("nos.walkai.io/spec-tpu-0", "1"),
+            ("nos.walkai.io/spec-tpu-0-", "1"),
+            ("other/spec-tpu-0-2x2", "1"),
+        ],
+    )
+    def test_invalid(self, key, value):
+        with pytest.raises(AnnotationParseError):
+            parse_spec_annotation(key, value)
+
+
+class TestStatusAnnotation:
+    def test_round_trip_free(self):
+        a = StatusAnnotation(0, "2x2", DeviceStatus.FREE, 1)
+        assert a.key == "nos.walkai.io/status-tpu-0-2x2-free"
+        assert parse_status_annotation(a.key, a.value) == a
+
+    def test_round_trip_used(self):
+        a = StatusAnnotation(1, "1x1", DeviceStatus.USED, 3)
+        assert a.key == "nos.walkai.io/status-tpu-1-1x1-used"
+        assert parse_status_annotation(a.key, a.value) == a
+
+    @pytest.mark.parametrize(
+        "key",
+        [
+            "nos.walkai.io/status-tpu-0-2x2-busy",
+            "nos.walkai.io/status-tpu-0-2x2",
+            "nos.walkai.io/status-tpu-0-2x2-unknown",
+            "nos.walkai.io/status-tpu-a-2x2-free",
+        ],
+    )
+    def test_invalid(self, key):
+        with pytest.raises(AnnotationParseError):
+            parse_status_annotation(key, "1")
+
+
+class TestParseNodeAnnotations:
+    def test_splits_and_skips(self):
+        annotations = {
+            "nos.walkai.io/spec-tpu-0-2x2": "2",
+            "nos.walkai.io/spec-tpu-0-1x1": "4",
+            "nos.walkai.io/status-tpu-0-2x2-free": "1",
+            "nos.walkai.io/status-tpu-0-2x2-used": "1",
+            "nos.walkai.io/spec-partitioning-plan": "12345",
+            "nos.walkai.io/spec-tpu-garbage": "zz",  # malformed -> skipped
+            "unrelated.io/foo": "bar",
+        }
+        status, spec = parse_node_annotations(annotations)
+        assert len(spec) == 2
+        assert len(status) == 2
+        assert {s.profile for s in spec} == {"2x2", "1x1"}
+
+    def test_empty(self):
+        assert parse_node_annotations({}) == ([], [])
+
+
+class TestSpecMatchesStatus:
+    def test_matches(self):
+        spec = [SpecAnnotation(0, "2x2", 2)]
+        status = [
+            StatusAnnotation(0, "2x2", DeviceStatus.FREE, 1),
+            StatusAnnotation(0, "2x2", DeviceStatus.USED, 1),
+        ]
+        assert spec_matches_status(spec, status)
+
+    def test_quantity_mismatch(self):
+        spec = [SpecAnnotation(0, "2x2", 2)]
+        status = [StatusAnnotation(0, "2x2", DeviceStatus.FREE, 1)]
+        assert not spec_matches_status(spec, status)
+
+    def test_profile_mismatch(self):
+        spec = [SpecAnnotation(0, "2x2", 1)]
+        status = [StatusAnnotation(0, "1x2", DeviceStatus.FREE, 1)]
+        assert not spec_matches_status(spec, status)
+
+    def test_extra_status_profile(self):
+        spec = [SpecAnnotation(0, "2x2", 1)]
+        status = [
+            StatusAnnotation(0, "2x2", DeviceStatus.FREE, 1),
+            StatusAnnotation(0, "1x1", DeviceStatus.FREE, 1),
+        ]
+        assert not spec_matches_status(spec, status)
+
+    def test_zero_quantities_ignored(self):
+        spec = [SpecAnnotation(0, "2x2", 1), SpecAnnotation(0, "1x1", 0)]
+        status = [
+            StatusAnnotation(0, "2x2", DeviceStatus.USED, 1),
+            StatusAnnotation(0, "1x1", DeviceStatus.FREE, 0),
+        ]
+        assert spec_matches_status(spec, status)
+
+    def test_both_empty(self):
+        assert spec_matches_status([], [])
+
+
+class TestHelpers:
+    def test_spec_from_partitioning(self):
+        out = spec_annotations_from_node_partitioning({0: {"2x2": 2, "1x1": 0}})
+        assert out == [SpecAnnotation(0, "2x2", 2)]
+
+    def test_status_to_geometry(self):
+        status = [
+            StatusAnnotation(0, "2x2", DeviceStatus.FREE, 1),
+            StatusAnnotation(0, "2x2", DeviceStatus.USED, 1),
+            StatusAnnotation(1, "1x1", DeviceStatus.FREE, 2),
+        ]
+        assert status_annotations_to_geometry(status, 0) == {"2x2": 2}
+        assert status_annotations_to_geometry(status, 1) == {"1x1": 2}
+
+
+class TestNegativeQuantitiesRejected:
+    def test_negative_spec(self):
+        with pytest.raises(AnnotationParseError, match="negative"):
+            parse_spec_annotation("nos.walkai.io/spec-tpu-0-2x2", "-1")
+
+    def test_negative_status(self):
+        with pytest.raises(AnnotationParseError, match="negative"):
+            parse_status_annotation("nos.walkai.io/status-tpu-0-2x2-free", "-3")
+
+    def test_parse_node_annotations_skips_negative(self):
+        st, sp = parse_node_annotations(
+            {"nos.walkai.io/status-tpu-0-2x2-free": "-3"}
+        )
+        assert st == [] and sp == []

@@ -1,0 +1,140 @@
+"""Allowed-tilings generation + override tests.
+
+Reference analogue: `pkg/gpu/mig/known_config_test.go`,
+`allowed_geometries_test.go`.
+"""
+
+import pytest
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.partitioning import (
+    get_fewest_slices_geometry,
+    geometry_total_slices,
+)
+from walkai_nos_tpu.tpu.tiling import known_tilings
+
+V5E = topology.KNOWN_MODELS["tpu-v5-lite-podslice"]
+V4 = topology.KNOWN_MODELS["tpu-v4-podslice"]
+
+
+class TestCandidateShapes:
+    def test_v5e_2x4(self):
+        shapes = known_tilings.candidate_shapes((2, 4))
+        names = {known_tilings.canonical_profile(s) for s in shapes}
+        # GKE v5e single-host shapes exactly.
+        assert names == {"1x1", "1x2", "1x4", "2x2", "2x4"}
+
+    def test_power_of_two_only(self):
+        shapes = known_tilings.candidate_shapes((2, 4))
+        for s in shapes:
+            n = topology.shape_chip_count(s)
+            assert n & (n - 1) == 0
+
+    def test_v4_2x2x1(self):
+        names = {
+            known_tilings.canonical_profile(s)
+            for s in known_tilings.candidate_shapes((2, 2, 1))
+        }
+        assert names == {"1x1x1", "1x1x2", "1x2x2"}
+
+
+class TestGenerateTilings:
+    def test_v5e_contains_expected_geometries(self):
+        geoms = known_tilings.get_allowed_geometries(V5E)
+        as_sets = [tuple(sorted(g.items())) for g in geoms]
+        for expected in [
+            {"2x4": 1},
+            {"2x2": 2},
+            {"1x4": 2},
+            {"1x1": 8},
+            {"2x2": 1, "1x2": 2},
+            {"1x2": 4},
+            {"2x2": 1, "1x1": 4},
+        ]:
+            assert tuple(sorted(expected.items())) in as_sets, expected
+
+    def test_every_geometry_covers_all_chips(self):
+        for g in known_tilings.get_allowed_geometries(V5E):
+            total = sum(
+                topology.shape_chip_count(topology.parse_shape(p)) * q
+                for p, q in g.items()
+            )
+            assert total == 8
+
+    def test_fewest_slices_is_whole_host(self):
+        geoms = known_tilings.get_allowed_geometries(V5E)
+        assert get_fewest_slices_geometry(geoms) == {"2x4": 1}
+
+    def test_deterministic(self):
+        a = known_tilings.get_allowed_geometries(V5E)
+        b = known_tilings.get_allowed_geometries(V5E)
+        assert a == b
+
+    def test_v4_geometries(self):
+        geoms = known_tilings.get_allowed_geometries(V4)
+        as_sets = [tuple(sorted(g.items())) for g in geoms]
+        assert tuple(sorted({"1x2x2": 1}.items())) in as_sets
+        assert tuple(sorted({"1x1x1": 4}.items())) in as_sets
+        assert tuple(sorted({"1x1x2": 2}.items())) in as_sets
+
+
+class TestOverrides:
+    def test_set_and_clear(self):
+        known_tilings.set_known_geometries(
+            {"tpu-v5-lite-podslice": [{"2x4": 1}, {"2x2": 2}]}
+        )
+        assert known_tilings.get_allowed_geometries(V5E) == [
+            {"2x4": 1},
+            {"2x2": 2},
+        ]
+        known_tilings.clear_known_geometries()
+        assert len(known_tilings.get_allowed_geometries(V5E)) > 2
+
+    def test_unknown_model_rejected(self):
+        with pytest.raises(ValueError, match="unknown TPU model"):
+            known_tilings.set_known_geometries({"nope": [{"2x4": 1}]})
+
+    def test_too_many_chips_rejected(self):
+        with pytest.raises(ValueError, match="chips"):
+            known_tilings.set_known_geometries(
+                {"tpu-v5-lite-podslice": [{"2x4": 2}]}
+            )
+
+    def test_non_canonical_profile_rejected(self):
+        with pytest.raises(ValueError, match="canonical"):
+            known_tilings.set_known_geometries(
+                {"tpu-v5-lite-podslice": [{"4x2": 1}]}
+            )
+
+    def test_non_pow2_rejected(self):
+        with pytest.raises(ValueError):
+            known_tilings.set_known_geometries(
+                {"tpu-v5-lite-podslice": [{"1x3": 1}]}
+            )
+
+    def test_unplaceable_rejected(self):
+        # 1x4 + 2x2 = 8 chips but cannot tile a 2x4 grid together: the 1x4
+        # row leaves a 1x4 strip that a 2x2 cannot occupy.
+        with pytest.raises(ValueError, match="not placeable"):
+            known_tilings.set_known_geometries(
+                {"tpu-v5-lite-podslice": [{"1x4": 1, "2x2": 1}]}
+            )
+
+    def test_all_or_nothing(self):
+        with pytest.raises(ValueError):
+            known_tilings.set_known_geometries(
+                {"tpu-v5-lite-podslice": [{"2x4": 1}, {"2x4": 2}]}
+            )
+        # first (valid) entry must not have been installed
+        assert geometry_total_slices(
+            get_fewest_slices_geometry(
+                known_tilings.get_allowed_geometries(V5E)
+            )
+        ) == 1
+
+    def test_partial_geometry_allowed_in_override(self):
+        # Operators may expose fewer chips than the host has.
+        known_tilings.set_known_geometries(
+            {"tpu-v5-lite-podslice": [{"2x2": 1}]}
+        )
+        assert known_tilings.get_allowed_geometries(V5E) == [{"2x2": 1}]

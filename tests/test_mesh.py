@@ -1,0 +1,121 @@
+"""TpuMesh geometry-search tests (reference: `pkg/gpu/mig/gpu_test.go`, 596 LoC)."""
+
+import pytest
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.tiling.mesh import TpuMesh
+
+V5E = topology.KNOWN_MODELS["tpu-v5-lite-podslice"]
+
+
+def mesh(used=None, free=None):
+    return TpuMesh(model=V5E, mesh_index=0, used=used or {}, free=free or {})
+
+
+class TestGeometry:
+    def test_empty(self):
+        assert mesh().geometry() == {}
+
+    def test_used_plus_free(self):
+        m = mesh(used={"2x2": 1}, free={"2x2": 1})
+        assert m.geometry() == {"2x2": 2}
+
+
+class TestCanApplyGeometry:
+    def test_empty_mesh_accepts_all(self):
+        assert mesh().can_apply_geometry({"2x4": 1})
+
+    def test_never_drops_used(self):
+        m = mesh(used={"2x2": 1})
+        assert m.can_apply_geometry({"2x2": 2})
+        assert m.can_apply_geometry({"2x2": 1, "1x2": 2})
+        assert not m.can_apply_geometry({"2x4": 1})
+        assert not m.can_apply_geometry({"1x1": 8})
+
+    def test_apply_rejects_dropping_used(self):
+        m = mesh(used={"2x2": 1})
+        with pytest.raises(GenericError):
+            m.apply_geometry({"2x4": 1})
+
+    def test_apply_sets_free(self):
+        m = mesh(used={"2x2": 1})
+        m.apply_geometry({"2x2": 2})
+        assert m.free == {"2x2": 1}
+        assert m.used == {"2x2": 1}
+
+
+class TestInitGeometry:
+    def test_defaults_to_whole_host(self):
+        m = mesh()
+        assert m.init_geometry()
+        assert m.geometry() == {"2x4": 1}
+        assert m.free == {"2x4": 1}
+
+
+class TestUpdateGeometryFor:
+    def test_provides_wanted_profile(self):
+        m = mesh()
+        assert m.update_geometry_for({"2x2": 1})
+        assert m.free_count("2x2") >= 1
+
+    def test_prefers_most_provided(self):
+        m = mesh()
+        assert m.update_geometry_for({"1x1": 8})
+        assert m.free_count("1x1") == 8
+
+    def test_respects_used_slices(self):
+        m = mesh(used={"2x2": 1})
+        assert m.update_geometry_for({"1x1": 4})
+        geom = m.geometry()
+        assert geom.get("2x2", 0) >= 1  # used slice kept
+        assert m.free_count("1x1") == 4
+
+    def test_impossible_request_no_change(self):
+        # All chips used: nothing can change.
+        m = mesh(used={"1x1": 8})
+        assert not m.update_geometry_for({"2x2": 1})
+        assert m.geometry() == {"1x1": 8}
+
+    def test_no_change_when_nothing_provided(self):
+        m = mesh(free={"2x4": 1})
+        # wanted profile unknown to this topology
+        assert not m.update_geometry_for({"9x9": 1})
+
+    def test_full_free_mesh_repartitions(self):
+        m = mesh(free={"2x4": 1})
+        assert m.update_geometry_for({"2x2": 2})
+        assert m.free_count("2x2") == 2
+
+    def test_deterministic(self):
+        a, b = mesh(), mesh()
+        a.update_geometry_for({"1x2": 1})
+        b.update_geometry_for({"1x2": 1})
+        assert a.geometry() == b.geometry()
+
+    def test_distance_tie_break_prefers_similar_geometry(self):
+        # Current: 2x2:2. Wanting one more 1x2-pair should pick a geometry
+        # close to the current one rather than exploding everything.
+        m = mesh(used={"2x2": 1}, free={"2x2": 1})
+        assert m.update_geometry_for({"1x2": 2})
+        assert m.geometry().get("2x2", 0) >= 1
+
+
+class TestAddPod:
+    def test_moves_free_to_used(self):
+        m = mesh(free={"2x2": 2})
+        m.add_pod("2x2")
+        assert m.used == {"2x2": 1}
+        assert m.free == {"2x2": 1}
+
+    def test_no_free_raises(self):
+        m = mesh(used={"2x2": 1})
+        with pytest.raises(GenericError):
+            m.add_pod("2x2")
+
+    def test_clone_is_independent(self):
+        m = mesh(free={"2x2": 2})
+        c = m.clone()
+        c.add_pod("2x2")
+        assert m.used == {}
+        assert c.used == {"2x2": 1}

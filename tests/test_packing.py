@@ -1,0 +1,141 @@
+"""Mesh-packing tests — the placement engine replacing NVML permutations.
+
+Reference analogue: the placement-order behavior exercised in
+`pkg/gpu/nvml` (permutation creation) and `plan_test.go` recreate semantics.
+"""
+
+from walkai_nos_tpu.tpu.tiling import known_tilings, packing
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+
+
+class TestPackGeometry:
+    def test_whole_host(self):
+        placements = packing.pack_geometry((2, 4), {"2x4": 1}, pinned=[])
+        assert placements is not None
+        assert len(placements) == 1
+        assert placements[0].profile == "2x4"
+        assert sorted(placements[0].cells()) == [
+            (r, c) for r in range(2) for c in range(4)
+        ]
+
+    def test_two_2x2(self):
+        placements = packing.pack_geometry((2, 4), {"2x2": 2}, pinned=[])
+        assert placements is not None
+        cells = sorted(c for p in placements for c in p.cells())
+        assert cells == [(r, c) for r in range(2) for c in range(4)]
+
+    def test_mixed_geometry(self):
+        placements = packing.pack_geometry((2, 4), {"2x2": 1, "1x2": 2}, pinned=[])
+        assert placements is not None
+        cells = [c for p in placements for c in p.cells()]
+        assert len(cells) == len(set(cells)) == 8
+
+    def test_partial_geometry_leaves_holes(self):
+        placements = packing.pack_geometry((2, 4), {"2x2": 1}, pinned=[])
+        assert placements is not None
+        assert len(placements) == 1
+        assert placements[0].chip_count == 4
+
+    def test_infeasible_returns_none(self):
+        # Five 1x2 slices need 10 chips; host has 8.
+        assert packing.pack_geometry((2, 4), {"1x2": 5}, pinned=[]) is None
+
+    def test_unplaceable_mix_returns_none(self):
+        assert packing.pack_geometry((2, 4), {"1x4": 1, "2x2": 1}, pinned=[]) is None
+
+    def test_deterministic(self):
+        a = packing.pack_geometry((2, 4), {"2x2": 1, "1x1": 4}, pinned=[])
+        b = packing.pack_geometry((2, 4), {"2x2": 1, "1x1": 4}, pinned=[])
+        assert a == b
+
+    def test_pinned_respected(self):
+        pinned = [Placement("2x2", (0, 2), (2, 2))]
+        placements = packing.pack_geometry((2, 4), {"2x2": 2}, pinned=pinned)
+        assert placements is not None
+        assert placements[0] == pinned[0]
+        other = placements[1]
+        assert set(other.cells()).isdisjoint(set(pinned[0].cells()))
+
+    def test_pinned_not_in_geometry_is_infeasible(self):
+        pinned = [Placement("2x2", (0, 0), (2, 2))]
+        assert packing.pack_geometry((2, 4), {"1x1": 8}, pinned=pinned) is None
+
+    def test_pinned_overlap_is_infeasible(self):
+        pinned = [
+            Placement("2x2", (0, 0), (2, 2)),
+            Placement("2x2", (0, 1), (2, 2)),
+        ]
+        assert packing.pack_geometry((2, 4), {"2x2": 2}, pinned=pinned) is None
+
+    def test_pinned_out_of_bounds_is_infeasible(self):
+        pinned = [Placement("2x2", (0, 3), (2, 2))]
+        assert packing.pack_geometry((2, 4), {"2x2": 2}, pinned=pinned) is None
+
+    def test_awkward_pin_forces_backtracking(self):
+        # Pin a 2x2 in the middle; 1x1s must fill around it.
+        pinned = [Placement("2x2", (0, 1), (2, 2))]
+        placements = packing.pack_geometry(
+            (2, 4), {"2x2": 1, "1x1": 4}, pinned=pinned
+        )
+        assert placements is not None
+        cells = [c for p in placements for c in p.cells()]
+        assert len(cells) == len(set(cells)) == 8
+
+    def test_orientation_permutation(self):
+        # A canonical 1x2 must be placeable vertically in a 2x1 grid.
+        placements = packing.pack_geometry((2, 1), {"1x2": 1}, pinned=[])
+        assert placements is not None
+        assert placements[0].orientation == (2, 1)
+
+    def test_3d_host(self):
+        placements = packing.pack_geometry(
+            (2, 2, 1), {"1x1x2": 2}, pinned=[]
+        )
+        assert placements is not None
+        cells = [c for p in placements for c in p.cells()]
+        assert len(set(cells)) == 4
+
+    def test_every_generated_tiling_is_placeable(self):
+        for host in [(2, 4), (2, 2, 1), (2, 2)]:
+            for gid in known_tilings.generate_tilings(host):
+                geom = {}
+                for part in gid.split("|"):
+                    p, _, q = part.partition("=")
+                    geom[p] = int(q)
+                assert packing.pack_geometry(host, geom, pinned=[]) is not None, (
+                    host,
+                    geom,
+                )
+
+    def test_slice_ids_stable(self):
+        placements = packing.pack_geometry((2, 4), {"2x2": 2}, pinned=[])
+        ids = [p.slice_id() for p in placements]
+        assert len(ids) == len(set(ids))
+        assert all("@" in i for i in ids)
+
+
+class TestReviewRegressions:
+    def test_fragmented_pinned_packing(self):
+        # Pinned 1x1 in the middle of a 1x4 strip fragments the mesh; the
+        # packer must try the 1x1 (not only the largest 1x2) at the first
+        # anchor to find 1x1@(0,0) + 1x2@(0,2).
+        pinned = [Placement("1x1", (0, 1), (1, 1))]
+        out = packing.pack_geometry((1, 4), {"1x2": 1, "1x1": 2}, pinned=pinned)
+        assert out is not None
+        cells = [c for p in out for c in p.cells()]
+        assert len(cells) == len(set(cells)) == 4
+
+    def test_fragmented_pinned_packing_2d(self):
+        # Pin 1x1s at the corners of a 2x4 mesh; 2x2 can't be placed, but
+        # 1x2s can fill the middle columns.
+        pinned = [
+            Placement("1x1", (0, 0), (1, 1)),
+            Placement("1x1", (0, 3), (1, 1)),
+        ]
+        out = packing.pack_geometry(
+            (2, 4), {"1x1": 2, "1x2": 3}, pinned=pinned
+        )
+        assert out is not None
+        cells = [c for p in out for c in p.cells()]
+        assert len(cells) == len(set(cells)) == 8

@@ -1,0 +1,113 @@
+"""Profile / resource-name tests (reference: `pkg/gpu/mig/profile.go` tests +
+`util.go` helpers)."""
+
+import pytest
+
+from walkai_nos_tpu.tpu.tiling import profile as prof
+
+
+class TestProfile:
+    def test_parse(self):
+        p = prof.Profile.parse("2x2")
+        assert p.chip_count() == 4
+        assert str(p) == "2x2"
+        assert p.as_resource_name() == "walkai.io/tpu-2x2"
+
+    def test_ordering(self):
+        small = prof.Profile.parse("1x1")
+        big = prof.Profile.parse("2x4")
+        assert small.smaller_than(big)
+        assert sorted([big, small]) == [small, big]
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            prof.Profile.parse("2x-2")
+
+
+class TestResourceNames:
+    @pytest.mark.parametrize(
+        "res,ok",
+        [
+            ("walkai.io/tpu-2x2", True),
+            ("walkai.io/tpu-2x2x1", True),
+            ("walkai.io/tpu-8", True),
+            ("google.com/tpu", False),
+            ("walkai.io/tpu-", False),
+            ("walkai.io/tpu-2x", False),
+            ("nvidia.com/mig-1g.10gb", False),
+            ("walkai.io/tpu-shared-2c", False),
+        ],
+    )
+    def test_is_slice_resource(self, res, ok):
+        assert prof.is_slice_resource(res) == ok
+
+    def test_extract(self):
+        assert prof.extract_profile_name("walkai.io/tpu-2x4") == "2x4"
+        with pytest.raises(ValueError):
+            prof.extract_profile_name("google.com/tpu")
+
+
+class TestGetRequestedProfiles:
+    def pod(self, requests, init_requests=None):
+        containers = [
+            {"resources": {"requests": r, "limits": dict(r)}} for r in requests
+        ]
+        spec = {"containers": containers}
+        if init_requests:
+            spec["initContainers"] = [
+                {"resources": {"requests": r}} for r in init_requests
+            ]
+        return {"spec": spec}
+
+    def test_single_container(self):
+        p = self.pod([{"walkai.io/tpu-2x2": "1"}])
+        assert prof.get_requested_profiles(p) == {"2x2": 1}
+
+    def test_sums_containers(self):
+        p = self.pod(
+            [{"walkai.io/tpu-2x2": "1"}, {"walkai.io/tpu-2x2": "1", "cpu": "1"}]
+        )
+        assert prof.get_requested_profiles(p) == {"2x2": 2}
+
+    def test_init_containers_max(self):
+        p = self.pod(
+            [{"walkai.io/tpu-1x1": "1"}],
+            init_requests=[{"walkai.io/tpu-1x1": "3"}],
+        )
+        assert prof.get_requested_profiles(p) == {"1x1": 3}
+
+    def test_non_slice_resources_ignored(self):
+        p = self.pod([{"cpu": "2", "google.com/tpu": "4"}])
+        assert prof.get_requested_profiles(p) == {}
+
+    def test_limits_only(self):
+        p = {
+            "spec": {
+                "containers": [
+                    {"resources": {"limits": {"walkai.io/tpu-2x4": "1"}}}
+                ]
+            }
+        }
+        assert prof.get_requested_profiles(p) == {"2x4": 1}
+
+
+class TestQuantityRobustness:
+    def pod_with(self, qty):
+        return {
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"walkai.io/tpu-2x2": qty}}}
+                ]
+            }
+        }
+
+    def test_k8s_suffix(self):
+        import walkai_nos_tpu.tpu.tiling.profile as prof
+
+        assert prof.get_requested_profiles(self.pod_with("2k")) == {"2x2": 2000}
+
+    @pytest.mark.parametrize("qty", ["1.5", "", "zz", "0", "-1"])
+    def test_bad_quantities_skipped(self, qty):
+        import walkai_nos_tpu.tpu.tiling.profile as prof
+
+        assert prof.get_requested_profiles(self.pod_with(qty)) == {}

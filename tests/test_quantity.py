@@ -1,0 +1,22 @@
+"""k8s Quantity parser tests."""
+
+import pytest
+
+from walkai_nos_tpu.utils.quantity import parse_quantity
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("1", 1), ("2k", 2000), ("1Ki", 1024), ("3Mi", 3 * 2**20),
+        ("2000m", 2), (4, 4), (2.0, 2), ("0", 0), ("-1", -1),
+    ],
+)
+def test_valid(raw, expected):
+    assert parse_quantity(raw) == expected
+
+
+@pytest.mark.parametrize("raw", ["1.5", "", "zz", "1500m", 1.5, "1e"])
+def test_invalid(raw):
+    with pytest.raises(ValueError):
+        parse_quantity(raw)

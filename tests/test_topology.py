@@ -1,0 +1,67 @@
+"""Topology model tests (reference analogue: `pkg/gpu/util_test.go`)."""
+
+import pytest
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.tpu import topology
+
+
+class TestParseShape:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("2x4", (2, 4)),
+            ("1x1", (1, 1)),
+            ("2x2x1", (2, 2, 1)),
+            ("8", (8,)),
+        ],
+    )
+    def test_valid(self, s, expected):
+        assert topology.parse_shape(s) == expected
+        assert topology.format_shape(expected) == s
+
+    @pytest.mark.parametrize("s", ["", "2x", "x4", "2x-1", "axb", "2 x 4", "0x2"])
+    def test_invalid(self, s):
+        with pytest.raises(ValueError):
+            topology.parse_shape(s)
+
+    def test_chip_count(self):
+        assert topology.shape_chip_count((2, 4)) == 8
+        assert topology.shape_chip_count((2, 2, 1)) == 4
+
+
+class TestGetModel:
+    def test_v5e_host(self):
+        labels = {constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice"}
+        model = topology.get_model(labels)
+        assert model is not None
+        assert model.generation == "v5e"
+        assert model.host_mesh == (2, 4)
+        assert model.chips_per_host == 8
+
+    def test_explicit_smaller_topology_label(self):
+        labels = {
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+            constants.LABEL_TPU_TOPOLOGY: "2x2",
+        }
+        model = topology.get_model(labels)
+        assert model.host_mesh == (2, 2)
+        assert model.chips_per_host == 4
+
+    def test_multi_host_topology_label_falls_back_to_host_mesh(self):
+        # 4x4 is a 2-host v5e slice; the per-host mesh stays 2x4.
+        labels = {
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+            constants.LABEL_TPU_TOPOLOGY: "4x4",
+        }
+        assert topology.get_model(labels).host_mesh == (2, 4)
+
+    def test_unknown_model(self):
+        assert topology.get_model({constants.LABEL_TPU_ACCELERATOR: "gpu"}) is None
+        assert topology.get_model({}) is None
+
+    def test_v4_host(self):
+        labels = {constants.LABEL_TPU_ACCELERATOR: "tpu-v4-podslice"}
+        model = topology.get_model(labels)
+        assert model.host_mesh == (2, 2, 1)
+        assert topology.get_chip_count(labels) == 4

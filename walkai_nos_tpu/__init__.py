@@ -1,0 +1,11 @@
+"""walkai-nos, TPU-native.
+
+A Kubernetes control plane that dynamically partitions TPU hosts into
+right-sized sub-slices (contiguous sub-meshes of the ICI mesh) to match
+pending-pod demand, plus the JAX/Pallas workload runtime that consumes those
+slices.
+
+Capability parity target: saguirregaray1/walkai-nos (see SURVEY.md).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from walkai_nos_tpu.api.constants import *  # noqa: F401,F403

@@ -1,0 +1,96 @@
+"""API contract: annotations, labels, resource names.
+
+TPU-native analogue of the reference's contract layer
+(`pkg/api/nos.nebuly.com/v1alpha1/annotations.go:22-58`, `labels.go:20-22`,
+`constants.go:24-27`, and `pkg/constant/constants.go`). The spec/status
+node-annotation protocol is kept structurally identical — it is the
+coordination bus between the cluster-scope partitioner and the per-node
+agents — with TPU slice shapes in place of MIG profiles.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# API group
+# ---------------------------------------------------------------------------
+
+API_GROUP = "nos.walkai.io"
+
+# ---------------------------------------------------------------------------
+# Node annotations (the control bus).
+#
+# Spec (desired state, written by the cluster partitioner):
+#   nos.walkai.io/spec-tpu-<meshIndex>-<profile>: "<quantity>"
+#   nos.walkai.io/spec-partitioning-plan: "<planID>"
+# Status (observed state, written by the node agent):
+#   nos.walkai.io/status-tpu-<meshIndex>-<profile>-<free|used>: "<quantity>"
+#   nos.walkai.io/status-partitioning-plan: "<planID>"
+#
+# Reference: `pkg/api/nos.nebuly.com/v1alpha1/annotations.go:22-58`.
+# ---------------------------------------------------------------------------
+
+ANNOTATION_PARTITIONING_PLAN = f"{API_GROUP}/spec-partitioning-plan"
+ANNOTATION_REPORTED_PARTITIONING_PLAN = f"{API_GROUP}/status-partitioning-plan"
+
+ANNOTATION_TPU_SPEC_PREFIX = f"{API_GROUP}/spec-tpu"
+ANNOTATION_TPU_STATUS_PREFIX = f"{API_GROUP}/status-tpu"
+
+ANNOTATION_TPU_SPEC_FORMAT = ANNOTATION_TPU_SPEC_PREFIX + "-{index}-{profile}"
+ANNOTATION_TPU_STATUS_FORMAT = (
+    ANNOTATION_TPU_STATUS_PREFIX + "-{index}-{profile}-{status}"
+)
+
+# ---------------------------------------------------------------------------
+# Node labels
+# ---------------------------------------------------------------------------
+
+# Partitioning-mode node label (reference: `labels.go:20-22`,
+# `nos.nebuly.com/gpu-partitioning`). Values: see PartitioningKind.
+LABEL_TPU_PARTITIONING = f"{API_GROUP}/tpu-partitioning"
+
+# GKE TPU node labels (the GFD-label analogue; reference consumed
+# `nvidia.com/gpu.{product,count,memory}`, `pkg/constant/constants.go:64-77`).
+LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+# ---------------------------------------------------------------------------
+# Resource names
+# ---------------------------------------------------------------------------
+
+# Resource prefix for partitioned sub-slices, advertised by the walkai TPU
+# device plugin (reference: `nvidia.com/mig-` prefix, constants.go:44-48).
+RESOURCE_TPU_SLICE_PREFIX = "walkai.io/tpu-"
+# Shared (non-contiguous chip-count) resources — the MPS/slicing analogue.
+RESOURCE_TPU_SHARED_PREFIX = "walkai.io/tpu-shared-"
+# The native whole-host resource advertised by the stock TPU device plugin.
+RESOURCE_TPU = "google.com/tpu"
+# Custom scalar resource used by the elastic-quota scheduler (reference:
+# `nos.nebuly.com/gpu-memory`, `pkg/api/nos.nebuly.com/v1alpha1/constants.go:24-27`).
+RESOURCE_TPU_CHIPS = f"{API_GROUP}/tpu-chips"
+
+# ---------------------------------------------------------------------------
+# Controller names (reference: constants.go:25-27)
+# ---------------------------------------------------------------------------
+
+PARTITIONER_CONTROLLER_NAME = "tpu-partitioner"
+AGENT_REPORTER_NAME = "tpuagent-reporter"
+AGENT_ACTUATOR_NAME = "tpuagent-actuator"
+
+# ---------------------------------------------------------------------------
+# Environment / defaults (reference: constants.go:58-97)
+# ---------------------------------------------------------------------------
+
+ENV_NODE_NAME = "NODE_NAME"
+
+# Device plugin pod selector on TPU-partitioned nodes (reference restarts the
+# pod labeled `app=nvidia-device-plugin-daemonset`, `pkg/gpu/client.go:45-49`).
+DEVICE_PLUGIN_LABEL_KEY = "app"
+DEVICE_PLUGIN_LABEL_VALUE = "walkai-tpu-device-plugin"
+
+DEFAULT_DEVICE_PLUGIN_RESTART_TIMEOUT_S = 60.0
+DEFAULT_POD_RESOURCES_TIMEOUT_S = 10.0
+DEFAULT_POD_RESOURCES_MAX_MSG_SIZE = 1024 * 1024 * 16
+POD_RESOURCES_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+DEVICE_PLUGIN_SOCKET_DIR = "/var/lib/kubelet/device-plugins"
+
+DEFAULT_AGENT_REPORT_INTERVAL_S = 10.0

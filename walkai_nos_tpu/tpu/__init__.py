@@ -1,0 +1,24 @@
+"""TPU domain model (L1): devices, slices, geometries, annotations, errors.
+
+Analogue of the reference's `pkg/gpu/` layer — pure data structures and
+codecs with no I/O.
+"""
+
+from walkai_nos_tpu.tpu.errors import (  # noqa: F401
+    TpuError,
+    NotFoundError,
+    GenericError,
+    ignore_not_found,
+    is_not_found,
+)
+from walkai_nos_tpu.tpu.partitioning import (  # noqa: F401
+    Geometry,
+    PartitioningKind,
+    get_fewest_slices_geometry,
+    geometry_id,
+    geometry_str,
+    partitioning_kind_of_node,
+    is_tiling_partitioning_enabled,
+    is_sharing_partitioning_enabled,
+)
+from walkai_nos_tpu.tpu.device import Device, DeviceList, DeviceStatus  # noqa: F401

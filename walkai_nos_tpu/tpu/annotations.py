@@ -1,0 +1,190 @@
+"""Spec/status node-annotation codec — the wire format of the control bus.
+
+Analogue of `pkg/gpu/annotation.go:29-224`. The cluster partitioner writes
+*spec* annotations (desired slices per mesh); the node agent writes *status*
+annotations (observed slices per mesh, split free/used). Example:
+
+    nos.walkai.io/spec-tpu-0-2x2: "2"
+    nos.walkai.io/status-tpu-0-2x2-free: "1"
+    nos.walkai.io/status-tpu-0-2x2-used: "1"
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.tpu.device import DeviceStatus
+from walkai_nos_tpu.tpu.partitioning import Geometry
+
+
+class AnnotationParseError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class SpecAnnotation:
+    """Desired quantity of one profile on one mesh (`annotation.go:103-140`)."""
+
+    mesh_index: int
+    profile: str
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return constants.ANNOTATION_TPU_SPEC_FORMAT.format(
+            index=self.mesh_index, profile=self.profile
+        )
+
+    @property
+    def value(self) -> str:
+        return str(self.quantity)
+
+
+@dataclass(frozen=True)
+class StatusAnnotation:
+    """Observed quantity of one (profile, free|used) on one mesh
+    (`annotation.go:142-196`)."""
+
+    mesh_index: int
+    profile: str
+    status: DeviceStatus
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return constants.ANNOTATION_TPU_STATUS_FORMAT.format(
+            index=self.mesh_index, profile=self.profile, status=self.status.value
+        )
+
+    @property
+    def value(self) -> str:
+        return str(self.quantity)
+
+
+def parse_spec_annotation(key: str, value: str) -> SpecAnnotation:
+    """Parse `nos.walkai.io/spec-tpu-<idx>-<profile>` (`annotation.go:29-55`)."""
+    prefix = constants.ANNOTATION_TPU_SPEC_PREFIX + "-"
+    if not key.startswith(prefix):
+        raise AnnotationParseError(f"invalid spec annotation key {key!r}")
+    rest = key[len(prefix):]
+    idx_str, sep, profile = rest.partition("-")
+    if not sep or not profile:
+        raise AnnotationParseError(f"invalid spec annotation key {key!r}")
+    try:
+        ann = SpecAnnotation(
+            mesh_index=int(idx_str), profile=profile, quantity=int(value)
+        )
+    except ValueError as e:
+        raise AnnotationParseError(f"invalid spec annotation {key}={value}: {e}") from e
+    if ann.mesh_index < 0 or ann.quantity < 0:
+        raise AnnotationParseError(f"invalid spec annotation {key}={value}: negative")
+    return ann
+
+
+def parse_status_annotation(key: str, value: str) -> StatusAnnotation:
+    """Parse `nos.walkai.io/status-tpu-<idx>-<profile>-<free|used>`
+    (`annotation.go:57-85`)."""
+    prefix = constants.ANNOTATION_TPU_STATUS_PREFIX + "-"
+    if not key.startswith(prefix):
+        raise AnnotationParseError(f"invalid status annotation key {key!r}")
+    rest = key[len(prefix):]
+    parts = rest.split("-")
+    if len(parts) < 3:
+        raise AnnotationParseError(f"invalid status annotation key {key!r}")
+    idx_str, profile_parts, status_str = parts[0], parts[1:-1], parts[-1]
+    try:
+        status = DeviceStatus(status_str)
+    except ValueError as e:
+        raise AnnotationParseError(
+            f"invalid status annotation key {key!r}: bad status {status_str!r}"
+        ) from e
+    if status == DeviceStatus.UNKNOWN:
+        raise AnnotationParseError(
+            f"invalid status annotation key {key!r}: bad status {status_str!r}"
+        )
+    try:
+        ann = StatusAnnotation(
+            mesh_index=int(idx_str),
+            profile="-".join(profile_parts),
+            status=status,
+            quantity=int(value),
+        )
+    except ValueError as e:
+        raise AnnotationParseError(
+            f"invalid status annotation {key}={value}: {e}"
+        ) from e
+    if ann.mesh_index < 0 or ann.quantity < 0:
+        raise AnnotationParseError(
+            f"invalid status annotation {key}={value}: negative"
+        )
+    return ann
+
+
+def parse_node_annotations(
+    annotations: Mapping[str, str],
+) -> tuple[list[StatusAnnotation], list[SpecAnnotation]]:
+    """Split a node's annotation map into (status, spec) lists, skipping
+    non-nos annotations and silently ignoring malformed ones, like the
+    reference (`annotation.go:87-101`).
+    """
+    status: list[StatusAnnotation] = []
+    spec: list[SpecAnnotation] = []
+    for key, value in annotations.items():
+        if key.startswith(constants.ANNOTATION_TPU_SPEC_PREFIX + "-"):
+            try:
+                spec.append(parse_spec_annotation(key, value))
+            except AnnotationParseError:
+                continue
+        elif key.startswith(constants.ANNOTATION_TPU_STATUS_PREFIX + "-"):
+            try:
+                status.append(parse_status_annotation(key, value))
+            except AnnotationParseError:
+                continue
+    return status, spec
+
+
+def spec_annotations_from_node_partitioning(
+    per_mesh_geometry: Mapping[int, Geometry],
+) -> list[SpecAnnotation]:
+    """Geometry-per-mesh -> spec annotation list (sorted, deterministic)."""
+    out: list[SpecAnnotation] = []
+    for mesh_index in sorted(per_mesh_geometry):
+        for profile in sorted(per_mesh_geometry[mesh_index]):
+            qty = per_mesh_geometry[mesh_index][profile]
+            if qty > 0:
+                out.append(SpecAnnotation(mesh_index, profile, qty))
+    return out
+
+
+def spec_matches_status(
+    spec: Iterable[SpecAnnotation], status: Iterable[StatusAnnotation]
+) -> bool:
+    """True when the observed devices exactly satisfy the desired spec
+    (free+used folded together). Reference: `pkg/gpu/mig/annotation.go:24-35`.
+    """
+    desired: dict[tuple[int, str], int] = {}
+    for s in spec:
+        if s.quantity > 0:
+            desired[(s.mesh_index, s.profile)] = (
+                desired.get((s.mesh_index, s.profile), 0) + s.quantity
+            )
+    observed: dict[tuple[int, str], int] = {}
+    for st in status:
+        if st.quantity > 0:
+            observed[(st.mesh_index, st.profile)] = (
+                observed.get((st.mesh_index, st.profile), 0) + st.quantity
+            )
+    return desired == observed
+
+
+def status_annotations_to_geometry(
+    status: Iterable[StatusAnnotation], mesh_index: int
+) -> Geometry:
+    """Fold status annotations for one mesh into a Geometry (free+used)."""
+    geom: Geometry = {}
+    for st in status:
+        if st.mesh_index == mesh_index and st.quantity > 0:
+            geom[st.profile] = geom.get(st.profile, 0) + st.quantity
+    return geom

@@ -1,0 +1,100 @@
+"""Device model and list combinators.
+
+Analogue of `pkg/gpu/device.go:26-137`: a `Device` pairs a concrete
+device-plugin resource (resource name + device ID + status) with the index of
+the TPU mesh it belongs to (the `GpuIndex` analogue — one TPU host normally
+exposes a single ICI mesh, index 0, but the model keeps the index so
+multi-mesh hosts and tests stay general). `DeviceList` carries the group /
+sort / filter combinators the planners are written against.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+from enum import Enum
+from typing import Callable, Iterable, Iterator
+
+
+class DeviceStatus(str, Enum):
+    USED = "used"
+    FREE = "free"
+    UNKNOWN = "unknown"
+
+
+@dataclass(frozen=True)
+class Device:
+    """One allocatable device-plugin device (a materialized TPU sub-slice)."""
+
+    resource_name: str  # e.g. "walkai.io/tpu-2x2"
+    device_id: str  # device-plugin device ID
+    status: DeviceStatus
+    mesh_index: int = 0
+
+    def is_used(self) -> bool:
+        return self.status == DeviceStatus.USED
+
+    def is_free(self) -> bool:
+        return self.status == DeviceStatus.FREE
+
+
+class DeviceList(list[Device]):
+    """List of devices with the combinators of `device.go:42-137`."""
+
+    def group_by(self, key: Callable[[Device], object]) -> dict[object, "DeviceList"]:
+        out: dict[object, DeviceList] = defaultdict(DeviceList)
+        for d in self:
+            out[key(d)].append(d)
+        return dict(out)
+
+    def group_by_mesh_index(self) -> dict[int, "DeviceList"]:
+        return self.group_by(lambda d: d.mesh_index)  # type: ignore[return-value]
+
+    def group_by_resource_name(self) -> dict[str, "DeviceList"]:
+        return self.group_by(lambda d: d.resource_name)  # type: ignore[return-value]
+
+    def group_by_status(self) -> dict[DeviceStatus, "DeviceList"]:
+        return self.group_by(lambda d: d.status)  # type: ignore[return-value]
+
+    def get_used(self) -> "DeviceList":
+        return DeviceList(d for d in self if d.is_used())
+
+    def get_free(self) -> "DeviceList":
+        return DeviceList(d for d in self if d.is_free())
+
+    def sorted_by_device_id(self) -> "DeviceList":
+        return DeviceList(sorted(self, key=lambda d: d.device_id))
+
+    def as_status_annotations(
+        self, extract_profile: Callable[[str], str]
+    ) -> "list":
+        """Fold devices into per-(mesh, profile, status) count annotations.
+
+        ``extract_profile`` maps a resource name to a profile name (e.g.
+        ``walkai.io/tpu-2x2`` -> ``2x2``). Reference: `device.go:118-137`
+        (`AsStatusAnnotation`).
+        """
+        from walkai_nos_tpu.tpu.annotations import StatusAnnotation
+
+        counts: dict[tuple[int, str, DeviceStatus], int] = defaultdict(int)
+        for d in self:
+            counts[(d.mesh_index, extract_profile(d.resource_name), d.status)] += 1
+        return [
+            StatusAnnotation(
+                mesh_index=mesh, profile=profile, status=status, quantity=qty
+            )
+            for (mesh, profile, status), qty in sorted(
+                counts.items(), key=lambda kv: (kv[0][0], kv[0][1], kv[0][2].value)
+            )
+        ]
+
+
+def device_list(devices: Iterable[Device]) -> DeviceList:
+    return DeviceList(devices)
+
+
+__all__ = ["Device", "DeviceList", "DeviceStatus", "device_list"]
+
+
+def _iter_type_check() -> Iterator[Device]:  # pragma: no cover - typing aid
+    return iter(DeviceList())

@@ -1,0 +1,38 @@
+"""Typed errors for the TPU domain.
+
+Analogue of `pkg/gpu/errors.go:26-99`: a small typed-error hierarchy where
+"not found" is distinguishable, because the actuator's recovery policy differs
+by error kind (a stale/unknown device triggers a device-plugin restart rather
+than a failed plan — reference `internal/controllers/migagent/actuator.go:135-138`).
+"""
+
+from __future__ import annotations
+
+
+class TpuError(Exception):
+    """Base class for domain errors."""
+
+    def is_not_found(self) -> bool:
+        return False
+
+
+class NotFoundError(TpuError):
+    """A device/slice/resource was not found."""
+
+    def is_not_found(self) -> bool:
+        return True
+
+
+class GenericError(TpuError):
+    pass
+
+
+def is_not_found(err: BaseException | None) -> bool:
+    return isinstance(err, TpuError) and err.is_not_found()
+
+
+def ignore_not_found(err: BaseException | None) -> BaseException | None:
+    """Return ``err`` unless it is a NotFound, in which case None."""
+    if is_not_found(err):
+        return None
+    return err

@@ -1,0 +1,95 @@
+"""Partitioning abstractions shared by tiling and sharing.
+
+Analogue of `pkg/gpu/partitioning.go:28-124`: a *slice* is any profile-like
+unit a device can be partitioned into (here: a TPU sub-mesh shape such as
+``2x2``, or a shared chip-count such as ``2c``); a *geometry* is a multiset of
+slices, modeled as ``dict[profile, count]``. Geometries have deterministic
+string forms so they can be compared, hashed and logged.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Mapping, Protocol, runtime_checkable
+
+# A Geometry maps a profile name (e.g. "2x2") to how many slices of that
+# profile the partitioning exposes. Reference: `partitioning.go:34-36`.
+Geometry = dict[str, int]
+
+
+@runtime_checkable
+class SliceProfile(Protocol):
+    """Anything usable as a slice profile: sized and nameable.
+
+    Reference: the `gpu.Slice` interface (`partitioning.go:28-32`) requires
+    `SmallerThan` + `String`; here sizing is expressed as chip count.
+    """
+
+    def chip_count(self) -> int: ...
+
+    def __str__(self) -> str: ...
+
+
+class PartitioningKind(str, Enum):
+    """Value of the `nos.walkai.io/tpu-partitioning` node label.
+
+    Reference: `partitioning.go:79-106` (`PartitioningKindMig`,
+    `PartitioningKindMps`). ``TILING`` is the MIG analogue (contiguous
+    sub-meshes of the ICI mesh); ``SHARING`` is the MPS/slicing analogue
+    (chip-count shares without contiguity).
+    """
+
+    TILING = "tiling"
+    SHARING = "sharing"
+
+
+def geometry_str(geometry: Mapping[str, int]) -> str:
+    """Deterministic human form, e.g. ``"1x1:2, 2x2:1"``.
+
+    Reference: `partitioning.go:38-52` (sorted, stable).
+    """
+    return ", ".join(f"{p}:{geometry[p]}" for p in sorted(geometry))
+
+
+def geometry_id(geometry: Mapping[str, int]) -> str:
+    """Deterministic identifier usable as a dict key (`partitioning.go:54-64`)."""
+    return "|".join(f"{p}={geometry[p]}" for p in sorted(geometry))
+
+
+def geometry_total_slices(geometry: Mapping[str, int]) -> int:
+    return sum(geometry.values())
+
+
+def get_fewest_slices_geometry(geometries: list[Geometry]) -> Geometry | None:
+    """Pick the geometry with the fewest total slices (ties broken by ID for
+    determinism). Used to initialize fresh nodes to the coarsest tiling.
+
+    Reference: `partitioning.go:66-77` + `pkg/gpu/mig/gpu.go:120`.
+    """
+    if not geometries:
+        return None
+    return min(geometries, key=lambda g: (geometry_total_slices(g), geometry_id(g)))
+
+
+def partitioning_kind_of_node(node_labels: Mapping[str, str]) -> PartitioningKind | None:
+    """Read the partitioning kind from node labels; None if absent/unknown.
+
+    Reference: `partitioning.go:91-106`.
+    """
+    from walkai_nos_tpu.api import constants
+
+    raw = node_labels.get(constants.LABEL_TPU_PARTITIONING)
+    if raw is None:
+        return None
+    try:
+        return PartitioningKind(raw)
+    except ValueError:
+        return None
+
+
+def is_tiling_partitioning_enabled(node_labels: Mapping[str, str]) -> bool:
+    return partitioning_kind_of_node(node_labels) == PartitioningKind.TILING
+
+
+def is_sharing_partitioning_enabled(node_labels: Mapping[str, str]) -> bool:
+    return partitioning_kind_of_node(node_labels) == PartitioningKind.SHARING

@@ -1,0 +1,24 @@
+"""Tiling partitioning model (L2) — the MIG analogue for TPU hosts.
+
+A host's ICI mesh is partitioned into contiguous, axis-aligned sub-meshes
+("slices": 1x1, 1x2, 2x2, 2x4, ...). Mirrors `pkg/gpu/mig/` in structure:
+profiles, known geometries (generated, not hand-tabled), the per-mesh
+geometry search, the node model, and — new, TPU-specific — deterministic
+mesh packing that replaces NVML's placement-permutation search.
+"""
+
+from walkai_nos_tpu.tpu.tiling.profile import (  # noqa: F401
+    Profile,
+    extract_profile_name,
+    profile_resource_name,
+    is_slice_resource,
+    get_requested_profiles,
+)
+from walkai_nos_tpu.tpu.tiling.known_tilings import (  # noqa: F401
+    get_allowed_geometries,
+    set_known_geometries,
+    generate_tilings,
+)
+from walkai_nos_tpu.tpu.tiling.mesh import TpuMesh  # noqa: F401
+from walkai_nos_tpu.tpu.tiling.node import Node  # noqa: F401
+from walkai_nos_tpu.tpu.tiling.packing import pack_geometry, Placement  # noqa: F401

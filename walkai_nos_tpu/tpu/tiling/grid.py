@@ -1,0 +1,55 @@
+"""Shared grid machinery for the tiling enumerator and the packer.
+
+One source of truth for coordinate indexing, shape orientations and
+anchored placement so `known_tilings.generate_tilings` and
+`packing.pack_geometry` can never disagree about which placements exist.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+
+from walkai_nos_tpu.tpu.topology import Shape
+
+
+def coord_to_idx(coord: tuple[int, ...], mesh: Shape) -> int:
+    idx = 0
+    for c, d in zip(coord, mesh):
+        idx = idx * d + c
+    return idx
+
+
+@lru_cache(maxsize=None)
+def orientations(shape: Shape) -> tuple[Shape, ...]:
+    """Distinct axis permutations of a shape, deterministic order."""
+    return tuple(sorted({p for p in itertools.permutations(shape)}))
+
+
+def all_coords(mesh: Shape) -> list[tuple[int, ...]]:
+    return list(itertools.product(*[range(d) for d in mesh]))
+
+
+def first_empty(grid: list[bool], coords: list[tuple[int, ...]], mesh: Shape):
+    """First unoccupied coordinate in row-major order, or None."""
+    for coord in coords:
+        if not grid[coord_to_idx(coord, mesh)]:
+            return coord
+    return None
+
+
+def placement_cells(
+    grid: list[bool], anchor: tuple[int, ...], orient: Shape, mesh: Shape
+) -> list[int] | None:
+    """Cell indices a shape at `anchor` with `orient` would occupy, or None
+    if it leaves the mesh or overlaps an occupied cell."""
+    for a, o, d in zip(anchor, orient, mesh):
+        if a + o > d:
+            return None
+    idxs = []
+    for off in itertools.product(*[range(o) for o in orient]):
+        idx = coord_to_idx(tuple(a + x for a, x in zip(anchor, off)), mesh)
+        if grid[idx]:
+            return None
+        idxs.append(idx)
+    return idxs

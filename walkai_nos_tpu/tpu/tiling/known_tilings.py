@@ -1,0 +1,186 @@
+"""Allowed tilings per TPU topology.
+
+The reference hard-codes allowed MIG geometries per GPU model
+(`pkg/gpu/mig/known_configs.go:25-140`) and lets operators override them from
+YAML at startup (`SetKnownGeometries`, `known_configs.go:144-185`;
+schema `allowed_geometries.go:25-82`). Here the geometry tables are
+*generated* from the host mesh — every exact tiling of the mesh into valid
+slice shapes — which is both exhaustive and provably placeable, while keeping
+the same YAML override hook for operators who want to restrict shapes.
+
+A valid slice shape is an axis-aligned sub-mesh with a power-of-two chip
+count (matching real TPU slice granularity: 1, 2, 4, 8, ... chips).
+Profiles are canonicalized with dimensions sorted ascending ("1x2", not
+"2x1"); placement may use any axis permutation.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+from typing import Mapping, Sequence
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.partitioning import Geometry, geometry_id
+from walkai_nos_tpu.tpu.topology import Shape
+
+
+def canonical_profile(shape: Shape) -> str:
+    """Canonical profile name for a shape: dims sorted ascending."""
+    return topology.format_shape(tuple(sorted(shape)))
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+@lru_cache(maxsize=None)
+def candidate_shapes(host_mesh: Shape) -> tuple[Shape, ...]:
+    """All canonical slice shapes that fit in `host_mesh` (under some axis
+    permutation) and have a power-of-two chip count."""
+    ranges = [range(1, max(host_mesh) + 1) for _ in host_mesh]
+    seen: set[Shape] = set()
+    host_sorted = tuple(sorted(host_mesh))
+    for dims in itertools.product(*ranges):
+        c = tuple(sorted(dims))
+        if c in seen:
+            continue
+        if not _is_pow2(topology.shape_chip_count(c)):
+            continue
+        # canonical shape must fit the host mesh dim-by-dim after sorting
+        if all(a <= b for a, b in zip(c, host_sorted)):
+            seen.add(c)
+    return tuple(sorted(seen, key=lambda s: (topology.shape_chip_count(s), s)))
+
+
+@lru_cache(maxsize=None)
+def generate_tilings(host_mesh: Shape) -> tuple[str, ...]:
+    """Enumerate every exact tiling of `host_mesh` by candidate shapes.
+
+    Returns geometry IDs (see below for the dict form). Exact cover by
+    backtracking over grid cells in row-major order: find the first empty
+    cell, try each shape orientation anchored there. The grid is tiny
+    (≤ 8 cells on current hosts) so this is instant and cached. Shares its
+    grid machinery with the packer (`grid.py`) so every enumerated tiling
+    is placeable by construction.
+    """
+    from walkai_nos_tpu.tpu.tiling import grid as gridlib
+
+    shapes = candidate_shapes(host_mesh)
+    n_cells = topology.shape_chip_count(host_mesh)
+    grid = [False] * n_cells
+    coords = gridlib.all_coords(host_mesh)
+    geometries: dict[str, Geometry] = {}
+
+    def backtrack(current: dict[str, int]) -> None:
+        anchor = gridlib.first_empty(grid, coords, host_mesh)
+        if anchor is None:
+            geometries[geometry_id(current)] = dict(current)
+            return
+        for shape in shapes:
+            for orient in gridlib.orientations(shape):
+                idxs = gridlib.placement_cells(grid, anchor, orient, host_mesh)
+                if idxs is None:
+                    continue
+                for i in idxs:
+                    grid[i] = True
+                prof = canonical_profile(shape)
+                current[prof] = current.get(prof, 0) + 1
+                backtrack(current)
+                current[prof] -= 1
+                if current[prof] == 0:
+                    del current[prof]
+                for i in idxs:
+                    grid[i] = False
+
+    backtrack({})
+    return tuple(sorted(geometries))
+
+
+# ---------------------------------------------------------------------------
+# Operator-facing table: model name -> list of allowed geometries, with the
+# same override mechanism as the reference (`known_configs.go:144-185`).
+# ---------------------------------------------------------------------------
+
+_overrides: dict[str, list[Geometry]] = {}
+
+
+def _geometries_from_ids(ids: Sequence[str]) -> list[Geometry]:
+    out = []
+    for gid in ids:
+        geom: Geometry = {}
+        for part in gid.split("|"):
+            if not part:
+                continue
+            prof, _, qty = part.partition("=")
+            geom[prof] = int(qty)
+        out.append(geom)
+    return out
+
+
+def get_allowed_geometries(model: topology.TpuModel) -> list[Geometry]:
+    """All allowed geometries for a model — the `GetKnownGeometries` analogue
+    (`known_configs.go:25-140`). Overrides win when installed."""
+    if model.name in _overrides:
+        return [dict(g) for g in _overrides[model.name]]
+    return _geometries_from_ids(generate_tilings(model.host_mesh))
+
+
+def validate_geometry(model: topology.TpuModel, geometry: Mapping[str, int]) -> None:
+    """Validate an override geometry: known shapes, positive counts, chips
+    must not exceed the host mesh, and the multiset must be placeable
+    (packable) on the host mesh. Reference validation: `known_configs.go:164-185`.
+    """
+    from walkai_nos_tpu.tpu.tiling import packing
+
+    if not geometry:
+        raise ValueError("geometry must not be empty")
+    total = 0
+    for prof, qty in geometry.items():
+        shape = topology.parse_shape(prof)
+        if canonical_profile(shape) != prof:
+            raise ValueError(
+                f"profile {prof!r} is not canonical (dims must be ascending)"
+            )
+        if qty <= 0:
+            raise ValueError(f"profile {prof!r}: quantity must be positive")
+        if not _is_pow2(topology.shape_chip_count(shape)):
+            raise ValueError(f"profile {prof!r}: chip count must be a power of two")
+        total += topology.shape_chip_count(shape) * qty
+    if total > model.chips_per_host:
+        raise ValueError(
+            f"geometry needs {total} chips but {model.name} hosts have "
+            f"{model.chips_per_host}"
+        )
+    if packing.pack_geometry(model.host_mesh, dict(geometry), pinned=[]) is None:
+        raise ValueError(f"geometry {dict(geometry)} is not placeable on "
+                         f"{topology.format_shape(model.host_mesh)}")
+
+
+def set_known_geometries(table: Mapping[str, Sequence[Mapping[str, int]]]) -> None:
+    """Install operator-provided geometry tables, replacing the generated
+    ones for the listed models (`SetKnownGeometries`, `known_configs.go:144`).
+
+    `table` maps model name -> list of geometries. Validates everything
+    before installing anything (all-or-nothing, like the reference).
+    """
+    from walkai_nos_tpu.tpu.topology import KNOWN_MODELS
+
+    staged: dict[str, list[Geometry]] = {}
+    for model_name, geoms in table.items():
+        model = KNOWN_MODELS.get(model_name)
+        if model is None:
+            raise ValueError(f"unknown TPU model {model_name!r}")
+        validated: list[Geometry] = []
+        for g in geoms:
+            validate_geometry(model, g)
+            validated.append(dict(g))
+        if not validated:
+            raise ValueError(f"model {model_name!r}: empty geometry list")
+        staged[model_name] = validated
+    _overrides.update(staged)
+
+
+def clear_known_geometries() -> None:
+    """Drop overrides (test hook)."""
+    _overrides.clear()

@@ -1,0 +1,165 @@
+"""Per-host-mesh tiling state and the geometry search.
+
+`TpuMesh` is the analogue of `mig.GPU` (`pkg/gpu/mig/gpu.go:29-315`): it
+tracks used/free slice counts per profile for one host ICI mesh, knows the
+allowed geometries for its model, and implements the geometry-transition
+search with the reference's lexicographic scoring
+(`gpu.go:160-262`): among allowed geometries that keep every used slice,
+prefer (most wanted-profiles provided, most total slices, smallest distance
+from the current geometry, smallest ID) — in that order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.partitioning import (
+    Geometry,
+    geometry_id,
+    geometry_total_slices,
+)
+from walkai_nos_tpu.tpu.tiling import known_tilings
+
+
+@dataclass
+class TpuMesh:
+    model: topology.TpuModel
+    mesh_index: int = 0
+    used: Geometry = field(default_factory=dict)
+    free: Geometry = field(default_factory=dict)
+
+    # ------------------------------------------------------------------ state
+
+    def geometry(self) -> Geometry:
+        """Current geometry = used + free (`gpu.go:86-97`)."""
+        geom: Geometry = dict(self.free)
+        for p, q in self.used.items():
+            geom[p] = geom.get(p, 0) + q
+        return {p: q for p, q in geom.items() if q > 0}
+
+    def allowed_geometries(self) -> list[Geometry]:
+        return known_tilings.get_allowed_geometries(self.model)
+
+    def has_any_slice(self) -> bool:
+        return bool(self.geometry())
+
+    def free_count(self, profile: str) -> int:
+        return self.free.get(profile, 0)
+
+    def used_count(self, profile: str) -> int:
+        return self.used.get(profile, 0)
+
+    def clone(self) -> "TpuMesh":
+        return TpuMesh(
+            model=self.model,
+            mesh_index=self.mesh_index,
+            used=dict(self.used),
+            free=dict(self.free),
+        )
+
+    # ------------------------------------------------------- geometry changes
+
+    def can_apply_geometry(self, geometry: Geometry) -> bool:
+        """A transition may never drop a used slice (`gpu.go:99-118`)."""
+        return all(
+            geometry.get(p, 0) >= q for p, q in self.used.items() if q > 0
+        )
+
+    def apply_geometry(self, geometry: Geometry) -> None:
+        """Set the mesh to `geometry`, keeping used counts (`gpu.go:140-158`)."""
+        if not self.can_apply_geometry(geometry):
+            raise GenericError(
+                f"mesh {self.mesh_index}: geometry {geometry} drops used slices "
+                f"{self.used}"
+            )
+        self.free = {
+            p: geometry.get(p, 0) - self.used.get(p, 0)
+            for p in geometry
+            if geometry.get(p, 0) - self.used.get(p, 0) > 0
+        }
+
+    def init_geometry(self) -> bool:
+        """First-touch default: the fewest-slices allowed geometry
+        (`gpu.go:120-138`). Returns False when the model has no geometries."""
+        from walkai_nos_tpu.tpu.partitioning import get_fewest_slices_geometry
+
+        geom = get_fewest_slices_geometry(self.allowed_geometries())
+        if geom is None:
+            return False
+        self.apply_geometry(geom)
+        return True
+
+    # ---------------------------------------------------------------- search
+
+    def _provided_profiles(self, geometry: Geometry, wanted: Geometry) -> int:
+        """How many of the wanted slices this geometry would newly provide as
+        *free* devices (`gpu.go:198-230` `countProvidedProfiles`)."""
+        provided = 0
+        for p, q in wanted.items():
+            if q <= 0:
+                continue
+            would_be_free = geometry.get(p, 0) - self.used.get(p, 0)
+            provided += max(0, min(q, would_be_free))
+        return provided
+
+    def _geometry_distance(self, geometry: Geometry) -> int:
+        """Sum of absolute per-profile count differences vs. the current
+        geometry — fewer slice create/deletes to actuate (`gpu.go:245-262`)."""
+        current = self.geometry()
+        keys = set(current) | set(geometry)
+        return sum(abs(current.get(p, 0) - geometry.get(p, 0)) for p in keys)
+
+    def update_geometry_for(self, wanted: Geometry) -> bool:
+        """Transition to the allowed geometry best providing `wanted`.
+
+        Scoring is the reference's lexicographic rule (`gpu.go:232-243`
+        `isBetterGeometryScore`): more provided profiles beats everything;
+        then more total slices; then smaller distance to the current
+        geometry; then smaller geometry ID (pure determinism tie-break).
+        Returns True iff the geometry changed and provides at least one
+        wanted profile.
+        """
+        best: Geometry | None = None
+        best_score: tuple | None = None
+        current_id = geometry_id(self.geometry())
+        for geom in self.allowed_geometries():
+            if not self.can_apply_geometry(geom):
+                continue
+            provided = self._provided_profiles(geom, wanted)
+            if provided <= 0:
+                continue
+            score = (
+                -provided,
+                -geometry_total_slices(geom),
+                self._geometry_distance(geom),
+                geometry_id(geom),
+            )
+            if best_score is None or score < best_score:
+                best, best_score = geom, score
+        if best is None or geometry_id(best) == current_id:
+            return False
+        self.apply_geometry(best)
+        return True
+
+    # ----------------------------------------------------------------- pods
+
+    def add_pod(self, profile: str, quantity: int = 1) -> None:
+        """Consume free slices for a (simulated) pod placement
+        (`gpu.go:289-315`)."""
+        if self.free.get(profile, 0) < quantity:
+            raise GenericError(
+                f"mesh {self.mesh_index}: cannot allocate {quantity}x{profile}, "
+                f"only {self.free.get(profile, 0)} free"
+            )
+        self.free[profile] -= quantity
+        if self.free[profile] == 0:
+            del self.free[profile]
+        self.used[profile] = self.used.get(profile, 0) + quantity
+
+    def __str__(self) -> str:
+        return (
+            f"TpuMesh(index={self.mesh_index}, model={self.model.name}, "
+            f"used={self.used}, free={self.free})"
+        )

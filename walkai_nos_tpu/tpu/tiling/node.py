@@ -1,0 +1,168 @@
+"""Node-level tiling model.
+
+Analogue of `mig.Node` (`pkg/gpu/mig/node.go:27-222`): builds the host's
+`TpuMesh` list from node labels (TPU model/topology) + status annotations
+(current used/free slices), and offers the node-level geometry search the
+cluster partitioner simulates on (`node.go:145-209`).
+
+A TPU host exposes one ICI mesh, so the list normally has one entry at
+index 0; the reference's per-GPU loop shape is kept so multi-mesh hosts and
+status annotations with higher indices keep working.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.annotations import (
+    StatusAnnotation,
+    parse_node_annotations,
+)
+from walkai_nos_tpu.tpu.device import DeviceStatus
+from walkai_nos_tpu.tpu.partitioning import Geometry, geometry_id
+from walkai_nos_tpu.tpu.tiling.mesh import TpuMesh
+
+
+@dataclass
+class Node:
+    name: str
+    model: topology.TpuModel | None
+    meshes: list[TpuMesh] = field(default_factory=list)
+
+    @staticmethod
+    def from_node(
+        name: str,
+        labels: Mapping[str, str],
+        annotations: Mapping[str, str],
+    ) -> "Node":
+        """Build from a Node object's labels + annotations
+        (`node.go:40-103` `NewNode` + `extractGPUs`)."""
+        model = topology.get_model(labels)
+        if model is None:
+            return Node(name=name, model=None, meshes=[])
+        status, _ = parse_node_annotations(annotations)
+        return Node(
+            name=name, model=model, meshes=_extract_meshes(model, status)
+        )
+
+    # ----------------------------------------------------------------- state
+
+    def geometry(self) -> dict[int, Geometry]:
+        """Per-mesh current geometry (`node.go:106-122` `Geometry`)."""
+        return {m.mesh_index: m.geometry() for m in self.meshes}
+
+    def has_free_capacity(self, wanted: Geometry) -> bool:
+        """True when some wanted profile is already free, or when any mesh
+        sits in an invalid/unknown geometry — in which case re-partitioning
+        could free capacity (`node.go:124-143` `HasFreeCapacity`)."""
+        if not self.meshes:
+            return False
+        for m in self.meshes:
+            for p, q in wanted.items():
+                if q > 0 and m.free_count(p) > 0:
+                    return True
+            # A geometry outside the allowed table — including the empty
+            # geometry of a never-partitioned mesh — means re-partitioning
+            # could free capacity (`node.go:124-139`: the reference returns
+            # true whenever the current geometry is not in the allowed list).
+            if geometry_id(m.geometry()) not in {
+                geometry_id(g) for g in m.allowed_geometries()
+            }:
+                return True
+        return False
+
+    def provides_profiles(self, wanted: Geometry) -> bool:
+        """True when current *free* slices satisfy all wanted quantities."""
+        remaining = {p: q for p, q in wanted.items() if q > 0}
+        for m in self.meshes:
+            for p in list(remaining):
+                take = min(remaining[p], m.free_count(p))
+                remaining[p] -= take
+                if remaining[p] == 0:
+                    del remaining[p]
+        return not remaining
+
+    # ---------------------------------------------------------------- search
+
+    def update_geometry_for(self, wanted: Geometry) -> bool:
+        """Walk meshes, transitioning each toward the still-unsatisfied part
+        of `wanted` (`node.go:145-165`): after each mesh transition, subtract
+        what that mesh now provides free. Returns True if any mesh changed.
+        """
+        remaining = {p: q for p, q in wanted.items() if q > 0}
+        changed = False
+        for m in self.meshes:
+            if not remaining:
+                break
+            # First subtract what is already free on this mesh.
+            for p in list(remaining):
+                take = min(remaining[p], m.free_count(p))
+                if take:
+                    remaining[p] -= take
+                    if remaining[p] == 0:
+                        del remaining[p]
+            if not remaining:
+                break
+            if m.update_geometry_for(remaining):
+                changed = True
+                for p in list(remaining):
+                    take = min(remaining[p], m.free_count(p))
+                    if take:
+                        remaining[p] -= take
+                        if remaining[p] == 0:
+                            del remaining[p]
+        return changed
+
+    def add_pod(self, profiles: Geometry) -> None:
+        """Consume free slices across meshes for a simulated pod.
+
+        Atomic like the reference (`node.go:167-189`): the pod is placed
+        whole or the node is left untouched, so callers may catch the error
+        and keep simulating with the same object.
+        """
+        from walkai_nos_tpu.tpu.errors import GenericError
+
+        if not self.provides_profiles(profiles):
+            raise GenericError(
+                f"node {self.name}: cannot place "
+                f"{ {p: q for p, q in profiles.items() if q > 0} }"
+            )
+        remaining = {p: q for p, q in profiles.items() if q > 0}
+        for m in self.meshes:
+            for p in list(remaining):
+                take = min(remaining[p], m.free_count(p))
+                for _ in range(take):
+                    m.add_pod(p)
+                remaining[p] -= take
+                if remaining[p] == 0:
+                    del remaining[p]
+
+    def clone(self) -> "Node":
+        """Deep copy for what-if simulation (`node.go:211-222`)."""
+        return Node(
+            name=self.name,
+            model=self.model,
+            meshes=[m.clone() for m in self.meshes],
+        )
+
+
+def _extract_meshes(
+    model: topology.TpuModel, status: list[StatusAnnotation]
+) -> list[TpuMesh]:
+    """Build meshes from status annotations; indexes without annotations get
+    an empty mesh (`node.go:65-103` `extractGPUs` — missing GPUs added empty).
+    """
+    indices = {s.mesh_index for s in status} | {0}
+    meshes = []
+    for idx in sorted(indices):
+        used: Geometry = {}
+        free: Geometry = {}
+        for s in status:
+            if s.mesh_index != idx or s.quantity <= 0:
+                continue
+            target = used if s.status == DeviceStatus.USED else free
+            target[s.profile] = target.get(s.profile, 0) + s.quantity
+        meshes.append(TpuMesh(model=model, mesh_index=idx, used=used, free=free))
+    return meshes

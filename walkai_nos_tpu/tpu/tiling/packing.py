@@ -1,0 +1,141 @@
+"""Deterministic mesh packing: geometry (shape multiset) -> chip placements.
+
+This is the TPU replacement for the reference's NVML placement-permutation
+search (`pkg/gpu/nvml/client.go:225-334`, which iterates O(n!) creation
+orders until one satisfies MIG placement rules). TPU sub-slices must be
+contiguous axis-aligned sub-meshes, so instead of permuting we solve the
+placement directly with a small exact backtracking packer that:
+
+- honors *pinned* placements (slices hosting running pods must not move —
+  the used-device invariant of `pkg/gpu/mig/gpu.go:99`),
+- anchors at the first empty cell in row-major order and, at each anchor,
+  tries every distinct remaining profile (largest first) in deterministic
+  orientation order — so fragmented layouts around pinned slices are still
+  found, and the same inputs always yield the same layout (idempotent
+  actuation),
+- allows cells to stay unexposed (partial geometries) via an explicit
+  hole branch, pruned by a chips-remaining bound.
+
+Returns None when the geometry cannot be placed — callers treat that like a
+failed NVML create and roll back (`actuator.go:287`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.partitioning import Geometry
+from walkai_nos_tpu.tpu.tiling import grid as gridlib
+from walkai_nos_tpu.tpu.topology import Shape
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One slice placed on the host mesh."""
+
+    profile: str  # canonical profile name, e.g. "2x2"
+    offset: tuple[int, ...]  # anchor coordinate (top-left corner)
+    orientation: Shape  # actual dims at this placement (a permutation
+    # of the canonical profile shape)
+
+    def cells(self) -> list[tuple[int, ...]]:
+        import itertools
+
+        return [
+            tuple(a + x for a, x in zip(self.offset, off))
+            for off in itertools.product(*[range(o) for o in self.orientation])
+        ]
+
+    @property
+    def chip_count(self) -> int:
+        return topology.shape_chip_count(self.orientation)
+
+    def slice_id(self) -> str:
+        """Stable identifier, e.g. ``"2x2@0-0"``."""
+        return f"{self.profile}@{'-'.join(str(c) for c in self.offset)}"
+
+
+def pack_geometry(
+    host_mesh: Shape,
+    geometry: Geometry,
+    pinned: list[Placement],
+) -> list[Placement] | None:
+    """Place `geometry` on `host_mesh`, keeping every placement in `pinned`
+    exactly where it is. Returns the full placement list (pinned first,
+    then new placements in deterministic order), or None if infeasible.
+
+    `geometry` counts include the pinned slices; a geometry that doesn't
+    cover the pinned profiles is infeasible by definition.
+    """
+    n_cells = topology.shape_chip_count(host_mesh)
+    grid = [False] * n_cells
+
+    remaining: Geometry = {p: q for p, q in geometry.items() if q > 0}
+    for p in pinned:
+        if remaining.get(p.profile, 0) <= 0:
+            return None  # geometry drops a pinned (used) slice
+        remaining[p.profile] -= 1
+        if remaining[p.profile] == 0:
+            del remaining[p.profile]
+        for cell in p.cells():
+            if any(c >= d for c, d in zip(cell, host_mesh)):
+                return None  # pinned placement out of bounds
+            idx = gridlib.coord_to_idx(cell, host_mesh)
+            if grid[idx]:
+                return None  # pinned placements overlap
+            grid[idx] = True
+
+    coords = gridlib.all_coords(host_mesh)
+    placed: list[Placement] = []
+
+    def chips_of(prof: str) -> int:
+        return topology.shape_chip_count(topology.parse_shape(prof))
+
+    def backtrack() -> bool:
+        if not remaining:
+            return True  # leftover cells simply stay unexposed
+        anchor = gridlib.first_empty(grid, coords, host_mesh)
+        if anchor is None:
+            return False  # slices left but no space
+        # Try every distinct remaining profile at this anchor, largest
+        # first (deterministic tie-break by name).
+        for prof in sorted(remaining, key=lambda p: (-chips_of(p), p)):
+            shape = topology.parse_shape(prof)
+            for orient in gridlib.orientations(shape):
+                idxs = gridlib.placement_cells(grid, anchor, orient, host_mesh)
+                if idxs is None:
+                    continue
+                for x in idxs:
+                    grid[x] = True
+                remaining[prof] -= 1
+                if remaining[prof] == 0:
+                    del remaining[prof]
+                placed.append(Placement(prof, anchor, orient))
+                if backtrack():
+                    return True
+                placed.pop()
+                remaining[prof] = remaining.get(prof, 0) + 1
+                for x in idxs:
+                    grid[x] = False
+        # Leave this anchor cell unexposed (partial geometry) if the
+        # remaining slices still fit in the other free cells.
+        needed = sum(chips_of(p) * q for p, q in remaining.items())
+        if grid.count(False) - 1 >= needed:
+            hole = gridlib.coord_to_idx(anchor, host_mesh)
+            grid[hole] = True
+            if backtrack():
+                return True
+            grid[hole] = False
+        return False
+
+    if not backtrack():
+        return None
+    return list(pinned) + placed
+
+
+def placements_for_profiles(
+    host_mesh: Shape, profiles: Geometry
+) -> list[Placement] | None:
+    """Convenience: pack with nothing pinned."""
+    return pack_geometry(host_mesh, profiles, pinned=[])

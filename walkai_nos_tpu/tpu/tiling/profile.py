@@ -1,0 +1,114 @@
+"""Slice-shape profiles: the TPU analogue of MIG profile names.
+
+A tiling profile is a mesh-shape string such as ``"2x2"``; the resource name
+advertised by the device plugin is ``walkai.io/tpu-2x2``. Mirrors
+`pkg/gpu/mig/profile.go:30-114` (regex validation, resource-name mapping,
+size ordering) and `pkg/gpu/mig/util.go:30-132` (resource-name regexes,
+profile extraction, requested-profiles-from-pod).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Mapping
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.utils.quantity import parse_quantity
+
+_RESOURCE_RE = re.compile(
+    re.escape(constants.RESOURCE_TPU_SLICE_PREFIX) + r"(\d+(?:x\d+)*)$"
+)
+
+
+@dataclass(frozen=True, order=True)
+class Profile:
+    """A validated slice shape, ordered by (chip count, name)."""
+
+    # order=True sorts by fields in declaration order; put chip count first.
+    chips: int
+    name: str
+
+    @staticmethod
+    def parse(name: str) -> "Profile":
+        shape = topology.parse_shape(name)
+        return Profile(chips=topology.shape_chip_count(shape), name=name)
+
+    @property
+    def shape(self) -> topology.Shape:
+        return topology.parse_shape(self.name)
+
+    def chip_count(self) -> int:
+        return self.chips
+
+    def smaller_than(self, other: "Profile") -> bool:
+        """Size ordering (`profile.go:95-114` `SmallerThan`)."""
+        return self.chips < other.chips
+
+    def as_resource_name(self) -> str:
+        return profile_resource_name(self.name)
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def profile_resource_name(profile: str) -> str:
+    """``"2x2"`` -> ``"walkai.io/tpu-2x2"`` (`profile.go:83-93` analogue)."""
+    return constants.RESOURCE_TPU_SLICE_PREFIX + profile
+
+
+def is_slice_resource(resource_name: str) -> bool:
+    """True for `walkai.io/tpu-<shape>` resources (`util.go:30-40` analogue)."""
+    return _RESOURCE_RE.match(resource_name) is not None
+
+
+def extract_profile_name(resource_name: str) -> str:
+    """``"walkai.io/tpu-2x2"`` -> ``"2x2"`` (`util.go:42-66` analogue).
+
+    Raises ValueError for non-slice resources.
+    """
+    m = _RESOURCE_RE.match(resource_name)
+    if m is None:
+        raise ValueError(f"{resource_name!r} is not a TPU slice resource")
+    return m.group(1)
+
+
+def get_requested_profiles(pod: Mapping) -> dict[str, int]:
+    """Parse a pod manifest's container requests into {profile: quantity}.
+
+    Counts ``max(init, sum(containers))`` per resource like the scheduler's
+    pod-request math (`pkg/resource/resource.go:107-146`), restricted to
+    slice resources. Quantities use the k8s Quantity grammar; malformed or
+    non-positive quantities are skipped rather than crashing the controller.
+    Reference: `pkg/gpu/mig/util.go:87-108` (`GetRequestedProfiles`).
+    """
+    spec = pod.get("spec", {})
+
+    def slice_requests(c: Mapping) -> dict[str, int]:
+        reqs = (c.get("resources") or {}).get("requests") or {}
+        # limits count too for extended resources (k8s requires
+        # requests == limits for them; tolerate either being set).
+        limits = (c.get("resources") or {}).get("limits") or {}
+        merged = {**limits, **reqs}
+        out: dict[str, int] = {}
+        for res, raw in merged.items():
+            if not is_slice_resource(res):
+                continue
+            try:
+                qty = parse_quantity(raw)
+            except ValueError:
+                continue
+            if qty > 0:
+                p = extract_profile_name(res)
+                out[p] = out.get(p, 0) + qty
+        return out
+
+    main: dict[str, int] = {}
+    for c in spec.get("containers", []) or []:
+        for p, q in slice_requests(c).items():
+            main[p] = main.get(p, 0) + q
+    for c in spec.get("initContainers", []) or []:
+        for p, q in slice_requests(c).items():
+            main[p] = max(main.get(p, 0), q)
+    return main

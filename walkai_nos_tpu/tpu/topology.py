@@ -1,0 +1,107 @@
+"""TPU mesh topology: shapes, parsing, accelerator models.
+
+A TPU host exposes its chips as an ICI mesh described by a shape string such
+as ``2x4`` (v5e-8: 2×4 = 8 chips) or ``2x2x1`` (v4/v5p host: 4 chips).
+This module is the analogue of the reference's GPU-model layer
+(`pkg/gpu/model.go:19-29` + the GFD label helpers `pkg/gpu/util.go:29-89`),
+with mesh shapes instead of memory sizes.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Mapping
+
+from walkai_nos_tpu.api import constants
+
+# Shape: dimensions of an axis-aligned sub-mesh, e.g. (2, 4) or (2, 2, 1).
+Shape = tuple[int, ...]
+
+_SHAPE_RE = re.compile(r"^\d+(x\d+)*$")
+
+
+def parse_shape(s: str) -> Shape:
+    """Parse ``"2x4"`` -> ``(2, 4)``. Raises ValueError on malformed input."""
+    if not _SHAPE_RE.match(s):
+        raise ValueError(f"invalid topology shape {s!r}")
+    dims = tuple(int(p) for p in s.split("x"))
+    if any(d <= 0 for d in dims):
+        raise ValueError(f"invalid topology shape {s!r}: dims must be positive")
+    return dims
+
+
+def format_shape(shape: Shape) -> str:
+    return "x".join(str(d) for d in shape)
+
+
+def shape_chip_count(shape: Shape) -> int:
+    return math.prod(shape)
+
+
+@dataclass(frozen=True)
+class TpuModel:
+    """A known TPU accelerator model (one GKE accelerator label value).
+
+    `host_mesh` is the per-host ICI mesh this control plane partitions —
+    partitioning is host-local, exactly as the reference partitions one GPU
+    at a time (multi-host slices are scheduled whole, not partitioned).
+    """
+
+    name: str  # GKE accelerator label value, e.g. "tpu-v5-lite-podslice"
+    generation: str  # "v4" | "v5e" | "v5p" | "v6e"
+    host_mesh: Shape  # chips per host as a mesh
+    hbm_gb_per_chip: int
+
+    @property
+    def chips_per_host(self) -> int:
+        return shape_chip_count(self.host_mesh)
+
+
+# Known models, keyed by the `cloud.google.com/gke-tpu-accelerator` label
+# value. The reference's analogue is the A30/A100 model enum
+# (`pkg/gpu/model.go:19-29`).
+KNOWN_MODELS: dict[str, TpuModel] = {
+    m.name: m
+    for m in [
+        TpuModel("tpu-v4-podslice", "v4", (2, 2, 1), 32),
+        TpuModel("tpu-v5-lite-podslice", "v5e", (2, 4), 16),
+        TpuModel("tpu-v5-lite-device", "v5e", (2, 4), 16),
+        TpuModel("tpu-v5p-slice", "v5p", (2, 2, 1), 95),
+        TpuModel("tpu-v6e-slice", "v6e", (2, 4), 32),
+    ]
+}
+
+
+def get_model(node_labels: Mapping[str, str]) -> TpuModel | None:
+    """Resolve the TPU model from node labels (`pkg/gpu/util.go:29-45` analogue).
+
+    Honors an explicit `gke-tpu-topology` label when it describes a
+    *single-host* mesh smaller than the model default (e.g. a v5e-4 host).
+    """
+    acc = node_labels.get(constants.LABEL_TPU_ACCELERATOR)
+    if acc is None:
+        return None
+    model = KNOWN_MODELS.get(acc)
+    if model is None:
+        return None
+    topo = node_labels.get(constants.LABEL_TPU_TOPOLOGY)
+    if topo:
+        try:
+            shape = parse_shape(topo)
+        except ValueError:
+            return model
+        if (
+            len(shape) == len(model.host_mesh)
+            and shape_chip_count(shape) <= model.chips_per_host
+            and all(a <= b for a, b in zip(shape, model.host_mesh))
+        ):
+            return TpuModel(model.name, model.generation, shape, model.hbm_gb_per_chip)
+    return model
+
+
+def get_chip_count(node_labels: Mapping[str, str]) -> int | None:
+    """Chip count of the node's host mesh (`pkg/gpu/util.go:47-60` analogue)."""
+    model = get_model(node_labels)
+    return model.chips_per_host if model else None

@@ -1,0 +1,1 @@
+from walkai_nos_tpu.utils.quantity import parse_quantity  # noqa: F401

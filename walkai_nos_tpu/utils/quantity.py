@@ -1,0 +1,59 @@
+"""Kubernetes resource.Quantity parsing (integer subset).
+
+Device-plugin (extended) resources are integer quantities, but the k8s API
+accepts any Quantity serialization for them ("2", "2k", "2Ki"). The
+reference gets this for free from apimachinery; here we implement the
+integer subset so controllers never crash on a legally-encoded pod spec.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal, InvalidOperation
+
+_SUFFIXES = {
+    "": 1,
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+    "Pi": 2**50,
+    "Ei": 2**60,
+    "m": Decimal("0.001"),
+}
+
+
+def parse_quantity(value: str | int | float) -> int:
+    """Parse a k8s Quantity into an integer count.
+
+    Raises ValueError for malformed input or non-integer results (extended
+    resources must be whole numbers).
+    """
+    if isinstance(value, int):
+        return value
+    if isinstance(value, float):
+        if value != int(value):
+            raise ValueError(f"quantity {value!r} is not an integer")
+        return int(value)
+    s = str(value).strip()
+    if not s:
+        raise ValueError("empty quantity")
+    suffix = ""
+    for suf in sorted(_SUFFIXES, key=len, reverse=True):
+        if suf and s.endswith(suf):
+            suffix = suf
+            s = s[: -len(suf)]
+            break
+    try:
+        num = Decimal(s)
+    except InvalidOperation as e:
+        raise ValueError(f"invalid quantity {value!r}") from e
+    result = num * Decimal(_SUFFIXES[suffix])
+    if result != result.to_integral_value():
+        raise ValueError(f"quantity {value!r} is not an integer")
+    return int(result)
