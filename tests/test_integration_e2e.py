@@ -1,0 +1,176 @@
+"""End-to-end integration: the §7.3 minimum slice, on the sim harness.
+
+The analogue of the reference's envtest suites
+(`internal/controllers/migagent/suite_int_test.go`,
+`actuator_int_test.go:64-206`): real controllers, fake boundaries, assert
+on node-annotation / pod-scheduling side effects with eventually-semantics.
+"""
+
+import time
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.sim import SimCluster
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+
+
+def eventually(fn, timeout=10.0, interval=0.05, msg=""):
+    deadline = time.monotonic() + timeout
+    last_exc = None
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception as e:  # assertion helpers may race with controllers
+            last_exc = e
+        time.sleep(interval)
+    raise AssertionError(f"eventually timed out: {msg} (last: {last_exc})")
+
+
+class TestEndToEnd:
+    def test_node_init_agent_report_pod_schedules(self):
+        cluster = SimCluster()
+        cluster.add_node("tpu-node-a", mesh=(2, 4))
+        with cluster:
+            # 1. Node controller initializes the fresh node to the coarsest
+            #    tiling (whole-host 2x4).
+            def node_initialized():
+                node = cluster.kube.get("Node", "tpu-node-a")
+                _, spec = parse_node_annotations(objects.annotations(node))
+                return any(
+                    s.profile == "2x4" and s.quantity == 1 for s in spec
+                )
+
+            eventually(node_initialized, msg="node init writes default tiling spec")
+
+            # 2. The agent materializes the slice and reports status.
+            def status_reported():
+                node = cluster.kube.get("Node", "tpu-node-a")
+                status, _ = parse_node_annotations(objects.annotations(node))
+                return any(
+                    s.profile == "2x4" and s.status.value == "free"
+                    for s in status
+                )
+
+            eventually(status_reported, msg="agent reports free 2x4")
+            assert [s.profile for s in cluster.nodes["tpu-node-a"].tpudev.list_slices()] == ["2x4"]
+
+            # 3. A pod requesting a 2x2 (not exposed) goes pending; the
+            #    partitioner re-tiles; the pod schedules.
+            cluster.create_slice_pod("job-1", "2x2")
+
+            def pod_scheduled():
+                pod = cluster.kube.get("Pod", "job-1", "default")
+                return objects.pod_is_scheduled(pod)
+
+            eventually(pod_scheduled, msg="pending pod triggers re-tiling and binds")
+
+            pod = cluster.kube.get("Pod", "job-1", "default")
+            assert pod["spec"]["nodeName"] == "tpu-node-a"
+
+            # 4. The node's reported status converges to spec, with the 2x2
+            #    used by the pod.
+            def converged():
+                node = cluster.kube.get("Node", "tpu-node-a")
+                status, spec = parse_node_annotations(objects.annotations(node))
+                used_2x2 = sum(
+                    s.quantity
+                    for s in status
+                    if s.profile == "2x2" and s.status.value == "used"
+                )
+                return used_2x2 == 1
+
+            eventually(converged, msg="status shows used 2x2")
+
+            # 5. Plan-ID ack: status-partitioning-plan equals the spec plan.
+            def plan_acked():
+                node = cluster.kube.get("Node", "tpu-node-a")
+                ann = objects.annotations(node)
+                return (
+                    ann.get(constants.ANNOTATION_PARTITIONING_PLAN)
+                    is not None
+                    and ann.get(constants.ANNOTATION_PARTITIONING_PLAN)
+                    == ann.get(constants.ANNOTATION_REPORTED_PARTITIONING_PLAN)
+                )
+
+            eventually(plan_acked, msg="reporter acks the plan id")
+
+    def test_second_pod_fits_remaining_capacity(self):
+        cluster = SimCluster()
+        cluster.add_node("tpu-node-a", mesh=(2, 4))
+        with cluster:
+            cluster.create_slice_pod("job-1", "2x2")
+            cluster.create_slice_pod("job-2", "2x2")
+
+            def both_scheduled():
+                pods = [
+                    cluster.kube.get("Pod", n, "default")
+                    for n in ("job-1", "job-2")
+                ]
+                return all(objects.pod_is_scheduled(p) for p in pods)
+
+            eventually(both_scheduled, timeout=15, msg="both 2x2 pods bind")
+
+    def test_device_plugin_restarted_on_retile(self):
+        cluster = SimCluster()
+        cluster.add_node("tpu-node-a", mesh=(2, 4))
+        with cluster:
+            # wait for initial materialization
+            def initial():
+                return [
+                    s.profile
+                    for s in cluster.nodes["tpu-node-a"].tpudev.list_slices()
+                ] == ["2x4"]
+
+            eventually(initial, msg="initial whole-host slice")
+            plugin_before = cluster.kube.list(
+                "Pod",
+                label_selector={
+                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                },
+            )
+            assert len(plugin_before) == 1
+            uid_before = objects.uid(plugin_before[0])
+
+            cluster.create_slice_pod("job-1", "1x2")
+
+            def retiled_and_plugin_restarted():
+                pods = cluster.kube.list(
+                    "Pod",
+                    label_selector={
+                        constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                    },
+                )
+                return (
+                    len(pods) == 1
+                    and objects.uid(pods[0]) != uid_before
+                )
+
+            eventually(
+                retiled_and_plugin_restarted,
+                timeout=15,
+                msg="device plugin pod replaced after re-tiling",
+            )
+
+    def test_multi_node_first_fit(self):
+        cluster = SimCluster()
+        cluster.add_node("node-a", mesh=(2, 4))
+        cluster.add_node("node-b", mesh=(2, 4))
+        with cluster:
+            # Five 2x2 pods: one host provides at most two -> both nodes used.
+            for i in range(4):
+                cluster.create_slice_pod(f"job-{i}", "2x2")
+
+            def all_scheduled():
+                pods = [
+                    cluster.kube.get("Pod", f"job-{i}", "default")
+                    for i in range(4)
+                ]
+                return all(objects.pod_is_scheduled(p) for p in pods)
+
+            eventually(all_scheduled, timeout=20, msg="4x 2x2 across two hosts")
+            nodes_used = {
+                cluster.kube.get("Pod", f"job-{i}", "default")["spec"]["nodeName"]
+                for i in range(4)
+            }
+            assert nodes_used == {"node-a", "node-b"}

@@ -1,0 +1,195 @@
+"""Fake kube client + runtime tests."""
+
+import threading
+import time
+
+import pytest
+
+from walkai_nos_tpu.kube import objects, predicates
+from walkai_nos_tpu.kube.client import Conflict, NotFound
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.runtime import Controller, Request, Result
+
+
+def node(name, labels=None, annotations=None):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": labels or {},
+            "annotations": annotations or {},
+        }
+    }
+
+
+class TestFakeCrud:
+    def test_create_get(self):
+        c = FakeKubeClient()
+        c.create("Node", node("n1"))
+        got = c.get("Node", "n1")
+        assert objects.name(got) == "n1"
+        assert got["metadata"]["resourceVersion"]
+
+    def test_get_missing(self):
+        with pytest.raises(NotFound):
+            FakeKubeClient().get("Node", "nope")
+
+    def test_create_duplicate(self):
+        c = FakeKubeClient()
+        c.create("Node", node("n1"))
+        with pytest.raises(Conflict):
+            c.create("Node", node("n1"))
+
+    def test_namespacing(self):
+        c = FakeKubeClient()
+        c.create("Pod", {"metadata": {"name": "p", "namespace": "a"}})
+        c.create("Pod", {"metadata": {"name": "p", "namespace": "b"}})
+        assert len(c.list("Pod")) == 2
+        assert len(c.list("Pod", namespace="a")) == 1
+        c.delete("Pod", "p", "a")
+        assert len(c.list("Pod")) == 1
+
+    def test_label_selector(self):
+        c = FakeKubeClient()
+        c.create("Node", node("n1", labels={"x": "1"}))
+        c.create("Node", node("n2", labels={"x": "2"}))
+        assert [objects.name(n) for n in c.list("Node", label_selector={"x": "1"})] == [
+            "n1"
+        ]
+
+    def test_merge_patch_annotations(self):
+        c = FakeKubeClient()
+        c.create("Node", node("n1", annotations={"a": "1", "b": "2"}))
+        c.patch("Node", "n1", objects.annotation_patch({"a": None, "c": "3"}))
+        ann = objects.annotations(c.get("Node", "n1"))
+        assert ann == {"b": "2", "c": "3"}
+
+    def test_update_conflict_on_stale_rv(self):
+        c = FakeKubeClient()
+        created = c.create("Node", node("n1"))
+        c.patch("Node", "n1", {"metadata": {"labels": {"x": "1"}}})
+        with pytest.raises(Conflict):
+            c.update("Node", created)  # stale resourceVersion
+
+    def test_field_selector(self):
+        c = FakeKubeClient()
+        c.create("Pod", {"metadata": {"name": "p1", "namespace": "d"}, "spec": {"nodeName": "n1"}})
+        c.create("Pod", {"metadata": {"name": "p2", "namespace": "d"}, "spec": {"nodeName": "n2"}})
+        got = c.list("Pod", field_selector={"spec.nodeName": "n1"})
+        assert [objects.name(p) for p in got] == ["p1"]
+
+
+class TestWatch:
+    def test_backlog_and_live_events(self):
+        c = FakeKubeClient()
+        c.create("Node", node("n1"))
+        events = []
+        stop = threading.Event()
+
+        def consume():
+            for ev in c.watch("Node", stop=stop.is_set):
+                events.append(ev)
+                if len(events) >= 3:
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        c.patch("Node", "n1", {"metadata": {"labels": {"x": "1"}}})
+        c.delete("Node", "n1")
+        t.join(timeout=2)
+        stop.set()
+        kinds = [e[0] for e in events]
+        assert kinds == ["ADDED", "MODIFIED", "DELETED"]
+
+
+class TestPredicates:
+    def test_matching_name(self):
+        p = predicates.matching_name("n1")
+        assert p("ADDED", node("n1"), None)
+        assert not p("ADDED", node("n2"), None)
+
+    def test_exclude_delete(self):
+        p = predicates.exclude_delete()
+        assert not p("DELETED", node("n1"), None)
+        assert p("ADDED", node("n1"), None)
+
+    def test_annotations_changed(self):
+        p = predicates.annotations_changed()
+        old = node("n1", annotations={"a": "1"})
+        same = node("n1", annotations={"a": "1"})
+        diff = node("n1", annotations={"a": "2"})
+        assert not p("MODIFIED", same, old)
+        assert p("MODIFIED", diff, old)
+        assert p("ADDED", same, None)
+
+    def test_node_resources_changed(self):
+        p = predicates.node_resources_changed()
+        old = {"metadata": {"name": "n"}, "status": {"capacity": {"x": "1"}, "allocatable": {"x": "1"}}}
+        cap_changed = {"metadata": {"name": "n"}, "status": {"capacity": {"x": "2"}, "allocatable": {"x": "1"}}}
+        both_changed = {"metadata": {"name": "n"}, "status": {"capacity": {"x": "2"}, "allocatable": {"x": "2"}}}
+        assert p("MODIFIED", cap_changed, old)
+        assert not p("MODIFIED", both_changed, old)
+
+
+class TestController:
+    def test_reconciles_on_events_and_dedupes(self):
+        c = FakeKubeClient()
+        seen = []
+        lock = threading.Lock()
+
+        def reconcile(req: Request) -> Result:
+            with lock:
+                seen.append(req.name)
+            return Result()
+
+        ctrl = Controller("t", c, "Node", reconcile)
+        ctrl.start()
+        try:
+            c.create("Node", node("n1"))
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline:
+                with lock:
+                    if "n1" in seen:
+                        break
+                time.sleep(0.01)
+            with lock:
+                assert "n1" in seen
+        finally:
+            ctrl.stop()
+
+    def test_requeue_after(self):
+        c = FakeKubeClient()
+        c.create("Node", node("n1"))
+        count = [0]
+
+        def reconcile(req: Request) -> Result:
+            count[0] += 1
+            return Result(requeue_after=0.05)
+
+        ctrl = Controller("t", c, "Node", reconcile)
+        ctrl.start()
+        try:
+            time.sleep(0.5)
+            assert count[0] >= 3
+        finally:
+            ctrl.stop()
+
+    def test_error_backoff_retries(self):
+        c = FakeKubeClient()
+        c.create("Node", node("n1"))
+        attempts = [0]
+        done = threading.Event()
+
+        def reconcile(req: Request) -> Result:
+            attempts[0] += 1
+            if attempts[0] < 3:
+                raise RuntimeError("boom")
+            done.set()
+            return Result()
+
+        ctrl = Controller("t", c, "Node", reconcile)
+        ctrl.start()
+        try:
+            assert done.wait(timeout=3)
+        finally:
+            ctrl.stop()

@@ -1,0 +1,157 @@
+"""Diff-planner tests — port of the semantics covered by
+`internal/controllers/migagent/plan/plan_test.go` (617 LoC)."""
+
+from walkai_nos_tpu.controllers.tpuagent.plan import (
+    CreateOperation,
+    TilingState,
+    new_tiling_plan,
+)
+from walkai_nos_tpu.tpu.annotations import SpecAnnotation
+from walkai_nos_tpu.tpu.device import Device, DeviceList, DeviceStatus
+
+
+def dev(profile, device_id, status=DeviceStatus.FREE, mesh=0):
+    return Device(
+        resource_name=f"walkai.io/tpu-{profile}",
+        device_id=device_id,
+        status=status,
+        mesh_index=mesh,
+    )
+
+
+def state(*devices):
+    return TilingState.from_devices(DeviceList(devices))
+
+
+def spec(*entries):
+    return [SpecAnnotation(mesh, profile, qty) for mesh, profile, qty in entries]
+
+
+class TestEmptyCases:
+    def test_empty_state_empty_spec(self):
+        plan = new_tiling_plan(state(), [])
+        assert plan.is_empty()
+
+    def test_state_matches_spec_no_ops(self):
+        s = state(dev("2x2", "a"), dev("2x2", "b"))
+        plan = new_tiling_plan(s, spec((0, "2x2", 2)))
+        assert plan.is_empty()
+
+    def test_matches_spec_helper(self):
+        s = state(dev("2x2", "a"), dev("2x2", "b", DeviceStatus.USED))
+        assert s.matches_spec(spec((0, "2x2", 2)))
+        assert not s.matches_spec(spec((0, "2x2", 1)))
+        assert not s.matches_spec(spec((0, "1x1", 2)))
+
+
+class TestCreates:
+    def test_create_missing_profile(self):
+        plan = new_tiling_plan(state(), spec((0, "2x2", 2)))
+        assert plan.create_ops == [CreateOperation(0, "2x2", 2)]
+        assert plan.delete_ops == []
+
+    def test_create_additional_quantity(self):
+        s = state(dev("2x2", "a", DeviceStatus.USED))
+        plan = new_tiling_plan(s, spec((0, "2x2", 2)))
+        assert plan.create_ops == [CreateOperation(0, "2x2", 1)]
+        # the used device is never recreated
+        assert plan.delete_ops == []
+
+
+class TestDeletes:
+    def test_delete_profile_not_in_spec(self):
+        s = state(dev("2x2", "a"), dev("2x2", "b"))
+        plan = new_tiling_plan(s, [])
+        assert len(plan.delete_ops) == 1
+        op = plan.delete_ops[0]
+        assert op.quantity == 2
+        assert {d.device_id for d in op.candidates} == {"a", "b"}
+
+    def test_delete_excess_quantity(self):
+        s = state(dev("1x1", "a"), dev("1x1", "b"), dev("1x1", "c"))
+        plan = new_tiling_plan(s, spec((0, "1x1", 1)))
+        assert plan.delete_ops[0].quantity == 2
+
+    def test_deletion_candidates_prefer_free(self):
+        # `plan_test.go`: free devices are preferred deletion candidates.
+        s = state(
+            dev("1x1", "used-1", DeviceStatus.USED),
+            dev("1x1", "free-1"),
+            dev("1x1", "free-2"),
+        )
+        plan = new_tiling_plan(s, spec((0, "1x1", 1)))
+        op = plan.delete_ops[0]
+        assert op.quantity == 2
+        assert [d.device_id for d in op.candidates[:2]] == ["free-1", "free-2"]
+
+
+class TestRecreateSemantics:
+    def test_creating_new_profiles_recreates_existing_free(self):
+        # "Creating new profiles on a GPU should delete and re-create all
+        # the existing free MIG profiles" (`plan_test.go:204` analogue):
+        # gives the packer the whole free area.
+        s = state(
+            dev("2x2", "free-2x2"),
+            dev("1x1", "used-1x1", DeviceStatus.USED),
+        )
+        plan = new_tiling_plan(s, spec((0, "2x2", 1), (0, "1x1", 5)))
+        # wants 4 more 1x1; the free 2x2 must be deleted and re-created.
+        deletes = {(o.profile, o.quantity) for o in plan.delete_ops}
+        creates = {(o.profile, o.quantity) for o in plan.create_ops}
+        assert ("2x2", 1) in deletes
+        assert ("1x1", 4) in creates
+        assert ("2x2", 1) in creates  # re-create
+
+    def test_no_recreate_on_meshes_without_creates(self):
+        s = state(
+            dev("2x2", "m0", mesh=0),
+            dev("2x2", "m1-a", mesh=1),
+        )
+        plan = new_tiling_plan(
+            s, spec((0, "2x2", 1), (1, "2x2", 1), (1, "1x1", 4))
+        )
+        # mesh 0 satisfied: no ops for mesh 0
+        assert all(o.mesh_index == 1 for o in plan.create_ops)
+        assert all(o.mesh_index == 1 for o in plan.delete_ops)
+        # mesh 1's free 2x2 is recreated
+        assert {(o.profile, o.quantity) for o in plan.create_ops} == {
+            ("1x1", 4),
+            ("2x2", 1),
+        }
+
+    def test_recreate_excludes_devices_already_doomed(self):
+        # A free device already being deleted (excess quantity) must not be
+        # double-counted by the recreate pass.
+        s = state(
+            dev("2x2", "a"),
+            dev("2x2", "b"),
+        )
+        plan = new_tiling_plan(s, spec((0, "2x2", 1), (0, "1x1", 4)))
+        # Want: delete one 2x2 (excess), recreate the kept one, create 4 1x1.
+        create_map = {(o.profile): o.quantity for o in plan.create_ops}
+        assert create_map["1x1"] == 4
+        assert create_map["2x2"] == 1
+        delete_map = {o.profile: o.quantity for o in plan.delete_ops}
+        assert delete_map["2x2"] == 2  # both free ones go (1 excess + 1 recreate)
+
+    def test_used_devices_never_in_recreate(self):
+        s = state(
+            dev("2x2", "used", DeviceStatus.USED),
+        )
+        plan = new_tiling_plan(s, spec((0, "2x2", 1), (0, "1x1", 4)))
+        assert plan.create_ops == [CreateOperation(0, "1x1", 4)]
+        assert plan.delete_ops == []
+
+
+class TestMultiMesh:
+    def test_ops_carry_mesh_index(self):
+        s = state(dev("2x2", "a", mesh=0), dev("1x1", "b", mesh=1))
+        plan = new_tiling_plan(
+            s, spec((0, "2x2", 1), (1, "1x1", 0), (1, "2x2", 1))
+        )
+        assert any(
+            o.mesh_index == 1 and o.profile == "2x2" for o in plan.create_ops
+        )
+        assert any(
+            o.mesh_index == 1 and o.profile == "1x1" for o in plan.delete_ops
+        )

@@ -42,23 +42,25 @@ class TestFromNode:
 
 
 class TestHasFreeCapacity:
-    def test_free_profile_matches(self):
-        n = make_node({"nos.walkai.io/status-tpu-0-2x2-free": "1"})
-        assert n.has_free_capacity({"2x2": 1})
+    def test_any_free_slice_counts(self):
+        # Reference semantics (`node.go:122-139`): ANY free device counts,
+        # regardless of wanted profile — a free slice can be re-tiled.
+        n = make_node({"nos.walkai.io/status-tpu-0-2x4-free": "1"})
+        assert n.has_free_capacity()
 
-    def test_no_free(self):
+    def test_fully_used_valid_geometry_has_none(self):
         n = make_node({"nos.walkai.io/status-tpu-0-2x2-used": "2"})
-        assert not n.has_free_capacity({"2x2": 1})
+        assert not n.has_free_capacity()
 
     def test_invalid_geometry_counts_as_capacity(self):
         # 1x1:3 is not an allowed geometry (not a full or generated tiling)
         # -> repartitioning could help (`node.go:124-143`).
         n = make_node({"nos.walkai.io/status-tpu-0-1x1-used": "3"})
-        assert n.has_free_capacity({"2x2": 1})
+        assert n.has_free_capacity()
 
     def test_no_meshes(self):
         n = Node.from_node("n", {}, {})
-        assert not n.has_free_capacity({"2x2": 1})
+        assert not n.has_free_capacity()
 
 
 class TestUpdateGeometryFor:
@@ -115,7 +117,7 @@ class TestReviewRegressions:
         # A never-partitioned node (empty geometry) must count as having
         # capacity, else pending pods never trigger initial partitioning.
         n = make_node(annotations={})
-        assert n.has_free_capacity({"2x2": 1})
+        assert n.has_free_capacity()
 
     def test_add_pod_is_atomic(self):
         n = make_node({"nos.walkai.io/status-tpu-0-1x1-free": "1"})

@@ -1,0 +1,120 @@
+"""tpudev fake + stub + tiling client tests (mock analogue of
+`pkg/test/mocks/nvml` usage)."""
+
+import pytest
+
+from walkai_nos_tpu.resource.fake import FakeResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+from walkai_nos_tpu.tpu.errors import GenericError, NotFoundError
+from walkai_nos_tpu.tpu.tiling.client import TilingClient
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+from walkai_nos_tpu.tpudev.stub import StubTpudevClient
+
+
+class TestFakeTpudev:
+    def test_topology(self):
+        t = FakeTpudevClient(mesh=(2, 4)).get_topology()
+        assert t.mesh == (2, 4)
+        assert t.chip_count == 8
+        assert t.chips[0].device_path == "/dev/accel0"
+
+    def test_create_list_delete(self):
+        c = FakeTpudevClient(mesh=(2, 4))
+        created = c.create_slices(
+            [Placement("2x2", (0, 0), (2, 2)), Placement("2x2", (0, 2), (2, 2))]
+        )
+        assert len(created) == 2
+        assert {s.slice_id for s in c.list_slices()} == {"2x2@0-0", "2x2@0-2"}
+        assert created[0].env["TPU_VISIBLE_CHIPS"]
+        c.delete_slice("2x2@0-0")
+        assert len(c.list_slices()) == 1
+        with pytest.raises(NotFoundError):
+            c.delete_slice("2x2@0-0")
+
+    def test_overlap_rejected(self):
+        c = FakeTpudevClient(mesh=(2, 4))
+        c.create_slices([Placement("2x2", (0, 0), (2, 2))])
+        with pytest.raises(GenericError):
+            c.create_slices([Placement("2x2", (0, 1), (2, 2))])
+
+    def test_partial_failure_returns_created(self):
+        c = FakeTpudevClient(mesh=(2, 4))
+        created = c.create_slices(
+            [
+                Placement("2x2", (0, 0), (2, 2)),
+                Placement("2x2", (0, 0), (2, 2)),  # duplicate fails
+            ]
+        )
+        assert len(created) == 1
+
+    def test_delete_all_except(self):
+        c = FakeTpudevClient(mesh=(2, 4))
+        c.create_slices(
+            [Placement("2x2", (0, 0), (2, 2)), Placement("2x2", (0, 2), (2, 2))]
+        )
+        deleted = c.delete_all_slices_except({"2x2@0-0"})
+        assert deleted == ["2x2@0-2"]
+        assert [s.slice_id for s in c.list_slices()] == ["2x2@0-0"]
+
+    def test_mesh_index_lookup(self):
+        c = FakeTpudevClient(mesh=(2, 4), mesh_index=0)
+        c.create_slices([Placement("2x2", (0, 0), (2, 2))])
+        assert c.get_slice_mesh_index("2x2@0-0") == 0
+        with pytest.raises(NotFoundError):
+            c.get_slice_mesh_index("nope")
+
+
+class TestStub:
+    def test_all_methods_fail(self):
+        s = StubTpudevClient()
+        for call in [
+            s.get_topology,
+            s.list_slices,
+            lambda: s.get_slice_mesh_index("x"),
+            lambda: s.create_slices([]),
+            lambda: s.delete_slice("x"),
+            lambda: s.delete_all_slices_except(set()),
+        ]:
+            with pytest.raises(GenericError, match="disabled"):
+                call()
+
+
+class TestTilingClient:
+    def _setup(self):
+        tpudev = FakeTpudevClient(mesh=(2, 4))
+        tpudev.create_slices(
+            [Placement("2x2", (0, 0), (2, 2)), Placement("2x2", (0, 2), (2, 2))]
+        )
+        res = FakeResourceClient()
+        res.set_allocatable(
+            [
+                Device("walkai.io/tpu-2x2", "2x2@0-0", DeviceStatus.UNKNOWN),
+                Device("walkai.io/tpu-2x2", "2x2@0-2", DeviceStatus.UNKNOWN),
+            ]
+        )
+        return TilingClient(res, tpudev), res, tpudev
+
+    def test_used_plus_free(self):
+        client, res, _ = self._setup()
+        res.mark_used("2x2@0-0")
+        devices = client.get_tpu_devices()
+        by_status = devices.group_by_status()
+        assert [d.device_id for d in by_status[DeviceStatus.USED]] == ["2x2@0-0"]
+        assert [d.device_id for d in by_status[DeviceStatus.FREE]] == ["2x2@0-2"]
+
+    def test_stale_device_raises_not_found(self):
+        client, res, tpudev = self._setup()
+        tpudev.delete_slice("2x2@0-2")  # kubelet still advertises it
+        with pytest.raises(NotFoundError):
+            client.get_tpu_devices()
+
+    def test_delete_all_except(self):
+        client, res, tpudev = self._setup()
+        from walkai_nos_tpu.tpu.device import DeviceList
+
+        keep = DeviceList(
+            [Device("walkai.io/tpu-2x2", "2x2@0-0", DeviceStatus.USED)]
+        )
+        deleted = client.delete_all_except(keep)
+        assert deleted == ["2x2@0-2"]

@@ -1,0 +1,13 @@
+"""Cluster-scope partitioner controllers.
+
+Analogue of `internal/controllers/gpupartitioner/`: the pod controller
+reacts to pending pods requesting TPU slices by re-tiling a node; the node
+controller initializes freshly labeled TPU nodes.
+"""
+
+from walkai_nos_tpu.controllers.partitioner.pod_controller import (  # noqa: F401
+    PodController,
+)
+from walkai_nos_tpu.controllers.partitioner.node_controller import (  # noqa: F401
+    NodeController,
+)

@@ -1,0 +1,51 @@
+"""Node controller: initialize freshly labeled TPU nodes.
+
+Port of `internal/controllers/gpupartitioner/node_controller.go:36-115`:
+watches nodes carrying the partitioning label; a node whose meshes carry no
+spec annotations yet is uninitialized (the reference compares GFD GPU count
+with annotated GPU count, `node_controller.go:90-97`) and gets the default
+fewest-slices tiling.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient, NotFound
+from walkai_nos_tpu.kube.runtime import Request, Result
+from walkai_nos_tpu.partitioning.initializer import NodeInitializer
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.partitioning import is_tiling_partitioning_enabled
+
+logger = logging.getLogger(__name__)
+
+
+class NodeController:
+    def __init__(self, kube: KubeClient, initializer: NodeInitializer | None = None):
+        self._kube = kube
+        self._initializer = initializer or NodeInitializer(kube)
+
+    def reconcile(self, request: Request) -> Result:
+        try:
+            node = self._kube.get("Node", request.name)
+        except NotFound:
+            return Result()
+        if not is_tiling_partitioning_enabled(objects.labels(node)):
+            return Result()
+        if self._is_initialized(node):
+            return Result()
+        logger.info("node controller: initializing node %s", request.name)
+        self._initializer.init_node_partitioning(node)
+        return Result()
+
+    def _is_initialized(self, node: dict) -> bool:
+        """Mesh count == number of spec-annotated meshes
+        (`node_controller.go:90-97` `isNodeInitialized`)."""
+        model = topology.get_model(objects.labels(node))
+        if model is None:
+            return True  # nothing to initialize
+        _, spec = parse_node_annotations(objects.annotations(node))
+        annotated_meshes = {s.mesh_index for s in spec}
+        return len(annotated_meshes) >= 1  # one mesh per host

@@ -1,0 +1,140 @@
+"""Pod controller: pending pod -> repartitioned node (the core loop).
+
+Port of `internal/controllers/gpupartitioner/mig_controller.go:35-213`:
+for a pending+unschedulable pod requesting `walkai.io/tpu-<shape>` slices,
+list tiling-partitioned nodes; if no node already exposes the wanted
+profiles free, walk nodes first-fit and try a geometry transition; on
+success write the new spec annotations + plan ID. Single-threaded
+(MaxConcurrentReconciles=1, `mig_controller.go:204`) so concurrent pending
+pods can't race partitioning decisions.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient, NotFound
+from walkai_nos_tpu.kube.runtime import Request, Result
+from walkai_nos_tpu.partitioning.partitioner import Partitioner
+from walkai_nos_tpu.partitioning.plan_id import new_partitioning_plan_id
+from walkai_nos_tpu.partitioning.state import build_node_partitioning
+from walkai_nos_tpu.tpu.partitioning import Geometry, PartitioningKind
+from walkai_nos_tpu.tpu.tiling.node import Node
+from walkai_nos_tpu.tpu.tiling.profile import get_requested_profiles
+
+logger = logging.getLogger(__name__)
+
+
+class PodController:
+    def __init__(
+        self,
+        kube: KubeClient,
+        partitioner: Partitioner | None = None,
+        plan_id_fn: Callable[[], str] = new_partitioning_plan_id,
+        retry_interval: float = 5.0,
+    ) -> None:
+        self._kube = kube
+        self._partitioner = partitioner or Partitioner(kube)
+        # Injectable plan-ID generator (test seam, `mig_controller.go:209-213`).
+        self._plan_id_fn = plan_id_fn
+        # A pod can stay unschedulable because capacity freed *after* its
+        # last event (another pod bound the only free slice); the reference
+        # leans on kube-scheduler's periodic retry updates for fresh events,
+        # which a watch-only controller can't rely on — so requeue pending
+        # pods on an interval until they bind or disappear.
+        self._retry_interval = retry_interval
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, request: Request) -> Result:
+        try:
+            pod = self._kube.get("Pod", request.name, request.namespace or None)
+        except NotFound:
+            return Result()
+        if not self._should_consider_pod(pod):
+            return Result()
+        wanted = get_requested_profiles(pod)
+        if not wanted:
+            return Result()
+
+        nodes = self._list_tiling_nodes()
+        if self._profiles_already_available(nodes, wanted):
+            # The scheduler will bind the pod on its next cycle
+            # (`mig_controller.go:121-144`).
+            return Result(requeue_after=self._retry_interval)
+        self._try_repartition(nodes, wanted, pod)
+        return Result(requeue_after=self._retry_interval)
+
+    # --------------------------------------------------------------- helpers
+
+    def _should_consider_pod(self, pod: dict) -> bool:
+        """pending ∧ not scheduled ∧ unschedulable
+        (`mig_controller.go:100-111` -> `pkg/util/pod/pod.go:38-55`)."""
+        return (
+            objects.pod_is_pending(pod)
+            and not objects.pod_is_scheduled(pod)
+            and objects.pod_is_unschedulable(pod)
+        )
+
+    def _list_tiling_nodes(self) -> list[dict]:
+        return self._kube.list(
+            "Node",
+            label_selector={
+                constants.LABEL_TPU_PARTITIONING: PartitioningKind.TILING.value
+            },
+        )
+
+    def _profiles_already_available(
+        self, nodes: list[dict], wanted: Geometry
+    ) -> bool:
+        for node_obj in nodes:
+            node = Node.from_node(
+                objects.name(node_obj),
+                objects.labels(node_obj),
+                objects.annotations(node_obj),
+            )
+            if node.provides_profiles(wanted):
+                return True
+        return False
+
+    def _try_repartition(
+        self, nodes: list[dict], wanted: Geometry, pod: dict
+    ) -> bool:
+        """First-fit over candidate nodes (`mig_controller.go:146-207`)."""
+        for node_obj in nodes:
+            node = Node.from_node(
+                objects.name(node_obj),
+                objects.labels(node_obj),
+                objects.annotations(node_obj),
+            )
+            if not node.has_free_capacity():
+                continue
+            candidate = node.clone()
+            if not candidate.update_geometry_for(wanted):
+                continue
+            if not candidate.provides_profiles(wanted):
+                continue
+            plan_id = self._plan_id_fn()
+            self._partitioner.apply_partitioning(
+                node_obj, build_node_partitioning(candidate), plan_id
+            )
+            logger.info(
+                "pod controller: repartitioned node %s for pod %s/%s "
+                "(wanted %s, plan %s)",
+                node.name,
+                objects.namespace(pod),
+                objects.name(pod),
+                wanted,
+                plan_id,
+            )
+            return True
+        logger.info(
+            "pod controller: no node can provide %s for pod %s/%s",
+            wanted,
+            objects.namespace(pod),
+            objects.name(pod),
+        )
+        return False

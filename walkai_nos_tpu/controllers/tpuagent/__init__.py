@@ -1,0 +1,19 @@
+"""tpuagent: the per-node DaemonSet agent (reporter + actuator).
+
+Analogue of `internal/controllers/migagent/`: the reporter writes observed
+slice state into `status-tpu-*` node annotations; the actuator diffs
+`spec-tpu-*` against status, plans create/delete operations, actuates them
+through tpudev, and restarts the device plugin — with the same
+report-before-apply handshake, plan-ID acking, delete-free-only rule, and
+rollback-on-failed-create semantics.
+"""
+
+from walkai_nos_tpu.controllers.tpuagent.plan import (  # noqa: F401
+    CreateOperation,
+    DeleteOperation,
+    TilingPlan,
+    TilingState,
+)
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState  # noqa: F401
+from walkai_nos_tpu.controllers.tpuagent.reporter import Reporter  # noqa: F401
+from walkai_nos_tpu.controllers.tpuagent.actuator import Actuator  # noqa: F401

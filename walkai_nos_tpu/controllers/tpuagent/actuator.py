@@ -1,0 +1,246 @@
+"""Actuator: spec annotations -> actual TPU slices.
+
+Port of `internal/controllers/migagent/actuator.go:36-310` with the
+placement-permutation search replaced by deterministic mesh packing:
+
+- gate on the reporter handshake (`actuator.go:75-78`);
+- record the spec plan ID for the reporter to ack (`:90`);
+- done when spec matches status (`:94`) or when the same (plan, status)
+  pair was already applied (`:113-116`);
+- plan via the pure diff planner; a NotFound from the device boundary means
+  the kubelet advertises a stale device -> restart the device plugin
+  instead of failing (`:135-138`);
+- apply deletes first (free devices only), then pack + create; roll back
+  deletions if creates fail (`:287`); restart the device plugin when
+  devices changed (`:210`).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.tpuagent.plan import (
+    TilingPlan,
+    TilingState,
+    new_tiling_plan,
+)
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.kube.runtime import Request, Result
+from walkai_nos_tpu.tpu import topology as topo
+from walkai_nos_tpu.tpu.annotations import (
+    SpecAnnotation,
+    StatusAnnotation,
+    parse_node_annotations,
+    spec_matches_status,
+)
+from walkai_nos_tpu.tpu.errors import GenericError, TpuError
+from walkai_nos_tpu.tpu.tiling.client import DevicePluginClient, TilingClient
+from walkai_nos_tpu.tpu.tiling.packing import Placement, pack_geometry
+from walkai_nos_tpu.tpudev.client import SliceInfo
+
+logger = logging.getLogger(__name__)
+
+
+def placement_from_slice_info(info: SliceInfo, host) -> Placement:
+    """Reconstruct a Placement from a materialized slice's chip coords."""
+    chip_by_id = {c.chip_id: c for c in host.chips}
+    coords = [chip_by_id[cid].coords for cid in info.chip_ids]
+    lo = tuple(min(c[d] for c in coords) for d in range(len(host.mesh)))
+    hi = tuple(max(c[d] for c in coords) for d in range(len(host.mesh)))
+    orientation = tuple(h - l + 1 for l, h in zip(lo, hi))
+    return Placement(profile=info.profile, offset=lo, orientation=orientation)
+
+
+class Actuator:
+    def __init__(
+        self,
+        kube: KubeClient,
+        tiling_client: TilingClient,
+        device_plugin: DevicePluginClient,
+        shared_state: SharedState,
+        node_name: str,
+    ) -> None:
+        self._kube = kube
+        self._client = tiling_client
+        self._plugin = device_plugin
+        self._shared = shared_state
+        self._node_name = node_name
+        self._last_applied: tuple[str | None, frozenset] | None = None
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, request: Request) -> Result:
+        if not self._shared.at_least_one_report_since_last_apply():
+            return Result(requeue_after=1.0)
+
+        node = self._kube.get("Node", self._node_name)
+        ann = objects.annotations(node)
+        plan_id = ann.get(constants.ANNOTATION_PARTITIONING_PLAN)
+        self._shared.last_parsed_plan_id = plan_id
+
+        status, spec = parse_node_annotations(ann)
+        if spec_matches_status(spec, status):
+            return Result()
+
+        applied_key = (plan_id, frozenset(status))
+        if self._last_applied == applied_key:
+            # Already actuated this exact (plan, observed-state) pair; wait
+            # for the reporter to move status (`actuator.go:113-116`).
+            return Result()
+
+        plan = self._plan(spec)
+        if plan is None:  # stale device -> plugin restarted instead
+            return Result(requeue_after=1.0)
+        if plan.is_empty():
+            return Result()
+        logger.info("actuator: node %s applying plan %s", self._node_name, plan.summary())
+        self._apply(plan)
+        self._last_applied = applied_key
+        self._shared.on_apply_done()
+        return Result()
+
+    # ------------------------------------------------------------------ plan
+
+    def _plan(self, spec: list[SpecAnnotation]) -> TilingPlan | None:
+        try:
+            devices = self._client.get_tpu_devices()
+        except TpuError as e:
+            if e.is_not_found():
+                # kubelet advertises a device tpudev doesn't know: restart
+                # the plugin to resync (`actuator.go:135-138`).
+                logger.warning(
+                    "actuator: stale device on %s (%s); restarting device plugin",
+                    self._node_name,
+                    e,
+                )
+                self._plugin.restart(self._node_name)
+                return None
+            raise
+        # Symmetric staleness: tpudev knows slices the kubelet does NOT
+        # advertise (e.g. a crash between slice creation and device-plugin
+        # re-registration). Planning against the stale kubelet view would
+        # double-create; restart the plugin to resync instead.
+        known = {d.device_id for d in devices}
+        materialized = {s.slice_id for s in self._client._tpudev.list_slices()}
+        if materialized - known:
+            logger.warning(
+                "actuator: %d slice(s) on %s not advertised by kubelet (%s); "
+                "restarting device plugin",
+                len(materialized - known),
+                self._node_name,
+                sorted(materialized - known),
+            )
+            self._plugin.restart(self._node_name)
+            return None
+        state = TilingState.from_devices(devices)
+        return new_tiling_plan(state, spec)
+
+    # ----------------------------------------------------------------- apply
+
+    def _apply(self, plan: TilingPlan) -> None:
+        host = self._client.get_topology()
+        deleted: list[SliceInfo] = []
+        changed = False
+        slice_by_id = {s.slice_id: s for s in self._client._tpudev.list_slices()}
+
+        # Deletes first, free devices only (`actuator.go:216-261`).
+        delete_errors: list[str] = []
+        for op in plan.delete_ops:
+            remaining = op.quantity
+            for device in op.candidates:
+                if remaining == 0:
+                    break
+                if not device.is_free():
+                    continue  # never delete a used device
+                info = slice_by_id.get(device.device_id)
+                try:
+                    self._client.delete_slice(device.device_id)
+                except TpuError as e:
+                    if e.is_not_found():
+                        remaining -= 1  # already gone counts as deleted
+                        continue
+                    delete_errors.append(f"{device.device_id}: {e}")
+                    continue
+                if info is not None:
+                    deleted.append(info)
+                remaining -= 1
+                changed = True
+            if remaining > 0:
+                delete_errors.append(
+                    f"mesh {op.mesh_index} {op.profile}: "
+                    f"{remaining} device(s) could not be deleted"
+                )
+
+        # Creates via packing (`actuator.go:263-309`, packing replaces the
+        # NVML permutation loop).
+        try:
+            created = self._apply_create_ops(plan, host)
+            changed = changed or bool(created)
+        except GenericError:
+            self._rollback_deleted(deleted)
+            raise
+
+        if delete_errors:
+            raise GenericError("; ".join(delete_errors))
+
+        if changed:
+            self._plugin.restart(self._node_name)
+
+    def _apply_create_ops(self, plan: TilingPlan, host) -> list[SliceInfo]:
+        if not plan.create_ops:
+            return []
+        created: list[SliceInfo] = []
+        by_mesh: dict[int, list] = {}
+        for op in plan.create_ops:
+            by_mesh.setdefault(op.mesh_index, []).append(op)
+        for mesh_index, ops in sorted(by_mesh.items()):
+            existing = [
+                s
+                for s in self._client._tpudev.list_slices()
+                if s.mesh_index == mesh_index
+            ]
+            pinned = [placement_from_slice_info(s, host) for s in existing]
+            geometry: dict[str, int] = {}
+            for p in pinned:
+                geometry[p.profile] = geometry.get(p.profile, 0) + 1
+            for op in ops:
+                geometry[op.profile] = geometry.get(op.profile, 0) + op.quantity
+            placements = pack_geometry(host.mesh, geometry, pinned)
+            if placements is None:
+                raise GenericError(
+                    f"mesh {mesh_index}: geometry {geometry} not placeable "
+                    f"with {len(pinned)} pinned slice(s)"
+                )
+            new_placements = placements[len(pinned):]
+            result = self._client.create_slices(new_placements)
+            created.extend(result)
+            if len(result) < len(new_placements):
+                raise GenericError(
+                    f"mesh {mesh_index}: created only {len(result)}/"
+                    f"{len(new_placements)} slices"
+                )
+        return created
+
+    def _rollback_deleted(self, deleted: list[SliceInfo]) -> None:
+        """Re-create slices deleted earlier in a failed apply
+        (`actuator.go:287-296`)."""
+        if not deleted:
+            return
+        host = self._client.get_topology()
+        placements = [placement_from_slice_info(s, host) for s in deleted]
+        try:
+            self._client.create_slices(placements)
+        except TpuError as e:
+            logger.error(
+                "actuator: rollback of %d deleted slice(s) failed: %s",
+                len(deleted),
+                e,
+            )
+
+    # ------------------------------------------------------------- test seam
+
+    def last_applied_status(self) -> frozenset[StatusAnnotation] | None:
+        return self._last_applied[1] if self._last_applied else None

@@ -1,0 +1,181 @@
+"""Node-local diff planner: (observed devices, desired spec) -> operations.
+
+Pure port of the semantics of `internal/controllers/migagent/plan/`
+(`plan.go:31-139`, `mig_state.go`, `operation.go`):
+
+- delete devices whose profile/quantity exceeds the spec, preferring *free*
+  devices as candidates (used ones are listed but the actuator only ever
+  deletes free devices);
+- create devices the spec wants but the node lacks;
+- when any create op exists on a mesh, every existing free device on that
+  mesh is deleted and re-created too, giving the placement engine the whole
+  free area to work with (`plan.go:81-89` — the reference does this to
+  maximize NVML placement permutations; here it maximizes contiguous room
+  for the packer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from walkai_nos_tpu.tpu.annotations import SpecAnnotation
+from walkai_nos_tpu.tpu.device import Device, DeviceList
+from walkai_nos_tpu.tpu.tiling.profile import extract_profile_name
+
+
+class TilingState(dict):
+    """mesh index -> DeviceList (`mig_state.go:24-87` `MigState`)."""
+
+    @staticmethod
+    def from_devices(devices: DeviceList) -> "TilingState":
+        state = TilingState()
+        for idx, devs in devices.group_by_mesh_index().items():
+            state[idx] = devs
+        return state
+
+    def matches_spec(self, spec: list[SpecAnnotation]) -> bool:
+        """Order-insensitive equality of (mesh, profile) -> qty
+        (`mig_state.go:42-66` `Matches`)."""
+        desired: dict[tuple[int, str], int] = {}
+        for s in spec:
+            if s.quantity > 0:
+                key = (s.mesh_index, s.profile)
+                desired[key] = desired.get(key, 0) + s.quantity
+        actual: dict[tuple[int, str], int] = {}
+        for idx, devs in self.items():
+            for d in devs:
+                key = (idx, extract_profile_name(d.resource_name))
+                actual[key] = actual.get(key, 0) + 1
+        return desired == actual
+
+
+@dataclass(frozen=True)
+class CreateOperation:
+    """Create `quantity` slices of `profile` on mesh `mesh_index`
+    (`operation.go:25-38`)."""
+
+    mesh_index: int
+    profile: str
+    quantity: int
+
+
+@dataclass(frozen=True)
+class DeleteOperation:
+    """Delete `quantity` devices among `candidates` (free ones only get
+    actuated — `operation.go:40-54` + `actuator.go:216-261`)."""
+
+    mesh_index: int
+    profile: str
+    candidates: tuple[Device, ...]
+    quantity: int
+
+
+@dataclass
+class TilingPlan:
+    create_ops: list[CreateOperation] = field(default_factory=list)
+    delete_ops: list[DeleteOperation] = field(default_factory=list)
+
+    def is_empty(self) -> bool:
+        return not self.create_ops and not self.delete_ops
+
+    def summary(self) -> str:
+        return (
+            f"create={[(o.mesh_index, o.profile, o.quantity) for o in self.create_ops]} "
+            f"delete={[(o.mesh_index, o.profile, o.quantity) for o in self.delete_ops]}"
+        )
+
+
+def new_tiling_plan(state: TilingState, spec: list[SpecAnnotation]) -> TilingPlan:
+    """Compute the ops turning `state` into `spec` (`plan.go:31-92`)."""
+    plan = TilingPlan()
+
+    desired: dict[int, dict[str, int]] = {}
+    for s in spec:
+        if s.quantity > 0:
+            desired.setdefault(s.mesh_index, {})
+            desired[s.mesh_index][s.profile] = (
+                desired[s.mesh_index].get(s.profile, 0) + s.quantity
+            )
+
+    actual: dict[int, dict[str, DeviceList]] = {}
+    for idx, devs in state.items():
+        actual[idx] = {}
+        for d in devs:
+            actual[idx].setdefault(extract_profile_name(d.resource_name), DeviceList())
+            actual[idx][extract_profile_name(d.resource_name)].append(d)
+
+    mesh_indices = sorted(set(desired) | set(actual))
+    meshes_with_creates: set[int] = set()
+
+    # Pass 1: quantity diffs.
+    for idx in mesh_indices:
+        profiles = sorted(
+            set(desired.get(idx, {})) | set(actual.get(idx, {}))
+        )
+        for profile in profiles:
+            want = desired.get(idx, {}).get(profile, 0)
+            have_devices = actual.get(idx, {}).get(profile, DeviceList())
+            have = len(have_devices)
+            if want > have:
+                plan.create_ops.append(
+                    CreateOperation(idx, profile, want - have)
+                )
+                meshes_with_creates.add(idx)
+            elif have > want:
+                plan.delete_ops.append(
+                    DeleteOperation(
+                        idx,
+                        profile,
+                        candidates=tuple(
+                            _deletion_candidates(have_devices)
+                        ),
+                        quantity=have - want,
+                    )
+                )
+
+    # Pass 2: re-create free devices on meshes with creates (`plan.go:81-89`),
+    # excluding devices already fully scheduled for deletion.
+    doomed: dict[int, dict[str, int]] = {}
+    for op in plan.delete_ops:
+        doomed.setdefault(op.mesh_index, {})[op.profile] = op.quantity
+    extra_deletes: list[DeleteOperation] = []
+    extra_creates: list[CreateOperation] = []
+    for idx in sorted(meshes_with_creates):
+        for profile, devices in sorted(actual.get(idx, {}).items()):
+            already_doomed = doomed.get(idx, {}).get(profile, 0)
+            free = devices.get_free()
+            recreate = len(free) - already_doomed
+            if recreate <= 0:
+                continue
+            extra_deletes.append(
+                DeleteOperation(
+                    idx,
+                    profile,
+                    candidates=tuple(_deletion_candidates(devices)),
+                    quantity=len(free),  # all free devices go
+                )
+            )
+            extra_creates.append(CreateOperation(idx, profile, recreate))
+    # Merge: an extra delete op for a (mesh, profile) replaces the pass-1 op
+    # (it covers a superset of the quantity).
+    for ed in extra_deletes:
+        plan.delete_ops = [
+            op
+            for op in plan.delete_ops
+            if (op.mesh_index, op.profile) != (ed.mesh_index, ed.profile)
+        ]
+        plan.delete_ops.append(ed)
+    plan.create_ops.extend(extra_creates)
+
+    plan.create_ops.sort(key=lambda o: (o.mesh_index, o.profile))
+    plan.delete_ops.sort(key=lambda o: (o.mesh_index, o.profile))
+    return plan
+
+
+def _deletion_candidates(devices: DeviceList) -> DeviceList:
+    """Free devices first, deterministic within each group
+    (`plan.go:111-139` `extractCandidatesForDeletion`)."""
+    return DeviceList(
+        devices.get_free().sorted_by_device_id()
+        + devices.get_used().sorted_by_device_id()
+    )

@@ -1,0 +1,83 @@
+"""Reporter: observed devices -> status annotations on the node.
+
+Port of `internal/controllers/migagent/reporter.go:34-123`: read ground
+truth through the tiling client, fold into `status-tpu-*` annotations, diff
+against the node, and patch — replacing *all* previous status annotations —
+plus echo `status-partitioning-plan` = the last plan ID the actuator
+parsed. Requeues on a fixed refresh interval so drift is always healed.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.kube.runtime import Request, Result
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.tiling.client import TilingClient
+from walkai_nos_tpu.tpu.tiling.profile import extract_profile_name
+
+logger = logging.getLogger(__name__)
+
+
+class Reporter:
+    def __init__(
+        self,
+        kube: KubeClient,
+        tiling_client: TilingClient,
+        shared_state: SharedState,
+        node_name: str,
+        refresh_interval: float = constants.DEFAULT_AGENT_REPORT_INTERVAL_S,
+    ) -> None:
+        self._kube = kube
+        self._client = tiling_client
+        self._shared = shared_state
+        self._node_name = node_name
+        self._interval = refresh_interval
+
+    def reconcile(self, request: Request) -> Result:
+        with self._shared.lock:
+            try:
+                return self._reconcile(request)
+            finally:
+                # Even a failed report observed the world; the actuator gate
+                # only needs *a* report attempt after its last apply
+                # (`reporter.go:60-62` defers OnReportDone under the lock).
+                self._shared.on_report_done()
+
+    def _reconcile(self, request: Request) -> Result:
+        node = self._kube.get("Node", self._node_name)
+        devices = self._client.get_tpu_devices()
+        status_annotations = devices.as_status_annotations(extract_profile_name)
+
+        current_status, _ = parse_node_annotations(objects.annotations(node))
+        plan_ack = objects.annotations(node).get(
+            constants.ANNOTATION_REPORTED_PARTITIONING_PLAN
+        )
+        desired_ack = self._shared.last_parsed_plan_id
+
+        if set(status_annotations) == set(current_status) and plan_ack == desired_ack:
+            return Result(requeue_after=self._interval)
+
+        # Replace ALL status annotations (`reporter.go:89-103`): build a
+        # merge patch that nulls stale keys and writes fresh ones.
+        updates: dict[str, str | None] = {
+            ann.key: None
+            for ann in current_status
+        }
+        for ann in status_annotations:
+            updates[ann.key] = ann.value
+        updates[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] = desired_ack
+        self._kube.patch(
+            "Node", self._node_name, objects.annotation_patch(updates)
+        )
+        logger.info(
+            "reporter: node %s status updated (%d annotations, plan=%s)",
+            self._node_name,
+            len(status_annotations),
+            desired_ack,
+        )
+        return Result(requeue_after=self._interval)

@@ -1,0 +1,47 @@
+"""Reporter/Actuator handshake state.
+
+Port of `internal/controllers/migagent/shared.go:24-57`: a mutex plus a
+"report happened since the last apply" latch. The actuator refuses to act
+on state the reporter hasn't refreshed since the previous actuation —
+otherwise it would re-plan against a stale status and thrash the devices.
+Also carries the last plan ID the actuator parsed, which the reporter
+echoes into `status-partitioning-plan` as the ack.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class SharedState:
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self._report_since_apply = threading.Event()
+        self._last_parsed_plan_id: str | None = None
+
+    # -------------------------------------------------------------- handshake
+
+    def on_report_done(self) -> None:
+        """Reporter finished a cycle (`shared.go:36-41`)."""
+        self._report_since_apply.set()
+
+    def on_apply_done(self) -> None:
+        """Actuator finished an apply; require a fresh report before the
+        next one (`shared.go:43-48`)."""
+        self._report_since_apply.clear()
+
+    def at_least_one_report_since_last_apply(self) -> bool:
+        """`shared.go:50-57`."""
+        return self._report_since_apply.is_set()
+
+    # --------------------------------------------------------------- plan ids
+
+    @property
+    def last_parsed_plan_id(self) -> str | None:
+        with self.lock:
+            return self._last_parsed_plan_id
+
+    @last_parsed_plan_id.setter
+    def last_parsed_plan_id(self, value: str | None) -> None:
+        with self.lock:
+            self._last_parsed_plan_id = value

@@ -1,0 +1,19 @@
+"""Minimal Kubernetes machinery: typed-dict objects, client interface,
+in-memory fake API server (the envtest analogue), REST client, and a small
+controller runtime (watch -> predicates -> workqueue -> reconcile).
+
+The reference builds on controller-runtime; this package provides the same
+architectural seams (client interface injected everywhere, predicates to cut
+watch chatter, per-controller work queues with bounded concurrency,
+requeue-after) without external dependencies.
+"""
+
+from walkai_nos_tpu.kube import objects  # noqa: F401
+from walkai_nos_tpu.kube.client import KubeClient, ApiError, NotFound, Conflict  # noqa: F401
+from walkai_nos_tpu.kube.fake import FakeKubeClient  # noqa: F401
+from walkai_nos_tpu.kube.runtime import (  # noqa: F401
+    Controller,
+    Manager,
+    Request,
+    Result,
+)

@@ -1,0 +1,87 @@
+"""KubeClient: the API-server boundary interface.
+
+Everything above this line (controllers, planners, exporters) is written
+against this interface, mirroring how the reference injects
+controller-runtime's `client.Client` everywhere so envtest/mocks can stand
+in (SURVEY.md §4 "test seams"). Implementations: `FakeKubeClient`
+(in-memory, tests/simulation) and `RestKubeClient` (real API server).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Iterator, Mapping
+
+# A watch event: ("ADDED" | "MODIFIED" | "DELETED", object-dict)
+WatchEvent = tuple[str, dict]
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFound(ApiError):
+    def __init__(self, message: str = "not found"):
+        super().__init__(404, message)
+
+
+class Conflict(ApiError):
+    def __init__(self, message: str = "conflict"):
+        super().__init__(409, message)
+
+
+class KubeClient(abc.ABC):
+    """CRUD + watch over dict-shaped objects.
+
+    `kind` is a plural-insensitive kind name ("Node", "Pod", "Lease",
+    "ElasticQuota", ...). Namespaced kinds take `namespace`; cluster-scoped
+    kinds ignore it.
+    """
+
+    @abc.abstractmethod
+    def get(self, kind: str, name: str, namespace: str | None = None) -> dict: ...
+
+    @abc.abstractmethod
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: Mapping[str, str] | None = None,
+        field_selector: Mapping[str, str] | None = None,
+    ) -> list[dict]: ...
+
+    @abc.abstractmethod
+    def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict: ...
+
+    @abc.abstractmethod
+    def update(self, kind: str, obj: dict, namespace: str | None = None) -> dict: ...
+
+    @abc.abstractmethod
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        patch: dict,
+        namespace: str | None = None,
+    ) -> dict:
+        """JSON merge patch (RFC 7386) — the reference's `client.MergeFrom`
+        optimistic-concurrency pattern (`partitioner.go:65`)."""
+        ...
+
+    @abc.abstractmethod
+    def delete(self, kind: str, name: str, namespace: str | None = None) -> None: ...
+
+    @abc.abstractmethod
+    def watch(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        stop: Callable[[], bool] | None = None,
+    ) -> Iterator[WatchEvent]:
+        """Stream events. Implementations yield an initial synthetic ADDED
+        for each existing object, then live events, and poll `stop` to
+        terminate."""
+        ...

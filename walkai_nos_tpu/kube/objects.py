@@ -1,0 +1,117 @@
+"""Helpers over dict-shaped Kubernetes objects.
+
+Objects are plain JSON dicts (what the API server speaks); these helpers
+keep controller code readable without a types layer.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Mapping
+
+
+def name(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("namespace", "")
+
+
+def uid(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("uid", "")
+
+
+def labels(obj: Mapping) -> dict[str, str]:
+    return obj.get("metadata", {}).get("labels") or {}
+
+
+def annotations(obj: Mapping) -> dict[str, str]:
+    return obj.get("metadata", {}).get("annotations") or {}
+
+
+def owner_references(obj: Mapping) -> list[dict]:
+    return obj.get("metadata", {}).get("ownerReferences") or []
+
+
+def is_owned_by_kind(obj: Mapping, kind: str) -> bool:
+    return any(ref.get("kind") == kind for ref in owner_references(obj))
+
+
+def deep_copy(obj: Mapping) -> dict:
+    return copy.deepcopy(dict(obj))
+
+
+def matches_labels(obj: Mapping, selector: Mapping[str, str]) -> bool:
+    lbls = labels(obj)
+    return all(lbls.get(k) == v for k, v in selector.items())
+
+
+def set_annotations(obj: dict, new: Mapping[str, str | None]) -> dict:
+    """Return a copy with annotation updates applied (None deletes)."""
+    out = deep_copy(obj)
+    ann = dict(annotations(out))
+    for k, v in new.items():
+        if v is None:
+            ann.pop(k, None)
+        else:
+            ann[k] = v
+    out.setdefault("metadata", {})["annotations"] = ann
+    return out
+
+
+def merge_patch(base: Any, patch: Any) -> Any:
+    """RFC 7386 JSON Merge Patch: dicts merge recursively, null deletes,
+    everything else replaces."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(base, dict):
+        base = {}
+    out = copy.deepcopy(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = merge_patch(out.get(k), v)
+    return out
+
+
+def annotation_patch(updates: Mapping[str, str | None]) -> dict:
+    """Merge patch touching only metadata.annotations."""
+    return {"metadata": {"annotations": dict(updates)}}
+
+
+# ------------------------------------------------------------------ pod state
+
+
+def pod_phase(pod: Mapping) -> str:
+    return (pod.get("status") or {}).get("phase", "")
+
+
+def pod_is_pending(pod: Mapping) -> bool:
+    """`pkg/util/pod/pod.go:28-31` analogue."""
+    return pod_phase(pod) == "Pending"
+
+def pod_is_running(pod: Mapping) -> bool:
+    return pod_phase(pod) == "Running"
+
+
+def pod_is_scheduled(pod: Mapping) -> bool:
+    """`pod.go:33-36`: a nodeName is assigned."""
+    return bool((pod.get("spec") or {}).get("nodeName"))
+
+
+def pod_is_unschedulable(pod: Mapping) -> bool:
+    """`pod.go:38-55`: PodScheduled condition False/Unschedulable."""
+    for cond in (pod.get("status") or {}).get("conditions") or []:
+        if (
+            cond.get("type") == "PodScheduled"
+            and cond.get("status") == "False"
+            and cond.get("reason") == "Unschedulable"
+        ):
+            return True
+    return False
+
+
+def pod_is_owned_by_daemonset(pod: Mapping) -> bool:
+    return is_owned_by_kind(pod, "DaemonSet")

@@ -1,0 +1,81 @@
+"""Watch-event predicates — cut reconcile chatter at the source.
+
+Analogue of `pkg/util/predicate/predicates.go:27-76`. A predicate sees the
+watch event type, the new object, and (for MODIFIED) the previous object
+snapshot held by the controller's cache.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+from walkai_nos_tpu.kube import objects
+
+# (event_type, new_obj, old_obj|None) -> bool
+Predicate = Callable[[str, Mapping, Mapping | None], bool]
+
+
+def matching_name(name: str, namespace: str | None = None) -> Predicate:
+    """Only events for one specific object (`predicates.go:27-45`) — the
+    node agents watch only their own Node."""
+
+    def pred(_event: str, obj: Mapping, _old: Mapping | None) -> bool:
+        if objects.name(obj) != name:
+            return False
+        return namespace is None or objects.namespace(obj) == namespace
+
+    return pred
+
+
+def exclude_delete() -> Predicate:
+    """Drop DELETED events (`predicates.go:70-76`)."""
+    return lambda event, _obj, _old: event != "DELETED"
+
+
+def annotations_changed() -> Predicate:
+    """MODIFIED events only when annotations differ (`predicates.go:61-68`);
+    ADDED always passes."""
+
+    def pred(event: str, obj: Mapping, old: Mapping | None) -> bool:
+        if event != "MODIFIED" or old is None:
+            return True
+        return objects.annotations(obj) != objects.annotations(old)
+
+    return pred
+
+
+def node_resources_changed() -> Predicate:
+    """Fires on MODIFIED only when status.capacity changed while
+    status.allocatable did not — the kubelet is re-advertising resources
+    (`predicates.go:47-59` `NodeResourcesChanged`)."""
+
+    def pred(event: str, obj: Mapping, old: Mapping | None) -> bool:
+        if event != "MODIFIED" or old is None:
+            return True
+        new_cap = (obj.get("status") or {}).get("capacity") or {}
+        old_cap = (old.get("status") or {}).get("capacity") or {}
+        new_alloc = (obj.get("status") or {}).get("allocatable") or {}
+        old_alloc = (old.get("status") or {}).get("allocatable") or {}
+        return new_cap != old_cap and new_alloc == old_alloc
+
+    return pred
+
+
+def has_label(key: str, value: str | None = None) -> Predicate:
+    """Only objects carrying a label (optionally with a specific value)."""
+
+    def pred(_event: str, obj: Mapping, _old: Mapping | None) -> bool:
+        lbls = objects.labels(obj)
+        if key not in lbls:
+            return False
+        return value is None or lbls[key] == value
+
+    return pred
+
+
+def any_of(*preds: Predicate) -> Predicate:
+    return lambda e, o, old: any(p(e, o, old) for p in preds)
+
+
+def all_of(*preds: Predicate) -> Predicate:
+    return lambda e, o, old: all(p(e, o, old) for p in preds)

@@ -1,0 +1,266 @@
+"""Controller runtime: watch -> predicates -> workqueue -> reconcile.
+
+The dependency-free equivalent of controller-runtime's manager/controller
+machinery the reference builds every binary on: each controller watches one
+kind, filters events through predicates, deduplicates work on a keyed queue,
+and runs `reconcile(request)` on a bounded worker pool with
+requeue/requeue-after semantics and per-key exponential backoff on error
+(mirrors `MaxConcurrentReconciles`, `Result{RequeueAfter}`, and the default
+rate limiter).
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Mapping
+
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.kube.predicates import Predicate
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Request:
+    """A reconcile request: the object's key."""
+
+    name: str
+    namespace: str = ""
+
+
+@dataclass
+class Result:
+    """Reconcile outcome (`reconcile.Result` analogue)."""
+
+    requeue: bool = False
+    requeue_after: float | None = None
+
+
+Reconciler = Callable[[Request], Result]
+
+_BACKOFF_BASE = 0.05
+_BACKOFF_MAX = 30.0
+
+
+class _WorkQueue:
+    """Keyed, deduplicating, delay-capable work queue."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._pending: set[Request] = set()
+        self._active: set[Request] = set()
+        self._redo: set[Request] = set()
+        self._delayed: list[tuple[float, int, Request]] = []
+        self._seq = 0
+        self._shutdown = False
+
+    def add(self, req: Request) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if req in self._active:
+                self._redo.add(req)
+            else:
+                self._pending.add(req)
+            self._cond.notify()
+
+    def add_after(self, req: Request, delay: float) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, req))
+            self._cond.notify()
+
+    def get(self, timeout: float = 0.2) -> Request | None:
+        with self._cond:
+            deadline = time.monotonic() + timeout
+            while True:
+                now = time.monotonic()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, req = heapq.heappop(self._delayed)
+                    if req in self._active:
+                        self._redo.add(req)
+                    else:
+                        self._pending.add(req)
+                if self._shutdown:
+                    return None
+                ready = self._pending - self._active
+                if ready:
+                    req = sorted(ready, key=lambda r: (r.namespace, r.name))[0]
+                    self._pending.discard(req)
+                    self._active.add(req)
+                    return req
+                wait = deadline - now
+                if self._delayed:
+                    wait = min(wait, self._delayed[0][0] - now)
+                if wait <= 0:
+                    return None
+                self._cond.wait(wait)
+
+    def done(self, req: Request) -> None:
+        with self._cond:
+            self._active.discard(req)
+            if req in self._redo:
+                self._redo.discard(req)
+                self._pending.add(req)
+                self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+
+class Controller:
+    """One watch + one reconciler (`ctrl.NewControllerManagedBy` analogue)."""
+
+    def __init__(
+        self,
+        name: str,
+        client: KubeClient,
+        kind: str,
+        reconciler: Reconciler,
+        predicates: list[Predicate] | None = None,
+        max_concurrent: int = 1,
+        namespace: str | None = None,
+    ) -> None:
+        self.name = name
+        self.client = client
+        self.kind = kind
+        self.reconciler = reconciler
+        self.predicates = predicates or []
+        self.max_concurrent = max_concurrent
+        self.namespace = namespace
+        self.queue = _WorkQueue()
+        self._cache: dict[tuple[str, str], dict] = {}
+        self._cache_lock = threading.Lock()
+        self._failures: dict[Request, int] = {}
+        self._threads: list[threading.Thread] = []
+        self._stop = False
+        self.watch_ready = threading.Event()
+
+    # ----------------------------------------------------------------- watch
+
+    def _watch_loop(self) -> None:
+        while not self._stop:
+            try:
+                stream = self.client.watch(
+                    self.kind, self.namespace, stop=lambda: self._stop
+                )
+                # The client registers the watch at call time (see
+                # FakeKubeClient.watch); signal readiness so start() can
+                # guarantee no event published after start() is missed.
+                self.watch_ready.set()
+                for event, obj in stream:
+                    self._handle_event(event, obj)
+                    if self._stop:
+                        break
+            except Exception:
+                if not self._stop:
+                    logger.warning(
+                        "%s: watch failed, retrying:\n%s",
+                        self.name,
+                        traceback.format_exc(),
+                    )
+                    time.sleep(0.5)
+
+    def _handle_event(self, event: str, obj: Mapping) -> None:
+        key = (objects.namespace(obj), objects.name(obj))
+        with self._cache_lock:
+            old = self._cache.get(key)
+            if event == "DELETED":
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = objects.deep_copy(obj)
+        for pred in self.predicates:
+            if not pred(event, obj, old):
+                return
+        self.queue.add(Request(name=key[1], namespace=key[0]))
+
+    # --------------------------------------------------------------- workers
+
+    def _worker_loop(self) -> None:
+        while not self._stop:
+            req = self.queue.get()
+            if req is None:
+                continue
+            try:
+                result = self.reconciler(req)
+                self._failures.pop(req, None)
+                if result and result.requeue_after is not None:
+                    self.queue.add_after(req, result.requeue_after)
+                elif result and result.requeue:
+                    self.queue.add(req)
+            except Exception:
+                n = self._failures.get(req, 0) + 1
+                self._failures[req] = n
+                delay = min(_BACKOFF_BASE * (2 ** (n - 1)), _BACKOFF_MAX)
+                logger.warning(
+                    "%s: reconcile %s failed (attempt %d, retry in %.2fs):\n%s",
+                    self.name,
+                    req,
+                    n,
+                    delay,
+                    traceback.format_exc(),
+                )
+                self.queue.add_after(req, delay)
+            finally:
+                self.queue.done(req)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._stop = False
+        self.watch_ready.clear()
+        t = threading.Thread(
+            target=self._watch_loop, name=f"{self.name}-watch", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        if not self.watch_ready.wait(timeout=5.0):
+            logger.warning("%s: watch not established within 5s", self.name)
+        for i in range(self.max_concurrent):
+            w = threading.Thread(
+                target=self._worker_loop, name=f"{self.name}-worker-{i}", daemon=True
+            )
+            w.start()
+            self._threads.append(w)
+
+    def stop(self) -> None:
+        self._stop = True
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+
+@dataclass
+class Manager:
+    """Runs a set of controllers (`ctrl.Manager` analogue)."""
+
+    controllers: list[Controller] = field(default_factory=list)
+
+    def add(self, controller: Controller) -> None:
+        self.controllers.append(controller)
+
+    def start(self) -> None:
+        for c in self.controllers:
+            c.start()
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()
+
+    def __enter__(self) -> "Manager":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

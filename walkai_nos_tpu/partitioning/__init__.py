@@ -1,0 +1,14 @@
+"""Cluster-level partitioning: desired state, spec writer, node initializer.
+
+Analogue of `internal/partitioning/{state,mig}/`.
+"""
+
+from walkai_nos_tpu.partitioning.state import (  # noqa: F401
+    MeshPartitioning,
+    NodePartitioning,
+    PartitioningState,
+    build_node_partitioning,
+)
+from walkai_nos_tpu.partitioning.partitioner import Partitioner  # noqa: F401
+from walkai_nos_tpu.partitioning.initializer import NodeInitializer  # noqa: F401
+from walkai_nos_tpu.partitioning.plan_id import new_partitioning_plan_id  # noqa: F401

@@ -1,0 +1,47 @@
+"""First-touch node initialization.
+
+Port of `internal/partitioning/mig/initializer.go:40-79`: a freshly labeled
+TPU node gets the fewest-slices (coarsest) tiling as its initial spec —
+whole-host slices until pending pods ask for something finer.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.partitioning.partitioner import Partitioner
+from walkai_nos_tpu.partitioning.state import build_node_partitioning
+from walkai_nos_tpu.tpu.tiling.node import Node
+
+logger = logging.getLogger(__name__)
+
+
+class NodeInitializer:
+    def __init__(self, kube: KubeClient, partitioner: Partitioner | None = None):
+        self._kube = kube
+        self._partitioner = partitioner or Partitioner(kube)
+
+    def init_node_partitioning(self, node_obj: dict) -> None:
+        node = Node.from_node(
+            objects.name(node_obj),
+            objects.labels(node_obj),
+            objects.annotations(node_obj),
+        )
+        if node.model is None:
+            logger.warning(
+                "initializer: node %s has no recognizable TPU model",
+                objects.name(node_obj),
+            )
+            return
+        changed = False
+        for mesh in node.meshes:
+            if not mesh.geometry():
+                if mesh.init_geometry():
+                    changed = True
+        if not changed:
+            return
+        self._partitioner.apply_partitioning(
+            node_obj, build_node_partitioning(node)
+        )

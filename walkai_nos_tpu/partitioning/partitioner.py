@@ -1,0 +1,54 @@
+"""Spec writer: NodePartitioning -> node annotations.
+
+Port of `internal/partitioning/mig/partitioner.go:40-91`: delete every
+existing `spec-tpu-*` annotation, write the new set plus
+`spec-partitioning-plan=<planID>`, patch the node (JSON merge patch — the
+`client.MergeFrom` analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.partitioning.plan_id import new_partitioning_plan_id
+from walkai_nos_tpu.partitioning.state import NodePartitioning
+from walkai_nos_tpu.tpu.annotations import (
+    parse_node_annotations,
+    spec_annotations_from_node_partitioning,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class Partitioner:
+    def __init__(self, kube: KubeClient):
+        self._kube = kube
+
+    def apply_partitioning(
+        self,
+        node: dict,
+        partitioning: NodePartitioning,
+        plan_id: str | None = None,
+    ) -> str:
+        """Write the desired partitioning; returns the plan ID."""
+        plan_id = plan_id or new_partitioning_plan_id()
+        _, old_spec = parse_node_annotations(objects.annotations(node))
+        updates: dict[str, str | None] = {a.key: None for a in old_spec}
+        for ann in spec_annotations_from_node_partitioning(
+            partitioning.per_mesh_geometry()
+        ):
+            updates[ann.key] = ann.value
+        updates[constants.ANNOTATION_PARTITIONING_PLAN] = plan_id
+        self._kube.patch(
+            "Node", objects.name(node), objects.annotation_patch(updates)
+        )
+        logger.info(
+            "partitioner: node %s spec updated (plan %s): %s",
+            objects.name(node),
+            plan_id,
+            partitioning.per_mesh_geometry(),
+        )
+        return plan_id

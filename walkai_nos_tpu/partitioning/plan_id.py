@@ -1,0 +1,24 @@
+"""Plan IDs: UTC unix-nanosecond strings.
+
+Port of `internal/partitioning/mig/plan.go:24-26`. The ID is written with
+the spec and echoed back in status so the partitioner can tell which plan a
+node's reported state reflects.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+# Injectable for tests (the reference injects the generator through
+# `InjectFunc`, `mig_controller.go:209-213`).
+_now_ns: Callable[[], int] = time.time_ns
+
+
+def new_partitioning_plan_id() -> str:
+    return str(_now_ns())
+
+
+def set_clock_for_tests(now_ns: Callable[[], int]) -> None:
+    global _now_ns
+    _now_ns = now_ns

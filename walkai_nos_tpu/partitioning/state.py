@@ -1,0 +1,59 @@
+"""Desired-state value types.
+
+Port of `internal/partitioning/state/partitioning.go:24-56` +
+`internal/partitioning/mig/state.go:25-45` (node conversion).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from walkai_nos_tpu.tpu.partitioning import Geometry
+
+
+@dataclass(frozen=True)
+class MeshPartitioning:
+    """Desired slices for one mesh (`GPUPartitioning` analogue)."""
+
+    mesh_index: int
+    resources: tuple[tuple[str, int], ...]  # sorted (profile, qty) pairs
+
+    @staticmethod
+    def of(mesh_index: int, geometry: Geometry) -> "MeshPartitioning":
+        return MeshPartitioning(
+            mesh_index=mesh_index,
+            resources=tuple(
+                sorted((p, q) for p, q in geometry.items() if q > 0)
+            ),
+        )
+
+    def geometry(self) -> Geometry:
+        return {p: q for p, q in self.resources}
+
+
+@dataclass(frozen=True)
+class NodePartitioning:
+    """Desired slices for one node (`NodePartitioning` analogue).
+
+    Equality is order-insensitive by construction (sorted tuples)."""
+
+    name: str
+    meshes: tuple[MeshPartitioning, ...] = field(default_factory=tuple)
+
+    def per_mesh_geometry(self) -> dict[int, Geometry]:
+        return {m.mesh_index: m.geometry() for m in self.meshes}
+
+
+class PartitioningState(dict):
+    """node name -> NodePartitioning (`PartitioningState` analogue)."""
+
+
+def build_node_partitioning(node) -> NodePartitioning:
+    """tiling.Node -> NodePartitioning (`internal/partitioning/mig/state.go:25-45`)."""
+    return NodePartitioning(
+        name=node.name,
+        meshes=tuple(
+            MeshPartitioning.of(idx, geom)
+            for idx, geom in sorted(node.geometry().items())
+        ),
+    )

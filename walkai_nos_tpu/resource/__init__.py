@@ -1,0 +1,2 @@
+from walkai_nos_tpu.resource.client import ResourceClient  # noqa: F401
+from walkai_nos_tpu.resource.fake import FakeResourceClient  # noqa: F401

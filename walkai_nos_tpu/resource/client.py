@@ -1,0 +1,26 @@
+"""Kubelet resource introspection (L0').
+
+Analogue of `pkg/resource/client.go:26-29`: ground truth for which
+device-plugin devices exist on this node (allocatable) and which are
+attached to running containers (used), from the kubelet pod-resources API
+(`unix:///var/lib/kubelet/pod-resources/kubelet.sock`). Works identically
+for `walkai.io/tpu-*` devices — device plugins are resource-agnostic.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from walkai_nos_tpu.tpu.device import Device
+
+
+class ResourceClient(abc.ABC):
+    @abc.abstractmethod
+    def get_allocatable_devices(self, resource_prefix: str = "") -> list[Device]:
+        """Every device the kubelet can allocate (status unset/unknown).
+        Reference: `GetAllocatableDevices` (`pkg/resource/client.go:39-60`)."""
+
+    @abc.abstractmethod
+    def get_used_devices(self, resource_prefix: str = "") -> list[Device]:
+        """Devices currently attached to pods (status=used).
+        Reference: `GetUsedDevices` (`pkg/resource/client.go:62-87`)."""

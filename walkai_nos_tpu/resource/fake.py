@@ -1,0 +1,60 @@
+"""Fake kubelet resource client (mock analogue: `pkg/test/mocks/resource/`)."""
+
+from __future__ import annotations
+
+import threading
+
+from walkai_nos_tpu.resource.client import ResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+
+
+class FakeResourceClient(ResourceClient):
+    """In-memory allocatable/used sets keyed by device ID."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._allocatable: dict[str, Device] = {}
+        self._used_ids: set[str] = set()
+
+    # ------------------------------------------------------------- test hooks
+
+    def set_allocatable(self, devices: list[Device]) -> None:
+        with self._lock:
+            self._allocatable = {d.device_id: d for d in devices}
+
+    def mark_used(self, device_id: str) -> None:
+        with self._lock:
+            self._used_ids.add(device_id)
+
+    def mark_free(self, device_id: str) -> None:
+        with self._lock:
+            self._used_ids.discard(device_id)
+
+    # -------------------------------------------------------------- interface
+
+    def get_allocatable_devices(self, resource_prefix: str = "") -> list[Device]:
+        with self._lock:
+            return [
+                Device(
+                    resource_name=d.resource_name,
+                    device_id=d.device_id,
+                    status=DeviceStatus.UNKNOWN,
+                    mesh_index=d.mesh_index,
+                )
+                for d in sorted(self._allocatable.values(), key=lambda x: x.device_id)
+                if d.resource_name.startswith(resource_prefix)
+            ]
+
+    def get_used_devices(self, resource_prefix: str = "") -> list[Device]:
+        with self._lock:
+            return [
+                Device(
+                    resource_name=d.resource_name,
+                    device_id=d.device_id,
+                    status=DeviceStatus.USED,
+                    mesh_index=d.mesh_index,
+                )
+                for d in sorted(self._allocatable.values(), key=lambda x: x.device_id)
+                if d.device_id in self._used_ids
+                and d.resource_name.startswith(resource_prefix)
+            ]

@@ -1,0 +1,1 @@
+from walkai_nos_tpu.sim.harness import SimCluster, SimNode  # noqa: F401

@@ -1,0 +1,371 @@
+"""Hardware-free cluster simulation — the kind-cluster/envtest analogue.
+
+Wires the real controllers (partitioner pod/node controllers, tpuagent
+reporter/actuator) against the in-memory fakes (kube API, tpudev hosts,
+kubelet resource clients) plus two simulated cluster components:
+
+- a *device-plugin simulator*: respawns the walkai device-plugin pod when
+  the actuator restarts it (DaemonSet behavior) and re-advertises the
+  host's materialized slices as allocatable devices (what the real plugin
+  does via the kubelet device-plugin API);
+- a *scheduler simulator*: marks pending slice-requesting pods
+  Unschedulable (so the partitioner considers them), binds them to a node
+  once the wanted devices are allocatable, and marks devices used (what
+  kube-scheduler + kubelet do).
+
+This is the reference's §7.3 "minimum end-to-end slice": label a node,
+node-init writes the default tiling, the agent materializes + reports, a
+pending pod triggers re-tiling, the pod schedules.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.partitioner import NodeController, PodController
+from walkai_nos_tpu.controllers.tpuagent import (
+    Actuator,
+    Reporter,
+    SharedState,
+)
+from walkai_nos_tpu.kube import objects, predicates
+from walkai_nos_tpu.kube.client import NotFound
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.runtime import Controller, Manager, Request, Result
+from walkai_nos_tpu.resource.fake import FakeResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+from walkai_nos_tpu.tpu.tiling.client import DevicePluginClient, TilingClient
+from walkai_nos_tpu.tpu.tiling.profile import get_requested_profiles
+from walkai_nos_tpu.tpu.topology import Shape
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+
+class SimNode:
+    """One simulated TPU host: tpudev + kubelet resources + agent."""
+
+    def __init__(
+        self,
+        name: str,
+        mesh: Shape = (2, 4),
+        accelerator: str = "tpu-v5-lite-podslice",
+    ) -> None:
+        self.name = name
+        self.mesh = mesh
+        self.accelerator = accelerator
+        self.tpudev = FakeTpudevClient(mesh=mesh)
+        self.resources = FakeResourceClient()
+        self.shared = SharedState()
+
+    def advertise_slices(self) -> None:
+        """What the device plugin does on (re)start: advertise every
+        materialized slice as an allocatable device."""
+        used_ids = {
+            d.device_id for d in self.resources.get_used_devices()
+        }
+        self.resources.set_allocatable(
+            [
+                Device(
+                    resource_name=s.resource_name,
+                    device_id=s.slice_id,
+                    status=DeviceStatus.UNKNOWN,
+                    mesh_index=s.mesh_index,
+                )
+                for s in self.tpudev.list_slices()
+            ]
+        )
+        for dev_id in used_ids:
+            self.resources.mark_used(dev_id)
+
+
+class SimCluster:
+    def __init__(self, report_interval: float = 0.05) -> None:
+        self.kube = FakeKubeClient()
+        self.nodes: dict[str, SimNode] = {}
+        self.manager = Manager()
+        self._report_interval = report_interval
+        self._partitioner_wired = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- topology
+
+    def add_node(
+        self,
+        name: str,
+        mesh: Shape = (2, 4),
+        accelerator: str = "tpu-v5-lite-podslice",
+        topology_label: str | None = None,
+    ) -> SimNode:
+        sim = SimNode(name, mesh=mesh, accelerator=accelerator)
+        self.nodes[name] = sim
+        self.kube.create(
+            "Node",
+            {
+                "metadata": {
+                    "name": name,
+                    "labels": {
+                        constants.LABEL_TPU_ACCELERATOR: accelerator,
+                        constants.LABEL_TPU_TOPOLOGY: topology_label
+                        or "x".join(str(d) for d in mesh),
+                        constants.LABEL_TPU_PARTITIONING: "tiling",
+                    },
+                },
+                "status": {"capacity": {}, "allocatable": {}},
+            },
+        )
+        self._create_plugin_pod(name)
+        self._wire_agent(sim)
+        return sim
+
+    def _create_plugin_pod(self, node_name: str) -> None:
+        self.kube.create(
+            "Pod",
+            {
+                "metadata": {
+                    "name": f"walkai-tpu-device-plugin-{node_name}-{uuid.uuid4().hex[:5]}",
+                    "namespace": "kube-system",
+                    "labels": {
+                        constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                    },
+                    "ownerReferences": [{"kind": "DaemonSet", "name": "walkai-tpu-device-plugin"}],
+                },
+                "spec": {"nodeName": node_name},
+                "status": {"phase": "Running"},
+            },
+        )
+
+    # ------------------------------------------------------------ controllers
+
+    def _wire_agent(self, sim: SimNode) -> None:
+        tiling_client = TilingClient(sim.resources, sim.tpudev)
+        plugin_client = DevicePluginClient(
+            self.kube, poll_interval=0.01, restart_timeout=5.0
+        )
+        reporter = Reporter(
+            self.kube,
+            tiling_client,
+            sim.shared,
+            sim.name,
+            refresh_interval=self._report_interval,
+        )
+        actuator = Actuator(
+            self.kube, tiling_client, plugin_client, sim.shared, sim.name
+        )
+        self.manager.add(
+            Controller(
+                f"reporter-{sim.name}",
+                self.kube,
+                "Node",
+                reporter.reconcile,
+                predicates=[
+                    predicates.matching_name(sim.name),
+                    predicates.exclude_delete(),
+                ],
+            )
+        )
+        self.manager.add(
+            Controller(
+                f"actuator-{sim.name}",
+                self.kube,
+                "Node",
+                actuator.reconcile,
+                predicates=[
+                    predicates.matching_name(sim.name),
+                    predicates.exclude_delete(),
+                    predicates.annotations_changed(),
+                ],
+            )
+        )
+
+    def wire_partitioner(self) -> None:
+        if self._partitioner_wired:
+            return
+        self._partitioner_wired = True
+        pod_controller = PodController(
+            self.kube, retry_interval=max(self._report_interval * 4, 0.2)
+        )
+        node_controller = NodeController(self.kube)
+        self.manager.add(
+            Controller(
+                constants.PARTITIONER_CONTROLLER_NAME,
+                self.kube,
+                "Pod",
+                pod_controller.reconcile,
+                max_concurrent=1,  # `mig_controller.go:204`
+            )
+        )
+        self.manager.add(
+            Controller(
+                "tpu-node-controller",
+                self.kube,
+                "Node",
+                node_controller.reconcile,
+                predicates=[
+                    predicates.has_label(constants.LABEL_TPU_PARTITIONING)
+                ],
+                max_concurrent=5,  # `node_controller.go:113`
+            )
+        )
+        # simulators. The device-plugin simulator is keyed on Nodes (which
+        # always exist), so its requeue chain survives windows with no
+        # plugin pods; pod deletions are healed by the periodic requeue.
+        self.manager.add(
+            Controller(
+                "sim-device-plugin",
+                self.kube,
+                "Node",
+                self._plugin_sim_reconcile,
+            )
+        )
+        self.manager.add(
+            Controller(
+                "sim-scheduler",
+                self.kube,
+                "Pod",
+                self._scheduler_sim_reconcile,
+            )
+        )
+
+    # -------------------------------------------------------- plugin simulator
+
+    def _plugin_sim_reconcile(self, request: Request) -> Result:
+        """DaemonSet + device-plugin behavior: for every node, make sure a
+        Running plugin pod exists and the node's slices are advertised."""
+        with self._lock:
+            plugin_pods = self.kube.list(
+                "Pod",
+                label_selector={
+                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                },
+            )
+            nodes_with_plugin = {
+                (p.get("spec") or {}).get("nodeName") for p in plugin_pods
+            }
+            for name, sim in self.nodes.items():
+                if name not in nodes_with_plugin:
+                    self._create_plugin_pod(name)
+                sim.advertise_slices()
+        return Result(requeue_after=self._report_interval)
+
+    # ----------------------------------------------------- scheduler simulator
+
+    def _scheduler_sim_reconcile(self, request: Request) -> Result:
+        """kube-scheduler + kubelet behavior for slice-requesting pods."""
+        try:
+            pod = self.kube.get("Pod", request.name, request.namespace or None)
+        except NotFound:
+            return Result()
+        if objects.pod_is_scheduled(pod) or not objects.pod_is_pending(pod):
+            return Result()
+        wanted = get_requested_profiles(pod)
+        if not wanted:
+            return Result()
+        with self._lock:
+            for name, sim in self.nodes.items():
+                free = self._free_devices(sim)
+                chosen: list[Device] = []
+                satisfiable = True
+                for profile, qty in wanted.items():
+                    matches = [
+                        d
+                        for d in free
+                        if d.resource_name
+                        == constants.RESOURCE_TPU_SLICE_PREFIX + profile
+                        and d not in chosen
+                    ]
+                    if len(matches) < qty:
+                        satisfiable = False
+                        break
+                    chosen.extend(matches[:qty])
+                if satisfiable:
+                    for d in chosen:
+                        sim.resources.mark_used(d.device_id)
+                    self.kube.patch(
+                        "Pod",
+                        request.name,
+                        {
+                            "spec": {"nodeName": name},
+                            "status": {
+                                "phase": "Running",
+                                "conditions": [
+                                    {"type": "PodScheduled", "status": "True"}
+                                ],
+                            },
+                        },
+                        request.namespace or None,
+                    )
+                    return Result()
+        # Unschedulable: record the condition so the partitioner reacts.
+        if not objects.pod_is_unschedulable(pod):
+            self.kube.patch(
+                "Pod",
+                request.name,
+                {
+                    "status": {
+                        "conditions": [
+                            {
+                                "type": "PodScheduled",
+                                "status": "False",
+                                "reason": "Unschedulable",
+                            }
+                        ]
+                    }
+                },
+                request.namespace or None,
+            )
+        return Result(requeue_after=self._report_interval)
+
+    def _free_devices(self, sim: SimNode) -> list[Device]:
+        used = {d.device_id for d in sim.resources.get_used_devices()}
+        return [
+            d
+            for d in sim.resources.get_allocatable_devices()
+            if d.device_id not in used
+        ]
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.wire_partitioner()
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
+
+    def __enter__(self) -> "SimCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --------------------------------------------------------------- helpers
+
+    def create_slice_pod(
+        self, name: str, profile: str, quantity: int = 1, namespace: str = "default"
+    ) -> dict:
+        return self.kube.create(
+            "Pod",
+            {
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "main",
+                            "resources": {
+                                "requests": {
+                                    constants.RESOURCE_TPU_SLICE_PREFIX
+                                    + profile: str(quantity)
+                                },
+                                "limits": {
+                                    constants.RESOURCE_TPU_SLICE_PREFIX
+                                    + profile: str(quantity)
+                                },
+                            },
+                        }
+                    ]
+                },
+                "status": {"phase": "Pending"},
+            },
+        )

@@ -1,0 +1,20 @@
+"""Sharing partitioning model (L2) — the MPS/"slicing" analogue.
+
+Where tiling carves the ICI mesh into contiguous sub-meshes, *sharing*
+hands out chip-count shares (`walkai.io/tpu-shared-<n>c`) without a
+contiguity guarantee — the TPU equivalent of the reference's memory-based
+MPS slicing (`pkg/gpu/slicing/`). Like the reference fork, sharing is
+report-only at the controller level (the gpu-agent only reports,
+`internal/controllers/gpuagent/reporter.go`), but the full domain model is
+implemented so a planner/actuator can be added without redesign.
+"""
+
+from walkai_nos_tpu.tpu.sharing.profile import (  # noqa: F401
+    SharedProfile,
+    extract_shared_profile_name,
+    is_shared_resource,
+    shared_profile_resource_name,
+    get_requested_shared_profiles,
+)
+from walkai_nos_tpu.tpu.sharing.mesh import SharedTpuMesh  # noqa: F401
+from walkai_nos_tpu.tpu.sharing.node import SharingNode  # noqa: F401

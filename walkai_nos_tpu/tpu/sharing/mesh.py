@@ -1,0 +1,125 @@
+"""Per-host shared-chip state and the share-packing search.
+
+Analogue of `slicing.GPU` (`pkg/gpu/slicing/gpu.go:27-265`): shares are
+chip-count chunks packed against the host's total chips (where the
+reference packs GB against GPU memory). `update_geometry_for` mirrors the
+reference's two-phase strategy (`gpu.go:162-230`): first fill spare chips
+smallest-missing-first, then try deleting free shares and re-packing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.partitioning import Geometry
+from walkai_nos_tpu.tpu.sharing.profile import SharedProfile
+
+
+def _chips_of(profile: str) -> int:
+    return SharedProfile.parse(profile).chip_count()
+
+
+def _total_chips(geom: Geometry) -> int:
+    return sum(_chips_of(p) * q for p, q in geom.items())
+
+
+@dataclass
+class SharedTpuMesh:
+    model: topology.TpuModel
+    mesh_index: int = 0
+    used: Geometry = field(default_factory=dict)
+    free: Geometry = field(default_factory=dict)
+
+    def geometry(self) -> Geometry:
+        geom: Geometry = dict(self.free)
+        for p, q in self.used.items():
+            geom[p] = geom.get(p, 0) + q
+        return {p: q for p, q in geom.items() if q > 0}
+
+    def free_count(self, profile: str) -> int:
+        return self.free.get(profile, 0)
+
+    def has_free_devices(self) -> bool:
+        """Any free share on this mesh (`slicing/gpu.go:131` analogue)."""
+        return any(q > 0 for q in self.free.values())
+
+    def spare_chips(self) -> int:
+        return self.model.chips_per_host - _total_chips(self.geometry())
+
+    def validate(self) -> None:
+        """Min share = 1 chip, total shares ≤ host chips (`gpu.go:67-96`)."""
+        for p in self.geometry():
+            if _chips_of(p) < 1:
+                raise GenericError(f"share {p} below minimum size")
+        if _total_chips(self.geometry()) > self.model.chips_per_host:
+            raise GenericError(
+                f"shares exceed host chips ({_total_chips(self.geometry())} > "
+                f"{self.model.chips_per_host})"
+            )
+
+    def clone(self) -> "SharedTpuMesh":
+        return SharedTpuMesh(
+            model=self.model,
+            mesh_index=self.mesh_index,
+            used=dict(self.used),
+            free=dict(self.free),
+        )
+
+    # ---------------------------------------------------------------- search
+
+    def update_geometry_for(self, wanted: Geometry) -> bool:
+        """Create missing shares to satisfy `wanted` (`gpu.go:162-230`).
+
+        Phase 1: pack missing shares into spare chips, smallest profile
+        first. Phase 2: if still unsatisfied, delete free shares and re-pack
+        them together with the missing ones.
+        """
+        missing = {
+            p: q - self.free_count(p)
+            for p, q in wanted.items()
+            if q - self.free_count(p) > 0
+        }
+        if not missing:
+            return False
+        changed = False
+        # Phase 1: fill spare chips, smallest missing share first.
+        for p in sorted(missing, key=_chips_of):
+            while missing.get(p, 0) > 0 and _chips_of(p) <= self.spare_chips():
+                self.free[p] = self.free.get(p, 0) + 1
+                missing[p] -= 1
+                changed = True
+            if missing.get(p, 0) == 0:
+                missing.pop(p, None)
+        if not missing:
+            return changed
+        # Phase 2: delete free shares, re-pack (free + missing) greedily.
+        pool = self.spare_chips() + _total_chips(self.free)
+        new_free: Geometry = {}
+        for p in sorted(missing, key=_chips_of):
+            want = missing[p]
+            while want > 0 and _chips_of(p) <= pool:
+                new_free[p] = new_free.get(p, 0) + 1
+                pool -= _chips_of(p)
+                want -= 1
+        if not new_free:
+            return changed
+        # Keep as many previous free shares as still fit.
+        for p in sorted(self.free, key=_chips_of):
+            for _ in range(self.free[p]):
+                if _chips_of(p) <= pool:
+                    new_free[p] = new_free.get(p, 0) + 1
+                    pool -= _chips_of(p)
+        self.free = new_free
+        return True
+
+    def add_pod(self, profile: str, quantity: int = 1) -> None:
+        if self.free.get(profile, 0) < quantity:
+            raise GenericError(
+                f"mesh {self.mesh_index}: cannot allocate {quantity}x{profile}"
+            )
+        self.free[profile] -= quantity
+        if self.free[profile] == 0:
+            del self.free[profile]
+        self.used[profile] = self.used.get(profile, 0) + quantity

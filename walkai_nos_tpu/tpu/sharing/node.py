@@ -1,0 +1,117 @@
+"""Node-level sharing model (`pkg/gpu/slicing/node.go:26-215` analogue)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.device import DeviceStatus
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.partitioning import Geometry
+from walkai_nos_tpu.tpu.sharing.mesh import SharedTpuMesh
+from walkai_nos_tpu.tpu.sharing.profile import SharedProfile
+
+
+@dataclass
+class SharingNode:
+    name: str
+    model: topology.TpuModel | None
+    meshes: list[SharedTpuMesh] = field(default_factory=list)
+
+    @staticmethod
+    def from_node(
+        name: str,
+        labels: Mapping[str, str],
+        annotations: Mapping[str, str],
+    ) -> "SharingNode":
+        model = topology.get_model(labels)
+        if model is None:
+            return SharingNode(name=name, model=None, meshes=[])
+        status, _ = parse_node_annotations(annotations)
+        indices = {s.mesh_index for s in status} | {0}
+        meshes = []
+        for idx in sorted(indices):
+            used: Geometry = {}
+            free: Geometry = {}
+            for s in status:
+                if s.mesh_index != idx or s.quantity <= 0:
+                    continue
+                try:
+                    SharedProfile.parse(s.profile)
+                except ValueError:
+                    continue  # tiling profile on a sharing node: skip
+                target = used if s.status == DeviceStatus.USED else free
+                target[s.profile] = target.get(s.profile, 0) + s.quantity
+            meshes.append(
+                SharedTpuMesh(model=model, mesh_index=idx, used=used, free=free)
+            )
+        return SharingNode(name=name, model=model, meshes=meshes)
+
+    def geometry(self) -> dict[int, Geometry]:
+        return {m.mesh_index: m.geometry() for m in self.meshes}
+
+    def has_free_capacity(self) -> bool:
+        """Any free share, or spare chips to create more
+        (`slicing/node.go:207-214` + `slicing/gpu.go:131`)."""
+        for m in self.meshes:
+            if m.has_free_devices():
+                return True
+            if m.spare_chips() > 0:
+                return True
+        return False
+
+    def update_geometry_for(self, wanted: Geometry) -> bool:
+        remaining = {p: q for p, q in wanted.items() if q > 0}
+        changed = False
+        for m in self.meshes:
+            if not remaining:
+                break
+            for p in list(remaining):
+                take = min(remaining[p], m.free_count(p))
+                if take:
+                    remaining[p] -= take
+                    if remaining[p] == 0:
+                        del remaining[p]
+            if not remaining:
+                break
+            if m.update_geometry_for(remaining):
+                changed = True
+                for p in list(remaining):
+                    take = min(remaining[p], m.free_count(p))
+                    if take:
+                        remaining[p] -= take
+                        if remaining[p] == 0:
+                            del remaining[p]
+        return changed
+
+    def provides_profiles(self, wanted: Geometry) -> bool:
+        remaining = {p: q for p, q in wanted.items() if q > 0}
+        for m in self.meshes:
+            for p in list(remaining):
+                take = min(remaining[p], m.free_count(p))
+                remaining[p] -= take
+                if remaining[p] == 0:
+                    del remaining[p]
+        return not remaining
+
+    def add_pod(self, profiles: Geometry) -> None:
+        if not self.provides_profiles(profiles):
+            raise GenericError(f"node {self.name}: cannot place {profiles}")
+        remaining = {p: q for p, q in profiles.items() if q > 0}
+        for m in self.meshes:
+            for p in list(remaining):
+                take = min(remaining[p], m.free_count(p))
+                for _ in range(take):
+                    m.add_pod(p)
+                remaining[p] -= take
+                if remaining[p] == 0:
+                    del remaining[p]
+
+    def clone(self) -> "SharingNode":
+        return SharingNode(
+            name=self.name,
+            model=self.model,
+            meshes=[m.clone() for m in self.meshes],
+        )

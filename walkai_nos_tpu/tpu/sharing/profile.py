@@ -1,0 +1,81 @@
+"""Shared chip-count profiles: ``"2c"`` = a share of 2 chips.
+
+Analogue of `pkg/gpu/slicing/profile.go:29-64` (``"10gb"`` memory slices):
+same string-profile + resource-name mapping, with chips instead of GB.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Mapping
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.utils.quantity import parse_quantity
+
+_PROFILE_RE = re.compile(r"^(\d+)c$")
+_RESOURCE_RE = re.compile(
+    re.escape(constants.RESOURCE_TPU_SHARED_PREFIX) + r"(\d+c)$"
+)
+
+
+@dataclass(frozen=True, order=True)
+class SharedProfile:
+    chips: int
+
+    @staticmethod
+    def parse(name: str) -> "SharedProfile":
+        m = _PROFILE_RE.match(name)
+        if m is None or int(m.group(1)) <= 0:
+            raise ValueError(f"invalid shared profile {name!r}")
+        return SharedProfile(chips=int(m.group(1)))
+
+    @property
+    def name(self) -> str:
+        return f"{self.chips}c"
+
+    def chip_count(self) -> int:
+        return self.chips
+
+    def smaller_than(self, other: "SharedProfile") -> bool:
+        return self.chips < other.chips
+
+    def as_resource_name(self) -> str:
+        return shared_profile_resource_name(self.name)
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def shared_profile_resource_name(profile: str) -> str:
+    return constants.RESOURCE_TPU_SHARED_PREFIX + profile
+
+
+def is_shared_resource(resource_name: str) -> bool:
+    return _RESOURCE_RE.match(resource_name) is not None
+
+
+def extract_shared_profile_name(resource_name: str) -> str:
+    m = _RESOURCE_RE.match(resource_name)
+    if m is None:
+        raise ValueError(f"{resource_name!r} is not a shared TPU resource")
+    return m.group(1)
+
+
+def get_requested_shared_profiles(pod: Mapping) -> dict[str, int]:
+    """{profile: qty} requested by a pod (`slicing/util.go` analogue)."""
+    out: dict[str, int] = {}
+    for c in (pod.get("spec", {}).get("containers") or []):
+        reqs = (c.get("resources") or {}).get("requests") or {}
+        limits = (c.get("resources") or {}).get("limits") or {}
+        for res, raw in {**limits, **reqs}.items():
+            if not is_shared_resource(res):
+                continue
+            try:
+                qty = parse_quantity(raw)
+            except ValueError:
+                continue
+            if qty > 0:
+                p = extract_shared_profile_name(res)
+                out[p] = out.get(p, 0) + qty
+    return out

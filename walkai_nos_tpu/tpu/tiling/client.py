@@ -1,0 +1,140 @@
+"""Tiling device client: orchestrates kubelet introspection + tpudev.
+
+Analogue of `mig.Client` (`pkg/gpu/mig/client.go:28-174`): device state is
+*used* (kubelet says a pod holds it) + *free* (allocatable minus used), with
+each device's mesh index resolved through the device layer; creation and
+deletion delegate to tpudev with partial-failure tolerance.
+"""
+
+from __future__ import annotations
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.resource.client import ResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceList, DeviceStatus
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpudev.client import SliceInfo, TpudevClient
+
+
+class TilingClient:
+    def __init__(self, resource_client: ResourceClient, tpudev: TpudevClient):
+        self._resource = resource_client
+        self._tpudev = tpudev
+
+    def get_tpu_devices(self) -> DeviceList:
+        """Used + free slice devices with mesh indices
+        (`client.go:80-130` `GetMigDevices`).
+
+        Raises NotFoundError (propagated from tpudev) when the kubelet
+        advertises a device the device layer doesn't know — the actuator
+        turns that into a device-plugin restart (`actuator.go:135-138`).
+        """
+        used = self._resource.get_used_devices(constants.RESOURCE_TPU_SLICE_PREFIX)
+        allocatable = self._resource.get_allocatable_devices(
+            constants.RESOURCE_TPU_SLICE_PREFIX
+        )
+        used_ids = {d.device_id for d in used}
+        out = DeviceList()
+        for d in used:
+            out.append(self._with_mesh_index(d, DeviceStatus.USED))
+        for d in allocatable:
+            if d.device_id not in used_ids:
+                out.append(self._with_mesh_index(d, DeviceStatus.FREE))
+        return out
+
+    def _with_mesh_index(self, device: Device, status: DeviceStatus) -> Device:
+        idx = self._tpudev.get_slice_mesh_index(device.device_id)
+        return Device(
+            resource_name=device.resource_name,
+            device_id=device.device_id,
+            status=status,
+            mesh_index=idx,
+        )
+
+    def create_slices(self, placements: list) -> list[SliceInfo]:
+        """Create slices; tolerates partial failure like
+        `CreateMigDevices` (`client.go:50-74`)."""
+        return self._tpudev.create_slices(placements)
+
+    def delete_slice(self, slice_id: str) -> None:
+        self._tpudev.delete_slice(slice_id)
+
+    def delete_all_except(self, keep: DeviceList) -> list[str]:
+        """Startup cleanup (`client.go:131-160` `DeleteAllExcept`)."""
+        return self._tpudev.delete_all_slices_except(
+            {d.device_id for d in keep}
+        )
+
+    def get_topology(self):
+        return self._tpudev.get_topology()
+
+
+class DevicePluginClient:
+    """Restarts the walkai TPU device plugin pod on a node and waits for the
+    replacement — forcing re-advertisement of slice resources.
+
+    Analogue of `gpu.DevicePluginClient` (`pkg/gpu/client.go:29-135`): the
+    reference deletes the `nvidia-device-plugin-daemonset` pod and polls
+    until the DaemonSet respawns it Running.
+    """
+
+    def __init__(
+        self,
+        kube_client,
+        poll_interval: float = 0.1,
+        restart_timeout: float = constants.DEFAULT_DEVICE_PLUGIN_RESTART_TIMEOUT_S,
+    ):
+        self._kube = kube_client
+        self._poll = poll_interval
+        self._restart_timeout = restart_timeout
+
+    def restart(
+        self,
+        node_name: str,
+        timeout: float | None = None,
+    ) -> None:
+        import time
+
+        from walkai_nos_tpu.kube import objects
+        from walkai_nos_tpu.kube.client import NotFound
+
+        timeout = self._restart_timeout if timeout is None else timeout
+        pods = [
+            p
+            for p in self._kube.list(
+                "Pod",
+                label_selector={
+                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                },
+            )
+            if (p.get("spec") or {}).get("nodeName") == node_name
+        ]
+        if not pods:
+            raise GenericError(
+                f"no device plugin pod found on node {node_name}"
+            )
+        doomed = pods[0]
+        try:
+            self._kube.delete(
+                "Pod", objects.name(doomed), objects.namespace(doomed) or None
+            )
+        except NotFound:
+            pass
+        old_uid = objects.uid(doomed)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for p in self._kube.list(
+                "Pod",
+                label_selector={
+                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                },
+            ):
+                if (
+                    (p.get("spec") or {}).get("nodeName") == node_name
+                    and objects.uid(p) != old_uid
+                    and objects.pod_is_running(p)
+                ):
+                    return
+            time.sleep(self._poll)
+        raise GenericError(
+            f"device plugin pod on {node_name} not Running after {timeout}s"
+        )

@@ -48,6 +48,10 @@ class TpuMesh:
     def free_count(self, profile: str) -> int:
         return self.free.get(profile, 0)
 
+    def has_free_devices(self) -> bool:
+        """Any free slice on this mesh (`gpu.HasFreeMigDevices`, node.go:128)."""
+        return any(q > 0 for q in self.free.values())
+
     def used_count(self, profile: str) -> int:
         return self.used.get(profile, 0)
 

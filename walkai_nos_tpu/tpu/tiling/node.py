@@ -53,20 +53,17 @@ class Node:
         """Per-mesh current geometry (`node.go:106-122` `Geometry`)."""
         return {m.mesh_index: m.geometry() for m in self.meshes}
 
-    def has_free_capacity(self, wanted: Geometry) -> bool:
-        """True when some wanted profile is already free, or when any mesh
-        sits in an invalid/unknown geometry — in which case re-partitioning
-        could free capacity (`node.go:124-143` `HasFreeCapacity`)."""
+    def has_free_capacity(self) -> bool:
+        """True when any mesh has a free slice — re-tileable room — or sits
+        in an invalid/unknown geometry, in which case re-partitioning could
+        free capacity (`node.go:122-139` `HasFreeCapacity`: any free MIG
+        device, or current geometry not in the allowed list — which covers
+        the empty geometry of a never-partitioned mesh)."""
         if not self.meshes:
             return False
         for m in self.meshes:
-            for p, q in wanted.items():
-                if q > 0 and m.free_count(p) > 0:
-                    return True
-            # A geometry outside the allowed table — including the empty
-            # geometry of a never-partitioned mesh — means re-partitioning
-            # could free capacity (`node.go:124-139`: the reference returns
-            # true whenever the current geometry is not in the allowed list).
+            if m.has_free_devices():
+                return True
             if geometry_id(m.geometry()) not in {
                 geometry_id(g) for g in m.allowed_geometries()
             }:

@@ -1,0 +1,21 @@
+"""tpudev: the TPU host device layer (L0) — the NVML-binding analogue.
+
+The reference's only native boundary is `pkg/gpu/nvml/` (cgo NVML client
+behind `//go:build nvml`, pure-Go stub otherwise). Here the same dual:
+
+- `NativeTpudevClient` (`native.py`): ctypes binding over the C++
+  `libtpudev` library (`native/tpudev/`), which enumerates `/dev/accel*`
+  chips, reads ICI topology, and materializes sub-slice visibility sets for
+  the device plugin on a real TPU-VM host.
+- `StubTpudevClient` (`stub.py`): the default, hardware-free build.
+- `FakeTpudevClient` (`fake.py`): in-memory host for tests/simulation.
+"""
+
+from walkai_nos_tpu.tpudev.client import (  # noqa: F401
+    ChipInfo,
+    HostTopology,
+    SliceInfo,
+    TpudevClient,
+)
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient  # noqa: F401
+from walkai_nos_tpu.tpudev.stub import StubTpudevClient  # noqa: F401

@@ -1,0 +1,90 @@
+"""TpudevClient interface: the device-control boundary.
+
+Analogue of `nvml.Client` (`pkg/gpu/nvml/interface.go:23-35`) with TPU
+semantics: instead of MIG GPU-instance/compute-instance create/destroy, a
+"slice" on a TPU-VM host is a *materialized visibility set* — a named group
+of chips plus the TPU runtime environment (TPU_VISIBLE_CHIPS /
+TPU_PROCESS_BOUNDS / TPU_CHIPS_PER_PROCESS_BOUNDS) that the walkai device
+plugin advertises as one `walkai.io/tpu-<shape>` device and injects into
+the pod that is allocated the slice.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+from walkai_nos_tpu.tpu.topology import Shape
+
+
+@dataclass(frozen=True)
+class ChipInfo:
+    """One TPU chip on the host."""
+
+    chip_id: int  # host-local ordinal (stable across reboots)
+    device_path: str  # e.g. "/dev/accel0"
+    coords: tuple[int, ...]  # position in the host ICI mesh
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    mesh: Shape  # host ICI mesh shape, e.g. (2, 4)
+    chips: tuple[ChipInfo, ...]
+    mesh_index: int = 0  # the GpuIndex analogue (one mesh per host)
+
+    @property
+    def chip_count(self) -> int:
+        return len(self.chips)
+
+
+@dataclass(frozen=True)
+class SliceInfo:
+    """A materialized sub-slice."""
+
+    slice_id: str  # e.g. "2x2@0-0" (packing.Placement.slice_id())
+    profile: str  # canonical shape, e.g. "2x2"
+    mesh_index: int
+    chip_ids: tuple[int, ...]  # chips in the visibility set
+    env: dict[str, str] = field(default_factory=dict)  # TPU runtime env
+    # injected into allocated pods
+
+    @property
+    def resource_name(self) -> str:
+        from walkai_nos_tpu.api import constants
+
+        return constants.RESOURCE_TPU_SLICE_PREFIX + self.profile
+
+
+class TpudevClient(abc.ABC):
+    """Device-control boundary (reference: `nvml/interface.go:23-35`)."""
+
+    @abc.abstractmethod
+    def get_topology(self) -> HostTopology:
+        """Enumerate chips + ICI mesh (the GetMigEnabledGPUs analogue: a
+        host with zero chips is not TPU-partitionable)."""
+
+    @abc.abstractmethod
+    def list_slices(self) -> list[SliceInfo]:
+        """All currently materialized slices."""
+
+    @abc.abstractmethod
+    def get_slice_mesh_index(self, slice_id: str) -> int:
+        """Mesh index owning a slice (`GetMigDeviceGpuIndex` analogue);
+        raises NotFoundError for unknown slices."""
+
+    @abc.abstractmethod
+    def create_slices(self, placements: list) -> list[SliceInfo]:
+        """Materialize slices for `packing.Placement`s. All-or-nothing per
+        call is NOT guaranteed: returns the successfully created slices and
+        raises only if none could be created — mirroring the partial-failure
+        tolerance of `mig.Client.CreateMigDevices` (`client.go:50-74`)."""
+
+    @abc.abstractmethod
+    def delete_slice(self, slice_id: str) -> None:
+        """Tear down one slice (`DeleteMigDevice` analogue); raises
+        NotFoundError if absent."""
+
+    @abc.abstractmethod
+    def delete_all_slices_except(self, keep_slice_ids: set[str]) -> list[str]:
+        """Startup cleanup of orphans (`DeleteAllMigDevicesExcept`,
+        `nvml/client.go:369-456`). Returns deleted slice IDs."""

@@ -1,0 +1,112 @@
+"""In-memory fake TPU host (the mockery-mock analogue, but stateful).
+
+Tracks chip occupancy so overlapping creates fail the way the real device
+layer would; used by unit tests, the simulation harness, and the fake
+device plugin.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from walkai_nos_tpu.tpu import topology as topo
+from walkai_nos_tpu.tpu.errors import GenericError, NotFoundError
+from walkai_nos_tpu.tpu.tiling import grid as gridlib
+from walkai_nos_tpu.tpudev.client import (
+    ChipInfo,
+    HostTopology,
+    SliceInfo,
+    TpudevClient,
+)
+
+
+def make_slice_env(mesh: topo.Shape, placement, chip_ids: tuple[int, ...]) -> dict:
+    """TPU runtime env for a slice: what the device plugin injects so a JAX
+    process only initializes its sub-slice."""
+    return {
+        "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chip_ids),
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": ",".join(
+            str(d) for d in (tuple(placement.orientation) + (1, 1, 1))[:3]
+        ),
+        "TPU_SLICE_ID": placement.slice_id(),
+    }
+
+
+class FakeTpudevClient(TpudevClient):
+    def __init__(self, mesh: topo.Shape = (2, 4), mesh_index: int = 0) -> None:
+        self._mesh = mesh
+        self._mesh_index = mesh_index
+        self._lock = threading.RLock()
+        coords = gridlib.all_coords(mesh)
+        self._chips = tuple(
+            ChipInfo(chip_id=i, device_path=f"/dev/accel{i}", coords=c)
+            for i, c in enumerate(coords)
+        )
+        self._coord_to_chip = {c.coords: c.chip_id for c in self._chips}
+        self._slices: dict[str, SliceInfo] = {}
+
+    # ------------------------------------------------------------- interface
+
+    def get_topology(self) -> HostTopology:
+        return HostTopology(
+            mesh=self._mesh, chips=self._chips, mesh_index=self._mesh_index
+        )
+
+    def list_slices(self) -> list[SliceInfo]:
+        with self._lock:
+            return sorted(self._slices.values(), key=lambda s: s.slice_id)
+
+    def get_slice_mesh_index(self, slice_id: str) -> int:
+        with self._lock:
+            if slice_id not in self._slices:
+                raise NotFoundError(f"slice {slice_id} not found")
+            return self._slices[slice_id].mesh_index
+
+    def create_slices(self, placements: list) -> list[SliceInfo]:
+        created: list[SliceInfo] = []
+        errors: list[str] = []
+        with self._lock:
+            occupied: set[int] = set()
+            for s in self._slices.values():
+                occupied.update(s.chip_ids)
+            for p in placements:
+                try:
+                    chip_ids = tuple(
+                        self._coord_to_chip[c] for c in p.cells()
+                    )
+                except KeyError:
+                    errors.append(f"{p.slice_id()}: cell outside host mesh")
+                    continue
+                if p.slice_id() in self._slices:
+                    errors.append(f"{p.slice_id()}: already exists")
+                    continue
+                if occupied.intersection(chip_ids):
+                    errors.append(f"{p.slice_id()}: chips already in a slice")
+                    continue
+                info = SliceInfo(
+                    slice_id=p.slice_id(),
+                    profile=p.profile,
+                    mesh_index=self._mesh_index,
+                    chip_ids=chip_ids,
+                    env=make_slice_env(self._mesh, p, chip_ids),
+                )
+                self._slices[info.slice_id] = info
+                occupied.update(chip_ids)
+                created.append(info)
+        if not created and errors:
+            raise GenericError("; ".join(errors))
+        return created
+
+    def delete_slice(self, slice_id: str) -> None:
+        with self._lock:
+            if slice_id not in self._slices:
+                raise NotFoundError(f"slice {slice_id} not found")
+            del self._slices[slice_id]
+
+    def delete_all_slices_except(self, keep_slice_ids: set[str]) -> list[str]:
+        with self._lock:
+            doomed = [s for s in self._slices if s not in keep_slice_ids]
+            for s in doomed:
+                del self._slices[s]
+            return sorted(doomed)

@@ -1,0 +1,33 @@
+"""No-op stub — the default when the native library isn't present.
+
+Analogue of `pkg/gpu/nvml/client_stub.go:24-58` (`//go:build !nvml`): every
+method fails with a clear "tpudev support disabled" error so non-agent
+binaries and tests never need the hardware layer.
+"""
+
+from __future__ import annotations
+
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpudev.client import HostTopology, SliceInfo, TpudevClient
+
+_MSG = "tpudev support disabled (native libtpudev not loaded)"
+
+
+class StubTpudevClient(TpudevClient):
+    def get_topology(self) -> HostTopology:
+        raise GenericError(_MSG)
+
+    def list_slices(self) -> list[SliceInfo]:
+        raise GenericError(_MSG)
+
+    def get_slice_mesh_index(self, slice_id: str) -> int:
+        raise GenericError(_MSG)
+
+    def create_slices(self, placements: list) -> list[SliceInfo]:
+        raise GenericError(_MSG)
+
+    def delete_slice(self, slice_id: str) -> None:
+        raise GenericError(_MSG)
+
+    def delete_all_slices_except(self, keep_slice_ids: set[str]) -> list[str]:
+        raise GenericError(_MSG)
