@@ -1,0 +1,81 @@
+"""Headline benchmark: the reference's GPU-sharing comparison, TPU-native.
+
+The reference's only published numbers are average inference times of N
+YOLOS-small pods sharing one A100 (BASELINE.md). This bench reproduces the
+workload on one TPU chip: 4 concurrent inference streams (the north-star
+config — 4 concurrent JAX pods, BASELINE.json) each running the flagship
+YOLOS-style ViT at batch 1, reporting the mean per-inference latency.
+
+vs_baseline compares against the reference's MPS result interpolated to 4
+pods ((0.1640 + 0.2409) / 2 = 0.20245 s, `demos/gpu-sharing-comparison/
+README.md:70`), as baseline_s / measured_s — >1.0 means faster than the
+reference's best sharing mode at the same concurrency.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+N_STREAMS = 4
+WARMUP_ITERS = 3
+MEASURE_SECONDS = 15.0
+BASELINE_MPS_4POD_S = (0.1640 + 0.2409) / 2
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from walkai_nos_tpu.models.train import make_infer_step
+    from walkai_nos_tpu.models.vit import VIT_SMALL, ViTDetector
+
+    cfg = VIT_SMALL
+    params = jax.device_put(ViTDetector(cfg).init_params(jax.random.PRNGKey(0)))
+    infer = make_infer_step(cfg)
+
+    images = jnp.ones((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    # Compile once (shared across streams) + warm up.
+    for _ in range(WARMUP_ITERS):
+        jax.block_until_ready(infer(params, images))
+
+    latencies: list[list[float]] = [[] for _ in range(N_STREAMS)]
+    stop = time.monotonic() + MEASURE_SECONDS
+    barrier = threading.Barrier(N_STREAMS)
+
+    def stream(idx: int) -> None:
+        barrier.wait()
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            jax.block_until_ready(infer(params, images))
+            latencies[idx].append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=stream, args=(i,)) for i in range(N_STREAMS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    all_lat = [x for s in latencies for x in s]
+    mean_s = sum(all_lat) / max(len(all_lat), 1)
+    print(
+        json.dumps(
+            {
+                "metric": "avg_inference_time_4streams",
+                "value": round(mean_s, 6),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_MPS_4POD_S / mean_s, 4)
+                if mean_s > 0
+                else 0.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,17 +1,24 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
 Tests never touch TPU hardware (mirrors the reference's rule that no test
-touches NVML — SURVEY.md §4). Must run before any jax import.
+touches NVML — SURVEY.md §4). The interpreter may arrive with jax already
+imported and pointed at real hardware (sitecustomize + JAX_PLATFORMS=axon
+tunneling one TPU chip), so we override via jax.config, which works
+post-import as long as no backend is initialized yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

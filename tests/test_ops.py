@@ -1,0 +1,67 @@
+"""Fused-op correctness: Pallas kernels vs XLA reference (interpret mode).
+
+Mirrors the reference's rule that hardware never appears in tests
+(SURVEY.md §4): Pallas runs in interpret mode on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.ops import attention as attn
+from walkai_nos_tpu.ops.ring_attention import ring_attention
+from walkai_nos_tpu.parallel.mesh import MeshAxes, build_mesh
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    rng = np.random.default_rng(0)
+    b, h, s, d = 2, 3, 256, 32
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        for _ in range(3)
+    )
+    ref = attn.attention_reference(q, k, v, causal=causal)
+    out = attn.flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=64, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_falls_back_on_odd_shapes():
+    # 100 is not a sublane multiple -> XLA reference path.
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((1, 2, 100, 16)), jnp.float32)
+    out = attn.flash_attention(q, q, q, interpret=True)
+    ref = attn.attention_reference(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_causal_cross_length():
+    """sq < sk (decode-style): diagonal is bottom-right aligned, matching
+    the reference's tril(k=sk-sq) on both dispatch paths."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((1, 2, 64, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 256, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 256, 32)), jnp.float32)
+    out = attn.flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=64, interpret=True
+    )
+    ref = attn.attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    """Sequence sharded over a 4-way seq ring == single-device attention."""
+    mesh = build_mesh(jax.devices()[:4], axes=MeshAxes(seq=4))
+    rng = np.random.default_rng(2)
+    b, h, s, d = 1, 2, 64, 16
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        for _ in range(3)
+    )
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = attn.attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -124,7 +124,7 @@ class Actuator:
         # re-registration). Planning against the stale kubelet view would
         # double-create; restart the plugin to resync instead.
         known = {d.device_id for d in devices}
-        materialized = {s.slice_id for s in self._client._tpudev.list_slices()}
+        materialized = {s.slice_id for s in self._client.list_slices()}
         if materialized - known:
             logger.warning(
                 "actuator: %d slice(s) on %s not advertised by kubelet (%s); "
@@ -144,7 +144,7 @@ class Actuator:
         host = self._client.get_topology()
         deleted: list[SliceInfo] = []
         changed = False
-        slice_by_id = {s.slice_id: s for s in self._client._tpudev.list_slices()}
+        slice_by_id = {s.slice_id: s for s in self._client.list_slices()}
 
         # Deletes first, free devices only (`actuator.go:216-261`).
         delete_errors: list[str] = []
@@ -199,7 +199,7 @@ class Actuator:
         for mesh_index, ops in sorted(by_mesh.items()):
             existing = [
                 s
-                for s in self._client._tpudev.list_slices()
+                for s in self._client.list_slices()
                 if s.mesh_index == mesh_index
             ]
             pinned = [placement_from_slice_info(s, host) for s in existing]

@@ -1,0 +1,20 @@
+"""Flagship models for partitioned-slice workloads.
+
+The reference's benchmark workload is YOLOS-small inference pods sharing one
+GPU (`demos/gpu-sharing-comparison/README.md:23-47`, `app/main.py`). Here
+the equivalent workload is a first-class, TPU-first model: a YOLOS-style
+detection ViT in JAX/flax with bf16 matmuls, fused Pallas attention, and
+mesh-sharded train/infer steps.
+"""
+
+from walkai_nos_tpu.models.vit import (  # noqa: F401
+    ViTDetector,
+    ViTConfig,
+    VIT_TINY,
+    VIT_SMALL,
+)
+from walkai_nos_tpu.models.train import (  # noqa: F401
+    make_train_step,
+    make_infer_step,
+    init_train_state,
+)

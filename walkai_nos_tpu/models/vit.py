@@ -1,0 +1,150 @@
+"""YOLOS-style detection Vision Transformer (JAX/flax, TPU-first).
+
+The reference benchmarks YOLOS-small inference pods
+(`demos/gpu-sharing-comparison/app/main.py` pulls
+`hustvl/yolos-small`); this is that workload rebuilt TPU-native: a plain
+ViT encoder with learnable detection tokens appended to the patch sequence
+and MLP heads predicting class logits + boxes per detection token
+(YOLOS, Fang et al. 2021). Design choices for the MXU/HBM:
+
+- all matmuls in bfloat16 with f32 accumulation (`preferred_element_type`),
+  params kept f32;
+- attention via the fused Pallas kernel (`walkai_nos_tpu/ops/attention.py`)
+  on TPU, XLA reference elsewhere;
+- module/param names line up with the tensor-parallel rules in
+  `walkai_nos_tpu/parallel/sharding.py` (qkv/out_proj column/row split,
+  fc1/fc2 column/row split).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from walkai_nos_tpu.ops.attention import flash_attention
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_dim: int = 384
+    num_layers: int = 12
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    num_det_tokens: int = 100
+    num_classes: int = 92  # COCO classes + no-object, as YOLOS
+    dtype: str = "bfloat16"  # compute dtype; params stay float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+VIT_TINY = ViTConfig(
+    image_size=64, patch_size=16, hidden_dim=128, num_layers=2,
+    num_heads=4, num_det_tokens=8, num_classes=10,
+)
+VIT_SMALL = ViTConfig()  # YOLOS-small scale: 384 dim, 12 layers, 6 heads
+
+
+class Attention(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        d = c.hidden_dim
+        head_dim = d // c.num_heads
+        qkv = nn.Dense(3 * d, dtype=c.compute_dtype, name="qkv")(x)
+        qkv = qkv.reshape(x.shape[0], x.shape[1], 3, c.num_heads, head_dim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        o = flash_attention(q, k, v)
+        o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
+        return nn.Dense(d, dtype=c.compute_dtype, name="out_proj")(o)
+
+
+class Mlp(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        x = nn.Dense(c.mlp_ratio * c.hidden_dim, dtype=c.compute_dtype,
+                     name="fc1")(x)
+        x = nn.gelu(x)
+        return nn.Dense(c.hidden_dim, dtype=c.compute_dtype, name="fc2")(x)
+
+
+class Block(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.cfg, name="attn")(
+            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
+        )
+        x = x + Mlp(self.cfg, name="mlp")(
+            nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
+        )
+        return x
+
+
+class ViTDetector(nn.Module):
+    """ViT encoder + detection tokens + class/box heads (YOLOS shape)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        """images: [batch, H, W, 3] -> dict(logits, boxes).
+
+        logits: [batch, num_det_tokens, num_classes]; boxes: [..., 4] in
+        normalized cxcywh via sigmoid.
+        """
+        c = self.cfg
+        b = images.shape[0]
+        x = nn.Conv(
+            c.hidden_dim, (c.patch_size, c.patch_size),
+            strides=(c.patch_size, c.patch_size),
+            dtype=c.compute_dtype, name="patch_embed",
+        )(images.astype(c.compute_dtype))
+        x = x.reshape(b, -1, c.hidden_dim)
+
+        det = self.param(
+            "det_tokens", nn.initializers.normal(0.02),
+            (1, c.num_det_tokens, c.hidden_dim),
+        )
+        x = jnp.concatenate(
+            [x, jnp.broadcast_to(det, (b,) + det.shape[1:]).astype(x.dtype)],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, c.num_patches + c.num_det_tokens, c.hidden_dim),
+        )
+        x = x + pos.astype(x.dtype)
+
+        for i in range(c.num_layers):
+            x = Block(c, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
+
+        tokens = x[:, -c.num_det_tokens:, :]
+        logits = nn.Dense(c.num_classes, dtype=jnp.float32,
+                          name="class_head")(tokens)
+        boxes = nn.sigmoid(
+            nn.Dense(4, dtype=jnp.float32, name="box_head")(tokens)
+        )
+        return {"logits": logits, "boxes": boxes}
+
+    def init_params(self, rng: jax.Array):
+        c = self.cfg
+        dummy = jnp.zeros((1, c.image_size, c.image_size, 3), jnp.float32)
+        return self.init(rng, dummy)["params"]

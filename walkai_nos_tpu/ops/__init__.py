@@ -1,0 +1,13 @@
+"""TPU-first fused ops (Pallas kernels + XLA reference paths).
+
+Hot ops for the flagship workloads. Every kernel ships with a pure-XLA
+reference implementation: the dispatcher uses Pallas on TPU backends and the
+reference elsewhere, and tests compare the two in Pallas interpret mode on
+the CPU mesh (no hardware in CI — SURVEY.md §4).
+"""
+
+from walkai_nos_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    attention_reference,
+)
+from walkai_nos_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,112 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context attention where the sequence is sharded across devices on the
+``seq`` mesh axis. Each device holds its local Q shard and rotates K/V
+shards around the ring with `ppermute` (one ICI hop per step), folding every
+incoming block into an online-softmax accumulator — so the full sequence
+never resides on one chip and comm overlaps compute the way XLA schedules
+the permute against the local block matmuls. Causal masking uses each
+shard's global offset.
+
+This is the long-context subsystem the task mandates as first-class; the
+reference control plane has no analogue (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from walkai_nos_tpu.parallel.mesh import AXIS_SEQ
+
+_NEG_INF = -1e30
+
+
+def _local_block(q, k, v, q_off, k_off, causal):
+    """Scores of local Q against one K/V shard, with global-position mask.
+    Shapes: q [b,h,sq,d], k/v [b,h,sk,d]; returns (scores-softmax stats)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return s
+
+
+def _ring_body(i, carry, *, axis_name, axis_size, q, causal, q_off, sk):
+    acc, m_prev, l_prev, k_cur, v_cur, src_idx = carry
+    k_off = src_idx * sk
+    s = _local_block(q, k_cur, v_cur, q_off, k_off, causal)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    # Rotate K/V one step around the ring (neighbor ICI hop).
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+    v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+    src_nxt = jax.lax.ppermute(src_idx, axis_name, perm)
+    return acc, m_new, l_new, k_nxt, v_nxt, src_nxt
+
+
+def _ring_attn_local(q, k, v, *, axis_name, causal):
+    """Per-device body under shard_map: q/k/v are the local sequence shards."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    sq, sk = q.shape[2], k.shape[2]
+    q_off = my_idx * sq
+    qf = q.astype(jnp.float32)
+
+    b, h, _, _ = q.shape
+    d_v = v.shape[-1]
+    acc0 = jnp.zeros((b, h, sq, d_v), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+
+    body = functools.partial(
+        _ring_body, axis_name=axis_name, axis_size=axis_size, q=qf,
+        causal=causal, q_off=q_off, sk=sk,
+    )
+    acc, _m, l, _k, _v, _s = jax.lax.fori_loop(
+        0, axis_size, body, (acc0, m0, l0, k, v, my_idx)
+    )
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    axis_name: str = AXIS_SEQ,
+) -> jax.Array:
+    """Sequence-parallel attention over `mesh`'s `axis_name` ring.
+
+    Inputs are [batch, heads, seq, head_dim] global arrays; the seq dim is
+    sharded over `axis_name` (batch over the data axes per the caller's
+    shardings). Returns output with the same sharding as Q.
+    """
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_ring_attn_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

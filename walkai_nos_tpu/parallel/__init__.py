@@ -1,0 +1,20 @@
+"""Slice-aware JAX parallelism runtime.
+
+The control plane (`walkai_nos_tpu/controllers`) carves a TPU host's ICI mesh
+into contiguous sub-slices; the workloads that land on those slices use this
+package to turn "my granted slice shape" into a `jax.sharding.Mesh` with
+data/model/sequence axes and the right `PartitionSpec`s. The reference's demo
+workloads were plain torch pods (`demos/gpu-sharing-comparison/app/main.py`);
+here the compute side is a first-class, TPU-first subsystem.
+"""
+
+from walkai_nos_tpu.parallel.mesh import (  # noqa: F401
+    MeshAxes,
+    build_mesh,
+    slice_mesh,
+)
+from walkai_nos_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    param_partition_spec,
+    shard_params,
+)

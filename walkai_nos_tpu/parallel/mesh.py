@@ -1,0 +1,122 @@
+"""Device-mesh construction for partitioned TPU slices.
+
+A workload pod granted a ``walkai.io/tpu-<shape>`` slice sees exactly the
+chips of that contiguous sub-mesh. This module maps the slice shape (and the
+factored data/model/sequence parallel degrees) onto a `jax.sharding.Mesh`
+whose axis layout follows ICI locality: the *model* (tensor-parallel) axis is
+placed on the fastest-varying mesh dimension so tensor collectives ride
+single-hop ICI links, and the *data* axis spans the remaining dimensions.
+
+There is no reference analogue — the reference's demo workloads were
+single-GPU torch pods; this is the TPU-first compute runtime that consumes
+the slices the control plane creates.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from walkai_nos_tpu.tpu import topology
+
+# Canonical mesh axis names, in the order they appear in every Mesh this
+# module builds. Axes of size 1 are still present so PartitionSpecs are
+# uniform across slice sizes.
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+ALL_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ)
+
+
+@dataclass(frozen=True)
+class MeshAxes:
+    """Parallel degrees for one workload; product must equal device count."""
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.data * self.fsdp * self.model * self.seq
+
+    def as_shape(self) -> tuple[int, int, int, int]:
+        return (self.data, self.fsdp, self.model, self.seq)
+
+
+def _factor_axes(n: int, model: int | None, seq: int) -> MeshAxes:
+    """Pick (data, fsdp, model, seq) degrees for `n` devices.
+
+    Heuristic when `model` is unspecified: tensor parallelism up to 4-way
+    (v5e host meshes are 2x4; a 4-chip TP group is one ICI row), the rest
+    data parallel. Callers with strong opinions pass `model` explicitly.
+    """
+    if n % seq != 0:
+        raise ValueError(f"seq degree {seq} does not divide device count {n}")
+    rem = n // seq
+    if model is None:
+        model = math.gcd(rem, 4)
+    if rem % model != 0:
+        raise ValueError(f"model degree {model} does not divide {rem}")
+    return MeshAxes(data=rem // model, fsdp=1, model=model, seq=seq)
+
+
+def build_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    *,
+    axes: MeshAxes | None = None,
+    model: int | None = None,
+    seq: int = 1,
+) -> Mesh:
+    """Build a 4-axis ``Mesh`` (data, fsdp, model, seq) over `devices`.
+
+    Axis placement: devices are reshaped so the *model* axis is the
+    fastest-varying — adjacent device ids (adjacent chips on the ICI mesh,
+    per JAX's default TPU device order) form a tensor-parallel group, which
+    keeps the latency-critical TP collectives on nearest-neighbor links.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if axes is None:
+        axes = _factor_axes(len(devs), model, seq)
+    if axes.total != len(devs):
+        raise ValueError(
+            f"mesh axes {axes.as_shape()} need {axes.total} devices, "
+            f"got {len(devs)}"
+        )
+    arr = np.array(devs, dtype=object).reshape(axes.as_shape())
+    return Mesh(arr, ALL_AXES)
+
+
+def slice_mesh(
+    shape: str | topology.Shape,
+    devices: Sequence[jax.Device] | None = None,
+    *,
+    model: int | None = None,
+    seq: int = 1,
+) -> Mesh:
+    """Mesh for a workload granted one ``walkai.io/tpu-<shape>`` slice.
+
+    `shape` is the slice's mesh shape (e.g. ``"2x2"``); the caller's visible
+    devices must match its chip count. The slice's own geometry informs the
+    default tensor-parallel degree: TP spans the slice's last (fastest) ICI
+    dimension so a ``2x4`` slice defaults to 4-way TP × 2-way DP.
+    """
+    dims = topology.parse_shape(shape) if isinstance(shape, str) else shape
+    chips = topology.shape_chip_count(dims)
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if len(devs) != chips:
+        raise ValueError(
+            f"slice {topology.format_shape(dims)} has {chips} chips but "
+            f"{len(devs)} devices are visible"
+        )
+    if model is None and seq == 1:
+        model = dims[-1]
+    return build_mesh(devs, model=model, seq=seq)

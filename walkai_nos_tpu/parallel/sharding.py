@@ -1,0 +1,105 @@
+"""Parameter/activation sharding rules for the flagship models.
+
+Path-pattern → `PartitionSpec` rules in the spirit of t5x/flax logical axis
+rules, kept deliberately small and explicit. Tensor-parallel layout for a
+transformer block follows the Megatron split: QKV and MLP-in kernels are
+column-split (output features on the *model* axis), the output projections
+are row-split (input features on the *model* axis) so each block needs one
+psum on its residual add — which XLA inserts from the shardings; no manual
+collectives. `fsdp` additionally shards the non-TP axis of every kernel
+(ZeRO-3 style) when its degree > 1.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
+
+# (regex over "/"-joined param path, spec). First match wins. Kernels are
+# (in_features, out_features); conv kernels are (h, w, in, out).
+_PARAM_RULES: list[tuple[str, P]] = [
+    # Patch embedding conv: shard output channels over model axis.
+    (r"patch_embed/.*kernel", P(None, None, AXIS_FSDP, AXIS_MODEL)),
+    # Column-parallel: attention qkv + MLP up-projection.
+    (r"(qkv|query|key|value|fc1|up)/kernel", P(AXIS_FSDP, AXIS_MODEL)),
+    # Row-parallel: attention output proj + MLP down-projection.
+    (r"(out_proj|proj|fc2|down)/kernel", P(AXIS_MODEL, AXIS_FSDP)),
+    # Detection/classifier heads: column-parallel.
+    (r"(class_head|box_head|head)/.*kernel", P(AXIS_FSDP, AXIS_MODEL)),
+    # Biases of column-parallel layers follow their kernel's output split.
+    (r"(qkv|query|key|value|fc1|up|class_head|box_head|head)/.*bias", P(AXIS_MODEL)),
+    # Everything else (layernorms, row-parallel biases, cls/det tokens,
+    # position embeddings) is replicated.
+    (r".*", P()),
+]
+
+
+def param_partition_spec(path: str) -> P:
+    """Spec for one parameter, by its "/"-joined pytree path."""
+    for pattern, spec in _PARAM_RULES:
+        if re.search(pattern, path):
+            return spec
+    return P()
+
+
+def _fit_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Drop sharded axes that don't divide the parameter's dimensions.
+
+    Real models have head dims (e.g. num_classes, box coords) that won't
+    divide the model axis; those dims replicate instead of erroring.
+    """
+    dims: list = []
+    for i, entry in enumerate(spec):
+        if entry is None or i >= len(shape):
+            dims.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        dims.append(entry if shape[i] % size == 0 else None)
+    while dims and dims[-1] is None:
+        dims.pop()
+    return P(*dims)
+
+
+def param_specs(params, mesh: Mesh | None = None) -> object:
+    """Pytree of `PartitionSpec`s matching `params`' structure.
+
+    With `mesh`, specs are fitted to each leaf's shape (non-dividing dims
+    replicate); without, the raw rule specs are returned.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        joined = "/".join(
+            getattr(k, "key", getattr(k, "name", str(k))) for k in path
+        )
+        spec = param_partition_spec(joined)
+        if mesh is not None:
+            spec = _fit_spec(spec, tuple(getattr(leaf, "shape", ())), mesh)
+        specs.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a params pytree onto `mesh` per the rules (device_put)."""
+    specs = param_specs(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def batch_sharding(mesh: Mesh, *, seq_axis: int | None = None) -> NamedSharding:
+    """Sharding for a batch: batch dim over (data, fsdp), optional sequence
+    dim over the seq axis (sequence/context parallelism for long inputs)."""
+    dims: list = [(AXIS_DATA, AXIS_FSDP)]
+    if seq_axis is not None:
+        while len(dims) < seq_axis:
+            dims.append(None)
+        dims.append(AXIS_SEQ)
+    return NamedSharding(mesh, P(*dims))

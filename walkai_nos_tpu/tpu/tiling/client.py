@@ -64,6 +64,10 @@ class TilingClient:
             {d.device_id for d in keep}
         )
 
+    def list_slices(self) -> list[SliceInfo]:
+        """Ground-truth slices on the host, straight from the device layer."""
+        return self._tpudev.list_slices()
+
     def get_topology(self):
         return self._tpudev.get_topology()
 
