@@ -1,0 +1,426 @@
+// tpudev implementation. See tpudev.h for the contract.
+//
+// Slice records persist as one file per slice under TPUDEV_STATE_DIR in a
+// compact line format this library both writes and reads:
+//   line 1: <profile>@<o0>-<o1>[...]:<d0>x<d1>[...]
+//   line 2: <chip_id>,<chip_id>,...
+// All mutations happen under an exclusive flock on <state>/.lock so
+// concurrent agents (or an agent racing its own reporter) can't interleave
+// overlap checks with creates.
+
+#include "tpudev.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+tpudev_status fail(tpudev_status st, const std::string& msg) {
+  g_last_error = msg;
+  return st;
+}
+
+struct Chip {
+  int chip_id;
+  std::string device_path;
+  std::vector<int> coords;
+};
+
+struct Slice {
+  std::string slice_id;
+  std::string profile;
+  std::vector<int> offset;
+  std::vector<int> orientation;
+  std::vector<int> chip_ids;
+};
+
+struct State {
+  bool initialized = false;
+  std::string dev_dir;
+  std::string state_dir;
+  std::vector<int> mesh;
+  std::vector<Chip> chips;
+  std::mutex mu;  // in-process; cross-process safety is the flock
+};
+
+State g_state;
+
+std::string env_or(const char* name, const std::string& dflt) {
+  const char* v = std::getenv(name);
+  return (v && *v) ? std::string(v) : dflt;
+}
+
+bool parse_dims(const std::string& s, char sep, std::vector<int>* out) {
+  out->clear();
+  std::stringstream ss(s);
+  std::string part;
+  while (std::getline(ss, part, sep)) {
+    if (part.empty()) return false;
+    for (char c : part)
+      if (!isdigit(static_cast<unsigned char>(c))) return false;
+    out->push_back(std::atoi(part.c_str()));
+  }
+  return !out->empty();
+}
+
+int product(const std::vector<int>& v) {
+  int p = 1;
+  for (int d : v) p *= d;
+  return p;
+}
+
+// Row-major coords of linear index `i` in `mesh`.
+std::vector<int> unravel(int i, const std::vector<int>& mesh) {
+  std::vector<int> c(mesh.size(), 0);
+  for (int d = static_cast<int>(mesh.size()) - 1; d >= 0; --d) {
+    c[d] = i % mesh[d];
+    i /= mesh[d];
+  }
+  return c;
+}
+
+int ravel(const std::vector<int>& coords, const std::vector<int>& mesh) {
+  int idx = 0;
+  for (size_t d = 0; d < mesh.size(); ++d) idx = idx * mesh[d] + coords[d];
+  return idx;
+}
+
+// ----------------------------------------------------------------- devices
+
+// Chips are <dev_dir>/accel<N> (TPU-VM exposes /dev/accel0..accelK-1;
+// the reference's analogue walks NVML device handles,
+// `pkg/gpu/nvml/client.go:59-99`).
+std::vector<Chip> enumerate_chips(const std::string& dev_dir) {
+  std::vector<std::pair<int, std::string>> found;
+  DIR* dir = opendir(dev_dir.c_str());
+  if (dir != nullptr) {
+    while (dirent* e = readdir(dir)) {
+      const char* n = e->d_name;
+      if (std::strncmp(n, "accel", 5) != 0) continue;
+      const char* num = n + 5;
+      if (*num == '\0') continue;
+      bool digits = true;
+      for (const char* p = num; *p; ++p)
+        if (!isdigit(static_cast<unsigned char>(*p))) digits = false;
+      if (!digits) continue;
+      found.emplace_back(std::atoi(num), dev_dir + "/" + n);
+    }
+    closedir(dir);
+  }
+  std::sort(found.begin(), found.end());
+  std::vector<Chip> chips;
+  for (auto& f : found) chips.push_back(Chip{f.first, f.second, {}});
+  return chips;
+}
+
+bool infer_mesh(size_t chip_count, std::vector<int>* mesh) {
+  switch (chip_count) {
+    case 1: *mesh = {1, 1}; return true;
+    case 2: *mesh = {1, 2}; return true;
+    case 4: *mesh = {2, 2}; return true;
+    case 8: *mesh = {2, 4}; return true;   // v5e / v6e host
+    case 16: *mesh = {4, 4}; return true;
+    default: return false;
+  }
+}
+
+// ------------------------------------------------------------ persistence
+
+std::string lock_path() { return g_state.state_dir + "/.lock"; }
+
+std::string slice_path(const std::string& slice_id) {
+  return g_state.state_dir + "/" + slice_id + ".slice";
+}
+
+// Exclusive cross-process lock held for the scope of one mutation.
+class FileLock {
+ public:
+  explicit FileLock(const std::string& path) {
+    fd_ = open(path.c_str(), O_CREAT | O_RDWR, 0644);
+    if (fd_ >= 0) flock(fd_, LOCK_EX);
+  }
+  ~FileLock() {
+    if (fd_ >= 0) {
+      flock(fd_, LOCK_UN);
+      close(fd_);
+    }
+  }
+  bool ok() const { return fd_ >= 0; }
+
+ private:
+  int fd_ = -1;
+};
+
+std::string placement_string(const Slice& s) {
+  std::ostringstream os;
+  os << s.profile << "@";
+  for (size_t i = 0; i < s.offset.size(); ++i)
+    os << (i ? "-" : "") << s.offset[i];
+  os << ":";
+  for (size_t i = 0; i < s.orientation.size(); ++i)
+    os << (i ? "x" : "") << s.orientation[i];
+  return os.str();
+}
+
+bool parse_placement(const std::string& text, Slice* out) {
+  auto at = text.find('@');
+  auto colon = text.find(':', at == std::string::npos ? 0 : at);
+  if (at == std::string::npos || colon == std::string::npos || at == 0)
+    return false;
+  out->profile = text.substr(0, at);
+  std::vector<int> profile_dims;
+  if (!parse_dims(out->profile, 'x', &profile_dims)) return false;
+  if (!parse_dims(text.substr(at + 1, colon - at - 1), '-', &out->offset))
+    return false;
+  if (!parse_dims(text.substr(colon + 1), 'x', &out->orientation))
+    return false;
+  if (out->offset.size() != out->orientation.size()) return false;
+  // Orientation must be a permutation of the canonical profile shape.
+  std::vector<int> a = profile_dims, b = out->orientation;
+  std::sort(a.begin(), a.end());
+  std::sort(b.begin(), b.end());
+  if (a != b) return false;
+  out->slice_id = out->profile + "@" + [&] {
+    std::ostringstream os;
+    for (size_t i = 0; i < out->offset.size(); ++i)
+      os << (i ? "-" : "") << out->offset[i];
+    return os.str();
+  }();
+  return true;
+}
+
+bool write_slice(const Slice& s) {
+  std::ofstream f(slice_path(s.slice_id) + ".tmp",
+                  std::ios::out | std::ios::trunc);
+  if (!f) return false;
+  f << placement_string(s) << "\n";
+  for (size_t i = 0; i < s.chip_ids.size(); ++i)
+    f << (i ? "," : "") << s.chip_ids[i];
+  f << "\n";
+  f.close();
+  return rename((slice_path(s.slice_id) + ".tmp").c_str(),
+                slice_path(s.slice_id).c_str()) == 0;
+}
+
+bool read_slice(const std::string& path, Slice* out) {
+  std::ifstream f(path);
+  if (!f) return false;
+  std::string line1, line2;
+  if (!std::getline(f, line1) || !std::getline(f, line2)) return false;
+  if (!parse_placement(line1, out)) return false;
+  return parse_dims(line2, ',', &out->chip_ids);
+}
+
+std::vector<Slice> load_slices() {
+  std::vector<Slice> out;
+  DIR* dir = opendir(g_state.state_dir.c_str());
+  if (dir == nullptr) return out;
+  while (dirent* e = readdir(dir)) {
+    std::string name = e->d_name;
+    if (name.size() < 7 ||
+        name.compare(name.size() - 6, 6, ".slice") != 0)
+      continue;
+    Slice s;
+    if (read_slice(g_state.state_dir + "/" + name, &s)) out.push_back(s);
+  }
+  closedir(dir);
+  std::sort(out.begin(), out.end(),
+            [](const Slice& a, const Slice& b) {
+              return a.slice_id < b.slice_id;
+            });
+  return out;
+}
+
+// ------------------------------------------------------------------ JSON
+
+void json_ints(std::ostringstream& os, const std::vector<int>& v) {
+  os << "[";
+  for (size_t i = 0; i < v.size(); ++i) os << (i ? "," : "") << v[i];
+  os << "]";
+}
+
+void json_slice(std::ostringstream& os, const Slice& s) {
+  os << "{\"slice_id\":\"" << s.slice_id << "\",\"profile\":\"" << s.profile
+     << "\",\"mesh_index\":0,\"chip_ids\":";
+  json_ints(os, s.chip_ids);
+  os << ",\"offset\":";
+  json_ints(os, s.offset);
+  os << ",\"orientation\":";
+  json_ints(os, s.orientation);
+  os << "}";
+}
+
+tpudev_status emit(const std::string& json, char* buf, size_t buflen) {
+  if (json.size() + 1 > buflen)
+    return fail(TPUDEV_ERANGE,
+                "buffer too small: need " + std::to_string(json.size() + 1));
+  std::memcpy(buf, json.c_str(), json.size() + 1);
+  return TPUDEV_OK;
+}
+
+// Chips covered by a placement; false if any cell is outside the mesh.
+bool cells_to_chips(const Slice& s, std::vector<int>* chips) {
+  const auto& mesh = g_state.mesh;
+  if (s.offset.size() != mesh.size()) return false;
+  for (size_t d = 0; d < mesh.size(); ++d)
+    if (s.offset[d] + s.orientation[d] > mesh[d]) return false;
+  chips->clear();
+  int n = product(s.orientation);
+  for (int i = 0; i < n; ++i) {
+    std::vector<int> rel = unravel(i, s.orientation);
+    std::vector<int> abs(mesh.size());
+    for (size_t d = 0; d < mesh.size(); ++d) abs[d] = s.offset[d] + rel[d];
+    int ordinal = ravel(abs, mesh);
+    if (ordinal < 0 || ordinal >= static_cast<int>(g_state.chips.size()))
+      return false;
+    chips->push_back(g_state.chips[ordinal].chip_id);
+  }
+  std::sort(chips->begin(), chips->end());
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+tpudev_status tpudev_init(void) {
+  std::lock_guard<std::mutex> g(g_state.mu);
+  if (g_state.initialized) return TPUDEV_OK;
+  g_state.dev_dir = env_or("TPUDEV_DEV_DIR", "/dev");
+  g_state.state_dir = env_or("TPUDEV_STATE_DIR", "/var/run/walkai-tpudev");
+  g_state.chips = enumerate_chips(g_state.dev_dir);
+  if (g_state.chips.empty())
+    return fail(TPUDEV_ERR, "no TPU chips (accel*) in " + g_state.dev_dir);
+
+  std::string mesh_s = env_or("TPUDEV_MESH", env_or("TPU_TOPOLOGY", ""));
+  if (!mesh_s.empty()) {
+    if (!parse_dims(mesh_s, 'x', &g_state.mesh))
+      return fail(TPUDEV_ERR, "malformed mesh " + mesh_s);
+  } else if (!infer_mesh(g_state.chips.size(), &g_state.mesh)) {
+    return fail(TPUDEV_ERR,
+                "cannot infer mesh for " +
+                    std::to_string(g_state.chips.size()) +
+                    " chips; set TPUDEV_MESH");
+  }
+  if (product(g_state.mesh) != static_cast<int>(g_state.chips.size()))
+    return fail(TPUDEV_ERR, "mesh does not match chip count");
+  for (size_t i = 0; i < g_state.chips.size(); ++i)
+    g_state.chips[i].coords = unravel(static_cast<int>(i), g_state.mesh);
+
+  if (mkdir(g_state.state_dir.c_str(), 0755) != 0 && errno != EEXIST)
+    return fail(TPUDEV_ERR, "cannot create state dir " + g_state.state_dir);
+  g_state.initialized = true;
+  return TPUDEV_OK;
+}
+
+void tpudev_shutdown(void) {
+  std::lock_guard<std::mutex> g(g_state.mu);
+  g_state.initialized = false;
+  g_state.chips.clear();
+  g_state.mesh.clear();
+}
+
+tpudev_status tpudev_get_topology(char* buf, size_t buflen) {
+  std::lock_guard<std::mutex> g(g_state.mu);
+  if (!g_state.initialized) return fail(TPUDEV_ERR, "not initialized");
+  std::ostringstream os;
+  os << "{\"mesh\":";
+  json_ints(os, g_state.mesh);
+  os << ",\"mesh_index\":0,\"chips\":[";
+  for (size_t i = 0; i < g_state.chips.size(); ++i) {
+    const Chip& c = g_state.chips[i];
+    if (i) os << ",";
+    os << "{\"chip_id\":" << c.chip_id << ",\"device_path\":\""
+       << c.device_path << "\",\"coords\":";
+    json_ints(os, c.coords);
+    os << "}";
+  }
+  os << "]}";
+  return emit(os.str(), buf, buflen);
+}
+
+tpudev_status tpudev_list_slices(char* buf, size_t buflen) {
+  std::lock_guard<std::mutex> g(g_state.mu);
+  if (!g_state.initialized) return fail(TPUDEV_ERR, "not initialized");
+  FileLock lock(lock_path());
+  std::ostringstream os;
+  os << "[";
+  auto slices = load_slices();
+  for (size_t i = 0; i < slices.size(); ++i) {
+    if (i) os << ",";
+    json_slice(os, slices[i]);
+  }
+  os << "]";
+  return emit(os.str(), buf, buflen);
+}
+
+tpudev_status tpudev_create_slice(const char* placement, char* buf,
+                                  size_t buflen) {
+  std::lock_guard<std::mutex> g(g_state.mu);
+  if (!g_state.initialized) return fail(TPUDEV_ERR, "not initialized");
+  Slice s;
+  if (placement == nullptr || !parse_placement(placement, &s))
+    return fail(TPUDEV_EINVAL,
+                std::string("malformed placement '") +
+                    (placement ? placement : "(null)") + "'");
+  if (!cells_to_chips(s, &s.chip_ids))
+    return fail(TPUDEV_EINVAL,
+                "placement " + s.slice_id + " outside host mesh");
+
+  FileLock lock(lock_path());
+  if (!lock.ok()) return fail(TPUDEV_ERR, "cannot lock state dir");
+  std::set<int> occupied;
+  for (const Slice& other : load_slices()) {
+    if (other.slice_id == s.slice_id)
+      return fail(TPUDEV_CONFLICT, "slice " + s.slice_id + " already exists");
+    occupied.insert(other.chip_ids.begin(), other.chip_ids.end());
+  }
+  for (int c : s.chip_ids)
+    if (occupied.count(c))
+      return fail(TPUDEV_CONFLICT,
+                  "slice " + s.slice_id + ": chip " + std::to_string(c) +
+                      " already in a slice");
+  if (!write_slice(s))
+    return fail(TPUDEV_ERR, "cannot persist slice " + s.slice_id);
+  std::ostringstream os;
+  json_slice(os, s);
+  return emit(os.str(), buf, buflen);
+}
+
+tpudev_status tpudev_delete_slice(const char* slice_id) {
+  std::lock_guard<std::mutex> g(g_state.mu);
+  if (!g_state.initialized) return fail(TPUDEV_ERR, "not initialized");
+  if (slice_id == nullptr || *slice_id == '\0' ||
+      std::strstr(slice_id, "/") != nullptr ||
+      std::strstr(slice_id, "..") != nullptr)
+    return fail(TPUDEV_EINVAL, "malformed slice id");
+  FileLock lock(lock_path());
+  if (unlink(slice_path(slice_id).c_str()) != 0) {
+    if (errno == ENOENT)
+      return fail(TPUDEV_NOTFOUND,
+                  std::string("slice ") + slice_id + " not found");
+    return fail(TPUDEV_ERR, std::string("cannot delete ") + slice_id);
+  }
+  return TPUDEV_OK;
+}
+
+const char* tpudev_last_error(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,69 @@
+/* tpudev: native TPU host device-control library (C ABI).
+ *
+ * The one native component of the framework, mirroring the reference's
+ * single native layer — the cgo NVML binding (`pkg/gpu/nvml/client.go`,
+ * behind `//go:build nvml`). Where NVML creates/destroys MIG GPU/compute
+ * instances in the driver, a TPU "slice" is a materialized visibility set:
+ * a named group of chips plus the TPU runtime env the device plugin
+ * injects into the allocated pod. That state must survive agent restarts
+ * (NVML keeps GI/CI state in the driver; we persist slice records on the
+ * host filesystem, guarded by flock).
+ *
+ * Strings crossing the ABI:
+ *   - topology / slice listings are emitted as JSON (callers parse with
+ *     their stdlib);
+ *   - placement input uses a compact grammar so the library needs no JSON
+ *     parser: "<profile>@<o0>-<o1>[-<o2>]:<d0>x<d1>[x<d2>]"
+ *     e.g. "2x2@0-2:2x2"  (profile 2x2 anchored at (0,2), orientation 2x2).
+ *
+ * Configuration (read at tpudev_init):
+ *   TPUDEV_DEV_DIR    chip device directory        (default /dev)
+ *   TPUDEV_STATE_DIR  slice-state directory        (default /var/run/walkai-tpudev)
+ *   TPUDEV_MESH       host ICI mesh, e.g. "2x4"    (else TPU_TOPOLOGY,
+ *                     else inferred from chip count)
+ */
+#ifndef WALKAI_TPUDEV_H_
+#define WALKAI_TPUDEV_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  TPUDEV_OK = 0,
+  TPUDEV_ERR = 1,       /* generic failure; see tpudev_last_error()   */
+  TPUDEV_NOTFOUND = 2,  /* unknown slice id                            */
+  TPUDEV_CONFLICT = 3,  /* overlap / duplicate create                  */
+  TPUDEV_ERANGE = 4,    /* output buffer too small                     */
+  TPUDEV_EINVAL = 5,    /* malformed placement string                  */
+} tpudev_status;
+
+/* Enumerate chips + mesh, open state dir. Idempotent. */
+tpudev_status tpudev_init(void);
+void tpudev_shutdown(void);
+
+/* {"mesh":[2,4],"mesh_index":0,"chips":[{"chip_id":0,
+    "device_path":"/dev/accel0","coords":[0,0]},...]} */
+tpudev_status tpudev_get_topology(char* buf, size_t buflen);
+
+/* [{"slice_id":"2x2@0-0","profile":"2x2","mesh_index":0,
+    "chip_ids":[0,1,4,5],"offset":[0,0],"orientation":[2,2]},...] */
+tpudev_status tpudev_list_slices(char* buf, size_t buflen);
+
+/* Materialize one slice from a placement string; returns its JSON record
+ * (same schema as one tpudev_list_slices element). */
+tpudev_status tpudev_create_slice(const char* placement, char* buf,
+                                  size_t buflen);
+
+tpudev_status tpudev_delete_slice(const char* slice_id);
+
+/* Thread-local message for the most recent failure. */
+const char* tpudev_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* WALKAI_TPUDEV_H_ */

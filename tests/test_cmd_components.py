@@ -1,0 +1,264 @@
+"""Config loading, health/metrics server, leader election, clusterinfo
+collector, sharing client, metricsexporter payload."""
+
+import json
+import urllib.request
+
+import pytest
+
+from walkai_nos_tpu import config as configlib
+from walkai_nos_tpu.clusterinfo import Collector
+from walkai_nos_tpu.cmd.metricsexporter import build_metrics
+from walkai_nos_tpu.health import HealthServer
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.leader import LeaderElector
+from walkai_nos_tpu.resource.fake import FakeResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+from walkai_nos_tpu.tpu.sharing.client import SharingClient
+
+
+class TestConfig:
+    def test_partitioner_config_roundtrip(self, tmp_path):
+        path = tmp_path / "cfg.yaml"
+        path.write_text(
+            """
+apiVersion: config.nos.walkai.io/v1alpha1
+kind: TpuPartitionerConfig
+health:
+  healthProbeBindAddress: ":9001"
+leaderElection:
+  leaderElect: true
+  resourceName: part-leader
+devicePluginDelaySeconds: 2
+podRetryIntervalSeconds: 3
+"""
+        )
+        cfg = configlib.load_config(path, "TpuPartitionerConfig")
+        assert cfg.manager.health_probe_addr == ":9001"
+        assert cfg.manager.leader_elect is True
+        assert cfg.manager.leader_election_id == "part-leader"
+        assert cfg.device_plugin_delay_s == 2.0
+        assert cfg.pod_retry_interval_s == 3.0
+
+    def test_wrong_kind_rejected(self, tmp_path):
+        path = tmp_path / "cfg.yaml"
+        path.write_text("kind: SomethingElse\n")
+        with pytest.raises(ValueError, match="expected kind"):
+            configlib.load_config(path, "TpuAgentConfig")
+
+    def test_agent_config_validates_interval(self, tmp_path):
+        path = tmp_path / "cfg.yaml"
+        path.write_text(
+            "kind: TpuAgentConfig\nreportConfigIntervalSeconds: 0\n"
+        )
+        with pytest.raises(ValueError, match="report_interval_s"):
+            configlib.load_config(path, "TpuAgentConfig")
+
+    def test_known_geometries_file(self, tmp_path):
+        path = tmp_path / "geom.yaml"
+        path.write_text(
+            """
+- models: [tpu-v5-lite-podslice]
+  allowedGeometries:
+    - "2x4": 1
+    - "2x2": 2
+"""
+        )
+        from walkai_nos_tpu.tpu import topology
+        from walkai_nos_tpu.tpu.tiling import known_tilings
+
+        table = configlib.load_known_geometries_file(path)
+        assert "tpu-v5-lite-podslice" in table
+        model = topology.KNOWN_MODELS["tpu-v5-lite-podslice"]
+        geoms = known_tilings.get_allowed_geometries(model)
+        assert len(geoms) == 2
+
+
+class TestHealthServer:
+    def test_probes_and_metrics(self):
+        server = HealthServer("127.0.0.1:0")
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert urllib.request.urlopen(f"{base}/healthz").status == 200
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/readyz")
+            assert e.value.code == 503
+            server.mark_ready()
+            assert urllib.request.urlopen(f"{base}/readyz").status == 200
+            server.metrics.counter_add(
+                "nos_reconcile_total", 2, {"controller": "partitioner"}
+            )
+            server.metrics.gauge_set("nos_free_slices", 3)
+            body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert 'nos_reconcile_total{controller="partitioner"} 2.0' in body
+            assert "nos_free_slices 3" in body
+        finally:
+            server.stop()
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self):
+        kube = FakeKubeClient()
+        a = LeaderElector(
+            kube, "test-lease", identity="a",
+            lease_duration=0.4, renew_interval=0.05,
+        )
+        b = LeaderElector(
+            kube, "test-lease", identity="b",
+            lease_duration=0.4, renew_interval=0.05,
+        )
+        a.start()
+        assert a.wait_for_leadership(2.0)
+        b.start()
+        assert not b.wait_for_leadership(0.3)  # a holds the lease
+        a.stop()
+        assert b.wait_for_leadership(3.0)  # lease expires, b takes over
+        b.stop()
+
+
+def _node(name, accelerator="tpu-v5-lite-podslice", annotations=None,
+          capacity=None):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator": accelerator,
+                "cloud.google.com/gke-tpu-topology": "2x4",
+            },
+            "annotations": annotations or {},
+        },
+        "status": {"capacity": capacity or {}},
+    }
+
+
+class TestClusterInfoCollector:
+    def test_annotations_path(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            _node(
+                "n1",
+                annotations={
+                    "nos.walkai.io/status-tpu-0-2x2-used": "1",
+                    "nos.walkai.io/status-tpu-0-2x2-free": "1",
+                    "nos.walkai.io/status-tpu-0-1x1-free": "4",
+                },
+            ),
+        )
+        snap = Collector(kube).collect()
+        by_name = {t.tpu: t for t in snap.tpus}
+        assert by_name["n1: tpu-v5-lite-podslice 2x2"].allocated == 1
+        assert by_name["n1: tpu-v5-lite-podslice 2x2"].available == 1
+        assert by_name["n1: tpu-v5-lite-podslice 1x1"].available == 4
+
+    def test_capacity_fallback_path(self):
+        """Unmanaged node: capacity minus pod requests
+        (`collector_test.go:33-133` capacity-fallback case)."""
+        kube = FakeKubeClient()
+        kube.create(
+            "Node", _node("n2", capacity={"walkai.io/tpu-2x2": "2"})
+        )
+        kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "p1", "namespace": "default"},
+                "spec": {
+                    "nodeName": "n2",
+                    "containers": [
+                        {
+                            "name": "main",
+                            "resources": {
+                                "requests": {"walkai.io/tpu-2x2": "1"}
+                            },
+                        }
+                    ],
+                },
+                "status": {"phase": "Running"},
+            },
+        )
+        snap = Collector(kube).collect()
+        inv = next(t for t in snap.tpus if "2x2" in t.tpu)
+        assert inv.allocated == 1 and inv.available == 1
+
+    def test_pod_summaries(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "train", "namespace": "ml"},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "main",
+                            "resources": {
+                                "requests": {"walkai.io/tpu-2x2": "2"}
+                            },
+                        }
+                    ]
+                },
+                "status": {
+                    "phase": "Failed",
+                    "startTime": "2026-07-29T10:00:00Z",
+                    "containerStatuses": [
+                        {
+                            "state": {
+                                "terminated": {
+                                    "reason": "OOMKilled",
+                                    "finishedAt": "2026-07-29T11:00:00Z",
+                                }
+                            }
+                        }
+                    ],
+                },
+            },
+        )
+        snap = Collector(kube).collect()
+        assert len(snap.pods) == 1
+        p = snap.pods[0]
+        assert p.status == "OOMKilled"
+        assert p.tpu == "2x2 x2"
+        assert p.start_time == "2026-07-29T10:00:00Z"
+        assert p.finish_time == "2026-07-29T11:00:00Z"
+
+    def test_snapshot_is_json_serializable(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            _node(
+                "n1",
+                annotations={"nos.walkai.io/status-tpu-0-2x4-free": "1"},
+            ),
+        )
+        json.dumps(Collector(kube).collect().to_dict())
+
+
+class TestSharingClient:
+    def test_replica_suffix_identity(self):
+        resources = FakeResourceClient()
+        resources.set_allocatable(
+            [
+                Device("walkai.io/tpu-shared-2c", "shared-0::0", DeviceStatus.UNKNOWN),
+                Device("walkai.io/tpu-shared-2c", "shared-0::1", DeviceStatus.UNKNOWN),
+                Device("walkai.io/tpu-shared-2c", "shared-1::0", DeviceStatus.UNKNOWN),
+            ]
+        )
+        resources.mark_used("shared-0::0")
+        devices = SharingClient(resources).get_tpu_devices()
+        used = [d.device_id for d in devices.get_used()]
+        free = [d.device_id for d in devices.get_free()]
+        assert used == ["shared-0::0"]
+        # shared-0::1 is a replica of a used device -> not free
+        assert free == ["shared-1::0"]
+
+
+class TestMetricsExporter:
+    def test_build_metrics_enriches_nodes(self):
+        kube = FakeKubeClient()
+        kube.create("Node", _node("n1", capacity={"google.com/tpu": "8"}))
+        m = build_metrics(
+            {"installationUUID": "u1", "chartValues": {"a": 1}}, kube
+        )
+        assert m["installation_uuid"] == "u1"
+        assert m["nodes"][0]["name"] == "n1"
+        assert m["nodes"][0]["capacity"] == {"google.com/tpu": "8"}

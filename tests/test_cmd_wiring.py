@@ -1,0 +1,113 @@
+"""Binary wiring: build_manager functions drive real control loops.
+
+Uses the FakeKubeClient the way the mains use RestKubeClient (same
+interface), asserting the partitioner wiring initializes a fresh TPU node —
+the `cmd/` analogue of the reference's manager-boot integration tests.
+"""
+
+import time
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.cmd.tpuagent import build_manager as build_agent_manager
+from walkai_nos_tpu.cmd.tpupartitioner import build_manager as build_part_manager
+from walkai_nos_tpu.config import AgentConfig, PartitionerConfig
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.tiling.client import DevicePluginClient, TilingClient
+from walkai_nos_tpu.resource.fake import FakeResourceClient
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+
+def _tpu_node(name="host-a"):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: "2x4",
+                constants.LABEL_TPU_PARTITIONING: "tiling",
+            },
+        },
+        "status": {"capacity": {}, "allocatable": {}},
+    }
+
+
+def _eventually(fn, timeout=10.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out: {msg}")
+
+
+class TestPartitionerWiring:
+    def test_manager_initializes_fresh_node(self):
+        kube = FakeKubeClient()
+        kube.create("Node", _tpu_node())
+        manager = build_part_manager(kube, PartitionerConfig())
+        with manager:
+            _eventually(
+                lambda: any(
+                    s.profile == "2x4"
+                    for s in parse_node_annotations(
+                        objects.annotations(kube.get("Node", "host-a"))
+                    )[1]
+                ),
+                msg="node controller writes default tiling spec",
+            )
+
+    def test_controller_names_match_contract(self):
+        manager = build_part_manager(FakeKubeClient(), PartitionerConfig())
+        names = {c.name for c in manager.controllers}
+        assert constants.PARTITIONER_CONTROLLER_NAME in names
+        pod_ctrl = next(
+            c
+            for c in manager.controllers
+            if c.name == constants.PARTITIONER_CONTROLLER_NAME
+        )
+        assert pod_ctrl.max_concurrent == 1  # mig_controller.go:204
+
+
+class TestAgentWiring:
+    def test_reporter_writes_status_for_existing_slices(self):
+        kube = FakeKubeClient()
+        kube.create("Node", _tpu_node())
+        tpudev = FakeTpudevClient(mesh=(2, 4))
+        from walkai_nos_tpu.tpu.tiling.packing import Placement
+
+        created = tpudev.create_slices([Placement("2x4", (0, 0), (2, 4))])
+        resources = FakeResourceClient()
+        from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+
+        resources.set_allocatable(
+            [
+                Device(
+                    s.resource_name, s.slice_id, DeviceStatus.UNKNOWN
+                )
+                for s in created
+            ]
+        )
+        tiling = TilingClient(resources, tpudev)
+        manager, _shared = build_agent_manager(
+            kube,
+            tiling,
+            DevicePluginClient(kube, restart_timeout=1.0),
+            "host-a",
+            AgentConfig(report_interval_s=0.1),
+        )
+        with manager:
+            _eventually(
+                lambda: any(
+                    s.profile == "2x4" and s.status.value == "free"
+                    for s in parse_node_annotations(
+                        objects.annotations(kube.get("Node", "host-a"))
+                    )[0]
+                ),
+                msg="reporter publishes free 2x4 status",
+            )

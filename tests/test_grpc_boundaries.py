@@ -1,0 +1,185 @@
+"""Real-gRPC kubelet boundaries over unix sockets in tmp dirs.
+
+The pod-resources client and the device plugin talk actual protobuf/gRPC
+to a fake kubelet — protocol-real, hardware-free (SURVEY.md §4).
+"""
+
+import time
+
+import grpc
+import pytest
+
+from walkai_nos_tpu.deviceplugin import PluginManager, SliceDevicePlugin
+from walkai_nos_tpu.protos_gen import deviceplugin_pb2 as dp
+from walkai_nos_tpu.resource.fake_kubelet import FakeKubelet, PodDevices
+from walkai_nos_tpu.resource.lister import PodResourcesClient
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+
+@pytest.fixture
+def kubelet():
+    # Short tempdir: unix socket paths cap at ~107 chars, and pytest's
+    # tmp_path nesting blows through it.
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="kl-", dir="/tmp")
+    k = FakeKubelet(root)
+    k.start()
+    yield k
+    k.stop()
+    shutil.rmtree(root, ignore_errors=True)
+
+
+class TestPodResourcesClient:
+    def test_allocatable_and_used(self, kubelet):
+        kubelet.set_allocatable(
+            [
+                ("walkai.io/tpu-2x2", "2x2@0-0"),
+                ("walkai.io/tpu-2x2", "2x2@0-2"),
+                ("other.io/widget", "w0"),
+            ]
+        )
+        kubelet.set_used(
+            [
+                PodDevices(
+                    "job-1", "default", "main", "walkai.io/tpu-2x2",
+                    ["2x2@0-0"],
+                )
+            ]
+        )
+        client = PodResourcesClient(kubelet.pod_resources_socket, timeout=5.0)
+        try:
+            alloc = client.get_allocatable_devices("walkai.io/tpu-")
+            assert [d.device_id for d in alloc] == ["2x2@0-0", "2x2@0-2"]
+            used = client.get_used_devices("walkai.io/tpu-")
+            assert [d.device_id for d in used] == ["2x2@0-0"]
+            assert used[0].status.value == "used"
+        finally:
+            client.close()
+
+
+class TestDevicePlugin:
+    def _tpudev_with_slices(self):
+        tpudev = FakeTpudevClient(mesh=(2, 4))
+        tpudev.create_slices(
+            [
+                Placement("2x2", (0, 0), (2, 2)),
+                Placement("2x2", (0, 2), (2, 2)),
+            ]
+        )
+        return tpudev
+
+    def test_list_and_watch_and_allocate(self, kubelet):
+        tpudev = self._tpudev_with_slices()
+        plugin = SliceDevicePlugin(
+            "walkai.io/tpu-2x2", tpudev, kubelet.plugin_dir, dev_dir="/dev"
+        )
+        plugin.start()
+        try:
+            plugin.register(kubelet.registration_socket)
+            assert [r.resource_name for r in kubelet.registrations] == [
+                "walkai.io/tpu-2x2"
+            ]
+            assert kubelet.registrations[0].version == "v1beta1"
+
+            channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            stream = channel.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=dp.Empty.SerializeToString,
+                response_deserializer=dp.ListAndWatchResponse.FromString,
+            )(dp.Empty())
+            first = next(stream)
+            assert sorted(d.ID for d in first.devices) == [
+                "2x2@0-0", "2x2@0-2",
+            ]
+            assert all(d.health == "Healthy" for d in first.devices)
+
+            allocate = channel.unary_unary(
+                "/v1beta1.DevicePlugin/Allocate",
+                request_serializer=dp.AllocateRequest.SerializeToString,
+                response_deserializer=dp.AllocateResponse.FromString,
+            )
+            resp = allocate(
+                dp.AllocateRequest(
+                    container_requests=[
+                        dp.ContainerAllocateRequest(devicesIDs=["2x2@0-0"])
+                    ]
+                )
+            )
+            creq = resp.container_responses[0]
+            assert creq.envs["TPU_VISIBLE_CHIPS"] == "0,1,4,5"
+            assert creq.envs["TPU_SLICE_ID"] == "2x2@0-0"
+            assert sorted(d.host_path for d in creq.devices) == [
+                "/dev/accel0", "/dev/accel1", "/dev/accel4", "/dev/accel5",
+            ]
+            channel.close()
+        finally:
+            plugin.stop()
+
+    def test_list_and_watch_streams_retile(self, kubelet):
+        tpudev = self._tpudev_with_slices()
+        plugin = SliceDevicePlugin(
+            "walkai.io/tpu-2x2", tpudev, kubelet.plugin_dir
+        )
+        plugin.start()
+        try:
+            channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            stream = channel.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=dp.Empty.SerializeToString,
+                response_deserializer=dp.ListAndWatchResponse.FromString,
+            )(dp.Empty())
+            assert len(next(stream).devices) == 2
+            tpudev.delete_slice("2x2@0-2")
+            plugin.notify()
+            assert sorted(d.ID for d in next(stream).devices) == ["2x2@0-0"]
+            channel.close()
+        finally:
+            plugin.stop()
+
+    def test_plugin_manager_syncs_resources(self, kubelet):
+        tpudev = FakeTpudevClient(mesh=(2, 4))
+        tpudev.create_slices(
+            [
+                Placement("2x2", (0, 0), (2, 2)),
+                Placement("1x2", (0, 2), (1, 2)),
+            ]
+        )
+        manager = PluginManager(
+            tpudev,
+            plugin_dir=kubelet.plugin_dir,
+            kubelet_socket=kubelet.registration_socket,
+            poll_interval=0.1,
+        )
+        manager.sync()
+        try:
+            assert sorted(manager.plugins) == [
+                "walkai.io/tpu-1x2", "walkai.io/tpu-2x2",
+            ]
+            registered = sorted(
+                r.resource_name for r in kubelet.registrations
+            )
+            assert registered == ["walkai.io/tpu-1x2", "walkai.io/tpu-2x2"]
+            # Retile: 1x2 goes away; its plugin stays, serving zero devices.
+            tpudev.delete_slice("1x2@0-2")
+            manager.sync()
+            assert sorted(manager.plugins) == [
+                "walkai.io/tpu-1x2", "walkai.io/tpu-2x2",
+            ]
+            plugin = manager.plugins["walkai.io/tpu-1x2"]
+            channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            stream = channel.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=dp.Empty.SerializeToString,
+                response_deserializer=dp.ListAndWatchResponse.FromString,
+            )(dp.Empty())
+            deadline = time.monotonic() + 5
+            devices = list(next(stream).devices)
+            while devices and time.monotonic() < deadline:
+                devices = list(next(stream).devices)
+            assert devices == []
+            channel.close()
+        finally:
+            manager.stop()

@@ -123,13 +123,31 @@ class TestEndToEnd:
                 ] == ["2x4"]
 
             eventually(initial, msg="initial whole-host slice")
+
+            # The initial materialization itself restarts the plugin pod;
+            # wait until the actuator's apply has fully settled (status
+            # reflects the slice) before capturing the pod uid, else the
+            # listing races the delete/respawn window.
+            def settled():
+                node = cluster.kube.get("Node", "tpu-node-a")
+                status, _ = parse_node_annotations(objects.annotations(node))
+                pods = cluster.kube.list(
+                    "Pod",
+                    label_selector={
+                        constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                    },
+                )
+                return len(pods) == 1 and any(
+                    s.profile == "2x4" for s in status
+                )
+
+            eventually(settled, msg="initial apply settled")
             plugin_before = cluster.kube.list(
                 "Pod",
                 label_selector={
                     constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
                 },
             )
-            assert len(plugin_before) == 1
             uid_before = objects.uid(plugin_before[0])
 
             cluster.create_slice_pod("job-1", "1x2")

@@ -1,0 +1,174 @@
+"""Native tpudev library, driven through the ctypes binding.
+
+Builds libtpudev.so once per session (the toolchain is part of the dev
+environment). Hardware is emulated with a temp device dir of fake accelN
+nodes — the native layer itself is under test, not a TPU (SURVEY.md §4).
+"""
+
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from walkai_nos_tpu.tpu.errors import GenericError, NotFoundError
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="session")
+def libtpudev() -> Path:
+    subprocess.run(
+        ["make", "-C", str(REPO / "native" / "tpudev")],
+        check=True,
+        capture_output=True,
+    )
+    return REPO / "native" / "tpudev" / "build" / "libtpudev.so"
+
+
+@pytest.fixture
+def host_env(tmp_path, monkeypatch):
+    """Fake v5e-8 host: 8 accel nodes + empty state dir."""
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(8):
+        (dev / f"accel{i}").touch()
+    state = tmp_path / "state"
+    monkeypatch.setenv("TPUDEV_DEV_DIR", str(dev))
+    monkeypatch.setenv("TPUDEV_STATE_DIR", str(state))
+    monkeypatch.setenv("TPUDEV_MESH", "2x4")
+    return tmp_path
+
+
+def _spawn_client_subprocess(lib, code):
+    """Native state is process-global (init reads env once), so each test
+    case runs its client in a subprocess with its own env."""
+    script = f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+from walkai_nos_tpu.tpudev.native import NativeTpudevClient
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+from walkai_nos_tpu.tpu.errors import GenericError, NotFoundError
+client = NativeTpudevClient({str(lib)!r})
+{code}
+"""
+    return subprocess.run(
+        ["python3", "-c", script],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+class TestNativeTpudev:
+    def test_topology(self, libtpudev, host_env):
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "t = client.get_topology()\n"
+            "assert t.mesh == (2, 4), t.mesh\n"
+            "assert t.chip_count == 8\n"
+            "assert t.chips[5].coords == (1, 1), t.chips[5]\n"
+            "assert t.chips[5].device_path.endswith('accel5')\n"
+            "print('OK')",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
+
+    def test_create_list_delete_roundtrip(self, libtpudev, host_env):
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "p = Placement(profile='2x2', offset=(0, 0), orientation=(2, 2))\n"
+            "created = client.create_slices([p])\n"
+            "assert [s.slice_id for s in created] == ['2x2@0-0']\n"
+            "assert created[0].chip_ids == (0, 1, 4, 5), created[0].chip_ids\n"
+            "assert created[0].env['TPU_VISIBLE_CHIPS'] == '0,1,4,5'\n"
+            "assert client.get_slice_mesh_index('2x2@0-0') == 0\n"
+            "client.delete_slice('2x2@0-0')\n"
+            "assert client.list_slices() == []\n"
+            "print('OK')",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
+
+    def test_overlap_and_duplicate_rejected(self, libtpudev, host_env):
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "p = Placement(profile='2x2', offset=(0, 0), orientation=(2, 2))\n"
+            "client.create_slices([p])\n"
+            "q = Placement(profile='2x2', offset=(0, 1), orientation=(2, 2))\n"
+            "try:\n"
+            "    client.create_slices([q])\n"
+            "    raise SystemExit('overlap accepted')\n"
+            "except GenericError:\n"
+            "    pass\n"
+            "try:\n"
+            "    client.create_slices([p])\n"
+            "    raise SystemExit('duplicate accepted')\n"
+            "except GenericError:\n"
+            "    pass\n"
+            "print('OK')",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
+
+    def test_state_survives_process_restart(self, libtpudev, host_env):
+        """Slices persist host-side (the GI/CI-in-driver analogue)."""
+        r1 = _spawn_client_subprocess(
+            libtpudev,
+            "client.create_slices([Placement('2x4', (0, 0), (2, 4))])\n"
+            "print('OK')",
+        )
+        assert r1.returncode == 0, r1.stderr
+        r2 = _spawn_client_subprocess(
+            libtpudev,
+            "s = client.list_slices()\n"
+            "assert [x.slice_id for x in s] == ['2x4@0-0'], s\n"
+            "assert s[0].chip_ids == (0, 1, 2, 3, 4, 5, 6, 7)\n"
+            "deleted = client.delete_all_slices_except(set())\n"
+            "assert deleted == ['2x4@0-0']\n"
+            "print('OK')",
+        )
+        assert r2.returncode == 0, r2.stderr
+        assert "OK" in r2.stdout
+
+    def test_delete_missing_is_notfound(self, libtpudev, host_env):
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "try:\n"
+            "    client.delete_slice('2x2@9-9')\n"
+            "    raise SystemExit('missing delete accepted')\n"
+            "except NotFoundError:\n"
+            "    print('OK')",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
+
+    def test_partial_failure_tolerated(self, libtpudev, host_env):
+        """One bad placement among good ones: good ones create, like
+        mig.Client.CreateMigDevices (`client.go:50-74`)."""
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "good = Placement('2x2', (0, 0), (2, 2))\n"
+            "bad = Placement('2x2', (3, 3), (2, 2))\n"
+            "created = client.create_slices([good, bad])\n"
+            "assert [s.slice_id for s in created] == ['2x2@0-0']\n"
+            "print('OK')",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
+
+    def test_no_chips_fails_init(self, libtpudev, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUDEV_DEV_DIR", str(tmp_path / "empty"))
+        monkeypatch.setenv("TPUDEV_STATE_DIR", str(tmp_path / "state"))
+        r = _spawn_client_subprocess(libtpudev, "print('UNREACHABLE')")
+        assert r.returncode != 0
+        assert "no TPU chips" in r.stderr
+
+    def test_stub_fallback_when_lib_missing(self, monkeypatch):
+        from walkai_nos_tpu.tpudev import native as native_mod
+        from walkai_nos_tpu.tpudev.stub import StubTpudevClient
+
+        monkeypatch.setenv("WALKAI_TPUDEV_LIB", "/nonexistent/libtpudev.so")
+        client = native_mod.load_client()
+        assert isinstance(client, StubTpudevClient)

@@ -1,0 +1,8 @@
+"""Cluster TPU-inventory snapshots (the clusterinfo exporter's payload)."""
+
+from walkai_nos_tpu.clusterinfo.collector import Collector  # noqa: F401
+from walkai_nos_tpu.clusterinfo.types import (  # noqa: F401
+    PodSummary,
+    Snapshot,
+    TpuInventory,
+)

@@ -1,0 +1,229 @@
+"""Cluster TPU inventory + pod summaries (`pkg/clusterinfo/collector.go` port).
+
+Two inventory paths, like the reference (`collector.go:88-138`):
+- primary: nodes managed by this control plane carry `status-tpu-*`
+  annotations — aggregate used/free per profile from them (`:95-111`);
+- fallback: unmanaged TPU nodes — derive from node capacity
+  (`walkai.io/tpu-*` or whole-host `google.com/tpu`) minus summed pod
+  requests (`:113-138`).
+
+Pod summaries derive status from container states, then phase
+(`:190-204`); start time from status, finish time only for terminal pods
+(`:206-233`); profiles formatted `"2x2 x2"` (`:269-291`). Clock is
+injectable (`:34-61` test seam).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+from typing import Callable, Mapping
+
+from walkai_nos_tpu.clusterinfo.types import PodSummary, Snapshot, TpuInventory
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.annotations import (
+    AnnotationParseError,
+    parse_node_annotations,
+)
+from walkai_nos_tpu.tpu.device import DeviceStatus
+from walkai_nos_tpu.tpu.tiling.profile import (
+    get_requested_profiles,
+    is_slice_resource,
+    extract_profile_name,
+)
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.utils.quantity import parse_quantity
+
+
+def _utc_now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _iso(t: datetime) -> str:
+    return t.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class Collector:
+    def __init__(
+        self, kube: KubeClient, now: Callable[[], datetime] = _utc_now
+    ) -> None:
+        self._kube = kube
+        self._now = now  # injectable clock (`collector.go:56-61`)
+
+    def collect(self) -> Snapshot:
+        """List all nodes + pods, build inventory + summaries
+        (`collector.go:64-81`)."""
+        nodes = self._kube.list("Node")
+        pods = self._kube.list("Pod")
+        return Snapshot(
+            timestamp=_iso(self._now()),
+            tpus=self._build_inventory(nodes, pods),
+            pods=self._build_pod_summaries(pods),
+        )
+
+    # ------------------------------------------------------------- inventory
+
+    def _build_inventory(self, nodes, pods) -> list[TpuInventory]:
+        out: list[TpuInventory] = []
+        for node in nodes:
+            labels = objects.labels(node)
+            model = topology.get_model(labels)
+            if model is None:
+                continue
+            entries = self._inventory_from_annotations(node, model)
+            if not entries:
+                entries = self._inventory_from_capacity(node, model, pods)
+            out.extend(entries)
+        return sorted(out, key=lambda t: t.tpu)
+
+    def _inventory_from_annotations(self, node, model) -> list[TpuInventory]:
+        """Primary path: managed nodes' status annotations (`:95-111`)."""
+        try:
+            status, _ = parse_node_annotations(objects.annotations(node))
+        except AnnotationParseError:
+            return []
+        per_profile: dict[str, dict[str, int]] = {}
+        for ann in status:
+            bucket = per_profile.setdefault(
+                ann.profile, {"used": 0, "free": 0}
+            )
+            key = "used" if ann.status == DeviceStatus.USED else "free"
+            bucket[key] += ann.quantity
+        name = objects.name(node)
+        return [
+            TpuInventory(
+                tpu=f"{name}: {model.name} {profile}",
+                allocated=counts["used"],
+                available=counts["free"],
+            )
+            for profile, counts in sorted(per_profile.items())
+        ]
+
+    def _inventory_from_capacity(self, node, model, pods) -> list[TpuInventory]:
+        """Fallback: capacity minus summed pod requests (`:113-138`)."""
+        capacity: Mapping = (node.get("status") or {}).get("capacity") or {}
+        name = objects.name(node)
+        out = []
+        for resource, raw in sorted(capacity.items()):
+            if is_slice_resource(resource):
+                profile = extract_profile_name(resource)
+            elif resource == constants.RESOURCE_TPU:
+                profile = topology.format_shape(model.host_mesh)
+            else:
+                continue
+            try:
+                cap = parse_quantity(raw)
+            except ValueError:
+                continue
+            used = 0
+            for pod in pods:
+                if (pod.get("spec") or {}).get("nodeName") != name:
+                    continue
+                # Terminal pods no longer hold devices even though the
+                # object persists until GC.
+                if (pod.get("status") or {}).get("phase") in (
+                    "Succeeded",
+                    "Failed",
+                ):
+                    continue
+                if is_slice_resource(resource):
+                    used += get_requested_profiles(pod).get(profile, 0)
+                else:
+                    used += _whole_tpu_request(pod)
+            out.append(
+                TpuInventory(
+                    tpu=f"{name}: {model.name} {profile}",
+                    allocated=min(used, cap),
+                    available=max(cap - used, 0),
+                )
+            )
+        return out
+
+    # ---------------------------------------------------------- pod summaries
+
+    def _build_pod_summaries(self, pods) -> list[PodSummary]:
+        out = []
+        for pod in pods:
+            profiles = dict(get_requested_profiles(pod))
+            whole = _whole_tpu_request(pod)
+            if whole:
+                profiles[f"{whole}-chips"] = 1
+            if not profiles:
+                continue
+            out.append(
+                PodSummary(
+                    name=objects.name(pod),
+                    namespace=objects.namespace(pod) or "default",
+                    status=_pod_status(pod),
+                    tpu=_format_profiles(profiles),
+                    start_time=_pod_start_time(pod),
+                    finish_time=_pod_finish_time(pod),
+                )
+            )
+        return sorted(out, key=lambda p: (p.namespace, p.name))
+
+
+def _whole_tpu_request(pod: Mapping) -> int:
+    total = 0
+    for c in (pod.get("spec") or {}).get("containers") or []:
+        reqs = (c.get("resources") or {}).get("requests") or {}
+        raw = reqs.get(constants.RESOURCE_TPU)
+        if raw is None:
+            continue
+        try:
+            total += parse_quantity(raw)
+        except ValueError:
+            continue
+    return total
+
+
+def _format_profiles(profiles: Mapping[str, int]) -> str:
+    """`"2x2 x2, 1x1 x1"` (`formatProfiles`, `collector.go:269-291`)."""
+    return ", ".join(
+        f"{profile} x{qty}" for profile, qty in sorted(profiles.items())
+    )
+
+
+def _container_statuses_reason(statuses) -> str:
+    for status in statuses or []:
+        state = status.get("state") or {}
+        waiting = state.get("waiting") or {}
+        terminated = state.get("terminated") or {}
+        if waiting.get("reason"):
+            return waiting["reason"]
+        if terminated.get("reason"):
+            return terminated["reason"]
+    return ""
+
+
+def _pod_status(pod: Mapping) -> str:
+    """Container-state reason, else phase, else Unknown (`:199-210`)."""
+    status = pod.get("status") or {}
+    reason = _container_statuses_reason(status.get("containerStatuses"))
+    if not reason:
+        reason = _container_statuses_reason(status.get("initContainerStatuses"))
+    if reason:
+        return reason
+    return status.get("phase") or "Unknown"
+
+
+def _pod_start_time(pod: Mapping) -> str | None:
+    return (pod.get("status") or {}).get("startTime")
+
+
+def _pod_finish_time(pod: Mapping) -> str | None:
+    """Latest terminated-at across containers, terminal phases only
+    (`:212-233`)."""
+    status = pod.get("status") or {}
+    if status.get("phase") not in ("Succeeded", "Failed"):
+        return None
+    latest = None
+    for key in ("initContainerStatuses", "containerStatuses"):
+        for cs in status.get(key) or []:
+            for state_key in ("state", "lastState"):
+                term = (cs.get(state_key) or {}).get("terminated") or {}
+                t = term.get("finishedAt")
+                if t and (latest is None or t > latest):
+                    latest = t
+    return latest

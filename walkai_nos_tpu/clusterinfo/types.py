@@ -1,0 +1,59 @@
+"""Snapshot schema (`pkg/clusterinfo/types.go:21-43` analogue, TPU-shaped)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TpuInventory:
+    """Per-(node, profile) allocation summary (`GPUInventory` analogue)."""
+
+    tpu: str  # "<node>: <accelerator> <profile>", the GPU-name analogue
+    allocated: int
+    available: int
+
+    def to_dict(self) -> dict:
+        return {
+            "tpu": self.tpu,
+            "allocated": self.allocated,
+            "available": self.available,
+        }
+
+
+@dataclass
+class PodSummary:
+    """One TPU pod (`PodSummary`, `types.go:33-43`)."""
+
+    name: str
+    namespace: str
+    status: str
+    tpu: str  # profiles formatted "2x2 x2, 1x1 x1" (`collector.go:269-291`)
+    start_time: str | None = None
+    finish_time: str | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "namespace": self.namespace,
+            "status": self.status,
+            "tpu": self.tpu,
+            "start_time": self.start_time,
+            "finish_time": self.finish_time,
+        }
+
+
+@dataclass
+class Snapshot:
+    """`Snapshot{ts,gpus,pods}` analogue (`types.go:21-27`)."""
+
+    timestamp: str
+    tpus: list[TpuInventory] = field(default_factory=list)
+    pods: list[PodSummary] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "timestamp": self.timestamp,
+            "tpus": [t.to_dict() for t in self.tpus],
+            "pods": [p.to_dict() for p in self.pods],
+        }

@@ -1,0 +1,9 @@
+"""Process entry points (`cmd/` analogue, SURVEY.md §2.1).
+
+Run as modules:
+    python -m walkai_nos_tpu.cmd.tpupartitioner --config <yaml>
+    python -m walkai_nos_tpu.cmd.tpuagent --config <yaml>
+    python -m walkai_nos_tpu.cmd.tpusharingagent --config <yaml>
+    python -m walkai_nos_tpu.cmd.clusterinfoexporter --endpoint <url>
+    python -m walkai_nos_tpu.cmd.metricsexporter --metrics-file <yaml>
+"""

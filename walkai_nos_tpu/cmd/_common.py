@@ -1,0 +1,41 @@
+"""Shared main-wiring: logging, signals, health server, kube client."""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+
+def setup_logging(level: str = "info") -> None:
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+
+def wait_for_shutdown() -> threading.Event:
+    """Block-able event set on SIGTERM/SIGINT (manager ctx.Done analogue)."""
+    stop = threading.Event()
+
+    def _handler(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+    return stop
+
+
+def build_kube_client():
+    """Real API-server client from in-cluster/KUBECONFIG credentials."""
+    from walkai_nos_tpu.kube.rest import RestKubeClient
+
+    return RestKubeClient()
+
+
+def start_health(addr: str):
+    from walkai_nos_tpu.health import HealthServer
+
+    server = HealthServer(addr)
+    server.start()
+    return server

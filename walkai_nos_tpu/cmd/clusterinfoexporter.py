@@ -1,0 +1,68 @@
+"""Cluster-info exporter loop (`cmd/clusterinfoexporter/clusterinfoexporter.go:37-133`).
+
+Every --interval seconds: collect the cluster TPU inventory + TPU-pod
+summaries and POST the JSON snapshot to --endpoint with an optional Bearer
+token. Send failures are logged and skipped — the loop must outlive a flaky
+receiver (`sendSnapshot`, :95-128).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import urllib.error
+import urllib.request
+
+from walkai_nos_tpu.cmd import _common
+from walkai_nos_tpu.clusterinfo import Collector
+
+logger = logging.getLogger("clusterinfoexporter")
+
+
+def send_snapshot(
+    endpoint: str, snapshot: dict, auth_token: str = "", timeout: float = 10.0
+) -> None:
+    req = urllib.request.Request(
+        endpoint,
+        data=json.dumps(snapshot).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    if auth_token:
+        req.add_header("Authorization", f"Bearer {auth_token}")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp.read()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="clusterinfoexporter")
+    parser.add_argument("--endpoint", required=True)
+    parser.add_argument("--auth-token", default="")
+    parser.add_argument("--interval", type=float, default=60.0)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    _common.setup_logging(args.log_level)
+
+    kube = _common.build_kube_client()
+    collector = Collector(kube)
+    stop = _common.wait_for_shutdown()
+
+    while not stop.is_set():
+        try:
+            snapshot = collector.collect().to_dict()
+            send_snapshot(args.endpoint, snapshot, args.auth_token)
+            logger.info(
+                "snapshot sent: %d TPUs, %d pods",
+                len(snapshot["tpus"]),
+                len(snapshot["pods"]),
+            )
+        except Exception as e:  # the loop must survive any single failure
+            logger.warning("snapshot failed: %s", e)
+        stop.wait(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

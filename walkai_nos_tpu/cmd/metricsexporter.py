@@ -1,0 +1,89 @@
+"""One-shot install-telemetry hook (`cmd/metricsexporter/metricsexporter.go:33-91`).
+
+Helm post-install hook: read the metrics YAML the chart rendered (install
+UUID, node inventory, chart values, enabled components — schema per
+`cmd/metricsexporter/metrics/metrics.go:24-42`), POST it as JSON to the
+telemetry endpoint. EVERY error path exits 0 — telemetry must never fail an
+install (the reference swallows all errors the same way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import urllib.request
+
+import yaml
+
+from walkai_nos_tpu.cmd import _common
+
+logger = logging.getLogger("metricsexporter")
+
+
+def build_metrics(raw: dict, kube=None) -> dict:
+    """Metrics schema (`metrics.go:24-42` analogue). If a kube client is
+    given, enrich with live node inventory like the reference does."""
+    metrics = {
+        "installation_uuid": raw.get("installationUUID", ""),
+        "chart_values": raw.get("chartValues", {}),
+        "components": raw.get("components", {}),
+        "nodes": raw.get("nodes", []),
+    }
+    if kube is not None:
+        nodes = []
+        for node in kube.list("Node"):
+            meta = node.get("metadata") or {}
+            status = node.get("status") or {}
+            nodes.append(
+                {
+                    "name": meta.get("name", ""),
+                    "labels": meta.get("labels") or {},
+                    "capacity": status.get("capacity") or {},
+                }
+            )
+        metrics["nodes"] = nodes
+    return metrics
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="metricsexporter")
+    parser.add_argument("--metrics-file", required=True)
+    parser.add_argument(
+        "--endpoint", default="https://telemetry.walkai.io/v1/nos-metrics"
+    )
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    _common.setup_logging(args.log_level)
+
+    # Exit 0 on every failure (`metricsexporter.go:33-91`).
+    try:
+        with open(args.metrics_file) as f:
+            raw = yaml.safe_load(f) or {}
+    except Exception as e:
+        logger.warning("cannot read metrics file: %s", e)
+        return 0
+    kube = None
+    try:
+        kube = _common.build_kube_client()
+    except Exception:
+        pass
+    try:
+        metrics = build_metrics(raw, kube)
+        req = urllib.request.Request(
+            args.endpoint,
+            data=json.dumps(metrics).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            resp.read()
+        logger.info("install metrics sent")
+    except Exception as e:
+        logger.warning("cannot send metrics: %s", e)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Per-node TPU agent DaemonSet main (`cmd/migagent/migagent.go:56-199`).
+
+Requires NODE_NAME. Startup mirrors `initAgent` (:165): verify the host has
+at least one TPU chip (`checkAtLeastOneMigGpu` analogue, :179), then clean
+up slices no pod is using that aren't reachable from the kubelet's
+allocatable set (`cleanupUnusedMigResources`, :192). Runs the
+Reporter/Actuator pair on this node's watch with the SharedState handshake,
+plus the device-plugin manager advertising `walkai.io/tpu-<shape>`.
+
+Device layer selection (the build-tag dual at runtime): native libtpudev
+when present; `WALKAI_TPUDEV_FAKE=<mesh>` runs the in-memory fake for
+kind-cluster demos; otherwise the stub makes startup fail loudly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.cmd import _common
+from walkai_nos_tpu.config import AgentConfig, load_config
+from walkai_nos_tpu.controllers.tpuagent.actuator import Actuator
+from walkai_nos_tpu.controllers.tpuagent.reporter import Reporter
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import predicates
+from walkai_nos_tpu.kube.runtime import Controller, Manager
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.errors import TpuError
+from walkai_nos_tpu.tpu.tiling.client import DevicePluginClient, TilingClient
+
+logger = logging.getLogger("tpuagent")
+
+
+def build_tpudev():
+    fake_mesh = os.environ.get("WALKAI_TPUDEV_FAKE")
+    if fake_mesh:
+        from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+        logger.warning("using FAKE tpudev with mesh %s", fake_mesh)
+        return FakeTpudevClient(mesh=topology.parse_shape(fake_mesh))
+    from walkai_nos_tpu.tpudev.native import load_client
+
+    return load_client()
+
+
+def init_agent(tiling_client: TilingClient) -> None:
+    """Startup checks (`initAgent`, `cmd/migagent/migagent.go:165-199`)."""
+    host = tiling_client.get_topology()  # raises on stub/no chips
+    if host.chip_count < 1:
+        raise TpuError("no TPU chips on this host")
+    logger.info(
+        "host mesh %s with %d chips",
+        topology.format_shape(host.mesh),
+        host.chip_count,
+    )
+    used = tiling_client.get_tpu_devices().get_used()
+    deleted = tiling_client.delete_all_except(used)
+    if deleted:
+        logger.info("startup cleanup removed orphan slices: %s", deleted)
+
+
+def build_manager(
+    kube,
+    tiling_client: TilingClient,
+    plugin_client: DevicePluginClient,
+    node_name: str,
+    config: AgentConfig,
+) -> tuple[Manager, SharedState]:
+    shared = SharedState()
+    manager = Manager()
+    manager.add(
+        Controller(
+            constants.AGENT_REPORTER_NAME,
+            kube,
+            "Node",
+            Reporter(
+                kube,
+                tiling_client,
+                shared,
+                node_name,
+                refresh_interval=config.report_interval_s,
+            ).reconcile,
+            predicates=[
+                predicates.matching_name(node_name),
+                predicates.exclude_delete(),
+            ],
+        )
+    )
+    manager.add(
+        Controller(
+            constants.AGENT_ACTUATOR_NAME,
+            kube,
+            "Node",
+            Actuator(
+                kube, tiling_client, plugin_client, shared, node_name
+            ).reconcile,
+            predicates=[
+                predicates.matching_name(node_name),
+                predicates.exclude_delete(),
+                predicates.annotations_changed(),
+            ],
+        )
+    )
+    return manager, shared
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpuagent")
+    parser.add_argument("--config", help="TpuAgentConfig YAML path")
+    parser.add_argument("--log-level", default="info")
+    parser.add_argument(
+        "--pod-resources-socket", default=constants.POD_RESOURCES_SOCKET
+    )
+    args = parser.parse_args(argv)
+    _common.setup_logging(args.log_level)
+
+    node_name = os.environ.get(constants.ENV_NODE_NAME)
+    if not node_name:
+        logger.error("%s env var is required", constants.ENV_NODE_NAME)
+        return 1
+
+    config = (
+        load_config(args.config, "TpuAgentConfig") if args.config else AgentConfig()
+    )
+
+    tpudev = build_tpudev()
+    from walkai_nos_tpu.resource.lister import PodResourcesClient
+
+    resources = PodResourcesClient(args.pod_resources_socket)
+    tiling_client = TilingClient(resources, tpudev)
+    try:
+        init_agent(tiling_client)
+    except TpuError as e:
+        logger.error("startup check failed: %s", e)
+        return 1
+
+    kube = _common.build_kube_client()
+    plugin_client = DevicePluginClient(kube)
+    health = _common.start_health(config.manager.health_probe_addr)
+
+    from walkai_nos_tpu.deviceplugin import PluginManager
+
+    plugins = PluginManager(tpudev)
+    plugins.start()
+
+    manager, _shared = build_manager(
+        kube, tiling_client, plugin_client, node_name, config
+    )
+    stop = _common.wait_for_shutdown()
+    manager.start()
+    health.mark_ready()
+    stop.wait()
+    manager.stop()
+    plugins.stop()
+    health.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

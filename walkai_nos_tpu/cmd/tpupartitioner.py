@@ -1,0 +1,103 @@
+"""Cluster-scope partitioner manager (`cmd/gpupartitioner/gpupartitioner.go:49-132`).
+
+Loads the component config + known TPU geometries, optionally runs leader
+election, and manages the NodeController (fresh-node init) + PodController
+(pending pod -> repartition), with health probes on the manager address.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.cmd import _common
+from walkai_nos_tpu.config import (
+    PartitionerConfig,
+    load_config,
+    load_known_geometries_file,
+)
+from walkai_nos_tpu.controllers.partitioner.node_controller import NodeController
+from walkai_nos_tpu.controllers.partitioner.pod_controller import PodController
+from walkai_nos_tpu.kube import predicates
+from walkai_nos_tpu.kube.runtime import Controller, Manager
+
+logger = logging.getLogger("tpupartitioner")
+
+
+def build_manager(kube, config: PartitionerConfig) -> Manager:
+    """Wire the two control loops (test seam: callers inject any KubeClient)."""
+    manager = Manager()
+    manager.add(
+        Controller(
+            constants.PARTITIONER_CONTROLLER_NAME,
+            kube,
+            "Pod",
+            PodController(
+                kube, retry_interval=config.pod_retry_interval_s
+            ).reconcile,
+            max_concurrent=1,  # `mig_controller.go:204`
+        )
+    )
+    manager.add(
+        Controller(
+            "tpu-node-controller",
+            kube,
+            "Node",
+            NodeController(kube).reconcile,
+            predicates=[predicates.has_label(constants.LABEL_TPU_PARTITIONING)],
+            max_concurrent=5,  # `node_controller.go:113`
+        )
+    )
+    return manager
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpupartitioner")
+    parser.add_argument("--config", help="TpuPartitionerConfig YAML path")
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    _common.setup_logging(args.log_level)
+
+    config = (
+        load_config(args.config, "TpuPartitionerConfig")
+        if args.config
+        else PartitionerConfig()
+    )
+    if config.known_geometries_file:
+        table = load_known_geometries_file(config.known_geometries_file)
+        logger.info(
+            "installed known TPU geometries for models: %s",
+            ", ".join(sorted(table)),
+        )
+
+    kube = _common.build_kube_client()
+    health = _common.start_health(config.manager.health_probe_addr)
+    manager = build_manager(kube, config)
+    stop = _common.wait_for_shutdown()
+
+    if config.manager.leader_elect:
+        from walkai_nos_tpu.kube.leader import LeaderElector
+
+        elector = LeaderElector(
+            kube,
+            config.manager.leader_election_id or "tpupartitioner-leader",
+            on_started_leading=manager.start,
+            on_stopped_leading=manager.stop,
+        )
+        elector.start()
+        health.mark_ready()
+        stop.wait()
+        elector.stop()
+    else:
+        manager.start()
+        health.mark_ready()
+        stop.wait()
+        manager.stop()
+    health.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Per-node sharing agent (`cmd/gpuagent/gpuagent.go:54-152` analogue).
+
+Reporter-only DaemonSet for chip-count-sharing nodes (the MPS/slicing
+analogue — report-only in the reference fork too, SURVEY.md §0). Refuses to
+run if the host has tiled slices materialized, mirroring gpuagent's refusal
+on MIG-enabled GPUs (`AnyMigEnabledGpu`, :109-117, :146).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.cmd import _common
+from walkai_nos_tpu.cmd.tpuagent import build_tpudev
+from walkai_nos_tpu.config import AgentConfig, load_config
+from walkai_nos_tpu.controllers.tpuagent.reporter import Reporter
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import predicates
+from walkai_nos_tpu.kube.runtime import Controller, Manager
+from walkai_nos_tpu.tpu.errors import TpuError
+from walkai_nos_tpu.tpu.sharing.client import SharingClient
+from walkai_nos_tpu.tpu.sharing.profile import extract_shared_profile_name
+
+logger = logging.getLogger("tpusharingagent")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpusharingagent")
+    parser.add_argument("--config", help="TpuAgentConfig YAML path")
+    parser.add_argument("--log-level", default="info")
+    parser.add_argument(
+        "--pod-resources-socket", default=constants.POD_RESOURCES_SOCKET
+    )
+    args = parser.parse_args(argv)
+    _common.setup_logging(args.log_level)
+
+    node_name = os.environ.get(constants.ENV_NODE_NAME)
+    if not node_name:
+        logger.error("%s env var is required", constants.ENV_NODE_NAME)
+        return 1
+
+    config = (
+        load_config(args.config, "TpuAgentConfig") if args.config else AgentConfig()
+    )
+
+    tpudev = build_tpudev()
+    try:
+        tiled = tpudev.list_slices()
+    except TpuError as e:
+        logger.error("device layer unavailable: %s", e)
+        return 1
+    if tiled:
+        # Tiled hosts belong to the tpuagent (`gpuagent.go:109-117`).
+        logger.error(
+            "host has %d tiled slice(s); sharing agent cannot run here",
+            len(tiled),
+        )
+        return 1
+
+    from walkai_nos_tpu.resource.lister import PodResourcesClient
+
+    sharing_client = SharingClient(PodResourcesClient(args.pod_resources_socket))
+    kube = _common.build_kube_client()
+    health = _common.start_health(config.manager.health_probe_addr)
+
+    shared = SharedState()
+    manager = Manager()
+    manager.add(
+        Controller(
+            "tpusharing-reporter",
+            kube,
+            "Node",
+            Reporter(
+                kube,
+                sharing_client,
+                shared,
+                node_name,
+                refresh_interval=config.report_interval_s,
+                profile_extractor=extract_shared_profile_name,
+            ).reconcile,
+            predicates=[
+                predicates.matching_name(node_name),
+                predicates.exclude_delete(),
+            ],
+        )
+    )
+    stop = _common.wait_for_shutdown()
+    manager.start()
+    health.mark_ready()
+    stop.wait()
+    manager.stop()
+    health.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

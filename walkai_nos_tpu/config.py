@@ -1,0 +1,167 @@
+"""Component configuration: the config.nos.walkai.io analogue.
+
+The reference loads per-binary YAML component configs whose kinds embed
+controller-runtime's manager spec (health/metrics/leader-election) plus the
+component's own knobs (`pkg/api/nos.nebuly.com/config/v1alpha1/
+gpu_partitioner_config.go:28-55`, `mig_agent_config.go:27-31`,
+`gpu_agent_config.go:27-31`; loaded at
+`cmd/gpupartitioner/gpupartitioner.go:60-69`). Same layering here:
+dataclasses with validation, YAML files keyed by `kind`, env for NODE_NAME.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+from walkai_nos_tpu.api import constants
+
+
+@dataclass
+class ManagerSpec:
+    """Embedded manager settings (health probes, metrics, leader election —
+    the ControllerManagerConfigurationSpec analogue)."""
+
+    health_probe_addr: str = ":8081"
+    metrics_addr: str = ":8080"
+    leader_elect: bool = False
+    leader_election_id: str = ""
+
+    @staticmethod
+    def from_dict(d: dict) -> "ManagerSpec":
+        health = d.get("health") or {}
+        metrics = d.get("metrics") or {}
+        le = d.get("leaderElection") or {}
+        return ManagerSpec(
+            health_probe_addr=health.get(
+                "healthProbeBindAddress", ":8081"
+            ),
+            metrics_addr=metrics.get("bindAddress", ":8080"),
+            leader_elect=bool(le.get("leaderElect", False)),
+            leader_election_id=le.get("resourceName", ""),
+        )
+
+
+@dataclass
+class PartitionerConfig:
+    """`GpuPartitionerConfig` analogue (`gpu_partitioner_config.go:28-55`)."""
+
+    manager: ManagerSpec = field(default_factory=ManagerSpec)
+    known_geometries_file: str | None = None
+    # Wait after a device-plugin restart before trusting re-advertised
+    # resources (`devicePluginDelaySeconds`, `values.yaml:178-181`).
+    device_plugin_delay_s: float = 5.0
+    pod_retry_interval_s: float = 5.0
+
+    def validate(self) -> None:
+        if self.device_plugin_delay_s < 0:
+            raise ValueError("device_plugin_delay_s must be >= 0")
+        if self.pod_retry_interval_s <= 0:
+            raise ValueError("pod_retry_interval_s must be > 0")
+        if (
+            self.known_geometries_file
+            and not Path(self.known_geometries_file).exists()
+        ):
+            raise ValueError(
+                f"known geometries file not found: {self.known_geometries_file}"
+            )
+
+
+@dataclass
+class AgentConfig:
+    """`MigAgentConfig`/`GpuAgentConfig` analogue (report interval)."""
+
+    manager: ManagerSpec = field(default_factory=ManagerSpec)
+    report_interval_s: float = constants.DEFAULT_AGENT_REPORT_INTERVAL_S
+
+    def validate(self) -> None:
+        if self.report_interval_s <= 0:
+            raise ValueError("report_interval_s must be > 0")
+
+
+@dataclass
+class ExporterConfig:
+    endpoint: str = ""
+    auth_token: str = ""
+    interval_s: float = 60.0
+
+    def validate(self) -> None:
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+
+
+_KIND_LOADERS = {
+    "TpuPartitionerConfig": (
+        PartitionerConfig,
+        lambda d: PartitionerConfig(
+            manager=ManagerSpec.from_dict(d),
+            known_geometries_file=d.get("knownTpuGeometriesFile"),
+            device_plugin_delay_s=float(
+                d.get("devicePluginDelaySeconds", 5.0)
+            ),
+            pod_retry_interval_s=float(d.get("podRetryIntervalSeconds", 5.0)),
+        ),
+    ),
+    "TpuAgentConfig": (
+        AgentConfig,
+        lambda d: AgentConfig(
+            manager=ManagerSpec.from_dict(d),
+            report_interval_s=float(
+                d.get(
+                    "reportConfigIntervalSeconds",
+                    constants.DEFAULT_AGENT_REPORT_INTERVAL_S,
+                )
+            ),
+        ),
+    ),
+    "ClusterInfoExporterConfig": (
+        ExporterConfig,
+        lambda d: ExporterConfig(
+            endpoint=d.get("endpoint", ""),
+            auth_token=d.get("authToken", ""),
+            interval_s=float(d.get("intervalSeconds", 60.0)),
+        ),
+    ),
+}
+
+
+def load_config(path: str | Path, expected_kind: str):
+    """Load + validate a component config file by its `kind`."""
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    kind = data.get("kind")
+    if kind != expected_kind:
+        raise ValueError(
+            f"{path}: expected kind {expected_kind!r}, got {kind!r}"
+        )
+    cls, loader = _KIND_LOADERS[expected_kind]
+    cfg = loader(data)
+    cfg.validate()
+    return cfg
+
+
+def load_known_geometries_file(path: str | Path) -> dict:
+    """Load + install a YAML allowed-geometries override, the analogue of
+    `loadKnownMigGeometriesFromFile` (`cmd/gpupartitioner/gpupartitioner.go:122`
+    + `SetKnownGeometries`, `pkg/gpu/mig/known_configs.go:144`).
+
+    Schema mirrors `allowed_geometries.go:25-82`:
+        - models: [tpu-v5-lite-podslice, ...]
+          allowedGeometries:
+            - "2x2": 2
+            - "2x4": 1
+    """
+    from walkai_nos_tpu.tpu.tiling import known_tilings
+
+    with open(path) as f:
+        entries = yaml.safe_load(f) or []
+    table: dict[str, list[dict]] = {}
+    for entry in entries:
+        for model in entry.get("models", []):
+            table.setdefault(model, []).extend(
+                entry.get("allowedGeometries", [])
+            )
+    known_tilings.set_known_geometries(table)
+    return table

@@ -31,12 +31,17 @@ class Reporter:
         shared_state: SharedState,
         node_name: str,
         refresh_interval: float = constants.DEFAULT_AGENT_REPORT_INTERVAL_S,
+        profile_extractor=extract_profile_name,
     ) -> None:
         self._kube = kube
         self._client = tiling_client
         self._shared = shared_state
         self._node_name = node_name
         self._interval = refresh_interval
+        # Resource-name -> profile mapping; the sharing agent reuses this
+        # reporter with the shared-profile extractor (the gpuagent reporter
+        # is structurally identical to the migagent one, `gpuagent/reporter.go`).
+        self._extract_profile = profile_extractor
 
     def reconcile(self, request: Request) -> Result:
         with self._shared.lock:
@@ -51,7 +56,7 @@ class Reporter:
     def _reconcile(self, request: Request) -> Result:
         node = self._kube.get("Node", self._node_name)
         devices = self._client.get_tpu_devices()
-        status_annotations = devices.as_status_annotations(extract_profile_name)
+        status_annotations = devices.as_status_annotations(self._extract_profile)
 
         current_status, _ = parse_node_annotations(objects.annotations(node))
         plan_ack = objects.annotations(node).get(

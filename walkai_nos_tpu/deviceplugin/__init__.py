@@ -1,0 +1,13 @@
+"""walkai TPU device plugin: advertises materialized slices to the kubelet.
+
+The analogue of the NVIDIA device plugin in the reference's deployment (the
+component it restarts to re-advertise MIG devices, `pkg/gpu/client.go:45-49`).
+One DevicePlugin gRPC server per distinct `walkai.io/tpu-<shape>` resource;
+each slice is one device (ID = slice_id); Allocate injects the slice's TPU
+runtime env and the chips' /dev/accel* device nodes.
+"""
+
+from walkai_nos_tpu.deviceplugin.plugin import (  # noqa: F401
+    PluginManager,
+    SliceDevicePlugin,
+)

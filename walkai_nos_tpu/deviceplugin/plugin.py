@@ -1,0 +1,285 @@
+"""Device-plugin gRPC servers + kubelet registration.
+
+Wire-compatible with the kubelet device-plugin API v1beta1 (see
+`protos/deviceplugin.proto`). The kubelet flow: plugin serves its own unix
+socket under /var/lib/kubelet/device-plugins/, then calls Register on the
+kubelet's socket; the kubelet dials back with ListAndWatch (streamed device
+inventory) and Allocate (at pod admission).
+
+Stubs are hand-rolled (no grpc_tools): a generic handler per service with
+explicit method handlers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+from concurrent import futures
+
+import grpc
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.protos_gen import deviceplugin_pb2 as pb
+from walkai_nos_tpu.tpudev.client import SliceInfo, TpudevClient
+
+logger = logging.getLogger(__name__)
+
+_API_VERSION = "v1beta1"
+_HEALTHY = "Healthy"
+
+
+def _socket_name(resource_name: str) -> str:
+    # Keep it short: unix socket paths are capped at ~107 chars and the
+    # kubelet identifies plugins by endpoint basename, not content.
+    return "walkai-" + resource_name.rsplit("/", 1)[-1] + ".sock"
+
+
+class SliceDevicePlugin:
+    """One DevicePlugin server for one `walkai.io/tpu-<shape>` resource."""
+
+    def __init__(
+        self,
+        resource_name: str,
+        tpudev: TpudevClient,
+        plugin_dir: str = constants.DEVICE_PLUGIN_SOCKET_DIR,
+        dev_dir: str = "/dev",
+    ) -> None:
+        self.resource_name = resource_name
+        self._tpudev = tpudev
+        self._plugin_dir = plugin_dir
+        self._dev_dir = dev_dir
+        self.socket_path = os.path.join(plugin_dir, _socket_name(resource_name))
+        self._server: grpc.Server | None = None
+        self._updates: "queue.Queue[None]" = queue.Queue()
+        self._stopped = threading.Event()
+
+    # ------------------------------------------------------------- inventory
+
+    def _slices(self) -> list[SliceInfo]:
+        return [
+            s
+            for s in self._tpudev.list_slices()
+            if s.resource_name == self.resource_name
+        ]
+
+    def _device_list(self) -> pb.ListAndWatchResponse:
+        return pb.ListAndWatchResponse(
+            devices=[
+                pb.Device(ID=s.slice_id, health=_HEALTHY)
+                for s in self._slices()
+            ]
+        )
+
+    def notify(self) -> None:
+        """Signal a slice-inventory change to the ListAndWatch stream."""
+        self._updates.put(None)
+
+    # --------------------------------------------------------------- methods
+
+    def _get_options(self, request, context):
+        return pb.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=False,
+        )
+
+    def _list_and_watch(self, request, context):
+        yield self._device_list()
+        while not self._stopped.is_set():
+            try:
+                self._updates.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            # Coalesce bursts of updates into one response.
+            while True:
+                try:
+                    self._updates.get_nowait()
+                except queue.Empty:
+                    break
+            yield self._device_list()
+
+    def _allocate(self, request, context):
+        by_id = {s.slice_id: s for s in self._slices()}
+        responses = []
+        for creq in request.container_requests:
+            envs: dict[str, str] = {}
+            devices: list[pb.DeviceSpec] = []
+            for device_id in creq.devicesIDs:
+                s = by_id.get(device_id)
+                if s is None:
+                    context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        f"unknown slice {device_id}",
+                    )
+                envs.update(s.env)
+                for chip in s.chip_ids:
+                    path = f"{self._dev_dir}/accel{chip}"
+                    devices.append(
+                        pb.DeviceSpec(
+                            container_path=path,
+                            host_path=path,
+                            permissions="rw",
+                        )
+                    )
+            responses.append(
+                pb.ContainerAllocateResponse(envs=envs, devices=devices)
+            )
+        return pb.AllocateResponse(container_responses=responses)
+
+    def _preferred_allocation(self, request, context):
+        return pb.PreferredAllocationResponse(
+            container_responses=[
+                pb.ContainerPreferredAllocationResponse(
+                    deviceIDs=creq.available_deviceIDs[: creq.allocation_size]
+                )
+                for creq in request.container_requests
+            ]
+        )
+
+    def _pre_start(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        os.makedirs(self._plugin_dir, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        handler = grpc.method_handlers_generic_handler(
+            f"{_API_VERSION}.DevicePlugin",
+            {
+                "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+                    self._get_options,
+                    request_deserializer=pb.Empty.FromString,
+                    response_serializer=pb.DevicePluginOptions.SerializeToString,
+                ),
+                "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+                    self._list_and_watch,
+                    request_deserializer=pb.Empty.FromString,
+                    response_serializer=pb.ListAndWatchResponse.SerializeToString,
+                ),
+                "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+                    self._preferred_allocation,
+                    request_deserializer=pb.PreferredAllocationRequest.FromString,
+                    response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+                ),
+                "Allocate": grpc.unary_unary_rpc_method_handler(
+                    self._allocate,
+                    request_deserializer=pb.AllocateRequest.FromString,
+                    response_serializer=pb.AllocateResponse.SerializeToString,
+                ),
+                "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+                    self._pre_start,
+                    request_deserializer=pb.PreStartContainerRequest.FromString,
+                    response_serializer=pb.PreStartContainerResponse.SerializeToString,
+                ),
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+
+    def register(self, kubelet_socket: str) -> None:
+        """Register with the kubelet's Registration service."""
+        with grpc.insecure_channel(f"unix://{kubelet_socket}") as channel:
+            register = channel.unary_unary(
+                f"/{_API_VERSION}.Registration/Register",
+                request_serializer=pb.RegisterRequest.SerializeToString,
+                response_deserializer=pb.Empty.FromString,
+            )
+            register(
+                pb.RegisterRequest(
+                    version=_API_VERSION,
+                    endpoint=os.path.basename(self.socket_path),
+                    resource_name=self.resource_name,
+                ),
+                timeout=10.0,
+            )
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._server:
+            self._server.stop(grace=0.5)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+
+class PluginManager:
+    """Runs one SliceDevicePlugin per distinct slice resource on the host,
+    creating/retiring plugins as the tpuagent re-tiles the mesh."""
+
+    def __init__(
+        self,
+        tpudev: TpudevClient,
+        plugin_dir: str = constants.DEVICE_PLUGIN_SOCKET_DIR,
+        kubelet_socket: str | None = None,
+        dev_dir: str = "/dev",
+        poll_interval: float = 2.0,
+    ) -> None:
+        self._tpudev = tpudev
+        self._plugin_dir = plugin_dir
+        self._kubelet_socket = kubelet_socket or os.path.join(
+            plugin_dir, "kubelet.sock"
+        )
+        self._dev_dir = dev_dir
+        self._poll = poll_interval
+        self.plugins: dict[str, SliceDevicePlugin] = {}
+        self._last_inventory: dict[str, tuple[str, ...]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sync(self) -> None:
+        """Reconcile the plugin set with the current slice inventory."""
+        by_resource: dict[str, list[str]] = {}
+        for s in self._tpudev.list_slices():
+            by_resource.setdefault(s.resource_name, []).append(s.slice_id)
+        inventory = {
+            res: tuple(sorted(ids)) for res, ids in by_resource.items()
+        }
+        for res in sorted(inventory.keys() - self.plugins.keys()):
+            plugin = SliceDevicePlugin(
+                res, self._tpudev, self._plugin_dir, self._dev_dir
+            )
+            plugin.start()
+            try:
+                plugin.register(self._kubelet_socket)
+            except grpc.RpcError as e:
+                logger.warning("device plugin %s: registration failed: %s", res, e)
+                plugin.stop()
+                continue
+            self.plugins[res] = plugin
+            self._last_inventory[res] = inventory[res]
+            logger.info("device plugin serving %s at %s", res, plugin.socket_path)
+        # Notify only plugins whose device set actually changed (including
+        # resources whose slices all went away after a retile — the plugin
+        # stays up advertising an empty list so the kubelet zeroes capacity).
+        for res, plugin in self.plugins.items():
+            current = inventory.get(res, ())
+            if self._last_inventory.get(res) != current:
+                self._last_inventory[res] = current
+                plugin.notify()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync()
+            except Exception:
+                logger.exception("plugin manager sync failed")
+            self._stop.wait(self._poll)
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="plugin-manager"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        for plugin in self.plugins.values():
+            plugin.stop()
+        self.plugins.clear()

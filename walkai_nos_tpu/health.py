@@ -1,0 +1,134 @@
+"""Health probes + metrics endpoint for the binaries.
+
+The analogue of controller-runtime's health/metrics servers every reference
+main wires (`healthz.Ping`, `cmd/gpupartitioner/gpupartitioner.go:106-113`;
+metrics at `metrics.bindAddress`). Serves:
+
+- /healthz  liveness (200 while the process runs)
+- /readyz   readiness (200 once mark_ready(), 503 before/after)
+- /metrics  Prometheus text exposition of registered gauges/counters
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Metrics:
+    """Minimal Prometheus registry: counters and gauges with labels."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, tuple], float] = {}
+        self._help: dict[str, tuple[str, str]] = {}  # name -> (type, help)
+
+    def _register(self, name: str, kind: str, help_text: str) -> None:
+        self._help.setdefault(name, (kind, help_text))
+
+    def counter_add(
+        self, name: str, value: float = 1.0,
+        labels: dict | None = None, help_text: str = "",
+    ) -> None:
+        self._register(name, "counter", help_text)
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def gauge_set(
+        self, name: str, value: float,
+        labels: dict | None = None, help_text: str = "",
+    ) -> None:
+        self._register(name, "gauge", help_text)
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            by_name: dict[str, list] = {}
+            for (name, labels), value in sorted(self._values.items()):
+                by_name.setdefault(name, []).append((labels, value))
+        for name, series in by_name.items():
+            kind, help_text = self._help.get(name, ("gauge", ""))
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, value in series:
+                label_s = (
+                    "{"
+                    + ",".join(f'{k}="{v}"' for k, v in labels)
+                    + "}"
+                    if labels
+                    else ""
+                )
+                lines.append(f"{name}{label_s} {value}")
+        return "\n".join(lines) + "\n"
+
+
+class HealthServer:
+    """Serves /healthz, /readyz, /metrics on one address."""
+
+    def __init__(self, addr: str = ":8081", metrics: Metrics | None = None):
+        host, _, port = addr.rpartition(":")
+        self._host = host or "0.0.0.0"
+        self._port = int(port)
+        self.metrics = metrics or Metrics()
+        self._ready = threading.Event()
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def mark_ready(self) -> None:
+        self._ready.set()
+
+    def mark_unready(self) -> None:
+        self._ready.clear()
+
+    @property
+    def port(self) -> int:
+        """Bound port (useful when constructed with port 0)."""
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        ready = self._ready
+        metrics = self.metrics
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/healthz":
+                    self._respond(200, "ok")
+                elif self.path == "/readyz":
+                    if ready.is_set():
+                        self._respond(200, "ok")
+                    else:
+                        self._respond(503, "not ready")
+                elif self.path == "/metrics":
+                    self._respond(200, metrics.render())
+                else:
+                    self._respond(404, "not found")
+
+            def _respond(self, code: int, body: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="health"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)

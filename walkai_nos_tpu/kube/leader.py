@@ -1,0 +1,161 @@
+"""Lease-based leader election for the cluster-scope partitioner.
+
+The reference enables controller-runtime leader election for the
+gpupartitioner (`config/gpupartitioner/manager/gpu_partitioner_config.yaml:9-21`)
+while agents run with `leaderElect: false`. Same semantics here on
+`coordination.k8s.io/v1` Leases: acquire when unheld/expired, renew at
+`renew_interval`, step down (callback) if renewal falls behind
+`lease_duration`.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+import uuid
+from datetime import datetime, timedelta, timezone
+from typing import Callable
+
+from walkai_nos_tpu.kube.client import ApiError, Conflict, KubeClient, NotFound
+
+logger = logging.getLogger(__name__)
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _fmt(t: datetime) -> str:
+    return t.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def _parse(s: str) -> datetime:
+    return datetime.strptime(s.rstrip("Z"), "%Y-%m-%dT%H:%M:%S.%f").replace(
+        tzinfo=timezone.utc
+    )
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        kube: KubeClient,
+        lease_name: str,
+        namespace: str = "walkai-nos",
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+        on_started_leading: Callable[[], None] | None = None,
+        on_stopped_leading: Callable[[], None] | None = None,
+    ) -> None:
+        self._kube = kube
+        self._name = lease_name
+        self._ns = namespace
+        self.identity = identity or f"{lease_name}-{uuid.uuid4().hex[:8]}"
+        self._duration = lease_duration
+        self._renew = renew_interval
+        self._on_start = on_started_leading or (lambda: None)
+        self._on_stop = on_stopped_leading or (lambda: None)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.is_leader = threading.Event()
+
+    # ------------------------------------------------------------- lease ops
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = _now()
+        body = {
+            "metadata": {"name": self._name, "namespace": self._ns},
+            "spec": {
+                "holderIdentity": self.identity,
+                # k8s requires an integer; round up so sub-second test
+                # durations don't truncate to an instantly-expired lease.
+                "leaseDurationSeconds": max(1, math.ceil(self._duration)),
+                "acquireTime": _fmt(now),
+                "renewTime": _fmt(now),
+            },
+        }
+        try:
+            lease = self._kube.get("Lease", self._name, self._ns)
+        except NotFound:
+            try:
+                self._kube.create("Lease", body, self._ns)
+                return True
+            except (Conflict, ApiError):
+                return False
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        renew_s = spec.get("renewTime")
+        expired = True
+        if renew_s:
+            try:
+                expires = _parse(renew_s) + timedelta(
+                    seconds=float(spec.get("leaseDurationSeconds", self._duration))
+                )
+                expired = now > expires
+            except ValueError:
+                expired = True
+        if holder not in (None, "", self.identity) and not expired:
+            return False
+        if holder == self.identity:
+            body["spec"]["acquireTime"] = spec.get(
+                "acquireTime", body["spec"]["acquireTime"]
+            )
+        # Conditional update on the read resourceVersion so two candidates
+        # racing on an expired lease can't both win (client-go guards the
+        # same way; a merge patch cannot conflict).
+        lease["spec"] = body["spec"]
+        try:
+            self._kube.update("Lease", lease, self._ns)
+            return True
+        except ApiError:  # Conflict: someone else won the race
+            return False
+
+    # -------------------------------------------------------------- lifecycle
+
+    def _run(self) -> None:
+        leading = False
+        last_renew = 0.0
+        while not self._stop.is_set():
+            ok = False
+            try:
+                ok = self._try_acquire_or_renew()
+            except ApiError as e:
+                logger.warning("leader election: API error: %s", e)
+            now = time.monotonic()
+            if ok:
+                last_renew = now
+                if not leading:
+                    leading = True
+                    self.is_leader.set()
+                    logger.info(
+                        "leader election: %s acquired %s", self.identity, self._name
+                    )
+                    self._on_start()
+            elif leading and now - last_renew > self._duration:
+                leading = False
+                self.is_leader.clear()
+                logger.warning(
+                    "leader election: %s lost %s", self.identity, self._name
+                )
+                self._on_stop()
+            self._stop.wait(self._renew)
+        if leading:
+            self.is_leader.clear()
+            self._on_stop()
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"leader-{self._name}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def wait_for_leadership(self, timeout: float | None = None) -> bool:
+        return self.is_leader.wait(timeout)

@@ -1,0 +1,345 @@
+"""RestKubeClient: the real API-server implementation of KubeClient.
+
+Dependency-light (stdlib HTTP) Kubernetes REST client covering exactly what
+the controllers need: CRUD + merge-patch + watch on the kinds this control
+plane touches. Credential resolution mirrors client-go's in-cluster config
+(`rest.InClusterConfig` — service-account token + CA from
+/var/run/secrets/kubernetes.io/serviceaccount) with a KUBECONFIG fallback
+for dev clusters (kind/minikube, cf. the reference's local flows,
+`Makefile:115-117`, `docs/walkai/deploy.md`).
+
+Watches use the streaming watch API with resourceVersion bookkeeping and
+seed the stream with synthetic ADDED events from a fresh list — the same
+informer-cache semantics `FakeKubeClient.watch` provides, so controllers
+behave identically against either implementation.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import tempfile
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Iterator, Mapping
+
+from walkai_nos_tpu.kube.client import (
+    ApiError,
+    Conflict,
+    KubeClient,
+    NotFound,
+    WatchEvent,
+)
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (api prefix, plural, namespaced)
+_KINDS: dict[str, tuple[str, str, bool]] = {
+    "Node": ("/api/v1", "nodes", False),
+    "Pod": ("/api/v1", "pods", True),
+    "Namespace": ("/api/v1", "namespaces", False),
+    "ConfigMap": ("/api/v1", "configmaps", True),
+    "Event": ("/api/v1", "events", True),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
+    "ResourceQuota": ("/api/v1", "resourcequotas", True),
+    "ElasticQuota": ("/apis/nos.walkai.io/v1alpha1", "elasticquotas", True),
+    "CompositeElasticQuota": (
+        "/apis/nos.walkai.io/v1alpha1",
+        "compositeelasticquotas",
+        True,
+    ),
+}
+
+
+def _kind_route(kind: str) -> tuple[str, str, bool]:
+    try:
+        return _KINDS[kind]
+    except KeyError:
+        raise ApiError(400, f"unknown kind {kind!r}") from None
+
+
+class RestKubeClient(KubeClient):
+    def __init__(
+        self,
+        server: str | None = None,
+        token: str | None = None,
+        ca_file: str | None = None,
+        insecure: bool = False,
+        timeout: float = 30.0,
+        client_cert: tuple[str, str] | None = None,  # (cert_file, key_file)
+    ) -> None:
+        if server is None:
+            server, token, ca_file, insecure, client_cert = (
+                self._resolve_config()
+            )
+        self._server = server.rstrip("/")
+        self._token = token
+        self._timeout = timeout
+        if insecure:
+            self._ssl = ssl._create_unverified_context()
+        elif ca_file:
+            self._ssl = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ssl = ssl.create_default_context()
+        if client_cert:
+            # mTLS client auth — what kind/minikube kubeconfigs use.
+            self._ssl.load_cert_chain(client_cert[0], client_cert[1])
+
+    # -------------------------------------------------------------- config
+
+    @staticmethod
+    def _resolve_config():
+        """In-cluster first, then $KUBECONFIG (current-context).
+
+        Returns (server, token, ca_file, insecure, client_cert).
+        """
+        token_path = os.path.join(_SA_DIR, "token")
+        if os.path.exists(token_path):
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            with open(token_path) as f:
+                token = f.read().strip()
+            ca = os.path.join(_SA_DIR, "ca.crt")
+            return (
+                f"https://{host}:{port}",
+                token,
+                ca if os.path.exists(ca) else None,
+                False,
+                None,
+            )
+        kubeconfig = os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config")
+        )
+        if os.path.exists(kubeconfig):
+            return RestKubeClient._from_kubeconfig(kubeconfig)
+        raise ApiError(500, "no in-cluster credentials and no kubeconfig")
+
+    @staticmethod
+    def _materialize(data_b64: str | None, path: str | None, suffix: str):
+        """Inline base64 kubeconfig data -> temp file path."""
+        if data_b64:
+            fd, path = tempfile.mkstemp(suffix=suffix)
+            with os.fdopen(fd, "wb") as f:
+                f.write(base64.b64decode(data_b64))
+        return path
+
+    @staticmethod
+    def _from_kubeconfig(path: str):
+        import yaml
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context")
+        ctx = next(
+            c["context"] for c in cfg["contexts"] if c["name"] == ctx_name
+        )
+        cluster = next(
+            c["cluster"]
+            for c in cfg["clusters"]
+            if c["name"] == ctx["cluster"]
+        )
+        user = next(
+            u["user"] for u in cfg["users"] if u["name"] == ctx["user"]
+        )
+        server = cluster["server"]
+        insecure = bool(cluster.get("insecure-skip-tls-verify"))
+        ca_file = RestKubeClient._materialize(
+            cluster.get("certificate-authority-data"),
+            cluster.get("certificate-authority"),
+            ".crt",
+        )
+        # kind/minikube kubeconfigs authenticate with client certs, not
+        # tokens — support both.
+        cert_file = RestKubeClient._materialize(
+            user.get("client-certificate-data"),
+            user.get("client-certificate"),
+            ".crt",
+        )
+        key_file = RestKubeClient._materialize(
+            user.get("client-key-data"), user.get("client-key"), ".key"
+        )
+        client_cert = (cert_file, key_file) if cert_file and key_file else None
+        token = user.get("token")
+        return server, token, ca_file, insecure, client_cert
+
+    # ----------------------------------------------------------------- http
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        content_type: str = "application/json",
+        stream: bool = False,
+        timeout: float | None = None,
+    ):
+        url = self._server + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout or self._timeout, context=self._ssl
+            )
+        except urllib.error.HTTPError as e:
+            msg = e.read().decode(errors="replace")[:500]
+            if e.code == 404:
+                raise NotFound(msg) from None
+            if e.code == 409:
+                raise Conflict(msg) from None
+            raise ApiError(e.code, msg) from None
+        except urllib.error.URLError as e:
+            raise ApiError(500, f"{method} {path}: {e.reason}") from None
+        if stream:
+            return resp
+        with resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    def _path(
+        self, kind: str, namespace: str | None, name: str | None = None
+    ) -> str:
+        prefix, plural, namespaced = _kind_route(kind)
+        parts = [prefix]
+        if namespaced:
+            parts += ["namespaces", urllib.parse.quote(namespace or "default")]
+        parts.append(plural)
+        if name:
+            parts.append(urllib.parse.quote(name))
+        return "/".join(parts)
+
+    # ------------------------------------------------------------ interface
+
+    def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        return self._request("GET", self._path(kind, namespace, name))
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: Mapping[str, str] | None = None,
+        field_selector: Mapping[str, str] | None = None,
+    ) -> list[dict]:
+        return self._list(kind, namespace, label_selector, field_selector)[0]
+
+    def _list(
+        self,
+        kind: str,
+        namespace: str | None,
+        label_selector: Mapping[str, str] | None = None,
+        field_selector: Mapping[str, str] | None = None,
+    ) -> tuple[list[dict], str]:
+        query = {}
+        if label_selector:
+            query["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(label_selector.items())
+            )
+        if field_selector:
+            query["fieldSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(field_selector.items())
+            )
+        path = self._path(kind, namespace)
+        if query:
+            path += "?" + urllib.parse.urlencode(query)
+        data = self._request("GET", path)
+        items = data.get("items") or []
+        for it in items:  # server omits per-item kind in lists
+            it.setdefault("kind", kind)
+        return items, (data.get("metadata") or {}).get("resourceVersion", "")
+
+    def create(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
+        ns = namespace or (obj.get("metadata") or {}).get("namespace")
+        return self._request("POST", self._path(kind, ns), body=obj)
+
+    def update(self, kind: str, obj: dict, namespace: str | None = None) -> dict:
+        meta = obj.get("metadata") or {}
+        ns = namespace or meta.get("namespace")
+        return self._request(
+            "PUT", self._path(kind, ns, meta.get("name")), body=obj
+        )
+
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        patch: dict,
+        namespace: str | None = None,
+    ) -> dict:
+        return self._request(
+            "PATCH",
+            self._path(kind, namespace, name),
+            body=patch,
+            content_type="application/merge-patch+json",
+        )
+
+    def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
+        self._request("DELETE", self._path(kind, namespace, name))
+
+    # ---------------------------------------------------------------- watch
+
+    def watch(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        stop: Callable[[], bool] | None = None,
+    ) -> Iterator[WatchEvent]:
+        stop = stop or (lambda: False)
+        items, rv = self._list(kind, namespace)
+        rv_box = [rv]
+        for obj in items:
+            yield ("ADDED", obj)
+        while not stop():
+            try:
+                yield from self._watch_once(kind, namespace, rv_box, stop)
+            except ApiError:
+                # 410 Gone (stale resourceVersion) or transient API failure:
+                # relist and resume, informer-style.
+                items, rv_box[0] = self._list(kind, namespace)
+                for obj in items:
+                    yield ("MODIFIED", obj)
+
+    def _watch_once(
+        self,
+        kind: str,
+        namespace: str | None,
+        rv_box: list,
+        stop: Callable[[], bool],
+    ) -> Iterator[WatchEvent]:
+        query = urllib.parse.urlencode(
+            {
+                "watch": "true",
+                "resourceVersion": rv_box[0],
+                "timeoutSeconds": "30",
+                "allowWatchBookmarks": "true",
+            }
+        )
+        resp = self._request(
+            "GET",
+            self._path(kind, namespace) + "?" + query,
+            stream=True,
+            timeout=45.0,
+        )
+        with resp:
+            for line in resp:
+                if stop():
+                    return
+                if not line.strip():
+                    continue
+                event = json.loads(line)
+                etype, obj = event.get("type"), event.get("object") or {}
+                rv = (obj.get("metadata") or {}).get("resourceVersion")
+                if rv:
+                    rv_box[0] = rv
+                if etype == "BOOKMARK":
+                    continue
+                if etype == "ERROR":
+                    raise ApiError(410, json.dumps(obj)[:200])
+                obj.setdefault("kind", kind)
+                yield (etype, obj)

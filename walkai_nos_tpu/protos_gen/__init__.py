@@ -1,0 +1,10 @@
+"""Generated protobuf messages (protoc --python_out over `protos/`).
+
+Regenerate with:
+    protoc --python_out=walkai_nos_tpu/protos_gen -I protos \
+        protos/podresources.proto protos/deviceplugin.proto
+
+gRPC stubs are hand-written (no grpc_tools dependency):
+`walkai_nos_tpu/resource/lister.py` (pod-resources client),
+`walkai_nos_tpu/deviceplugin/` (device-plugin server + registration).
+"""

@@ -1,0 +1,153 @@
+"""Fake kubelet gRPC services for tests.
+
+Serves the two kubelet boundaries this framework touches, wire-compatible
+with the real APIs, over unix sockets in a temp dir: the pod-resources
+lister (fed from an in-memory inventory) and the device-plugin Registration
+endpoint (records registrations). The gRPC analogue of the reference's
+envtest strategy — real protocol, no hardware or kubelet (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+from dataclasses import dataclass, field
+
+import grpc
+
+from walkai_nos_tpu.protos_gen import deviceplugin_pb2 as dp
+from walkai_nos_tpu.protos_gen import podresources_pb2 as pr
+
+
+@dataclass
+class PodDevices:
+    pod_name: str
+    namespace: str
+    container: str
+    resource_name: str
+    device_ids: list[str] = field(default_factory=list)
+
+
+class FakeKubelet:
+    def __init__(self, root_dir: str) -> None:
+        self.root = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self.pod_resources_socket = os.path.join(root_dir, "kubelet-podres.sock")
+        self.plugin_dir = os.path.join(root_dir, "device-plugins")
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        self.registration_socket = os.path.join(self.plugin_dir, "kubelet.sock")
+
+        self._lock = threading.Lock()
+        self._allocatable: list[tuple[str, str]] = []  # (resource, device_id)
+        self._used: list[PodDevices] = []
+        self.registrations: list[dp.RegisterRequest] = []
+        self._servers: list[grpc.Server] = []
+
+    # ------------------------------------------------------------ test hooks
+
+    def set_allocatable(self, devices: list[tuple[str, str]]) -> None:
+        with self._lock:
+            self._allocatable = list(devices)
+
+    def set_used(self, used: list[PodDevices]) -> None:
+        with self._lock:
+            self._used = list(used)
+
+    # --------------------------------------------------------------- serving
+
+    def _list(self, request, context):
+        with self._lock:
+            pods: dict[tuple[str, str], dict[str, list[PodDevices]]] = {}
+            for u in self._used:
+                pods.setdefault((u.pod_name, u.namespace), {}).setdefault(
+                    u.container, []
+                ).append(u)
+        return pr.ListPodResourcesResponse(
+            pod_resources=[
+                pr.PodResources(
+                    name=name,
+                    namespace=ns,
+                    containers=[
+                        pr.ContainerResources(
+                            name=cname,
+                            devices=[
+                                pr.ContainerDevices(
+                                    resource_name=u.resource_name,
+                                    device_ids=u.device_ids,
+                                )
+                                for u in entries
+                            ],
+                        )
+                        for cname, entries in containers.items()
+                    ],
+                )
+                for (name, ns), containers in pods.items()
+            ]
+        )
+
+    def _get_allocatable(self, request, context):
+        with self._lock:
+            by_resource: dict[str, list[str]] = {}
+            for resource, device_id in self._allocatable:
+                by_resource.setdefault(resource, []).append(device_id)
+        return pr.AllocatableResourcesResponse(
+            devices=[
+                pr.ContainerDevices(resource_name=res, device_ids=ids)
+                for res, ids in sorted(by_resource.items())
+            ]
+        )
+
+    def _register(self, request, context):
+        with self._lock:
+            self.registrations.append(request)
+        return dp.Empty()
+
+    def start(self) -> None:
+        podres = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        podres.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "v1.PodResourcesLister",
+                    {
+                        "List": grpc.unary_unary_rpc_method_handler(
+                            self._list,
+                            request_deserializer=pr.ListPodResourcesRequest.FromString,
+                            response_serializer=pr.ListPodResourcesResponse.SerializeToString,
+                        ),
+                        "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
+                            self._get_allocatable,
+                            request_deserializer=pr.AllocatableResourcesRequest.FromString,
+                            response_serializer=pr.AllocatableResourcesResponse.SerializeToString,
+                        ),
+                    },
+                ),
+            )
+        )
+        podres.add_insecure_port(f"unix://{self.pod_resources_socket}")
+        podres.start()
+        self._servers.append(podres)
+
+        reg = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        reg.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "v1beta1.Registration",
+                    {
+                        "Register": grpc.unary_unary_rpc_method_handler(
+                            self._register,
+                            request_deserializer=dp.RegisterRequest.FromString,
+                            response_serializer=dp.Empty.SerializeToString,
+                        ),
+                    },
+                ),
+            )
+        )
+        reg.add_insecure_port(f"unix://{self.registration_socket}")
+        reg.start()
+        self._servers.append(reg)
+
+    def stop(self) -> None:
+        for s in self._servers:
+            s.stop(grace=0.2)
+        self._servers.clear()

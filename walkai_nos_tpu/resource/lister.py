@@ -1,0 +1,101 @@
+"""Kubelet pod-resources gRPC client (the real ResourceClient).
+
+Port of `pkg/resource/lister.go:26-38` + `client.go:39-87`: dials the
+kubelet's pod-resources unix socket, `List` gives used devices (attached to
+pod containers), `GetAllocatableResources` gives everything the kubelet can
+allocate; free = allocatable − used is computed by callers
+(`pkg/gpu/util.go:62-89`). Same 10s timeout / 16MB max-message defaults
+(`pkg/constant/constants.go:89-92`).
+
+gRPC stubs are hand-rolled over grpc.Channel.unary_unary so we don't need
+grpc_tools codegen — method paths match the kubelet service
+`v1.PodResourcesLister`.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.protos_gen import podresources_pb2 as pb
+from walkai_nos_tpu.resource.client import ResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+from walkai_nos_tpu.tpu.errors import GenericError
+
+_SERVICE = "/v1.PodResourcesLister"
+
+
+class PodResourcesClient(ResourceClient):
+    def __init__(
+        self,
+        socket_path: str = constants.POD_RESOURCES_SOCKET,
+        timeout: float = constants.DEFAULT_POD_RESOURCES_TIMEOUT_S,
+        max_msg_size: int = constants.DEFAULT_POD_RESOURCES_MAX_MSG_SIZE,
+    ) -> None:
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(
+            f"unix://{socket_path}",
+            options=[
+                ("grpc.max_receive_message_length", max_msg_size),
+                ("grpc.max_send_message_length", max_msg_size),
+            ],
+        )
+        self._list = self._channel.unary_unary(
+            f"{_SERVICE}/List",
+            request_serializer=pb.ListPodResourcesRequest.SerializeToString,
+            response_deserializer=pb.ListPodResourcesResponse.FromString,
+        )
+        self._allocatable = self._channel.unary_unary(
+            f"{_SERVICE}/GetAllocatableResources",
+            request_serializer=pb.AllocatableResourcesRequest.SerializeToString,
+            response_deserializer=pb.AllocatableResourcesResponse.FromString,
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+    # -------------------------------------------------------------- interface
+
+    def get_allocatable_devices(self, resource_prefix: str = "") -> list[Device]:
+        try:
+            resp = self._allocatable(
+                pb.AllocatableResourcesRequest(), timeout=self._timeout
+            )
+        except grpc.RpcError as e:
+            raise GenericError(f"pod-resources GetAllocatableResources: {e}") from e
+        out = []
+        for dev in resp.devices:
+            if not dev.resource_name.startswith(resource_prefix):
+                continue
+            for device_id in dev.device_ids:
+                out.append(
+                    Device(
+                        resource_name=dev.resource_name,
+                        device_id=device_id,
+                        status=DeviceStatus.UNKNOWN,
+                    )
+                )
+        return sorted(out, key=lambda d: d.device_id)
+
+    def get_used_devices(self, resource_prefix: str = "") -> list[Device]:
+        try:
+            resp = self._list(
+                pb.ListPodResourcesRequest(), timeout=self._timeout
+            )
+        except grpc.RpcError as e:
+            raise GenericError(f"pod-resources List: {e}") from e
+        out = []
+        for pod in resp.pod_resources:
+            for container in pod.containers:
+                for dev in container.devices:
+                    if not dev.resource_name.startswith(resource_prefix):
+                        continue
+                    for device_id in dev.device_ids:
+                        out.append(
+                            Device(
+                                resource_name=dev.resource_name,
+                                device_id=device_id,
+                                status=DeviceStatus.USED,
+                            )
+                        )
+        return sorted(out, key=lambda d: d.device_id)

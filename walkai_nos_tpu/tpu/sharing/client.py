@@ -1,0 +1,63 @@
+"""Sharing device client: used/free shared-chip devices from the kubelet.
+
+Analogue of the slicing `gpu.Client` (`pkg/gpu/slicing/client.go:32-105`):
+shared devices aren't placed on the mesh (non-contiguous chip-count
+sharing), so there's no device-layer index resolution — everything reports
+mesh index 0, and device IDs may carry a replica suffix (`"::"` separator,
+`slicing/constant.go:21`) that is stripped for identity.
+"""
+
+from __future__ import annotations
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.resource.client import ResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceList, DeviceStatus
+
+REPLICA_SEPARATOR = "::"
+
+
+def extract_shared_device_id(device_id: str) -> str:
+    """Strip the device-plugin replica suffix (`slicing/util.go:50`)."""
+    return device_id.split(REPLICA_SEPARATOR, 1)[0]
+
+
+class SharingClient:
+    def __init__(self, resource_client: ResourceClient, mesh_index: int = 0):
+        self._resource = resource_client
+        self._mesh_index = mesh_index
+
+    def get_tpu_devices(self) -> DeviceList:
+        used = self._resource.get_used_devices(
+            constants.RESOURCE_TPU_SHARED_PREFIX
+        )
+        allocatable = self._resource.get_allocatable_devices(
+            constants.RESOURCE_TPU_SHARED_PREFIX
+        )
+        used_ids = {extract_shared_device_id(d.device_id) for d in used}
+        out = DeviceList()
+        seen: set[str] = set()
+        for d in used:
+            out.append(
+                Device(
+                    resource_name=d.resource_name,
+                    device_id=d.device_id,
+                    status=DeviceStatus.USED,
+                    mesh_index=self._mesh_index,
+                )
+            )
+            seen.add(d.device_id)
+        for d in allocatable:
+            if (
+                d.device_id in seen
+                or extract_shared_device_id(d.device_id) in used_ids
+            ):
+                continue
+            out.append(
+                Device(
+                    resource_name=d.resource_name,
+                    device_id=d.device_id,
+                    status=DeviceStatus.FREE,
+                    mesh_index=self._mesh_index,
+                )
+            )
+        return out

@@ -1,0 +1,186 @@
+"""ctypes binding to the native tpudev library (`native/tpudev/`).
+
+The analogue of the reference's cgo NVML client (`pkg/gpu/nvml/client.go`,
+`//go:build nvml`): the real device layer, loaded at runtime, with the
+pure-Python stub (`walkai_nos_tpu/tpudev/stub.py`) as the default when the
+shared library isn't present — mirroring the build-tag/stub dual
+(`client_stub.go:24`).
+
+Library resolution order: $WALKAI_TPUDEV_LIB, then the in-repo build
+(`native/tpudev/build/libtpudev.so`), then the system loader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from pathlib import Path
+
+from walkai_nos_tpu.tpu.errors import GenericError, NotFoundError
+from walkai_nos_tpu.tpudev.client import (
+    ChipInfo,
+    HostTopology,
+    SliceInfo,
+    TpudevClient,
+)
+
+_OK = 0
+_ERR = 1
+_NOTFOUND = 2
+_CONFLICT = 3
+_ERANGE = 4
+_EINVAL = 5
+
+_BUF_SIZE = 1 << 20
+
+_REPO_BUILD = (
+    Path(__file__).resolve().parents[2] / "native" / "tpudev" / "build"
+    / "libtpudev.so"
+)
+
+
+def find_library() -> str | None:
+    env = os.environ.get("WALKAI_TPUDEV_LIB")
+    if env:
+        return env if os.path.exists(env) else None
+    if _REPO_BUILD.exists():
+        return str(_REPO_BUILD)
+    return None
+
+
+class NativeTpudevClient(TpudevClient):
+    """TpudevClient over libtpudev.so."""
+
+    def __init__(self, lib_path: str | None = None) -> None:
+        path = lib_path or find_library()
+        if path is None:
+            raise GenericError(
+                "libtpudev.so not found (set WALKAI_TPUDEV_LIB or run "
+                "`make -C native/tpudev`)"
+            )
+        self._lib = ctypes.CDLL(path)
+        self._lib.tpudev_last_error.restype = ctypes.c_char_p
+        self._lib.tpudev_get_topology.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        self._lib.tpudev_list_slices.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        self._lib.tpudev_create_slice.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        self._lib.tpudev_delete_slice.argtypes = [ctypes.c_char_p]
+        self._check(self._lib.tpudev_init(), "tpudev_init")
+
+    # ----------------------------------------------------------------- errors
+
+    def _check(self, status: int, op: str) -> None:
+        if status == _OK:
+            return
+        msg = (self._lib.tpudev_last_error() or b"").decode()
+        if status == _NOTFOUND:
+            raise NotFoundError(f"{op}: {msg}")
+        raise GenericError(f"{op}: {msg or f'status {status}'}")
+
+    def _call_json(self, fn, *args):
+        buf = ctypes.create_string_buffer(_BUF_SIZE)
+        self._check(fn(*args, buf, _BUF_SIZE), fn.__name__)
+        return json.loads(buf.value.decode())
+
+    # -------------------------------------------------------------- interface
+
+    def get_topology(self) -> HostTopology:
+        data = self._call_json(self._lib.tpudev_get_topology)
+        return HostTopology(
+            mesh=tuple(data["mesh"]),
+            mesh_index=data["mesh_index"],
+            chips=tuple(
+                ChipInfo(
+                    chip_id=c["chip_id"],
+                    device_path=c["device_path"],
+                    coords=tuple(c["coords"]),
+                )
+                for c in data["chips"]
+            ),
+        )
+
+    def _slice_from_json(self, s: dict, mesh) -> SliceInfo:
+        from walkai_nos_tpu.tpudev.fake import make_slice_env
+        from walkai_nos_tpu.tpu.tiling.packing import Placement
+
+        placement = Placement(
+            profile=s["profile"],
+            offset=tuple(s["offset"]),
+            orientation=tuple(s["orientation"]),
+        )
+        chip_ids = tuple(s["chip_ids"])
+        return SliceInfo(
+            slice_id=s["slice_id"],
+            profile=s["profile"],
+            mesh_index=s["mesh_index"],
+            chip_ids=chip_ids,
+            env=make_slice_env(mesh, placement, chip_ids),
+        )
+
+    def list_slices(self) -> list[SliceInfo]:
+        mesh = self.get_topology().mesh  # one native call for the listing
+        return [
+            self._slice_from_json(s, mesh)
+            for s in self._call_json(self._lib.tpudev_list_slices)
+        ]
+
+    def get_slice_mesh_index(self, slice_id: str) -> int:
+        for s in self.list_slices():
+            if s.slice_id == slice_id:
+                return s.mesh_index
+        raise NotFoundError(f"slice {slice_id} not found")
+
+    def create_slices(self, placements: list) -> list[SliceInfo]:
+        created: list[SliceInfo] = []
+        errors: list[str] = []
+        for p in placements:
+            text = (
+                f"{p.profile}@"
+                + "-".join(str(c) for c in p.offset)
+                + ":"
+                + "x".join(str(d) for d in p.orientation)
+            )
+            try:
+                data = self._call_json(
+                    self._lib.tpudev_create_slice, text.encode()
+                )
+            except GenericError as e:
+                errors.append(str(e))
+                continue
+            created.append(
+                self._slice_from_json(data, self.get_topology().mesh)
+            )
+        if not created and errors:
+            raise GenericError("; ".join(errors))
+        return created
+
+    def delete_slice(self, slice_id: str) -> None:
+        self._check(
+            self._lib.tpudev_delete_slice(slice_id.encode()),
+            "tpudev_delete_slice",
+        )
+
+    def delete_all_slices_except(self, keep_slice_ids: set[str]) -> list[str]:
+        deleted = []
+        for s in self.list_slices():
+            if s.slice_id not in keep_slice_ids:
+                self.delete_slice(s.slice_id)
+                deleted.append(s.slice_id)
+        return sorted(deleted)
+
+
+def load_client() -> TpudevClient:
+    """Native client when the library is available, else the noop stub —
+    the runtime equivalent of the reference's nvml build-tag dual."""
+    try:
+        return NativeTpudevClient()
+    except GenericError:
+        from walkai_nos_tpu.tpudev.stub import StubTpudevClient
+
+        return StubTpudevClient()
