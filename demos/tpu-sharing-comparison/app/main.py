@@ -1,0 +1,78 @@
+"""Inference server pod for the TPU sharing-comparison demo.
+
+TPU-native rebuild of the reference's demo workload
+(`demos/gpu-sharing-comparison/app/main.py`, a torch YOLOS-small HTTP
+server): serves the flagship YOLOS-style ViT over HTTP on whatever slice
+the device plugin granted this pod (TPU_VISIBLE_CHIPS et al. are injected
+by the walkai device plugin at Allocate time).
+
+POST /infer with a JSON body {"batch": N} runs one jitted forward pass;
+GET /healthz for probes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from walkai_nos_tpu.models.train import make_infer_step
+    from walkai_nos_tpu.models.vit import VIT_SMALL, ViTDetector
+
+    cfg = VIT_SMALL
+    params = jax.device_put(
+        ViTDetector(cfg).init_params(jax.random.PRNGKey(0))
+    )
+    infer = make_infer_step(cfg)
+    warm = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    jax.block_until_ready(infer(params, warm))
+    slice_id = os.environ.get("TPU_SLICE_ID", "whole-host")
+    print(f"serving on slice {slice_id} with {jax.device_count()} device(s)")
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            if self.path != "/infer":
+                self.send_error(404)
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            batch = int(body.get("batch", 1))
+            images = jnp.zeros(
+                (batch, cfg.image_size, cfg.image_size, 3), jnp.float32
+            )
+            t0 = time.perf_counter()
+            jax.block_until_ready(infer(params, images))
+            elapsed = time.perf_counter() - t0
+            payload = json.dumps(
+                {"inference_time_seconds": elapsed, "slice": slice_id}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+            else:
+                self.send_error(404)
+
+        def log_message(self, *args):
+            pass
+
+    port = int(os.environ.get("PORT", "8000"))
+    ThreadingHTTPServer(("0.0.0.0", port), Handler).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Benchmark client for the TPU sharing-comparison demo.
+
+Port of the reference's client (`demos/gpu-sharing-comparison/client/main.py`,
+which exports a Prometheus `inference_time_seconds` Summary): continuously
+POSTs /infer to the target servers and serves the same summary metric on
+/metrics so the comparison query from the reference README works unchanged:
+
+    avg(sum(rate(inference_time_seconds_sum[2m]))
+        / sum(rate(inference_time_seconds_count[2m])))
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import urllib.request
+
+from walkai_nos_tpu.health import HealthServer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--targets", required=True,
+        help="comma-separated inference server URLs",
+    )
+    parser.add_argument("--batch", type=int, default=1)
+    parser.add_argument("--metrics-addr", default=":9090")
+    args = parser.parse_args()
+
+    server = HealthServer(args.metrics_addr)
+    server.start()
+    server.mark_ready()
+
+    def hammer(target: str) -> None:
+        while True:
+            try:
+                req = urllib.request.Request(
+                    f"{target}/infer",
+                    data=json.dumps({"batch": args.batch}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    elapsed = json.loads(resp.read())["inference_time_seconds"]
+                server.metrics.counter_add(
+                    "inference_time_seconds_sum", elapsed, {"target": target}
+                )
+                server.metrics.counter_add(
+                    "inference_time_seconds_count", 1, {"target": target}
+                )
+            except Exception:
+                server.metrics.counter_add(
+                    "inference_errors_total", 1, {"target": target}
+                )
+
+    for target in args.targets.split(","):
+        threading.Thread(target=hammer, args=(target,), daemon=True).start()
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

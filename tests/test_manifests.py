@@ -1,0 +1,69 @@
+"""Deployment manifests sanity: parseable YAML, consistent contracts."""
+
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _all_docs():
+    for path in sorted(REPO.glob("deploy/**/*.yaml")) + sorted(
+        REPO.glob("demos/**/manifests/*.yaml")
+    ):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if doc:
+                yield path, doc
+
+
+def test_all_manifests_parse():
+    docs = list(_all_docs())
+    assert len(docs) >= 10
+
+
+def test_kinds_and_namespaces():
+    for path, doc in _all_docs():
+        assert "kind" in doc and "apiVersion" in doc, path
+        if doc["kind"] in ("Deployment", "DaemonSet", "ConfigMap", "Secret"):
+            assert doc["metadata"].get("namespace"), (path, doc["kind"])
+
+
+def test_agent_daemonset_contract():
+    """The agent DS must carry the pieces the code contracts require."""
+    for path, doc in _all_docs():
+        if doc["kind"] == "DaemonSet" and doc["metadata"]["name"] == "tpuagent":
+            spec = doc["spec"]["template"]["spec"]
+            container = spec["containers"][0]
+            env_names = {e["name"] for e in container["env"]}
+            assert "NODE_NAME" in env_names  # cmd/tpuagent requires it
+            mounts = {m["mountPath"] for m in container["volumeMounts"]}
+            assert "/var/lib/kubelet/pod-resources" in mounts
+            assert "/var/lib/kubelet/device-plugins" in mounts
+            assert spec["nodeSelector"] == {
+                "nos.walkai.io/tpu-partitioning": "tiling"
+            }
+            return
+    raise AssertionError("tpuagent DaemonSet not found")
+
+
+def test_crds_define_quota_kinds():
+    kinds = {
+        doc["spec"]["names"]["kind"]
+        for _, doc in _all_docs()
+        if doc["kind"] == "CustomResourceDefinition"
+    }
+    assert {"ElasticQuota", "CompositeElasticQuota"} <= kinds
+
+
+def test_demo_requests_slice_resources():
+    for path, doc in _all_docs():
+        if (
+            doc["kind"] == "Deployment"
+            and doc["metadata"]["name"] == "tpu-inference"
+        ):
+            spec = doc["spec"]["template"]["spec"]
+            assert spec["schedulerName"] == "walkai-nos-scheduler"
+            limits = spec["containers"][0]["resources"]["limits"]
+            assert any(k.startswith("walkai.io/tpu-") for k in limits)
+            return
+    raise AssertionError("demo deployment not found")

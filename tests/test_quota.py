@@ -1,0 +1,310 @@
+"""Elastic Resource Quota: calculator, fair-share math, labeler, scheduler.
+
+The fair-sharing cases reproduce the worked example preserved in the
+reference docs (`docs/en/docs/elastic-resource-quota/key-concepts.md:48-75`:
+quotas A/B/C with min 40/10/30, B borrowing, A reclaiming via preemption),
+with `nos.walkai.io/tpu-chips` in place of gpu-memory.
+"""
+
+import time
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.cmd.tpuscheduler import build_manager
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.quota import (
+    CapacityScheduling,
+    ClusterQuotaState,
+    pod_tpu_chips,
+)
+from walkai_nos_tpu.quota.labeler import (
+    IN_QUOTA,
+    LABEL_CAPACITY,
+    OVER_QUOTA,
+    CapacityLabeler,
+)
+from walkai_nos_tpu.kube.runtime import Request
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+def _quota(name, namespace, min_chips, max_chips=None):
+    spec = {"min": {CHIPS: str(min_chips)}}
+    if max_chips is not None:
+        spec["max"] = {CHIPS: str(max_chips)}
+    return {
+        "kind": "ElasticQuota",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+def _pod(name, namespace, chips, *, phase="Running", created="2026-01-01T00:00:00Z",
+         labels=None, scheduler=None, node=None):
+    pod = {
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "creationTimestamp": created,
+            "labels": labels or {},
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {
+                        "requests": {constants.RESOURCE_TPU: str(chips)}
+                    },
+                }
+            ]
+        },
+        "status": {"phase": phase},
+    }
+    if scheduler:
+        pod["spec"]["schedulerName"] = scheduler
+    if node is None and phase == "Running":
+        node = "host-a"  # quota accrues only once scheduled
+    if node:
+        pod["spec"]["nodeName"] = node
+    return pod
+
+
+class TestCalculator:
+    def test_mixed_resources_sum_chips(self):
+        pod = {
+            "spec": {
+                "containers": [
+                    {
+                        "resources": {
+                            "limits": {
+                                "walkai.io/tpu-2x2": "1",
+                                "google.com/tpu": "1",
+                            }
+                        }
+                    }
+                ]
+            }
+        }
+        # 2x2 slice = 4 chips + 1 whole chip = 5 (the 10+32=42 example of
+        # key-concepts.md, TPU-shaped).
+        assert pod_tpu_chips(pod) == 5
+
+    def test_shared_profile_chips(self):
+        pod = {
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"walkai.io/tpu-shared-2c": "3"}}}
+                ]
+            }
+        }
+        assert pod_tpu_chips(pod) == 6
+
+
+class TestFairShareMath:
+    def _docs_state(self, used_a, used_b, used_c):
+        quotas = [
+            _quota("qa", "team-a", 40),
+            _quota("qb", "team-b", 10),
+            _quota("qc", "team-c", 30),
+        ]
+        pods = []
+        for ns, used in (("team-a", used_a), ("team-b", used_b), ("team-c", used_c)):
+            for i in range(used // 10):
+                pods.append(_pod(f"{ns}-{i}", ns, 10))
+        return ClusterQuotaState.build(quotas, pods)
+
+    def test_docs_example_guaranteed_shares(self):
+        state = self._docs_state(40, 40, 0)  # t1
+        qa = state.for_namespace("team-a")
+        qb = state.for_namespace("team-b")
+        assert state.total_available_over_quotas(CHIPS) == 30
+        assert state.guaranteed_over_quota(qa, CHIPS) == 15.0
+        assert state.guaranteed_over_quota(qb, CHIPS) == 3.75
+        assert qb.over_quota_usage(CHIPS) == 30
+
+    def test_docs_example_preemption(self):
+        state = self._docs_state(40, 40, 0)
+        plugin = CapacityScheduling(state)
+        new_pod = _pod("a-new", "team-a", 10, phase="Pending")
+        over_quota_pods = [
+            _pod(
+                f"team-b-{i}", "team-b", 10,
+                labels={LABEL_CAPACITY: OVER_QUOTA},
+                created=f"2026-01-01T00:0{i}:00Z",
+            )
+            for i in range(3)
+        ]
+        victims = plugin.find_preemption_victims(new_pod, over_quota_pods)
+        assert len(victims) == 1
+        # newest over-quota pod goes first
+        assert objects.name(victims[0]) == "team-b-2"
+
+    def test_preemptor_over_its_share_gets_nothing(self):
+        # team-b (min 10) trying to claim beyond min + guaranteed share.
+        state = self._docs_state(40, 40, 0)
+        plugin = CapacityScheduling(state)
+        pod = _pod("b-more", "team-b", 10, phase="Pending")
+        assert plugin.find_preemption_victims(pod, []) == []
+
+    def test_pre_filter_max_and_borrowing(self):
+        quotas = [
+            _quota("qa", "team-a", 4, max_chips=8),
+            _quota("qb", "team-b", 4),
+        ]
+        pods = [_pod("a-0", "team-a", 4)]
+        plugin = CapacityScheduling(ClusterQuotaState.build(quotas, pods))
+        # borrowing 4 from qb's unused min: allowed
+        assert plugin.pre_filter(_pod("a-1", "team-a", 4, phase="Pending")).allowed
+        # beyond max: denied
+        state = ClusterQuotaState.build(
+            quotas, pods + [_pod("a-1", "team-a", 4)]
+        )
+        decision = CapacityScheduling(state).pre_filter(
+            _pod("a-2", "team-a", 4, phase="Pending")
+        )
+        assert not decision.allowed and "max exceeded" in decision.reason
+
+    def test_pre_filter_denies_when_nothing_to_borrow(self):
+        quotas = [_quota("qa", "team-a", 4), _quota("qb", "team-b", 4)]
+        pods = [_pod("a-0", "team-a", 4), _pod("b-0", "team-b", 4)]
+        plugin = CapacityScheduling(ClusterQuotaState.build(quotas, pods))
+        decision = plugin.pre_filter(_pod("a-1", "team-a", 4, phase="Pending"))
+        assert not decision.allowed and "borrow" in decision.reason
+
+
+class TestCapacityLabeler:
+    def test_labels_in_and_over_quota(self):
+        kube = FakeKubeClient()
+        kube.create("ElasticQuota", _quota("qa", "team-a", 8), "team-a")
+        kube.create("Pod", _pod("p1", "team-a", 8, created="2026-01-01T00:00:00Z"))
+        kube.create("Pod", _pod("p2", "team-a", 4, created="2026-01-02T00:00:00Z"))
+        CapacityLabeler(kube).reconcile(Request("p2", "team-a"))
+        p1 = kube.get("Pod", "p1", "team-a")
+        p2 = kube.get("Pod", "p2", "team-a")
+        assert objects.labels(p1)[LABEL_CAPACITY] == IN_QUOTA
+        assert objects.labels(p2)[LABEL_CAPACITY] == OVER_QUOTA
+
+    def test_composite_quota_spans_namespaces(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "CompositeElasticQuota",
+            {
+                "kind": "CompositeElasticQuota",
+                "metadata": {"name": "cq", "namespace": "default"},
+                "spec": {"min": {CHIPS: "8"}, "namespaces": ["ns1", "ns2"]},
+            },
+        )
+        kube.create("Pod", _pod("p1", "ns1", 8, created="2026-01-01T00:00:00Z"))
+        kube.create("Pod", _pod("p2", "ns2", 4, created="2026-01-02T00:00:00Z"))
+        CapacityLabeler(kube).reconcile(Request("p1", "ns1"))
+        assert (
+            objects.labels(kube.get("Pod", "p2", "ns2"))[LABEL_CAPACITY]
+            == OVER_QUOTA
+        )
+
+
+def _eventually(fn, timeout=10.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out: {msg}")
+
+
+class TestSchedulerE2E:
+    def _cluster(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            {
+                "metadata": {"name": "host-a"},
+                "status": {"allocatable": {"google.com/tpu": "8"}},
+            },
+        )
+        kube.create("ElasticQuota", _quota("qa", "team-a", 4), "team-a")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        return kube
+
+    def test_binds_within_quota(self):
+        kube = self._cluster()
+        manager = build_manager(kube)
+        with manager:
+            kube.create(
+                "Pod",
+                _pod("j1", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler"),
+            )
+            _eventually(
+                lambda: kube.get("Pod", "j1", "team-a")["spec"].get("nodeName")
+                == "host-a",
+                msg="pod binds",
+            )
+
+    def test_over_quota_pod_preempted_when_owner_reclaims(self):
+        """The docs' t2 scenario end-to-end: B over-quota, A reclaims."""
+        kube = self._cluster()
+        manager = build_manager(kube)
+        with manager:
+            # team-b fills its min and borrows all of team-a's min.
+            for i in range(2):
+                kube.create(
+                    "Pod",
+                    _pod(f"b-{i}", "team-b", 4, phase="Pending",
+                         scheduler="walkai-nos-scheduler",
+                         created=f"2026-01-01T00:0{i}:00Z"),
+                )
+            _eventually(
+                lambda: all(
+                    kube.get("Pod", f"b-{i}", "team-b")["spec"].get("nodeName")
+                    for i in range(2)
+                ),
+                msg="team-b pods bind (one borrowing)",
+            )
+            for i in range(2):
+                kube.patch("Pod", f"b-{i}", {"status": {"phase": "Running"}},
+                           "team-b")
+            _eventually(
+                lambda: objects.labels(
+                    kube.get("Pod", "b-1", "team-b")
+                ).get(LABEL_CAPACITY) == OVER_QUOTA,
+                msg="borrowing pod labelled over-quota",
+            )
+            # team-a claims its guaranteed min back.
+            kube.create(
+                "Pod",
+                _pod("a-0", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler",
+                     created="2026-01-02T00:00:00Z"),
+            )
+            _eventually(
+                lambda: kube.get("Pod", "a-0", "team-a")["spec"].get("nodeName")
+                == "host-a",
+                msg="team-a pod binds after preemption",
+                timeout=15.0,
+            )
+            remaining = {
+                objects.name(p)
+                for p in kube.list("Pod", namespace="team-b")
+            }
+            assert "b-1" not in remaining  # over-quota victim evicted
+            assert "b-0" in remaining
+
+    def test_quota_status_updated(self):
+        kube = self._cluster()
+        manager = build_manager(kube)
+        with manager:
+            kube.create("Pod", _pod("r1", "team-a", 4))
+            _eventually(
+                lambda: (
+                    kube.get("ElasticQuota", "qa", "team-a")
+                    .get("status", {})
+                    .get("used", {})
+                    .get(CHIPS)
+                )
+                == "4",
+                msg="status.used reflects running pod",
+            )

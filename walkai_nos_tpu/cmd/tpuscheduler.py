@@ -1,0 +1,199 @@
+"""Quota-aware TPU scheduler (restores the deleted `nos-scheduler` binary).
+
+The reference fork removed the scheduler + capacity-scheduling plugin,
+keeping only its args type (`pkg/api/scheduler/v1beta3/types.go:26-30`) and
+docs. This binary restores the capability for TPU resources: it schedules
+pods that set `schedulerName: walkai-nos-scheduler`, applying
+
+1. elastic-quota pre-filter (max limit + borrowing availability),
+2. node fit over free `walkai.io/tpu-*` / `google.com/tpu` resources,
+3. fair-sharing preemption of over-quota pods when denied capacity,
+
+and binds with the pods/binding subresource (spec.nodeName patch on fakes).
+It also runs the capacity labeler (in-quota/over-quota, `key-concepts.md:9-25`)
+and keeps ElasticQuota `status.used` current.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from walkai_nos_tpu.cmd import _common
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import ApiError, KubeClient, NotFound
+from walkai_nos_tpu.kube.runtime import Controller, Manager, Request, Result
+from walkai_nos_tpu.quota.fit import fits_node
+from walkai_nos_tpu.quota.labeler import CapacityLabeler
+from walkai_nos_tpu.quota.scheduler import CapacityScheduling
+from walkai_nos_tpu.quota.state import ClusterQuotaState
+
+logger = logging.getLogger("tpuscheduler")
+
+SCHEDULER_NAME = "walkai-nos-scheduler"
+
+
+def list_quota_objects(kube: KubeClient) -> list[dict]:
+    quotas: list[dict] = []
+    for kind in ("ElasticQuota", "CompositeElasticQuota"):
+        try:
+            quotas.extend(kube.list(kind))
+        except ApiError:
+            continue  # CRD not installed
+    return quotas
+
+
+def bind_pod(kube: KubeClient, pod: dict, node_name: str) -> None:
+    kube.bind_pod(objects.name(pod), objects.namespace(pod) or "default", node_name)
+
+
+class Scheduler:
+    def __init__(self, kube: KubeClient, scheduler_name: str = SCHEDULER_NAME):
+        self._kube = kube
+        self._name = scheduler_name
+
+    def reconcile(self, request: Request) -> Result:
+        try:
+            pod = self._kube.get("Pod", request.name, request.namespace or "default")
+        except NotFound:
+            return Result()
+        if (pod.get("spec") or {}).get("schedulerName") != self._name:
+            return Result()
+        if objects.pod_is_scheduled(pod):
+            return Result()
+        if (pod.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+            return Result()
+
+        pods = self._kube.list("Pod")
+        state = ClusterQuotaState.build(list_quota_objects(self._kube), pods)
+        plugin = CapacityScheduling(state)
+
+        decision = plugin.pre_filter(pod)
+        if not decision.allowed:
+            # Quota-level denial (over max / nothing to borrow): preemption
+            # can't create quota headroom; wait for usage to change.
+            logger.info(
+                "pod %s/%s quota-denied: %s",
+                request.namespace,
+                request.name,
+                decision.reason,
+            )
+            return Result(requeue_after=5.0)
+
+        nodes = self._kube.list("Node")
+        for node in sorted(nodes, key=objects.name):
+            if fits_node(pod, node, pods):
+                bind_pod(self._kube, pod, objects.name(node))
+                logger.info(
+                    "bound %s/%s to %s",
+                    request.namespace,
+                    request.name,
+                    objects.name(node),
+                )
+                return Result()
+
+        # Physically unschedulable (PostFilter): fair-sharing preemption of
+        # over-quota pods elsewhere (`key-concepts.md:31-40`).
+        victims = plugin.find_preemption_victims(pod, pods)
+        for victim in victims:
+            logger.info(
+                "preempting over-quota pod %s/%s for %s/%s",
+                objects.namespace(victim),
+                objects.name(victim),
+                request.namespace,
+                request.name,
+            )
+            try:
+                self._kube.delete(
+                    "Pod",
+                    objects.name(victim),
+                    objects.namespace(victim) or "default",
+                )
+            except NotFound:
+                pass
+        if victims:
+            return Result(requeue_after=0.5)  # re-fit after evictions
+        return Result(requeue_after=5.0)  # no fit; the partitioner may retile
+
+
+class QuotaStatusUpdater:
+    """Keeps ElasticQuota/CompositeElasticQuota status.used current."""
+
+    def __init__(self, kube: KubeClient):
+        self._kube = kube
+
+    def reconcile(self, request: Request) -> Result:
+        state = ClusterQuotaState.build(
+            list_quota_objects(self._kube), self._kube.list("Pod")
+        )
+        for quota in state.quotas:
+            kind = "CompositeElasticQuota" if quota.composite else "ElasticQuota"
+            namespace = None if quota.composite else quota.namespaces[0]
+            try:
+                current = self._kube.get(kind, quota.name, namespace)
+            except ApiError:
+                continue
+            used = {k: str(v) for k, v in sorted(quota.used.items())}
+            if ((current.get("status") or {}).get("used") or {}) != used:
+                try:
+                    self._kube.patch(
+                        kind, quota.name, {"status": {"used": used}}, namespace
+                    )
+                except ApiError:
+                    continue
+        return Result(requeue_after=10.0)
+
+
+def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Manager:
+    manager = Manager()
+    manager.add(
+        Controller(
+            "tpu-scheduler",
+            kube,
+            "Pod",
+            Scheduler(kube, scheduler_name).reconcile,
+            max_concurrent=1,  # serialized decisions, like the partitioner
+        )
+    )
+    manager.add(
+        Controller(
+            "capacity-labeler",
+            kube,
+            "Pod",
+            CapacityLabeler(kube).reconcile,
+        )
+    )
+    manager.add(
+        Controller(
+            "quota-status",
+            kube,
+            "Pod",
+            QuotaStatusUpdater(kube).reconcile,
+        )
+    )
+    return manager
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpuscheduler")
+    parser.add_argument("--scheduler-name", default=SCHEDULER_NAME)
+    parser.add_argument("--health-probe-addr", default=":8081")
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    _common.setup_logging(args.log_level)
+
+    kube = _common.build_kube_client()
+    health = _common.start_health(args.health_probe_addr)
+    manager = build_manager(kube, args.scheduler_name)
+    stop = _common.wait_for_shutdown()
+    manager.start()
+    health.mark_ready()
+    stop.wait()
+    manager.stop()
+    health.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,12 @@ class KubeClient(abc.ABC):
     @abc.abstractmethod
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None: ...
 
+    def bind_pod(self, name: str, namespace: str, node_name: str) -> None:
+        """Assign a pod to a node. Real API servers use the pods/binding
+        subresource (overridden in RestKubeClient); the default mutates
+        spec.nodeName directly, which is what fakes accept."""
+        self.patch("Pod", name, {"spec": {"nodeName": node_name}}, namespace)
+
     @abc.abstractmethod
     def watch(
         self,

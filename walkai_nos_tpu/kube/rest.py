@@ -282,6 +282,19 @@ class RestKubeClient(KubeClient):
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
         self._request("DELETE", self._path(kind, namespace, name))
 
+    def bind_pod(self, name: str, namespace: str, node_name: str) -> None:
+        """pods/binding subresource — how real schedulers assign nodes."""
+        self._request(
+            "POST",
+            self._path("Pod", namespace, name) + "/binding",
+            body={
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
+            },
+        )
+
     # ---------------------------------------------------------------- watch
 
     def watch(

@@ -1,0 +1,21 @@
+"""Elastic Resource Quota: over-quota borrowing + fair-sharing preemption.
+
+Restores the capability the reference fork removed (only docs + API types
+survive there — SURVEY.md §0): ElasticQuota/CompositeElasticQuota resources
+with `min` guaranteed / `max` limit, over-quota borrowing of other
+namespaces' unused `min`, the in-quota/over-quota pod capacity label, and
+fair-sharing preemption per the spec preserved in
+`docs/en/docs/elastic-resource-quota/key-concepts.md:27-75`. The custom
+resource is `nos.walkai.io/tpu-chips` (the `nos.nebuly.com/gpu-memory`
+analogue, `pkg/api/scheduler/v1beta3/types.go:26-30`).
+"""
+
+from walkai_nos_tpu.quota.resources import (  # noqa: F401
+    add,
+    le,
+    pod_tpu_chips,
+    sub_non_negative,
+)
+from walkai_nos_tpu.quota.state import ClusterQuotaState, QuotaInfo  # noqa: F401
+from walkai_nos_tpu.quota.scheduler import CapacityScheduling  # noqa: F401
+from walkai_nos_tpu.quota.labeler import CapacityLabeler  # noqa: F401

@@ -1,0 +1,66 @@
+"""Node fitting for the quota scheduler: free slice resources per node.
+
+Free capacity for `walkai.io/tpu-*` (and whole-host `google.com/tpu`)
+resources = the node's allocatable minus requests of pods already bound to
+it — the NodeInfo-recompute pattern of `pkg/gpu/mig/node.go:167` without
+dragging in the scheduler framework.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.tpu.sharing.profile import is_shared_resource
+from walkai_nos_tpu.tpu.tiling.profile import is_slice_resource
+from walkai_nos_tpu.utils.quantity import parse_quantity
+
+
+def _tpu_resources(raw: Mapping | None) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for name, qty in (raw or {}).items():
+        if (
+            is_slice_resource(name)
+            or is_shared_resource(name)
+            or name == constants.RESOURCE_TPU
+        ):
+            try:
+                out[name] = parse_quantity(qty)
+            except ValueError:
+                continue
+    return out
+
+
+def pod_tpu_requests(pod: Mapping) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for c in (pod.get("spec") or {}).get("containers") or []:
+        resources = c.get("resources") or {}
+        merged = {
+            **(resources.get("limits") or {}),
+            **(resources.get("requests") or {}),
+        }
+        for name, qty in _tpu_resources(merged).items():
+            out[name] = out.get(name, 0) + qty
+    return out
+
+
+def node_free_resources(node: Mapping, pods: list[Mapping]) -> dict[str, int]:
+    free = _tpu_resources((node.get("status") or {}).get("allocatable"))
+    name = objects.name(node)
+    for pod in pods:
+        if (pod.get("spec") or {}).get("nodeName") != name:
+            continue
+        if (pod.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+            continue
+        for res, qty in pod_tpu_requests(pod).items():
+            free[res] = free.get(res, 0) - qty
+    return free
+
+
+def fits_node(pod: Mapping, node: Mapping, pods: list[Mapping]) -> bool:
+    wanted = pod_tpu_requests(pod)
+    if not wanted:
+        return True
+    free = node_free_resources(node, pods)
+    return all(free.get(res, 0) >= qty for res, qty in wanted.items())

@@ -1,0 +1,94 @@
+"""Capacity labeler: marks pods `in-quota` / `over-quota`.
+
+The operator behavior from the preserved spec (`key-concepts.md:9-25`):
+every pod in a namespace governed by a quota carries the
+`nos.walkai.io/capacity` label; on every pod phase change to/from Running
+the namespace's pods are re-evaluated — sorted by (creationTimestamp,
+requested resources asc), cumulative usage is summed in that order, and
+every pod past the quota's `min` is labelled over-quota.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import ApiError, KubeClient
+from walkai_nos_tpu.kube.runtime import Request, Result
+from walkai_nos_tpu.quota.resources import pod_quota_request
+from walkai_nos_tpu.quota.state import ClusterQuotaState
+
+logger = logging.getLogger(__name__)
+
+LABEL_CAPACITY = f"{constants.API_GROUP}/capacity"
+IN_QUOTA = "in-quota"
+OVER_QUOTA = "over-quota"
+
+
+class CapacityLabeler:
+    """Reconciles one namespace's capacity labels per pod event."""
+
+    def __init__(self, kube: KubeClient):
+        self._kube = kube
+
+    def reconcile(self, request: Request) -> Result:
+        namespace = request.namespace or "default"
+        state = ClusterQuotaState.build(
+            self._list_quotas(), self._kube.list("Pod")
+        )
+        quota = state.for_namespace(namespace)
+        if quota is None:
+            return Result()
+
+        # Aggregate across all governed namespaces (composite quotas span
+        # several), in (creation ts, requested asc) order (`key-concepts.md:21`).
+        from walkai_nos_tpu.quota.state import pod_holds_quota
+
+        pods = [
+            p
+            for p in self._kube.list("Pod")
+            if (objects.namespace(p) or "default") in quota.namespaces
+            and pod_holds_quota(p)
+        ]
+        pods.sort(
+            key=lambda p: (
+                (p.get("metadata") or {}).get("creationTimestamp") or "",
+                sum(pod_quota_request(p).values()),
+            )
+        )
+        cumulative: dict[str, int] = {}
+        for pod in pods:
+            request_res = pod_quota_request(pod)
+            within = all(
+                cumulative.get(k, 0) + v <= quota.min.get(k, 0)
+                for k, v in request_res.items()
+            )
+            for k, v in request_res.items():
+                cumulative[k] = cumulative.get(k, 0) + v
+            desired = IN_QUOTA if within else OVER_QUOTA
+            if objects.labels(pod).get(LABEL_CAPACITY) != desired:
+                try:
+                    self._kube.patch(
+                        "Pod",
+                        objects.name(pod),
+                        {"metadata": {"labels": {LABEL_CAPACITY: desired}}},
+                        objects.namespace(pod) or "default",
+                    )
+                except ApiError as e:
+                    logger.warning(
+                        "capacity label on %s/%s failed: %s",
+                        objects.namespace(pod),
+                        objects.name(pod),
+                        e,
+                    )
+        return Result()
+
+    def _list_quotas(self) -> list[dict]:
+        quotas: list[dict] = []
+        for kind in ("ElasticQuota", "CompositeElasticQuota"):
+            try:
+                quotas.extend(self._kube.list(kind))
+            except ApiError:
+                continue  # CRD not installed
+        return quotas

@@ -1,0 +1,98 @@
+"""Quota resource math + the tpu-chips calculator.
+
+`nos.walkai.io/tpu-chips` is the unit elastic quotas are expressed in — the
+analogue of `nos.nebuly.com/gpu-memory`, which the reference computes per
+pod from its GPU requests (`pkg/gpu/util/resource.go:28-86`: full GPU =
+configured GB, MIG profile = GB parsed from the profile name). Here: slice
+profile = chips of its mesh shape, shared profile = its chip count, whole
+`google.com/tpu` = requested chip count.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.sharing.profile import (
+    extract_shared_profile_name,
+    is_shared_resource,
+)
+from walkai_nos_tpu.tpu.tiling.profile import (
+    extract_profile_name,
+    is_slice_resource,
+)
+from walkai_nos_tpu.utils.quantity import parse_quantity
+
+Resources = dict[str, int]
+
+
+def add(a: Mapping[str, int], b: Mapping[str, int]) -> Resources:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def sub_non_negative(a: Mapping[str, int], b: Mapping[str, int]) -> Resources:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = max(out.get(k, 0) - v, 0)
+    return out
+
+
+def le(a: Mapping[str, int], b: Mapping[str, int]) -> bool:
+    """True if every resource in `a` fits within `b` (missing = 0)."""
+    return all(v <= b.get(k, 0) for k, v in a.items())
+
+
+def _container_chips(container: Mapping) -> int:
+    resources = container.get("resources") or {}
+    merged = {**(resources.get("limits") or {}), **(resources.get("requests") or {})}
+    chips = 0
+    for name, raw in merged.items():
+        try:
+            qty = parse_quantity(raw)
+        except ValueError:
+            continue
+        if qty <= 0:
+            continue
+        if is_slice_resource(name):
+            shape = topology.parse_shape(extract_profile_name(name))
+            chips += topology.shape_chip_count(shape) * qty
+        elif is_shared_resource(name):
+            chips += extract_shared_profile_chips(name) * qty
+        elif name == constants.RESOURCE_TPU:
+            chips += qty
+    return chips
+
+
+def extract_shared_profile_chips(resource_name: str) -> int:
+    from walkai_nos_tpu.tpu.sharing.profile import SharedProfile
+
+    return SharedProfile.parse(
+        extract_shared_profile_name(resource_name)
+    ).chip_count()
+
+
+def pod_tpu_chips(pod: Mapping) -> int:
+    """Total TPU chips a pod requests, scheduler pod-request style
+    (max(init, sum(containers)) — `pkg/resource/resource.go:107-146`)."""
+    spec = pod.get("spec") or {}
+    main = sum(_container_chips(c) for c in spec.get("containers") or [])
+    init = max(
+        (_container_chips(c) for c in spec.get("initContainers") or []),
+        default=0,
+    )
+    return max(main, init)
+
+
+def pod_quota_request(pod: Mapping) -> Resources:
+    """The resources a pod counts against its quota: its explicit requests
+    restricted to quota-relevant names, plus the computed tpu-chips
+    (the `ResourceCalculator` pattern, `resource.go:28-86`)."""
+    chips = pod_tpu_chips(pod)
+    out: Resources = {}
+    if chips:
+        out[constants.RESOURCE_TPU_CHIPS] = chips
+    return out

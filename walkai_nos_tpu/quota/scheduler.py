@@ -1,0 +1,149 @@
+"""Capacity-scheduling plugin: admit / deny / preempt on elastic quotas.
+
+The scheduler-side half the reference fork deleted (only
+`CapacitySchedulingArgs` survives, `pkg/api/scheduler/v1beta3/types.go:26-30`).
+Decision points follow the scheduler-framework shape:
+
+- `pre_filter(pod)`: deny when the pod would exceed its quota's `max`, or
+  when borrowing would exceed the cluster's actually-available over-quotas.
+- `post_filter(pod)`: preemption — find over-quota victims per the
+  fair-sharing conditions (`key-concepts.md:31-40`):
+    1. victim is over-quota,
+    2. used_A + request_A <= min_A + guaranteed over-quota A,
+    3. used over-quotas of victim's quota > its guaranteed over-quotas.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.quota.labeler import LABEL_CAPACITY, OVER_QUOTA
+from walkai_nos_tpu.quota.resources import add, pod_quota_request
+from walkai_nos_tpu.quota.state import ClusterQuotaState
+
+logger = logging.getLogger(__name__)
+
+RESOURCE = constants.RESOURCE_TPU_CHIPS
+
+
+@dataclass
+class Decision:
+    allowed: bool
+    reason: str = ""
+
+
+class CapacityScheduling:
+    def __init__(self, state: ClusterQuotaState):
+        self._state = state
+
+    # -------------------------------------------------------------- prefilter
+
+    def pre_filter(self, pod: dict) -> Decision:
+        namespace = objects.namespace(pod) or "default"
+        quota = self._state.for_namespace(namespace)
+        if quota is None:
+            return Decision(True, "namespace not governed by a quota")
+        request = pod_quota_request(pod)
+        if not request:
+            return Decision(True, "no quota-relevant resources requested")
+        if not quota.fits_max(request):
+            return Decision(
+                False,
+                f"quota {quota.name}: max exceeded "
+                f"(used {quota.used.get(RESOURCE, 0)} + "
+                f"request {request.get(RESOURCE, 0)})",
+            )
+        new_used = add(quota.used, request)
+        over = {
+            k: max(0, v - quota.min.get(k, 0)) for k, v in new_used.items()
+        }
+        if all(v == 0 for v in over.values()):
+            return Decision(True, "fits within min")
+        # Borrowing: the borrowed amount must exist as unused min elsewhere.
+        for resource, borrowed in over.items():
+            prior = quota.over_quota_usage(resource)
+            available = self._state.total_available_over_quotas(resource)
+            if borrowed - prior > available:
+                return Decision(
+                    False,
+                    f"quota {quota.name}: would borrow {borrowed} {resource} "
+                    f"but only {available} over-quota available",
+                )
+        return Decision(True, "fits borrowing unused quota")
+
+    # ------------------------------------------------------------- postfilter
+
+    def find_preemption_victims(self, pod: dict, pods: list[dict]) -> list[dict]:
+        """Victims whose eviction lets `pod` schedule, fair-sharing rules.
+
+        Candidates are over-quota pods of OTHER quotas, considered only
+        while their quota's over-quota usage exceeds its guaranteed share;
+        newest-first so older over-quota pods survive longer.
+        """
+        namespace = objects.namespace(pod) or "default"
+        quota = self._state.for_namespace(namespace)
+        if quota is None:
+            return []
+        request = pod_quota_request(pod).get(RESOURCE, 0)
+        if request == 0:
+            return []
+
+        # Condition 2: the preemptor must stay within min + guaranteed share.
+        guaranteed = self._state.guaranteed_over_quota(quota, RESOURCE)
+        if (
+            quota.used.get(RESOURCE, 0) + request
+            > quota.min.get(RESOURCE, 0) + guaranteed
+        ):
+            return []
+
+        # Preemption frees *physical* capacity: quota headroom ("available
+        # over-quotas") is an accounting construct — the chips may well be
+        # occupied by other namespaces' over-quota pods. Free enough of
+        # their usage to place this pod.
+        needed = request
+
+        # Over-quota usage per quota, to enforce condition 3 as we go.
+        over_usage = {
+            q.name: q.over_quota_usage(RESOURCE) for q in self._state.quotas
+        }
+        guaranteed_by_name = {
+            q.name: self._state.guaranteed_over_quota(q, RESOURCE)
+            for q in self._state.quotas
+        }
+
+        candidates = []
+        for p in pods:
+            ns = objects.namespace(p) or "default"
+            victim_quota = self._state.for_namespace(ns)
+            if victim_quota is None or victim_quota.name == quota.name:
+                continue
+            if objects.labels(p).get(LABEL_CAPACITY) != OVER_QUOTA:
+                continue
+            candidates.append((p, victim_quota))
+        # Newest first: LIFO eviction preserves older workloads.
+        candidates.sort(
+            key=lambda t: (t[0].get("metadata") or {}).get(
+                "creationTimestamp", ""
+            ),
+            reverse=True,
+        )
+
+        victims = []
+        freed = 0
+        for p, victim_quota in candidates:
+            if freed >= needed:
+                break
+            if over_usage[victim_quota.name] <= guaranteed_by_name[victim_quota.name]:
+                continue  # condition 3 no longer holds for this quota
+            victim_request = pod_quota_request(p).get(RESOURCE, 0)
+            if victim_request == 0:
+                continue
+            victims.append(p)
+            freed += victim_request
+            over_usage[victim_quota.name] -= victim_request
+        if freed < needed:
+            return []  # preemption cannot free enough; don't evict for nothing
+        return victims

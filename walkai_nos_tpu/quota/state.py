@@ -1,0 +1,129 @@
+"""Cluster quota state: per-quota usage, over-quota, fair-share math.
+
+Implements the accounting from the preserved spec
+(`docs/en/docs/elastic-resource-quota/key-concepts.md`):
+
+- a quota's `used` = sum of quota-relevant requests of its namespaces'
+  non-terminal pods;
+- over-quota usage = max(0, used - min);
+- total available over-quotas = sum_i max(0, min_i - used_i);
+- guaranteed over-quota_i = min_i / sum(min_j) * total available.
+
+ElasticQuota is namespaced (its namespace is the one it governs);
+CompositeElasticQuota spans the namespaces listed in spec.namespaces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping
+
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.quota.resources import (
+    Resources,
+    add,
+    le,
+    pod_quota_request,
+)
+from walkai_nos_tpu.utils.quantity import parse_quantity
+
+
+def pod_holds_quota(pod: Mapping) -> bool:
+    """A pod consumes quota once scheduled and until terminal — a pending
+    unscheduled pod must not count (it would double-count itself during
+    its own scheduling decision)."""
+    if (pod.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+        return False
+    return bool((pod.get("spec") or {}).get("nodeName"))
+
+
+def _parse_resources(raw: Mapping | None) -> Resources:
+    out: Resources = {}
+    for k, v in (raw or {}).items():
+        try:
+            out[k] = parse_quantity(v)
+        except ValueError:
+            continue
+    return out
+
+
+@dataclass
+class QuotaInfo:
+    """One ElasticQuota or CompositeElasticQuota."""
+
+    name: str
+    namespaces: tuple[str, ...]  # governed namespaces
+    min: Resources
+    max: Resources | None  # None = unlimited (`Max: None` in the spec table)
+    used: Resources = field(default_factory=dict)
+    composite: bool = False
+
+    @staticmethod
+    def from_object(obj: Mapping) -> "QuotaInfo":
+        spec = obj.get("spec") or {}
+        kind = obj.get("kind") or "ElasticQuota"
+        composite = kind == "CompositeElasticQuota"
+        if composite:
+            namespaces = tuple(spec.get("namespaces") or [])
+        else:
+            namespaces = (objects.namespace(obj) or "default",)
+        raw_max = spec.get("max")
+        return QuotaInfo(
+            name=objects.name(obj),
+            namespaces=namespaces,
+            min=_parse_resources(spec.get("min")),
+            max=_parse_resources(raw_max) if raw_max else None,
+            composite=composite,
+        )
+
+    def over_quota_usage(self, resource: str) -> int:
+        return max(0, self.used.get(resource, 0) - self.min.get(resource, 0))
+
+    def fits_max(self, request: Resources) -> bool:
+        if self.max is None:
+            return True
+        return le(add(self.used, request), self.max)
+
+
+class ClusterQuotaState:
+    def __init__(self, quotas: Iterable[QuotaInfo]):
+        self.quotas = list(quotas)
+        self._by_namespace: dict[str, QuotaInfo] = {}
+        for q in self.quotas:
+            for ns in q.namespaces:
+                self._by_namespace[ns] = q
+
+    @staticmethod
+    def build(quota_objects: Iterable[Mapping], pods: Iterable[Mapping]):
+        """Aggregate `used` from non-terminal pods of governed namespaces."""
+        state = ClusterQuotaState(
+            QuotaInfo.from_object(o) for o in quota_objects
+        )
+        for pod in pods:
+            if not pod_holds_quota(pod):
+                continue
+            quota = state.for_namespace(objects.namespace(pod) or "default")
+            if quota is None:
+                continue
+            quota.used = add(quota.used, pod_quota_request(pod))
+        return state
+
+    def for_namespace(self, namespace: str) -> QuotaInfo | None:
+        return self._by_namespace.get(namespace)
+
+    # ------------------------------------------------------------ fair share
+
+    def total_available_over_quotas(self, resource: str) -> int:
+        """sum_i max(0, min_i - used_i) (`key-concepts.md:46`)."""
+        return sum(
+            max(0, q.min.get(resource, 0) - q.used.get(resource, 0))
+            for q in self.quotas
+        )
+
+    def guaranteed_over_quota(self, quota: QuotaInfo, resource: str) -> float:
+        """min_i / sum(min_j) * total available (`key-concepts.md:44-46`)."""
+        total_min = sum(q.min.get(resource, 0) for q in self.quotas)
+        if total_min == 0:
+            return 0.0
+        share = quota.min.get(resource, 0) / total_min
+        return share * self.total_available_over_quotas(resource)
