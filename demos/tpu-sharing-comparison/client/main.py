@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import threading
+import time
 import urllib.request
 
 from walkai_nos_tpu.health import HealthServer
@@ -54,6 +55,7 @@ def main() -> None:
                 server.metrics.counter_add(
                     "inference_errors_total", 1, {"target": target}
                 )
+                time.sleep(1.0)  # back off while the target is unreachable
 
     for target in args.targets.split(","):
         threading.Thread(target=hammer, args=(target,), daemon=True).start()

@@ -1,0 +1,106 @@
+"""Fluent builders for dict-shaped k8s test objects.
+
+The `pkg/test/factory/core_factory.go:27-229` analogue: composable
+Node/Pod builders so tests read as scenarios, not YAML blobs.
+"""
+
+from __future__ import annotations
+
+from walkai_nos_tpu.api import constants
+
+
+class NodeBuilder:
+    def __init__(self, name: str):
+        self._obj: dict = {
+            "metadata": {"name": name, "labels": {}, "annotations": {}},
+            "status": {"capacity": {}, "allocatable": {}},
+        }
+
+    def with_label(self, key: str, value: str) -> "NodeBuilder":
+        self._obj["metadata"]["labels"][key] = value
+        return self
+
+    def with_annotation(self, key: str, value: str) -> "NodeBuilder":
+        self._obj["metadata"]["annotations"][key] = value
+        return self
+
+    def with_tpu_model(
+        self, accelerator: str = "tpu-v5-lite-podslice", topology: str = "2x4"
+    ) -> "NodeBuilder":
+        return self.with_label(
+            constants.LABEL_TPU_ACCELERATOR, accelerator
+        ).with_label(constants.LABEL_TPU_TOPOLOGY, topology)
+
+    def with_tiling_enabled(self) -> "NodeBuilder":
+        return self.with_label(constants.LABEL_TPU_PARTITIONING, "tiling")
+
+    def with_allocatable(self, resource: str, qty: str) -> "NodeBuilder":
+        self._obj["status"]["allocatable"][resource] = qty
+        self._obj["status"]["capacity"][resource] = qty
+        return self
+
+    def build(self) -> dict:
+        import copy
+
+        return copy.deepcopy(self._obj)
+
+
+class PodBuilder:
+    def __init__(self, name: str, namespace: str = "default"):
+        self._obj: dict = {
+            "metadata": {"name": name, "namespace": namespace, "labels": {}},
+            "spec": {"containers": []},
+            "status": {"phase": "Pending"},
+        }
+
+    def with_container(
+        self, name: str = "main", requests: dict | None = None
+    ) -> "PodBuilder":
+        container: dict = {"name": name}
+        if requests:
+            container["resources"] = {"requests": dict(requests)}
+        self._obj["spec"]["containers"].append(container)
+        return self
+
+    def with_slice_request(self, profile: str, qty: int = 1) -> "PodBuilder":
+        return self.with_container(
+            f"c{len(self._obj['spec']['containers'])}",
+            {constants.RESOURCE_TPU_SLICE_PREFIX + profile: str(qty)},
+        )
+
+    def with_phase(self, phase: str) -> "PodBuilder":
+        self._obj["status"]["phase"] = phase
+        return self
+
+    def scheduled_on(self, node: str) -> "PodBuilder":
+        self._obj["spec"]["nodeName"] = node
+        return self
+
+    def unschedulable(self) -> "PodBuilder":
+        self._obj["status"].setdefault("conditions", []).append(
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "Unschedulable",
+            }
+        )
+        return self
+
+    def preempting(self, node: str = "some-node") -> "PodBuilder":
+        self._obj["status"]["nominatedNodeName"] = node
+        return self
+
+    def owned_by(self, kind: str, name: str = "owner") -> "PodBuilder":
+        self._obj["metadata"].setdefault("ownerReferences", []).append(
+            {"kind": kind, "name": name, "apiVersion": "apps/v1"}
+        )
+        return self
+
+    def with_priority(self, priority: int) -> "PodBuilder":
+        self._obj["spec"]["priority"] = priority
+        return self
+
+    def build(self) -> dict:
+        import copy
+
+        return copy.deepcopy(self._obj)

@@ -164,6 +164,65 @@ class TestFairShareMath:
         )
         assert not decision.allowed and "max exceeded" in decision.reason
 
+    def test_own_unused_min_is_not_borrowable(self):
+        """A(min=10, used=8) + B(min=10, used=10): a 4-chip pod in A must
+        be denied — the only 'available' min is A's own headroom, which
+        this pod itself consumes; admitting it would push cluster usage
+        past total guaranteed quota."""
+        quotas = [_quota("qa", "team-a", 10), _quota("qb", "team-b", 10)]
+        pods = [
+            _pod("a-0", "team-a", 8),
+            _pod("b-0", "team-b", 10),
+        ]
+        plugin = CapacityScheduling(ClusterQuotaState.build(quotas, pods))
+        decision = plugin.pre_filter(_pod("a-1", "team-a", 4, phase="Pending"))
+        assert not decision.allowed and "borrow" in decision.reason
+
+    def test_preemption_ignores_terminal_pods_with_stale_labels(self):
+        state = self._docs_state(40, 40, 0)
+        plugin = CapacityScheduling(state)
+        new_pod = _pod("a-new", "team-a", 10, phase="Pending")
+        stale = _pod(
+            "b-done", "team-b", 10, phase="Succeeded",
+            labels={LABEL_CAPACITY: OVER_QUOTA},
+        )
+        live = _pod(
+            "b-live", "team-b", 10,
+            labels={LABEL_CAPACITY: OVER_QUOTA},
+            created="2026-01-01T00:09:00Z",
+        )
+        victims = plugin.find_preemption_victims(new_pod, [stale, live])
+        assert [objects.name(v) for v in victims] == ["b-live"]
+
+    def test_preemption_is_node_local(self):
+        """Victims spread across nodes free nothing one pod can use —
+        with node context, either one node's victims cover the request or
+        nobody is evicted."""
+        quotas = [_quota("qa", "team-a", 8), _quota("qb", "team-b", 2)]
+        # team-b holds 4 chips over-quota as 2-chip pods on two hosts.
+        pods = [
+            _pod("b-0", "team-b", 2, node="host-a",
+                 labels={LABEL_CAPACITY: OVER_QUOTA}),
+            _pod("b-1", "team-b", 2, node="host-b",
+                 labels={LABEL_CAPACITY: OVER_QUOTA}),
+        ]
+        nodes = [
+            {"metadata": {"name": n}, "status": {"allocatable": {}}}
+            for n in ("host-a", "host-b")
+        ]
+        plugin = CapacityScheduling(ClusterQuotaState.build(quotas, pods))
+        wanting_4 = _pod("a-0", "team-a", 4, phase="Pending")
+        # 4 chips can't be freed on any single node -> no cascade.
+        assert plugin.find_preemption_victims(wanting_4, pods, nodes) == []
+        wanting_2 = {
+            "metadata": {"name": "a-1", "namespace": "team-a"},
+            "spec": {"containers": [{"name": "m", "resources": {
+                "requests": {"google.com/tpu": "2"}}}]},
+            "status": {"phase": "Pending"},
+        }
+        victims = plugin.find_preemption_victims(wanting_2, pods, nodes)
+        assert [objects.name(v) for v in victims] == ["b-0"]
+
     def test_pre_filter_denies_when_nothing_to_borrow(self):
         quotas = [_quota("qa", "team-a", 4), _quota("qb", "team-b", 4)]
         pods = [_pod("a-0", "team-a", 4), _pod("b-0", "team-b", 4)]

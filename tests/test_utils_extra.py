@@ -1,0 +1,155 @@
+"""Batcher windows, pod predicates, checkpoint/resume, factory builders."""
+
+import queue
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tests.factory import NodeBuilder, PodBuilder
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.utils.batcher import Batcher
+
+
+class TestBatcher:
+    def test_idle_window_flushes(self):
+        b = Batcher(timeout=5.0, idle=0.15, buffer_size=10)
+        b.start()
+        try:
+            b.add(1)
+            b.add(2)
+            batch = b.get_batch(timeout=2.0)
+            assert batch == [1, 2]
+        finally:
+            b.stop()
+
+    def test_timeout_window_caps_batch(self):
+        """Items arriving faster than idle: timeout closes the batch
+        (`batcher_test.go:36` timing semantics)."""
+        b = Batcher(timeout=0.4, idle=0.3, buffer_size=100)
+        b.start()
+        try:
+            stop_feeding = time.monotonic() + 1.0
+            fed = 0
+            batch = None
+            while time.monotonic() < stop_feeding:
+                b.add(fed)
+                fed += 1
+                try:
+                    batch = b.get_batch(timeout=0.0)
+                    break
+                except queue.Empty:
+                    time.sleep(0.05)
+            assert batch is not None, "timeout window never flushed"
+            assert 1 <= len(batch) < fed + 1
+        finally:
+            b.stop()
+
+    def test_no_empty_batches(self):
+        b = Batcher(timeout=0.2, idle=0.1)
+        b.start()
+        try:
+            with pytest.raises(queue.Empty):
+                b.get_batch(timeout=0.5)
+        finally:
+            b.stop()
+
+    def test_rejects_bad_windows(self):
+        with pytest.raises(ValueError):
+            Batcher(timeout=0, idle=1)
+
+
+class TestPodPredicates:
+    def test_extra_resources_could_help(self):
+        pod = (
+            PodBuilder("p").with_slice_request("2x2").unschedulable().build()
+        )
+        assert objects.extra_resources_could_help_scheduling(pod)
+
+    @pytest.mark.parametrize(
+        "builder",
+        [
+            lambda p: p.scheduled_on("n1"),
+            lambda p: p.with_phase("Running"),
+            lambda p: p.preempting(),
+            lambda p: p.owned_by("DaemonSet"),
+            lambda p: p.owned_by("Node"),
+        ],
+        ids=["scheduled", "running", "preempting", "daemonset", "static"],
+    )
+    def test_extra_resources_cannot_help(self, builder):
+        pod = builder(
+            PodBuilder("p").with_slice_request("2x2").unschedulable()
+        ).build()
+        assert not objects.extra_resources_could_help_scheduling(pod)
+
+    def test_priority_compare(self):
+        high = PodBuilder("a").with_priority(100).build()
+        low = PodBuilder("b").with_priority(1).build()
+        none = PodBuilder("c").build()
+        assert objects.pod_is_more_important(high, low)
+        assert not objects.pod_is_more_important(none, low)
+
+
+class TestFactory:
+    def test_node_builder(self):
+        node = (
+            NodeBuilder("n1")
+            .with_tpu_model()
+            .with_tiling_enabled()
+            .with_allocatable("walkai.io/tpu-2x2", "2")
+            .build()
+        )
+        assert node["metadata"]["labels"][
+            "cloud.google.com/gke-tpu-accelerator"
+        ] == "tpu-v5-lite-podslice"
+        assert node["status"]["allocatable"]["walkai.io/tpu-2x2"] == "2"
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        from walkai_nos_tpu.models.checkpoint import CheckpointManager
+        from walkai_nos_tpu.models.train import init_train_state, make_train_step
+        from walkai_nos_tpu.models.vit import VIT_TINY
+        from walkai_nos_tpu.parallel.mesh import build_mesh
+
+        cfg = VIT_TINY
+        mesh = build_mesh(jax.devices())
+        state = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, mesh)
+        rng = np.random.default_rng(0)
+        batch = {
+            "images": jax.numpy.asarray(
+                rng.standard_normal((8, cfg.image_size, cfg.image_size, 3)),
+                jax.numpy.float32,
+            ),
+            "labels": jax.numpy.asarray(
+                rng.integers(0, cfg.num_classes, (8, cfg.num_det_tokens))
+            ),
+            "boxes": jax.numpy.asarray(
+                rng.uniform(0, 1, (8, cfg.num_det_tokens, 4)),
+                jax.numpy.float32,
+            ),
+        }
+        state, _ = step(state, batch)
+        state, loss_at_2 = step(state, batch)
+
+        manager = CheckpointManager(tmp_path / "ckpt")
+        assert manager.save(state, force=True)
+        assert manager.latest_step() == 2
+
+        template = init_train_state(cfg, mesh, jax.random.PRNGKey(1))
+        restored = manager.restore(template)
+        manager.close()
+        assert restored is not None
+        assert int(restored.step) == 2
+        qkv_a = np.asarray(state.params["block0"]["attn"]["qkv"]["kernel"])
+        qkv_b = np.asarray(restored.params["block0"]["attn"]["qkv"]["kernel"])
+        np.testing.assert_array_equal(qkv_a, qkv_b)
+        # resumed training continues from the same loss trajectory
+        _, loss_resumed = step(restored, batch)
+        state, loss_orig = step(state, batch)
+        np.testing.assert_allclose(
+            float(loss_resumed), float(loss_orig), rtol=1e-5
+        )

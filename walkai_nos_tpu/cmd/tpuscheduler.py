@@ -94,8 +94,9 @@ class Scheduler:
                 return Result()
 
         # Physically unschedulable (PostFilter): fair-sharing preemption of
-        # over-quota pods elsewhere (`key-concepts.md:31-40`).
-        victims = plugin.find_preemption_victims(pod, pods)
+        # over-quota pods elsewhere (`key-concepts.md:31-40`), chosen
+        # node-locally so the freed chips are actually usable.
+        victims = plugin.find_preemption_victims(pod, pods, nodes)
         for victim in victims:
             logger.info(
                 "preempting over-quota pod %s/%s for %s/%s",
@@ -129,7 +130,7 @@ class QuotaStatusUpdater:
         )
         for quota in state.quotas:
             kind = "CompositeElasticQuota" if quota.composite else "ElasticQuota"
-            namespace = None if quota.composite else quota.namespaces[0]
+            namespace = quota.object_namespace
             try:
                 current = self._kube.get(kind, quota.name, namespace)
             except ApiError:
@@ -137,7 +138,9 @@ class QuotaStatusUpdater:
             used = {k: str(v) for k, v in sorted(quota.used.items())}
             if ((current.get("status") or {}).get("used") or {}) != used:
                 try:
-                    self._kube.patch(
+                    # Status subresource-aware: a main-resource patch would
+                    # be silently dropped by real API servers.
+                    self._kube.patch_status(
                         kind, quota.name, {"status": {"used": used}}, namespace
                     )
                 except ApiError:

@@ -71,13 +71,12 @@ class PodController:
     # --------------------------------------------------------------- helpers
 
     def _should_consider_pod(self, pod: dict) -> bool:
-        """pending ∧ not scheduled ∧ unschedulable
-        (`mig_controller.go:100-111` -> `pkg/util/pod/pod.go:38-55`)."""
-        return (
-            objects.pod_is_pending(pod)
-            and not objects.pod_is_scheduled(pod)
-            and objects.pod_is_unschedulable(pod)
-        )
+        """Re-tiling only helps pods that new slice resources could
+        schedule (`mig_controller.go:100-111` ->
+        `ExtraResourcesCouldHelpScheduling`, `pkg/util/pod/pod.go:28-35`):
+        pending + unschedulable, not mid-preemption, and not node-bound by
+        ownership (DaemonSet/static pods follow their node, not resources)."""
+        return objects.extra_resources_could_help_scheduling(pod)
 
     def _list_tiling_nodes(self) -> list[dict]:
         return self._kube.list(

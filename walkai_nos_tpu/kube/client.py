@@ -74,6 +74,19 @@ class KubeClient(abc.ABC):
     @abc.abstractmethod
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None: ...
 
+    def patch_status(
+        self,
+        kind: str,
+        name: str,
+        patch: dict,
+        namespace: str | None = None,
+    ) -> dict:
+        """Patch an object's status. Real API servers route this through
+        the /status subresource when the CRD enables it (overridden in
+        RestKubeClient) — a main-resource write would silently drop status
+        changes there. Fakes store status inline, so default to patch."""
+        return self.patch(kind, name, patch, namespace)
+
     def bind_pod(self, name: str, namespace: str, node_name: str) -> None:
         """Assign a pod to a node. Real API servers use the pods/binding
         subresource (overridden in RestKubeClient); the default mutates

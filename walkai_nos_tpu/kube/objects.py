@@ -115,3 +115,36 @@ def pod_is_unschedulable(pod: Mapping) -> bool:
 
 def pod_is_owned_by_daemonset(pod: Mapping) -> bool:
     return is_owned_by_kind(pod, "DaemonSet")
+
+
+def pod_is_owned_by_node(pod: Mapping) -> bool:
+    """Static/mirror pods (`pod.go:66-72`)."""
+    return is_owned_by_kind(pod, "Node")
+
+
+def pod_is_preempting(pod: Mapping) -> bool:
+    """A nominated node means preemption is in flight (`pod.go:45-47`)."""
+    return bool((pod.get("status") or {}).get("nominatedNodeName"))
+
+
+def pod_priority(pod: Mapping) -> int:
+    return int((pod.get("spec") or {}).get("priority") or 0)
+
+
+def pod_is_more_important(p1: Mapping, p2: Mapping) -> bool:
+    """Priority compare (`pod.go:82-88` `IsMoreImportant`)."""
+    return pod_priority(p1) > pod_priority(p2)
+
+
+def extra_resources_could_help_scheduling(pod: Mapping) -> bool:
+    """Would creating new slice resources let this pod schedule?
+    (`pod.go:28-35`): pending, unschedulable, not already scheduled,
+    not preempting, and not node-bound by ownership (DaemonSet/static)."""
+    return (
+        not pod_is_scheduled(pod)
+        and pod_is_pending(pod)
+        and pod_is_unschedulable(pod)
+        and not pod_is_preempting(pod)
+        and not pod_is_owned_by_daemonset(pod)
+        and not pod_is_owned_by_node(pod)
+    )

@@ -282,6 +282,20 @@ class RestKubeClient(KubeClient):
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
         self._request("DELETE", self._path(kind, namespace, name))
 
+    def patch_status(
+        self,
+        kind: str,
+        name: str,
+        patch: dict,
+        namespace: str | None = None,
+    ) -> dict:
+        return self._request(
+            "PATCH",
+            self._path(kind, namespace, name) + "/status",
+            body=patch,
+            content_type="application/merge-patch+json",
+        )
+
     def bind_pod(self, name: str, namespace: str, node_name: str) -> None:
         """pods/binding subresource — how real schedulers assign nodes."""
         self._request(

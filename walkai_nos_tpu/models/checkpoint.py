@@ -1,0 +1,103 @@
+"""Checkpoint/resume for training state (orbax-backed).
+
+The control plane's checkpointing is the Node object (SURVEY.md §5.4);
+this is the compute-side counterpart: crash-safe TrainState save/restore
+so a training pod rescheduled onto a re-tiled slice resumes where it
+stopped. Orbax handles atomicity (tmp dir + rename) and sharded arrays —
+on restore, params land back on the caller's mesh per their shardings.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from walkai_nos_tpu.models.train import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+        self._manager = ocp.CheckpointManager(
+            Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, state: TrainState, *, force: bool = False) -> bool:
+        step = int(state.step)
+        saved = self._manager.save(
+            step,
+            args=ocp.args.StandardSave(
+                {"params": state.params, "opt_state": state.opt_state,
+                 "step": np.asarray(step)}
+            ),
+            force=force,
+        )
+        self._manager.wait_until_finished()
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def restore(self, template: TrainState) -> TrainState | None:
+        """Restore the newest checkpoint shaped/sharded like `template`
+        (a freshly-initialized TrainState on the target mesh)."""
+        step = self._manager.latest_step()
+        if step is None:
+            return None
+        target = {
+            "params": template.params,
+            "opt_state": template.opt_state,
+            "step": np.asarray(int(template.step)),
+        }
+        restored = self._manager.restore(
+            step,
+            args=ocp.args.StandardRestore(target),
+        )
+        restored = self._replace_on_mesh(restored, template)
+        return TrainState(
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            step=jax.numpy.asarray(int(restored["step"])),
+        )
+
+    @staticmethod
+    def _replace_on_mesh(restored, template: TrainState):
+        """Restored arrays come back *committed* to whatever devices orbax
+        chose; a template scalar created eagerly is uncommitted, so its
+        restored twin would be pinned to one device and clash with
+        mesh-sharded params inside jit. Re-place every leaf: template
+        NamedShardings are honored, everything else replicates over the
+        template's mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = None
+        for leaf in jax.tree_util.tree_leaves(template.params):
+            sharding = getattr(leaf, "sharding", None)
+            if isinstance(sharding, NamedSharding):
+                mesh = sharding.mesh
+                break
+
+        def place(value, tmpl):
+            sharding = getattr(tmpl, "sharding", None)
+            if isinstance(sharding, NamedSharding):
+                return jax.device_put(value, sharding)
+            if mesh is not None:
+                return jax.device_put(
+                    value, NamedSharding(mesh, PartitionSpec())
+                )
+            return value
+
+        target_tmpl = {
+            "params": template.params,
+            "opt_state": template.opt_state,
+            "step": np.asarray(int(template.step)),
+        }
+        return jax.tree_util.tree_map(place, restored, target_tmpl)
+
+    def close(self) -> None:
+        self._manager.close()

@@ -57,6 +57,8 @@ def init_train_state(
     params = model.init_params(rng)
     params = shardlib.shard_params(params, mesh)
     tx = make_optimizer(lr)
+    # Eager init: moments follow params' shardings, scalars stay
+    # *uncommitted* so the first jitted step may place them freely.
     opt_state = tx.init(params)
     return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 

@@ -75,6 +75,22 @@ def extract_shared_profile_chips(resource_name: str) -> int:
     ).chip_count()
 
 
+def resources_chip_count(resources: Mapping[str, int]) -> int:
+    """Total chips represented by a resource map (negative counts clamp)."""
+    chips = 0
+    for name, qty in resources.items():
+        if qty <= 0:
+            continue
+        if is_slice_resource(name):
+            shape = topology.parse_shape(extract_profile_name(name))
+            chips += topology.shape_chip_count(shape) * qty
+        elif is_shared_resource(name):
+            chips += extract_shared_profile_chips(name) * qty
+        elif name == constants.RESOURCE_TPU:
+            chips += qty
+    return chips
+
+
 def pod_tpu_chips(pod: Mapping) -> int:
     """Total TPU chips a pod requests, scheduler pod-request style
     (max(init, sum(containers)) — `pkg/resource/resource.go:107-146`)."""

@@ -62,10 +62,11 @@ class CapacityScheduling:
         }
         if all(v == 0 for v in over.values()):
             return Decision(True, "fits within min")
-        # Borrowing: the borrowed amount must exist as unused min elsewhere.
+        # Borrowing: the borrowed amount must exist as unused min of OTHER
+        # quotas (own headroom isn't a loan).
         for resource, borrowed in over.items():
             prior = quota.over_quota_usage(resource)
-            available = self._state.total_available_over_quotas(resource)
+            available = self._state.lendable_over_quotas(quota, resource)
             if borrowed - prior > available:
                 return Decision(
                     False,
@@ -76,13 +77,24 @@ class CapacityScheduling:
 
     # ------------------------------------------------------------- postfilter
 
-    def find_preemption_victims(self, pod: dict, pods: list[dict]) -> list[dict]:
+    def find_preemption_victims(
+        self,
+        pod: dict,
+        pods: list[dict],
+        nodes: list[dict] | None = None,
+    ) -> list[dict]:
         """Victims whose eviction lets `pod` schedule, fair-sharing rules.
 
-        Candidates are over-quota pods of OTHER quotas, considered only
-        while their quota's over-quota usage exceeds its guaranteed share;
-        newest-first so older over-quota pods survive longer.
+        Candidates are scheduled, non-terminal over-quota pods of OTHER
+        quotas, considered only while their quota's over-quota usage
+        exceeds its guaranteed share; newest-first so older over-quota
+        pods survive longer. With `nodes`, victims come from ONE node
+        whose (free + freed) chips cover the request -- evicting the same
+        chip count spread across hosts frees nothing a single pod (or the
+        partitioner's retile) can use.
         """
+        from walkai_nos_tpu.quota.state import pod_holds_quota
+
         namespace = objects.namespace(pod) or "default"
         quota = self._state.for_namespace(namespace)
         if quota is None:
@@ -98,12 +110,6 @@ class CapacityScheduling:
             > quota.min.get(RESOURCE, 0) + guaranteed
         ):
             return []
-
-        # Preemption frees *physical* capacity: quota headroom ("available
-        # over-quotas") is an accounting construct — the chips may well be
-        # occupied by other namespaces' over-quota pods. Free enough of
-        # their usage to place this pod.
-        needed = request
 
         # Over-quota usage per quota, to enforce condition 3 as we go.
         over_usage = {
@@ -122,6 +128,10 @@ class CapacityScheduling:
                 continue
             if objects.labels(p).get(LABEL_CAPACITY) != OVER_QUOTA:
                 continue
+            # A terminal or unscheduled pod holds no chips -- evicting it
+            # frees nothing (its capacity label may simply be stale).
+            if not pod_holds_quota(p):
+                continue
             candidates.append((p, victim_quota))
         # Newest first: LIFO eviction preserves older workloads.
         candidates.sort(
@@ -131,6 +141,39 @@ class CapacityScheduling:
             reverse=True,
         )
 
+        if nodes is None:
+            return self._select_victims(
+                candidates, request, dict(over_usage), guaranteed_by_name
+            )
+
+        # Per-node: free the chips where they can actually be used.
+        from walkai_nos_tpu.quota.fit import node_free_resources
+        from walkai_nos_tpu.quota.resources import resources_chip_count
+
+        by_node: dict[str, list] = {}
+        for p, vq in candidates:
+            node_name = (p.get("spec") or {}).get("nodeName")
+            by_node.setdefault(node_name, []).append((p, vq))
+        for node in sorted(nodes, key=objects.name):
+            node_name = objects.name(node)
+            node_candidates = by_node.get(node_name)
+            if not node_candidates:
+                continue
+            free_chips = resources_chip_count(
+                node_free_resources(node, pods)
+            )
+            needed = max(0, request - free_chips)
+            if needed == 0:
+                continue  # this node already fits; no eviction warranted
+            victims = self._select_victims(
+                node_candidates, needed, dict(over_usage), guaranteed_by_name
+            )
+            if victims:
+                return victims
+        return []
+
+    @staticmethod
+    def _select_victims(candidates, needed, over_usage, guaranteed_by_name):
         victims = []
         freed = 0
         for p, victim_quota in candidates:

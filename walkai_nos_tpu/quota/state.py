@@ -57,16 +57,20 @@ class QuotaInfo:
     max: Resources | None  # None = unlimited (`Max: None` in the spec table)
     used: Resources = field(default_factory=dict)
     composite: bool = False
+    # Where the quota object itself lives (a composite governs OTHER
+    # namespaces but is stored in its own).
+    object_namespace: str = "default"
 
     @staticmethod
     def from_object(obj: Mapping) -> "QuotaInfo":
         spec = obj.get("spec") or {}
         kind = obj.get("kind") or "ElasticQuota"
         composite = kind == "CompositeElasticQuota"
+        own_ns = objects.namespace(obj) or "default"
         if composite:
             namespaces = tuple(spec.get("namespaces") or [])
         else:
-            namespaces = (objects.namespace(obj) or "default",)
+            namespaces = (own_ns,)
         raw_max = spec.get("max")
         return QuotaInfo(
             name=objects.name(obj),
@@ -74,6 +78,7 @@ class QuotaInfo:
             min=_parse_resources(spec.get("min")),
             max=_parse_resources(raw_max) if raw_max else None,
             composite=composite,
+            object_namespace=own_ns,
         )
 
     def over_quota_usage(self, resource: str) -> int:
@@ -118,6 +123,17 @@ class ClusterQuotaState:
         return sum(
             max(0, q.min.get(resource, 0) - q.used.get(resource, 0))
             for q in self.quotas
+        )
+
+    def lendable_over_quotas(self, borrower: QuotaInfo, resource: str) -> int:
+        """Unused min of OTHER quotas — what `borrower` may actually
+        borrow. Its own unused min is headroom within min, not a loan
+        (counting it would admit borrowing beyond the cluster's total
+        guaranteed quota)."""
+        return sum(
+            max(0, q.min.get(resource, 0) - q.used.get(resource, 0))
+            for q in self.quotas
+            if q.name != borrower.name
         )
 
     def guaranteed_over_quota(self, quota: QuotaInfo, resource: str) -> float:

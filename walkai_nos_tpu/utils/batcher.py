@@ -1,0 +1,92 @@
+"""Generic batching with timeout + idle windows.
+
+Port of `pkg/util/batcher.go:25-130` (orphaned in the reference fork —
+upstream used it to batch pending pods before planning; kept here for the
+same optional use). Semantics: the first item opens a batch and starts the
+*timeout* window; each item restarts the *idle* window; the batch is
+emitted when either window elapses, and an empty idle-window fire emits
+nothing.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class Batcher(Generic[T]):
+    def __init__(
+        self, timeout: float, idle: float, buffer_size: int = 0
+    ) -> None:
+        if timeout <= 0 or idle <= 0:
+            raise ValueError("timeout and idle must be > 0")
+        self._timeout = timeout
+        self._idle = idle
+        self._trigger: "queue.Queue[T]" = queue.Queue(maxsize=buffer_size)
+        # Unbounded: a bounded output queue would wedge the worker inside
+        # a blocking put when the consumer lags, making stop() time out.
+        self._batches: "queue.Queue[list[T]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------ input
+
+    def add(self, item: T, timeout: float | None = None) -> None:
+        """Blocks while a bounded trigger buffer is full (unbuffered
+        Batcher = rendezvous, like the reference's unbuffered channel)."""
+        self._trigger.put(item, timeout=timeout)
+
+    # ----------------------------------------------------------------- output
+
+    def get_batch(self, timeout: float | None = None) -> list[T]:
+        """Next non-empty batch; raises queue.Empty on timeout."""
+        return self._batches.get(timeout=timeout)
+
+    # -------------------------------------------------------------- lifecycle
+
+    def _run(self) -> None:
+        batch: list[T] = []
+        deadline: float | None = None  # timeout-window end
+        import time
+
+        while not self._stop.is_set():
+            if not batch:
+                # Wait for the first item; it opens both windows.
+                try:
+                    batch.append(self._trigger.get(timeout=0.1))
+                except queue.Empty:
+                    continue
+                deadline = time.monotonic() + self._timeout
+                continue
+            now = time.monotonic()
+            wait = min(self._idle, max(deadline - now, 0.0))
+            try:
+                batch.append(self._trigger.get(timeout=wait))
+                # Idle window restarts on every item; timeout window doesn't.
+                if time.monotonic() >= deadline:
+                    self._emit(batch)
+                    batch, deadline = [], None
+            except queue.Empty:
+                self._emit(batch)
+                batch, deadline = [], None
+        if batch:
+            self._emit(batch)
+
+    def _emit(self, batch: list[T]) -> None:
+        if batch:
+            self._batches.put(list(batch))
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="batcher"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
