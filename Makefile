@@ -1,0 +1,41 @@
+# walkai-nos TPU-native — build/test/deploy entry points
+# (reference: Makefile with test/docker-build/deploy targets).
+
+IMG ?= ghcr.io/walkai/nos-tpu:latest
+KIND_CLUSTER ?= walkai-nos
+
+.PHONY: all test native bench dryrun docker-build kind-cluster deploy undeploy clean
+
+all: native test
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native/tpudev
+
+bench: native
+	python bench.py
+
+dryrun:
+	python __graft_entry__.py
+
+docker-build:
+	docker build -f build/Dockerfile -t $(IMG) .
+
+# Local e2e flow (reference: Makefile:115-117 + hack/kind/cluster.yaml).
+kind-cluster:
+	kind create cluster --name $(KIND_CLUSTER) --config hack/kind/cluster.yaml
+
+deploy:
+	kubectl apply -f deploy/crds/ -f deploy/common/ \
+	    -f deploy/tpupartitioner/ -f deploy/tpuagent/ \
+	    -f deploy/tpuscheduler/ -f deploy/clusterinfoexporter/
+
+undeploy:
+	kubectl delete -f deploy/clusterinfoexporter/ -f deploy/tpuscheduler/ \
+	    -f deploy/tpuagent/ -f deploy/tpupartitioner/ -f deploy/common/ \
+	    -f deploy/crds/ --ignore-not-found
+
+clean:
+	$(MAKE) -C native/tpudev clean

@@ -1,0 +1,9 @@
+{{- define "walkai-nos.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
+
+{{- define "walkai-nos.labels" -}}
+app.kubernetes.io/part-of: walkai-nos-tpu
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+helm.sh/chart: {{ .Chart.Name }}-{{ .Chart.Version }}
+{{- end -}}

@@ -1,0 +1,76 @@
+"""Decoder LM: forward shapes, training, sequence-parallel ring path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from walkai_nos_tpu.models.lm import (
+    LM_TINY,
+    DecoderLM,
+    LMConfig,
+    init_lm_state,
+    make_lm_train_step,
+)
+from walkai_nos_tpu.parallel.mesh import MeshAxes, build_mesh
+
+
+def _tokens(cfg, b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (b, cfg.max_seq_len)), jnp.int32
+    )
+
+
+class TestDecoderLM:
+    def test_forward_shapes(self):
+        cfg = LM_TINY
+        model = DecoderLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        logits = model.apply({"params": params}, _tokens(cfg, b=2))
+        assert logits.shape == (2, cfg.max_seq_len, cfg.vocab_size)
+
+    def test_causality(self):
+        """Future tokens must not affect earlier logits."""
+        cfg = LM_TINY
+        model = DecoderLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        toks = _tokens(cfg, b=1)
+        logits_a = model.apply({"params": params}, toks)
+        toks_b = toks.at[0, -1].set((int(toks[0, -1]) + 1) % cfg.vocab_size)
+        logits_b = model.apply({"params": params}, toks_b)
+        np.testing.assert_allclose(
+            np.asarray(logits_a[0, :-1]),
+            np.asarray(logits_b[0, :-1]),
+            atol=1e-5,
+        )
+        assert not np.allclose(
+            np.asarray(logits_a[0, -1]), np.asarray(logits_b[0, -1])
+        )
+
+    def test_train_step_decreases_loss_on_mesh(self):
+        cfg = LM_TINY
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(cfg, mesh, jax.random.PRNGKey(0), lr=1e-2)
+        step = make_lm_train_step(cfg, mesh, lr=1e-2)
+        toks = _tokens(cfg)
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, toks)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_ring_attention_path_matches_local(self):
+        """Sequence-parallel (ring) training loss == local-kernel loss."""
+        cfg_local = LM_TINY
+        cfg_ring = LMConfig(**{**cfg_local.__dict__, "use_ring_attention": True})
+        mesh_ring = build_mesh(jax.devices(), axes=MeshAxes(data=2, seq=4))
+        mesh_local = build_mesh(jax.devices(), axes=MeshAxes(data=2, model=4))
+
+        state_l = init_lm_state(cfg_local, mesh_local, jax.random.PRNGKey(0))
+        state_r = init_lm_state(cfg_ring, mesh_ring, jax.random.PRNGKey(0))
+        toks = _tokens(cfg_local)
+        _, loss_l = make_lm_train_step(cfg_local, mesh_local)(state_l, toks)
+        _, loss_r = make_lm_train_step(cfg_ring, mesh_ring)(state_r, toks)
+        np.testing.assert_allclose(
+            float(loss_l), float(loss_r), rtol=2e-4
+        )

@@ -35,7 +35,11 @@ def build_kube_client():
 
 def start_health(addr: str):
     from walkai_nos_tpu.health import HealthServer
+    from walkai_nos_tpu.kube import runtime
 
     server = HealthServer(addr)
     server.start()
+    # Controller reconcile metrics flow to this binary's /metrics endpoint
+    # (the controller-runtime built-in registry analogue).
+    runtime.set_metrics_registry(server.metrics)
     return server

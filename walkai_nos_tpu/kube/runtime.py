@@ -25,6 +25,30 @@ from walkai_nos_tpu.kube.predicates import Predicate
 
 logger = logging.getLogger(__name__)
 
+# Process-global controller metrics, served by the binaries' /metrics
+# endpoint — the analogue of controller-runtime's built-in Prometheus
+# registry (reconcile totals/errors/duration; SURVEY.md §5.5).
+_metrics = None
+
+
+def set_metrics_registry(metrics) -> None:
+    global _metrics
+    _metrics = metrics
+
+
+def _record_reconcile(controller: str, outcome: str, seconds: float) -> None:
+    if _metrics is None:
+        return
+    labels = {"controller": controller, "result": outcome}
+    _metrics.counter_add(
+        "nos_reconcile_total", 1, labels,
+        help_text="Reconciliations per controller and outcome",
+    )
+    _metrics.counter_add(
+        "nos_reconcile_seconds_sum", seconds, {"controller": controller},
+        help_text="Cumulative reconcile wall time",
+    )
+
 
 @dataclass(frozen=True)
 class Request:
@@ -191,14 +215,21 @@ class Controller:
             req = self.queue.get()
             if req is None:
                 continue
+            started = time.monotonic()
             try:
                 result = self.reconciler(req)
                 self._failures.pop(req, None)
+                _record_reconcile(
+                    self.name, "success", time.monotonic() - started
+                )
                 if result and result.requeue_after is not None:
                     self.queue.add_after(req, result.requeue_after)
                 elif result and result.requeue:
                     self.queue.add(req)
             except Exception:
+                _record_reconcile(
+                    self.name, "error", time.monotonic() - started
+                )
                 n = self._failures.get(req, 0) + 1
                 self._failures[req] = n
                 delay = min(_BACKOFF_BASE * (2 ** (n - 1)), _BACKOFF_MAX)

@@ -1,0 +1,163 @@
+"""Decoder-only language model: the long-context flagship.
+
+Second model family beside the detector (`vit.py`): a GPT-style causal
+transformer built on the same TPU-first pieces — bf16 matmuls with f32
+accumulation, the fused causal flash-attention kernel on TPU, and
+optional **ring attention** (`walkai_nos_tpu/ops/ring_attention.py`) so
+the sequence axis shards across the mesh's `seq` ring for contexts that
+don't fit one chip. Param names line up with the tensor-parallel rules in
+`walkai_nos_tpu/parallel/sharding.py` (qkv/out_proj, fc1/fc2).
+
+No reference analogue — the reference is a control plane; this is a
+workload its slices serve, first-class per the TPU mandate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from walkai_nos_tpu.ops.attention import flash_attention
+from walkai_nos_tpu.ops.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    vocab_size: int = 32000
+    hidden_dim: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    dtype: str = "bfloat16"
+    # Sequence parallelism: shard the sequence over the mesh's `seq` axis
+    # and run ring attention instead of the local kernel.
+    use_ring_attention: bool = False
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+LM_TINY = LMConfig(
+    vocab_size=256, hidden_dim=128, num_layers=2, num_heads=4,
+    max_seq_len=128,
+)
+LM_SMALL = LMConfig()
+
+
+class CausalAttention(nn.Module):
+    cfg: LMConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        d = c.hidden_dim
+        head_dim = d // c.num_heads
+        qkv = nn.Dense(3 * d, dtype=c.compute_dtype, name="qkv")(x)
+        qkv = qkv.reshape(x.shape[0], x.shape[1], 3, c.num_heads, head_dim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        if c.use_ring_attention and self.mesh is not None:
+            o = ring_attention(q, k, v, self.mesh, causal=True)
+        else:
+            o = flash_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
+        return nn.Dense(d, dtype=c.compute_dtype, name="out_proj")(o)
+
+
+class DecoderBlock(nn.Module):
+    cfg: LMConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        x = x + CausalAttention(c, self.mesh, name="attn")(
+            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
+        )
+        h = nn.Dense(c.mlp_ratio * c.hidden_dim, dtype=c.compute_dtype,
+                     name="fc1")(
+            nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
+        )
+        h = nn.gelu(h)
+        x = x + nn.Dense(c.hidden_dim, dtype=c.compute_dtype, name="fc2")(h)
+        return x
+
+
+class DecoderLM(nn.Module):
+    cfg: LMConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        """tokens: [batch, seq] int32 -> logits [batch, seq, vocab]."""
+        c = self.cfg
+        x = nn.Embed(
+            c.vocab_size, c.hidden_dim,
+            dtype=c.compute_dtype, name="embed",
+        )(tokens)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, c.max_seq_len, c.hidden_dim),
+        )
+        x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
+        for i in range(c.num_layers):
+            x = DecoderBlock(c, self.mesh, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
+        return nn.Dense(c.vocab_size, dtype=jnp.float32, name="head")(x)
+
+    def init_params(self, rng: jax.Array):
+        dummy = jnp.zeros((1, self.cfg.max_seq_len), jnp.int32)
+        return self.init(rng, dummy)["params"]
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy (shift by one)."""
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]
+    ).mean()
+
+
+def make_lm_train_step(cfg: LMConfig, mesh: Mesh, *, lr: float = 3e-4):
+    """Jitted `(state, tokens) -> (state, loss)` over the mesh, using the
+    shared TrainState/sharding machinery."""
+    import optax
+
+    from walkai_nos_tpu.models.train import TrainState, make_optimizer
+    from walkai_nos_tpu.parallel import sharding as shardlib
+
+    model = DecoderLM(cfg, mesh)
+    tx = make_optimizer(lr)
+
+    def step(state: TrainState, tokens) -> tuple[TrainState, jax.Array]:
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens)
+            return lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    seq_axis = 1 if cfg.use_ring_attention else None
+    tokens_sharding = shardlib.batch_sharding(mesh, seq_axis=seq_axis)
+    return jax.jit(
+        step, in_shardings=(None, tokens_sharding), donate_argnums=(0,)
+    )
+
+
+def init_lm_state(cfg: LMConfig, mesh: Mesh, rng: jax.Array, *, lr: float = 3e-4):
+    from walkai_nos_tpu.models.train import TrainState, make_optimizer
+    from walkai_nos_tpu.parallel import sharding as shardlib
+
+    model = DecoderLM(cfg, mesh)
+    params = shardlib.shard_params(model.init_params(rng), mesh)
+    tx = make_optimizer(lr)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
