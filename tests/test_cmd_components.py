@@ -3,6 +3,7 @@ collector, sharing client, metricsexporter payload."""
 
 import json
 import urllib.request
+from datetime import datetime, timezone
 
 import pytest
 
@@ -115,6 +116,30 @@ class TestLeaderElection:
         a.stop()
         assert b.wait_for_leadership(3.0)  # lease expires, b takes over
         b.stop()
+
+    def test_renew_time_without_fractional_seconds_respected(self):
+        """A renewTime serialized without '.%f' (another client's lease)
+        must not parse as 'expired' and get stolen."""
+        kube = FakeKubeClient()
+        kube.create(
+            "Lease",
+            {
+                "metadata": {"name": "foreign-lease", "namespace": "walkai-nos"},
+                "spec": {
+                    "holderIdentity": "someone-else",
+                    "leaseDurationSeconds": 3600,
+                    "renewTime": datetime.now(timezone.utc).strftime(
+                        "%Y-%m-%dT%H:%M:%SZ"
+                    ),
+                },
+            },
+            "walkai-nos",
+        )
+        thief = LeaderElector(
+            kube, "foreign-lease", identity="thief",
+            lease_duration=0.4, renew_interval=0.05,
+        )
+        assert thief._try_acquire_or_renew() is False
 
 
 def _node(name, accelerator="tpu-v5-lite-podslice", annotations=None,

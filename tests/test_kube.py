@@ -88,7 +88,7 @@ class TestWatch:
         def consume():
             for ev in c.watch("Node", stop=stop.is_set):
                 events.append(ev)
-                if len(events) >= 3:
+                if len(events) >= 4:
                     return
 
         t = threading.Thread(target=consume, daemon=True)
@@ -99,7 +99,7 @@ class TestWatch:
         t.join(timeout=2)
         stop.set()
         kinds = [e[0] for e in events]
-        assert kinds == ["ADDED", "MODIFIED", "DELETED"]
+        assert kinds == ["ADDED", "SYNCED", "MODIFIED", "DELETED"]
 
 
 class TestPredicates:
@@ -171,6 +171,50 @@ class TestController:
         try:
             time.sleep(0.5)
             assert count[0] >= 3
+        finally:
+            ctrl.stop()
+
+    def test_watch_restart_prunes_deleted_objects(self):
+        """Objects deleted while no watch stream is up must still produce a
+        DELETED reconcile (with last-seen content, so label predicates
+        match) when the watch is re-established — the cache must not retain
+        them forever."""
+
+        class _OneShotWatch(FakeKubeClient):
+            def watch(self, kind, namespace=None, stop=None):
+                # Stream dies after the initial snapshot: deletions in the
+                # gap are only observable via the SYNCED-marker prune on
+                # the next stream.
+                for obj in self.list(kind, namespace):
+                    yield ("ADDED", obj)
+                yield ("SYNCED", {})
+                time.sleep(0.05)
+
+        c = _OneShotWatch()
+        c.create("Node", node("n1", labels={"role": "tpu"}))
+        deleted = threading.Event()
+
+        def labeled(event, obj, old):
+            return (objects.labels(obj)).get("role") == "tpu"
+
+        def reconcile(req: Request) -> Result:
+            try:
+                c.get("Node", req.name)
+            except NotFound:
+                deleted.set()
+            return Result()
+
+        ctrl = Controller("t", c, "Node", reconcile, predicates=[labeled])
+        ctrl.start()
+        try:
+            time.sleep(0.2)  # first stream consumed the backlog and died
+            # Remove without a watch event reaching the controller.
+            FakeKubeClient.delete(c, "Node", "n1")
+            assert deleted.wait(timeout=3)
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and ctrl._cache:
+                time.sleep(0.01)
+            assert not ctrl._cache
         finally:
             ctrl.stop()
 

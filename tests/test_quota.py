@@ -178,6 +178,33 @@ class TestFairShareMath:
         decision = plugin.pre_filter(_pod("a-1", "team-a", 4, phase="Pending"))
         assert not decision.allowed and "borrow" in decision.reason
 
+    def test_cumulative_borrowing_is_bounded(self):
+        """A quota with no max cannot admit pod after pod past the lendable
+        pool: TOTAL over-quota holding is compared, not the marginal
+        borrow."""
+        quotas = [_quota("qa", "team-a", 2), _quota("qb", "team-b", 4)]
+        # team-a already borrowed all 4 of team-b's unused min.
+        pods = [_pod("a-0", "team-a", 6)]
+        plugin = CapacityScheduling(ClusterQuotaState.build(quotas, pods))
+        decision = plugin.pre_filter(_pod("a-1", "team-a", 2, phase="Pending"))
+        assert not decision.allowed and "borrow" in decision.reason
+
+    def test_two_borrowers_cannot_share_the_same_lender_slack(self):
+        """team-b's 4 unused chips can back only 4 borrowed chips total:
+        once team-c borrowed them, team-a may not borrow them again."""
+        quotas = [
+            _quota("qa", "team-a", 2),
+            _quota("qb", "team-b", 4),
+            _quota("qc", "team-c", 2),
+        ]
+        pods = [
+            _pod("a-0", "team-a", 2),  # at min
+            _pod("c-0", "team-c", 6),  # borrowing all 4 of team-b's slack
+        ]
+        plugin = CapacityScheduling(ClusterQuotaState.build(quotas, pods))
+        decision = plugin.pre_filter(_pod("a-1", "team-a", 2, phase="Pending"))
+        assert not decision.allowed and "borrow" in decision.reason
+
     def test_preemption_ignores_terminal_pods_with_stale_labels(self):
         state = self._docs_state(40, 40, 0)
         plugin = CapacityScheduling(state)

@@ -13,8 +13,9 @@ from urllib.parse import parse_qs, urlparse
 
 import pytest
 
-from walkai_nos_tpu.kube.client import NotFound
+from walkai_nos_tpu.kube.client import ApiError, NotFound
 from walkai_nos_tpu.kube.rest import RestKubeClient
+from walkai_nos_tpu.kube.runtime import Controller, Request, Result
 
 
 class _MiniApiServer:
@@ -246,6 +247,114 @@ class TestRestKubeClient:
         with pytest.raises(NotFound):
             client.get("Pod", "p1", "default")
 
+    def test_list_all_namespaces_uses_cluster_path(self, api):
+        """namespace=None on a namespaced kind must list ALL namespaces
+        (the KubeClient contract) — not silently only 'default'."""
+        url, _ = api
+        client = RestKubeClient(server=url)
+        client.create("Pod", {"metadata": {"name": "p1", "namespace": "ml"}})
+        client.create(
+            "Pod", {"metadata": {"name": "p2", "namespace": "default"}}
+        )
+        names = {o["metadata"]["name"] for o in client.list("Pod")}
+        assert names == {"p1", "p2"}
+        # Single-object addressing still defaults to the default namespace.
+        assert client.get("Pod", "p2")["metadata"]["namespace"] == "default"
+
+    def test_watch_all_namespaces(self, api):
+        url, _ = api
+        client = RestKubeClient(server=url)
+        client.create("Pod", {"metadata": {"name": "p1", "namespace": "ml"}})
+        client.create("Pod", {"metadata": {"name": "p2", "namespace": "ops"}})
+        done = threading.Event()
+        seen = []
+        for etype, obj in client.watch("Pod", stop=done.is_set):
+            seen.append(obj["metadata"]["name"])
+            if len(seen) >= 2:
+                done.set()
+                break
+        assert set(seen) == {"p1", "p2"}
+
+    @staticmethod
+    def _make_flaky(client, on_outage):
+        """Patch client._watch_once to fail once, running `on_outage`
+        during the simulated stream outage."""
+        orig = client._watch_once
+        failed = []
+
+        def flaky(kind, namespace, rv_box, stop):
+            if not failed:
+                failed.append(True)
+                on_outage()
+                raise ApiError(410, "gone")
+            return orig(kind, namespace, rv_box, stop)
+
+        client._watch_once = flaky
+
+    def test_relist_is_framed_resync_to_synced(self, api):
+        """After an outage the relist replay is framed RESYNC…SYNCED and
+        names only survivors — that framing is what lets consumers drop
+        objects deleted during the outage."""
+        url, _ = api
+        client = RestKubeClient(server=url)
+        admin = RestKubeClient(server=url)
+        client.create("Node", {"metadata": {"name": "n1"}})
+        client.create("Node", {"metadata": {"name": "n2"}})
+        self._make_flaky(client, lambda: admin.delete("Node", "n2"))
+        events = []
+        done = threading.Event()
+
+        def consume():
+            for etype, obj in client.watch("Node", stop=done.is_set):
+                events.append((etype, (obj.get("metadata") or {}).get("name")))
+                if sum(1 for t, _ in events if t == "SYNCED") >= 2:
+                    done.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=5.0)
+        done.set()
+        assert events[:3] == [
+            ("ADDED", "n1"), ("ADDED", "n2"), ("SYNCED", None)
+        ]
+        resync = events.index(("RESYNC", None))
+        replay = [n for (t2, n) in events[resync:] if t2 == "MODIFIED"]
+        assert replay == ["n1"]  # n2 is gone, not re-mentioned
+        assert events[-1] == ("SYNCED", None)
+
+    def test_controller_prunes_deleted_during_outage(self, api):
+        """End-to-end: a Controller on the real wire path reconciles (and
+        un-caches) an object deleted while its watch stream was down."""
+        url, _ = api
+        client = RestKubeClient(server=url)
+        admin = RestKubeClient(server=url)
+        admin.create("Node", {"metadata": {"name": "n1"}})
+        admin.create("Node", {"metadata": {"name": "n2"}})
+        self._make_flaky(client, lambda: admin.delete("Node", "n2"))
+        deleted = threading.Event()
+
+        def reconcile(req: Request) -> Result:
+            try:
+                admin.get("Node", req.name)
+            except NotFound:
+                if req.name == "n2":
+                    deleted.set()
+            return Result()
+
+        ctrl = Controller("t", client, "Node", reconcile)
+        ctrl.start()
+        try:
+            assert deleted.wait(timeout=10)
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and any(
+                name == "n2" for (_, name) in ctrl._cache
+            ):
+                time.sleep(0.02)
+            assert all(name != "n2" for (_, name) in ctrl._cache)
+        finally:
+            ctrl.stop()
+
     def test_watch_streams_live_events(self, api):
         url, _ = api
         client = RestKubeClient(server=url)
@@ -255,6 +364,8 @@ class TestRestKubeClient:
 
         def consume():
             for event, obj in client.watch("Node", stop=done.is_set):
+                if event in ("SYNCED", "RESYNC"):
+                    continue
                 events.append((event, obj["metadata"]["name"]))
                 if len(events) >= 3:
                     done.set()

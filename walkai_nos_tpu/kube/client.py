@@ -12,8 +12,19 @@ from __future__ import annotations
 import abc
 from typing import Callable, Iterator, Mapping
 
-# A watch event: ("ADDED" | "MODIFIED" | "DELETED", object-dict)
+# A watch event: ("ADDED" | "MODIFIED" | "DELETED" | sync marker, object-dict)
 WatchEvent = tuple[str, dict]
+
+# Sync markers framing full-snapshot replays in a watch stream. The initial
+# ADDED burst ends with (SYNCED, {}); after an outage, an informer-style
+# relist is framed as (RESYNC, {}), MODIFIED per survivor, (SYNCED, {}).
+# Between a RESYNC and its SYNCED the stream has named every live object,
+# so consumers tracking object sets can drop anything not re-mentioned —
+# that's how deletions missed during an outage are reconciled (the analogue
+# of client-go's DeletedFinalStateUnknown handling, resolved consumer-side
+# where the last-seen content lives).
+RESYNC = "RESYNC"
+SYNCED = "SYNCED"
 
 
 class ApiError(Exception):
@@ -101,6 +112,8 @@ class KubeClient(abc.ABC):
         stop: Callable[[], bool] | None = None,
     ) -> Iterator[WatchEvent]:
         """Stream events. Implementations yield an initial synthetic ADDED
-        for each existing object, then live events, and poll `stop` to
-        terminate."""
+        for each existing object followed by a (SYNCED, {}) marker, then
+        live events, and poll `stop` to terminate. Recoverable stream
+        outages are resolved with a RESYNC…SYNCED framed relist replay
+        (see the marker docs above)."""
         ...

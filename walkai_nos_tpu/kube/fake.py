@@ -17,6 +17,7 @@ from typing import Callable, Iterator, Mapping
 
 from walkai_nos_tpu.kube import objects
 from walkai_nos_tpu.kube.client import (
+    SYNCED,
     Conflict,
     KubeClient,
     NotFound,
@@ -157,7 +158,10 @@ class FakeKubeClient(KubeClient):
         # and the backlog snapshot goes stale.
         q: queue.Queue = queue.Queue()
         with self._lock:
-            backlog = [("ADDED", o) for o in self.list(kind, namespace=None)]
+            backlog = [
+                ("ADDED", o) for o in self.list(kind, namespace=namespace)
+            ]
+            backlog.append((SYNCED, {}))
             self._watchers.setdefault(kind, []).append(q)
         return self._watch_iter(kind, namespace, stop, q, backlog)
 

@@ -32,9 +32,13 @@ def _fmt(t: datetime) -> str:
 
 
 def _parse(s: str) -> datetime:
-    return datetime.strptime(s.rstrip("Z"), "%Y-%m-%dT%H:%M:%S.%f").replace(
-        tzinfo=timezone.utc
-    )
+    """Any RFC3339 form — fractional seconds optional, 'Z' or offset
+    (other clients may serialize either; treating a valid form as
+    unparseable would let a candidate steal a still-valid lease)."""
+    t = datetime.fromisoformat(s.replace("Z", "+00:00"))
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=timezone.utc)
+    return t.astimezone(timezone.utc)
 
 
 class LeaderElector:

@@ -18,21 +18,27 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
 import ssl
 import tempfile
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Callable, Iterator, Mapping
 
 from walkai_nos_tpu.kube.client import (
+    RESYNC,
+    SYNCED,
     ApiError,
     Conflict,
     KubeClient,
     NotFound,
     WatchEvent,
 )
+
+logger = logging.getLogger(__name__)
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -79,7 +85,9 @@ class RestKubeClient(KubeClient):
         self._token = token
         self._timeout = timeout
         if insecure:
-            self._ssl = ssl._create_unverified_context()
+            self._ssl = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            self._ssl.check_hostname = False
+            self._ssl.verify_mode = ssl.CERT_NONE
         elif ca_file:
             self._ssl = ssl.create_default_context(cafile=ca_file)
         else:
@@ -206,6 +214,7 @@ class RestKubeClient(KubeClient):
     def _path(
         self, kind: str, namespace: str | None, name: str | None = None
     ) -> str:
+        """Single-object path; namespace=None addresses the default namespace."""
         prefix, plural, namespaced = _kind_route(kind)
         parts = [prefix]
         if namespaced:
@@ -214,6 +223,20 @@ class RestKubeClient(KubeClient):
         if name:
             parts.append(urllib.parse.quote(name))
         return "/".join(parts)
+
+    def _collection_path(self, kind: str, namespace: str | None) -> str:
+        """Collection path for list/watch.
+
+        namespace=None means ALL namespaces (the KubeClient/FakeKubeClient
+        contract): use the cluster-wide collection, e.g. /api/v1/pods —
+        NOT /api/v1/namespaces/default/pods.
+        """
+        prefix, plural, namespaced = _kind_route(kind)
+        if namespaced and namespace is not None:
+            return "/".join(
+                [prefix, "namespaces", urllib.parse.quote(namespace), plural]
+            )
+        return "/".join([prefix, plural])
 
     # ------------------------------------------------------------ interface
 
@@ -245,7 +268,7 @@ class RestKubeClient(KubeClient):
             query["fieldSelector"] = ",".join(
                 f"{k}={v}" for k, v in sorted(field_selector.items())
             )
-        path = self._path(kind, namespace)
+        path = self._collection_path(kind, namespace)
         if query:
             path += "?" + urllib.parse.urlencode(query)
         data = self._request("GET", path)
@@ -322,15 +345,37 @@ class RestKubeClient(KubeClient):
         rv_box = [rv]
         for obj in items:
             yield ("ADDED", obj)
+        yield (SYNCED, {})
+        backoff = 1.0
         while not stop():
             try:
                 yield from self._watch_once(kind, namespace, rv_box, stop)
-            except ApiError:
+                backoff = 1.0
+            except ApiError as watch_err:
                 # 410 Gone (stale resourceVersion) or transient API failure:
-                # relist and resume, informer-style.
-                items, rv_box[0] = self._list(kind, namespace)
+                # relist and resume, informer-style. The RESYNC…SYNCED
+                # framing lets consumers drop objects deleted during the
+                # outage (they won't be re-mentioned in the replay).
+                try:
+                    items, rv_box[0] = self._list(kind, namespace)
+                except ApiError as list_err:
+                    # API server still down: back off (capped exponential)
+                    # and keep the generator alive rather than dying
+                    # mid-outage — but say so, or persistent auth/RBAC
+                    # failures would be invisible in the logs.
+                    logger.warning(
+                        "watch %s: stream failed (%s) and relist failed "
+                        "(%s); retrying in %.1fs",
+                        kind, watch_err, list_err, backoff,
+                    )
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 30.0)
+                    continue
+                backoff = 1.0
+                yield (RESYNC, {})
                 for obj in items:
                     yield ("MODIFIED", obj)
+                yield (SYNCED, {})
 
     def _watch_once(
         self,
@@ -349,7 +394,7 @@ class RestKubeClient(KubeClient):
         )
         resp = self._request(
             "GET",
-            self._path(kind, namespace) + "?" + query,
+            self._collection_path(kind, namespace) + "?" + query,
             stream=True,
             timeout=45.0,
         )

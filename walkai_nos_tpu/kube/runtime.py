@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Mapping
 
 from walkai_nos_tpu.kube import objects
-from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.kube.client import RESYNC, SYNCED, KubeClient
 from walkai_nos_tpu.kube.predicates import Predicate
 
 logger = logging.getLogger(__name__)
@@ -182,8 +182,36 @@ class Controller:
                 # FakeKubeClient.watch); signal readiness so start() can
                 # guarantee no event published after start() is missed.
                 self.watch_ready.set()
+                # Keys cached from a previous stream but not (yet)
+                # re-mentioned by this one. Whatever survives to the SYNCED
+                # marker was deleted while no stream was up — prune it with
+                # a synthetic DELETED (carrying the last-seen content so
+                # predicates still match). The snapshot comes from the
+                # stream's own initial list, so there is no list-vs-watch
+                # race window.
+                with self._cache_lock:
+                    unconfirmed: set | None = set(self._cache)
                 for event, obj in stream:
-                    self._handle_event(event, obj)
+                    if event == RESYNC:
+                        with self._cache_lock:
+                            unconfirmed = set(self._cache)
+                    elif event == SYNCED:
+                        if unconfirmed:
+                            with self._cache_lock:
+                                stale = [
+                                    self._cache[k]
+                                    for k in unconfirmed
+                                    if k in self._cache
+                                ]
+                            for dead in stale:
+                                self._handle_event("DELETED", dead)
+                        unconfirmed = None
+                    else:
+                        if unconfirmed is not None:
+                            unconfirmed.discard(
+                                (objects.namespace(obj), objects.name(obj))
+                            )
+                        self._handle_event(event, obj)
                     if self._stop:
                         break
             except Exception:

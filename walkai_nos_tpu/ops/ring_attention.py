@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
-from walkai_nos_tpu.parallel.mesh import AXIS_SEQ
+from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
 
 _NEG_INF = -1e30
 
@@ -94,14 +94,31 @@ def ring_attention(
     *,
     causal: bool = False,
     axis_name: str = AXIS_SEQ,
+    batch_axes: tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `axis_name` ring.
 
     Inputs are [batch, heads, seq, head_dim] global arrays; the seq dim is
-    sharded over `axis_name` (batch over the data axes per the caller's
-    shardings). Returns output with the same sharding as Q.
+    sharded over `axis_name`, the batch dim over `batch_axes` (defaults to
+    whichever of the data/fsdp axes the mesh has — declaring batch
+    replicated here would force an all-gather of the full batch onto every
+    device on entry, defeating data parallelism). Returns output with the
+    same sharding as Q.
     """
-    spec = P(None, None, axis_name, None)
+    if batch_axes is None:
+        # Shard batch over the data/fsdp axes present in the mesh, but only
+        # while the batch size stays evenly divisible (shard_map rejects
+        # ragged shards).
+        batch_axes = ()
+        shards = 1
+        for a in (AXIS_DATA, AXIS_FSDP):
+            if a in mesh.axis_names and a != axis_name:
+                size = shards * mesh.shape[a]
+                if size > 1 and q.shape[0] % size == 0:
+                    batch_axes += (a,)
+                    shards = size
+    batch_dim = batch_axes if batch_axes else None
+    spec = P(batch_dim, None, axis_name, None)
     fn = shard_map(
         functools.partial(_ring_attn_local, axis_name=axis_name, causal=causal),
         mesh=mesh,

@@ -62,16 +62,19 @@ class CapacityScheduling:
         }
         if all(v == 0 for v in over.values()):
             return Decision(True, "fits within min")
-        # Borrowing: the borrowed amount must exist as unused min of OTHER
-        # quotas (own headroom isn't a loan).
+        # Borrowing: the quota's TOTAL over-quota holding (prior borrowing
+        # plus this pod) must fit in unused min of OTHER quotas, net of what
+        # other borrowers already took from that pool (own headroom isn't a
+        # loan, and two borrowers can't both take the same lender's slack).
         for resource, borrowed in over.items():
-            prior = quota.over_quota_usage(resource)
-            available = self._state.lendable_over_quotas(quota, resource)
-            if borrowed - prior > available:
+            available = self._state.available_over_quotas_for(quota, resource)
+            if borrowed > available:
+                prior = quota.over_quota_usage(resource)
                 return Decision(
                     False,
-                    f"quota {quota.name}: would borrow {borrowed} {resource} "
-                    f"but only {available} over-quota available",
+                    f"quota {quota.name}: total over-quota holding would "
+                    f"reach {borrowed} {resource} (currently borrowing "
+                    f"{prior}) but only {available} is available to borrow",
                 )
         return Decision(True, "fits borrowing unused quota")
 

@@ -136,6 +136,22 @@ class ClusterQuotaState:
             if q.name != borrower.name
         )
 
+    def available_over_quotas_for(
+        self, borrower: QuotaInfo, resource: str
+    ) -> int:
+        """What `borrower` may hold over-quota IN TOTAL right now: the
+        lendable pool minus what OTHER quotas are already borrowing.
+        Without the subtraction, multiple borrowers could each 'borrow'
+        the same lender's unused min."""
+        others_borrowing = sum(
+            q.over_quota_usage(resource)
+            for q in self.quotas
+            if q.name != borrower.name
+        )
+        return max(
+            0, self.lendable_over_quotas(borrower, resource) - others_borrowing
+        )
+
     def guaranteed_over_quota(self, quota: QuotaInfo, resource: str) -> float:
         """min_i / sum(min_j) * total available (`key-concepts.md:44-46`)."""
         total_min = sum(q.min.get(resource, 0) for q in self.quotas)
