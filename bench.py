@@ -1,80 +1,243 @@
-"""Headline benchmark: the reference's GPU-sharing comparison, TPU-native.
+"""Headline benchmark: the north-star metrics through the product's paths.
 
-The reference's only published numbers are average inference times of N
-YOLOS-small pods sharing one A100 (BASELINE.md). This bench reproduces the
-workload on one TPU chip: 4 concurrent inference streams (the north-star
-config — 4 concurrent JAX pods, BASELINE.json) each running the flagship
-YOLOS-style ViT at batch 1, reporting the mean per-inference latency.
+BASELINE.json's north star is (a) aggregate TPU chip utilization with 4
+concurrent JAX client streams and (b) pending-pod p50 time-to-scheduled.
+Both are measured here through the product, not a bare jit loop:
 
-vs_baseline compares against the reference's MPS result interpolated to 4
-pods ((0.1640 + 0.2409) / 2 = 0.20245 s, `demos/gpu-sharing-comparison/
-README.md:70`), as baseline_s / measured_s — >1.0 means faster than the
-reference's best sharing mode at the same concurrency.
+- Serving: spawns the REAL demo inference server
+  (`demos/tpu-sharing-comparison/app/main.py`, which micro-batches
+  concurrent requests onto the MXU and acks completion with device
+  fences) and drives it with 4 concurrent client streams, each a
+  realistic async client keeping a small pipeline of in-flight requests
+  — the TPU-native analogue of the reference's measurement
+  (`demos/gpu-sharing-comparison/README.md:146`, N client pods hammering
+  servers sharing one device). Utilization = fenced serving throughput
+  over the chip's flat-out throughput ON THE SAME MODEL (calibrated at
+  server startup through the same dispatch+fence path): the fraction of
+  the chip's attainable delivery the shared path sustains — the honest
+  analogue of device-utilization uplift, robust to remote/tunneled
+  runtimes where wall-clock busy time is unmeasurable. Model-FLOPs
+  utilization (MFU) over the theoretical bf16 peak is also reported;
+  for a memory-bound model the two differ by design.
+- Scheduling: runs ~50 slice pods through the REAL controllers (node
+  init, retile, actuate, report, advertise, bind) over the sim harness
+  and reports p50/p90 create->bind (`walkai_nos_tpu/sim/schedbench.py`).
 
-Prints exactly one JSON line.
+vs_baseline is utilization_pct / 85.0 — the north-star target ratio
+(>=1.0 means the target is met). The MPS per-inference latency
+comparison from the reference's table is measured by a separate
+sequential probe (one outstanding batch=1 request per stream, exactly
+the reference client's shape — NOT derived from the pipelined
+throughput window, where closed-loop latency is just Little's law) and
+reported as `latency_vs_mps_baseline` (baseline_s / probe_s, >1.0 =
+faster).
+
+Prints exactly ONE JSON line.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import statistics
 import threading
 import time
 
+from walkai_nos_tpu.utils.httpbench import (
+    get_json,
+    kill_server,
+    post_infer,
+    spawn_server,
+)
+
 N_STREAMS = 4
-WARMUP_ITERS = 3
-MEASURE_SECONDS = 15.0
+# Outstanding requests each stream keeps in flight (an async client's
+# pipeline depth) — keeps the device fed across completion-fence
+# round-trips on remote runtimes.
+STREAM_PIPELINE = int(os.environ.get("WALKAI_BENCH_PIPELINE", "16"))
+REQUEST_BATCH = int(os.environ.get("WALKAI_BENCH_REQUEST_BATCH", "32"))
+MAX_BATCH = int(os.environ.get("WALKAI_BENCH_MAX_BATCH", "128"))
+WARMUP_SECONDS = 5.0
+MEASURE_SECONDS = float(os.environ.get("WALKAI_BENCH_SECONDS", "15"))
+LATENCY_PROBE_SECONDS = float(os.environ.get("WALKAI_BENCH_PROBE_SECONDS", "5"))
+SERVER_STARTUP_TIMEOUT_S = 420.0
+# Reference MPS result interpolated to 4 pods, per single-image inference
+# ((0.1640 + 0.2409) / 2, `demos/gpu-sharing-comparison/README.md:70`).
 BASELINE_MPS_4POD_S = (0.1640 + 0.2409) / 2
+TARGET_UTILIZATION_PCT = 85.0
+
+
+def serving_benchmark() -> dict:
+    proc, base = spawn_server(
+        {
+            "WALKAI_MAX_BATCH": str(MAX_BATCH),
+            "WALKAI_MAX_INFLIGHT": "24",
+            "WALKAI_BATCH_WINDOW_MS": "1.0",
+            "WALKAI_WARM_BUCKETS": ",".join(
+                [
+                    str(b)
+                    for i in range(8)
+                    if (b := REQUEST_BATCH * (2**i)) <= MAX_BATCH
+                ]
+                # The sequential latency probe posts batch=1 from
+                # N_STREAMS clients; coalescing can produce any
+                # power-of-two bucket up to N_STREAMS.
+                + [str(2**i) for i in range(N_STREAMS.bit_length())]
+            ),
+        },
+        startup_timeout_s=SERVER_STARTUP_TIMEOUT_S,
+    )
+    try:
+        samples: list[tuple[float, float]] = []  # (monotonic, request seconds)
+        errors = [0]
+        lock = threading.Lock()
+        halt = threading.Event()
+
+        def stream() -> None:
+            while not halt.is_set():
+                t0 = time.perf_counter()
+                try:
+                    post_infer(base, REQUEST_BATCH)
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                    time.sleep(0.2)  # back off, keep the stream alive
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    samples.append((time.monotonic(), dt))
+
+        threads = [
+            threading.Thread(target=stream, daemon=True)
+            for _ in range(N_STREAMS * STREAM_PIPELINE)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(WARMUP_SECONDS)
+        stats0 = get_json(f"{base}/stats")
+        measure_start = time.monotonic()
+        time.sleep(MEASURE_SECONDS)
+        stats1 = get_json(f"{base}/stats")
+        measure_end = time.monotonic()
+        halt.set()
+        for t in threads:
+            t.join(timeout=160.0)
+
+        # Separate UN-pipelined latency probe, comparable to the
+        # reference's sequential per-pod client (one outstanding batch=1
+        # request per stream): the pipelined window above measures
+        # throughput, where closed-loop latency is just Little's law on
+        # the pipeline depth, not a latency claim.
+        probe_lat: list[float] = []
+        probe_halt = threading.Event()
+
+        def probe_stream() -> None:
+            while not probe_halt.is_set():
+                t0 = time.perf_counter()
+                try:
+                    post_infer(base, 1)
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                    continue
+                with lock:
+                    probe_lat.append(time.perf_counter() - t0)
+
+        probe_threads = [
+            threading.Thread(target=probe_stream, daemon=True)
+            for _ in range(N_STREAMS)
+        ]
+        for t in probe_threads:
+            t.start()
+        time.sleep(LATENCY_PROBE_SECONDS)
+        probe_halt.set()
+        for t in probe_threads:
+            t.join(timeout=160.0)
+    finally:
+        kill_server(proc)
+
+    wall = stats1["monotonic_s"] - stats0["monotonic_s"]
+    images = stats1["images"] - stats0["images"]
+    flops = stats1["flops"] - stats0["flops"]
+    rate = flops / wall if wall > 0 else 0.0
+    lat = [
+        dt
+        for (ts, dt) in samples
+        if measure_start <= ts <= measure_end
+    ]
+    lat.sort()
+    probe_lat.sort()
+    ceiling = stats1.get("model_ceiling_images_per_s")
+    peak = stats1.get("peak_bf16_flops")
+    img_rate = images / wall if wall > 0 else 0.0
+    util_pct = 100.0 * img_rate / ceiling if ceiling else 0.0
+    mfu_pct = 100.0 * rate / peak if peak else None
+    probe_mean = statistics.fmean(probe_lat) if probe_lat else 0.0
+    return {
+        "utilization_pct": round(util_pct, 2),
+        "throughput_images_per_s": round(img_rate, 1),
+        "model_ceiling_images_per_s": round(ceiling, 1) if ceiling else None,
+        "achieved_tflops_per_s": round(rate / 1e12, 2),
+        "mfu_pct": round(mfu_pct, 2) if mfu_pct is not None else None,
+        "fence_rtt_ms": round(stats1.get("fence_rtt_s", 0.0) * 1e3, 2),
+        "latency_mean_request_s": round(
+            statistics.fmean(lat), 6
+        ) if lat else 0.0,
+        "latency_probe_mean_s": round(probe_mean, 6),
+        "latency_probe_p50_s": round(
+            probe_lat[len(probe_lat) // 2], 6
+        ) if probe_lat else 0.0,
+        "latency_vs_mps_baseline": round(BASELINE_MPS_4POD_S / probe_mean, 2)
+        if probe_mean > 0
+        else None,
+        "client_errors": errors[0],
+        "request_batch": REQUEST_BATCH,
+        "device_kind": stats1.get("device_kind"),
+        "streams": N_STREAMS,
+        "stream_pipeline": STREAM_PIPELINE,
+    }
+
+
+def scheduling_benchmark() -> dict:
+    import logging
+
+    logging.disable(logging.CRITICAL)
+    from walkai_nos_tpu.sim.schedbench import run_scheduling_benchmark
+
+    r = run_scheduling_benchmark()
+    logging.disable(logging.NOTSET)
+    return {
+        "pods_scheduled": r.scheduled,
+        "pods_unscheduled": r.unscheduled,
+        "p50_time_to_scheduled_s": round(r.p50_s, 4),
+        "p90_time_to_scheduled_s": round(r.p90_s, 4),
+        "max_time_to_scheduled_s": round(r.max_s, 4),
+    }
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
-    from walkai_nos_tpu.models.train import make_infer_step
-    from walkai_nos_tpu.models.vit import VIT_SMALL, ViTDetector
-
-    cfg = VIT_SMALL
-    params = jax.device_put(ViTDetector(cfg).init_params(jax.random.PRNGKey(0)))
-    infer = make_infer_step(cfg)
-
-    images = jnp.ones((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
-    # Compile once (shared across streams) + warm up.
-    for _ in range(WARMUP_ITERS):
-        jax.block_until_ready(infer(params, images))
-
-    latencies: list[list[float]] = [[] for _ in range(N_STREAMS)]
-    stop = time.monotonic() + MEASURE_SECONDS
-    barrier = threading.Barrier(N_STREAMS)
-
-    def stream(idx: int) -> None:
-        barrier.wait()
-        while time.monotonic() < stop:
-            t0 = time.perf_counter()
-            jax.block_until_ready(infer(params, images))
-            latencies[idx].append(time.perf_counter() - t0)
-
-    threads = [
-        threading.Thread(target=stream, args=(i,)) for i in range(N_STREAMS)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-
-    all_lat = [x for s in latencies for x in s]
-    mean_s = sum(all_lat) / max(len(all_lat), 1)
-    print(
-        json.dumps(
-            {
-                "metric": "avg_inference_time_4streams",
-                "value": round(mean_s, 6),
-                "unit": "s",
-                "vs_baseline": round(BASELINE_MPS_4POD_S / mean_s, 4)
-                if mean_s > 0
-                else 0.0,
-            }
-        )
-    )
+    result: dict = {}
+    err = None
+    try:
+        result.update(serving_benchmark())
+    except Exception as e:  # still emit the line (and the sched phase)
+        err = f"serving: {e}"
+        result.setdefault("utilization_pct", 0.0)
+    try:
+        result.update(scheduling_benchmark())
+    except Exception as e:
+        err = (err + "; " if err else "") + f"scheduling: {e}"
+    util = result.get("utilization_pct", 0.0)
+    out = {
+        "metric": "aggregate_chip_utilization_4streams",
+        "value": util,
+        "unit": "%",
+        "vs_baseline": round(util / TARGET_UTILIZATION_PCT, 4),
+        **result,
+    }
+    if err:
+        out["error"] = err
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

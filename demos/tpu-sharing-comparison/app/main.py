@@ -6,16 +6,146 @@ server): serves the flagship YOLOS-style ViT over HTTP on whatever slice
 the device plugin granted this pod (TPU_VISIBLE_CHIPS et al. are injected
 by the walkai device plugin at Allocate time).
 
-POST /infer with a JSON body {"batch": N} runs one jitted forward pass;
-GET /healthz for probes.
+Two serving-path design points, both TPU-native:
+
+1. **Micro-batching.** Unlike the reference (one CUDA forward per
+   request), concurrent POST /infer requests are coalesced by a single
+   device worker into one padded forward per tick, bucketed to
+   power-of-two batch sizes so XLA compiles each shape once. N clients
+   sharing a slice drive one batch=N matmul pipeline instead of N
+   serialized batch-1 passes.
+2. **Fence-based completion.** Dispatch is asynchronous and the device
+   runtime may acknowledge enqueue long before compute finishes (remote/
+   tunneled PJRT backends do), so requests are acked by a fencer thread
+   that host-fetches a scalar from the NEWEST dispatched batch — same-
+   device executions complete in dispatch order, so one fence
+   acknowledges every earlier batch. In-flight batches are bounded by a
+   semaphore for backpressure. All throughput counters count only FENCED
+   (provably completed) work; a startup calibration measures the host
+   round-trip and the chip's attainable FLOP/s through the same fencing
+   so utilization can be reported against what the runtime can actually
+   deliver.
+
+Endpoints:
+- POST /infer  {"batch": N}  -> {"inference_time_seconds": s, ...}
+- GET  /stats  -> cumulative fenced {images, requests, batches, flops,
+  monotonic_s} + {device_kind, peak_bf16_flops,
+  model_ceiling_images_per_s, fence_rtt_s} for utilization measurement.
+- GET  /healthz for probes.
+
+Env knobs: WALKAI_MAX_BATCH (default 32), WALKAI_BATCH_WINDOW_MS
+(default 2.0), WALKAI_WARM_BUCKETS (comma list, default "1,8,32"),
+WALKAI_MAX_INFLIGHT (default 8), WALKAI_CALIB_BATCHES (initial
+calibration chain length, default 4, doubled until the run is long
+enough to dominate fence noise).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 import time
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+@dataclass
+class _Request:
+    n_images: int
+    arrived: float
+    done: threading.Event = field(default_factory=threading.Event)
+    elapsed: float = 0.0
+    batched_with: int = 0
+
+
+@dataclass
+class _Dispatched:
+    requests: list
+    n_images: int
+    output: object  # device array to fence on
+
+
+class _Stats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.images = 0
+        self.requests = 0
+        self.batches = 0
+        self.flops = 0.0
+
+    def record(self, images, requests, flops) -> None:
+        with self._lock:
+            self.images += images
+            self.requests += requests
+            self.batches += 1
+            self.flops += flops
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "images": self.images,
+                "requests": self.requests,
+                "batches": self.batches,
+                "flops": self.flops,
+                "monotonic_s": time.monotonic(),
+            }
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch)
+
+
+def _fence(output) -> None:
+    """Force true completion of `output` (and every earlier dispatch on
+    the same device) by fetching one scalar to the host. block_until_ready
+    alone is NOT a completion guarantee on remote/tunneled backends."""
+    import numpy as np
+
+    np.asarray(output["logits"][0, 0, 0])
+
+
+def _calibrate(jnp, jax, infer, params, images_of, max_batch):
+    """Measure (fence_rtt_s, model_ceiling_images_per_s): the chip's
+    flat-out throughput ON THE SERVED MODEL through the same
+    dispatch+fence path the server uses. Utilization is reported against
+    this ceiling — the TPU analogue of device-utilization uplift in the
+    reference's comparison: what fraction of the chip's attainable
+    delivery the shared serving path sustains. (Model FLOPs over the
+    theoretical bf16 peak — MFU — is reported separately; for a
+    memory-bound model the two differ by design.)"""
+    import numpy as np
+
+    tiny = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0)
+    np.asarray(tiny(x))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(tiny(x))
+        rtts.append(time.perf_counter() - t0)
+    rtt = sorted(rtts)[len(rtts) // 2]
+
+    images = images_of(max_batch)
+    _fence(infer(params, images))  # compile
+    n = max(4, int(os.environ.get("WALKAI_CALIB_BATCHES", "0")) or 4)
+    while True:
+        # Dispatch the whole chain asynchronously, fence once: the chip
+        # runs back-to-back with no host stalls — the true flat-out rate.
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = infer(params, images)
+        _fence(out)
+        wall = time.perf_counter() - t0
+        if wall > 2.0 or n >= 512:
+            break
+        n *= 2
+    return rtt, max_batch * n / max(wall - rtt, 1e-9)
 
 
 def main() -> None:
@@ -23,17 +153,130 @@ def main() -> None:
     import jax.numpy as jnp
 
     from walkai_nos_tpu.models.train import make_infer_step
-    from walkai_nos_tpu.models.vit import VIT_SMALL, ViTDetector
+    from walkai_nos_tpu.models.vit import VIT_SMALL, VIT_TINY, ViTDetector
+    from walkai_nos_tpu.utils.flops import peak_bf16_flops, vit_flops_per_image
 
-    cfg = VIT_SMALL
+    # WALKAI_DEMO_MODEL=tiny is the test seam: same serving path, a
+    # seconds-not-minutes compile on CPU CI.
+    cfg = (
+        VIT_TINY
+        if os.environ.get("WALKAI_DEMO_MODEL") == "tiny"
+        else VIT_SMALL
+    )
     params = jax.device_put(
         ViTDetector(cfg).init_params(jax.random.PRNGKey(0))
     )
     infer = make_infer_step(cfg)
-    warm = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
-    jax.block_until_ready(infer(params, warm))
+    max_batch = int(os.environ.get("WALKAI_MAX_BATCH", "32"))
+    window_s = float(os.environ.get("WALKAI_BATCH_WINDOW_MS", "2.0")) / 1e3
+    max_inflight = int(os.environ.get("WALKAI_MAX_INFLIGHT", "8"))
+
+    # One cached zero-input per bucket: inputs never leave the device, so
+    # in-flight batches cost no transfers and bounded output memory.
+    inputs = {}
+
+    def images_of(batch: int):
+        if batch not in inputs:
+            inputs[batch] = jnp.zeros(
+                (batch, cfg.image_size, cfg.image_size, 3), jnp.float32
+            )
+        return inputs[batch]
+
+    # Per-image FLOPs: prefer XLA's own cost analysis of the compiled
+    # forward, fall back to the analytic count.
+    flops_per_image = vit_flops_per_image(cfg)
+    try:
+        cost = infer.lower(params, images_of(1)).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        analyzed = float(cost.get("flops", 0.0))
+        if analyzed > 0:
+            flops_per_image = analyzed
+    except Exception:
+        pass
+
+    fence_rtt, ceiling_img_s = _calibrate(
+        jnp, jax, infer, params, images_of, max_batch
+    )
+
+    warm = os.environ.get("WALKAI_WARM_BUCKETS", "1,8,32")
+    for b in sorted({int(x) for x in warm.split(",") if x.strip()}):
+        if 1 <= b <= max_batch:
+            _fence(infer(params, images_of(b)))
+
+    device = jax.devices()[0]
     slice_id = os.environ.get("TPU_SLICE_ID", "whole-host")
-    print(f"serving on slice {slice_id} with {jax.device_count()} device(s)")
+    print(
+        f"serving on slice {slice_id} with {jax.device_count()} "
+        f"{device.device_kind} device(s), max_batch={max_batch}, "
+        f"fence_rtt={fence_rtt * 1e3:.1f}ms, "
+        f"model_ceiling={ceiling_img_s:.0f} img/s"
+    )
+
+    stats = _Stats()
+    requests_q: "queue.Queue[_Request]" = queue.Queue()
+    fence_q: "queue.Queue[_Dispatched]" = queue.Queue()
+    inflight = threading.Semaphore(max_inflight)
+
+    def device_worker() -> None:
+        """Single dispatcher: coalesce -> pad -> one async forward."""
+        while True:
+            first = requests_q.get()
+            batch_reqs = [first]
+            total = first.n_images
+            deadline = time.monotonic() + window_s
+            while total < max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = requests_q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if total + nxt.n_images > max_batch:
+                    requests_q.put(nxt)  # doesn't fit this tick
+                    break
+                batch_reqs.append(nxt)
+                total += nxt.n_images
+            inflight.acquire()
+            out = infer(params, images_of(_bucket(total, max_batch)))
+            fence_q.put(_Dispatched(batch_reqs, total, out))
+
+    def fencer() -> None:
+        """Ack completed work: drain dispatched batches, fence the newest
+        (same-device executions complete in order), release them all."""
+        while True:
+            drained = [fence_q.get()]
+            while True:
+                try:
+                    drained.append(fence_q.get_nowait())
+                except queue.Empty:
+                    break
+            _fence(drained[-1].output)
+            now = time.monotonic()
+            for d in drained:
+                inflight.release()
+                stats.record(
+                    d.n_images, len(d.requests), flops_per_image * d.n_images
+                )
+                for r in d.requests:
+                    r.elapsed = now - r.arrived
+                    r.batched_with = d.n_images
+                    r.done.set()
+
+    threading.Thread(target=device_worker, daemon=True).start()
+    threading.Thread(target=fencer, daemon=True).start()
+
+    device_info = {
+        "device_kind": device.device_kind,
+        "device_count": jax.device_count(),
+        "peak_bf16_flops": peak_bf16_flops(device.device_kind),
+        "model_ceiling_images_per_s": ceiling_img_s,
+        "fence_rtt_s": fence_rtt,
+        "flops_per_image": flops_per_image,
+        "max_batch": max_batch,
+        "slice": slice_id,
+    }
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
@@ -42,36 +285,48 @@ def main() -> None:
                 return
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n) or b"{}")
-            batch = int(body.get("batch", 1))
-            images = jnp.zeros(
-                (batch, cfg.image_size, cfg.image_size, 3), jnp.float32
+            batch = max(1, min(int(body.get("batch", 1)), max_batch))
+            req = _Request(n_images=batch, arrived=time.monotonic())
+            requests_q.put(req)
+            if not req.done.wait(timeout=120.0):
+                self.send_error(503, "inference timed out")
+                return
+            self._json(
+                200,
+                {
+                    "inference_time_seconds": req.elapsed,
+                    "batched_with": req.batched_with,
+                    "slice": slice_id,
+                },
             )
-            t0 = time.perf_counter()
-            jax.block_until_ready(infer(params, images))
-            elapsed = time.perf_counter() - t0
-            payload = json.dumps(
-                {"inference_time_seconds": elapsed, "slice": slice_id}
-            ).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
 
         def do_GET(self):
             if self.path == "/healthz":
-                self.send_response(200)
-                self.send_header("Content-Length", "2")
-                self.end_headers()
-                self.wfile.write(b"ok")
+                self._json(200, {"ok": True})
+            elif self.path == "/stats":
+                self._json(200, {**stats.snapshot(), **device_info})
             else:
                 self.send_error(404)
+
+        def _json(self, code, payload):
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
 
         def log_message(self, *args):
             pass
 
+    class Server(ThreadingHTTPServer):
+        # Many clients reconnect per request; the stdlib default backlog
+        # of 5 drops connections under burst load.
+        request_queue_size = 128
+        daemon_threads = True
+
     port = int(os.environ.get("PORT", "8000"))
-    ThreadingHTTPServer(("0.0.0.0", port), Handler).serve_forever()
+    Server(("0.0.0.0", port), Handler).serve_forever()
 
 
 if __name__ == "__main__":

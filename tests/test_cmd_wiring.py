@@ -73,6 +73,61 @@ class TestPartitionerWiring:
         )
         assert pod_ctrl.max_concurrent == 1  # mig_controller.go:204
 
+    def test_pending_pod_retry_is_event_driven(self):
+        """The pod controller never requeues periodically; a pending pod is
+        retried when a partitioned node changes (the reference's watch
+        mapping, `mig_controller.go:180-207`)."""
+        from walkai_nos_tpu.controllers.partitioner import (
+            PodController,
+            make_node_event_mapper,
+        )
+        from walkai_nos_tpu.kube.runtime import Request
+
+        kube = FakeKubeClient()
+        kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "j1", "namespace": "default"},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "main",
+                            "resources": {
+                                "requests": {"walkai.io/tpu-2x2": "1"}
+                            },
+                        }
+                    ]
+                },
+                "status": {
+                    "phase": "Pending",
+                    "conditions": [
+                        {
+                            "type": "PodScheduled",
+                            "status": "False",
+                            "reason": "Unschedulable",
+                        }
+                    ],
+                },
+            },
+        )
+        # No nodes: reconcile must NOT schedule a retry.
+        result = PodController(kube).reconcile(Request("j1", "default"))
+        assert not result.requeue and result.requeue_after is None
+
+        # A node event re-enqueues exactly the pending slice pod.
+        enqueued = []
+        mapper = make_node_event_mapper(kube, enqueued.append)
+        kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "no-tpu", "namespace": "default"},
+                "spec": {"containers": [{"name": "m", "resources": {}}]},
+                "status": {"phase": "Pending"},
+            },
+        )
+        mapper(Request("host-a"))
+        assert [(r.namespace, r.name) for r in enqueued] == [("default", "j1")]
+
 
 class TestAgentWiring:
     def test_reporter_writes_status_for_existing_slices(self):

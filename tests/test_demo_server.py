@@ -1,0 +1,71 @@
+"""Demo inference server: micro-batching + fence-based completion.
+
+Boots the real server process (tiny model, CPU) and exercises the
+product serving path the bench measures: concurrent requests coalesce
+into one forward, responses come only after a device fence, and /stats
+counts only fenced work (`demos/tpu-sharing-comparison/app/main.py`).
+"""
+
+import threading
+
+import pytest
+
+from walkai_nos_tpu.utils.httpbench import (
+    get_json,
+    kill_server,
+    post_infer,
+    spawn_server,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    proc, base = spawn_server(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "WALKAI_DEMO_MODEL": "tiny",
+            "WALKAI_MAX_BATCH": "8",
+            "WALKAI_BATCH_WINDOW_MS": "20",
+            "WALKAI_WARM_BUCKETS": "1,8",
+        },
+        startup_timeout_s=120.0,
+        poll_s=0.25,
+    )
+    yield base
+    kill_server(proc)
+
+
+class TestDemoServer:
+    def test_single_request_roundtrip(self, server):
+        out = post_infer(server, 1, timeout=60)
+        assert out["inference_time_seconds"] > 0
+        assert out["batched_with"] >= 1
+
+    def test_concurrent_requests_are_batched(self, server):
+        results = []
+        lock = threading.Lock()
+
+        def hit():
+            r = post_infer(server, 1, timeout=60)
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=hit) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 6
+        # The 20ms window must have coalesced at least some requests.
+        assert max(r["batched_with"] for r in results) > 1
+
+    def test_stats_count_only_fenced_work(self, server):
+        s0 = get_json(f"{server}/stats")
+        post_infer(server, 4, timeout=60)
+        s1 = get_json(f"{server}/stats")
+        assert s1["images"] - s0["images"] >= 4
+        assert s1["requests"] - s0["requests"] >= 1
+        assert s1["flops"] > s0["flops"]
+        assert s1["model_ceiling_images_per_s"] > 0
+        assert s1["fence_rtt_s"] >= 0
+        assert s1["flops_per_image"] > 0

@@ -1,0 +1,25 @@
+"""Scheduling-latency benchmark harness (`walkai_nos_tpu/sim/schedbench.py`)."""
+
+from walkai_nos_tpu.sim.schedbench import _workload, run_scheduling_benchmark
+from walkai_nos_tpu.tpu.tiling.profile import Profile
+
+
+class TestWorkload:
+    def test_fill_is_within_capacity(self):
+        for n_nodes in (2, 10):
+            plan = _workload(n_nodes)
+            chips = sum(Profile.parse(p).chips for _, p in plan)
+            assert 0 < chips <= n_nodes * 8
+            # Largest-first ordering (first-fit-decreasing).
+            sizes = [Profile.parse(p).chips for _, p in plan]
+            assert sizes == sorted(sizes, reverse=True)
+
+
+class TestSchedulingBench:
+    def test_small_cluster_end_to_end(self):
+        r = run_scheduling_benchmark(
+            n_nodes=2, report_interval=0.02, stagger_s=0.002, timeout_s=30.0
+        )
+        assert r.unscheduled == 0
+        assert r.scheduled == len(_workload(2))
+        assert 0 < r.p50_s <= r.p90_s <= r.max_s

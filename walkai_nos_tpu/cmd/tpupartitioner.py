@@ -19,7 +19,10 @@ from walkai_nos_tpu.config import (
     load_known_geometries_file,
 )
 from walkai_nos_tpu.controllers.partitioner.node_controller import NodeController
-from walkai_nos_tpu.controllers.partitioner.pod_controller import PodController
+from walkai_nos_tpu.controllers.partitioner.pod_controller import (
+    PodController,
+    make_node_event_mapper,
+)
 from walkai_nos_tpu.kube import predicates
 from walkai_nos_tpu.kube.runtime import Controller, Manager
 
@@ -29,15 +32,29 @@ logger = logging.getLogger("tpupartitioner")
 def build_manager(kube, config: PartitionerConfig) -> Manager:
     """Wire the two control loops (test seam: callers inject any KubeClient)."""
     manager = Manager()
+    pod_watch = Controller(
+        constants.PARTITIONER_CONTROLLER_NAME,
+        kube,
+        "Pod",
+        PodController(kube).reconcile,
+        max_concurrent=1,  # `mig_controller.go:204`
+    )
+    manager.add(pod_watch)
+    # Node events re-enqueue pending slice pods (the reference's watch
+    # mapping, `mig_controller.go:180-207`) — no periodic pod polling.
     manager.add(
         Controller(
-            constants.PARTITIONER_CONTROLLER_NAME,
+            "tpu-pending-pod-mapper",
             kube,
-            "Pod",
-            PodController(
-                kube, retry_interval=config.pod_retry_interval_s
-            ).reconcile,
-            max_concurrent=1,  # `mig_controller.go:204`
+            "Node",
+            make_node_event_mapper(kube, pod_watch.queue.add),
+            predicates=[
+                predicates.all_of(
+                    predicates.has_label(constants.LABEL_TPU_PARTITIONING),
+                    predicates.exclude_delete(),
+                    predicates.annotations_changed(),
+                )
+            ],
         )
     )
     manager.add(

@@ -53,6 +53,11 @@ class PartitionerConfig:
     # Wait after a device-plugin restart before trusting re-advertised
     # resources (`devicePluginDelaySeconds`, `values.yaml:178-181`).
     device_plugin_delay_s: float = 5.0
+    # Vestigial: pending-pod retry is event-driven since the node-event
+    # mapper (pod_controller.make_node_event_mapper); the knob is kept so
+    # existing config files still parse — the same treatment the reference
+    # gives its orphaned batch-window knobs
+    # (`gpu_partitioner_config.yaml:23-33`).
     pod_retry_interval_s: float = 5.0
 
     def validate(self) -> None:

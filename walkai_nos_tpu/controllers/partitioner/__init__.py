@@ -7,6 +7,7 @@ controller initializes freshly labeled TPU nodes.
 
 from walkai_nos_tpu.controllers.partitioner.pod_controller import (  # noqa: F401
     PodController,
+    make_node_event_mapper,
 )
 from walkai_nos_tpu.controllers.partitioner.node_controller import (  # noqa: F401
     NodeController,

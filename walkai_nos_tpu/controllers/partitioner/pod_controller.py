@@ -7,6 +7,12 @@ profiles free, walk nodes first-fit and try a geometry transition; on
 success write the new spec annotations + plan ID. Single-threaded
 (MaxConcurrentReconciles=1, `mig_controller.go:204`) so concurrent pending
 pods can't race partitioning decisions.
+
+Retry is event-driven, like the reference's watch mapping
+(`mig_controller.go:180-207`): a decision is a pure function of pod + node
+state, so a failed attempt is only worth repeating when a partitioned
+node actually changed — `make_node_event_mapper` re-enqueues every pending
+slice pod on node add/annotation-change events instead of polling.
 """
 
 from __future__ import annotations
@@ -28,24 +34,46 @@ from walkai_nos_tpu.tpu.tiling.profile import get_requested_profiles
 logger = logging.getLogger(__name__)
 
 
+def make_node_event_mapper(
+    kube: KubeClient, enqueue: Callable[[Request], None]
+) -> Callable[[Request], Result]:
+    """Node events -> pending-slice-pod reconciles.
+
+    The analogue of the reference's `Watches(&corev1.Node{},
+    handler.EnqueueRequestsFromMapFunc(...))` wiring
+    (`mig_controller.go:180-207`): whenever a partitioned node is added or
+    its annotations change (capacity freed, a retile reported, a plan
+    acked), every pod that re-tiling could still help is re-enqueued on
+    the pod controller's queue. This replaces periodic pending-pod polling
+    — with no node change, a retry would recompute the same answer."""
+
+    def reconcile(_request: Request) -> Result:
+        for pod in kube.list("Pod"):
+            if not objects.extra_resources_could_help_scheduling(pod):
+                continue
+            if not get_requested_profiles(pod):
+                continue
+            enqueue(
+                Request(
+                    name=objects.name(pod), namespace=objects.namespace(pod)
+                )
+            )
+        return Result()
+
+    return reconcile
+
+
 class PodController:
     def __init__(
         self,
         kube: KubeClient,
         partitioner: Partitioner | None = None,
         plan_id_fn: Callable[[], str] = new_partitioning_plan_id,
-        retry_interval: float = 5.0,
     ) -> None:
         self._kube = kube
         self._partitioner = partitioner or Partitioner(kube)
         # Injectable plan-ID generator (test seam, `mig_controller.go:209-213`).
         self._plan_id_fn = plan_id_fn
-        # A pod can stay unschedulable because capacity freed *after* its
-        # last event (another pod bound the only free slice); the reference
-        # leans on kube-scheduler's periodic retry updates for fresh events,
-        # which a watch-only controller can't rely on — so requeue pending
-        # pods on an interval until they bind or disappear.
-        self._retry_interval = retry_interval
 
     # ------------------------------------------------------------- reconcile
 
@@ -63,10 +91,12 @@ class PodController:
         nodes = self._list_tiling_nodes()
         if self._profiles_already_available(nodes, wanted):
             # The scheduler will bind the pod on its next cycle
-            # (`mig_controller.go:121-144`).
-            return Result(requeue_after=self._retry_interval)
+            # (`mig_controller.go:121-144`); its binding flips node usage,
+            # which flows back as a status-annotation event if anything
+            # else is still pending.
+            return Result()
         self._try_repartition(nodes, wanted, pod)
-        return Result(requeue_after=self._retry_interval)
+        return Result()
 
     # --------------------------------------------------------------- helpers
 

@@ -24,7 +24,6 @@ import threading
 import uuid
 
 from walkai_nos_tpu.api import constants
-from walkai_nos_tpu.controllers.partitioner import NodeController, PodController
 from walkai_nos_tpu.controllers.tpuagent import (
     Actuator,
     Reporter,
@@ -182,31 +181,13 @@ class SimCluster:
         if self._partitioner_wired:
             return
         self._partitioner_wired = True
-        pod_controller = PodController(
-            self.kube, retry_interval=max(self._report_interval * 4, 0.2)
-        )
-        node_controller = NodeController(self.kube)
-        self.manager.add(
-            Controller(
-                constants.PARTITIONER_CONTROLLER_NAME,
-                self.kube,
-                "Pod",
-                pod_controller.reconcile,
-                max_concurrent=1,  # `mig_controller.go:204`
-            )
-        )
-        self.manager.add(
-            Controller(
-                "tpu-node-controller",
-                self.kube,
-                "Node",
-                node_controller.reconcile,
-                predicates=[
-                    predicates.has_label(constants.LABEL_TPU_PARTITIONING)
-                ],
-                max_concurrent=5,  # `node_controller.go:113`
-            )
-        )
+        # The PRODUCTION wiring, verbatim — the sim exists to exercise the
+        # same controllers/predicates the tpupartitioner binary runs.
+        from walkai_nos_tpu.cmd.tpupartitioner import build_manager
+        from walkai_nos_tpu.config import PartitionerConfig
+
+        for controller in build_manager(self.kube, PartitionerConfig()).controllers:
+            self.manager.add(controller)
         # simulators. The device-plugin simulator is keyed on Nodes (which
         # always exist), so its requeue chain survives windows with no
         # plugin pods; pod deletions are healed by the periodic requeue.

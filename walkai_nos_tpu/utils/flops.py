@@ -1,0 +1,52 @@
+"""FLOP accounting helpers for utilization/MFU reporting.
+
+The serving bench reports model FLOPs utilization (achieved FLOP/s over
+the chip's peak); peaks are the published bf16 dense numbers per TPU
+generation. Unknown device kinds return None — the caller reports MFU as
+unavailable rather than guessing.
+"""
+
+from __future__ import annotations
+
+# Published peak dense bf16 FLOP/s per chip, by `device_kind` substring.
+# Checked in order, so more specific strings come first.
+_PEAK_BF16_FLOPS: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 918e12),  # v6e (Trillium)
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_bf16_flops(device_kind: str) -> float | None:
+    """Peak dense bf16 FLOP/s for a jax `device_kind` string, else None."""
+    kind = device_kind.lower()
+    for marker, peak in _PEAK_BF16_FLOPS:
+        if marker in kind:
+            return peak
+    return None
+
+
+def vit_flops_per_image(cfg) -> float:
+    """Analytic forward-pass FLOPs per image for a ViTConfig.
+
+    Fallback when XLA cost analysis is unavailable: dense matmul FLOPs
+    (2mnk) for patch embedding, attention (qkv/out projections + the two
+    T^2 contractions), and the MLP, plus the detection heads.
+    """
+    t = cfg.num_patches + cfg.num_det_tokens
+    d = cfg.hidden_dim
+    layers = cfg.num_layers
+    patch_in = cfg.patch_size * cfg.patch_size * 3
+    embed = 2 * cfg.num_patches * patch_in * d
+    qkv = 2 * t * d * 3 * d
+    attn = 2 * (2 * t * t * d)  # scores + weighted values
+    out = 2 * t * d * d
+    mlp = 2 * (2 * t * d * cfg.mlp_ratio * d)
+    heads = 2 * cfg.num_det_tokens * d * (cfg.num_classes + 4)
+    return float(embed + layers * (qkv + attn + out + mlp) + heads)

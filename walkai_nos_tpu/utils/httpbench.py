@@ -1,0 +1,88 @@
+"""Shared helpers for driving the demo inference server over HTTP.
+
+Used by the headline bench (`bench.py`) and the serving-path tests
+(`tests/test_demo_server.py`) so the boot/teardown and client paths
+cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SERVER_PATH = os.path.join(
+    REPO, "demos", "tpu-sharing-comparison", "app", "main.py"
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def post_infer(base: str, batch: int, timeout: float = 150.0) -> dict:
+    req = urllib.request.Request(
+        f"{base}/infer",
+        data=json.dumps({"batch": batch}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def spawn_server(
+    env_overrides: dict[str, str],
+    startup_timeout_s: float,
+    poll_s: float = 0.5,
+) -> tuple[subprocess.Popen, str]:
+    """Start the demo server on a free port; wait for /healthz.
+
+    Returns (process, base_url); raises RuntimeError (with the process
+    reaped) if it exits or never becomes healthy.
+    """
+    port = free_port()
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env["PORT"] = str(port)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, SERVER_PATH],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + startup_timeout_s
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError("demo server exited during startup")
+        try:
+            get_json(f"{base}/healthz", timeout=2.0)
+            return proc, base
+        except Exception:
+            if time.monotonic() > deadline:
+                kill_server(proc)
+                raise RuntimeError("demo server never became healthy")
+            time.sleep(poll_s)
+
+
+def kill_server(proc: subprocess.Popen) -> None:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
