@@ -1,9 +1,93 @@
-{{- define "walkai-nos.image" -}}
-{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{/*
+Create chart name and version as used by the chart label
+(reference: helm-charts/nos/templates/_helpers.tpl).
+*/}}
+{{- define "walkai-nos.chart" -}}
+{{- printf "%s-%s" .Chart.Name .Chart.Version | replace "+" "_" | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{/*
+Full name including the release name.
+*/}}
+{{- define "walkai-nos.fullname" -}}
+{{- $name := .Chart.Name -}}
+{{- if contains $name .Release.Name -}}
+{{- .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- (printf "%s-%s" .Release.Name $name) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
 {{- end -}}
 
+{{/*
+Common labels.
+*/}}
 {{- define "walkai-nos.labels" -}}
-app.kubernetes.io/part-of: walkai-nos-tpu
+helm.sh/chart: {{ include "walkai-nos.chart" . }}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- if .Chart.AppVersion }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+{{- end }}
 app.kubernetes.io/managed-by: {{ .Release.Service }}
-helm.sh/chart: {{ .Chart.Name }}-{{ .Chart.Version }}
+{{- end }}
+
+{{/*
+Per-component image refs: tag defaults to the chart appVersion
+(reference: values.yaml image.tag docs).
+*/}}
+{{- define "walkai-nos.partitioner.image" -}}
+{{ .Values.partitioner.image.repository }}:{{ .Values.partitioner.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "walkai-nos.agent.image" -}}
+{{ .Values.agent.image.repository }}:{{ .Values.agent.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "walkai-nos.sharingAgent.image" -}}
+{{ .Values.sharingAgent.image.repository }}:{{ .Values.sharingAgent.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "walkai-nos.scheduler.image" -}}
+{{ .Values.scheduler.image.repository }}:{{ .Values.scheduler.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "walkai-nos.clusterInfoExporter.image" -}}
+{{ .Values.clusterInfoExporter.image.repository }}:{{ .Values.clusterInfoExporter.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "walkai-nos.kubeRbacProxy.image" -}}
+{{ .Values.kubeRbacProxy.image.repository }}:{{ .Values.kubeRbacProxy.image.tag }}
+{{- end -}}
+
+{{/*
+kube-rbac-proxy sidecar container protecting 127.0.0.1:8080 /metrics
+(reference: helm-charts/nos/values.yaml:41-55 + the auth-proxy
+clusterrole in templates/gpu-partitioner/).
+*/}}
+{{- define "walkai-nos.kubeRbacProxy.container" -}}
+- name: kube-rbac-proxy
+  image: {{ include "walkai-nos.kubeRbacProxy.image" . }}
+  imagePullPolicy: {{ .Values.kubeRbacProxy.image.pullPolicy }}
+  args:
+    - --secure-listen-address=0.0.0.0:8443
+    - --upstream=http://127.0.0.1:8080/
+    - --logtostderr=true
+    - --v={{ .Values.kubeRbacProxy.logLevel }}
+  ports:
+    - containerPort: 8443
+      name: https-metrics
+  resources:
+    {{- toYaml .Values.kubeRbacProxy.resources | nindent 4 }}
+{{- end -}}
+
+{{/*
+ConfigMap names used by the UUID-persistence pattern
+(reference: _helpers.tpl nos.installationInfoConfigMap.name).
+*/}}
+{{- define "walkai-nos.metricsConfigMap.name" -}}
+{{- printf "%s-metrics" (include "walkai-nos.fullname" .) -}}
+{{- end -}}
+
+{{- define "walkai-nos.installationInfoConfigMap.name" -}}
+{{- printf "%s-installation-info" (include "walkai-nos.fullname" .) -}}
 {{- end -}}

@@ -218,6 +218,37 @@ class TestController:
         finally:
             ctrl.stop()
 
+    def test_controller_restarts_after_stop(self):
+        """Leader election stops and later restarts the manager; a
+        stopped controller must come back to life (fresh work queue)."""
+        c = FakeKubeClient()
+        seen = []
+        lock = threading.Lock()
+
+        def reconcile(req: Request) -> Result:
+            with lock:
+                seen.append(req.name)
+            return Result()
+
+        ctrl = Controller("t", c, "Node", reconcile)
+        ctrl.start()
+        try:
+            c.create("Node", node("n1"))
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and "n1" not in seen:
+                time.sleep(0.01)
+            assert "n1" in seen
+            ctrl.stop()
+            ctrl.start()  # lease re-acquired
+            c.create("Node", node("n2"))
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline and "n2" not in seen:
+                time.sleep(0.01)
+            with lock:
+                assert "n2" in seen
+        finally:
+            ctrl.stop()
+
     def test_error_backoff_retries(self):
         c = FakeKubeClient()
         c.create("Node", node("n1"))

@@ -3,8 +3,28 @@
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
+
+_SA_NAMESPACE_FILE = (
+    "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+)
+
+
+def current_namespace(default: str = "default") -> str:
+    """The namespace this process runs in: POD_NAMESPACE env (downward
+    API) first, then the service-account namespace file. Leader-election
+    leases must live here — RBAC only grants Lease access in the release
+    namespace."""
+    ns = os.environ.get("POD_NAMESPACE")
+    if ns:
+        return ns
+    try:
+        with open(_SA_NAMESPACE_FILE) as f:
+            return f.read().strip() or default
+    except OSError:
+        return default
 
 
 def setup_logging(level: str = "info") -> None:
@@ -33,13 +53,41 @@ def build_kube_client():
     return RestKubeClient()
 
 
-def start_health(addr: str):
+class _Servers:
+    """Health (+ optional separate metrics) servers as one handle."""
+
+    def __init__(self, health, metrics_server):
+        self._health = health
+        self._metrics_server = metrics_server
+        self.metrics = health.metrics
+
+    def mark_ready(self) -> None:
+        self._health.mark_ready()
+
+    def mark_unready(self) -> None:
+        self._health.mark_unready()
+
+    def stop(self) -> None:
+        self._health.stop()
+        if self._metrics_server:
+            self._metrics_server.stop()
+
+
+def start_health(addr: str, metrics_addr: str | None = None):
+    """Start the probe server; with `metrics_addr`, serve /metrics on its
+    own address instead (so it can bind 127.0.0.1 behind a kube-rbac-proxy
+    while probes stay reachable by the kubelet)."""
     from walkai_nos_tpu.health import HealthServer
     from walkai_nos_tpu.kube import runtime
 
-    server = HealthServer(addr)
-    server.start()
+    separate = bool(metrics_addr) and metrics_addr != addr
+    health = HealthServer(addr, serve_metrics=not separate)
+    health.start()
+    metrics_server = None
+    if separate:
+        metrics_server = HealthServer(metrics_addr, metrics=health.metrics)
+        metrics_server.start()
     # Controller reconcile metrics flow to this binary's /metrics endpoint
     # (the controller-runtime built-in registry analogue).
-    runtime.set_metrics_registry(server.metrics)
-    return server
+    runtime.set_metrics_registry(health.metrics)
+    return _Servers(health, metrics_server)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 import urllib.error
 import urllib.request
@@ -39,7 +40,11 @@ def send_snapshot(
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="clusterinfoexporter")
     parser.add_argument("--endpoint", required=True)
-    parser.add_argument("--auth-token", default="")
+    # Flag wins; WALKAI_AUTH_TOKEN env is how the Helm chart injects the
+    # token from a Secret without putting it on the command line.
+    parser.add_argument(
+        "--auth-token", default=os.environ.get("WALKAI_AUTH_TOKEN", "")
+    )
     parser.add_argument("--interval", type=float, default=60.0)
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)

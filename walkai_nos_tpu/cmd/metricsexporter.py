@@ -32,18 +32,23 @@ def build_metrics(raw: dict, kube=None) -> dict:
         "nodes": raw.get("nodes", []),
     }
     if kube is not None:
-        nodes = []
-        for node in kube.list("Node"):
-            meta = node.get("metadata") or {}
-            status = node.get("status") or {}
-            nodes.append(
-                {
-                    "name": meta.get("name", ""),
-                    "labels": meta.get("labels") or {},
-                    "capacity": status.get("capacity") or {},
-                }
-            )
-        metrics["nodes"] = nodes
+        try:
+            nodes = []
+            for node in kube.list("Node"):
+                meta = node.get("metadata") or {}
+                status = node.get("status") or {}
+                nodes.append(
+                    {
+                        "name": meta.get("name", ""),
+                        "labels": meta.get("labels") or {},
+                        "capacity": status.get("capacity") or {},
+                    }
+                )
+            metrics["nodes"] = nodes
+        except Exception as e:
+            # The hook pod may run with a low-privilege SA (RBAC denies
+            # node lists); the chart-rendered inventory in `raw` stands.
+            logger.warning("node inventory unavailable: %s", e)
     return metrics
 
 

@@ -90,7 +90,9 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     kube = _common.build_kube_client()
-    health = _common.start_health(config.manager.health_probe_addr)
+    health = _common.start_health(
+        config.manager.health_probe_addr, config.manager.metrics_addr
+    )
     manager = build_manager(kube, config)
     stop = _common.wait_for_shutdown()
 
@@ -100,6 +102,7 @@ def main(argv: list[str] | None = None) -> int:
         elector = LeaderElector(
             kube,
             config.manager.leader_election_id or "tpupartitioner-leader",
+            namespace=_common.current_namespace(),
             on_started_leading=manager.start,
             on_stopped_leading=manager.stop,
         )

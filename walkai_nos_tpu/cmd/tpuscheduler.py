@@ -25,23 +25,14 @@ from walkai_nos_tpu.kube import objects
 from walkai_nos_tpu.kube.client import ApiError, KubeClient, NotFound
 from walkai_nos_tpu.kube.runtime import Controller, Manager, Request, Result
 from walkai_nos_tpu.quota.fit import fits_node
-from walkai_nos_tpu.quota.labeler import CapacityLabeler
+from walkai_nos_tpu.quota.labeler import CapacityLabeler, list_quota_objects
+from walkai_nos_tpu.quota.reconciler import QuotaReconciler
 from walkai_nos_tpu.quota.scheduler import CapacityScheduling
 from walkai_nos_tpu.quota.state import ClusterQuotaState
 
 logger = logging.getLogger("tpuscheduler")
 
 SCHEDULER_NAME = "walkai-nos-scheduler"
-
-
-def list_quota_objects(kube: KubeClient) -> list[dict]:
-    quotas: list[dict] = []
-    for kind in ("ElasticQuota", "CompositeElasticQuota"):
-        try:
-            quotas.extend(kube.list(kind))
-        except ApiError:
-            continue  # CRD not installed
-    return quotas
 
 
 def bind_pod(kube: KubeClient, pod: dict, node_name: str) -> None:
@@ -118,36 +109,6 @@ class Scheduler:
         return Result(requeue_after=5.0)  # no fit; the partitioner may retile
 
 
-class QuotaStatusUpdater:
-    """Keeps ElasticQuota/CompositeElasticQuota status.used current."""
-
-    def __init__(self, kube: KubeClient):
-        self._kube = kube
-
-    def reconcile(self, request: Request) -> Result:
-        state = ClusterQuotaState.build(
-            list_quota_objects(self._kube), self._kube.list("Pod")
-        )
-        for quota in state.quotas:
-            kind = "CompositeElasticQuota" if quota.composite else "ElasticQuota"
-            namespace = quota.object_namespace
-            try:
-                current = self._kube.get(kind, quota.name, namespace)
-            except ApiError:
-                continue
-            used = {k: str(v) for k, v in sorted(quota.used.items())}
-            if ((current.get("status") or {}).get("used") or {}) != used:
-                try:
-                    # Status subresource-aware: a main-resource patch would
-                    # be silently dropped by real API servers.
-                    self._kube.patch_status(
-                        kind, quota.name, {"status": {"used": used}}, namespace
-                    )
-                except ApiError:
-                    continue
-        return Result(requeue_after=10.0)
-
-
 def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Manager:
     manager = Manager()
     manager.add(
@@ -167,14 +128,21 @@ def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Man
             CapacityLabeler(kube).reconcile,
         )
     )
-    manager.add(
-        Controller(
-            "quota-status",
-            kube,
-            "Pod",
-            QuotaStatusUpdater(kube).reconcile,
+    # Quota reconcile loops keyed on the QUOTA objects (the upstream
+    # operator's role): status + labels stay fresh with zero pods and no
+    # scheduling activity.
+    for kind, name in (
+        ("ElasticQuota", "elasticquota-reconciler"),
+        ("CompositeElasticQuota", "compositeelasticquota-reconciler"),
+    ):
+        manager.add(
+            Controller(
+                name,
+                kube,
+                kind,
+                QuotaReconciler(kube, kind).reconcile,
+            )
         )
-    )
     return manager
 
 
@@ -182,18 +150,36 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="tpuscheduler")
     parser.add_argument("--scheduler-name", default=SCHEDULER_NAME)
     parser.add_argument("--health-probe-addr", default=":8081")
+    parser.add_argument("--metrics-addr", default=":8080")
+    parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
     _common.setup_logging(args.log_level)
 
     kube = _common.build_kube_client()
-    health = _common.start_health(args.health_probe_addr)
+    health = _common.start_health(args.health_probe_addr, args.metrics_addr)
     manager = build_manager(kube, args.scheduler_name)
     stop = _common.wait_for_shutdown()
-    manager.start()
-    health.mark_ready()
-    stop.wait()
-    manager.stop()
+
+    if args.leader_elect:
+        from walkai_nos_tpu.kube.leader import LeaderElector
+
+        elector = LeaderElector(
+            kube,
+            "tpuscheduler-leader",
+            namespace=_common.current_namespace(),
+            on_started_leading=manager.start,
+            on_stopped_leading=manager.stop,
+        )
+        elector.start()
+        health.mark_ready()
+        stop.wait()
+        elector.stop()
+    else:
+        manager.start()
+        health.mark_ready()
+        stop.wait()
+        manager.stop()
     health.stop()
     return 0
 

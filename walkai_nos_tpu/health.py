@@ -70,11 +70,19 @@ class Metrics:
 class HealthServer:
     """Serves /healthz, /readyz, /metrics on one address."""
 
-    def __init__(self, addr: str = ":8081", metrics: Metrics | None = None):
+    def __init__(
+        self,
+        addr: str = ":8081",
+        metrics: Metrics | None = None,
+        serve_metrics: bool = True,
+    ):
         host, _, port = addr.rpartition(":")
         self._host = host or "0.0.0.0"
         self._port = int(port)
         self.metrics = metrics or Metrics()
+        # False when metrics live on a dedicated (proxied) address — the
+        # probe port must not leak them unauthenticated.
+        self._serve_metrics = serve_metrics
         self._ready = threading.Event()
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -94,6 +102,7 @@ class HealthServer:
     def start(self) -> None:
         ready = self._ready
         metrics = self.metrics
+        serve_metrics = self._serve_metrics
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
@@ -104,7 +113,7 @@ class HealthServer:
                         self._respond(200, "ok")
                     else:
                         self._respond(503, "not ready")
-                elif self.path == "/metrics":
+                elif self.path == "/metrics" and serve_metrics:
                     self._respond(200, metrics.render())
                 else:
                     self._respond(404, "not found")

@@ -141,6 +141,11 @@ class _WorkQueue:
             self._shutdown = True
             self._cond.notify_all()
 
+    @property
+    def is_shutdown(self) -> bool:
+        with self._cond:
+            return self._shutdown
+
 
 class Controller:
     """One watch + one reconciler (`ctrl.NewControllerManagedBy` analogue)."""
@@ -173,6 +178,7 @@ class Controller:
     # ----------------------------------------------------------------- watch
 
     def _watch_loop(self) -> None:
+        backoff = 0.5
         while not self._stop:
             try:
                 stream = self.client.watch(
@@ -192,6 +198,7 @@ class Controller:
                 with self._cache_lock:
                     unconfirmed: set | None = set(self._cache)
                 for event, obj in stream:
+                    backoff = 0.5  # stream delivering: reset failure backoff
                     if event == RESYNC:
                         with self._cache_lock:
                             unconfirmed = set(self._cache)
@@ -216,12 +223,17 @@ class Controller:
                         break
             except Exception:
                 if not self._stop:
+                    # Capped exponential backoff: a persistently failing
+                    # watch (e.g. a CRD that is simply not installed)
+                    # must not hot-loop full-traceback warnings forever.
                     logger.warning(
-                        "%s: watch failed, retrying:\n%s",
+                        "%s: watch failed, retrying in %.1fs:\n%s",
                         self.name,
+                        backoff,
                         traceback.format_exc(),
                     )
-                    time.sleep(0.5)
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 30.0)
 
     def _handle_event(self, event: str, obj: Mapping) -> None:
         key = (objects.namespace(obj), objects.name(obj))
@@ -277,6 +289,11 @@ class Controller:
 
     def start(self) -> None:
         self._stop = False
+        if self.queue.is_shutdown:
+            # A stopped controller can be restarted (leader election loses
+            # and re-acquires the lease); a shut-down queue is dead, so
+            # build a fresh one.
+            self.queue = _WorkQueue()
         self.watch_ready.clear()
         t = threading.Thread(
             target=self._watch_loop, name=f"{self.name}-watch", daemon=True

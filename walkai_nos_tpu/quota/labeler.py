@@ -26,6 +26,86 @@ IN_QUOTA = "in-quota"
 OVER_QUOTA = "over-quota"
 
 
+def relabel_quota_pods(kube: KubeClient, quota, all_pods: list[dict]) -> None:
+    """Refresh capacity labels for every pod governed by `quota`.
+
+    Aggregates across all governed namespaces (composite quotas span
+    several), in (creation ts, requested asc) order (`key-concepts.md:21`):
+    cumulative usage is summed in that order and every pod past the
+    quota's `min` is labelled over-quota.
+    """
+    from walkai_nos_tpu.quota.state import pod_holds_quota
+
+    pods = [
+        p
+        for p in all_pods
+        if (objects.namespace(p) or "default") in quota.namespaces
+        and pod_holds_quota(p)
+    ]
+    pods.sort(
+        key=lambda p: (
+            (p.get("metadata") or {}).get("creationTimestamp") or "",
+            sum(pod_quota_request(p).values()),
+        )
+    )
+    cumulative: dict[str, int] = {}
+    for pod in pods:
+        request_res = pod_quota_request(pod)
+        within = all(
+            cumulative.get(k, 0) + v <= quota.min.get(k, 0)
+            for k, v in request_res.items()
+        )
+        for k, v in request_res.items():
+            cumulative[k] = cumulative.get(k, 0) + v
+        desired = IN_QUOTA if within else OVER_QUOTA
+        if objects.labels(pod).get(LABEL_CAPACITY) != desired:
+            try:
+                kube.patch(
+                    "Pod",
+                    objects.name(pod),
+                    {"metadata": {"labels": {LABEL_CAPACITY: desired}}},
+                    objects.namespace(pod) or "default",
+                )
+            except ApiError as e:
+                logger.warning(
+                    "capacity label on %s/%s failed: %s",
+                    objects.namespace(pod),
+                    objects.name(pod),
+                    e,
+                )
+
+
+def update_quota_status(kube: KubeClient, quota) -> None:
+    """Patch the quota object's status.used when it drifted (including
+    initializing an absent status to the empty map)."""
+    kind = "CompositeElasticQuota" if quota.composite else "ElasticQuota"
+    try:
+        obj = kube.get(kind, quota.name, quota.object_namespace)
+    except ApiError:
+        return
+    used = {k: str(v) for k, v in sorted(quota.used.items())}
+    if (obj.get("status") or {}).get("used") != used:
+        try:
+            # Status subresource-aware: a main-resource patch would be
+            # silently dropped by real API servers.
+            kube.patch_status(
+                kind, quota.name, {"status": {"used": used}},
+                quota.object_namespace,
+            )
+        except ApiError as e:
+            logger.warning("quota %s status update failed: %s", quota.name, e)
+
+
+def list_quota_objects(kube: KubeClient) -> list[dict]:
+    quotas: list[dict] = []
+    for kind in ("ElasticQuota", "CompositeElasticQuota"):
+        try:
+            quotas.extend(kube.list(kind))
+        except ApiError:
+            continue  # CRD not installed
+    return quotas
+
+
 class CapacityLabeler:
     """Reconciles one namespace's capacity labels per pod event."""
 
@@ -34,61 +114,13 @@ class CapacityLabeler:
 
     def reconcile(self, request: Request) -> Result:
         namespace = request.namespace or "default"
-        state = ClusterQuotaState.build(
-            self._list_quotas(), self._kube.list("Pod")
-        )
+        all_pods = self._kube.list("Pod")
+        state = ClusterQuotaState.build(list_quota_objects(self._kube), all_pods)
         quota = state.for_namespace(namespace)
         if quota is None:
             return Result()
-
-        # Aggregate across all governed namespaces (composite quotas span
-        # several), in (creation ts, requested asc) order (`key-concepts.md:21`).
-        from walkai_nos_tpu.quota.state import pod_holds_quota
-
-        pods = [
-            p
-            for p in self._kube.list("Pod")
-            if (objects.namespace(p) or "default") in quota.namespaces
-            and pod_holds_quota(p)
-        ]
-        pods.sort(
-            key=lambda p: (
-                (p.get("metadata") or {}).get("creationTimestamp") or "",
-                sum(pod_quota_request(p).values()),
-            )
-        )
-        cumulative: dict[str, int] = {}
-        for pod in pods:
-            request_res = pod_quota_request(pod)
-            within = all(
-                cumulative.get(k, 0) + v <= quota.min.get(k, 0)
-                for k, v in request_res.items()
-            )
-            for k, v in request_res.items():
-                cumulative[k] = cumulative.get(k, 0) + v
-            desired = IN_QUOTA if within else OVER_QUOTA
-            if objects.labels(pod).get(LABEL_CAPACITY) != desired:
-                try:
-                    self._kube.patch(
-                        "Pod",
-                        objects.name(pod),
-                        {"metadata": {"labels": {LABEL_CAPACITY: desired}}},
-                        objects.namespace(pod) or "default",
-                    )
-                except ApiError as e:
-                    logger.warning(
-                        "capacity label on %s/%s failed: %s",
-                        objects.namespace(pod),
-                        objects.name(pod),
-                        e,
-                    )
+        relabel_quota_pods(self._kube, quota, all_pods)
+        # Keep status fresh on the pod-event path too; the quota-keyed
+        # reconciler covers drift with no pod events at all.
+        update_quota_status(self._kube, quota)
         return Result()
-
-    def _list_quotas(self) -> list[dict]:
-        quotas: list[dict] = []
-        for kind in ("ElasticQuota", "CompositeElasticQuota"):
-            try:
-                quotas.extend(self._kube.list(kind))
-            except ApiError:
-                continue  # CRD not installed
-        return quotas

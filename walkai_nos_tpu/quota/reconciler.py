@@ -1,0 +1,68 @@
+"""Quota reconcile loop: status + capacity labels, independent of
+scheduling.
+
+The upstream nos operator continuously reconciled ElasticQuota /
+CompositeElasticQuota objects (the fork kept only docs,
+`docs/en/docs/elastic-resource-quota/key-concepts.md:9-40`); here that
+role is a controller keyed on the QUOTA objects themselves, so
+`status.used` and the `nos.walkai.io/capacity` pod labels converge even
+with zero pending pods and no scheduling activity — a quota created in
+an empty cluster gets its status set, and labels heal after pod
+deletions without waiting for the next scheduling cycle.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient, NotFound
+from walkai_nos_tpu.kube.runtime import Request, Result
+from walkai_nos_tpu.quota.labeler import (
+    list_quota_objects,
+    relabel_quota_pods,
+    update_quota_status,
+)
+from walkai_nos_tpu.quota.state import ClusterQuotaState
+
+logger = logging.getLogger(__name__)
+
+
+class QuotaReconciler:
+    """Reconciles one quota object per event, plus an interval requeue."""
+
+    def __init__(
+        self, kube: KubeClient, kind: str, interval: float = 10.0
+    ) -> None:
+        self._kube = kube
+        self._kind = kind
+        self._interval = interval
+
+    def reconcile(self, request: Request) -> Result:
+        try:
+            obj = self._kube.get(
+                self._kind, request.name, request.namespace or None
+            )
+        except NotFound:
+            return Result()
+        all_pods = self._kube.list("Pod")
+        state = ClusterQuotaState.build(
+            list_quota_objects(self._kube), all_pods
+        )
+        composite = self._kind == "CompositeElasticQuota"
+        namespace = objects.namespace(obj) or "default"
+        quota = next(
+            (
+                q
+                for q in state.quotas
+                if q.name == objects.name(obj)
+                and q.composite == composite
+                and q.object_namespace == namespace
+            ),
+            None,
+        )
+        if quota is None:
+            return Result(requeue_after=self._interval)
+        update_quota_status(self._kube, quota)
+        relabel_quota_pods(self._kube, quota, all_pods)
+        return Result(requeue_after=self._interval)
