@@ -16,6 +16,15 @@
  *     parser: "<profile>@<o0>-<o1>[-<o2>]:<d0>x<d1>[x<d2>]"
  *     e.g. "2x2@0-2:2x2"  (profile 2x2 anchored at (0,2), orientation 2x2).
  *
+ * Enforcement contract: unlike MIG, a slice here is NOT a driver-level
+ * partition. Isolation is *env visibility* — the device plugin injects
+ * TPU_VISIBLE_CHIPS / TPU_CHIPS_PER_PROCESS_BOUNDS / TPU_PROCESS_BOUNDS
+ * (synthesized in `walkai_nos_tpu/tpudev/env.py` from the slice records
+ * this library persists) into the allocated container, so libtpu only
+ * initializes the slice's chips. This library's job is the durable,
+ * conflict-checked record of which chips belong to which slice; it does
+ * not (and cannot) fence ICI traffic between co-resident slices.
+ *
  * Configuration (read at tpudev_init):
  *   TPUDEV_DEV_DIR    chip device directory        (default /dev)
  *   TPUDEV_STATE_DIR  slice-state directory        (default /var/run/walkai-tpudev)

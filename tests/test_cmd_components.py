@@ -206,6 +206,37 @@ class TestClusterInfoCollector:
         inv = next(t for t in snap.tpus if "2x2" in t.tpu)
         assert inv.allocated == 1 and inv.available == 1
 
+    def test_multi_host_pool_reported_whole(self):
+        """A multi-host pool is never partitioned but its capacity must not
+        vanish from the inventory: it is reported as one whole slice."""
+        kube = FakeKubeClient()
+        node = _node("mh1", accelerator="tpu-v5p-slice",
+                     capacity={"google.com/tpu": "4"})
+        node["metadata"]["labels"]["cloud.google.com/gke-tpu-topology"] = "2x2x2"
+        kube.create("Node", node)
+        kube.create(
+            "Pod",
+            {
+                "metadata": {"name": "whole", "namespace": "default"},
+                "spec": {
+                    "nodeName": "mh1",
+                    "containers": [
+                        {
+                            "name": "main",
+                            "resources": {
+                                "requests": {"google.com/tpu": "4"}
+                            },
+                        }
+                    ],
+                },
+                "status": {"phase": "Running"},
+            },
+        )
+        snap = Collector(kube).collect()
+        inv = next(t for t in snap.tpus if t.tpu.startswith("mh1"))
+        assert "2x2x2" in inv.tpu
+        assert inv.allocated == 4 and inv.available == 0
+
     def test_pod_summaries(self):
         kube = FakeKubeClient()
         kube.create(

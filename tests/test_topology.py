@@ -48,13 +48,16 @@ class TestGetModel:
         assert model.host_mesh == (2, 2)
         assert model.chips_per_host == 4
 
-    def test_multi_host_topology_label_falls_back_to_host_mesh(self):
-        # 4x4 is a 2-host v5e slice; the per-host mesh stays 2x4.
+    def test_multi_host_topology_refused(self):
+        # 4x4 is a 2-host v5e slice; partitioning it would split the ICI
+        # torus, so the model resolver refuses instead of falling back.
         labels = {
             constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
             constants.LABEL_TPU_TOPOLOGY: "4x4",
         }
-        assert topology.get_model(labels).host_mesh == (2, 4)
+        assert topology.get_model(labels) is None
+        assert topology.is_multi_host(labels)
+        assert topology.get_chip_count(labels) is None
 
     def test_unknown_model(self):
         assert topology.get_model({constants.LABEL_TPU_ACCELERATOR: "gpu"}) is None
@@ -65,3 +68,81 @@ class TestGetModel:
         model = topology.get_model(labels)
         assert model.host_mesh == (2, 2, 1)
         assert topology.get_chip_count(labels) == 4
+
+
+class TestMultiHost:
+    @pytest.mark.parametrize("topo", ["2x2x2", "2x2x4", "4x4x4", "2x4x4"])
+    def test_v5p_multi_host_pools(self, topo):
+        # v5p hosts carry 4 chips (2x2x1); any 8-chip-or-larger pool spans
+        # hosts and must be scheduled whole.
+        labels = {
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice",
+            constants.LABEL_TPU_TOPOLOGY: topo,
+        }
+        assert topology.is_multi_host(labels)
+        assert topology.get_model(labels) is None
+
+    def test_v5p_single_host_pool(self):
+        labels = {
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice",
+            constants.LABEL_TPU_TOPOLOGY: "2x2x1",
+        }
+        assert not topology.is_multi_host(labels)
+        assert topology.get_model(labels).host_mesh == (2, 2, 1)
+
+    def test_no_topology_label_is_single_host(self):
+        labels = {constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice"}
+        assert not topology.is_multi_host(labels)
+
+    def test_malformed_topology_label(self):
+        labels = {
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice",
+            constants.LABEL_TPU_TOPOLOGY: "bogus",
+        }
+        assert not topology.is_multi_host(labels)
+        assert topology.get_model(labels) is not None
+
+    def test_non_tpu_node(self):
+        assert not topology.is_multi_host({})
+
+
+class TestNodeControllerMultiHostGuard:
+    def test_refuses_and_emits_event(self):
+        from walkai_nos_tpu.controllers.partitioner.node_controller import (
+            NodeController,
+        )
+        from walkai_nos_tpu.kube.fake import FakeKubeClient
+        from walkai_nos_tpu.kube.runtime import Request
+
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            {
+                "metadata": {
+                    "name": "tpu-mh",
+                    "labels": {
+                        constants.LABEL_TPU_PARTITIONING: "tiling",
+                        constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice",
+                        constants.LABEL_TPU_TOPOLOGY: "2x2x2",
+                    },
+                    # Partitioned before the pool was recognized as
+                    # multi-host: the guard must clear these.
+                    "annotations": {
+                        f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x2x1": "1",
+                        constants.ANNOTATION_PARTITIONING_PLAN: "123",
+                    },
+                },
+            },
+        )
+        ctrl = NodeController(kube)
+        ctrl.reconcile(Request(name="tpu-mh"))
+        # Not initialized, and stale spec annotations cleared.
+        node = kube.get("Node", "tpu-mh")
+        annos = (node["metadata"].get("annotations") or {})
+        assert not any("spec" in k for k in annos)
+        events = kube.list("Event", namespace="default")
+        assert len(events) == 1
+        assert events[0]["reason"] == "MultiHostTopology"
+        # Idempotent across reconciles.
+        ctrl.reconcile(Request(name="tpu-mh"))
+        assert len(kube.list("Event", namespace="default")) == 1

@@ -70,6 +70,23 @@ class Collector:
             labels = objects.labels(node)
             model = topology.get_model(labels)
             if model is None:
+                if topology.is_multi_host(labels):
+                    # Multi-host pool: never partitioned, but its capacity
+                    # still counts — report it as one whole slice, with the
+                    # full pool topology as the profile.
+                    base = topology.KNOWN_MODELS[
+                        labels[constants.LABEL_TPU_ACCELERATOR]
+                    ]
+                    pool_shape = topology.parse_shape(
+                        labels[constants.LABEL_TPU_TOPOLOGY]
+                    )
+                    whole = topology.TpuModel(
+                        base.name, base.generation, pool_shape,
+                        base.hbm_gb_per_chip,
+                    )
+                    out.extend(
+                        self._inventory_from_capacity(node, whole, pods)
+                    )
                 continue
             entries = self._inventory_from_annotations(node, model)
             if not entries:

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import logging
 
+from walkai_nos_tpu.api import constants
 from walkai_nos_tpu.kube import objects
-from walkai_nos_tpu.kube.client import KubeClient, NotFound
+from walkai_nos_tpu.kube.client import ApiError, KubeClient, NotFound
 from walkai_nos_tpu.kube.runtime import Request, Result
 from walkai_nos_tpu.partitioning.initializer import NodeInitializer
 from walkai_nos_tpu.tpu import topology
@@ -34,11 +35,50 @@ class NodeController:
             return Result()
         if not is_tiling_partitioning_enabled(objects.labels(node)):
             return Result()
+        if topology.is_multi_host(objects.labels(node)):
+            self._refuse_multi_host(node)
+            return Result()
         if self._is_initialized(node):
             return Result()
         logger.info("node controller: initializing node %s", request.name)
         self._initializer.init_node_partitioning(node)
         return Result()
+
+    def _refuse_multi_host(self, node: dict) -> None:
+        """Multi-host pool labeled for partitioning: refuse loudly (event +
+        log) and leave the node schedulable as a whole slice. Deterministic
+        event name makes the refusal idempotent across reconciles."""
+        name = objects.name(node)
+        topo = objects.labels(node).get(constants.LABEL_TPU_TOPOLOGY, "")
+        logger.warning(
+            "node controller: node %s has multi-host topology %s; "
+            "refusing to partition (schedule it whole)", name, topo,
+        )
+        # A node partitioned before it was recognized as multi-host (or
+        # relabeled into a multi-host pool) must stop being actuated:
+        # clear any lingering spec annotations so the agent tears nothing
+        # and the node really is whole.
+        _, spec = parse_node_annotations(objects.annotations(node))
+        if spec:
+            updates: dict[str, str | None] = {a.key: None for a in spec}
+            updates[constants.ANNOTATION_PARTITIONING_PLAN] = None
+            self._kube.patch(
+                "Node", name, {"metadata": {"annotations": updates}}
+            )
+        event = {
+            "metadata": {"name": f"{name}.multi-host-topology"},
+            "involvedObject": {"kind": "Node", "name": name},
+            "reason": "MultiHostTopology",
+            "type": "Warning",
+            "message": (
+                f"topology {topo} spans hosts; dynamic partitioning is "
+                "host-local — the node stays schedulable as a whole slice"
+            ),
+        }
+        try:
+            self._kube.create("Event", event, namespace="default")
+        except ApiError:
+            pass  # already emitted (409) or events unsupported
 
     def _is_initialized(self, node: dict) -> bool:
         """Mesh count == number of spec-annotated meshes

@@ -74,11 +74,36 @@ KNOWN_MODELS: dict[str, TpuModel] = {
 }
 
 
+def is_multi_host(node_labels: Mapping[str, str]) -> bool:
+    """True when `gke-tpu-topology` describes a slice spanning hosts.
+
+    A pool like v5p `2x2x2` (8 chips across two 4-chip hosts) must be
+    scheduled whole — partitioning its per-host mesh would split the ICI
+    torus a running workload depends on. The reference has no analogue
+    (one GPU never spans hosts); TPU-native correctness demands the
+    explicit refusal instead of a silent per-host fallback.
+    """
+    acc = node_labels.get(constants.LABEL_TPU_ACCELERATOR)
+    model = KNOWN_MODELS.get(acc) if acc else None
+    if model is None:
+        return False
+    topo = node_labels.get(constants.LABEL_TPU_TOPOLOGY)
+    if not topo:
+        return False
+    try:
+        shape = parse_shape(topo)
+    except ValueError:
+        return False
+    return shape_chip_count(shape) > model.chips_per_host
+
+
 def get_model(node_labels: Mapping[str, str]) -> TpuModel | None:
     """Resolve the TPU model from node labels (`pkg/gpu/util.go:29-45` analogue).
 
     Honors an explicit `gke-tpu-topology` label when it describes a
     *single-host* mesh smaller than the model default (e.g. a v5e-4 host).
+    Returns None for a multi-host pool (see `is_multi_host`): such nodes
+    are left schedulable as whole slices, never partitioned.
     """
     acc = node_labels.get(constants.LABEL_TPU_ACCELERATOR)
     if acc is None:
@@ -92,9 +117,10 @@ def get_model(node_labels: Mapping[str, str]) -> TpuModel | None:
             shape = parse_shape(topo)
         except ValueError:
             return model
+        if shape_chip_count(shape) > model.chips_per_host:
+            return None  # multi-host slice: refuse to partition
         if (
             len(shape) == len(model.host_mesh)
-            and shape_chip_count(shape) <= model.chips_per_host
             and all(a <= b for a, b in zip(shape, model.host_mesh))
         ):
             return TpuModel(model.name, model.generation, shape, model.hbm_gb_per_chip)

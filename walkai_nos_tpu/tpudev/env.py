@@ -1,0 +1,27 @@
+"""Slice runtime-env synthesis — the enforcement contract of a TPU slice.
+
+Where the reference's MIG layer gets hardware-level isolation from the
+driver (`pkg/gpu/nvml/client.go` creates GPU/compute instances), a TPU
+"slice" on a host is enforced by *visibility*: the device plugin injects
+this env into the allocated container so the JAX/libtpu process only
+initializes its sub-mesh. This module is that contract, shared by the
+real native client (`tpudev/native.py`) and the in-memory fake
+(`tpudev/fake.py`); see also `native/tpudev/tpudev.h`.
+"""
+
+from __future__ import annotations
+
+from walkai_nos_tpu.tpu import topology as topo
+
+
+def make_slice_env(mesh: topo.Shape, placement, chip_ids: tuple[int, ...]) -> dict:
+    """TPU runtime env for a slice: what the device plugin injects so a JAX
+    process only initializes its sub-slice."""
+    return {
+        "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chip_ids),
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": ",".join(
+            str(d) for d in (tuple(placement.orientation) + (1, 1, 1))[:3]
+        ),
+        "TPU_SLICE_ID": placement.slice_id(),
+    }

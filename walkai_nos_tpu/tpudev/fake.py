@@ -18,19 +18,7 @@ from walkai_nos_tpu.tpudev.client import (
     SliceInfo,
     TpudevClient,
 )
-
-
-def make_slice_env(mesh: topo.Shape, placement, chip_ids: tuple[int, ...]) -> dict:
-    """TPU runtime env for a slice: what the device plugin injects so a JAX
-    process only initializes its sub-slice."""
-    return {
-        "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chip_ids),
-        "TPU_PROCESS_BOUNDS": "1,1,1",
-        "TPU_CHIPS_PER_PROCESS_BOUNDS": ",".join(
-            str(d) for d in (tuple(placement.orientation) + (1, 1, 1))[:3]
-        ),
-        "TPU_SLICE_ID": placement.slice_id(),
-    }
+from walkai_nos_tpu.tpudev.env import make_slice_env
 
 
 class FakeTpudevClient(TpudevClient):

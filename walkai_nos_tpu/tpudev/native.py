@@ -106,7 +106,7 @@ class NativeTpudevClient(TpudevClient):
         )
 
     def _slice_from_json(self, s: dict, mesh) -> SliceInfo:
-        from walkai_nos_tpu.tpudev.fake import make_slice_env
+        from walkai_nos_tpu.tpudev.env import make_slice_env
         from walkai_nos_tpu.tpu.tiling.packing import Placement
 
         placement = Placement(
@@ -139,6 +139,7 @@ class NativeTpudevClient(TpudevClient):
     def create_slices(self, placements: list) -> list[SliceInfo]:
         created: list[SliceInfo] = []
         errors: list[str] = []
+        mesh = self.get_topology().mesh  # one native call for the batch
         for p in placements:
             text = (
                 f"{p.profile}@"
@@ -153,9 +154,7 @@ class NativeTpudevClient(TpudevClient):
             except GenericError as e:
                 errors.append(str(e))
                 continue
-            created.append(
-                self._slice_from_json(data, self.get_topology().mesh)
-            )
+            created.append(self._slice_from_json(data, mesh))
         if not created and errors:
             raise GenericError("; ".join(errors))
         return created
