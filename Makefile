@@ -4,12 +4,22 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test native bench dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test e2e e2e-kind native bench dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
 test:
 	python -m pytest tests/ -q
+
+# Envtest-grade e2e: real RestKubeClient wire path (HTTP watch framing,
+# merge patches, pods/binding) against the in-process API server.
+e2e:
+	python -m pytest tests/test_e2e_apiserver.py tests/test_rest_client.py -q
+
+# Full kind-cluster e2e: create the cluster, deploy with fake tpudev
+# hosts, and run the §7.3 scenario (see hack/kind/e2e.sh).
+e2e-kind: kind-cluster
+	bash hack/kind/e2e.sh $(KIND_CLUSTER)
 
 native:
 	$(MAKE) -C native/tpudev
@@ -25,7 +35,8 @@ docker-build:
 
 # Local e2e flow (reference: Makefile:115-117 + hack/kind/cluster.yaml).
 kind-cluster:
-	kind create cluster --name $(KIND_CLUSTER) --config hack/kind/cluster.yaml
+	kind get clusters 2>/dev/null | grep -qx $(KIND_CLUSTER) || \
+	    kind create cluster --name $(KIND_CLUSTER) --config hack/kind/cluster.yaml
 
 deploy:
 	kubectl apply -f deploy/crds/ -f deploy/common/ \

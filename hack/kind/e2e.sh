@@ -1,0 +1,100 @@
+#!/usr/bin/env bash
+# Scripted kind-cluster e2e: the §7.3 scenario against a REAL API server,
+# kubelet, and scheduler — the analogue of the reference's envtest suites
+# (`internal/controllers/migagent/suite_int_test.go:33-163`) plus its kind
+# flow (`Makefile:115-117`, `hack/kind/cluster.yaml`).
+#
+# Flow: build + load the image, helm-install with WALKAI_TPUDEV_FAKE
+# agents (fake chips, real device-plugin gRPC registration with the
+# node's kubelet), label a worker as a 2x4 TPU host, then:
+#   node init -> agent materializes + reports -> pending 2x2 pod ->
+#   partitioner re-tiles -> kubelet re-advertises -> pod schedules.
+#
+# Usage: hack/kind/e2e.sh [cluster-name]   (cluster must already exist:
+# `make kind-cluster`, or let `make e2e-kind` create it)
+set -euo pipefail
+
+CLUSTER=${1:-walkai-nos}
+IMG=${IMG:-ghcr.io/walkai/nos-tpu:e2e}
+NS=walkai-nos
+WORKER="${CLUSTER}-worker"
+
+say() { echo ">>> $*"; }
+
+say "building image ${IMG}"
+docker build -f build/Dockerfile -t "${IMG}" .
+kind load docker-image --name "${CLUSTER}" "${IMG}"
+
+say "installing chart with fake tpudev (2x4 mesh)"
+# Every enabled component must run the locally built image; the
+# kube-rbac-proxy sidecar is disabled so the flow has no external image
+# dependencies beyond busybox.
+helm upgrade --install walkai-nos helm-charts/walkai-nos-tpu \
+  --namespace "${NS}" --create-namespace \
+  --set partitioner.image.repository="${IMG%:*}" \
+  --set partitioner.image.tag="${IMG##*:}" \
+  --set agent.image.repository="${IMG%:*}" \
+  --set agent.image.tag="${IMG##*:}" \
+  --set scheduler.image.repository="${IMG%:*}" \
+  --set scheduler.image.tag="${IMG##*:}" \
+  --set clusterInfoExporter.enabled=false \
+  --set kubeRbacProxy.enabled=false \
+  --set agent.extraEnv[0].name=WALKAI_TPUDEV_FAKE \
+  --set agent.extraEnv[0].value=2x4 \
+  --wait --timeout 180s
+
+say "labeling ${WORKER} as a v5e 2x4 TPU host"
+kubectl label node "${WORKER}" --overwrite \
+  cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice \
+  cloud.google.com/gke-tpu-topology=2x4 \
+  nos.walkai.io/tpu-partitioning=tiling
+
+say "waiting for node init (spec annotations)"
+for i in $(seq 1 60); do
+  kubectl get node "${WORKER}" -o json \
+    | grep -q 'nos.walkai.io/spec-tpu' && break
+  sleep 2
+done
+kubectl get node "${WORKER}" -o json | grep -q 'nos.walkai.io/spec-tpu' \
+  || { echo "FAIL: node never initialized"; exit 1; }
+
+say "waiting for agent status report"
+for i in $(seq 1 60); do
+  kubectl get node "${WORKER}" -o json \
+    | grep -q 'nos.walkai.io/status-tpu' && break
+  sleep 2
+done
+kubectl get node "${WORKER}" -o json | grep -q 'nos.walkai.io/status-tpu' \
+  || { echo "FAIL: agent never reported"; exit 1; }
+
+say "creating a pending 2x2 slice pod"
+kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-slice-pod
+  namespace: default
+spec:
+  restartPolicy: Never
+  containers:
+    - name: main
+      image: busybox:1.36
+      command: ["sleep", "300"]
+      resources:
+        requests: {"walkai.io/tpu-2x2": "1"}
+        limits: {"walkai.io/tpu-2x2": "1"}
+EOF
+
+say "waiting for the pod to schedule (retile -> advertise -> bind)"
+if ! kubectl wait pod/e2e-slice-pod --for=condition=PodScheduled \
+    --timeout=180s; then
+  echo "FAIL: pod never scheduled"
+  kubectl describe pod e2e-slice-pod | tail -20
+  kubectl -n "${NS}" logs -l app.kubernetes.io/component=partitioner \
+    --tail=50 || true
+  exit 1
+fi
+
+say "PASS: e2e scenario complete"
+kubectl get node "${WORKER}" -o jsonpath='{.metadata.annotations}' \
+  | tr ',' '\n' | grep nos.walkai.io | sed 's/^/    /'

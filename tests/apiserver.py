@@ -23,15 +23,9 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-
-def merge_patch(target: dict, patch: dict) -> None:
-    for k, v in patch.items():
-        if v is None:
-            target.pop(k, None)
-        elif isinstance(v, dict) and isinstance(target.get(k), dict):
-            merge_patch(target[k], v)
-        else:
-            target[k] = v
+# The PRODUCT's RFC 7386 implementation (`kube/objects.py`) — the test
+# server must agree with the client on patch semantics, not re-derive them.
+from walkai_nos_tpu.kube.objects import merge_patch
 
 
 def _matches_labels(obj: dict, sel: dict) -> bool:
@@ -242,7 +236,8 @@ class MiniApiServer:
                     if obj is None:
                         self._send(404, {"message": "not found"})
                         return
-                    merge_patch(obj, patch)
+                    obj = merge_patch(obj, patch)
+                    outer._objects[key] = obj
                     outer._bump(plural, key[1], "MODIFIED", obj)
                     self._send(200, obj)
 

@@ -29,3 +29,14 @@ from walkai_nos_tpu.tpu.tiling import known_tilings  # noqa: E402
 def _reset_geometry_overrides():
     yield
     known_tilings.clear_known_geometries()
+
+
+@pytest.fixture()
+def api():
+    """In-process HTTP API server (tests/apiserver.py); yields its URL."""
+    from tests.apiserver import MiniApiServer
+
+    server = MiniApiServer()
+    url = server.start()
+    yield url
+    server.stop()

@@ -1,0 +1,22 @@
+"""Shared test helpers (the factory/util analogue of `pkg/test/util`)."""
+
+from __future__ import annotations
+
+import time
+
+
+def eventually(fn, timeout=10.0, interval=0.05, msg="condition"):
+    """Poll `fn` until truthy — the Gomega `Eventually` analogue used by
+    every controller-loop suite. Exceptions are retried (assertion helpers
+    race with controllers mid-retile by design)."""
+    deadline = time.monotonic() + timeout
+    last_exc = None
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+            last_exc = None
+        except Exception as e:
+            last_exc = e
+        time.sleep(interval)
+    raise AssertionError(f"eventually timed out: {msg} (last: {last_exc})")

@@ -1,0 +1,132 @@
+"""Full-stack e2e over a real HTTP API server — the envtest-grade suite.
+
+The reference proves its controllers against a real kube-apiserver via
+envtest (`internal/controllers/migagent/suite_int_test.go:33-163`). Here
+the same §7.3 scenario the FakeKubeClient e2e runs
+(`tests/test_integration_e2e.py`) is exercised with the REAL
+`RestKubeClient` wire path — HTTP watch framing, cluster-wide collection
+routes, JSON merge patches, the pods/binding subresource — against the
+in-process `MiniApiServer` (`tests/apiserver.py`): node init → agent
+actuation in the fake tpudev → status report → pending 2x2 pod →
+re-tile → bind.
+"""
+
+from __future__ import annotations
+
+from tests.helpers import eventually
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.rest import RestKubeClient
+from walkai_nos_tpu.sim.harness import SimCluster
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.device import DeviceStatus
+
+
+class TestE2EOverApiServer:
+    def test_init_report_retile_bind(self, api):
+        kube = RestKubeClient(server=api)
+        sim = SimCluster(report_interval=0.1, kube=kube)
+        sim.add_node("host-a", mesh=(2, 4))
+        with sim:
+            # (a) NodeController initializes the node with the default
+            # fewest-slices tiling over real HTTP patches.
+            def initialized():
+                node = kube.get("Node", "host-a")
+                _, spec = parse_node_annotations(objects.annotations(node))
+                return any(
+                    s.profile == "2x4" and s.quantity == 1 for s in spec
+                )
+
+            eventually(initialized, timeout=30.0, msg="node init (spec 2x4)")
+
+            # (b) the agent actuates and the reporter writes status
+            # annotations + the plan ack.
+            def reported():
+                node = kube.get("Node", "host-a")
+                status, _ = parse_node_annotations(objects.annotations(node))
+                annos = objects.annotations(node)
+                return (
+                    any(s.profile == "2x4" for s in status)
+                    and constants.ANNOTATION_REPORTED_PARTITIONING_PLAN
+                    in annos
+                )
+
+            eventually(reported, timeout=30.0, msg="status report + plan ack")
+
+            # (c) a pending 2x2 pod triggers a re-tile and gets bound via
+            # the pods/binding subresource.
+            sim.create_slice_pod("job-1", "2x2")
+
+            def bound():
+                pod = kube.get("Pod", "job-1", "default")
+                return (pod.get("spec") or {}).get("nodeName") == "host-a"
+
+            eventually(bound, timeout=30.0, msg="pod bound after retile")
+
+            # (d) the node's status annotations converge to the used slice.
+            def status_used():
+                node = kube.get("Node", "host-a")
+                status, _ = parse_node_annotations(objects.annotations(node))
+                return any(
+                    s.profile == "2x2"
+                    and s.status == DeviceStatus.USED
+                    and s.quantity >= 1
+                    for s in status
+                )
+
+            eventually(status_used, timeout=30.0, msg="status 2x2 used")
+
+    def test_second_pod_lands_on_remaining_capacity(self, api):
+        kube = RestKubeClient(server=api)
+        sim = SimCluster(report_interval=0.1, kube=kube)
+        sim.add_node("host-a", mesh=(2, 4))
+        with sim:
+            sim.create_slice_pod("job-1", "2x2")
+            sim.create_slice_pod("job-2", "2x2")
+
+            def both_bound():
+                pods = [
+                    kube.get("Pod", n, "default") for n in ("job-1", "job-2")
+                ]
+                return all(
+                    (p.get("spec") or {}).get("nodeName") == "host-a"
+                    for p in pods
+                )
+
+            eventually(both_bound, timeout=30.0, msg="both 2x2 pods bound")
+
+    def test_multi_host_node_refused_over_http(self, api):
+        kube = RestKubeClient(server=api)
+        sim = SimCluster(report_interval=0.1, kube=kube)
+        with sim:
+            kube.create(
+                "Node",
+                {
+                    "metadata": {
+                        "name": "host-mh",
+                        "labels": {
+                            constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice",
+                            constants.LABEL_TPU_TOPOLOGY: "2x2x2",
+                            constants.LABEL_TPU_PARTITIONING: "tiling",
+                        },
+                        "annotations": {
+                            f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x2x1": "1",
+                        },
+                    },
+                },
+            )
+
+            def refused():
+                node = kube.get("Node", "host-mh")
+                annos = objects.annotations(node)
+                if any(
+                    k.startswith(constants.ANNOTATION_TPU_SPEC_PREFIX)
+                    for k in annos
+                ):
+                    return False
+                events = kube.list("Event", namespace="default")
+                return any(
+                    e.get("reason") == "MultiHostTopology" for e in events
+                )
+
+            eventually(refused, timeout=30.0, msg="multi-host refusal event + cleanup")

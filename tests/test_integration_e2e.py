@@ -8,23 +8,13 @@ on node-annotation / pod-scheduling side effects with eventually-semantics.
 
 import time
 
+from tests.helpers import eventually
 from walkai_nos_tpu.api import constants
 from walkai_nos_tpu.kube import objects
 from walkai_nos_tpu.sim import SimCluster
 from walkai_nos_tpu.tpu.annotations import parse_node_annotations
 
 
-def eventually(fn, timeout=10.0, interval=0.05, msg=""):
-    deadline = time.monotonic() + timeout
-    last_exc = None
-    while time.monotonic() < deadline:
-        try:
-            if fn():
-                return
-        except Exception as e:  # assertion helpers may race with controllers
-            last_exc = e
-        time.sleep(interval)
-    raise AssertionError(f"eventually timed out: {msg} (last: {last_exc})")
 
 
 class TestEndToEnd:

@@ -5,218 +5,20 @@ merge-patch, label selectors, streaming watch with resourceVersion) to
 exercise the client's real wire path.
 """
 
-import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
 
 import pytest
+
 
 from walkai_nos_tpu.kube.client import ApiError, NotFound
 from walkai_nos_tpu.kube.rest import RestKubeClient
 from walkai_nos_tpu.kube.runtime import Controller, Request, Result
 
 
-class _MiniApiServer:
-    """Cluster-scoped /api/v1/nodes + namespaced /api/v1/pods, with watch."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._rv = 0
-        self._objects: dict[tuple, dict] = {}  # (plural, ns, name) -> obj
-        self._events: list[tuple[int, str, dict]] = []
-        self._cond = threading.Condition(self._lock)
-        self._httpd = None
-        self._thread = None
-
-    # ------------------------------------------------------------------ state
-
-    def _bump(self, etype, obj):
-        self._rv += 1
-        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-        self._events.append((self._rv, etype, json.loads(json.dumps(obj))))
-        self._cond.notify_all()
-
-    # ---------------------------------------------------------------- serving
-
-    def start(self):
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def _parse(self):
-                u = urlparse(self.path)
-                parts = [p for p in u.path.split("/") if p]
-                # ["api","v1",("namespaces",ns)?,plural,(name)?]
-                assert parts[:2] == ["api", "v1"]
-                rest = parts[2:]
-                ns = ""
-                if rest and rest[0] == "namespaces":
-                    ns = rest[1]
-                    rest = rest[2:]
-                plural = rest[0]
-                name = rest[1] if len(rest) > 1 else None
-                return plural, ns, name, parse_qs(u.query)
-
-            def _send(self, code, payload):
-                data = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def _read_body(self):
-                n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n)) if n else {}
-
-            def do_GET(self):
-                plural, ns, name, query = self._parse()
-                if not name and query.get("watch"):
-                    rv = int(query.get("resourceVersion", ["0"])[0])
-                    self._watch(plural, ns, rv)
-                    return
-                with outer._lock:
-                    if name:
-                        obj = outer._objects.get((plural, ns, name))
-                        if obj is None:
-                            self._send(404, {"message": "not found"})
-                        else:
-                            self._send(200, obj)
-                        return
-                    sel = {}
-                    for pair in query.get("labelSelector", [""])[0].split(","):
-                        if "=" in pair:
-                            k, v = pair.split("=", 1)
-                            sel[k] = v
-                    items = [
-                        o
-                        for (p, n2, _), o in sorted(outer._objects.items())
-                        if p == plural
-                        and (not ns or n2 == ns)
-                        and all(
-                            (o.get("metadata", {}).get("labels") or {}).get(k)
-                            == v
-                            for k, v in sel.items()
-                        )
-                    ]
-                    self._send(
-                        200,
-                        {
-                            "items": items,
-                            "metadata": {"resourceVersion": str(outer._rv)},
-                        },
-                    )
-
-            def _watch(self, plural, ns, rv):
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-                deadline = time.monotonic() + 2.0
-                sent = rv
-                while time.monotonic() < deadline:
-                    with outer._cond:
-                        events = [
-                            (v, t, o)
-                            for v, t, o in outer._events
-                            if v > sent
-                        ]
-                        if not events:
-                            outer._cond.wait(0.1)
-                            continue
-                    for v, etype, obj in events:
-                        line = (
-                            json.dumps({"type": etype, "object": obj}) + "\n"
-                        ).encode()
-                        self.wfile.write(
-                            f"{len(line):x}\r\n".encode() + line + b"\r\n"
-                        )
-                        self.wfile.flush()
-                        sent = v
-                self.wfile.write(b"0\r\n\r\n")
-
-            def do_POST(self):
-                plural, ns, name, _ = self._parse()
-                obj = self._read_body()
-                name = obj["metadata"]["name"]
-                with outer._lock:
-                    key = (plural, ns, name)
-                    if key in outer._objects:
-                        self._send(409, {"message": "exists"})
-                        return
-                    outer._objects[key] = obj
-                    outer._bump("ADDED", obj)
-                    self._send(201, obj)
-
-            def do_PATCH(self):
-                plural, ns, name, _ = self._parse()
-                patch = self._read_body()
-                with outer._lock:
-                    obj = outer._objects.get((plural, ns, name))
-                    if obj is None:
-                        self._send(404, {"message": "not found"})
-                        return
-                    _merge(obj, patch)
-                    outer._bump("MODIFIED", obj)
-                    self._send(200, obj)
-
-            def do_PUT(self):
-                plural, ns, name, _ = self._parse()
-                obj = self._read_body()
-                with outer._lock:
-                    outer._objects[(plural, ns, name)] = obj
-                    outer._bump("MODIFIED", obj)
-                    self._send(200, obj)
-
-            def do_DELETE(self):
-                plural, ns, name, _ = self._parse()
-                with outer._lock:
-                    obj = outer._objects.pop((plural, ns, name), None)
-                    if obj is None:
-                        self._send(404, {"message": "not found"})
-                        return
-                    outer._bump("DELETED", obj)
-                    self._send(200, {})
-
-            def log_message(self, *a):
-                pass
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
-        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
-
-    def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
-
-
-def _merge(target: dict, patch: dict):
-    for k, v in patch.items():
-        if v is None:
-            target.pop(k, None)
-        elif isinstance(v, dict) and isinstance(target.get(k), dict):
-            _merge(target[k], v)
-        else:
-            target[k] = v
-
-
-@pytest.fixture
-def api():
-    server = _MiniApiServer()
-    url = server.start()
-    yield url, server
-    server.stop()
-
-
 class TestRestKubeClient:
     def test_crud_roundtrip(self, api):
-        url, _ = api
+        url = api
         client = RestKubeClient(server=url)
         client.create(
             "Node",
@@ -236,7 +38,7 @@ class TestRestKubeClient:
             client.get("Node", "n1")
 
     def test_namespaced_pods(self, api):
-        url, _ = api
+        url = api
         client = RestKubeClient(server=url)
         client.create(
             "Pod",
@@ -250,7 +52,7 @@ class TestRestKubeClient:
     def test_list_all_namespaces_uses_cluster_path(self, api):
         """namespace=None on a namespaced kind must list ALL namespaces
         (the KubeClient contract) — not silently only 'default'."""
-        url, _ = api
+        url = api
         client = RestKubeClient(server=url)
         client.create("Pod", {"metadata": {"name": "p1", "namespace": "ml"}})
         client.create(
@@ -262,7 +64,7 @@ class TestRestKubeClient:
         assert client.get("Pod", "p2")["metadata"]["namespace"] == "default"
 
     def test_watch_all_namespaces(self, api):
-        url, _ = api
+        url = api
         client = RestKubeClient(server=url)
         client.create("Pod", {"metadata": {"name": "p1", "namespace": "ml"}})
         client.create("Pod", {"metadata": {"name": "p2", "namespace": "ops"}})
@@ -295,7 +97,7 @@ class TestRestKubeClient:
         """After an outage the relist replay is framed RESYNC…SYNCED and
         names only survivors — that framing is what lets consumers drop
         objects deleted during the outage."""
-        url, _ = api
+        url = api
         client = RestKubeClient(server=url)
         admin = RestKubeClient(server=url)
         client.create("Node", {"metadata": {"name": "n1"}})
@@ -326,7 +128,7 @@ class TestRestKubeClient:
     def test_controller_prunes_deleted_during_outage(self, api):
         """End-to-end: a Controller on the real wire path reconciles (and
         un-caches) an object deleted while its watch stream was down."""
-        url, _ = api
+        url = api
         client = RestKubeClient(server=url)
         admin = RestKubeClient(server=url)
         admin.create("Node", {"metadata": {"name": "n1"}})
@@ -356,7 +158,7 @@ class TestRestKubeClient:
             ctrl.stop()
 
     def test_watch_streams_live_events(self, api):
-        url, _ = api
+        url = api
         client = RestKubeClient(server=url)
         client.create("Node", {"metadata": {"name": "n1"}})
         events = []

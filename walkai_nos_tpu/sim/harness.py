@@ -30,7 +30,7 @@ from walkai_nos_tpu.controllers.tpuagent import (
     SharedState,
 )
 from walkai_nos_tpu.kube import objects, predicates
-from walkai_nos_tpu.kube.client import NotFound
+from walkai_nos_tpu.kube.client import KubeClient, NotFound
 from walkai_nos_tpu.kube.fake import FakeKubeClient
 from walkai_nos_tpu.kube.runtime import Controller, Manager, Request, Result
 from walkai_nos_tpu.resource.fake import FakeResourceClient
@@ -79,8 +79,15 @@ class SimNode:
 
 
 class SimCluster:
-    def __init__(self, report_interval: float = 0.05) -> None:
-        self.kube = FakeKubeClient()
+    def __init__(
+        self,
+        report_interval: float = 0.05,
+        kube: "KubeClient | None" = None,
+    ) -> None:
+        # Injectable API-server boundary: FakeKubeClient by default, or a
+        # RestKubeClient against a real HTTP server for envtest-grade e2e
+        # (tests/test_e2e_apiserver.py).
+        self.kube = kube if kube is not None else FakeKubeClient()
         self.nodes: dict[str, SimNode] = {}
         self.manager = Manager()
         self._report_interval = report_interval
@@ -262,11 +269,16 @@ class SimCluster:
                 if satisfiable:
                     for d in chosen:
                         sim.resources.mark_used(d.device_id)
-                    self.kube.patch(
+                    # Bind via the pods/binding subresource (what
+                    # kube-scheduler does; spec.nodeName is immutable on a
+                    # real API server), then report the kubelet's phase.
+                    self.kube.bind_pod(
+                        request.name, request.namespace or "default", name
+                    )
+                    self.kube.patch_status(
                         "Pod",
                         request.name,
                         {
-                            "spec": {"nodeName": name},
                             "status": {
                                 "phase": "Running",
                                 "conditions": [
@@ -279,7 +291,7 @@ class SimCluster:
                     return Result()
         # Unschedulable: record the condition so the partitioner reacts.
         if not objects.pod_is_unschedulable(pod):
-            self.kube.patch(
+            self.kube.patch_status(
                 "Pod",
                 request.name,
                 {
