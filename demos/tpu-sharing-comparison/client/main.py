@@ -27,6 +27,12 @@ def main() -> None:
         help="comma-separated inference server URLs",
     )
     parser.add_argument("--batch", type=int, default=1)
+    parser.add_argument(
+        "--concurrency", type=int, default=1,
+        help="in-flight requests per target (each new connection is "
+        "load-balanced across the Service's server pods, so concurrency N "
+        "against one ClusterIP keeps ~N requests in flight cluster-wide)",
+    )
     parser.add_argument("--metrics-addr", default=":9090")
     args = parser.parse_args()
 
@@ -58,7 +64,10 @@ def main() -> None:
                 time.sleep(1.0)  # back off while the target is unreachable
 
     for target in args.targets.split(","):
-        threading.Thread(target=hammer, args=(target,), daemon=True).start()
+        for _ in range(max(1, args.concurrency)):
+            threading.Thread(
+                target=hammer, args=(target,), daemon=True
+            ).start()
     threading.Event().wait()
 
 
