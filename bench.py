@@ -72,7 +72,12 @@ def serving_benchmark() -> dict:
         {
             "WALKAI_MAX_BATCH": str(MAX_BATCH),
             "WALKAI_MAX_INFLIGHT": "24",
-            "WALKAI_BATCH_WINDOW_MS": "1.0",
+            # ~1/6 of a full-batch compute: long enough to coalesce full
+            # buckets under pipelined load (partial buckets waste padded
+            # MXU work), short enough to not gate dispatch when starved.
+            "WALKAI_BATCH_WINDOW_MS": os.environ.get(
+                "WALKAI_BENCH_WINDOW_MS", "8.0"
+            ),
             "WALKAI_WARM_BUCKETS": ",".join(
                 [
                     str(b)
@@ -191,6 +196,20 @@ def serving_benchmark() -> dict:
         if probe_mean > 0
         else None,
         "client_errors": errors[0],
+        # Gap diagnostics: fraction of dispatched images that were padding,
+        # and dispatcher starvation per measured second.
+        "padding_pct": round(
+            100.0
+            * (stats1["padded_images"] - stats0["padded_images"])
+            / max(1, images + stats1["padded_images"] - stats0["padded_images"]),
+            2,
+        ),
+        "worker_starved_pct": round(
+            100.0
+            * (stats1["worker_starved_s"] - stats0["worker_starved_s"])
+            / max(1e-9, wall),
+            2,
+        ),
         "request_batch": REQUEST_BATCH,
         "device_kind": stats1.get("device_kind"),
         "streams": N_STREAMS,

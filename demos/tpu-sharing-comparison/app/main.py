@@ -64,6 +64,7 @@ class _Request:
 class _Dispatched:
     requests: list
     n_images: int
+    bucket: int  # padded dispatch size (>= n_images)
     output: object  # device array to fence on
 
 
@@ -74,21 +75,47 @@ class _Stats:
         self.requests = 0
         self.batches = 0
         self.flops = 0.0
+        # Diagnostics for the utilization gap: padded images dispatched
+        # (bucket - actual, counted at fence time with the images they
+        # belong to) and time the dispatcher spent starved (blocked
+        # waiting for the FIRST request of a batch — device-feed droughts;
+        # an in-progress wait is included in snapshots).
+        self.padded_images = 0
+        self.worker_starved_s = 0.0
+        self.worker_waiting_since: float | None = None
 
-    def record(self, images, requests, flops) -> None:
+    def record(self, images, requests, padded, flops) -> None:
         with self._lock:
             self.images += images
             self.requests += requests
             self.batches += 1
+            self.padded_images += padded
             self.flops += flops
+
+    def wait_started(self) -> None:
+        with self._lock:
+            self.worker_waiting_since = time.monotonic()
+
+    def wait_ended(self) -> None:
+        with self._lock:
+            if self.worker_waiting_since is not None:
+                self.worker_starved_s += (
+                    time.monotonic() - self.worker_waiting_since
+                )
+                self.worker_waiting_since = None
 
     def snapshot(self) -> dict:
         with self._lock:
+            starved = self.worker_starved_s
+            if self.worker_waiting_since is not None:
+                starved += time.monotonic() - self.worker_waiting_since
             return {
                 "images": self.images,
                 "requests": self.requests,
                 "batches": self.batches,
                 "flops": self.flops,
+                "padded_images": self.padded_images,
+                "worker_starved_s": starved,
                 "monotonic_s": time.monotonic(),
             }
 
@@ -218,13 +245,21 @@ def main() -> None:
     fence_q: "queue.Queue[_Dispatched]" = queue.Queue()
     inflight = threading.Semaphore(max_inflight)
 
+    # A lone request waits only this long for company before dispatching:
+    # keeps sequential (latency-probe-style) clients near-unbatched while
+    # streaming load still gets the full coalesce window below.
+    lone_wait_s = min(window_s, 1e-3)
+
     def device_worker() -> None:
         """Single dispatcher: coalesce -> pad -> one async forward."""
         while True:
+            stats.wait_started()
             first = requests_q.get()
+            stats.wait_ended()
             batch_reqs = [first]
             total = first.n_images
-            deadline = time.monotonic() + window_s
+            deadline = time.monotonic() + lone_wait_s
+            extended = False
             while total < max_batch:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -238,9 +273,15 @@ def main() -> None:
                     break
                 batch_reqs.append(nxt)
                 total += nxt.n_images
+                if not extended:
+                    # Company arrived: load is streaming, so it's worth
+                    # holding the full window to fill the bucket.
+                    deadline = time.monotonic() + window_s
+                    extended = True
             inflight.acquire()
-            out = infer(params, images_of(_bucket(total, max_batch)))
-            fence_q.put(_Dispatched(batch_reqs, total, out))
+            bucket = _bucket(total, max_batch)
+            out = infer(params, images_of(bucket))
+            fence_q.put(_Dispatched(batch_reqs, total, bucket, out))
 
     def fencer() -> None:
         """Ack completed work: drain dispatched batches, fence the newest
@@ -257,7 +298,10 @@ def main() -> None:
             for d in drained:
                 inflight.release()
                 stats.record(
-                    d.n_images, len(d.requests), flops_per_image * d.n_images
+                    d.n_images,
+                    len(d.requests),
+                    d.bucket - d.n_images,
+                    flops_per_image * d.n_images,
                 )
                 for r in d.requests:
                     r.elapsed = now - r.arrived

@@ -1,0 +1,171 @@
+"""Sharing (chip-count) model tests — the slicing-model analogue suite
+(reference weight: `pkg/gpu/slicing/{gpu_test.go,node_test.go}` 387+515
+LoC of table-driven cases)."""
+
+import pytest
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.sharing.mesh import SharedTpuMesh
+from walkai_nos_tpu.tpu.sharing.node import SharingNode
+from walkai_nos_tpu.tpu.sharing.profile import (
+    SharedProfile,
+    shared_profile_resource_name,
+)
+
+V5E = topology.KNOWN_MODELS["tpu-v5-lite-podslice"]  # 2x4, 8 chips
+
+
+def mesh(used=None, free=None):
+    return SharedTpuMesh(model=V5E, used=used or {}, free=free or {})
+
+
+class TestSharedProfile:
+    def test_parse_and_resource_name(self):
+        p = SharedProfile.parse("2c")
+        assert p.chip_count() == 2
+        assert p.as_resource_name() == "walkai.io/tpu-shared-2c"
+        assert shared_profile_resource_name("4c") == (
+            constants.RESOURCE_TPU_SHARED_PREFIX + "4c"
+        )
+
+    @pytest.mark.parametrize("bad", ["2", "c2", "2gb", "", "2x2"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            SharedProfile.parse(bad)
+
+    def test_ordering(self):
+        assert SharedProfile.parse("1c").smaller_than(SharedProfile.parse("4c"))
+
+
+class TestSharedTpuMeshValidate:
+    def test_ok(self):
+        mesh(used={"2c": 2}, free={"1c": 4}).validate()  # 8 chips exactly
+
+    def test_overcommitted(self):
+        with pytest.raises(GenericError):
+            mesh(used={"4c": 2}, free={"1c": 1}).validate()  # 9 > 8
+
+
+class TestSharedTpuMeshUpdateGeometry:
+    """Mirrors the two-phase strategy cases of `slicing/gpu_test.go`."""
+
+    def test_already_satisfied_is_noop(self):
+        m = mesh(free={"2c": 2})
+        assert m.update_geometry_for({"2c": 2}) is False
+        assert m.geometry() == {"2c": 2}
+
+    def test_phase1_fills_spare_chips(self):
+        # 4 chips used, 4 spare: two 2c shares fit without touching free.
+        m = mesh(used={"4c": 1})
+        assert m.update_geometry_for({"2c": 2}) is True
+        assert m.free_count("2c") == 2
+        m.validate()
+
+    def test_phase1_smallest_first(self):
+        # 3 spare chips; wanting 2c+1c packs both (1c first, then 2c).
+        m = mesh(used={"4c": 1, "1c": 1})
+        assert m.update_geometry_for({"2c": 1, "1c": 1}) is True
+        assert m.free_count("1c") == 1
+        assert m.free_count("2c") == 1
+
+    def test_phase2_deletes_free_and_repacks(self):
+        # No spare chips; a free 4c must be broken up to provide 2x2c.
+        m = mesh(used={"4c": 1}, free={"4c": 1})
+        assert m.update_geometry_for({"2c": 2}) is True
+        assert m.free_count("2c") == 2
+        assert m.free_count("4c") == 0
+        m.validate()
+
+    def test_phase2_keeps_fitting_free_shares(self):
+        # Free = {2c:1, 1c:2}; want one 1c more than free... already free.
+        # Want a 4c: spare 0, pool = 4 chips of free -> new 4c replaces all.
+        m = mesh(used={"4c": 1}, free={"2c": 1, "1c": 2})
+        assert m.update_geometry_for({"4c": 1}) is True
+        assert m.free_count("4c") == 1
+        # old free shares no longer fit (pool exhausted)
+        assert m.free_count("2c") == 0 and m.free_count("1c") == 0
+        m.validate()
+
+    def test_used_shares_never_touched(self):
+        m = mesh(used={"2c": 3}, free={"2c": 1})
+        before_used = dict(m.used)
+        m.update_geometry_for({"4c": 1})
+        assert m.used == before_used
+        m.validate()
+
+    def test_unsatisfiable_returns_false(self):
+        m = mesh(used={"4c": 2})  # host full with used shares
+        assert m.update_geometry_for({"1c": 1}) is False
+
+    def test_add_pod_moves_free_to_used(self):
+        m = mesh(free={"2c": 2})
+        m.add_pod("2c")
+        assert m.used == {"2c": 1} and m.free == {"2c": 1}
+        with pytest.raises(GenericError):
+            m.add_pod("4c")
+
+    def test_clone_is_independent(self):
+        m = mesh(free={"2c": 1})
+        c = m.clone()
+        c.add_pod("2c")
+        assert m.free == {"2c": 1} and m.used == {}
+
+
+def _labels():
+    return {
+        constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+        constants.LABEL_TPU_TOPOLOGY: "2x4",
+    }
+
+
+class TestSharingNode:
+    def test_from_node_builds_meshes(self):
+        annos = {
+            "nos.walkai.io/status-tpu-0-2c-used": "1",
+            "nos.walkai.io/status-tpu-0-2c-free": "2",
+            "nos.walkai.io/status-tpu-0-2x2-free": "1",  # tiling: ignored
+        }
+        node = SharingNode.from_node("n1", _labels(), annos)
+        assert node.model is not None
+        assert node.geometry() == {0: {"2c": 3}}
+
+    def test_from_node_non_tpu(self):
+        node = SharingNode.from_node("n1", {}, {})
+        assert node.model is None and node.meshes == []
+
+    def test_from_node_multi_host_refused(self):
+        labels = dict(_labels())
+        labels[constants.LABEL_TPU_TOPOLOGY] = "4x4"
+        node = SharingNode.from_node("n1", labels, {})
+        assert node.model is None
+
+    def test_has_free_capacity(self):
+        empty = SharingNode.from_node("n1", _labels(), {})
+        assert empty.has_free_capacity()  # 8 spare chips
+        full = SharingNode.from_node(
+            "n2", _labels(), {"nos.walkai.io/status-tpu-0-8c-used": "1"}
+        )
+        assert not full.has_free_capacity()
+
+    def test_update_geometry_and_add_pod(self):
+        node = SharingNode.from_node("n1", _labels(), {})
+        assert node.update_geometry_for({"2c": 4}) is True
+        assert node.provides_profiles({"2c": 4})
+        node.add_pod({"2c": 2})
+        assert node.geometry()[0] == {"2c": 4}
+        assert node.meshes[0].used == {"2c": 2}
+
+    def test_add_pod_rejects_unprovided(self):
+        node = SharingNode.from_node("n1", _labels(), {})
+        with pytest.raises(GenericError):
+            node.add_pod({"2c": 1})
+
+    def test_clone_independent(self):
+        node = SharingNode.from_node(
+            "n1", _labels(), {"nos.walkai.io/status-tpu-0-2c-free": "1"}
+        )
+        clone = node.clone()
+        clone.add_pod({"2c": 1})
+        assert node.meshes[0].used == {}
