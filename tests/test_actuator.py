@@ -1,0 +1,180 @@
+"""Actuator failure-path suite — the envtest-actuator-case analogue
+(`internal/controllers/migagent/actuator_int_test.go:64-206` plus the
+rollback/staleness logic of `actuator.go:75-296`)."""
+
+from __future__ import annotations
+
+import pytest
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.tpuagent.actuator import Actuator
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.runtime import Request
+from walkai_nos_tpu.resource.fake import FakeResourceClient
+from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.tiling.client import TilingClient
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+NODE = "host-a"
+
+
+class RecordingPlugin:
+    """DevicePluginClient stand-in that records restarts."""
+
+    def __init__(self) -> None:
+        self.restarts = 0
+
+    def restart(self, node_name: str) -> None:
+        self.restarts += 1
+
+
+class FailingCreateTpudev(FakeTpudevClient):
+    """Fails create_slices a configurable number of times, then behaves."""
+
+    def __init__(self, mesh=(2, 4), fail_times: int = 1) -> None:
+        super().__init__(mesh=mesh)
+        self.fail_times = fail_times
+        self.create_calls = 0
+
+    def create_slices(self, placements):
+        self.create_calls += 1
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise GenericError("injected create failure")
+        return super().create_slices(placements)
+
+
+def advertise(resources: FakeResourceClient, tpudev) -> None:
+    """What the device plugin does: one allocatable device per slice."""
+    resources.set_allocatable(
+        [
+            Device(
+                resource_name=constants.RESOURCE_TPU_SLICE_PREFIX + s.profile,
+                device_id=s.slice_id,
+                status=DeviceStatus.UNKNOWN,
+                mesh_index=s.mesh_index,
+            )
+            for s in tpudev.list_slices()
+        ]
+    )
+
+
+def build(tpudev, spec_annotations: dict, reported: bool = True):
+    kube = FakeKubeClient()
+    kube.create(
+        "Node",
+        {"metadata": {"name": NODE, "annotations": dict(spec_annotations)}},
+    )
+    resources = FakeResourceClient()
+    advertise(resources, tpudev)
+    shared = SharedState()
+    if reported:
+        shared.on_report_done()
+    plugin = RecordingPlugin()
+    actuator = Actuator(
+        kube, TilingClient(resources, tpudev), plugin, shared, NODE
+    )
+    return actuator, kube, resources, plugin, shared
+
+
+SPEC_2X2 = {f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x2": "2"}
+
+
+class TestActuatorFailurePaths:
+    def test_rollback_recreates_deleted_on_failed_create(self):
+        # Host holds one free 2x4 slice; spec wants 2x 2x2, so the plan is
+        # delete-the-free-2x4 + create-two-2x2. Creation fails -> the
+        # deleted 2x4 must be rolled back (`actuator.go:287-296`).
+        tpudev = FailingCreateTpudev(fail_times=1)
+        FakeTpudevClient.create_slices(  # seed without tripping the failure
+            tpudev, [Placement("2x4", (0, 0), (2, 4))]
+        )
+        actuator, *_ = build(tpudev, SPEC_2X2)
+        with pytest.raises(GenericError):
+            actuator.reconcile(Request(name=NODE))
+        slices = tpudev.list_slices()
+        assert [s.profile for s in slices] == ["2x4"], (
+            "deleted free slice must be re-created after the failed create"
+        )
+
+    def test_successful_apply_restarts_plugin_once(self):
+        tpudev = FakeTpudevClient()
+        actuator, _, _, plugin, shared = build(tpudev, SPEC_2X2)
+        actuator.reconcile(Request(name=NODE))
+        assert sorted(s.profile for s in tpudev.list_slices()) == [
+            "2x2",
+            "2x2",
+        ]
+        assert plugin.restarts == 1
+        # apply consumed the report latch (`shared.go:43-48`)
+        assert not shared.at_least_one_report_since_last_apply()
+
+    def test_gated_until_reporter_has_reported(self):
+        tpudev = FakeTpudevClient()
+        actuator, *_ = build(tpudev, SPEC_2X2, reported=False)
+        result = actuator.reconcile(Request(name=NODE))
+        assert result.requeue_after == 1.0
+        assert tpudev.list_slices() == []  # nothing actuated
+
+    def test_same_plan_and_status_not_reapplied(self):
+        # After an apply, reconciling again with unchanged (plan, status)
+        # must be a no-op even though spec != status annotations
+        # (`actuator.go:113-116` dedup).
+        tpudev = FailingCreateTpudev(fail_times=0)
+        spec = dict(SPEC_2X2)
+        spec[constants.ANNOTATION_PARTITIONING_PLAN] = "plan-1"
+        actuator, _, _, plugin, shared = build(tpudev, spec)
+        actuator.reconcile(Request(name=NODE))
+        first_calls = tpudev.create_calls
+        shared.on_report_done()  # reporter ran, but status annos unchanged
+        actuator.reconcile(Request(name=NODE))
+        assert tpudev.create_calls == first_calls
+        assert plugin.restarts == 1
+
+    def test_stale_kubelet_device_restarts_plugin(self):
+        # kubelet advertises a device tpudev doesn't know -> restart the
+        # plugin instead of failing (`actuator.go:135-138`).
+        tpudev = FakeTpudevClient()
+        actuator, _, resources, plugin, _ = build(tpudev, SPEC_2X2)
+        resources.set_allocatable(
+            [
+                Device(
+                    resource_name=constants.RESOURCE_TPU_SLICE_PREFIX + "2x2",
+                    device_id="ghost-slice",
+                    status=DeviceStatus.UNKNOWN,
+                    mesh_index=0,
+                )
+            ]
+        )
+        result = actuator.reconcile(Request(name=NODE))
+        assert plugin.restarts == 1
+        assert result.requeue_after == 1.0
+        assert tpudev.list_slices() == []
+
+    def test_unadvertised_slice_restarts_plugin(self):
+        # Symmetric staleness: tpudev holds a slice the kubelet does NOT
+        # advertise (crash between create and plugin re-registration).
+        tpudev = FakeTpudevClient()
+        actuator, _, resources, plugin, _ = build(tpudev, SPEC_2X2)
+        # materialized but not advertised
+        tpudev.create_slices([Placement("2x2", (0, 0), (2, 2))])
+        result = actuator.reconcile(Request(name=NODE))
+        assert plugin.restarts == 1
+        assert result.requeue_after == 1.0
+
+    def test_used_slices_never_deleted(self):
+        # Spec asks for a full-host 2x4, but a used 2x2 pins the mesh: the
+        # apply must fail placement rather than delete the used slice.
+        tpudev = FakeTpudevClient()
+        tpudev.create_slices([Placement("2x2", (0, 0), (2, 2))])
+        actuator, _, resources, _, _ = build(
+            tpudev,
+            {f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x4": "1"},
+        )
+        resources.mark_used(tpudev.list_slices()[0].slice_id)
+        with pytest.raises(GenericError):
+            actuator.reconcile(Request(name=NODE))
+        assert [s.profile for s in tpudev.list_slices()] == ["2x2"]

@@ -95,6 +95,63 @@ class TestE2EOverApiServer:
 
             eventually(both_bound, timeout=30.0, msg="both 2x2 pods bound")
 
+    def test_quota_scheduler_binds_and_labels_over_http(self, api):
+        """The restored ERQ capability over the real wire path: CRD routes
+        (/apis/nos.walkai.io/v1alpha1/elasticquotas), the /status
+        subresource, the pods/binding subresource, and the capacity
+        labeler's merge patches."""
+        from tests.factory import NodeBuilder, PodBuilder
+        from walkai_nos_tpu.cmd.tpuscheduler import build_manager
+
+        kube = RestKubeClient(server=api)
+        kube.create(
+            "Node",
+            NodeBuilder("host-a")
+            .with_allocatable("walkai.io/tpu-2x2", "2")
+            .build(),
+        )
+        kube.create(
+            "ElasticQuota",
+            {
+                "metadata": {"name": "team-a", "namespace": "default"},
+                "spec": {"min": {"nos.walkai.io/tpu-chips": "4"}},
+            },
+            namespace="default",
+        )
+        pod = PodBuilder("q-pod").with_slice_request("2x2").build()
+        pod["spec"]["schedulerName"] = "walkai-nos-scheduler"
+        kube.create("Pod", pod)
+        manager = build_manager(kube)
+        manager.start()
+        try:
+            def bound():
+                pod = kube.get("Pod", "q-pod", "default")
+                return (pod.get("spec") or {}).get("nodeName") == "host-a"
+
+            eventually(bound, timeout=30.0, msg="quota pod bound over HTTP")
+
+            # kubelet's role: the pod runs, so quota usage counts it.
+            kube.patch_status(
+                "Pod", "q-pod", {"status": {"phase": "Running"}}, "default"
+            )
+
+            def labeled_and_counted():
+                pod = kube.get("Pod", "q-pod", "default")
+                label = objects.labels(pod).get("nos.walkai.io/capacity")
+                quota = kube.get("ElasticQuota", "team-a", "default")
+                used = ((quota.get("status") or {}).get("used") or {}).get(
+                    "nos.walkai.io/tpu-chips"
+                )
+                return label == "in-quota" and str(used) == "4"
+
+            eventually(
+                labeled_and_counted,
+                timeout=30.0,
+                msg="capacity label + quota status over HTTP",
+            )
+        finally:
+            manager.stop()
+
     def test_multi_host_node_refused_over_http(self, api):
         kube = RestKubeClient(server=api)
         sim = SimCluster(report_interval=0.1, kube=kube)
