@@ -101,7 +101,8 @@ class TestE2EOverApiServer:
         subresource, the pods/binding subresource, and the capacity
         labeler's merge patches."""
         from tests.factory import NodeBuilder, PodBuilder
-        from walkai_nos_tpu.cmd.tpuscheduler import build_manager
+        from walkai_nos_tpu.cmd.tpuscheduler import SCHEDULER_NAME, build_manager
+        from walkai_nos_tpu.quota.labeler import IN_QUOTA, LABEL_CAPACITY
 
         kube = RestKubeClient(server=api)
         kube.create(
@@ -114,12 +115,12 @@ class TestE2EOverApiServer:
             "ElasticQuota",
             {
                 "metadata": {"name": "team-a", "namespace": "default"},
-                "spec": {"min": {"nos.walkai.io/tpu-chips": "4"}},
+                "spec": {"min": {constants.RESOURCE_TPU_CHIPS: "4"}},
             },
             namespace="default",
         )
         pod = PodBuilder("q-pod").with_slice_request("2x2").build()
-        pod["spec"]["schedulerName"] = "walkai-nos-scheduler"
+        pod["spec"]["schedulerName"] = SCHEDULER_NAME
         kube.create("Pod", pod)
         manager = build_manager(kube)
         manager.start()
@@ -137,12 +138,12 @@ class TestE2EOverApiServer:
 
             def labeled_and_counted():
                 pod = kube.get("Pod", "q-pod", "default")
-                label = objects.labels(pod).get("nos.walkai.io/capacity")
+                label = objects.labels(pod).get(LABEL_CAPACITY)
                 quota = kube.get("ElasticQuota", "team-a", "default")
                 used = ((quota.get("status") or {}).get("used") or {}).get(
-                    "nos.walkai.io/tpu-chips"
+                    constants.RESOURCE_TPU_CHIPS
                 )
-                return label == "in-quota" and str(used) == "4"
+                return label == IN_QUOTA and str(used) == "4"
 
             eventually(
                 labeled_and_counted,

@@ -1,0 +1,134 @@
+"""Reporter suite — the reporter_int_test analogue
+(`internal/controllers/migagent/reporter_int_test.go:56`,
+`reporter.go:34-123`)."""
+
+from __future__ import annotations
+
+from tests.test_actuator import NODE, RecordingPlugin, advertise  # noqa: F401
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.tpuagent.reporter import Reporter
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.runtime import Request
+from walkai_nos_tpu.resource.fake import FakeResourceClient
+from walkai_nos_tpu.tpu.tiling.client import TilingClient
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+
+def build(annotations=None, interval=0.25):
+    kube = FakeKubeClient()
+    kube.create(
+        "Node",
+        {"metadata": {"name": NODE, "annotations": dict(annotations or {})}},
+    )
+    tpudev = FakeTpudevClient()
+    resources = FakeResourceClient()
+    shared = SharedState()
+    reporter = Reporter(
+        kube,
+        TilingClient(resources, tpudev),
+        shared,
+        NODE,
+        refresh_interval=interval,
+    )
+    return reporter, kube, tpudev, resources, shared
+
+
+def node_annotations(kube):
+    return objects.annotations(kube.get("Node", NODE))
+
+
+class TestReporter:
+    def test_reports_free_and_used_devices(self):
+        reporter, kube, tpudev, resources, _ = build()
+        tpudev.create_slices(
+            [
+                Placement("2x2", (0, 0), (2, 2)),
+                Placement("2x2", (0, 2), (2, 2)),
+            ]
+        )
+        advertise(resources, tpudev)
+        resources.mark_used(tpudev.list_slices()[0].slice_id)
+        result = reporter.reconcile(Request(name=NODE))
+        annos = node_annotations(kube)
+        assert annos[f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2-used"] == "1"
+        assert annos[f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2-free"] == "1"
+        assert result.requeue_after == 0.25
+
+    def test_replaces_all_stale_status_annotations(self):
+        # A status annotation for a profile that no longer exists must be
+        # nulled, not merged around (`reporter.go:89-103` replace-all).
+        stale = {f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1-free": "4"}
+        reporter, kube, tpudev, resources, _ = build(annotations=stale)
+        tpudev.create_slices([Placement("2x4", (0, 0), (2, 4))])
+        advertise(resources, tpudev)
+        reporter.reconcile(Request(name=NODE))
+        annos = node_annotations(kube)
+        assert f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1-free" not in annos
+        assert annos[f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x4-free"] == "1"
+
+    def test_echoes_plan_ack(self):
+        reporter, kube, _, _, shared = build()
+        shared.last_parsed_plan_id = "plan-42"
+        reporter.reconcile(Request(name=NODE))
+        annos = node_annotations(kube)
+        assert annos[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] == "plan-42"
+
+    def test_no_patch_when_nothing_changed(self):
+        reporter, kube, tpudev, resources, _ = build()
+        tpudev.create_slices([Placement("2x4", (0, 0), (2, 4))])
+        advertise(resources, tpudev)
+        reporter.reconcile(Request(name=NODE))
+        rv_after_first = kube.get("Node", NODE)["metadata"]["resourceVersion"]
+        reporter.reconcile(Request(name=NODE))
+        assert (
+            kube.get("Node", NODE)["metadata"]["resourceVersion"]
+            == rv_after_first
+        ), "unchanged state must not patch the node (watch-churn discipline)"
+
+    def test_sharing_reporter_reuses_with_shared_extractor(self):
+        # The sharing agent is this same Reporter with the shared-profile
+        # extractor (`gpuagent/reporter.go` is structurally the migagent
+        # reporter; `cmd/tpusharingagent.py:77-83`).
+        from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+        from walkai_nos_tpu.tpu.sharing.client import SharingClient
+        from walkai_nos_tpu.tpu.sharing.profile import (
+            extract_shared_profile_name,
+        )
+
+        kube = FakeKubeClient()
+        kube.create("Node", {"metadata": {"name": NODE}})
+        resources = FakeResourceClient()
+        resources.set_allocatable(
+            [
+                Device(
+                    resource_name=constants.RESOURCE_TPU_SHARED_PREFIX + "2c",
+                    device_id="share-0",
+                    status=DeviceStatus.UNKNOWN,
+                    mesh_index=0,
+                )
+            ]
+        )
+        reporter = Reporter(
+            kube,
+            SharingClient(resources),
+            SharedState(),
+            NODE,
+            profile_extractor=extract_shared_profile_name,
+        )
+        reporter.reconcile(Request(name=NODE))
+        annos = node_annotations(kube)
+        assert annos[f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2c-free"] == "1"
+
+    def test_report_latch_set_even_on_failure(self):
+        # The actuator gate only needs *a* report attempt
+        # (`reporter.go:60-62`): a reporter crash must still set the latch.
+        reporter, kube, _, _, shared = build()
+        kube.delete("Node", NODE)
+        try:
+            reporter.reconcile(Request(name=NODE))
+        except Exception:
+            pass
+        assert shared.at_least_one_report_since_last_apply()
