@@ -151,3 +151,54 @@ class TestNegativeQuantitiesRejected:
             {"nos.walkai.io/status-tpu-0-2x2-free": "-3"}
         )
         assert st == [] and sp == []
+
+
+class TestRoundTripProperty:
+    """Seeded fuzz: random spec/status sets survive key/value round-trips
+    through parse_node_annotations unchanged (codec bijectivity, the
+    invariant `annotation_test.go` exercises case by case)."""
+
+    def test_random_round_trips(self):
+        import random
+
+        from walkai_nos_tpu.tpu.annotations import (
+            SpecAnnotation,
+            StatusAnnotation,
+            parse_node_annotations,
+        )
+        from walkai_nos_tpu.tpu.device import DeviceStatus
+
+        rng = random.Random(42)
+        profiles = ["1x1", "1x2", "2x2", "2x4", "1x1x2", "2c", "4c"]
+        for _ in range(300):
+            spec = {
+                SpecAnnotation(
+                    mesh_index=rng.randrange(0, 4),
+                    profile=rng.choice(profiles),
+                    quantity=rng.randrange(1, 9),
+                )
+                for _ in range(rng.randrange(0, 5))
+            }
+            status = {
+                StatusAnnotation(
+                    mesh_index=rng.randrange(0, 4),
+                    profile=rng.choice(profiles),
+                    status=rng.choice(
+                        [DeviceStatus.USED, DeviceStatus.FREE]
+                    ),
+                    quantity=rng.randrange(1, 9),
+                )
+                for _ in range(rng.randrange(0, 5))
+            }
+            annotations = {a.key: a.value for a in spec}
+            annotations.update({a.key: a.value for a in status})
+            # unrelated annotations must be ignored, not break parsing
+            annotations["unrelated.io/foo"] = "bar"
+            parsed_status, parsed_spec = parse_node_annotations(annotations)
+            # key collisions merge: compare as {key: value} maps
+            assert {a.key: a.value for a in parsed_spec} == {
+                a.key: a.value for a in spec
+            }
+            assert {a.key: a.value for a in parsed_status} == {
+                a.key: a.value for a in status
+            }

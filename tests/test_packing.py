@@ -6,7 +6,7 @@ Reference analogue: the placement-order behavior exercised in
 
 from walkai_nos_tpu.tpu.tiling import known_tilings, packing
 from walkai_nos_tpu.tpu import topology
-from walkai_nos_tpu.tpu.tiling.packing import Placement
+from walkai_nos_tpu.tpu.tiling.packing import Placement, pack_geometry
 
 
 class TestPackGeometry:
@@ -139,3 +139,87 @@ class TestReviewRegressions:
         assert out is not None
         cells = [c for p in out for c in p.cells()]
         assert len(cells) == len(set(cells)) == 8
+
+
+class TestPackGeometryProperty:
+    """Seeded randomized property test: for random allowed geometries and
+    random pinned subsets, every returned placement list is legal — in
+    bounds, non-overlapping, matching the requested multiset, pinned kept
+    in place (the invariant set of `pack_geometry`'s docstring)."""
+
+    def _assert_legal(self, host_mesh, geometry, pinned, placements):
+        from walkai_nos_tpu.tpu import topology as topo
+
+        # pinned come back first, unmoved
+        assert placements[: len(pinned)] == pinned
+        seen = set()
+        counts = {}
+        for p in placements:
+            counts[p.profile] = counts.get(p.profile, 0) + 1
+            for cell in p.cells():
+                assert all(
+                    0 <= c < d for c, d in zip(cell, host_mesh)
+                ), (p, cell)
+                assert cell not in seen, f"overlap at {cell}"
+                seen.add(cell)
+            # orientation must be a permutation of the canonical profile
+            assert sorted(p.orientation) == sorted(
+                int(x) for x in p.profile.split("x")
+            )
+        assert counts == {k: v for k, v in geometry.items() if v > 0}
+
+    def test_random_geometries_with_random_pins(self):
+        import random
+
+        from walkai_nos_tpu.tpu import topology
+        from walkai_nos_tpu.tpu.tiling.known_tilings import (
+            get_allowed_geometries,
+        )
+
+        rng = random.Random(1234)
+        for model_name in (
+            "tpu-v5-lite-podslice",  # 2x4
+            "tpu-v4-podslice",  # 2x2x1
+        ):
+            model = topology.KNOWN_MODELS[model_name]
+            geometries = get_allowed_geometries(model)
+            for _ in range(200):
+                geometry = dict(rng.choice(geometries))
+                # Build a pinned subset by first packing the full geometry,
+                # then pinning a random sample of the result.
+                full = pack_geometry(model.host_mesh, geometry, [])
+                assert full is not None  # allowed => placeable
+                k = rng.randrange(0, len(full) + 1)
+                pinned = rng.sample(full, k)
+                placements = pack_geometry(model.host_mesh, geometry, pinned)
+                assert placements is not None, (
+                    f"{model_name}: {geometry} unplaceable with "
+                    f"{len(pinned)} pinned"
+                )
+                self._assert_legal(
+                    model.host_mesh, geometry, pinned, placements
+                )
+
+    def test_random_partial_geometries(self):
+        import random
+
+        from walkai_nos_tpu.tpu import topology
+        from walkai_nos_tpu.tpu.tiling.known_tilings import (
+            get_allowed_geometries,
+        )
+
+        rng = random.Random(99)
+        model = topology.KNOWN_MODELS["tpu-v5-lite-podslice"]
+        for _ in range(200):
+            geometry = dict(rng.choice(get_allowed_geometries(model)))
+            # Randomly drop quantities: partial geometries must still place
+            # (holes allowed by design).
+            geometry = {
+                p: rng.randrange(0, q + 1) for p, q in geometry.items()
+            }
+            geometry = {p: q for p, q in geometry.items() if q > 0}
+            if not geometry:
+                continue
+            placements = pack_geometry(model.host_mesh, geometry, [])
+            assert placements is not None, geometry
+            self._assert_legal(model.host_mesh, geometry, [], placements)
