@@ -148,8 +148,6 @@ class TestPackGeometryProperty:
     in place (the invariant set of `pack_geometry`'s docstring)."""
 
     def _assert_legal(self, host_mesh, geometry, pinned, placements):
-        from walkai_nos_tpu.tpu import topology as topo
-
         # pinned come back first, unmoved
         assert placements[: len(pinned)] == pinned
         seen = set()
@@ -171,7 +169,6 @@ class TestPackGeometryProperty:
     def test_random_geometries_with_random_pins(self):
         import random
 
-        from walkai_nos_tpu.tpu import topology
         from walkai_nos_tpu.tpu.tiling.known_tilings import (
             get_allowed_geometries,
         )
@@ -203,7 +200,6 @@ class TestPackGeometryProperty:
     def test_random_partial_geometries(self):
         import random
 
-        from walkai_nos_tpu.tpu import topology
         from walkai_nos_tpu.tpu.tiling.known_tilings import (
             get_allowed_geometries,
         )
