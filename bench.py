@@ -53,8 +53,10 @@ from walkai_nos_tpu.utils.httpbench import (
 N_STREAMS = 4
 # Outstanding requests each stream keeps in flight (an async client's
 # pipeline depth) — keeps the device fed across completion-fence
-# round-trips on remote runtimes.
-STREAM_PIPELINE = int(os.environ.get("WALKAI_BENCH_PIPELINE", "16"))
+# round-trips on remote runtimes. Measured on v5e through the tunneled
+# runtime: depth 16 -> 92.7% utilization (dispatcher starved 95% of the
+# wall), depth 24 -> 96.0% (starved 14%).
+STREAM_PIPELINE = int(os.environ.get("WALKAI_BENCH_PIPELINE", "24"))
 REQUEST_BATCH = int(os.environ.get("WALKAI_BENCH_REQUEST_BATCH", "32"))
 MAX_BATCH = int(os.environ.get("WALKAI_BENCH_MAX_BATCH", "128"))
 WARMUP_SECONDS = 5.0

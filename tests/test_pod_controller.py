@@ -1,0 +1,194 @@
+"""Pod-controller (partitioner core loop) suite — the
+`mig_controller.go:35-213` behaviors, table-driven."""
+
+from __future__ import annotations
+
+from tests.factory import NodeBuilder, PodBuilder
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.partitioner.pod_controller import (
+    PodController,
+    make_node_event_mapper,
+)
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.runtime import Request
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+
+
+def tiling_node(name: str, annotations: dict | None = None) -> dict:
+    builder = (
+        NodeBuilder(name)
+        .with_tpu_model("tpu-v5-lite-podslice", "2x4")
+        .with_tiling_enabled()
+    )
+    for k, v in (annotations or {}).items():
+        builder.with_annotation(k, v)
+    return builder.build()
+
+
+def pending_slice_pod(name: str, profile: str) -> dict:
+    return (
+        PodBuilder(name)
+        .with_slice_request(profile)
+        .unschedulable()
+        .build()
+    )
+
+
+def spec_of(kube, node_name: str):
+    _, spec = parse_node_annotations(
+        objects.annotations(kube.get("Node", node_name))
+    )
+    return {(s.mesh_index, s.profile): s.quantity for s in spec}
+
+
+class TestShouldConsider:
+    def setup_method(self):
+        self.kube = FakeKubeClient()
+        self.kube.create("Node", tiling_node("n1"))
+        self.ctrl = PodController(self.kube, plan_id_fn=lambda: "plan-t")
+
+    def _reconcile(self, pod):
+        self.kube.create("Pod", pod)
+        self.ctrl.reconcile(
+            Request(name=objects.name(pod), namespace="default")
+        )
+
+    def test_pending_unschedulable_pod_triggers_retile(self):
+        self._reconcile(pending_slice_pod("p1", "2x2"))
+        assert spec_of(self.kube, "n1")  # spec written
+
+    def test_scheduled_pod_ignored(self):
+        pod = (
+            PodBuilder("p1").with_slice_request("2x2").scheduled_on("n1").build()
+        )
+        self._reconcile(pod)
+        assert not spec_of(self.kube, "n1")
+
+    def test_pending_but_not_unschedulable_ignored(self):
+        # Not yet marked Unschedulable by the scheduler: retiling can't be
+        # known to help (`pod.go:38-55` semantics).
+        pod = PodBuilder("p1").with_slice_request("2x2").build()
+        self._reconcile(pod)
+        assert not spec_of(self.kube, "n1")
+
+    def test_daemonset_pod_ignored(self):
+        pod = (
+            PodBuilder("p1")
+            .with_slice_request("2x2")
+            .unschedulable()
+            .owned_by("DaemonSet")
+            .build()
+        )
+        self._reconcile(pod)
+        assert not spec_of(self.kube, "n1")
+
+    def test_non_slice_pod_ignored(self):
+        pod = (
+            PodBuilder("p1")
+            .with_container("main", {"cpu": "1"})
+            .unschedulable()
+            .build()
+        )
+        self._reconcile(pod)
+        assert not spec_of(self.kube, "n1")
+
+    def test_missing_pod_is_noop(self):
+        self.ctrl.reconcile(Request(name="ghost", namespace="default"))
+        assert not spec_of(self.kube, "n1")
+
+
+class TestProfileAlreadyPresent:
+    def test_no_retile_when_a_node_already_provides(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            tiling_node(
+                "n1",
+                {
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2-free": "1"
+                },
+            ),
+        )
+        kube.create("Node", tiling_node("n2"))
+        ctrl = PodController(kube, plan_id_fn=lambda: "plan-t")
+        kube.create("Pod", pending_slice_pod("p1", "2x2"))
+        ctrl.reconcile(Request(name="p1", namespace="default"))
+        # n1 already exposes a free 2x2: neither node gets a new spec
+        # (`mig_controller.go:121-144`).
+        assert not spec_of(kube, "n1")
+        assert not spec_of(kube, "n2")
+
+
+class TestFirstFit:
+    def test_first_node_that_fits_wins(self):
+        kube = FakeKubeClient()
+        # n1 is full with used slices (no room); n2 is empty.
+        kube.create(
+            "Node",
+            tiling_node(
+                "n1",
+                {
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1-used": "8"
+                },
+            ),
+        )
+        kube.create("Node", tiling_node("n2"))
+        ctrl = PodController(kube, plan_id_fn=lambda: "plan-t")
+        kube.create("Pod", pending_slice_pod("p1", "2x2"))
+        ctrl.reconcile(Request(name="p1", namespace="default"))
+        assert not spec_of(kube, "n1")
+        spec = spec_of(kube, "n2")
+        assert spec.get((0, "2x2"), 0) >= 1
+
+    def test_plan_id_written(self):
+        kube = FakeKubeClient()
+        kube.create("Node", tiling_node("n1"))
+        ctrl = PodController(kube, plan_id_fn=lambda: "plan-42")
+        kube.create("Pod", pending_slice_pod("p1", "2x2"))
+        ctrl.reconcile(Request(name="p1", namespace="default"))
+        annos = objects.annotations(kube.get("Node", "n1"))
+        assert annos[constants.ANNOTATION_PARTITIONING_PLAN] == "plan-42"
+
+    def test_used_slices_survive_retile(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            tiling_node(
+                "n1",
+                {
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2-used": "1",
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2-free": "1",
+                },
+            ),
+        )
+        ctrl = PodController(kube, plan_id_fn=lambda: "plan-t")
+        kube.create("Pod", pending_slice_pod("p1", "1x2"))
+        ctrl.reconcile(Request(name="p1", namespace="default"))
+        spec = spec_of(kube, "n1")
+        # the used 2x2 must still be in the target geometry
+        assert spec.get((0, "2x2"), 0) >= 1
+        assert spec.get((0, "1x2"), 0) >= 1
+
+
+class TestNodeEventMapper:
+    def test_reenqueues_pending_slice_pods(self):
+        kube = FakeKubeClient()
+        kube.create("Pod", pending_slice_pod("p1", "2x2"))
+        kube.create(  # scheduled: must not be re-enqueued
+            "Pod",
+            PodBuilder("p2").with_slice_request("2x2").scheduled_on("n1").build(),
+        )
+        kube.create(  # no slice request: must not be re-enqueued
+            "Pod",
+            PodBuilder("p3")
+            .with_container("main", {"cpu": "1"})
+            .unschedulable()
+            .build(),
+        )
+        enqueued: list[Request] = []
+        mapper = make_node_event_mapper(kube, enqueued.append)
+        mapper(Request(name="n1"))
+        assert [(r.name, r.namespace) for r in enqueued] == [
+            ("p1", "default")
+        ]
