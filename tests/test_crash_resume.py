@@ -1,0 +1,135 @@
+"""Crash/resume suite — the externalized-state design proof (SURVEY §5.4:
+all state lives in Node annotations + host-side slice records, so any
+process can die and resume; reference `migagent.go:192-199` startup
+cleanup + the spec/status diff protocol)."""
+
+from __future__ import annotations
+
+from tests.test_actuator import (
+    NODE,
+    SPEC_2X2,
+    FailingCreateTpudev,
+    RecordingPlugin,
+    advertise,
+)
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.partitioner.pod_controller import PodController
+from walkai_nos_tpu.controllers.tpuagent.actuator import Actuator
+from walkai_nos_tpu.controllers.tpuagent.reporter import Reporter
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.runtime import Request
+from walkai_nos_tpu.resource.fake import FakeResourceClient
+from walkai_nos_tpu.tpu.annotations import (
+    parse_node_annotations,
+    spec_matches_status,
+)
+from walkai_nos_tpu.tpu.tiling.client import TilingClient
+
+
+def agent_generation(kube, tpudev, resources):
+    """One agent process lifetime: fresh SharedState/Reporter/Actuator
+    (what a DaemonSet pod restart produces), same durable tpudev state."""
+    shared = SharedState()
+    client = TilingClient(resources, tpudev)
+    plugin = RecordingPlugin()
+    reporter = Reporter(kube, client, shared, NODE, refresh_interval=10.0)
+    actuator = Actuator(kube, client, plugin, shared, NODE)
+    return reporter, actuator, plugin
+
+
+class TestAgentCrashResume:
+    def test_restarted_agent_is_a_noop_on_converged_state(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node", {"metadata": {"name": NODE, "annotations": dict(SPEC_2X2)}}
+        )
+        tpudev = FailingCreateTpudev(fail_times=0)
+        resources = FakeResourceClient()
+        advertise(resources, tpudev)
+
+        # Generation 1: report -> actuate -> advertise -> report.
+        reporter, actuator, plugin = agent_generation(kube, tpudev, resources)
+        reporter.reconcile(Request(name=NODE))
+        actuator.reconcile(Request(name=NODE))
+        advertise(resources, tpudev)  # device plugin restarted and re-advertised
+        reporter.reconcile(Request(name=NODE))
+        gen1_creates = tpudev.create_calls
+        assert plugin.restarts == 1
+
+        status, spec = parse_node_annotations(
+            objects.annotations(kube.get("Node", NODE))
+        )
+        assert spec_matches_status(spec, status)
+
+        # Generation 2 (crash + restart): all in-memory state is gone; the
+        # node object and the durable slice store are the only truth.
+        reporter2, actuator2, plugin2 = agent_generation(
+            kube, tpudev, resources
+        )
+        reporter2.reconcile(Request(name=NODE))
+        actuator2.reconcile(Request(name=NODE))
+        assert tpudev.create_calls == gen1_creates  # nothing re-created
+        assert plugin2.restarts == 0  # nothing changed, no restart
+
+    def test_crash_mid_apply_converges_on_restart(self):
+        """Crash AFTER slice creation but BEFORE the report: the restarted
+        generation re-reports ground truth and the diff goes empty."""
+        kube = FakeKubeClient()
+        kube.create(
+            "Node", {"metadata": {"name": NODE, "annotations": dict(SPEC_2X2)}}
+        )
+        tpudev = FailingCreateTpudev(fail_times=0)
+        resources = FakeResourceClient()
+        advertise(resources, tpudev)
+
+        reporter, actuator, _ = agent_generation(kube, tpudev, resources)
+        reporter.reconcile(Request(name=NODE))
+        actuator.reconcile(Request(name=NODE))
+        # CRASH here: the plugin re-advertised but the reporter never ran,
+        # so node status still shows the pre-apply world.
+        advertise(resources, tpudev)
+
+        reporter2, actuator2, plugin2 = agent_generation(
+            kube, tpudev, resources
+        )
+        reporter2.reconcile(Request(name=NODE))
+        result = actuator2.reconcile(Request(name=NODE))
+        assert result.requeue_after is None
+        assert tpudev.create_calls == 1  # the one pre-crash apply
+        assert plugin2.restarts == 0
+        status, spec = parse_node_annotations(
+            objects.annotations(kube.get("Node", NODE))
+        )
+        assert spec_matches_status(spec, status)
+
+
+class TestPartitionerCrashResume:
+    def test_restarted_partitioner_recomputes_identical_spec(self):
+        """A partitioner restart mid-flight (spec written, not yet
+        actuated) must re-derive the same geometry — idempotent planning
+        from cluster state alone."""
+        kube = FakeKubeClient()
+        from tests.test_pod_controller import pending_slice_pod, tiling_node
+
+        kube.create("Node", tiling_node("n1"))
+        kube.create("Pod", pending_slice_pod("p1", "2x2"))
+
+        PodController(kube, plan_id_fn=lambda: "gen1").reconcile(
+            Request(name="p1", namespace="default")
+        )
+        _, spec1 = parse_node_annotations(
+            objects.annotations(kube.get("Node", "n1"))
+        )
+
+        # Restart: a brand-new controller sees the same pending pod again.
+        PodController(kube, plan_id_fn=lambda: "gen2").reconcile(
+            Request(name="p1", namespace="default")
+        )
+        _, spec2 = parse_node_annotations(
+            objects.annotations(kube.get("Node", "n1"))
+        )
+        assert {(s.mesh_index, s.profile, s.quantity) for s in spec1} == {
+            (s.mesh_index, s.profile, s.quantity) for s in spec2
+        }
