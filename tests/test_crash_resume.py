@@ -5,6 +5,7 @@ cleanup + the spec/status diff protocol)."""
 
 from __future__ import annotations
 
+from tests.test_pod_controller import pending_slice_pod, tiling_node
 from tests.test_actuator import (
     NODE,
     SPEC_2X2,
@@ -12,11 +13,11 @@ from tests.test_actuator import (
     RecordingPlugin,
     advertise,
 )
-from walkai_nos_tpu.api import constants
 from walkai_nos_tpu.controllers.partitioner.pod_controller import PodController
 from walkai_nos_tpu.controllers.tpuagent.actuator import Actuator
 from walkai_nos_tpu.controllers.tpuagent.reporter import Reporter
 from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.api import constants
 from walkai_nos_tpu.kube import objects
 from walkai_nos_tpu.kube.fake import FakeKubeClient
 from walkai_nos_tpu.kube.runtime import Request
@@ -111,8 +112,6 @@ class TestPartitionerCrashResume:
         actuated) must re-derive the same geometry — idempotent planning
         from cluster state alone."""
         kube = FakeKubeClient()
-        from tests.test_pod_controller import pending_slice_pod, tiling_node
-
         kube.create("Node", tiling_node("n1"))
         kube.create("Pod", pending_slice_pod("p1", "2x2"))
 
@@ -127,9 +126,11 @@ class TestPartitionerCrashResume:
         PodController(kube, plan_id_fn=lambda: "gen2").reconcile(
             Request(name="p1", namespace="default")
         )
-        _, spec2 = parse_node_annotations(
-            objects.annotations(kube.get("Node", "n1"))
-        )
+        annos = objects.annotations(kube.get("Node", "n1"))
+        _, spec2 = parse_node_annotations(annos)
+        # the restart really re-planned (gen2's plan id landed), with the
+        # identical geometry
+        assert annos[constants.ANNOTATION_PARTITIONING_PLAN] == "gen2"
         assert {(s.mesh_index, s.profile, s.quantity) for s in spec1} == {
             (s.mesh_index, s.profile, s.quantity) for s in spec2
         }
