@@ -155,3 +155,85 @@ class TestMultiMesh:
         assert any(
             o.mesh_index == 1 and o.profile == "1x1" for o in plan.delete_ops
         )
+
+
+class TestPlanApplicationProperty:
+    """Seeded fuzz of the differ's core invariant: simulating the
+    actuator's application of a plan (delete free candidates, create
+    requested) yields exactly the spec whenever no used device conflicts
+    with it — `plan.go`'s purpose, checked over random states."""
+
+    def _simulate_apply(self, state, plan):
+        """Pure simulation of actuator._apply on (mesh, profile) counts."""
+        counts = {}
+        deleted_ids = set()
+        for op in plan.delete_ops:
+            remaining = op.quantity
+            for device in op.candidates:
+                if remaining == 0:
+                    break
+                if not device.is_free() or device.device_id in deleted_ids:
+                    continue
+                deleted_ids.add(device.device_id)
+                remaining -= 1
+        from walkai_nos_tpu.tpu.tiling.profile import extract_profile_name
+
+        for idx, devs in state.items():
+            for d in devs:
+                if d.device_id in deleted_ids:
+                    continue
+                key = (idx, extract_profile_name(d.resource_name))
+                counts[key] = counts.get(key, 0) + 1
+        for op in plan.create_ops:
+            key = (op.mesh_index, op.profile)
+            counts[key] = counts.get(key, 0) + op.quantity
+        return counts
+
+    def test_random_states_converge_to_spec(self):
+        import random
+
+        from walkai_nos_tpu.api import constants
+        from walkai_nos_tpu.tpu.device import Device, DeviceStatus
+
+        rng = random.Random(7)
+        profiles = ["1x1", "1x2", "2x2", "2x4"]
+        for _ in range(300):
+            # Random observed state: up to 6 devices on one mesh.
+            devices = DeviceList()
+            for i in range(rng.randrange(0, 7)):
+                devices.append(
+                    Device(
+                        resource_name=constants.RESOURCE_TPU_SLICE_PREFIX
+                        + rng.choice(profiles),
+                        device_id=f"d{i}",
+                        status=rng.choice(
+                            [DeviceStatus.FREE, DeviceStatus.USED]
+                        ),
+                        mesh_index=0,
+                    )
+                )
+            state = TilingState.from_devices(devices)
+            # Random spec that keeps every used device (the planner's
+            # contract: used devices are never planned away).
+            used_counts: dict[str, int] = {}
+            for d in devices:
+                if not d.is_free():
+                    p = d.resource_name.rsplit("-", 1)[-1]
+                    used_counts[p] = used_counts.get(p, 0) + 1
+            spec_counts = dict(used_counts)
+            for p in rng.sample(profiles, rng.randrange(0, len(profiles))):
+                spec_counts[p] = spec_counts.get(p, 0) + rng.randrange(1, 3)
+            spec = [
+                SpecAnnotation(mesh_index=0, profile=p, quantity=q)
+                for p, q in spec_counts.items()
+            ]
+            plan = new_tiling_plan(state, spec)
+            result = self._simulate_apply(state, plan)
+            desired = {
+                (0, p): q for p, q in spec_counts.items() if q > 0
+            }
+            assert result == desired, (
+                f"spec {spec_counts} from state "
+                f"{[(d.device_id, d.resource_name, d.status) for d in devices]}"
+                f" -> plan {plan.summary()} -> {result}"
+            )
