@@ -1,0 +1,48 @@
+"""NodeInitializer suite (`internal/partitioning/mig/initializer.go:40-79`
+analogue cases)."""
+
+from __future__ import annotations
+
+from tests.test_pod_controller import tiling_node
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.partitioning.initializer import NodeInitializer
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+
+
+def spec_of(kube, name):
+    _, spec = parse_node_annotations(
+        objects.annotations(kube.get("Node", name))
+    )
+    return {(s.mesh_index, s.profile): s.quantity for s in spec}
+
+
+class TestNodeInitializer:
+    def test_fresh_node_gets_fewest_slices_tiling(self):
+        kube = FakeKubeClient()
+        kube.create("Node", tiling_node("n1"))
+        NodeInitializer(kube).init_node_partitioning(kube.get("Node", "n1"))
+        # v5e 2x4 host: the coarsest tiling is one whole-host 2x4 slice.
+        assert spec_of(kube, "n1") == {(0, "2x4"): 1}
+        annos = objects.annotations(kube.get("Node", "n1"))
+        assert constants.ANNOTATION_PARTITIONING_PLAN in annos
+
+    def test_already_initialized_node_untouched(self):
+        kube = FakeKubeClient()
+        node = tiling_node(
+            "n1",
+            {f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2-free": "2"},
+        )
+        kube.create("Node", node)
+        NodeInitializer(kube).init_node_partitioning(kube.get("Node", "n1"))
+        # Mesh already has a geometry (from status): no spec rewrite.
+        assert not spec_of(kube, "n1")
+
+    def test_non_tpu_node_ignored(self):
+        kube = FakeKubeClient()
+        kube.create("Node", {"metadata": {"name": "cpu-node"}})
+        NodeInitializer(kube).init_node_partitioning(
+            kube.get("Node", "cpu-node")
+        )
+        assert not spec_of(kube, "cpu-node")
