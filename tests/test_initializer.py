@@ -3,19 +3,11 @@ analogue cases)."""
 
 from __future__ import annotations
 
-from tests.test_pod_controller import tiling_node
+from tests.test_pod_controller import spec_of, tiling_node
 from walkai_nos_tpu.api import constants
 from walkai_nos_tpu.kube import objects
 from walkai_nos_tpu.kube.fake import FakeKubeClient
 from walkai_nos_tpu.partitioning.initializer import NodeInitializer
-from walkai_nos_tpu.tpu.annotations import parse_node_annotations
-
-
-def spec_of(kube, name):
-    _, spec = parse_node_annotations(
-        objects.annotations(kube.get("Node", name))
-    )
-    return {(s.mesh_index, s.profile): s.quantity for s in spec}
 
 
 class TestNodeInitializer:

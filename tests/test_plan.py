@@ -165,6 +165,8 @@ class TestPlanApplicationProperty:
 
     def _simulate_apply(self, state, plan):
         """Pure simulation of actuator._apply on (mesh, profile) counts."""
+        from walkai_nos_tpu.tpu.tiling.profile import extract_profile_name
+
         counts = {}
         deleted_ids = set()
         for op in plan.delete_ops:
@@ -176,8 +178,6 @@ class TestPlanApplicationProperty:
                     continue
                 deleted_ids.add(device.device_id)
                 remaining -= 1
-        from walkai_nos_tpu.tpu.tiling.profile import extract_profile_name
-
         for idx, devs in state.items():
             for d in devs:
                 if d.device_id in deleted_ids:
@@ -218,7 +218,11 @@ class TestPlanApplicationProperty:
             used_counts: dict[str, int] = {}
             for d in devices:
                 if not d.is_free():
-                    p = d.resource_name.rsplit("-", 1)[-1]
+                    from walkai_nos_tpu.tpu.tiling.profile import (
+                        extract_profile_name,
+                    )
+
+                    p = extract_profile_name(d.resource_name)
                     used_counts[p] = used_counts.get(p, 0) + 1
             spec_counts = dict(used_counts)
             for p in rng.sample(profiles, rng.randrange(0, len(profiles))):
