@@ -1,0 +1,76 @@
+"""cmd/_common wiring tests: namespace resolution, health/metrics server
+split (the kube-rbac-proxy topology), shutdown signal latch."""
+
+from __future__ import annotations
+
+import os
+import signal
+import urllib.error
+import urllib.request
+
+from walkai_nos_tpu.cmd import _common
+
+
+def _get(url: str) -> tuple[int, str]:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestCurrentNamespace:
+    def test_env_wins(self, monkeypatch):
+        monkeypatch.setenv("POD_NAMESPACE", "walkai-nos")
+        assert _common.current_namespace() == "walkai-nos"
+
+    def test_default_without_env_or_sa_file(self, monkeypatch):
+        monkeypatch.delenv("POD_NAMESPACE", raising=False)
+        assert _common.current_namespace(default="fallback") == "fallback"
+
+
+class TestStartHealth:
+    def test_single_address_serves_probes_and_metrics(self):
+        servers = _common.start_health("127.0.0.1:0")
+        try:
+            port = servers._health.port
+            servers.mark_ready()
+            assert _get(f"http://127.0.0.1:{port}/healthz")[0] == 200
+            assert _get(f"http://127.0.0.1:{port}/readyz")[0] == 200
+            servers.metrics.counter_add("test_metric_total", 1, {})
+            body = _get(f"http://127.0.0.1:{port}/metrics")[1]
+            assert "test_metric_total" in body
+        finally:
+            servers.stop()
+
+    def test_split_metrics_address(self):
+        # The rbac-proxy topology: probes on one port, /metrics on its own
+        # (proxied) port; the probe port must NOT expose metrics.
+        # Port 0 twice would compare equal as strings; the split is keyed
+        # on the *address string* differing, as it does in real deploys.
+        servers = _common.start_health("127.0.0.1:0", "localhost:0")
+        try:
+            probe_port = servers._health.port
+            metrics_port = servers._metrics_server.port
+            assert probe_port != metrics_port
+            servers.metrics.counter_add("split_metric_total", 1, {})
+            body = _get(f"http://127.0.0.1:{metrics_port}/metrics")[1]
+            assert "split_metric_total" in body
+            try:
+                status, _ = _get(f"http://127.0.0.1:{probe_port}/metrics")
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 404
+        finally:
+            servers.stop()
+
+
+class TestWaitForShutdown:
+    def test_sigterm_sets_latch(self):
+        old_term = signal.getsignal(signal.SIGTERM)
+        old_int = signal.getsignal(signal.SIGINT)
+        try:
+            stop = _common.wait_for_shutdown()
+            assert not stop.is_set()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert stop.wait(timeout=5)
+        finally:
+            signal.signal(signal.SIGTERM, old_term)
+            signal.signal(signal.SIGINT, old_int)
