@@ -1,9 +1,13 @@
 """Per-node sharing agent (`cmd/gpuagent/gpuagent.go:54-152` analogue).
 
-Reporter-only DaemonSet for chip-count-sharing nodes (the MPS/slicing
-analogue — report-only in the reference fork too, SURVEY.md §0). Refuses to
-run if the host has tiled slices materialized, mirroring gpuagent's refusal
-on MIG-enabled GPUs (`AnyMigEnabledGpu`, :109-117, :146).
+DaemonSet for chip-count-sharing nodes (the MPS/slicing analogue). The
+reference fork reduced sharing to report-only; this agent restores the
+actuation half the way the quota scheduler restored ERQ: a ShareActuator
+turns spec annotations into advertised share devices
+(`deviceplugin/share_manager.py`), and the Reporter closes the loop with
+status annotations + plan acks. Refuses to run if the host has tiled
+slices materialized, mirroring gpuagent's refusal on MIG-enabled GPUs
+(`AnyMigEnabledGpu`, :109-117, :146).
 """
 
 from __future__ import annotations
@@ -67,6 +71,15 @@ def main(argv: list[str] | None = None) -> int:
     kube = _common.build_kube_client()
     health = _common.start_health(config.manager.health_probe_addr)
 
+    host = tpudev.get_topology()
+    from walkai_nos_tpu.controllers.tpuagent.share_actuator import (
+        ShareActuator,
+    )
+    from walkai_nos_tpu.deviceplugin.share_manager import SharePluginManager
+
+    share_manager = SharePluginManager(len(host.chips))
+    share_manager.start()
+
     shared = SharedState()
     manager = Manager()
     manager.add(
@@ -88,11 +101,31 @@ def main(argv: list[str] | None = None) -> int:
             ],
         )
     )
+    manager.add(
+        Controller(
+            "tpusharing-actuator",
+            kube,
+            "Node",
+            ShareActuator(
+                kube,
+                shared,
+                node_name,
+                share_manager,
+                sharing_client=sharing_client,
+            ).reconcile,
+            predicates=[
+                predicates.matching_name(node_name),
+                predicates.exclude_delete(),
+                predicates.annotations_changed(),
+            ],
+        )
+    )
     stop = _common.wait_for_shutdown()
     manager.start()
     health.mark_ready()
     stop.wait()
     manager.stop()
+    share_manager.stop()
     health.stop()
     return 0
 

@@ -28,6 +28,8 @@ from walkai_nos_tpu.partitioning.partitioner import Partitioner
 from walkai_nos_tpu.partitioning.plan_id import new_partitioning_plan_id
 from walkai_nos_tpu.partitioning.state import build_node_partitioning
 from walkai_nos_tpu.tpu.partitioning import Geometry, PartitioningKind
+from walkai_nos_tpu.tpu.sharing.node import SharingNode
+from walkai_nos_tpu.tpu.sharing.profile import get_requested_shared_profiles
 from walkai_nos_tpu.tpu.tiling.node import Node
 from walkai_nos_tpu.tpu.tiling.profile import get_requested_profiles
 
@@ -51,7 +53,9 @@ def make_node_event_mapper(
         for pod in kube.list("Pod"):
             if not objects.extra_resources_could_help_scheduling(pod):
                 continue
-            if not get_requested_profiles(pod):
+            if not get_requested_profiles(pod) and not (
+                get_requested_shared_profiles(pod)
+            ):
                 continue
             enqueue(
                 Request(
@@ -85,17 +89,25 @@ class PodController:
         if not self._should_consider_pod(pod):
             return Result()
         wanted = get_requested_profiles(pod)
-        if not wanted:
-            return Result()
-
-        nodes = self._list_tiling_nodes()
-        if self._profiles_already_available(nodes, wanted):
-            # The scheduler will bind the pod on its next cycle
-            # (`mig_controller.go:121-144`); its binding flips node usage,
-            # which flows back as a status-annotation event if anything
-            # else is still pending.
-            return Result()
-        self._try_repartition(nodes, wanted, pod)
+        if wanted:
+            nodes = self._list_tiling_nodes()
+            if not self._profiles_already_available(nodes, wanted):
+                # Otherwise the scheduler will bind the pod on its next
+                # cycle (`mig_controller.go:121-144`); its binding flips
+                # node usage, which flows back as a status-annotation
+                # event if anything else is still pending.
+                self._try_repartition(nodes, wanted, pod)
+        # Dynamic sharing: the capability the reference fork reduced to
+        # report-only (upstream nos planned MPS layouts alongside MIG);
+        # chip-count shares are planned the same way against
+        # sharing-labeled nodes.
+        wanted_shared = get_requested_shared_profiles(pod)
+        if wanted_shared:
+            nodes = self._list_sharing_nodes()
+            if not self._shared_profiles_already_available(
+                nodes, wanted_shared
+            ):
+                self._try_reshare(nodes, wanted_shared, pod)
         return Result()
 
     # --------------------------------------------------------------- helpers
@@ -116,6 +128,37 @@ class PodController:
             },
         )
 
+    def _list_sharing_nodes(self) -> list[dict]:
+        return self._kube.list(
+            "Node",
+            label_selector={
+                constants.LABEL_TPU_PARTITIONING: PartitioningKind.SHARING.value
+            },
+        )
+
+    def _shared_profiles_already_available(
+        self, nodes: list[dict], wanted: Geometry
+    ) -> bool:
+        for node_obj in nodes:
+            node = SharingNode.from_node(
+                objects.name(node_obj),
+                objects.labels(node_obj),
+                objects.annotations(node_obj),
+            )
+            if node.provides_profiles(wanted):
+                return True
+        return False
+
+    def _try_reshare(
+        self, nodes: list[dict], wanted: Geometry, pod: dict
+    ) -> bool:
+        """First-fit share planning over sharing nodes — the sharing twin
+        of `_try_repartition`, using the chip-count model
+        (`tpu/sharing/mesh.py` two-phase search)."""
+        return self._first_fit(
+            nodes, wanted, pod, SharingNode.from_node, "re-shared"
+        )
+
     def _profiles_already_available(
         self, nodes: list[dict], wanted: Geometry
     ) -> bool:
@@ -133,8 +176,19 @@ class PodController:
         self, nodes: list[dict], wanted: Geometry, pod: dict
     ) -> bool:
         """First-fit over candidate nodes (`mig_controller.go:146-207`)."""
+        return self._first_fit(
+            nodes, wanted, pod, Node.from_node, "repartitioned"
+        )
+
+    def _first_fit(
+        self, nodes: list[dict], wanted: Geometry, pod: dict, node_factory,
+        verb: str,
+    ) -> bool:
+        """The first-fit planning loop shared by tiling and sharing: both
+        node models expose the same search surface (has_free_capacity /
+        clone / update_geometry_for / provides_profiles)."""
         for node_obj in nodes:
-            node = Node.from_node(
+            node = node_factory(
                 objects.name(node_obj),
                 objects.labels(node_obj),
                 objects.annotations(node_obj),
@@ -151,8 +205,9 @@ class PodController:
                 node_obj, build_node_partitioning(candidate), plan_id
             )
             logger.info(
-                "pod controller: repartitioned node %s for pod %s/%s "
+                "pod controller: %s node %s for pod %s/%s "
                 "(wanted %s, plan %s)",
+                verb,
                 node.name,
                 objects.namespace(pod),
                 objects.name(pod),

@@ -1,0 +1,84 @@
+"""Share actuator: node spec annotations -> advertised share devices.
+
+The sharing twin of the tiling Actuator, radically simpler because a
+share needs no device-layer materialization: the spec IS the durable
+desired state, and "applying" it means handing the geometry to the
+share plugin manager (which re-advertises to the kubelet). The plan-ID
+ack protocol is kept so the partitioner sees the same
+spec/status/plan handshake on both node kinds.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import KubeClient
+from walkai_nos_tpu.kube.runtime import Request, Result
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.partitioning import Geometry
+from walkai_nos_tpu.tpu.sharing.profile import SharedProfile
+
+logger = logging.getLogger(__name__)
+
+
+class ShareActuator:
+    def __init__(
+        self,
+        kube: KubeClient,
+        shared_state: SharedState,
+        node_name: str,
+        share_manager,
+        sharing_client=None,
+    ) -> None:
+        self._kube = kube
+        self._shared = shared_state
+        self._node_name = node_name
+        self._manager = share_manager
+        # Ground truth for pinning: kubelet-reported used share devices
+        # may never lose or change their chips (the sharing twin of the
+        # tiling rule that used slices are never moved).
+        self._sharing_client = sharing_client
+
+    def _pinned_ids(self) -> set[str]:
+        if self._sharing_client is None:
+            return set()
+        return {
+            d.device_id
+            for d in self._sharing_client.get_tpu_devices().get_used()
+        }
+
+    def reconcile(self, request: Request) -> Result:
+        node = self._kube.get("Node", self._node_name)
+        ann = objects.annotations(node)
+        self._shared.last_parsed_plan_id = ann.get(
+            constants.ANNOTATION_PARTITIONING_PLAN
+        )
+        _, spec = parse_node_annotations(ann)
+        geometry: Geometry = {}
+        for s in spec:
+            try:
+                SharedProfile.parse(s.profile)
+            except ValueError:
+                continue  # tiling profile on a sharing node: not ours
+            geometry[s.profile] = geometry.get(s.profile, 0) + s.quantity
+        # Non-destructive apply: no report-before-apply gating needed, so
+        # the latch is left alone — only the plan-ID ack flows through.
+        try:
+            self._manager.set_geometry(geometry, self._pinned_ids())
+        except GenericError as e:
+            # Oversized/invalid spec (e.g. labels disagree with the real
+            # host): keep the previous advertisement and say so; the
+            # reporter's status keeps showing reality, so the planner
+            # re-plans from truth.
+            logger.warning(
+                "share actuator: node %s spec %s not applicable: %s",
+                self._node_name,
+                geometry,
+                e,
+            )
+            return Result(requeue_after=5.0)
+        return Result()

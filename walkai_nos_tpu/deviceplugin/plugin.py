@@ -17,6 +17,7 @@ import os
 import queue
 import threading
 from concurrent import futures
+from typing import Callable
 
 import grpc
 
@@ -37,17 +38,25 @@ def _socket_name(resource_name: str) -> str:
 
 
 class SliceDevicePlugin:
-    """One DevicePlugin server for one `walkai.io/tpu-<shape>` resource."""
+    """One DevicePlugin server for one `walkai.io/tpu-*` resource.
+
+    The inventory `source` defaults to the tpudev slice store; the
+    sharing agent passes its own source (share records derived from the
+    node's spec annotations, `tpu/sharing/assign.py`) — same gRPC
+    surface, different ground truth."""
 
     def __init__(
         self,
         resource_name: str,
-        tpudev: TpudevClient,
+        tpudev: TpudevClient | None,
         plugin_dir: str = constants.DEVICE_PLUGIN_SOCKET_DIR,
         dev_dir: str = "/dev",
+        source: "Callable[[], list[SliceInfo]] | None" = None,
     ) -> None:
+        if tpudev is None and source is None:
+            raise ValueError("either tpudev or source is required")
         self.resource_name = resource_name
-        self._tpudev = tpudev
+        self._source = source or tpudev.list_slices
         self._plugin_dir = plugin_dir
         self._dev_dir = dev_dir
         self.socket_path = os.path.join(plugin_dir, _socket_name(resource_name))
@@ -60,7 +69,7 @@ class SliceDevicePlugin:
     def _slices(self) -> list[SliceInfo]:
         return [
             s
-            for s in self._tpudev.list_slices()
+            for s in self._source()
             if s.resource_name == self.resource_name
         ]
 
@@ -207,18 +216,23 @@ class SliceDevicePlugin:
 
 
 class PluginManager:
-    """Runs one SliceDevicePlugin per distinct slice resource on the host,
-    creating/retiring plugins as the tpuagent re-tiles the mesh."""
+    """Runs one SliceDevicePlugin per distinct device resource on the
+    host, creating/retiring plugins as the inventory changes — slices
+    from tpudev as the tpuagent re-tiles, or (with `source`) shares
+    derived from spec annotations for the sharing agent."""
 
     def __init__(
         self,
-        tpudev: TpudevClient,
+        tpudev: TpudevClient | None,
         plugin_dir: str = constants.DEVICE_PLUGIN_SOCKET_DIR,
         kubelet_socket: str | None = None,
         dev_dir: str = "/dev",
         poll_interval: float = 2.0,
+        source: "Callable[[], list[SliceInfo]] | None" = None,
     ) -> None:
-        self._tpudev = tpudev
+        if tpudev is None and source is None:
+            raise ValueError("either tpudev or source is required")
+        self._source = source or tpudev.list_slices
         self._plugin_dir = plugin_dir
         self._kubelet_socket = kubelet_socket or os.path.join(
             plugin_dir, "kubelet.sock"
@@ -233,14 +247,15 @@ class PluginManager:
     def sync(self) -> None:
         """Reconcile the plugin set with the current slice inventory."""
         by_resource: dict[str, list[str]] = {}
-        for s in self._tpudev.list_slices():
+        for s in self._source():
             by_resource.setdefault(s.resource_name, []).append(s.slice_id)
         inventory = {
             res: tuple(sorted(ids)) for res, ids in by_resource.items()
         }
         for res in sorted(inventory.keys() - self.plugins.keys()):
             plugin = SliceDevicePlugin(
-                res, self._tpudev, self._plugin_dir, self._dev_dir
+                res, None, self._plugin_dir, self._dev_dir,
+                source=self._source,
             )
             plugin.start()
             try:

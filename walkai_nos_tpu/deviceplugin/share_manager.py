@@ -1,0 +1,67 @@
+"""Share device-plugin manager: spec annotations -> advertised shares.
+
+The sharing agent's actuation half. Unlike tiling there is nothing to
+materialize on the device layer — a share is pure advertisement plus the
+env injected at Allocate — but chip assignments must stay stable under
+geometry changes and restarts (`tpu/sharing/assign.ShareAssigner`, which
+persists host-side like tpudev persists slice records). The same
+PluginManager/gRPC machinery the tiling agent uses serves the shares to
+the kubelet.
+"""
+
+from __future__ import annotations
+
+import os
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.deviceplugin.plugin import PluginManager
+from walkai_nos_tpu.tpu.partitioning import Geometry
+from walkai_nos_tpu.tpu.sharing.assign import ShareAssigner
+
+_DEFAULT_STATE_DIR = "/var/run/walkai-tpudev"
+
+
+class SharePluginManager:
+    """Serves one device plugin per shared resource, advertising the
+    shares assigned from the current geometry."""
+
+    def __init__(
+        self,
+        host_chip_count: int,
+        plugin_dir: str = constants.DEVICE_PLUGIN_SOCKET_DIR,
+        kubelet_socket: str | None = None,
+        dev_dir: str = "/dev",
+        poll_interval: float = 2.0,
+        state_path: str | None = None,
+    ) -> None:
+        if state_path is None:
+            state_dir = os.environ.get("TPUDEV_STATE_DIR", _DEFAULT_STATE_DIR)
+            state_path = os.path.join(state_dir, "shares.json")
+        self._assigner = ShareAssigner(host_chip_count, state_path)
+        self._manager = PluginManager(
+            None,
+            plugin_dir,
+            kubelet_socket,
+            dev_dir,
+            poll_interval,
+            source=self._assigner.shares,
+        )
+
+    def shares(self):
+        return self._assigner.shares()
+
+    def set_geometry(
+        self, geometry: Geometry, pinned_ids: set[str] | None = None
+    ) -> None:
+        """Reconcile the advertised shares. Raises GenericError (leaving
+        the previous assignment advertised) when the geometry cannot fit."""
+        before = self._assigner.shares()
+        after = self._assigner.set_geometry(geometry, pinned_ids)
+        if after != before:
+            self._manager.sync()
+
+    def start(self) -> None:
+        self._manager.start()
+
+    def stop(self) -> None:
+        self._manager.stop()

@@ -42,24 +42,38 @@ from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
 
 
 class SimNode:
-    """One simulated TPU host: tpudev + kubelet resources + agent."""
+    """One simulated TPU host: tpudev + kubelet resources + agent.
+
+    `kind` is "tiling" (slices from the fake tpudev) or "sharing"
+    (shares assigned from the node's spec annotations)."""
 
     def __init__(
         self,
         name: str,
         mesh: Shape = (2, 4),
         accelerator: str = "tpu-v5-lite-podslice",
+        kind: str = "tiling",
     ) -> None:
         self.name = name
         self.mesh = mesh
         self.accelerator = accelerator
+        self.kind = kind
         self.tpudev = FakeTpudevClient(mesh=mesh)
         self.resources = FakeResourceClient()
         self.shared = SharedState()
+        from walkai_nos_tpu.tpu.sharing.assign import ShareAssigner
+        from walkai_nos_tpu.tpu.topology import shape_chip_count
+
+        self.share_assigner = ShareAssigner(shape_chip_count(mesh))
+
+    def _inventory(self) -> list:
+        if self.kind == "sharing":
+            return self.share_assigner.shares()
+        return self.tpudev.list_slices()
 
     def advertise_slices(self) -> None:
         """What the device plugin does on (re)start: advertise every
-        materialized slice as an allocatable device."""
+        materialized slice/share as an allocatable device."""
         used_ids = {
             d.device_id for d in self.resources.get_used_devices()
         }
@@ -71,7 +85,7 @@ class SimNode:
                     status=DeviceStatus.UNKNOWN,
                     mesh_index=s.mesh_index,
                 )
-                for s in self.tpudev.list_slices()
+                for s in self._inventory()
             ]
         )
         for dev_id in used_ids:
@@ -124,6 +138,36 @@ class SimCluster:
         self._wire_agent(sim)
         return sim
 
+    def add_sharing_node(
+        self,
+        name: str,
+        mesh: Shape = (2, 4),
+        accelerator: str = "tpu-v5-lite-podslice",
+    ) -> SimNode:
+        """A chip-count-sharing host: ShareActuator + sharing Reporter
+        instead of the tiling agent pair."""
+        sim = SimNode(name, mesh=mesh, accelerator=accelerator, kind="sharing")
+        self.nodes[name] = sim
+        self.kube.create(
+            "Node",
+            {
+                "metadata": {
+                    "name": name,
+                    "labels": {
+                        constants.LABEL_TPU_ACCELERATOR: accelerator,
+                        constants.LABEL_TPU_TOPOLOGY: "x".join(
+                            str(d) for d in mesh
+                        ),
+                        constants.LABEL_TPU_PARTITIONING: "sharing",
+                    },
+                },
+                "status": {"capacity": {}, "allocatable": {}},
+            },
+        )
+        self._create_plugin_pod(name)
+        self._wire_sharing_agent(sim)
+        return sim
+
     def _create_plugin_pod(self, node_name: str) -> None:
         self.kube.create(
             "Pod",
@@ -173,6 +217,64 @@ class SimCluster:
         self.manager.add(
             Controller(
                 f"actuator-{sim.name}",
+                self.kube,
+                "Node",
+                actuator.reconcile,
+                predicates=[
+                    predicates.matching_name(sim.name),
+                    predicates.exclude_delete(),
+                    predicates.annotations_changed(),
+                ],
+            )
+        )
+
+    def _wire_sharing_agent(self, sim: SimNode) -> None:
+        from walkai_nos_tpu.controllers.tpuagent.share_actuator import (
+            ShareActuator,
+        )
+        from walkai_nos_tpu.tpu.sharing.client import SharingClient
+        from walkai_nos_tpu.tpu.sharing.profile import (
+            extract_shared_profile_name,
+        )
+
+        class _SimShareManager:
+            """set_geometry target: the plugin simulator re-advertises
+            the assigner's shares on its next tick."""
+
+            def set_geometry(self, geometry, pinned_ids=None):
+                sim.share_assigner.set_geometry(geometry, pinned_ids)
+
+        sharing_client = SharingClient(sim.resources)
+        reporter = Reporter(
+            self.kube,
+            sharing_client,
+            sim.shared,
+            sim.name,
+            refresh_interval=self._report_interval,
+            profile_extractor=extract_shared_profile_name,
+        )
+        actuator = ShareActuator(
+            self.kube,
+            sim.shared,
+            sim.name,
+            _SimShareManager(),
+            sharing_client=sharing_client,
+        )
+        self.manager.add(
+            Controller(
+                f"sharing-reporter-{sim.name}",
+                self.kube,
+                "Node",
+                reporter.reconcile,
+                predicates=[
+                    predicates.matching_name(sim.name),
+                    predicates.exclude_delete(),
+                ],
+            )
+        )
+        self.manager.add(
+            Controller(
+                f"sharing-actuator-{sim.name}",
                 self.kube,
                 "Node",
                 actuator.reconcile,
@@ -246,7 +348,18 @@ class SimCluster:
             return Result()
         if objects.pod_is_scheduled(pod) or not objects.pod_is_pending(pod):
             return Result()
-        wanted = get_requested_profiles(pod)
+        from walkai_nos_tpu.tpu.sharing.profile import (
+            get_requested_shared_profiles,
+            shared_profile_resource_name,
+        )
+
+        # Unified resource-name demand: tiling slices + chip-count shares.
+        wanted: dict[str, int] = {
+            constants.RESOURCE_TPU_SLICE_PREFIX + p: q
+            for p, q in get_requested_profiles(pod).items()
+        }
+        for p, q in get_requested_shared_profiles(pod).items():
+            wanted[shared_profile_resource_name(p)] = q
         if not wanted:
             return Result()
         with self._lock:
@@ -254,13 +367,11 @@ class SimCluster:
                 free = self._free_devices(sim)
                 chosen: list[Device] = []
                 satisfiable = True
-                for profile, qty in wanted.items():
+                for resource, qty in wanted.items():
                     matches = [
                         d
                         for d in free
-                        if d.resource_name
-                        == constants.RESOURCE_TPU_SLICE_PREFIX + profile
-                        and d not in chosen
+                        if d.resource_name == resource and d not in chosen
                     ]
                     if len(matches) < qty:
                         satisfiable = False
@@ -338,6 +449,28 @@ class SimCluster:
     def create_slice_pod(
         self, name: str, profile: str, quantity: int = 1, namespace: str = "default"
     ) -> dict:
+        return self._create_resource_pod(
+            name,
+            constants.RESOURCE_TPU_SLICE_PREFIX + profile,
+            quantity,
+            namespace,
+        )
+
+    def create_shared_pod(
+        self, name: str, profile: str, quantity: int = 1, namespace: str = "default"
+    ) -> dict:
+        """A pod requesting a chip-count share, e.g. profile \"2c\"."""
+        from walkai_nos_tpu.tpu.sharing.profile import (
+            shared_profile_resource_name,
+        )
+
+        return self._create_resource_pod(
+            name, shared_profile_resource_name(profile), quantity, namespace
+        )
+
+    def _create_resource_pod(
+        self, name: str, resource: str, quantity: int, namespace: str
+    ) -> dict:
         return self.kube.create(
             "Pod",
             {
@@ -347,14 +480,8 @@ class SimCluster:
                         {
                             "name": "main",
                             "resources": {
-                                "requests": {
-                                    constants.RESOURCE_TPU_SLICE_PREFIX
-                                    + profile: str(quantity)
-                                },
-                                "limits": {
-                                    constants.RESOURCE_TPU_SLICE_PREFIX
-                                    + profile: str(quantity)
-                                },
+                                "requests": {resource: str(quantity)},
+                                "limits": {resource: str(quantity)},
                             },
                         }
                     ]

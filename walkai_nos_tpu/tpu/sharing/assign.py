@@ -1,0 +1,178 @@
+"""Share→chip assignment: stable under geometry changes and restarts.
+
+The actuation core of dynamic sharing (the capability the reference fork
+reduced to report-only; upstream nos planned MPS layouts the same way it
+planned MIG). A sharing node's desired state is its spec annotations —
+a Geometry of chip-count profiles ("2c": 2, …) — and a share is pure
+advertisement plus the env injected at Allocate.
+
+Chip sets must be *stable*: the kubelet identifies devices by ID and
+never re-allocates a running pod, so a share's chips may never change
+while it exists, and chips belonging to an allocated (pinned) share may
+never be handed to a new one — the sharing twin of the tiling rule that
+used slices are never moved (`pkg/gpu/mig/gpu.go:99`). `ShareAssigner`
+therefore assigns incrementally against its previous assignment
+(optionally persisted host-side, as tpudev persists slice records) and
+treats kubelet-reported used device IDs as pinned.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.partitioning import Geometry
+from walkai_nos_tpu.tpu.sharing.profile import SharedProfile
+from walkai_nos_tpu.tpudev.client import SliceInfo
+
+
+def make_share_env(chip_ids: tuple[int, ...], share_id: str) -> dict:
+    """Runtime env injected at Allocate: the share's chips only. Shares
+    have no mesh placement, so process bounds collapse to a 1-D chip
+    list (same enforcement contract as slices: env visibility,
+    `walkai_nos_tpu/tpudev/env.py`)."""
+    return {
+        "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chip_ids),
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": f"{len(chip_ids)},1,1",
+        "TPU_SLICE_ID": share_id,
+    }
+
+
+def _share_info(share_id: str, profile: str, chip_ids: tuple[int, ...]) -> SliceInfo:
+    return SliceInfo(
+        slice_id=share_id,
+        profile=profile,
+        mesh_index=0,
+        chip_ids=chip_ids,
+        env=make_share_env(chip_ids, share_id),
+    )
+
+
+class ShareAssigner:
+    """Incremental chip assignment for shares.
+
+    set_geometry(geometry, pinned_ids) reconciles the assignment:
+
+    - existing shares still wanted keep their exact chips;
+    - pinned (allocated) shares are kept even if the geometry shrank
+      below them — the spec lags reality, never the other way;
+    - removed shares return their chips to the pool;
+    - new shares take the lowest free chip ids.
+
+    With `state_path`, the assignment survives agent restarts (flat JSON,
+    written atomically) so a crash can't re-deal chips under running
+    pods.
+    """
+
+    def __init__(self, host_chip_count: int, state_path: str | None = None):
+        self._host_chip_count = host_chip_count
+        self._state_path = state_path
+        # share_id -> (profile, chip_ids)
+        self._assigned: dict[str, tuple[str, tuple[int, ...]]] = {}
+        if state_path and os.path.exists(state_path):
+            with open(state_path) as f:
+                raw = json.load(f)
+            self._assigned = {
+                sid: (p, tuple(chips)) for sid, (p, chips) in raw.items()
+            }
+
+    # ------------------------------------------------------------- queries
+
+    def shares(self) -> list[SliceInfo]:
+        return [
+            _share_info(sid, profile, chips)
+            for sid, (profile, chips) in sorted(self._assigned.items())
+        ]
+
+    # ------------------------------------------------------------ assigning
+
+    def set_geometry(
+        self, geometry: Geometry, pinned_ids: set[str] | None = None
+    ) -> list[SliceInfo]:
+        """Reconcile to `geometry`; raises GenericError (without mutating
+        state) when the result cannot fit the host."""
+        pinned_ids = pinned_ids or set()
+        by_profile: dict[str, list[str]] = {}
+        for sid, (profile, _) in sorted(self._assigned.items()):
+            by_profile.setdefault(profile, []).append(sid)
+
+        keep: dict[str, tuple[str, tuple[int, ...]]] = {}
+        for profile, quantity in geometry.items():
+            chips = SharedProfile.parse(profile).chip_count()  # validates
+            existing = by_profile.get(profile, [])
+            # pinned first, then canonical order, capped at the quantity —
+            # but never drop a pinned share.
+            ordered = sorted(existing, key=lambda s: (s not in pinned_ids, s))
+            kept = [
+                sid
+                for i, sid in enumerate(ordered)
+                if i < quantity or sid in pinned_ids
+            ]
+            for sid in kept:
+                keep[sid] = self._assigned[sid]
+            # new shares for the shortfall
+            shortfall = quantity - len(kept)
+            ordinal = 0
+            while shortfall > 0:
+                sid = f"{profile}#{ordinal}"
+                if sid in keep or sid in self._assigned:
+                    ordinal += 1
+                    continue
+                keep[sid] = (profile, ())  # chips assigned below
+                shortfall -= 1
+                ordinal += 1
+        # profiles no longer in the geometry: keep only pinned shares
+        for profile, sids in by_profile.items():
+            if profile in geometry:
+                continue
+            for sid in sids:
+                if sid in pinned_ids:
+                    keep[sid] = self._assigned[sid]
+
+        taken: set[int] = set()
+        for _, chips in keep.values():
+            taken.update(chips)
+        free = [c for c in range(self._host_chip_count) if c not in taken]
+        new_assigned: dict[str, tuple[str, tuple[int, ...]]] = {}
+        for sid in sorted(keep):
+            profile, chips = keep[sid]
+            if not chips:
+                need = SharedProfile.parse(profile).chip_count()
+                if need > len(free):
+                    raise GenericError(
+                        f"shares exceed host chips: {geometry} on "
+                        f"{self._host_chip_count} chips "
+                        f"({len(free)} free for {sid})"
+                    )
+                chips = tuple(free[:need])
+                free = free[need:]
+            new_assigned[sid] = (profile, chips)
+        self._assigned = new_assigned
+        self._persist()
+        return self.shares()
+
+    def _persist(self) -> None:
+        if not self._state_path:
+            return
+        os.makedirs(os.path.dirname(self._state_path) or ".", exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(self._state_path) or "."
+        )
+        with os.fdopen(fd, "w") as f:
+            json.dump(
+                {
+                    sid: [p, list(chips)]
+                    for sid, (p, chips) in self._assigned.items()
+                },
+                f,
+            )
+        os.replace(tmp, self._state_path)
+
+
+def assign_shares(host_chip_count: int, geometry: Geometry) -> list[SliceInfo]:
+    """Pure from-scratch assignment (fresh hosts, tests, simulators):
+    one ShareAssigner shot with no prior state."""
+    return ShareAssigner(host_chip_count).set_geometry(geometry)

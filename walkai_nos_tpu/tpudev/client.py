@@ -51,8 +51,16 @@ class SliceInfo:
     @property
     def resource_name(self) -> str:
         from walkai_nos_tpu.api import constants
+        from walkai_nos_tpu.tpu.sharing.profile import SharedProfile
 
-        return constants.RESOURCE_TPU_SLICE_PREFIX + self.profile
+        # Chip-count shares ("2c") advertise under the shared prefix;
+        # mesh shapes ("2x2") under the slice prefix. The shared grammar
+        # has exactly one authority: SharedProfile.
+        try:
+            SharedProfile.parse(self.profile)
+        except ValueError:
+            return constants.RESOURCE_TPU_SLICE_PREFIX + self.profile
+        return constants.RESOURCE_TPU_SHARED_PREFIX + self.profile
 
 
 class TpudevClient(abc.ABC):
