@@ -153,6 +153,33 @@ class TestE2EOverApiServer:
         finally:
             manager.stop()
 
+    def test_sharing_loop_over_http(self, api):
+        """Dynamic sharing (the restored MPS-analogue planning loop) over
+        the real wire path: plan -> advertise -> bind -> report."""
+        kube = RestKubeClient(server=api)
+        sim = SimCluster(report_interval=0.1, kube=kube)
+        sim.add_sharing_node("share-host", mesh=(2, 4))
+        with sim:
+            sim.create_shared_pod("share-job", "2c")
+
+            def bound():
+                pod = kube.get("Pod", "share-job", "default")
+                return (pod.get("spec") or {}).get("nodeName") == "share-host"
+
+            eventually(bound, timeout=30.0, msg="shared pod bound over HTTP")
+
+            def status_used():
+                node = kube.get("Node", "share-host")
+                status, _ = parse_node_annotations(objects.annotations(node))
+                return any(
+                    s.profile == "2c" and s.status == DeviceStatus.USED
+                    for s in status
+                )
+
+            eventually(
+                status_used, timeout=30.0, msg="share status used over HTTP"
+            )
+
     def test_multi_host_node_refused_over_http(self, api):
         kube = RestKubeClient(server=api)
         sim = SimCluster(report_interval=0.1, kube=kube)
