@@ -41,13 +41,30 @@ helm upgrade --install walkai-nos helm-charts/walkai-nos-tpu \
   --set kubeRbacProxy.enabled=false \
   --set agent.extraEnv[0].name=WALKAI_TPUDEV_FAKE \
   --set agent.extraEnv[0].value=2x4 \
+  --set sharingAgent.enabled=true \
+  --set sharingAgent.image.repository="${IMG%:*}" \
+  --set sharingAgent.image.tag="${IMG##*:}" \
+  --set sharingAgent.extraEnv[0].name=WALKAI_TPUDEV_FAKE \
+  --set sharingAgent.extraEnv[0].value=2x4 \
   --wait --timeout 180s
 
-say "labeling ${WORKER} as a v5e 2x4 TPU host"
+say "labeling ${WORKER} as a v5e 2x4 TPU host (tiling)"
 kubectl label node "${WORKER}" --overwrite \
   cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice \
   cloud.google.com/gke-tpu-topology=2x4 \
   nos.walkai.io/tpu-partitioning=tiling
+
+# Label worker2 for sharing BEFORE any pod is created: nodes are
+# first-fit candidates in API order, so a still-tiling worker2 could
+# otherwise capture the tiling pod and then be relabeled under it.
+WORKER2="${CLUSTER}-worker2"
+if kubectl get node "${WORKER2}" >/dev/null 2>&1; then
+  say "labeling ${WORKER2} as a chip-count-sharing host"
+  kubectl label node "${WORKER2}" --overwrite \
+    cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice \
+    cloud.google.com/gke-tpu-topology=2x4 \
+    nos.walkai.io/tpu-partitioning=sharing
+fi
 
 say "waiting for node init (spec annotations)"
 for i in $(seq 1 60); do
@@ -93,6 +110,43 @@ if ! kubectl wait pod/e2e-slice-pod --for=condition=PodScheduled \
   kubectl -n "${NS}" logs -l app.kubernetes.io/component=partitioner \
     --tail=50 || true
   exit 1
+fi
+
+say "tiling scenario PASS"
+
+# ---- dynamic sharing scenario (second worker, labeled above) ----------
+if kubectl get node "${WORKER2}" >/dev/null 2>&1; then
+  say "creating a pending 2c share pod"
+  kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-share-pod
+  namespace: default
+spec:
+  restartPolicy: Never
+  containers:
+    - name: main
+      image: busybox:1.36
+      command: ["sleep", "300"]
+      resources:
+        requests: {"walkai.io/tpu-shared-2c": "1"}
+        limits: {"walkai.io/tpu-shared-2c": "1"}
+EOF
+
+  say "waiting for the share pod to schedule (plan -> advertise -> bind)"
+  if ! kubectl wait pod/e2e-share-pod --for=condition=PodScheduled \
+      --timeout=180s; then
+    echo "FAIL: share pod never scheduled"
+    kubectl describe pod e2e-share-pod | tail -20
+    kubectl -n "${NS}" logs -l app=tpusharingagent --tail=50 || true
+    kubectl -n "${NS}" logs -l app.kubernetes.io/component=partitioner \
+      --tail=50 || true
+    exit 1
+  fi
+  say "sharing scenario PASS"
+else
+  say "no ${WORKER2} in this cluster; skipping the sharing scenario"
 fi
 
 say "PASS: e2e scenario complete"

@@ -32,6 +32,30 @@ def kubelet():
     shutil.rmtree(root, ignore_errors=True)
 
 
+def _list_and_watch(channel):
+    """Open the v1beta1 ListAndWatch stream on a plugin channel."""
+    return channel.unary_stream(
+        "/v1beta1.DevicePlugin/ListAndWatch",
+        request_serializer=dp.Empty.SerializeToString,
+        response_deserializer=dp.ListAndWatchResponse.FromString,
+    )(dp.Empty())
+
+
+def _allocate(channel, device_ids):
+    """One v1beta1 Allocate call for `device_ids`."""
+    return channel.unary_unary(
+        "/v1beta1.DevicePlugin/Allocate",
+        request_serializer=dp.AllocateRequest.SerializeToString,
+        response_deserializer=dp.AllocateResponse.FromString,
+    )(
+        dp.AllocateRequest(
+            container_requests=[
+                dp.ContainerAllocateRequest(devicesIDs=list(device_ids))
+            ]
+        )
+    )
+
+
 class TestPodResourcesClient:
     def test_allocatable_and_used(self, kubelet):
         kubelet.set_allocatable(
@@ -85,29 +109,13 @@ class TestDevicePlugin:
             assert kubelet.registrations[0].version == "v1beta1"
 
             channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
-            stream = channel.unary_stream(
-                "/v1beta1.DevicePlugin/ListAndWatch",
-                request_serializer=dp.Empty.SerializeToString,
-                response_deserializer=dp.ListAndWatchResponse.FromString,
-            )(dp.Empty())
-            first = next(stream)
+            first = next(_list_and_watch(channel))
             assert sorted(d.ID for d in first.devices) == [
                 "2x2@0-0", "2x2@0-2",
             ]
             assert all(d.health == "Healthy" for d in first.devices)
 
-            allocate = channel.unary_unary(
-                "/v1beta1.DevicePlugin/Allocate",
-                request_serializer=dp.AllocateRequest.SerializeToString,
-                response_deserializer=dp.AllocateResponse.FromString,
-            )
-            resp = allocate(
-                dp.AllocateRequest(
-                    container_requests=[
-                        dp.ContainerAllocateRequest(devicesIDs=["2x2@0-0"])
-                    ]
-                )
-            )
+            resp = _allocate(channel, ["2x2@0-0"])
             creq = resp.container_responses[0]
             assert creq.envs["TPU_VISIBLE_CHIPS"] == "0,1,4,5"
             assert creq.envs["TPU_SLICE_ID"] == "2x2@0-0"
@@ -126,11 +134,7 @@ class TestDevicePlugin:
         plugin.start()
         try:
             channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
-            stream = channel.unary_stream(
-                "/v1beta1.DevicePlugin/ListAndWatch",
-                request_serializer=dp.Empty.SerializeToString,
-                response_deserializer=dp.ListAndWatchResponse.FromString,
-            )(dp.Empty())
+            stream = _list_and_watch(channel)
             assert len(next(stream).devices) == 2
             tpudev.delete_slice("2x2@0-2")
             plugin.notify()
@@ -170,16 +174,51 @@ class TestDevicePlugin:
             ]
             plugin = manager.plugins["walkai.io/tpu-1x2"]
             channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
-            stream = channel.unary_stream(
-                "/v1beta1.DevicePlugin/ListAndWatch",
-                request_serializer=dp.Empty.SerializeToString,
-                response_deserializer=dp.ListAndWatchResponse.FromString,
-            )(dp.Empty())
+            stream = _list_and_watch(channel)
             deadline = time.monotonic() + 5
             devices = list(next(stream).devices)
             while devices and time.monotonic() < deadline:
                 devices = list(next(stream).devices)
             assert devices == []
+            channel.close()
+        finally:
+            manager.stop()
+
+
+class TestSharePlugin:
+    """The restored sharing actuation over REAL gRPC: spec geometry ->
+    SharePluginManager -> kubelet registration + ListAndWatch +
+    Allocate with the share's chip env."""
+
+    def test_share_manager_registers_and_allocates(self, kubelet, tmp_path):
+        from walkai_nos_tpu.deviceplugin.share_manager import (
+            SharePluginManager,
+        )
+
+        manager = SharePluginManager(
+            8,
+            plugin_dir=kubelet.plugin_dir,
+            kubelet_socket=kubelet.registration_socket,
+            poll_interval=0.1,
+            state_path=str(tmp_path / "shares.json"),
+        )
+        manager.set_geometry({"2c": 2})
+        try:
+            registered = [r.resource_name for r in kubelet.registrations]
+            assert registered == ["walkai.io/tpu-shared-2c"]
+            plugin = manager._manager.plugins["walkai.io/tpu-shared-2c"]
+            channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            first = next(_list_and_watch(channel))
+            assert sorted(d.ID for d in first.devices) == ["2c#0", "2c#1"]
+
+            resp = _allocate(channel, ["2c#0"])
+            env = dict(resp.container_responses[0].envs)
+            assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+            assert env["TPU_SLICE_ID"] == "2c#0"
+            paths = [
+                d.host_path for d in resp.container_responses[0].devices
+            ]
+            assert paths == ["/dev/accel0", "/dev/accel1"]
             channel.close()
         finally:
             manager.stop()
