@@ -169,3 +169,31 @@ class TestSharingNode:
         clone = node.clone()
         clone.add_pod({"2c": 1})
         assert node.meshes[0].used == {}
+
+
+class TestRepackKeepsWantedProfiles:
+    """Regression (review finding): a wanted profile already covered by a
+    free share must survive the phase-2 repack — it must not lose its
+    chips to the shortfall of a smaller profile."""
+
+    def test_covered_profile_survives_repack(self):
+        m = mesh(free={"4c": 1, "2c": 2})  # 8 chips all free
+        assert m.update_geometry_for({"1c": 1, "4c": 1}) is True
+        assert m.free_count("4c") == 1
+        assert m.free_count("1c") == 1
+        m.validate()
+
+    def test_node_level_multi_profile_demand(self):
+        node = SharingNode.from_node(
+            "n1",
+            {
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: "2x4",
+            },
+            {
+                "nos.walkai.io/status-tpu-0-4c-free": "1",
+                "nos.walkai.io/status-tpu-0-2c-free": "2",
+            },
+        )
+        assert node.update_geometry_for({"1c": 1, "4c": 1}) is True
+        assert node.provides_profiles({"1c": 1, "4c": 1})

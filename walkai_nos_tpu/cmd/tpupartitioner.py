@@ -52,7 +52,9 @@ def build_manager(kube, config: PartitionerConfig) -> Manager:
                 predicates.all_of(
                     predicates.has_label(constants.LABEL_TPU_PARTITIONING),
                     predicates.exclude_delete(),
-                    predicates.annotations_changed(),
+                    # Status-only: the partitioner's own spec/plan writes
+                    # must not re-enqueue the pods it just planned for.
+                    predicates.status_annotations_changed(),
                 )
             ],
         )

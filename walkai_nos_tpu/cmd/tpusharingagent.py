@@ -71,7 +71,11 @@ def main(argv: list[str] | None = None) -> int:
     kube = _common.build_kube_client()
     health = _common.start_health(config.manager.health_probe_addr)
 
-    host = tpudev.get_topology()
+    try:
+        host = tpudev.get_topology()
+    except TpuError as e:
+        logger.error("device layer unavailable: %s", e)
+        return 1
     from walkai_nos_tpu.controllers.tpuagent.share_actuator import (
         ShareActuator,
     )

@@ -139,15 +139,7 @@ class PodController:
     def _shared_profiles_already_available(
         self, nodes: list[dict], wanted: Geometry
     ) -> bool:
-        for node_obj in nodes:
-            node = SharingNode.from_node(
-                objects.name(node_obj),
-                objects.labels(node_obj),
-                objects.annotations(node_obj),
-            )
-            if node.provides_profiles(wanted):
-                return True
-        return False
+        return self._available(nodes, wanted, SharingNode.from_node)
 
     def _try_reshare(
         self, nodes: list[dict], wanted: Geometry, pod: dict
@@ -162,8 +154,13 @@ class PodController:
     def _profiles_already_available(
         self, nodes: list[dict], wanted: Geometry
     ) -> bool:
+        return self._available(nodes, wanted, Node.from_node)
+
+    def _available(
+        self, nodes: list[dict], wanted: Geometry, node_factory
+    ) -> bool:
         for node_obj in nodes:
-            node = Node.from_node(
+            node = node_factory(
                 objects.name(node_obj),
                 objects.labels(node_obj),
                 objects.annotations(node_obj),

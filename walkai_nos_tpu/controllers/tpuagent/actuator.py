@@ -79,10 +79,11 @@ class Actuator:
         node = self._kube.get("Node", self._node_name)
         ann = objects.annotations(node)
         plan_id = ann.get(constants.ANNOTATION_PARTITIONING_PLAN)
-        self._shared.last_parsed_plan_id = plan_id
 
         status, spec = parse_node_annotations(ann)
         if spec_matches_status(spec, status):
+            # Converged: the plan is realized, ack it.
+            self._shared.last_parsed_plan_id = plan_id
             return Result()
 
         applied_key = (plan_id, frozenset(status))
@@ -95,9 +96,15 @@ class Actuator:
         if plan is None:  # stale device -> plugin restarted instead
             return Result(requeue_after=1.0)
         if plan.is_empty():
+            self._shared.last_parsed_plan_id = plan_id
             return Result()
         logger.info("actuator: node %s applying plan %s", self._node_name, plan.summary())
         self._apply(plan)
+        # Ack only plans that actually actuated: a failed apply must not
+        # be echoed into status-partitioning-plan, or the partitioner
+        # would take an unrealized plan as acknowledged and keep minting
+        # fresh plan IDs against it (ack-write -> replan churn).
+        self._shared.last_parsed_plan_id = plan_id
         self._last_applied = applied_key
         self._shared.on_apply_done()
         return Result()

@@ -46,17 +46,20 @@ class ShareActuator:
     def _pinned_ids(self) -> set[str]:
         if self._sharing_client is None:
             return set()
+        from walkai_nos_tpu.tpu.sharing.client import extract_shared_device_id
+
+        # Strip the device-plugin replica suffix ("2c#0::1" -> "2c#0"):
+        # assigner share IDs never carry it, and an unmatched pin is a
+        # silently unprotected allocation.
         return {
-            d.device_id
+            extract_shared_device_id(d.device_id)
             for d in self._sharing_client.get_tpu_devices().get_used()
         }
 
     def reconcile(self, request: Request) -> Result:
         node = self._kube.get("Node", self._node_name)
         ann = objects.annotations(node)
-        self._shared.last_parsed_plan_id = ann.get(
-            constants.ANNOTATION_PARTITIONING_PLAN
-        )
+        plan_id = ann.get(constants.ANNOTATION_PARTITIONING_PLAN)
         _, spec = parse_node_annotations(ann)
         geometry: Geometry = {}
         for s in spec:
@@ -71,9 +74,9 @@ class ShareActuator:
             self._manager.set_geometry(geometry, self._pinned_ids())
         except GenericError as e:
             # Oversized/invalid spec (e.g. labels disagree with the real
-            # host): keep the previous advertisement and say so; the
-            # reporter's status keeps showing reality, so the planner
-            # re-plans from truth.
+            # host): keep the previous advertisement, do NOT ack the plan
+            # (an acked-but-unrealized plan would feed replan churn), and
+            # say so; the reporter's status keeps showing reality.
             logger.warning(
                 "share actuator: node %s spec %s not applicable: %s",
                 self._node_name,
@@ -81,4 +84,6 @@ class ShareActuator:
                 e,
             )
             return Result(requeue_after=5.0)
+        # Ack only applied plans.
+        self._shared.last_parsed_plan_id = plan_id
         return Result()

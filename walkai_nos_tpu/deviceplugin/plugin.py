@@ -243,9 +243,17 @@ class PluginManager:
         self._last_inventory: dict[str, tuple[str, ...]] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # sync() runs on both the poll loop and (for shares) the
+        # actuator's controller thread — serialize it, or two threads
+        # can double-start a plugin for the same new resource.
+        self._sync_lock = threading.Lock()
 
     def sync(self) -> None:
-        """Reconcile the plugin set with the current slice inventory."""
+        """Reconcile the plugin set with the current inventory."""
+        with self._sync_lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
         by_resource: dict[str, list[str]] = {}
         for s in self._source():
             by_resource.setdefault(s.resource_name, []).append(s.slice_id)

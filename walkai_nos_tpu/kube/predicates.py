@@ -44,6 +44,31 @@ def annotations_changed() -> Predicate:
     return pred
 
 
+def status_annotations_changed() -> Predicate:
+    """MODIFIED events only when the AGENT-written annotations (status
+    slices/shares + plan ack) differ — the partitioner's pending-pod
+    mapper keys on these so its own spec/plan writes can't re-trigger it
+    (a spec write would otherwise re-enqueue the pod whose planning just
+    wrote that spec, looping plan-id churn through the API server).
+    ADDED always passes."""
+    from walkai_nos_tpu.api import constants
+
+    def status_view(obj: Mapping) -> dict:
+        return {
+            k: v
+            for k, v in objects.annotations(obj).items()
+            if k.startswith(constants.ANNOTATION_TPU_STATUS_PREFIX)
+            or k == constants.ANNOTATION_REPORTED_PARTITIONING_PLAN
+        }
+
+    def pred(event: str, obj: Mapping, old: Mapping | None) -> bool:
+        if event != "MODIFIED" or old is None:
+            return True
+        return status_view(obj) != status_view(old)
+
+    return pred
+
+
 def node_resources_changed() -> Predicate:
     """Fires on MODIFIED only when status.capacity changed while
     status.allocatable did not — the kubelet is re-advertising resources

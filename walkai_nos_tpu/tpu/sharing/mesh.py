@@ -94,23 +94,27 @@ class SharedTpuMesh:
                 missing.pop(p, None)
         if not missing:
             return changed
-        # Phase 2: delete free shares, re-pack (free + missing) greedily.
+        # Phase 2: delete free shares and re-pack — EVERYTHING `wanted`
+        # first (a wanted profile covered by existing free must survive
+        # the repack, not lose its chips to smaller shares), then as many
+        # previous free shares as still fit.
         pool = self.spare_chips() + _total_chips(self.free)
         new_free: Geometry = {}
-        for p in sorted(missing, key=_chips_of):
-            want = missing[p]
+        for p in sorted(wanted, key=_chips_of):
+            want = wanted[p]
             while want > 0 and _chips_of(p) <= pool:
                 new_free[p] = new_free.get(p, 0) + 1
                 pool -= _chips_of(p)
                 want -= 1
         if not new_free:
             return changed
-        # Keep as many previous free shares as still fit.
         for p in sorted(self.free, key=_chips_of):
             for _ in range(self.free[p]):
                 if _chips_of(p) <= pool:
                     new_free[p] = new_free.get(p, 0) + 1
                     pool -= _chips_of(p)
+        if new_free == self.free:
+            return changed
         self.free = new_free
         return True
 

@@ -68,33 +68,19 @@ class SharingNode:
         for m in self.meshes:
             if not remaining:
                 break
-            demand_here = dict(remaining)
-            # Count what this mesh's existing free shares already cover.
+            # Hand the mesh the WHOLE outstanding demand: its own search
+            # subtracts existing free availability (so nothing is double
+            # counted) and its repack keeps every demanded profile (so a
+            # free share covering part of the demand can't lose its chips
+            # to the shortfall).
+            if m.update_geometry_for(dict(remaining)):
+                changed = True
             for p in list(remaining):
                 take = min(remaining[p], m.free_count(p))
                 if take:
                     remaining[p] -= take
                     if remaining[p] == 0:
                         del remaining[p]
-            if not remaining:
-                break
-            # Shortfall left: ask the mesh to hold its current free PLUS
-            # the shortfall. Its own update_geometry_for subtracts free
-            # availability, so passing the bare shortfall would
-            # double-count the shares counted above.
-            ask = {p: m.free_count(p) + remaining[p] for p in remaining}
-            if m.update_geometry_for(ask):
-                changed = True
-                # The search may also have reshuffled free shares of
-                # profiles counted above (phase-2 repack), so re-account
-                # this mesh's whole contribution from fresh counts.
-                remaining = demand_here
-                for p in list(remaining):
-                    take = min(remaining[p], m.free_count(p))
-                    if take:
-                        remaining[p] -= take
-                        if remaining[p] == 0:
-                            del remaining[p]
         return changed
 
     def provides_profiles(self, wanted: Geometry) -> bool:
