@@ -229,12 +229,36 @@ class MiniApiServer:
                     self._send(201, body)
 
             def do_PATCH(self):
-                plural, ns, name, _sub, _ = self._parse()
+                plural, ns, name, sub, _ = self._parse()
                 patch = self._read_body()
                 with outer._lock:
                     key, obj = self._find(plural, ns, name)
                     if obj is None:
                         self._send(404, {"message": "not found"})
+                        return
+                    if sub == "status":
+                        # The /status subresource only touches status —
+                        # real API servers drop everything else.
+                        patch = {"status": patch.get("status") or {}}
+                    elif "status" in patch:
+                        # ...and a main-resource write silently drops
+                        # status changes (kube/client.py documents this
+                        # exact trap; the fake must reproduce it).
+                        patch = {
+                            k: v for k, v in patch.items() if k != "status"
+                        }
+                    if (
+                        plural == "pods"
+                        and sub is None
+                        and (patch.get("spec") or {}).get("nodeName")
+                        and (obj.get("spec") or {}).get("nodeName")
+                        != patch["spec"]["nodeName"]
+                    ):
+                        # spec.nodeName is immutable; schedulers must use
+                        # the pods/binding subresource.
+                        self._send(
+                            422, {"message": "spec.nodeName is immutable"}
+                        )
                         return
                     obj = merge_patch(obj, patch)
                     outer._objects[key] = obj

@@ -234,8 +234,20 @@ class TestClusterInfoCollector:
         )
         snap = Collector(kube).collect()
         inv = next(t for t in snap.tpus if t.tpu.startswith("mh1"))
-        assert "2x2x2" in inv.tpu
+        # Units are CHIPS of this host (one host of the pool), and the
+        # label says so — capacity 4 is 4 chips, not 4 pools.
+        assert "2x2x2-pool chips" in inv.tpu
         assert inv.allocated == 4 and inv.available == 0
+
+    def test_idle_multi_host_pool_reports_chip_units(self):
+        kube = FakeKubeClient()
+        node = _node("mh2", accelerator="tpu-v5p-slice",
+                     capacity={"google.com/tpu": "4"})
+        node["metadata"]["labels"]["cloud.google.com/gke-tpu-topology"] = "2x2x2"
+        kube.create("Node", node)
+        snap = Collector(kube).collect()
+        inv = next(t for t in snap.tpus if t.tpu.startswith("mh2"))
+        assert inv.allocated == 0 and inv.available == 4  # 4 chips, not pools
 
     def test_pod_summaries(self):
         kube = FakeKubeClient()

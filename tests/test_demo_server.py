@@ -27,8 +27,12 @@ def server():
             "WALKAI_MAX_BATCH": "8",
             "WALKAI_BATCH_WINDOW_MS": "20",
             "WALKAI_WARM_BUCKETS": "1,8",
+            # CPU CI doesn't read the ceiling; don't spend seconds
+            # calibrating it (startup raced the fixture timeout under
+            # parallel machine load).
+            "WALKAI_CALIB_WINDOW_S": "0.2",
         },
-        startup_timeout_s=120.0,
+        startup_timeout_s=240.0,
         poll_s=0.25,
     )
     yield base

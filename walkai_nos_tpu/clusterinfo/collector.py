@@ -70,22 +70,22 @@ class Collector:
             labels = objects.labels(node)
             model = topology.get_model(labels)
             if model is None:
-                if topology.is_multi_host(labels):
-                    # Multi-host pool: never partitioned, but its capacity
-                    # still counts — report it as one whole slice, with the
-                    # full pool topology as the profile.
-                    base = topology.KNOWN_MODELS[
-                        labels[constants.LABEL_TPU_ACCELERATOR]
-                    ]
-                    pool_shape = topology.parse_shape(
-                        labels[constants.LABEL_TPU_TOPOLOGY]
-                    )
-                    whole = topology.TpuModel(
-                        base.name, base.generation, pool_shape,
-                        base.hbm_gb_per_chip,
-                    )
+                whole = topology.pool_model(labels)
+                if whole is not None:
+                    # Multi-host pool: never partitioned, but this host's
+                    # chips still count. Units are CHIPS (the node's
+                    # google.com/tpu capacity covers one host, not the
+                    # whole pool), so say so in the label.
                     out.extend(
-                        self._inventory_from_capacity(node, whole, pods)
+                        self._inventory_from_capacity(
+                            node,
+                            whole,
+                            pods,
+                            whole_label=(
+                                f"{topology.format_shape(whole.host_mesh)}"
+                                "-pool chips"
+                            ),
+                        )
                     )
                 continue
             entries = self._inventory_from_annotations(node, model)
@@ -117,8 +117,12 @@ class Collector:
             for profile, counts in sorted(per_profile.items())
         ]
 
-    def _inventory_from_capacity(self, node, model, pods) -> list[TpuInventory]:
-        """Fallback: capacity minus summed pod requests (`:113-138`)."""
+    def _inventory_from_capacity(
+        self, node, model, pods, whole_label: str | None = None
+    ) -> list[TpuInventory]:
+        """Fallback: capacity minus summed pod requests (`:113-138`).
+        `whole_label` overrides the label for whole-TPU (`google.com/tpu`)
+        rows, whose counts are chips."""
         capacity: Mapping = (node.get("status") or {}).get("capacity") or {}
         name = objects.name(node)
         out = []
@@ -126,7 +130,9 @@ class Collector:
             if is_slice_resource(resource):
                 profile = extract_profile_name(resource)
             elif resource == constants.RESOURCE_TPU:
-                profile = topology.format_shape(model.host_mesh)
+                profile = whole_label or topology.format_shape(
+                    model.host_mesh
+                )
             else:
                 continue
             try:

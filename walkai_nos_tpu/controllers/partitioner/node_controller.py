@@ -27,6 +27,10 @@ class NodeController:
     def __init__(self, kube: KubeClient, initializer: NodeInitializer | None = None):
         self._kube = kube
         self._initializer = initializer or NodeInitializer(kube)
+        # Nodes already refused for multi-host topology: without this,
+        # every node MODIFIED event re-logs the warning and re-attempts
+        # the (409) event create for the node's whole lifetime.
+        self._refused_multi_host: set[str] = set()
 
     def reconcile(self, request: Request) -> Result:
         try:
@@ -49,6 +53,9 @@ class NodeController:
         log) and leave the node schedulable as a whole slice. Deterministic
         event name makes the refusal idempotent across reconciles."""
         name = objects.name(node)
+        _, spec = parse_node_annotations(objects.annotations(node))
+        if name in self._refused_multi_host and not spec:
+            return  # settled: already refused, nothing left to clean
         topo = objects.labels(node).get(constants.LABEL_TPU_TOPOLOGY, "")
         logger.warning(
             "node controller: node %s has multi-host topology %s; "
@@ -58,7 +65,6 @@ class NodeController:
         # relabeled into a multi-host pool) must stop being actuated:
         # clear any lingering spec annotations so the agent tears nothing
         # and the node really is whole.
-        _, spec = parse_node_annotations(objects.annotations(node))
         if spec:
             updates: dict[str, str | None] = {a.key: None for a in spec}
             updates[constants.ANNOTATION_PARTITIONING_PLAN] = None
@@ -79,6 +85,7 @@ class NodeController:
             self._kube.create("Event", event, namespace="default")
         except ApiError:
             pass  # already emitted (409) or events unsupported
+        self._refused_multi_host.add(name)
 
     def _is_initialized(self, node: dict) -> bool:
         """Mesh count == number of spec-annotated meshes

@@ -397,7 +397,7 @@ class SimCluster:
                                 ],
                             },
                         },
-                        request.namespace or None,
+                        request.namespace or "default",
                     )
                     return Result()
         # Unschedulable: record the condition so the partitioner reacts.
@@ -416,7 +416,7 @@ class SimCluster:
                         ]
                     }
                 },
-                request.namespace or None,
+                request.namespace or "default",
             )
         return Result(requeue_after=self._report_interval)
 

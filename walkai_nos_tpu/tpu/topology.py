@@ -97,6 +97,18 @@ def is_multi_host(node_labels: Mapping[str, str]) -> bool:
     return shape_chip_count(shape) > model.chips_per_host
 
 
+def pool_model(node_labels: Mapping[str, str]) -> TpuModel | None:
+    """The model of a multi-host pool, with the FULL pool topology as its
+    mesh — for consumers that must account a never-partitioned pool's
+    capacity (e.g. the cluster-info collector). None unless the labels
+    describe a multi-host pool."""
+    if not is_multi_host(node_labels):
+        return None
+    base = KNOWN_MODELS[node_labels[constants.LABEL_TPU_ACCELERATOR]]
+    shape = parse_shape(node_labels[constants.LABEL_TPU_TOPOLOGY])
+    return TpuModel(base.name, base.generation, shape, base.hbm_gb_per_chip)
+
+
 def get_model(node_labels: Mapping[str, str]) -> TpuModel | None:
     """Resolve the TPU model from node labels (`pkg/gpu/util.go:29-45` analogue).
 
@@ -111,14 +123,14 @@ def get_model(node_labels: Mapping[str, str]) -> TpuModel | None:
     model = KNOWN_MODELS.get(acc)
     if model is None:
         return None
+    if is_multi_host(node_labels):
+        return None  # multi-host slice: refuse to partition
     topo = node_labels.get(constants.LABEL_TPU_TOPOLOGY)
     if topo:
         try:
             shape = parse_shape(topo)
         except ValueError:
             return model
-        if shape_chip_count(shape) > model.chips_per_host:
-            return None  # multi-host slice: refuse to partition
         if (
             len(shape) == len(model.host_mesh)
             and all(a <= b for a, b in zip(shape, model.host_mesh))
