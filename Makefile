@@ -12,9 +12,11 @@ test:
 	python -m pytest tests/ -q
 
 # Envtest-grade e2e: real RestKubeClient wire path (HTTP watch framing,
-# merge patches, pods/binding) against the in-process API server.
+# merge patches, subresources, pods/binding) against the in-process API
+# server, plus the controller-loop scenarios (tiling + sharing).
 e2e:
-	python -m pytest tests/test_e2e_apiserver.py tests/test_rest_client.py -q
+	python -m pytest tests/test_e2e_apiserver.py tests/test_rest_client.py \
+	    tests/test_integration_e2e.py tests/test_sharing_e2e.py -q
 
 # Full kind-cluster e2e: create the cluster, deploy with fake tpudev
 # hosts, and run the §7.3 scenario (see hack/kind/e2e.sh).
