@@ -146,3 +146,43 @@ class TestNodeControllerMultiHostGuard:
         # Idempotent across reconciles.
         ctrl.reconcile(Request(name="tpu-mh"))
         assert len(kube.list("Event", namespace="default")) == 1
+
+    def test_transient_event_failure_is_retried(self):
+        from walkai_nos_tpu.controllers.partitioner.node_controller import (
+            NodeController,
+        )
+        from walkai_nos_tpu.kube.client import ApiError
+        from walkai_nos_tpu.kube.fake import FakeKubeClient
+        from walkai_nos_tpu.kube.runtime import Request
+
+        class FlakyEventKube(FakeKubeClient):
+            def __init__(self):
+                super().__init__()
+                self.event_failures = 1
+
+            def create(self, kind, obj, namespace=None):
+                if kind == "Event" and self.event_failures > 0:
+                    self.event_failures -= 1
+                    raise ApiError(500, "transient")
+                return super().create(kind, obj, namespace)
+
+        kube = FlakyEventKube()
+        kube.create(
+            "Node",
+            {
+                "metadata": {
+                    "name": "tpu-mh",
+                    "labels": {
+                        constants.LABEL_TPU_PARTITIONING: "tiling",
+                        constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice",
+                        constants.LABEL_TPU_TOPOLOGY: "2x2x2",
+                    },
+                },
+            },
+        )
+        ctrl = NodeController(kube)
+        ctrl.reconcile(Request(name="tpu-mh"))  # event create fails (500)
+        assert kube.list("Event", namespace="default") == []
+        ctrl.reconcile(Request(name="tpu-mh"))  # retried, not memoized
+        events = kube.list("Event", namespace="default")
+        assert [e["reason"] for e in events] == ["MultiHostTopology"]

@@ -83,8 +83,15 @@ class NodeController:
         }
         try:
             self._kube.create("Event", event, namespace="default")
-        except ApiError:
-            pass  # already emitted (409) or events unsupported
+        except ApiError as e:
+            if e.status != 409:
+                # Transient failure: leave the node un-memoized so the
+                # next reconcile retries the (idempotently named) event.
+                logger.warning(
+                    "node controller: could not emit MultiHostTopology "
+                    "event for %s: %s", name, e,
+                )
+                return
         self._refused_multi_host.add(name)
 
     def _is_initialized(self, node: dict) -> bool:
