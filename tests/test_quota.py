@@ -454,3 +454,228 @@ class TestSchedulerE2E:
                 msg="status.used converges after deletion",
                 timeout=15.0,
             )
+
+
+class TestSchedulerIntegrationGaps:
+    """Regression suite for the deep-review findings: the Unschedulable
+    handoff to the partitioner, preemption on borrowing denial, node
+    eligibility gates, init-container fit accounting, and crash-safety
+    on malformed profiles."""
+
+    def test_no_fit_marks_pod_unschedulable(self):
+        # Without this condition the partitioner never considers the pod
+        # (kube-scheduler ignores foreign-scheduler pods).
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            {"metadata": {"name": "host-a"},
+             "status": {"allocatable": {}}},  # no TPU capacity
+        )
+        manager = build_manager(kube)
+        with manager:
+            kube.create(
+                "Pod",
+                _pod("j1", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler"),
+            )
+            _eventually(
+                lambda: objects.pod_is_unschedulable(
+                    kube.get("Pod", "j1", "team-a")
+                ),
+                msg="Unschedulable condition recorded",
+            )
+
+    def test_borrowing_denial_triggers_preemption(self):
+        # Docs worked example shape: the lender's min is fully borrowed
+        # by another quota; a pod within its own min+guaranteed evicts
+        # the borrower instead of starving (key-concepts.md:31-46).
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            {
+                "metadata": {"name": "host-a"},
+                "status": {"allocatable": {"google.com/tpu": "8"}},
+            },
+        )
+        kube.create("ElasticQuota", _quota("qa", "team-a", 4), "team-a")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        manager = build_manager(kube)
+        with manager:
+            # team-b borrows team-a's entire unused min (4 own + 4 borrowed)
+            kube.create(
+                "Pod",
+                _pod("b1", "team-b", 8,
+                     labels={"nos.walkai.io/capacity": "over-quota"}),
+            )
+            # team-a claims its guaranteed min: the borrower must go.
+            kube.create(
+                "Pod",
+                _pod("a1", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler"),
+            )
+            _eventually(
+                lambda: not any(
+                    objects.name(p) == "b1"
+                    for p in kube.list("Pod", namespace="team-b")
+                ),
+                msg="borrower preempted on quota denial",
+            )
+            _eventually(
+                lambda: kube.get("Pod", "a1", "team-a")["spec"].get(
+                    "nodeName"
+                )
+                == "host-a",
+                msg="guaranteed pod binds after preemption",
+            )
+
+    def test_cordoned_node_skipped(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            {
+                "metadata": {"name": "host-a"},
+                "spec": {"unschedulable": True},
+                "status": {"allocatable": {"google.com/tpu": "8"}},
+            },
+        )
+        kube.create(
+            "Node",
+            {
+                "metadata": {"name": "host-b"},
+                "status": {"allocatable": {"google.com/tpu": "8"}},
+            },
+        )
+        manager = build_manager(kube)
+        with manager:
+            kube.create(
+                "Pod",
+                _pod("j1", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler"),
+            )
+            _eventually(
+                lambda: kube.get("Pod", "j1", "team-a")["spec"].get(
+                    "nodeName"
+                )
+                == "host-b",
+                msg="cordoned node skipped",
+            )
+
+    def test_node_selector_honored(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            {
+                "metadata": {"name": "host-a", "labels": {"gen": "v5e"}},
+                "status": {"allocatable": {"google.com/tpu": "8"}},
+            },
+        )
+        kube.create(
+            "Node",
+            {
+                "metadata": {"name": "host-b", "labels": {"gen": "v5p"}},
+                "status": {"allocatable": {"google.com/tpu": "8"}},
+            },
+        )
+        manager = build_manager(kube)
+        with manager:
+            pod = _pod("j1", "team-a", 4, phase="Pending",
+                       scheduler="walkai-nos-scheduler")
+            pod["spec"]["nodeSelector"] = {"gen": "v5p"}
+            kube.create("Pod", pod)
+            _eventually(
+                lambda: kube.get("Pod", "j1", "team-a")["spec"].get(
+                    "nodeName"
+                )
+                == "host-b",
+                msg="nodeSelector honored",
+            )
+
+
+class TestResourceEdgeCases:
+    def test_malformed_profiles_do_not_crash(self):
+        from walkai_nos_tpu.quota.resources import (
+            pod_quota_request,
+            resources_chip_count,
+        )
+
+        pod = {
+            "spec": {
+                "containers": [
+                    {
+                        "name": "m",
+                        "resources": {
+                            "requests": {
+                                "walkai.io/tpu-0x2": "1",
+                                "walkai.io/tpu-shared-0c": "1",
+                                "walkai.io/tpu-2x2": "1",
+                            }
+                        },
+                    }
+                ]
+            }
+        }
+        # malformed names contribute 0 instead of raising
+        assert pod_quota_request(pod) == {"nos.walkai.io/tpu-chips": 4}
+        assert resources_chip_count({"walkai.io/tpu-0x2": 2}) == 0
+
+    def test_explicit_tpu_chips_request_counts(self):
+        from walkai_nos_tpu.quota.resources import pod_quota_request
+
+        pod = {
+            "spec": {
+                "containers": [
+                    {
+                        "name": "m",
+                        "resources": {
+                            "requests": {"nos.walkai.io/tpu-chips": "6"}
+                        },
+                    }
+                ]
+            }
+        }
+        assert pod_quota_request(pod) == {"nos.walkai.io/tpu-chips": 6}
+
+    def test_init_container_requests_count_for_fit(self):
+        from walkai_nos_tpu.quota.fit import pod_tpu_requests
+
+        pod = {
+            "spec": {
+                "initContainers": [
+                    {
+                        "name": "warm",
+                        "resources": {"requests": {"google.com/tpu": "8"}},
+                    }
+                ],
+                "containers": [
+                    {
+                        "name": "m",
+                        "resources": {"requests": {"google.com/tpu": "4"}},
+                    }
+                ],
+            }
+        }
+        assert pod_tpu_requests(pod) == {"google.com/tpu": 8}
+
+    def test_overlapping_quota_claims_resolve_deterministically(self):
+        from walkai_nos_tpu.quota.state import ClusterQuotaState
+
+        state = ClusterQuotaState.build(
+            [
+                _quota_obj("qa", "team-a", 8),
+                _quota_obj("qz", "team-a", 8),  # overlap: config error
+            ],
+            [_pod("p1", "team-a", 4)],
+        )
+        quota = state.for_namespace("team-a")
+        assert quota.name == "qa"  # first claim in sorted order wins
+        assert quota.used.get("nos.walkai.io/tpu-chips") == 4
+        other = next(q for q in state.quotas if q.name == "qz")
+        # the loser accrues nothing, but its min is still real capacity;
+        # the point is usage is not split across both
+        assert other.used == {}
+
+
+def _quota_obj(name, namespace, min_chips):
+    q = _quota(name, namespace, min_chips)
+    q["metadata"] = {"name": name, "namespace": namespace}
+    return q

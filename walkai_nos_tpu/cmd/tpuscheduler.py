@@ -22,10 +22,14 @@ import sys
 
 from walkai_nos_tpu.cmd import _common
 from walkai_nos_tpu.kube import objects
-from walkai_nos_tpu.kube.client import ApiError, KubeClient, NotFound
+from walkai_nos_tpu.kube.client import KubeClient, NotFound
 from walkai_nos_tpu.kube.runtime import Controller, Manager, Request, Result
 from walkai_nos_tpu.quota.fit import fits_node
-from walkai_nos_tpu.quota.labeler import CapacityLabeler, list_quota_objects
+from walkai_nos_tpu.quota.labeler import (
+    LABEL_CAPACITY,
+    CapacityLabeler,
+    list_quota_objects,
+)
 from walkai_nos_tpu.quota.reconciler import QuotaReconciler
 from walkai_nos_tpu.quota.scheduler import CapacityScheduling
 from walkai_nos_tpu.quota.state import ClusterQuotaState
@@ -62,18 +66,30 @@ class Scheduler:
 
         decision = plugin.pre_filter(pod)
         if not decision.allowed:
-            # Quota-level denial (over max / nothing to borrow): preemption
-            # can't create quota headroom; wait for usage to change.
             logger.info(
                 "pod %s/%s quota-denied: %s",
                 request.namespace,
                 request.name,
                 decision.reason,
             )
+            if decision.borrowing_denied:
+                # The borrowing pool is exhausted by other quotas'
+                # over-quota pods; fair-share preemption can reclaim this
+                # pod's min+guaranteed entitlement (the docs' worked
+                # example, `key-concepts.md:31-46`). No node-locality:
+                # evictions anywhere shrink others' borrowing.
+                victims = plugin.find_preemption_victims(pod, pods)
+                self._evict(victims, request)
+                if victims:
+                    return Result(requeue_after=0.5)
+            # Hard max (or nothing preemptible): wait for usage to change.
+            self._mark_unschedulable(pod, request)
             return Result(requeue_after=5.0)
 
         nodes = self._kube.list("Node")
         for node in sorted(nodes, key=objects.name):
+            if not self._node_eligible(pod, node):
+                continue
             if fits_node(pod, node, pods):
                 bind_pod(self._kube, pod, objects.name(node))
                 logger.info(
@@ -88,6 +104,19 @@ class Scheduler:
         # over-quota pods elsewhere (`key-concepts.md:31-40`), chosen
         # node-locally so the freed chips are actually usable.
         victims = plugin.find_preemption_victims(pod, pods, nodes)
+        self._evict(victims, request)
+        if victims:
+            return Result(requeue_after=0.5)  # re-fit after evictions
+        # No fit anywhere: record the Unschedulable condition so the
+        # partitioner considers re-tiling for this pod — kube-scheduler
+        # writes this for its own pods, but ignores foreign-scheduler
+        # pods, so WE are the only writer for ours.
+        self._mark_unschedulable(pod, request)
+        return Result(requeue_after=5.0)  # the partitioner may now retile
+
+    # ---------------------------------------------------------------- helpers
+
+    def _evict(self, victims: list[dict], request: Request) -> None:
         for victim in victims:
             logger.info(
                 "preempting over-quota pod %s/%s for %s/%s",
@@ -104,9 +133,39 @@ class Scheduler:
                 )
             except NotFound:
                 pass
-        if victims:
-            return Result(requeue_after=0.5)  # re-fit after evictions
-        return Result(requeue_after=5.0)  # no fit; the partitioner may retile
+
+    def _mark_unschedulable(self, pod: dict, request: Request) -> None:
+        if objects.pod_is_unschedulable(pod):
+            return  # already recorded; don't churn the object
+        self._kube.patch_status(
+            "Pod",
+            objects.name(pod),
+            {
+                "status": {
+                    "conditions": [
+                        {
+                            "type": "PodScheduled",
+                            "status": "False",
+                            "reason": "Unschedulable",
+                            "message": "no TPU capacity within quota",
+                        }
+                    ]
+                }
+            },
+            objects.namespace(pod) or "default",
+        )
+
+    def _node_eligible(self, pod: dict, node: dict) -> bool:
+        """Basic scheduler-framework gates kube-scheduler would apply:
+        cordon, readiness, and the pod's nodeSelector."""
+        if (node.get("spec") or {}).get("unschedulable"):
+            return False
+        for cond in (node.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready" and cond.get("status") != "True":
+                return False
+        selector = (pod.get("spec") or {}).get("nodeSelector") or {}
+        labels = objects.labels(node)
+        return all(labels.get(k) == v for k, v in selector.items())
 
 
 def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Manager:
@@ -120,12 +179,28 @@ def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Man
             max_concurrent=1,  # serialized decisions, like the partitioner
         )
     )
+    def _labeler_relevant(event: str, obj, old) -> bool:
+        """The labeler's answer only changes when a pod starts/stops
+        holding quota or moves: gate MODIFIED on phase / nodeName /
+        capacity-label changes so status heartbeats across the whole
+        cluster don't each trigger an O(pods) relabel sweep."""
+        if event != "MODIFIED" or old is None:
+            return True
+        def view(p):
+            return (
+                (p.get("status") or {}).get("phase"),
+                (p.get("spec") or {}).get("nodeName"),
+                objects.labels(p).get(LABEL_CAPACITY),
+            )
+        return view(obj) != view(old)
+
     manager.add(
         Controller(
             "capacity-labeler",
             kube,
             "Pod",
             CapacityLabeler(kube).reconcile,
+            predicates=[_labeler_relevant],
         )
     )
     # Quota reconcile loops keyed on the QUOTA objects (the upstream

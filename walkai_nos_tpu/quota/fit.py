@@ -32,16 +32,30 @@ def _tpu_resources(raw: Mapping | None) -> dict[str, int]:
     return out
 
 
-def pod_tpu_requests(pod: Mapping) -> dict[str, int]:
-    out: dict[str, int] = {}
-    for c in (pod.get("spec") or {}).get("containers") or []:
-        resources = c.get("resources") or {}
-        merged = {
+def _container_tpu_requests(container: Mapping) -> dict[str, int]:
+    resources = container.get("resources") or {}
+    return _tpu_resources(
+        {
             **(resources.get("limits") or {}),
             **(resources.get("requests") or {}),
         }
-        for name, qty in _tpu_resources(merged).items():
+    )
+
+
+def pod_tpu_requests(pod: Mapping) -> dict[str, int]:
+    """Effective pod request per TPU resource: max(any initContainer,
+    sum(containers)) — the kubelet's accounting
+    (`pkg/resource/resource.go:107-146`), so node fitting agrees with
+    the quota math in `resources.pod_tpu_chips`."""
+    spec = pod.get("spec") or {}
+    out: dict[str, int] = {}
+    for c in spec.get("containers") or []:
+        for name, qty in _container_tpu_requests(c).items():
             out[name] = out.get(name, 0) + qty
+    for c in spec.get("initContainers") or []:
+        for name, qty in _container_tpu_requests(c).items():
+            if qty > out.get(name, 0):
+                out[name] = qty
     return out
 
 

@@ -46,6 +46,25 @@ def le(a: Mapping[str, int], b: Mapping[str, int]) -> bool:
     return all(v <= b.get(k, 0) for k, v in a.items())
 
 
+def _resource_chips(name: str, qty: int) -> int:
+    """Chips one resource entry represents; 0 for non-TPU or malformed
+    names. A regex-matching-but-invalid profile ("tpu-0x2",
+    "tpu-shared-0c") is user-authored pod input, and controllers rebuild
+    quota state from EVERY pod on every event — one bad spec must never
+    crash them."""
+    try:
+        if is_slice_resource(name):
+            shape = topology.parse_shape(extract_profile_name(name))
+            return topology.shape_chip_count(shape) * qty
+        if is_shared_resource(name):
+            return extract_shared_profile_chips(name) * qty
+    except ValueError:
+        return 0
+    if name == constants.RESOURCE_TPU:
+        return qty
+    return 0
+
+
 def _container_chips(container: Mapping) -> int:
     resources = container.get("resources") or {}
     merged = {**(resources.get("limits") or {}), **(resources.get("requests") or {})}
@@ -57,13 +76,7 @@ def _container_chips(container: Mapping) -> int:
             continue
         if qty <= 0:
             continue
-        if is_slice_resource(name):
-            shape = topology.parse_shape(extract_profile_name(name))
-            chips += topology.shape_chip_count(shape) * qty
-        elif is_shared_resource(name):
-            chips += extract_shared_profile_chips(name) * qty
-        elif name == constants.RESOURCE_TPU:
-            chips += qty
+        chips += _resource_chips(name, qty)
     return chips
 
 
@@ -81,13 +94,7 @@ def resources_chip_count(resources: Mapping[str, int]) -> int:
     for name, qty in resources.items():
         if qty <= 0:
             continue
-        if is_slice_resource(name):
-            shape = topology.parse_shape(extract_profile_name(name))
-            chips += topology.shape_chip_count(shape) * qty
-        elif is_shared_resource(name):
-            chips += extract_shared_profile_chips(name) * qty
-        elif name == constants.RESOURCE_TPU:
-            chips += qty
+        chips += _resource_chips(name, qty)
     return chips
 
 
@@ -104,10 +111,25 @@ def pod_tpu_chips(pod: Mapping) -> int:
 
 
 def pod_quota_request(pod: Mapping) -> Resources:
-    """The resources a pod counts against its quota: its explicit requests
-    restricted to quota-relevant names, plus the computed tpu-chips
-    (the `ResourceCalculator` pattern, `resource.go:28-86`)."""
+    """The resources a pod counts against its quota: the tpu-chips
+    computed from its TPU resource requests (the `ResourceCalculator`
+    pattern, `resource.go:28-86`), or an explicit
+    `nos.walkai.io/tpu-chips` request if it declares more."""
     chips = pod_tpu_chips(pod)
+    explicit = 0
+    for c in (pod.get("spec") or {}).get("containers") or []:
+        resources = c.get("resources") or {}
+        merged = {
+            **(resources.get("limits") or {}),
+            **(resources.get("requests") or {}),
+        }
+        raw = merged.get(constants.RESOURCE_TPU_CHIPS)
+        if raw is not None:
+            try:
+                explicit += parse_quantity(raw)
+            except ValueError:
+                pass
+    chips = max(chips, explicit)
     out: Resources = {}
     if chips:
         out[constants.RESOURCE_TPU_CHIPS] = chips

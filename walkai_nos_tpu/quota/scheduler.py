@@ -33,6 +33,10 @@ RESOURCE = constants.RESOURCE_TPU_CHIPS
 class Decision:
     allowed: bool
     reason: str = ""
+    # True when the denial is exhausted borrowing capacity (not a hard
+    # max): fair-share preemption of over-quota pods CAN create this
+    # headroom, so the scheduler should try it.
+    borrowing_denied: bool = False
 
 
 class CapacityScheduling:
@@ -75,6 +79,7 @@ class CapacityScheduling:
                     f"quota {quota.name}: total over-quota holding would "
                     f"reach {borrowed} {resource} (currently borrowing "
                     f"{prior}) but only {available} is available to borrow",
+                    borrowing_denied=True,
                 )
         return Decision(True, "fits borrowing unused quota")
 

@@ -15,6 +15,7 @@ CompositeElasticQuota spans the namespaces listed in spec.namespaces.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping
 
@@ -26,6 +27,8 @@ from walkai_nos_tpu.quota.resources import (
     pod_quota_request,
 )
 from walkai_nos_tpu.utils.quantity import parse_quantity
+
+logger = logging.getLogger(__name__)
 
 
 def pod_holds_quota(pod: Mapping) -> bool:
@@ -94,8 +97,23 @@ class ClusterQuotaState:
     def __init__(self, quotas: Iterable[QuotaInfo]):
         self.quotas = list(quotas)
         self._by_namespace: dict[str, QuotaInfo] = {}
-        for q in self.quotas:
+        # A namespace may be subject to at most one quota. Overlaps are a
+        # config error; resolve them deterministically (first claim in
+        # sorted quota order wins) instead of last-write-wins, which
+        # would split a namespace's usage across two quotas and let the
+        # "unused" one inflate the lendable pool with phantom slack.
+        for q in sorted(self.quotas, key=lambda q: (q.composite, q.name)):
             for ns in q.namespaces:
+                if ns in self._by_namespace:
+                    logger.warning(
+                        "namespace %s claimed by both quota %s and %s; "
+                        "keeping %s",
+                        ns,
+                        self._by_namespace[ns].name,
+                        q.name,
+                        self._by_namespace[ns].name,
+                    )
+                    continue
                 self._by_namespace[ns] = q
 
     @staticmethod
