@@ -486,47 +486,55 @@ class TestSchedulerIntegrationGaps:
             )
 
     def test_borrowing_denial_triggers_preemption(self):
-        # Docs worked example shape: the lender's min is fully borrowed
-        # by another quota; a pod within its own min+guaranteed evicts
-        # the borrower instead of starving (key-concepts.md:31-46).
+        """Exercises the borrowing_denied branch specifically: a pod that
+        must ITSELF borrow (beyond min, within min+guaranteed) finds the
+        pool drained by another borrower; only the shortfall's worth of
+        borrower pods is evicted (key-concepts.md:31-46 worked example).
+
+        qa(min=4) requests 6 (over=2); qb(min=1) holds 4x 1-chip pods
+        (over=3); qc(min=3) idle. lendable(qa)=0+3=3, others borrowing
+        3 -> available 0 < 2: borrowing-denied with shortfall 2.
+        Condition 2: 0+6 <= 4 + 4/8*(4+3) = 7.5 -> preempt exactly 2
+        chips of qb's borrowing; the oldest two qb pods survive."""
         kube = FakeKubeClient()
         kube.create(
             "Node",
             {
                 "metadata": {"name": "host-a"},
-                "status": {"allocatable": {"google.com/tpu": "8"}},
+                "status": {"allocatable": {"google.com/tpu": "16"}},
             },
         )
         kube.create("ElasticQuota", _quota("qa", "team-a", 4), "team-a")
-        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 1), "team-b")
+        kube.create("ElasticQuota", _quota("qc", "team-c", 3), "team-c")
         manager = build_manager(kube)
         with manager:
-            # team-b borrows team-a's entire unused min (4 own + 4 borrowed)
+            for i in range(4):
+                kube.create(
+                    "Pod",
+                    _pod(f"b{i}", "team-b", 1,
+                         created=f"2026-01-0{i + 1}T00:00:00Z",
+                         labels={"nos.walkai.io/capacity": "over-quota"}),
+                )
             kube.create(
                 "Pod",
-                _pod("b1", "team-b", 8,
-                     labels={"nos.walkai.io/capacity": "over-quota"}),
-            )
-            # team-a claims its guaranteed min: the borrower must go.
-            kube.create(
-                "Pod",
-                _pod("a1", "team-a", 4, phase="Pending",
+                _pod("a1", "team-a", 6, phase="Pending",
                      scheduler="walkai-nos-scheduler"),
-            )
-            _eventually(
-                lambda: not any(
-                    objects.name(p) == "b1"
-                    for p in kube.list("Pod", namespace="team-b")
-                ),
-                msg="borrower preempted on quota denial",
             )
             _eventually(
                 lambda: kube.get("Pod", "a1", "team-a")["spec"].get(
                     "nodeName"
                 )
                 == "host-a",
-                msg="guaranteed pod binds after preemption",
+                msg="borrowing pod binds after shortfall preemption",
             )
+            survivors = {
+                objects.name(p)
+                for p in kube.list("Pod", namespace="team-b")
+            }
+            # only the shortfall (2 chips) was evicted, newest first
+            assert len(survivors) == 2
+            assert "b0" in survivors and "b1" in survivors
 
     def test_cordoned_node_skipped(self):
         kube = FakeKubeClient()

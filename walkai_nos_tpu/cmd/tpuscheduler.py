@@ -76,14 +76,18 @@ class Scheduler:
                 # The borrowing pool is exhausted by other quotas'
                 # over-quota pods; fair-share preemption can reclaim this
                 # pod's min+guaranteed entitlement (the docs' worked
-                # example, `key-concepts.md:31-46`). No node-locality:
-                # evictions anywhere shrink others' borrowing.
-                victims = plugin.find_preemption_victims(pod, pods)
+                # example, `key-concepts.md:31-46`). No node-locality
+                # (evictions anywhere shrink others' borrowing), and only
+                # the shortfall's worth of chips — not the full request.
+                victims = plugin.find_preemption_victims(
+                    pod, pods, needed_chips=decision.shortfall
+                )
                 self._evict(victims, request)
                 if victims:
                     return Result(requeue_after=0.5)
-            # Hard max (or nothing preemptible): wait for usage to change.
-            self._mark_unschedulable(pod, request)
+            # Quota denials are NOT capacity problems: retiling can't
+            # create quota headroom, so don't mark Unschedulable (the
+            # partitioner would churn slices for a quota-blocked pod).
             return Result(requeue_after=5.0)
 
         nodes = self._kube.list("Node")
@@ -137,21 +141,25 @@ class Scheduler:
     def _mark_unschedulable(self, pod: dict, request: Request) -> None:
         if objects.pod_is_unschedulable(pod):
             return  # already recorded; don't churn the object
+        # Merge-patch replaces lists wholesale, so carry every OTHER
+        # condition through and only swap PodScheduled.
+        conditions = [
+            c
+            for c in (pod.get("status") or {}).get("conditions") or []
+            if c.get("type") != "PodScheduled"
+        ]
+        conditions.append(
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "Unschedulable",
+                "message": "no TPU capacity within quota",
+            }
+        )
         self._kube.patch_status(
             "Pod",
             objects.name(pod),
-            {
-                "status": {
-                    "conditions": [
-                        {
-                            "type": "PodScheduled",
-                            "status": "False",
-                            "reason": "Unschedulable",
-                            "message": "no TPU capacity within quota",
-                        }
-                    ]
-                }
-            },
+            {"status": {"conditions": conditions}},
             objects.namespace(pod) or "default",
         )
 

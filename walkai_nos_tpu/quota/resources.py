@@ -110,26 +110,43 @@ def pod_tpu_chips(pod: Mapping) -> int:
     return max(main, init)
 
 
+def _container_explicit_chips(container: Mapping) -> int:
+    resources = container.get("resources") or {}
+    merged = {
+        **(resources.get("limits") or {}),
+        **(resources.get("requests") or {}),
+    }
+    raw = merged.get(constants.RESOURCE_TPU_CHIPS)
+    if raw is None:
+        return 0
+    try:
+        return max(0, parse_quantity(raw))
+    except ValueError:
+        return 0
+
+
 def pod_quota_request(pod: Mapping) -> Resources:
     """The resources a pod counts against its quota: the tpu-chips
     computed from its TPU resource requests (the `ResourceCalculator`
     pattern, `resource.go:28-86`), or an explicit
-    `nos.walkai.io/tpu-chips` request if it declares more."""
-    chips = pod_tpu_chips(pod)
-    explicit = 0
-    for c in (pod.get("spec") or {}).get("containers") or []:
-        resources = c.get("resources") or {}
-        merged = {
-            **(resources.get("limits") or {}),
-            **(resources.get("requests") or {}),
-        }
-        raw = merged.get(constants.RESOURCE_TPU_CHIPS)
-        if raw is not None:
-            try:
-                explicit += parse_quantity(raw)
-            except ValueError:
-                pass
-    chips = max(chips, explicit)
+    `nos.walkai.io/tpu-chips` request if it declares more — with the
+    same max(init, sum(containers)) container accounting as the
+    computed path."""
+    spec = pod.get("spec") or {}
+    explicit = max(
+        sum(
+            _container_explicit_chips(c)
+            for c in spec.get("containers") or []
+        ),
+        max(
+            (
+                _container_explicit_chips(c)
+                for c in spec.get("initContainers") or []
+            ),
+            default=0,
+        ),
+    )
+    chips = max(pod_tpu_chips(pod), explicit)
     out: Resources = {}
     if chips:
         out[constants.RESOURCE_TPU_CHIPS] = chips

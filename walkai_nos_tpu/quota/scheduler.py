@@ -35,8 +35,10 @@ class Decision:
     reason: str = ""
     # True when the denial is exhausted borrowing capacity (not a hard
     # max): fair-share preemption of over-quota pods CAN create this
-    # headroom, so the scheduler should try it.
+    # headroom, so the scheduler should try it — and needs to free only
+    # `shortfall` chips of others' borrowing, not the whole request.
     borrowing_denied: bool = False
+    shortfall: int = 0
 
 
 class CapacityScheduling:
@@ -80,6 +82,7 @@ class CapacityScheduling:
                     f"reach {borrowed} {resource} (currently borrowing "
                     f"{prior}) but only {available} is available to borrow",
                     borrowing_denied=True,
+                    shortfall=borrowed - available,
                 )
         return Decision(True, "fits borrowing unused quota")
 
@@ -90,6 +93,7 @@ class CapacityScheduling:
         pod: dict,
         pods: list[dict],
         nodes: list[dict] | None = None,
+        needed_chips: int | None = None,
     ) -> list[dict]:
         """Victims whose eviction lets `pod` schedule, fair-sharing rules.
 
@@ -99,7 +103,10 @@ class CapacityScheduling:
         pods survive longer. With `nodes`, victims come from ONE node
         whose (free + freed) chips cover the request -- evicting the same
         chip count spread across hosts frees nothing a single pod (or the
-        partitioner's retile) can use.
+        partitioner's retile) can use. `needed_chips` overrides how many
+        chips eviction must free (the borrowing shortfall on a quota
+        denial — evicting a full request's worth there would kill more
+        workloads than the headroom requires).
         """
         from walkai_nos_tpu.quota.state import pod_holds_quota
 
@@ -151,7 +158,10 @@ class CapacityScheduling:
 
         if nodes is None:
             return self._select_victims(
-                candidates, request, dict(over_usage), guaranteed_by_name
+                candidates,
+                needed_chips if needed_chips is not None else request,
+                dict(over_usage),
+                guaranteed_by_name,
             )
 
         # Per-node: free the chips where they can actually be used.
