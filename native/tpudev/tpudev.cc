@@ -207,16 +207,22 @@ bool parse_placement(const std::string& text, Slice* out) {
 }
 
 bool write_slice(const Slice& s) {
-  std::ofstream f(slice_path(s.slice_id) + ".tmp",
-                  std::ios::out | std::ios::trunc);
+  const std::string tmp = slice_path(s.slice_id) + ".tmp";
+  std::ofstream f(tmp, std::ios::out | std::ios::trunc);
   if (!f) return false;
   f << placement_string(s) << "\n";
   for (size_t i = 0; i < s.chip_ids.size(); ++i)
     f << (i ? "," : "") << s.chip_ids[i];
   f << "\n";
   f.close();
-  return rename((slice_path(s.slice_id) + ".tmp").c_str(),
-                slice_path(s.slice_id).c_str()) == 0;
+  // A short write (ENOSPC) must not install a truncated record: the
+  // corrupted slice would vanish from the occupancy scan and its chips
+  // would be re-dealt under a running pod.
+  if (!f || rename(tmp.c_str(), slice_path(s.slice_id).c_str()) != 0) {
+    unlink(tmp.c_str());
+    return false;
+  }
+  return true;
 }
 
 bool read_slice(const std::string& path, Slice* out) {
@@ -228,7 +234,10 @@ bool read_slice(const std::string& path, Slice* out) {
   return parse_dims(line2, ',', &out->chip_ids);
 }
 
-std::vector<Slice> load_slices() {
+// Loads every persisted slice. A record that fails to parse is reported
+// via *corrupt (never silently dropped: a vanished record would free its
+// chips for re-allocation while the original pod still holds them).
+std::vector<Slice> load_slices(std::string* corrupt) {
   std::vector<Slice> out;
   DIR* dir = opendir(g_state.state_dir.c_str());
   if (dir == nullptr) return out;
@@ -238,7 +247,11 @@ std::vector<Slice> load_slices() {
         name.compare(name.size() - 6, 6, ".slice") != 0)
       continue;
     Slice s;
-    if (read_slice(g_state.state_dir + "/" + name, &s)) out.push_back(s);
+    if (read_slice(g_state.state_dir + "/" + name, &s)) {
+      out.push_back(s);
+    } else if (corrupt != nullptr && corrupt->empty()) {
+      *corrupt = name;
+    }
   }
   closedir(dir);
   std::sort(out.begin(), out.end(),
@@ -250,6 +263,19 @@ std::vector<Slice> load_slices() {
 
 // ------------------------------------------------------------------ JSON
 
+void json_str(std::ostringstream& os, const std::string& s) {
+  os << '"';
+  for (char c : s) {
+    switch (c) {
+      case '"': os << "\\\""; break;
+      case '\\': os << "\\\\"; break;
+      case '\n': os << "\\n"; break;
+      default: os << c;
+    }
+  }
+  os << '"';
+}
+
 void json_ints(std::ostringstream& os, const std::vector<int>& v) {
   os << "[";
   for (size_t i = 0; i < v.size(); ++i) os << (i ? "," : "") << v[i];
@@ -257,8 +283,11 @@ void json_ints(std::ostringstream& os, const std::vector<int>& v) {
 }
 
 void json_slice(std::ostringstream& os, const Slice& s) {
-  os << "{\"slice_id\":\"" << s.slice_id << "\",\"profile\":\"" << s.profile
-     << "\",\"mesh_index\":0,\"chip_ids\":";
+  os << "{\"slice_id\":";
+  json_str(os, s.slice_id);
+  os << ",\"profile\":";
+  json_str(os, s.profile);
+  os << ",\"mesh_index\":0,\"chip_ids\":";
   json_ints(os, s.chip_ids);
   os << ",\"offset\":";
   json_ints(os, s.offset);
@@ -309,7 +338,17 @@ tpudev_status tpudev_init(void) {
   if (g_state.chips.empty())
     return fail(TPUDEV_ERR, "no TPU chips (accel*) in " + g_state.dev_dir);
 
-  std::string mesh_s = env_or("TPUDEV_MESH", env_or("TPU_TOPOLOGY", ""));
+  std::string mesh_s = env_or("TPUDEV_MESH", "");
+  if (mesh_s.empty()) {
+    // TPU_TOPOLOGY describes the whole (possibly multi-host) slice; use
+    // it only when it matches this host's chips, else infer the local
+    // mesh (a v5e-16 host sees TPU_TOPOLOGY=4x4 but owns 4 chips).
+    std::string topo = env_or("TPU_TOPOLOGY", "");
+    std::vector<int> dims;
+    if (!topo.empty() && parse_dims(topo, 'x', &dims) &&
+        product(dims) == static_cast<int>(g_state.chips.size()))
+      mesh_s = topo;
+  }
   if (!mesh_s.empty()) {
     if (!parse_dims(mesh_s, 'x', &g_state.mesh))
       return fail(TPUDEV_ERR, "malformed mesh " + mesh_s);
@@ -347,8 +386,9 @@ tpudev_status tpudev_get_topology(char* buf, size_t buflen) {
   for (size_t i = 0; i < g_state.chips.size(); ++i) {
     const Chip& c = g_state.chips[i];
     if (i) os << ",";
-    os << "{\"chip_id\":" << c.chip_id << ",\"device_path\":\""
-       << c.device_path << "\",\"coords\":";
+    os << "{\"chip_id\":" << c.chip_id << ",\"device_path\":";
+    json_str(os, c.device_path);
+    os << ",\"coords\":";
     json_ints(os, c.coords);
     os << "}";
   }
@@ -360,9 +400,14 @@ tpudev_status tpudev_list_slices(char* buf, size_t buflen) {
   std::lock_guard<std::mutex> g(g_state.mu);
   if (!g_state.initialized) return fail(TPUDEV_ERR, "not initialized");
   FileLock lock(lock_path());
+  if (!lock.ok()) return fail(TPUDEV_ERR, "cannot lock state dir");
+  std::string corrupt;
+  auto slices = load_slices(&corrupt);
+  if (!corrupt.empty())
+    return fail(TPUDEV_ERR, "corrupt slice record " + corrupt +
+                                "; refusing to report a partial view");
   std::ostringstream os;
   os << "[";
-  auto slices = load_slices();
   for (size_t i = 0; i < slices.size(); ++i) {
     if (i) os << ",";
     json_slice(os, slices[i]);
@@ -387,7 +432,12 @@ tpudev_status tpudev_create_slice(const char* placement, char* buf,
   FileLock lock(lock_path());
   if (!lock.ok()) return fail(TPUDEV_ERR, "cannot lock state dir");
   std::set<int> occupied;
-  for (const Slice& other : load_slices()) {
+  std::string corrupt;
+  auto existing = load_slices(&corrupt);
+  if (!corrupt.empty())
+    return fail(TPUDEV_ERR, "corrupt slice record " + corrupt +
+                                "; refusing to allocate over unknown chips");
+  for (const Slice& other : existing) {
     if (other.slice_id == s.slice_id)
       return fail(TPUDEV_CONFLICT, "slice " + s.slice_id + " already exists");
     occupied.insert(other.chip_ids.begin(), other.chip_ids.end());
@@ -412,6 +462,7 @@ tpudev_status tpudev_delete_slice(const char* slice_id) {
       std::strstr(slice_id, "..") != nullptr)
     return fail(TPUDEV_EINVAL, "malformed slice id");
   FileLock lock(lock_path());
+  if (!lock.ok()) return fail(TPUDEV_ERR, "cannot lock state dir");
   if (unlink(slice_path(slice_id).c_str()) != 0) {
     if (errno == ENOENT)
       return fail(TPUDEV_NOTFOUND,

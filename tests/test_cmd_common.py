@@ -62,6 +62,17 @@ class TestStartHealth:
             servers.stop()
 
 
+class TestMetricsRender:
+    def test_label_values_escaped(self):
+        from walkai_nos_tpu.health import Metrics
+
+        m = Metrics()
+        m.counter_add("x_total", 1, {"result": 'bad "quote"\nline'})
+        out = m.render()
+        # One bad label value must not corrupt the whole exposition.
+        assert 'result="bad \\"quote\\"\\nline"' in out
+
+
 class TestWaitForShutdown:
     def test_sigterm_sets_latch(self):
         old_term = signal.getsignal(signal.SIGTERM)

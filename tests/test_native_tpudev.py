@@ -172,3 +172,57 @@ class TestNativeTpudev:
         monkeypatch.setenv("WALKAI_TPUDEV_LIB", "/nonexistent/libtpudev.so")
         client = native_mod.load_client()
         assert isinstance(client, StubTpudevClient)
+
+
+class TestHardening:
+    """Regression cases from the native-layer deep review."""
+
+    def test_corrupt_slice_record_refused(self, libtpudev, host_env):
+        # A truncated/corrupt record must fail the listing loudly — a
+        # silently dropped record would free its chips for re-allocation
+        # under a running pod.
+        state = host_env / "state"
+        state.mkdir(exist_ok=True)
+        (state / "broken.slice").write_text("not-a-placement\n")
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "client.list_slices()",
+        )
+        assert r.returncode != 0
+        assert "corrupt" in (r.stderr or "")
+
+    def test_corrupt_record_blocks_creates(self, libtpudev, host_env):
+        state = host_env / "state"
+        state.mkdir(exist_ok=True)
+        (state / "broken.slice").write_text("garbage\n")
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "client.create_slices([Placement('2x2', (0, 0), (2, 2))])",
+        )
+        assert r.returncode != 0
+        assert "corrupt" in (r.stderr or "")
+
+    def test_multi_host_tpu_topology_falls_back_to_local_mesh(
+        self, libtpudev, host_env, monkeypatch
+    ):
+        # TPU_TOPOLOGY describes the whole (multi-host) slice; a host
+        # with fewer chips must infer its local mesh instead of failing.
+        monkeypatch.delenv("TPUDEV_MESH")
+        monkeypatch.setenv("TPU_TOPOLOGY", "4x4")  # 16 chips; host has 8
+        r = _spawn_client_subprocess(
+            libtpudev,
+            "print(client.get_topology().mesh)",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "(2, 4)" in r.stdout  # inferred local v5e-8 mesh
+
+
+class TestFakeGrammarParity:
+    def test_fake_rejects_non_permutation_orientation(self):
+        from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+        fake = FakeTpudevClient(mesh=(2, 4))
+        with pytest.raises(GenericError):
+            fake.create_slices([Placement("2x2", (0, 0), (2, 3))])
+        with pytest.raises(GenericError):
+            fake.create_slices([Placement("bogus", (0, 0), (2, 2))])

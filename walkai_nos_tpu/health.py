@@ -15,6 +15,18 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+def _escape_label(value) -> str:
+    """Prometheus exposition label escaping: one bad value (a quote or
+    newline from an object name or error string) must not corrupt the
+    whole /metrics payload."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class Metrics:
     """Minimal Prometheus registry: counters and gauges with labels."""
 
@@ -58,7 +70,9 @@ class Metrics:
             for labels, value in series:
                 label_s = (
                     "{"
-                    + ",".join(f'{k}="{v}"' for k, v in labels)
+                    + ",".join(
+                        f'{k}="{_escape_label(v)}"' for k, v in labels
+                    )
                     + "}"
                     if labels
                     else ""

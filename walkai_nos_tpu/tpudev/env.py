@@ -11,10 +11,8 @@ real native client (`tpudev/native.py`) and the in-memory fake
 
 from __future__ import annotations
 
-from walkai_nos_tpu.tpu import topology as topo
 
-
-def make_slice_env(mesh: topo.Shape, placement, chip_ids: tuple[int, ...]) -> dict:
+def make_slice_env(placement, chip_ids: tuple[int, ...]) -> dict:
     """TPU runtime env for a slice: what the device plugin injects so a JAX
     process only initializes its sub-slice."""
     return {

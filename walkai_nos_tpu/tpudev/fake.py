@@ -59,6 +59,23 @@ class FakeTpudevClient(TpudevClient):
             for s in self._slices.values():
                 occupied.update(s.chip_ids)
             for p in placements:
+                # Mirror the native layer's placement-grammar validation
+                # (`parse_placement` in tpudev.cc): orientation must be a
+                # permutation of the canonical profile dims. Without this
+                # the fake accepts placements real hardware rejects.
+                try:
+                    profile_dims = sorted(
+                        int(x) for x in p.profile.split("x")
+                    )
+                except ValueError:
+                    errors.append(f"{p.slice_id()}: malformed profile")
+                    continue
+                if sorted(p.orientation) != profile_dims:
+                    errors.append(
+                        f"{p.slice_id()}: orientation {p.orientation} is "
+                        f"not a permutation of profile {p.profile}"
+                    )
+                    continue
                 try:
                     chip_ids = tuple(
                         self._coord_to_chip[c] for c in p.cells()
@@ -77,7 +94,7 @@ class FakeTpudevClient(TpudevClient):
                     profile=p.profile,
                     mesh_index=self._mesh_index,
                     chip_ids=chip_ids,
-                    env=make_slice_env(self._mesh, p, chip_ids),
+                    env=make_slice_env(p, chip_ids),
                 )
                 self._slices[info.slice_id] = info
                 occupied.update(chip_ids)

@@ -46,7 +46,11 @@ def find_library() -> str | None:
         return env if os.path.exists(env) else None
     if _REPO_BUILD.exists():
         return str(_REPO_BUILD)
-    return None
+    # System loader last: a bare soname lets ctypes consult the usual
+    # search path (ld.so.conf / LD_LIBRARY_PATH).
+    import ctypes.util
+
+    return ctypes.util.find_library("tpudev")
 
 
 class NativeTpudevClient(TpudevClient):
@@ -105,7 +109,7 @@ class NativeTpudevClient(TpudevClient):
             ),
         )
 
-    def _slice_from_json(self, s: dict, mesh) -> SliceInfo:
+    def _slice_from_json(self, s: dict) -> SliceInfo:
         from walkai_nos_tpu.tpudev.env import make_slice_env
         from walkai_nos_tpu.tpu.tiling.packing import Placement
 
@@ -120,13 +124,12 @@ class NativeTpudevClient(TpudevClient):
             profile=s["profile"],
             mesh_index=s["mesh_index"],
             chip_ids=chip_ids,
-            env=make_slice_env(mesh, placement, chip_ids),
+            env=make_slice_env(placement, chip_ids),
         )
 
     def list_slices(self) -> list[SliceInfo]:
-        mesh = self.get_topology().mesh  # one native call for the listing
         return [
-            self._slice_from_json(s, mesh)
+            self._slice_from_json(s)
             for s in self._call_json(self._lib.tpudev_list_slices)
         ]
 
@@ -139,7 +142,6 @@ class NativeTpudevClient(TpudevClient):
     def create_slices(self, placements: list) -> list[SliceInfo]:
         created: list[SliceInfo] = []
         errors: list[str] = []
-        mesh = self.get_topology().mesh  # one native call for the batch
         for p in placements:
             text = (
                 f"{p.profile}@"
@@ -154,7 +156,7 @@ class NativeTpudevClient(TpudevClient):
             except GenericError as e:
                 errors.append(str(e))
                 continue
-            created.append(self._slice_from_json(data, mesh))
+            created.append(self._slice_from_json(data))
         if not created and errors:
             raise GenericError("; ".join(errors))
         return created
@@ -176,10 +178,19 @@ class NativeTpudevClient(TpudevClient):
 
 def load_client() -> TpudevClient:
     """Native client when the library is available, else the noop stub —
-    the runtime equivalent of the reference's nvml build-tag dual."""
+    the runtime equivalent of the reference's nvml build-tag dual.
+    A present-but-unloadable library (wrong arch -> OSError, missing
+    symbol -> AttributeError) degrades the same way a missing one does,
+    with the reason logged."""
     try:
         return NativeTpudevClient()
-    except GenericError:
+    except (GenericError, OSError, AttributeError) as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "tpudev native library unavailable (%s); using the noop stub",
+            e,
+        )
         from walkai_nos_tpu.tpudev.stub import StubTpudevClient
 
         return StubTpudevClient()
