@@ -207,20 +207,42 @@ bool parse_placement(const std::string& text, Slice* out) {
 }
 
 bool write_slice(const Slice& s) {
+  // A short or unsynced write must not install a truncated record: the
+  // corrupted slice would fail the occupancy scan (or, pre-hardening,
+  // silently vanish and have its chips re-dealt under a running pod).
+  // POSIX fd + fsync before rename so a crash can't persist a partial
+  // file under the final name.
   const std::string tmp = slice_path(s.slice_id) + ".tmp";
-  std::ofstream f(tmp, std::ios::out | std::ios::trunc);
-  if (!f) return false;
-  f << placement_string(s) << "\n";
+  std::ostringstream body;
+  body << placement_string(s) << "\n";
   for (size_t i = 0; i < s.chip_ids.size(); ++i)
-    f << (i ? "," : "") << s.chip_ids[i];
-  f << "\n";
-  f.close();
-  // A short write (ENOSPC) must not install a truncated record: the
-  // corrupted slice would vanish from the occupancy scan and its chips
-  // would be re-dealt under a running pod.
-  if (!f || rename(tmp.c_str(), slice_path(s.slice_id).c_str()) != 0) {
+    body << (i ? "," : "") << s.chip_ids[i];
+  body << "\n";
+  const std::string data = body.str();
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  const char* ptr = data.c_str();
+  size_t left = data.size();
+  while (left > 0) {
+    ssize_t n = write(fd, ptr, left);
+    if (n <= 0) {
+      close(fd);
+      unlink(tmp.c_str());
+      return false;
+    }
+    ptr += n;
+    left -= static_cast<size_t>(n);
+  }
+  if (fsync(fd) != 0 || close(fd) != 0 ||
+      rename(tmp.c_str(), slice_path(s.slice_id).c_str()) != 0) {
     unlink(tmp.c_str());
     return false;
+  }
+  // Persist the directory entry too (the rename itself).
+  int dfd = open(g_state.state_dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
   }
   return true;
 }
@@ -266,11 +288,19 @@ std::vector<Slice> load_slices(std::string* corrupt) {
 void json_str(std::ostringstream& os, const std::string& s) {
   os << '"';
   for (char c : s) {
-    switch (c) {
-      case '"': os << "\\\""; break;
-      case '\\': os << "\\\\"; break;
-      case '\n': os << "\\n"; break;
-      default: os << c;
+    unsigned char u = static_cast<unsigned char>(c);
+    if (c == '"') {
+      os << "\\\"";
+    } else if (c == '\\') {
+      os << "\\\\";
+    } else if (u < 0x20) {
+      // All control characters (tab, CR, LF, ...) as \u00XX, or the
+      // Python binding's json.loads rejects the payload.
+      char esc[8];
+      std::snprintf(esc, sizeof(esc), "\\u%04x", u);
+      os << esc;
+    } else {
+      os << c;
     }
   }
   os << '"';

@@ -60,13 +60,12 @@ class FakeTpudevClient(TpudevClient):
                 occupied.update(s.chip_ids)
             for p in placements:
                 # Mirror the native layer's placement-grammar validation
-                # (`parse_placement` in tpudev.cc): orientation must be a
-                # permutation of the canonical profile dims. Without this
-                # the fake accepts placements real hardware rejects.
+                # (`parse_placement` in tpudev.cc): the profile must be a
+                # well-formed positive mesh shape and the orientation a
+                # permutation of its dims. Without this the fake accepts
+                # placements real hardware rejects.
                 try:
-                    profile_dims = sorted(
-                        int(x) for x in p.profile.split("x")
-                    )
+                    profile_dims = sorted(topo.parse_shape(p.profile))
                 except ValueError:
                     errors.append(f"{p.slice_id()}: malformed profile")
                     continue
