@@ -27,7 +27,14 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, state: TrainState, *, force: bool = False) -> bool:
+    def save(
+        self, state: TrainState, *, force: bool = False, wait: bool = False
+    ) -> bool:
+        """Kick off an (async, by orbax default) checkpoint save. The
+        write overlaps subsequent training steps; pass `wait=True` only
+        when synchronous durability matters (e.g. the final save before
+        exit) — an unconditional wait would stall the hot loop on
+        checkpoint I/O every interval."""
         step = int(state.step)
         saved = self._manager.save(
             step,
@@ -37,7 +44,8 @@ class CheckpointManager:
             ),
             force=force,
         )
-        self._manager.wait_until_finished()
+        if wait:
+            self._manager.wait_until_finished()
         return saved
 
     def latest_step(self) -> int | None:
@@ -46,6 +54,7 @@ class CheckpointManager:
     def restore(self, template: TrainState) -> TrainState | None:
         """Restore the newest checkpoint shaped/sharded like `template`
         (a freshly-initialized TrainState on the target mesh)."""
+        self._manager.wait_until_finished()  # drain any in-flight save
         step = self._manager.latest_step()
         if step is None:
             return None

@@ -13,10 +13,13 @@ part of the TPU-first compute layer its demo workloads become here.
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+logger = logging.getLogger(__name__)
 
 _NEG_INF = -1e30
 
@@ -133,7 +136,18 @@ def flash_attention(
         # causal with sq > sk would leave rows with zero visible keys
         # (l == 0); the reference defines that edge, so defer to it.
         or (causal and sq > sk)
+        # The kernel stages the whole K/V in VMEM per grid cell (~16 MB
+        # per core); beyond this the ring/chunked paths are the answer.
+        or sk * d * 8 > 8 * 2**20
     ):
+        # Not silent: the flagship ViT (seq 296) takes this path — its
+        # S^2 matrix is small enough that XLA's fusion is fine, but the
+        # dispatch decision should be observable.
+        logger.debug(
+            "flash_attention: falling back to XLA reference "
+            "(sq=%d sk=%d block_q=%d block_k=%d causal=%s)",
+            sq, sk, block_q, block_k, causal,
+        )
         return attention_reference(q, k, v, causal=causal)
 
     qr = q.reshape(b * h, sq, d)

@@ -26,9 +26,14 @@ from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
 _NEG_INF = -1e30
 
 
-def _local_block(q, k, v, q_off, k_off, causal):
+def _local_block(q, k, v, q_off, k_off, causal, align=0):
     """Scores of local Q against one K/V shard, with global-position mask.
-    Shapes: q [b,h,sq,d], k/v [b,h,sk,d]; returns (scores-softmax stats)."""
+    Shapes: q [b,h,sq,d], k/v [b,h,sk,d]; returns (scores-softmax stats).
+
+    The causal diagonal is bottom-right aligned via `align` (the global
+    Sk - Sq), matching `flash_attention`/`attention_reference`'s
+    `tril(k=sk-sq)` semantics so the two dispatch paths of the same API
+    agree on cross-length inputs."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -37,23 +42,44 @@ def _local_block(q, k, v, q_off, k_off, causal):
         sq, sk = s.shape[-2], s.shape[-1]
         q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = jnp.where(q_pos + align >= k_pos, s, _NEG_INF)
     return s
 
 
-def _ring_body(i, carry, *, axis_name, axis_size, q, causal, q_off, sk):
+def _ring_body(i, carry, *, axis_name, axis_size, q, causal, q_off, sk,
+               align=0):
     acc, m_prev, l_prev, k_cur, v_cur, src_idx = carry
     k_off = src_idx * sk
-    s = _local_block(q, k_cur, v_cur, q_off, k_off, causal)
-    m_cur = jnp.max(s, axis=-1)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new[..., None])
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-    acc = acc * alpha[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
+
+    def _accumulate(operands):
+        acc, m_prev, l_prev = operands
+        s = _local_block(q, k_cur, v_cur, q_off, k_off, causal, align)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    if causal:
+        # A ring step whose whole incoming shard lies in the future
+        # contributes nothing (every score is masked) — skipping it
+        # reclaims the ~(N-1)/2N of attention FLOPs the mask would
+        # discard on an N-way ring.
+        sq = q.shape[2]
+        fully_masked = q_off + sq - 1 + align < k_off
+        acc, m_new, l_new = jax.lax.cond(
+            fully_masked,
+            lambda operands: operands,
+            _accumulate,
+            (acc, m_prev, l_prev),
+        )
+    else:
+        acc, m_new, l_new = _accumulate((acc, m_prev, l_prev))
     # Rotate K/V one step around the ring (neighbor ICI hop).
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -79,6 +105,7 @@ def _ring_attn_local(q, k, v, *, axis_name, causal):
     body = functools.partial(
         _ring_body, axis_name=axis_name, axis_size=axis_size, q=qf,
         causal=causal, q_off=q_off, sk=sk,
+        align=(sk - sq) * axis_size,
     )
     acc, _m, l, _k, _v, _s = jax.lax.fori_loop(
         0, axis_size, body, (acc0, m0, l0, k, v, my_idx)
