@@ -131,3 +131,25 @@ class TestComponentTemplates:
         assert "/var/lib/kubelet/device-plugins" in text
         assert "nos.walkai.io/tpu-partitioning: tiling" in text
         assert "system-node-critical" in text
+
+    def test_monitors_cover_every_scrapable_component(self):
+        """Reference ships a prometheus monitor per component
+        (config/*/prometheus/monitor.yaml); the chart's monitors.yaml
+        must cover the partitioner Service and each agent/scheduler pod,
+        scraping through the rbac proxy when it is enabled."""
+        text = (CHART / "templates" / "monitors.yaml").read_text()
+        assert "monitoring.enabled" in text
+        assert "ServiceMonitor" in text and "PodMonitor" in text
+        for comp in ('"agent"', '"sharing-agent"', '"scheduler"'):
+            assert comp in text, comp
+        # rbac-proxied scrape mirrors the reference monitor endpoints
+        assert "bearerTokenFile" in text and "insecureSkipVerify: true" in text
+
+    def test_agents_scrapable_behind_proxy(self):
+        """Agents bind metrics to localhost and add the proxy sidecar when
+        kubeRbacProxy is on (reference: config/migagent/default/
+        mig_agent_auth_proxy_patch.yaml)."""
+        for name in ("daemonset_agent.yaml", "daemonset_sharing-agent.yaml"):
+            text = (CHART / "templates" / name).read_text()
+            assert '127.0.0.1:8080' in text, name
+            assert "walkai-nos.kubeRbacProxy.container" in text, name

@@ -72,3 +72,44 @@ def test_demo_requests_slice_resources():
             assert any(k.startswith("walkai.io/tpu-") for k in limits)
             return
     raise AssertionError("demo deployment not found")
+
+
+def test_kustomization_resources_exist():
+    """`kubectl apply -k deploy/` must not dangle: every resource listed
+    in a kustomization.yaml resolves to a file on disk."""
+    kustomizations = sorted(REPO.glob("deploy/**/kustomization.yaml"))
+    assert kustomizations, "deploy/ kustomize entry point missing"
+    for path in kustomizations:
+        doc = yaml.safe_load(path.read_text())
+        for res in doc.get("resources", []):
+            assert (path.parent / res).exists(), (path, res)
+
+
+def test_prometheus_monitors_target_real_apps():
+    """Each PodMonitor selector must match a workload that exists in
+    deploy/ (scraping :8080, the config-default metrics bind), and each
+    ServiceMonitor must match a Service defined alongside it."""
+    apps = set()
+    service_labels = []
+    for _, doc in _all_docs():
+        if doc.get("kind") in ("Deployment", "DaemonSet"):
+            template = doc.get("spec", {}).get("template", {})
+            labels = template.get("metadata", {}).get("labels", {})
+            apps.add(labels.get("app"))
+        elif doc.get("kind") == "Service":
+            service_labels.append(doc["metadata"].get("labels", {}))
+    monitors = REPO / "deploy" / "prometheus" / "monitors.yaml"
+    for doc in yaml.safe_load_all(monitors.read_text()):
+        if not doc:
+            continue
+        if doc["kind"] == "PodMonitor":
+            (app,) = doc["spec"]["selector"]["matchLabels"].values()
+            assert app in apps, app
+            for ep in doc["spec"]["podMetricsEndpoints"]:
+                assert ep["targetPort"] == 8080, doc["metadata"]["name"]
+        elif doc["kind"] == "ServiceMonitor":
+            want = doc["spec"]["selector"]["matchLabels"]
+            assert any(
+                all(labels.get(k) == v for k, v in want.items())
+                for labels in service_labels
+            ), want

@@ -139,7 +139,9 @@ def main(argv: list[str] | None = None) -> int:
 
     kube = _common.build_kube_client()
     plugin_client = DevicePluginClient(kube)
-    health = _common.start_health(config.manager.health_probe_addr)
+    health = _common.start_health(
+        config.manager.health_probe_addr, config.manager.metrics_addr
+    )
 
     from walkai_nos_tpu.deviceplugin import PluginManager
 

@@ -69,7 +69,9 @@ def main(argv: list[str] | None = None) -> int:
 
     sharing_client = SharingClient(PodResourcesClient(args.pod_resources_socket))
     kube = _common.build_kube_client()
-    health = _common.start_health(config.manager.health_probe_addr)
+    health = _common.start_health(
+        config.manager.health_probe_addr, config.manager.metrics_addr
+    )
 
     try:
         host = tpudev.get_topology()
