@@ -89,13 +89,17 @@ def test_prometheus_monitors_target_real_apps():
     """Each PodMonitor selector must match a workload that exists in
     deploy/ (scraping :8080, the config-default metrics bind), and each
     ServiceMonitor must match a Service defined alongside it."""
-    apps = set()
+    app_ports: dict = {}
     service_labels = []
     for _, doc in _all_docs():
         if doc.get("kind") in ("Deployment", "DaemonSet"):
             template = doc.get("spec", {}).get("template", {})
             labels = template.get("metadata", {}).get("labels", {})
-            apps.add(labels.get("app"))
+            ports = set()
+            for container in template.get("spec", {}).get("containers", []):
+                for port in container.get("ports", []):
+                    ports.add(port.get("name"))
+            app_ports[labels.get("app")] = ports
         elif doc.get("kind") == "Service":
             service_labels.append(doc["metadata"].get("labels", {}))
     monitors = REPO / "deploy" / "prometheus" / "monitors.yaml"
@@ -104,9 +108,12 @@ def test_prometheus_monitors_target_real_apps():
             continue
         if doc["kind"] == "PodMonitor":
             (app,) = doc["spec"]["selector"]["matchLabels"].values()
-            assert app in apps, app
+            assert app in app_ports, app
             for ep in doc["spec"]["podMetricsEndpoints"]:
-                assert ep["targetPort"] == 8080, doc["metadata"]["name"]
+                # prometheus-operator keep-relabels on the DECLARED
+                # container port; a port the workload doesn't declare
+                # matches zero targets, silently.
+                assert ep["port"] in app_ports[app], (app, ep)
         elif doc["kind"] == "ServiceMonitor":
             want = doc["spec"]["selector"]["matchLabels"]
             assert any(

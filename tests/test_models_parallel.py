@@ -35,7 +35,10 @@ def _tiny_batch(cfg, b=8, seed=0):
 class TestMesh:
     def test_build_mesh_factors_axes(self):
         m = meshlib.build_mesh(jax.devices())
-        assert m.shape == {"data": 2, "fsdp": 1, "model": 4, "seq": 1}
+        assert m.shape == {
+            "pipe": 1, "data": 2, "fsdp": 1,
+            "expert": 1, "model": 4, "seq": 1,
+        }
 
     def test_slice_mesh_uses_slice_geometry_for_tp(self):
         m = meshlib.slice_mesh("2x4", jax.devices())

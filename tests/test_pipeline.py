@@ -1,0 +1,146 @@
+"""Pipeline parallelism: GPipe schedule correctness and the pipelined LM."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.lm import LMConfig, lm_loss
+from walkai_nos_tpu.models.pipelined_lm import (
+    _Embed,
+    _Head,
+    _block,
+    init_pipelined_lm_state,
+    make_pipelined_lm_train_step,
+)
+from walkai_nos_tpu.parallel.mesh import MeshAxes, build_mesh
+from walkai_nos_tpu.parallel.pipeline import (
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+)
+
+D = 16
+
+
+def _stages(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": jnp.asarray(rng.standard_normal((D, D)) * 0.1, jnp.float32),
+            "b": jnp.asarray(rng.standard_normal(D) * 0.1, jnp.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+class TestPipelineApply:
+    def test_matches_sequential(self):
+        mesh = build_mesh(jax.devices(), axes=MeshAxes(pipe=4, data=2))
+        stages = _stages(4)
+        x = jnp.asarray(
+            np.random.default_rng(1).standard_normal((16, D)), jnp.float32
+        )
+        xm = split_microbatches(x, 8)
+        y = merge_microbatches(
+            pipeline_apply(_stage_fn, stack_stage_params(stages), xm, mesh)
+        )
+        ref = x
+        for p in stages:
+            ref = _stage_fn(p, ref)
+        assert jnp.allclose(y, ref, atol=1e-5)
+
+    def test_differentiable(self):
+        mesh = build_mesh(jax.devices(), axes=MeshAxes(pipe=4, data=2))
+        stacked = stack_stage_params(_stages(4))
+        xm = split_microbatches(
+            jnp.ones((8, D), jnp.float32), 4
+        )
+
+        def loss(params):
+            return jnp.sum(pipeline_apply(_stage_fn, params, xm, mesh) ** 2)
+
+        grads = jax.grad(loss)(stacked)
+        for leaf in jax.tree_util.tree_leaves(grads):
+            assert bool(jnp.all(jnp.isfinite(leaf)))
+            assert float(jnp.max(jnp.abs(leaf))) > 0.0
+
+    def test_underfilled_pipeline_rejected(self):
+        mesh = build_mesh(jax.devices(), axes=MeshAxes(pipe=4, data=2))
+        stacked = stack_stage_params(_stages(4))
+        xm = split_microbatches(jnp.ones((4, D), jnp.float32), 2)
+        with pytest.raises(ValueError, match="under-fill"):
+            pipeline_apply(_stage_fn, stacked, xm, mesh)
+
+    def test_indivisible_microbatches_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            split_microbatches(jnp.ones((6, D)), 4)
+
+
+class TestPipelinedLM:
+    CFG = LMConfig(
+        vocab_size=128, hidden_dim=64, num_layers=4, num_heads=4,
+        max_seq_len=32,
+    )
+
+    def _mesh(self):
+        return build_mesh(jax.devices(), axes=MeshAxes(pipe=4, data=2))
+
+    def test_layers_must_split_over_stages(self):
+        cfg = LMConfig(
+            vocab_size=128, hidden_dim=64, num_layers=3, num_heads=4,
+            max_seq_len=32,
+        )
+        with pytest.raises(ValueError, match="split over"):
+            init_pipelined_lm_state(cfg, self._mesh(), jax.random.PRNGKey(0))
+
+    def test_loss_matches_sequential_forward(self):
+        """The pipelined step's reported loss must equal the loss of a
+        plain sequential forward through the same parameters."""
+        cfg, mesh = self.CFG, self._mesh()
+        state = init_pipelined_lm_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_pipelined_lm_train_step(cfg, mesh, n_microbatches=4)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 32))
+        )
+        _, loss = step(state, tokens)
+
+        params = jax.device_get(
+            init_pipelined_lm_state(cfg, mesh, jax.random.PRNGKey(0)).params
+        )
+        x = _Embed(cfg).apply({"params": params["embed"]}, tokens)
+        block = _block(cfg)
+        n_stages, per_stage = 4, cfg.num_layers // 4
+        for s in range(n_stages):
+            for layer in range(per_stage):
+                layer_params = jax.tree_util.tree_map(
+                    lambda leaf: leaf[s][layer], params["blocks"]
+                )
+                x = block.apply({"params": layer_params}, x)
+        logits = _Head(cfg).apply({"params": params["head"]}, x)
+        expected = lm_loss(logits, tokens)
+        assert abs(float(loss) - float(expected)) < 2e-2, (
+            float(loss), float(expected),
+        )
+
+    def test_training_reduces_loss(self):
+        cfg, mesh = self.CFG, self._mesh()
+        state = init_pipelined_lm_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_pipelined_lm_train_step(cfg, mesh, n_microbatches=4)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 32))
+        )
+        state, loss0 = step(state, tokens)
+        state, loss1 = step(state, tokens)
+        assert float(loss1) < float(loss0)
+
+    def test_stage_params_sharded_over_pipe(self):
+        cfg, mesh = self.CFG, self._mesh()
+        state = init_pipelined_lm_state(cfg, mesh, jax.random.PRNGKey(0))
+        leaf = jax.tree_util.tree_leaves(state.params["blocks"])[0]
+        assert leaf.sharding.spec[0] == "pipe"

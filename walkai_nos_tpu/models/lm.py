@@ -37,6 +37,13 @@ class LMConfig:
     # Sequence parallelism: shard the sequence over the mesh's `seq` axis
     # and run ring attention instead of the local kernel.
     use_ring_attention: bool = False
+    # Mixture-of-Experts: 0 = dense MLP everywhere; >0 swaps the MLP of
+    # every `moe_every`-th block for an expert-parallel MoEMlp
+    # (models/moe.py), experts sharded over the mesh's `expert` axis.
+    num_experts: int = 0
+    moe_every: int = 2
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def compute_dtype(self):
@@ -73,6 +80,7 @@ class CausalAttention(nn.Module):
 class DecoderBlock(nn.Module):
     cfg: LMConfig
     mesh: Mesh | None = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -80,13 +88,24 @@ class DecoderBlock(nn.Module):
         x = x + CausalAttention(c, self.mesh, name="attn")(
             nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
         )
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
+        if self.use_moe:
+            from walkai_nos_tpu.models.moe import MoEMlp
+
+            return x + MoEMlp(
+                hidden_dim=c.hidden_dim,
+                mlp_dim=c.mlp_ratio * c.hidden_dim,
+                num_experts=c.num_experts,
+                top_k=c.expert_top_k,
+                capacity_factor=c.capacity_factor,
+                dtype=c.compute_dtype,
+                mesh=self.mesh,
+                name="moe",
+            )(h)
         h = nn.Dense(c.mlp_ratio * c.hidden_dim, dtype=c.compute_dtype,
-                     name="fc1")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
-        )
+                     name="fc1")(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(c.hidden_dim, dtype=c.compute_dtype, name="fc2")(h)
-        return x
+        return x + nn.Dense(c.hidden_dim, dtype=c.compute_dtype, name="fc2")(h)
 
 
 class DecoderLM(nn.Module):
@@ -107,7 +126,8 @@ class DecoderLM(nn.Module):
         )
         x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
         for i in range(c.num_layers):
-            x = DecoderBlock(c, self.mesh, name=f"block{i}")(x)
+            use_moe = c.num_experts > 0 and (i + 1) % c.moe_every == 0
+            x = DecoderBlock(c, self.mesh, use_moe, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
         return nn.Dense(c.vocab_size, dtype=jnp.float32, name="head")(x)
 
@@ -138,6 +158,18 @@ def make_lm_train_step(cfg: LMConfig, mesh: Mesh, *, lr: float = 3e-4):
 
     def step(state: TrainState, tokens) -> tuple[TrainState, jax.Array]:
         def loss_fn(params):
+            if cfg.num_experts > 0:
+                from walkai_nos_tpu.models.moe import (
+                    aux_loss_from_intermediates,
+                )
+
+                logits, variables = model.apply(
+                    {"params": params}, tokens, mutable=["intermediates"]
+                )
+                aux = aux_loss_from_intermediates(
+                    variables.get("intermediates", {})
+                )
+                return lm_loss(logits, tokens) + 1e-2 * aux
             logits = model.apply({"params": params}, tokens)
             return lm_loss(logits, tokens)
 

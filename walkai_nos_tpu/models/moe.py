@@ -1,0 +1,143 @@
+"""Mixture-of-Experts MLP with expert parallelism, the GSPMD way.
+
+Routing is expressed as dense one-hot dispatch/combine einsums with
+`with_sharding_constraint` pinning the expert dimension to the mesh's
+`expert` axis — XLA inserts the all-to-alls from the sharding change
+(tokens sharded over `data` → expert-major layout → back), exactly the
+compilation model the TPU mandate calls for: no manual collectives, no
+data-dependent shapes. Capacity is static (computed from the token
+count at trace time) so every step compiles to one program; overflow
+tokens fall through the residual connection rather than breaking shape
+stability.
+
+No reference analogue — the reference is a control plane; this extends
+the LM workload family (`models/lm.py`) with the expert-parallel axis
+the slice consumer uses on larger meshes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from walkai_nos_tpu.parallel.mesh import AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL
+
+
+def _constrain(x: jax.Array, mesh: Mesh | None, spec: P) -> jax.Array:
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec)
+    )
+
+
+class MoEMlp(nn.Module):
+    """Drop-in replacement for the dense fc1/gelu/fc2 MLP.
+
+    Top-k routing with static per-expert capacity; expert weights are
+    stacked with a leading expert dimension sharded over `expert` (see
+    the `experts_(up|down)` rules in `parallel/sharding.py`).
+    """
+
+    hidden_dim: int
+    mlp_dim: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d, f, num_experts = self.hidden_dim, self.mlp_dim, self.num_experts
+        batch, seq, _ = x.shape
+        tokens = batch * seq
+        xt = x.reshape(tokens, d)
+
+        # Router in f32: tiny matmul, and gate ordering must not wobble
+        # with bf16 rounding.
+        logits = nn.Dense(num_experts, dtype=jnp.float32, name="router")(
+            xt.astype(jnp.float32)
+        )
+        gates = jax.nn.softmax(logits, axis=-1)  # [T, E]
+
+        capacity = max(
+            1,
+            math.ceil(self.capacity_factor * tokens * self.top_k / num_experts),
+        )
+        capacity = min(capacity, tokens)
+
+        combine = jnp.zeros((tokens, num_experts, capacity), jnp.float32)
+        occupancy = jnp.zeros((1, num_experts), jnp.float32)
+        remaining = gates
+        weights = []
+        raw_masks = []
+        for _ in range(self.top_k):
+            index = jnp.argmax(remaining, axis=-1)  # [T]
+            mask = jax.nn.one_hot(index, num_experts)  # [T, E]
+            raw_masks.append(mask)
+            remaining = remaining * (1.0 - mask)
+            # Position of each token within its chosen expert's buffer,
+            # offset by what earlier routing rounds already filled.
+            position = jnp.cumsum(mask, axis=0) - mask + occupancy
+            mask = mask * (position < capacity)
+            occupancy = occupancy + mask.sum(axis=0, keepdims=True)
+            weights.append((gates * mask).sum(axis=-1))  # [T]
+            combine = combine + (
+                mask[:, :, None]
+                * jax.nn.one_hot(position.astype(jnp.int32), capacity)
+            ) * (gates * mask).sum(axis=-1)[:, None, None]
+        # Normalize the kept gate weights so routed mass sums to 1.
+        denom = sum(weights)
+        combine = combine / jnp.maximum(denom, 1e-9)[:, None, None]
+        dispatch = (combine > 0.0).astype(self.dtype)  # [T, E, C]
+
+        # Load-balance auxiliary loss (GShard eq. 4): fraction of tokens
+        # whose top-1 choice is each expert × mean router probability,
+        # scaled by E. Uses the PRE-capacity assignment — truncating at
+        # capacity would cap the penalty exactly when an expert
+        # overflows, the regime the loss exists to correct.
+        frac = raw_masks[0].mean(axis=0)
+        prob = gates.mean(axis=0)
+        self.sow("intermediates", "aux_loss", num_experts * (frac * prob).sum())
+
+        w_up = self.param(
+            "experts_up",
+            nn.initializers.lecun_normal(),
+            (num_experts, d, f),
+        ).astype(self.dtype)
+        w_down = self.param(
+            "experts_down",
+            nn.initializers.lecun_normal(),
+            (num_experts, f, d),
+        ).astype(self.dtype)
+
+        # Dispatch: tokens (data-sharded) -> expert-major [E, C, D]; the
+        # sharding constraint flips the partitioned dim from tokens to
+        # experts, which XLA lowers to an all-to-all over `expert`.
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch, xt.astype(self.dtype)
+        )
+        expert_in = _constrain(expert_in, self.mesh, P(AXIS_EXPERT, None, None))
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w_up)
+        h = _constrain(h, self.mesh, P(AXIS_EXPERT, None, AXIS_MODEL))
+        h = nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h, w_down)
+        out = _constrain(out, self.mesh, P(AXIS_EXPERT, None, None))
+        # Combine: back to token-major (the reverse all-to-all).
+        y = jnp.einsum(
+            "tec,ecd->td", combine.astype(self.dtype), out
+        )
+        return y.reshape(batch, seq, d).astype(x.dtype)
+
+
+def aux_loss_from_intermediates(intermediates) -> jax.Array:
+    """Sum every MoE layer's sown aux_loss (0.0 when the tree is empty)."""
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(intermediates):
+        total = total + jnp.asarray(leaf, jnp.float32).sum()
+    return total

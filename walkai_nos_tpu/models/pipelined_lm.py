@@ -1,0 +1,148 @@
+"""Pipeline-parallel decoder LM: the `pipe`-axis consumer.
+
+Splits the decoder stack of `models/lm.py` across the mesh's `pipe`
+axis using the GPipe transform (`parallel/pipeline.py`): each stage
+holds `num_layers / n_stages` blocks (scanned locally), activations
+hand off stage-to-stage with one ppermute per microbatch tick.
+Embedding and head are computed outside the pipeline (they are a
+different shape than the shape-preserving block stages) and replicated
+over `pipe`; the batch stays sharded over (data, fsdp) throughout, so
+pp composes with dp/fsdp.
+
+No reference analogue — compute-runtime workload, per the TPU mandate.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from walkai_nos_tpu.models.lm import DecoderBlock, LMConfig, lm_loss
+from walkai_nos_tpu.models.train import TrainState, make_optimizer
+from walkai_nos_tpu.parallel import sharding as shardlib
+from walkai_nos_tpu.parallel.mesh import AXIS_PIPE
+from walkai_nos_tpu.parallel.pipeline import (
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+)
+
+
+class _Embed(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        c = self.cfg
+        x = nn.Embed(
+            c.vocab_size, c.hidden_dim, dtype=c.compute_dtype, name="embed"
+        )(tokens)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, c.max_seq_len, c.hidden_dim),
+        )
+        return x + pos[:, : tokens.shape[1]].astype(x.dtype)
+
+
+class _Head(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
+        return nn.Dense(self.cfg.vocab_size, dtype=jnp.float32, name="head")(x)
+
+
+def _block(cfg: LMConfig) -> DecoderBlock:
+    # Stages run inside shard_map where XLA cannot re-shard mid-stage, so
+    # blocks are dense (no per-layer MoE all-to-all) and mesh-free.
+    return DecoderBlock(cfg, mesh=None, use_moe=False)
+
+
+def init_pipelined_lm_state(
+    cfg: LMConfig, mesh: Mesh, rng: jax.Array, *, lr: float = 3e-4
+) -> TrainState:
+    n_stages = mesh.shape[AXIS_PIPE]
+    if cfg.num_layers % n_stages != 0:
+        raise ValueError(
+            f"{cfg.num_layers} layers do not split over {n_stages} stages"
+        )
+    per_stage = cfg.num_layers // n_stages
+    block = _block(cfg)
+    dummy_tokens = jnp.zeros((1, cfg.max_seq_len), jnp.int32)
+    dummy_hidden = jnp.zeros(
+        (1, cfg.max_seq_len, cfg.hidden_dim), cfg.compute_dtype
+    )
+    rngs = jax.random.split(rng, cfg.num_layers + 2)
+    layer_params = [
+        block.init(rngs[i], dummy_hidden)["params"]
+        for i in range(cfg.num_layers)
+    ]
+    stacked = stack_stage_params(layer_params)  # leaves [L, ...]
+    stacked = jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(
+            leaf.reshape((n_stages, per_stage) + leaf.shape[1:]),
+            NamedSharding(mesh, P(AXIS_PIPE)),
+        ),
+        stacked,
+    )
+    params = {
+        "embed": shardlib.shard_params(
+            _Embed(cfg).init(rngs[-2], dummy_tokens)["params"], mesh
+        ),
+        "blocks": stacked,
+        "head": shardlib.shard_params(
+            _Head(cfg).init(rngs[-1], dummy_hidden)["params"], mesh
+        ),
+    }
+    tx = make_optimizer(lr)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+
+
+def make_pipelined_lm_train_step(
+    cfg: LMConfig,
+    mesh: Mesh,
+    *,
+    n_microbatches: int | None = None,
+    lr: float = 3e-4,
+):
+    """Jitted `(state, tokens) -> (state, loss)`; tokens [batch, seq]."""
+    n_stages = mesh.shape[AXIS_PIPE]
+    n_micro = n_microbatches or 2 * n_stages
+    block = _block(cfg)
+    embed_mod, head_mod = _Embed(cfg), _Head(cfg)
+    tx = make_optimizer(lr)
+
+    def stage_fn(stage_params, x):
+        # stage_params leaves: [per_stage, ...] — scan this stage's
+        # blocks locally (layer-stacked params, the standard TPU idiom).
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+
+        h, _ = lax.scan(body, x, stage_params)
+        return h
+
+    def step(state: TrainState, tokens) -> tuple[TrainState, jax.Array]:
+        def loss_fn(params):
+            x = embed_mod.apply({"params": params["embed"]}, tokens)
+            xm = split_microbatches(x, n_micro)
+            hm = pipeline_apply(stage_fn, params["blocks"], xm, mesh)
+            h = merge_microbatches(hm)
+            logits = head_mod.apply({"params": params["head"]}, h)
+            return lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        import optax
+
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    tokens_sharding = shardlib.batch_sharding(mesh)
+    return jax.jit(
+        step, in_shardings=(None, tokens_sharding), donate_argnums=(0,)
+    )

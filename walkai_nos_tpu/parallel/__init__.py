@@ -13,6 +13,12 @@ from walkai_nos_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     slice_mesh,
 )
+from walkai_nos_tpu.parallel.pipeline import (  # noqa: F401
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+)
 from walkai_nos_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     param_partition_spec,

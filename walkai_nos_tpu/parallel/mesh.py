@@ -27,12 +27,22 @@ from walkai_nos_tpu.tpu import topology
 # Canonical mesh axis names, in the order they appear in every Mesh this
 # module builds. Axes of size 1 are still present so PartitionSpecs are
 # uniform across slice sizes.
+AXIS_PIPE = "pipe"
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
 AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
 
-ALL_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ)
+# Axis order = collective locality order, fastest-varying last: `seq`
+# ring permutes ride nearest-neighbor links when sequence parallelism is
+# on; with seq=1 (the common case) `model` is effectively fastest, so
+# latency-critical TP psums stay on adjacent chips; `expert` all-to-alls
+# sit one stride out; `pipe` varies slowest — stage handoffs are the
+# rarest collective (one ppermute per microbatch tick). When combining
+# seq>1 with model>1, TP groups are strided by the seq degree — prefer
+# keeping one of the two at 1 on small slices.
+ALL_AXES = (AXIS_PIPE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_MODEL, AXIS_SEQ)
 
 
 @dataclass(frozen=True)
@@ -43,17 +53,26 @@ class MeshAxes:
     fsdp: int = 1
     model: int = 1
     seq: int = 1
+    expert: int = 1
+    pipe: int = 1
 
     @property
     def total(self) -> int:
-        return self.data * self.fsdp * self.model * self.seq
+        return (
+            self.data * self.fsdp * self.model * self.seq
+            * self.expert * self.pipe
+        )
 
-    def as_shape(self) -> tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.model, self.seq)
+    def as_shape(self) -> tuple[int, int, int, int, int, int]:
+        return (
+            self.pipe, self.data, self.fsdp,
+            self.expert, self.model, self.seq,
+        )
 
 
 def _factor_axes(n: int, model: int | None, seq: int) -> MeshAxes:
-    """Pick (data, fsdp, model, seq) degrees for `n` devices.
+    """Pick (data, model, seq) degrees for `n` devices (fsdp, expert and
+    pipe stay 1 unless the caller passes explicit `MeshAxes`).
 
     Heuristic when `model` is unspecified: tensor parallelism up to 4-way
     (v5e host meshes are 2x4; a 4-chip TP group is one ICI row), the rest
@@ -76,12 +95,15 @@ def build_mesh(
     model: int | None = None,
     seq: int = 1,
 ) -> Mesh:
-    """Build a 4-axis ``Mesh`` (data, fsdp, model, seq) over `devices`.
+    """Build a 6-axis ``Mesh`` (pipe, data, fsdp, expert, model, seq)
+    over `devices`; axes not in play have size 1.
 
-    Axis placement: devices are reshaped so the *model* axis is the
-    fastest-varying — adjacent device ids (adjacent chips on the ICI mesh,
-    per JAX's default TPU device order) form a tensor-parallel group, which
-    keeps the latency-critical TP collectives on nearest-neighbor links.
+    Axis placement: devices are reshaped per ``ALL_AXES`` order — with
+    seq=1, the *model* axis is the fastest-varying, so adjacent device
+    ids (adjacent chips on the ICI mesh, per JAX's default TPU device
+    order) form a tensor-parallel group and the latency-critical TP
+    collectives stay on nearest-neighbor links; with seq>1 the ring
+    permutes of sequence parallelism take those links instead.
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     if axes is None:

@@ -1,0 +1,138 @@
+"""GPipe-style pipeline parallelism over the mesh's `pipe` axis.
+
+A pipeline stage is a pure function `stage_fn(stage_params, x) -> y`
+with y.shape == x.shape (transformer blocks qualify). Stage parameters
+are stacked on a leading stage dimension sharded over `pipe`, so each
+device holds exactly its stage's weights. The schedule is the classic
+GPipe bubble: `n_microbatches + n_stages - 1` ticks of a `lax.scan`,
+each tick running every stage on its in-flight microbatch and handing
+activations to the next stage with a single nearest-neighbor
+`lax.ppermute` — the cheapest collective on the ICI mesh, which is why
+`pipe` is the slowest-varying mesh axis (`parallel/mesh.py` ALL_AXES).
+
+Everything is static-shaped and scan-based (no Python-level scheduling
+loop), compiles to one XLA program, and is differentiable end to end —
+gradients flow back through the ppermute chain, so a pipelined train
+step is just `jax.grad` over this transform.
+
+No reference analogue — the reference is a control plane; this is the
+pipeline dimension of the slice-consumer compute runtime.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees into one pytree with a leading
+    stage dimension (what `pipeline_apply` expects)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )
+
+
+def stage_param_specs(stage_params) -> object:
+    """PartitionSpecs pinning the leading stage dim to `pipe` (stage
+    weights otherwise replicated within their stage group)."""
+    return jax.tree_util.tree_map(lambda _: P(AXIS_PIPE), stage_params)
+
+
+def pipeline_apply(
+    stage_fn,
+    stage_params,
+    x_microbatches: jax.Array,
+    mesh: Mesh,
+):
+    """Run `x` through all stages, pipelined over microbatches.
+
+    Args:
+      stage_fn: `(params_one_stage, x) -> y`, shape-preserving.
+      stage_params: pytree whose leaves have leading dim `n_stages`
+        (== mesh.shape['pipe']), e.g. from `stack_stage_params`.
+      x_microbatches: `[n_microbatches, microbatch, ...]`; the
+        microbatch dim may be sharded over (data, fsdp).
+      mesh: the device mesh.
+
+    Returns `[n_microbatches, microbatch, ...]` outputs of the last
+    stage, replicated over `pipe`.
+    """
+    n_stages = mesh.shape[AXIS_PIPE]
+    n_micro = x_microbatches.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"{n_micro} microbatches under-fill a {n_stages}-stage "
+            "pipeline (every stage idles in the bubble); use at least "
+            "one microbatch per stage"
+        )
+    batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
+
+    def local(params, x):
+        # params leaves arrive as [1, ...] (this device's stage shard).
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        rank = lax.axis_index(AXIS_PIPE)
+        zero = jnp.zeros_like(x[0])
+        collected = jnp.zeros_like(x)
+
+        def tick(carry, t):
+            state, collected = carry
+            # Stage 0 feeds microbatch t (clamped past the end: those
+            # ticks produce garbage that drains past the last stage's
+            # collection window, never into it).
+            feed = x[jnp.minimum(t, n_micro - 1)]
+            cur = jnp.where(rank == 0, feed, state)
+            out = stage_fn(params, cur)
+            # Hand to the next stage; the last stage's output leaves the
+            # ring (no wraparound edge), stage 0 receives zeros.
+            nxt = lax.ppermute(
+                out, AXIS_PIPE, [(i, i + 1) for i in range(n_stages - 1)]
+            )
+            # Last stage: tick t completes microbatch t-(n_stages-1).
+            oidx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            take = jnp.logical_and(rank == n_stages - 1, t >= n_stages - 1)
+            collected = collected.at[oidx].set(
+                jnp.where(take, out, collected[oidx])
+            )
+            return (nxt, collected), None
+
+        (state, collected), _ = lax.scan(
+            tick, (zero, collected), jnp.arange(n_micro + n_stages - 1)
+        )
+        # Replicate the last stage's result across the pipe group so the
+        # caller sees an ordinary (pipe-replicated) array.
+        return lax.psum(
+            jnp.where(rank == n_stages - 1, collected,
+                      jnp.zeros_like(collected)),
+            AXIS_PIPE,
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(stage_param_specs(stage_params), batch_spec),
+        out_specs=batch_spec,
+        check_rep=False,
+    )(stage_params, x_microbatches)
+
+
+def split_microbatches(x: jax.Array, n_microbatches: int) -> jax.Array:
+    """[batch, ...] -> [n_microbatches, batch/n_microbatches, ...]."""
+    if x.shape[0] % n_microbatches != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {n_microbatches} "
+            "microbatches"
+        )
+    return x.reshape(
+        (n_microbatches, x.shape[0] // n_microbatches) + x.shape[1:]
+    )
+
+
+def merge_microbatches(x: jax.Array) -> jax.Array:
+    """Inverse of `split_microbatches`."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])

@@ -17,13 +17,23 @@ import re
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
+from walkai_nos_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+)
 
 # (regex over "/"-joined param path, spec). First match wins. Kernels are
 # (in_features, out_features); conv kernels are (h, w, in, out).
 _PARAM_RULES: list[tuple[str, P]] = [
     # Patch embedding conv: shard output channels over model axis.
     (r"patch_embed/.*kernel", P(None, None, AXIS_FSDP, AXIS_MODEL)),
+    # MoE expert stacks (models/moe.py): experts over the expert axis,
+    # then the usual Megatron column/row split within each expert.
+    (r"experts_up", P(AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL)),
+    (r"experts_down", P(AXIS_EXPERT, AXIS_MODEL, AXIS_FSDP)),
     # Column-parallel: attention qkv + MLP up-projection.
     (r"(qkv|query|key|value|fc1|up)/kernel", P(AXIS_FSDP, AXIS_MODEL)),
     # Row-parallel: attention output proj + MLP down-projection.
