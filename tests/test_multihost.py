@@ -1,0 +1,100 @@
+"""Multi-host bootstrap: env contract, DCN/ICI axis split, mesh degrade."""
+
+import jax
+import pytest
+
+from walkai_nos_tpu.parallel.mesh import MeshAxes
+from walkai_nos_tpu.parallel.multihost import (
+    multihost_mesh,
+    resolve_distributed_config,
+    split_dcn_axes,
+)
+
+
+class TestEnvContract:
+    def test_no_contract_returns_none(self):
+        assert resolve_distributed_config({}) is None
+
+    def test_gke_podslice_env(self):
+        config = resolve_distributed_config({
+            "MEGASCALE_COORDINATOR_ADDRESS": "t1v-n-0:8476",
+            "TPU_WORKER_ID": "2",
+            "TPU_WORKER_HOSTNAMES": "t1v-n-0,t1v-n-1,t1v-n-2,t1v-n-3",
+        })
+        assert config.coordinator == "t1v-n-0:8476"
+        assert config.process_id == 2
+        assert config.num_processes == 4
+
+    def test_port_defaulted(self):
+        config = resolve_distributed_config({
+            "JAX_COORDINATOR_ADDRESS": "coord",
+            "JAX_PROCESS_ID": "0",
+            "JAX_NUM_PROCESSES": "2",
+        })
+        assert config.coordinator == "coord:8476"
+
+    def test_missing_process_id_rejected(self):
+        with pytest.raises(ValueError, match="TPU_WORKER_ID"):
+            resolve_distributed_config({
+                "JAX_COORDINATOR_ADDRESS": "coord:1",
+                "JAX_NUM_PROCESSES": "2",
+            })
+
+    def test_missing_world_size_rejected(self):
+        with pytest.raises(ValueError, match="TPU_WORKER_HOSTNAMES"):
+            resolve_distributed_config({
+                "JAX_COORDINATOR_ADDRESS": "coord:1",
+                "JAX_PROCESS_ID": "0",
+            })
+
+    def test_out_of_range_process_rejected(self):
+        with pytest.raises(ValueError, match="out of range"):
+            resolve_distributed_config({
+                "JAX_COORDINATOR_ADDRESS": "coord:1",
+                "JAX_PROCESS_ID": "4",
+                "JAX_NUM_PROCESSES": "4",
+            })
+
+
+class TestDcnSplit:
+    def test_pipe_absorbs_hosts_first(self):
+        dcn, ici = split_dcn_axes(
+            MeshAxes(pipe=4, data=4, model=4), num_hosts=4
+        )
+        assert dcn.pipe == 4 and dcn.data == 1
+        assert ici.pipe == 1 and ici.data == 4 and ici.model == 4
+
+    def test_data_takes_the_remainder(self):
+        dcn, ici = split_dcn_axes(
+            MeshAxes(pipe=2, data=8, model=4), num_hosts=8
+        )
+        assert dcn.pipe == 2 and dcn.data == 4
+        assert ici.data == 2 and ici.model == 4
+
+    def test_critical_path_axes_never_cross_dcn(self):
+        dcn, _ = split_dcn_axes(
+            MeshAxes(pipe=2, data=2, model=8, seq=2), num_hosts=4
+        )
+        assert dcn.model == 1 and dcn.seq == 1 and dcn.expert == 1
+
+    def test_unplaceable_host_count_rejected(self):
+        with pytest.raises(ValueError, match="cannot place"):
+            split_dcn_axes(MeshAxes(model=8), num_hosts=4)
+
+    def test_single_host_is_identity(self):
+        axes = MeshAxes(data=2, model=4)
+        dcn, ici = split_dcn_axes(axes, num_hosts=1)
+        assert dcn.total == 1
+        assert ici == axes
+
+
+class TestMultihostMesh:
+    def test_single_host_degrades_to_build_mesh(self):
+        mesh = multihost_mesh(
+            MeshAxes(data=2, model=4), devices=jax.devices()
+        )
+        assert mesh.shape["data"] == 2 and mesh.shape["model"] == 4
+
+    def test_wrong_device_count_rejected(self):
+        with pytest.raises(ValueError, match="need"):
+            multihost_mesh(MeshAxes(data=2), devices=jax.devices())

@@ -13,6 +13,12 @@ from walkai_nos_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     slice_mesh,
 )
+from walkai_nos_tpu.parallel.multihost import (  # noqa: F401
+    initialize_distributed,
+    multihost_mesh,
+    resolve_distributed_config,
+    split_dcn_axes,
+)
 from walkai_nos_tpu.parallel.pipeline import (  # noqa: F401
     merge_microbatches,
     pipeline_apply,

@@ -1,0 +1,187 @@
+"""Multi-host mesh construction and distributed runtime bootstrap.
+
+The control plane partitions single hosts (multi-host pools are refused
+by the partitioner, `controllers/partitioner/node_controller.py:42`);
+workloads that span a multi-host TPU pod slice instead run WHOLE nodes
+and coordinate through this module — the XLA-collectives answer to an
+NCCL/MPI backend: one `jax.distributed.initialize` handshake, then the
+mesh places intra-host axes on ICI and cross-host axes on DCN, and
+every collective is compiler-inserted from shardings.
+
+Environment contract (GKE TPU podslice, the same labels/env the control
+plane reads in `tpu/topology.py`):
+  - ``MEGASCALE_COORDINATOR_ADDRESS`` or ``JAX_COORDINATOR_ADDRESS`` —
+    coordinator host:port
+  - ``TPU_WORKER_ID`` / ``JAX_PROCESS_ID`` — this host's process index
+  - ``TPU_WORKER_HOSTNAMES`` (comma-separated) or ``JAX_NUM_PROCESSES``
+    — world size
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from walkai_nos_tpu.parallel.mesh import ALL_AXES, MeshAxes
+
+logger = logging.getLogger(__name__)
+
+# Axes whose collectives tolerate DCN latency: data-parallel gradient
+# all-reduces overlap with backward compute, and pipeline handoffs are
+# one activation per microbatch tick. model/seq/expert collectives sit
+# on every layer's critical path and must stay on ICI.
+DCN_FRIENDLY_AXES = ("pipe", "data")
+
+
+class DistributedConfig:
+    """Resolved multi-process coordinates (pure data; no side effects)."""
+
+    def __init__(self, coordinator: str, process_id: int, num_processes: int):
+        self.coordinator = coordinator
+        self.process_id = process_id
+        self.num_processes = num_processes
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"DistributedConfig({self.coordinator!r}, "
+            f"{self.process_id}/{self.num_processes})"
+        )
+
+
+def resolve_distributed_config(
+    env: Mapping[str, str] | None = None,
+) -> DistributedConfig | None:
+    """Read the multi-host coordinates from the environment.
+
+    Returns None when the env carries no multi-host contract (single
+    host: nothing to initialize).
+    """
+    env = os.environ if env is None else env
+    coordinator = env.get("MEGASCALE_COORDINATOR_ADDRESS") or env.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if not coordinator:
+        return None
+    if ":" not in coordinator:
+        coordinator = f"{coordinator}:8476"
+
+    pid_raw = env.get("TPU_WORKER_ID", env.get("JAX_PROCESS_ID"))
+    if pid_raw is None:
+        raise ValueError(
+            "coordinator address set but no TPU_WORKER_ID/JAX_PROCESS_ID"
+        )
+    process_id = int(pid_raw)
+
+    hostnames = env.get("TPU_WORKER_HOSTNAMES", "")
+    if hostnames:
+        num_processes = len([h for h in hostnames.split(",") if h.strip()])
+    elif "JAX_NUM_PROCESSES" in env:
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    else:
+        raise ValueError(
+            "coordinator address set but neither TPU_WORKER_HOSTNAMES "
+            "nor JAX_NUM_PROCESSES present"
+        )
+    if not 0 <= process_id < num_processes:
+        raise ValueError(
+            f"process id {process_id} out of range for "
+            f"{num_processes} processes"
+        )
+    return DistributedConfig(coordinator, process_id, num_processes)
+
+
+def initialize_distributed(
+    env: Mapping[str, str] | None = None,
+) -> DistributedConfig | None:
+    """`jax.distributed.initialize` from the env contract; no-op (and
+    returns None) on a single host."""
+    config = resolve_distributed_config(env)
+    if config is None:
+        logger.info("no multi-host env contract; running single-process")
+        return None
+    logger.info("initializing distributed runtime: %r", config)
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator,
+        num_processes=config.num_processes,
+        process_id=config.process_id,
+    )
+    return config
+
+
+def split_dcn_axes(
+    axes: MeshAxes, num_hosts: int
+) -> tuple[MeshAxes, MeshAxes]:
+    """Factor `axes` into (dcn, ici) degrees for `num_hosts` hosts.
+
+    The DCN (cross-host) mesh takes its degrees from the DCN-friendly
+    axes — `pipe` first (stage handoffs are the cheapest cross-host
+    traffic), then `data` — and every other axis stays whole on ICI.
+    Raises when the friendly axes cannot absorb `num_hosts`.
+    """
+    if num_hosts <= 0:
+        raise ValueError(f"num_hosts must be positive, got {num_hosts}")
+    dcn = {a: 1 for a in ALL_AXES}
+    ici = {
+        "pipe": axes.pipe, "data": axes.data, "fsdp": axes.fsdp,
+        "expert": axes.expert, "model": axes.model, "seq": axes.seq,
+    }
+    remaining = num_hosts
+    for axis in DCN_FRIENDLY_AXES:
+        if remaining == 1:
+            break
+        take = np.gcd(ici[axis], remaining)
+        dcn[axis] = int(take)
+        ici[axis] //= int(take)
+        remaining //= int(take)
+    if remaining != 1:
+        raise ValueError(
+            f"cannot place {num_hosts} hosts on the DCN-friendly axes "
+            f"{DCN_FRIENDLY_AXES} of {axes} — give pipe/data a degree "
+            "divisible by the host count"
+        )
+    return (
+        MeshAxes(**{k: dcn[k] for k in ("data", "fsdp", "model", "seq",
+                                        "expert", "pipe")}),
+        MeshAxes(**{k: ici[k] for k in ("data", "fsdp", "model", "seq",
+                                        "expert", "pipe")}),
+    )
+
+
+def multihost_mesh(
+    axes: MeshAxes,
+    *,
+    num_hosts: int | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the 6-axis mesh across hosts: ICI degrees within each host,
+    DCN degrees across hosts (`mesh_utils.create_hybrid_device_mesh`).
+
+    With one host this degrades to the plain `build_mesh` layout.
+    """
+    from jax.experimental import mesh_utils
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_hosts is None:
+        num_hosts = max((d.process_index for d in devs), default=0) + 1
+    if axes.total != len(devs):
+        raise ValueError(
+            f"mesh axes {axes.as_shape()} need {axes.total} devices, "
+            f"got {len(devs)}"
+        )
+    if num_hosts == 1:
+        from walkai_nos_tpu.parallel.mesh import build_mesh
+
+        return build_mesh(devs, axes=axes)
+    dcn, ici = split_dcn_axes(axes, num_hosts)
+    arr = mesh_utils.create_hybrid_device_mesh(
+        ici.as_shape(),
+        dcn.as_shape(),
+        devices=devs,
+        allow_split_physical_axes=True,
+    )
+    return Mesh(arr, ALL_AXES)
