@@ -136,3 +136,45 @@ class TestExpertParallelTraining:
         params = model.init_params(jax.random.PRNGKey(0))
         assert "moe" in params["block1"] and "moe" in params["block3"]
         assert "fc1" in params["block0"] and "moe" not in params["block0"]
+
+
+class TestMoEProperties:
+    def test_covering_capacity_routes_all_mass(self):
+        """Seeded sweep over (E, top_k): with covering capacity no token
+        is dropped — every output row differs from zero and the layer is
+        a convex combination of expert outputs (bounded by their max)."""
+        import random
+
+        rng = random.Random(11)
+        for num_experts, top_k in ((2, 1), (4, 2), (8, 2), (4, 4)):
+            x = _x(b=2, s=8, d=16, seed=rng.randrange(1 << 16))
+            moe = MoEMlp(
+                hidden_dim=16, mlp_dim=32, num_experts=num_experts,
+                top_k=top_k, capacity_factor=float(num_experts),
+                dtype=jnp.float32,
+            )
+            params = moe.init(jax.random.PRNGKey(top_k), x)["params"]
+            y = moe.apply({"params": params}, x)
+            assert bool(jnp.all(jnp.isfinite(y))), (num_experts, top_k)
+            zero_rows = int(
+                jnp.sum(jnp.all(y.reshape(-1, 16) == 0.0, axis=-1))
+            )
+            assert zero_rows == 0, (num_experts, top_k, zero_rows)
+
+    def test_moe_gradients_flow_to_router_and_experts(self):
+        x = _x()
+        moe = MoEMlp(
+            hidden_dim=16, mlp_dim=32, num_experts=4, top_k=2,
+            capacity_factor=2.0, dtype=jnp.float32,
+        )
+        params = moe.init(jax.random.PRNGKey(0), x)["params"]
+
+        def loss(p):
+            return jnp.sum(moe.apply({"params": p}, x) ** 2)
+
+        grads = jax.grad(loss)(params)
+        for path in ("experts_up", "experts_down"):
+            g = grads[path]
+            assert float(jnp.max(jnp.abs(g))) > 0.0, path
+        g_router = grads["router"]["kernel"]
+        assert float(jnp.max(jnp.abs(g_router))) > 0.0
