@@ -149,6 +149,79 @@ else
   say "no ${WORKER2} in this cluster; skipping the sharing scenario"
 fi
 
+# ---- elastic-quota scenario (tpuscheduler binds, denies over-max) -----
+say "quota scenario: ElasticQuota min=max=4 chips in default namespace"
+# The chart ships the CRDs (helm-charts/walkai-nos-tpu/crds/); this is
+# belt-and-braces for clusters where helm skipped existing CRDs.
+kubectl apply -f deploy/crds/elasticquota.yaml
+kubectl apply -f - <<EOF
+apiVersion: nos.walkai.io/v1alpha1
+kind: ElasticQuota
+metadata:
+  name: e2e-quota
+  namespace: default
+spec:
+  min: {nos.walkai.io/tpu-chips: "4"}
+  max: {nos.walkai.io/tpu-chips: "4"}
+EOF
+
+say "creating a quota-scheduled 2x2 pod (4 chips, within min)"
+kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-quota-pod
+  namespace: default
+spec:
+  schedulerName: walkai-nos-scheduler
+  restartPolicy: Never
+  containers:
+    - name: main
+      image: busybox:1.36
+      command: ["sleep", "300"]
+      resources:
+        requests: {"walkai.io/tpu-2x2": "1"}
+        limits: {"walkai.io/tpu-2x2": "1"}
+EOF
+
+say "waiting for the quota pod to bind (scheduler -> retile -> bind)"
+if ! kubectl wait pod/e2e-quota-pod --for=condition=PodScheduled \
+    --timeout=180s; then
+  echo "FAIL: quota pod never scheduled"
+  kubectl describe pod e2e-quota-pod | tail -20
+  kubectl -n "${NS}" logs -l app=tpuscheduler --tail=50 || true
+  exit 1
+fi
+
+say "creating a second 2x2 pod that exceeds max (8 > 4 chips)"
+kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-overquota-pod
+  namespace: default
+spec:
+  schedulerName: walkai-nos-scheduler
+  restartPolicy: Never
+  containers:
+    - name: main
+      image: busybox:1.36
+      command: ["sleep", "300"]
+      resources:
+        requests: {"walkai.io/tpu-2x2": "1"}
+        limits: {"walkai.io/tpu-2x2": "1"}
+EOF
+
+say "asserting the over-max pod stays pending (quota denial, not capacity)"
+sleep 20
+if [ -n "$(kubectl get pod e2e-overquota-pod \
+    -o jsonpath='{.spec.nodeName}')" ]; then
+  echo "FAIL: over-quota pod was bound past the quota max"
+  kubectl -n "${NS}" logs -l app=tpuscheduler --tail=50 || true
+  exit 1
+fi
+say "quota scenario PASS"
+
 say "PASS: e2e scenario complete"
 kubectl get node "${WORKER}" -o jsonpath='{.metadata.annotations}' \
   | tr ',' '\n' | grep nos.walkai.io | sed 's/^/    /'

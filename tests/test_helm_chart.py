@@ -153,3 +153,22 @@ class TestComponentTemplates:
             text = (CHART / "templates" / name).read_text()
             assert '127.0.0.1:8080' in text, name
             assert "walkai-nos.kubeRbacProxy.container" in text, name
+
+    def test_chart_ships_quota_crds_in_sync_with_deploy(self):
+        """helm installs crds/ before templates; the chart copy must
+        exist and match the raw-manifest copy byte for byte."""
+        chart_crds = CHART / "crds" / "elasticquota.yaml"
+        deploy_crds = (
+            CHART.parents[1] / "deploy" / "crds" / "elasticquota.yaml"
+        )
+        assert chart_crds.exists()
+        assert chart_crds.read_text() == deploy_crds.read_text()
+        names = {
+            d["metadata"]["name"]
+            for d in yaml.safe_load_all(chart_crds.read_text())
+            if d
+        }
+        assert names == {
+            "elasticquotas.nos.walkai.io",
+            "compositeelasticquotas.nos.walkai.io",
+        }
