@@ -44,6 +44,11 @@ class LMConfig:
     moe_every: int = 2
     expert_top_k: int = 2
     capacity_factor: float = 1.25
+    # Rematerialization: recompute each block's activations in the
+    # backward pass instead of storing them (jax.checkpoint) — the
+    # standard HBM-for-FLOPs trade that lets long sequences / deep
+    # stacks fit chip memory.
+    remat: bool = False
 
     @property
     def compute_dtype(self):
@@ -125,9 +130,13 @@ class DecoderLM(nn.Module):
             (1, c.max_seq_len, c.hidden_dim),
         )
         x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
+        block_cls = (
+            nn.remat(DecoderBlock, prevent_cse=False) if c.remat
+            else DecoderBlock
+        )
         for i in range(c.num_layers):
             use_moe = c.num_experts > 0 and (i + 1) % c.moe_every == 0
-            x = DecoderBlock(c, self.mesh, use_moe, name=f"block{i}")(x)
+            x = block_cls(c, self.mesh, use_moe, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
         return nn.Dense(c.vocab_size, dtype=jnp.float32, name="head")(x)
 

@@ -120,8 +120,17 @@ def make_pipelined_lm_train_step(
     def stage_fn(stage_params, x):
         # stage_params leaves: [per_stage, ...] — scan this stage's
         # blocks locally (layer-stacked params, the standard TPU idiom).
+        def apply_layer(layer_params, h):
+            return block.apply({"params": layer_params}, h)
+
+        if cfg.remat:
+            # Per-layer rematerialization: with microbatches in flight
+            # across the whole pipeline, stored activations are the
+            # dominant HBM term — recompute them in backward instead.
+            apply_layer = jax.checkpoint(apply_layer, prevent_cse=False)
+
         def body(h, layer_params):
-            return block.apply({"params": layer_params}, h), None
+            return apply_layer(layer_params, h), None
 
         h, _ = lax.scan(body, x, stage_params)
         return h
