@@ -18,3 +18,10 @@ from walkai_nos_tpu.models.train import (  # noqa: F401
     make_infer_step,
     init_train_state,
 )
+from walkai_nos_tpu.models.lm import (  # noqa: F401
+    DecoderLM,
+    LMConfig,
+    init_lm_state,
+    make_lm_train_step,
+)
+from walkai_nos_tpu.models.decode import make_generate_fn  # noqa: F401

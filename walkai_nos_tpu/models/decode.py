@@ -1,0 +1,100 @@
+"""Autoregressive generation for the decoder LM (KV-cache decoding).
+
+Prefill runs the whole prompt through the cache-writing path once, then
+a `lax.scan` emits one token per step — everything static-shaped, one
+compiled program per (batch, prompt_len, max_new_tokens) signature, no
+Python in the decode loop. Greedy when temperature == 0, otherwise
+temperature sampling with a caller-provided PRNG key.
+
+No reference analogue — serving-side companion of `models/lm.py`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+
+
+def _sample(logits: jax.Array, temperature: float, rng: jax.Array):
+    """logits [batch, vocab] -> tokens [batch]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(rng, logits / temperature, axis=-1)
+
+
+def make_generate_fn(
+    cfg: LMConfig,
+    mesh: Mesh | None = None,
+    *,
+    temperature: float = 0.0,
+):
+    """Build a jitted `(params, prompt, rng) -> tokens` generator.
+
+    `prompt` is [batch, prompt_len] int32; the result is
+    [batch, max_new_tokens] (prompt not repeated). `max_new_tokens` is a
+    static argument of the returned function. Requires
+    prompt_len + max_new_tokens <= cfg.max_seq_len (the cache size).
+    """
+    if cfg.use_ring_attention:
+        raise ValueError(
+            "decode uses the KV-cache path; build the generate config "
+            "with use_ring_attention=False (ring is a training-time "
+            "sequence-parallel layout)"
+        )
+    model = DecoderLM(cfg, mesh)
+
+    @functools.partial(jax.jit, static_argnames=("max_new_tokens",))
+    def generate(
+        params, prompt: jax.Array, max_new_tokens: int,
+        rng: jax.Array | None = None,
+    ) -> jax.Array:
+        batch, prompt_len = prompt.shape
+        if prompt_len + max_new_tokens > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt_len} + {max_new_tokens} new tokens "
+                f"exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        cache = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+
+        # Prefill: one pass over the whole prompt populates the cache.
+        logits, variables = model.apply(
+            {"params": params, "cache": cache},
+            prompt, decode=True, mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        first = _sample(logits[:, -1], temperature, sub)
+
+        def step(carry, _):
+            cache, token, rng = carry
+            logits, variables = model.apply(
+                {"params": params, "cache": cache},
+                token[:, None], decode=True, mutable=["cache"],
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], temperature, sub)
+            return (variables["cache"], nxt, rng), nxt
+
+        if max_new_tokens == 1:
+            return first[:, None]
+        (_, _, _), rest = jax.lax.scan(
+            step,
+            (variables["cache"], first, rng),
+            None,
+            length=max_new_tokens - 1,
+        )
+        return jnp.concatenate(
+            [first[:, None], rest.transpose(1, 0)], axis=1
+        )
+
+    return generate

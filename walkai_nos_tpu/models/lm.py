@@ -67,19 +67,64 @@ class CausalAttention(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, decode: bool = False):
         c = self.cfg
         d = c.hidden_dim
         head_dim = d // c.num_heads
         qkv = nn.Dense(3 * d, dtype=c.compute_dtype, name="qkv")(x)
         qkv = qkv.reshape(x.shape[0], x.shape[1], 3, c.num_heads, head_dim)
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
-        if c.use_ring_attention and self.mesh is not None:
+        if decode:
+            o = self._decode_attention(q, k, v)
+        elif c.use_ring_attention and self.mesh is not None:
             o = ring_attention(q, k, v, self.mesh, causal=True)
         else:
             o = flash_attention(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
         return nn.Dense(d, dtype=c.compute_dtype, name="out_proj")(o)
+
+    def _decode_attention(self, q, k, v):
+        """KV-cache attention for autoregressive decoding (the flax
+        `cache` collection idiom): new K/V land at `cache_index` via a
+        static-shaped dynamic_update_slice, the query attends to every
+        cached position up to its own. Dense masked attention over
+        `max_seq_len` — decoding works on single steps or prefill
+        chunks, where flashing buys nothing."""
+        c = self.cfg
+        batch, heads, steps, head_dim = q.shape
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (batch, heads, c.max_seq_len, head_dim), c.compute_dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (batch, heads, c.max_seq_len, head_dim), c.compute_dtype,
+        )
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if self.is_initializing():
+            return jnp.zeros_like(q)
+        idx = index.value
+        k_all = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cached_k.value.dtype), (0, 0, idx, 0)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cached_v.value.dtype), (0, 0, idx, 0)
+        )
+        cached_k.value, cached_v.value = k_all, v_all
+        index.value = idx + steps
+        q_pos = idx + jnp.arange(steps)
+        k_pos = jnp.arange(c.max_seq_len)
+        mask = k_pos[None, :] <= q_pos[:, None]  # [steps, max_seq_len]
+        scale = head_dim ** -0.5
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+            k_all.astype(jnp.float32),
+        ) * scale
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_all.dtype), v_all)
 
 
 class DecoderBlock(nn.Module):
@@ -88,10 +133,10 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, decode: bool = False):
         c = self.cfg
         x = x + CausalAttention(c, self.mesh, name="attn")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
+            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x), decode=decode
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
         if self.use_moe:
@@ -118,8 +163,13 @@ class DecoderLM(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, tokens):
-        """tokens: [batch, seq] int32 -> logits [batch, seq, vocab]."""
+    def __call__(self, tokens, *, decode: bool = False):
+        """tokens: [batch, seq] int32 -> logits [batch, seq, vocab].
+
+        With `decode=True` the blocks run in KV-cache mode (mutable
+        `cache` collection): `tokens` is the prefill chunk or the next
+        single step, positions continue from the cache index.
+        """
         c = self.cfg
         x = nn.Embed(
             c.vocab_size, c.hidden_dim,
@@ -129,14 +179,30 @@ class DecoderLM(nn.Module):
             "pos_embed", nn.initializers.normal(0.02),
             (1, c.max_seq_len, c.hidden_dim),
         )
-        x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
+        if decode:
+            pos_index = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            offset = pos_index.value
+            if not self.is_initializing():
+                pos_index.value = offset + tokens.shape[1]
+            x = x + jax.lax.dynamic_slice(
+                pos, (0, offset, 0), (1, tokens.shape[1], c.hidden_dim)
+            ).astype(x.dtype)
+        else:
+            x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
+        # Remat only matters for training's backward pass; decode mode
+        # caches anyway — and remat would trace the static decode kwarg,
+        # so the rematted call omits it (default False).
+        use_remat = c.remat and not decode
         block_cls = (
-            nn.remat(DecoderBlock, prevent_cse=False) if c.remat
+            nn.remat(DecoderBlock, prevent_cse=False) if use_remat
             else DecoderBlock
         )
         for i in range(c.num_layers):
             use_moe = c.num_experts > 0 and (i + 1) % c.moe_every == 0
-            x = block_cls(c, self.mesh, use_moe, name=f"block{i}")(x)
+            block = block_cls(c, self.mesh, use_moe, name=f"block{i}")
+            x = block(x) if use_remat else block(x, decode=decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
         return nn.Dense(c.vocab_size, dtype=jnp.float32, name="head")(x)
 
