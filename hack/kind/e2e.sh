@@ -150,16 +150,23 @@ else
 fi
 
 # ---- elastic-quota scenario (tpuscheduler binds, denies over-max) -----
-say "quota scenario: ElasticQuota min=max=4 chips in default namespace"
+# Runs in its OWN namespace: quota accounting counts every bound
+# non-terminal pod in the namespace (quota/state.py), so the earlier
+# scenarios' sleeping pods in `default` must not be in scope.
+QNS=e2e-quota
+say "quota scenario: ElasticQuota min=max=4 chips in namespace ${QNS}"
 # The chart ships the CRDs (helm-charts/walkai-nos-tpu/crds/); this is
 # belt-and-braces for clusters where helm skipped existing CRDs.
 kubectl apply -f deploy/crds/elasticquota.yaml
+kubectl wait --for condition=established --timeout=60s \
+  crd/elasticquotas.nos.walkai.io crd/compositeelasticquotas.nos.walkai.io
+kubectl create namespace "${QNS}" --dry-run=client -o yaml | kubectl apply -f -
 kubectl apply -f - <<EOF
 apiVersion: nos.walkai.io/v1alpha1
 kind: ElasticQuota
 metadata:
   name: e2e-quota
-  namespace: default
+  namespace: ${QNS}
 spec:
   min: {nos.walkai.io/tpu-chips: "4"}
   max: {nos.walkai.io/tpu-chips: "4"}
@@ -171,7 +178,7 @@ apiVersion: v1
 kind: Pod
 metadata:
   name: e2e-quota-pod
-  namespace: default
+  namespace: ${QNS}
 spec:
   schedulerName: walkai-nos-scheduler
   restartPolicy: Never
@@ -185,10 +192,10 @@ spec:
 EOF
 
 say "waiting for the quota pod to bind (scheduler -> retile -> bind)"
-if ! kubectl wait pod/e2e-quota-pod --for=condition=PodScheduled \
-    --timeout=180s; then
+if ! kubectl -n "${QNS}" wait pod/e2e-quota-pod \
+    --for=condition=PodScheduled --timeout=180s; then
   echo "FAIL: quota pod never scheduled"
-  kubectl describe pod e2e-quota-pod | tail -20
+  kubectl -n "${QNS}" describe pod e2e-quota-pod | tail -20
   kubectl -n "${NS}" logs -l app=tpuscheduler --tail=50 || true
   exit 1
 fi
@@ -199,7 +206,7 @@ apiVersion: v1
 kind: Pod
 metadata:
   name: e2e-overquota-pod
-  namespace: default
+  namespace: ${QNS}
 spec:
   schedulerName: walkai-nos-scheduler
   restartPolicy: Never
@@ -212,14 +219,28 @@ spec:
         limits: {"walkai.io/tpu-2x2": "1"}
 EOF
 
-say "asserting the over-max pod stays pending (quota denial, not capacity)"
+say "asserting the over-max pod is QUOTA-denied (not a capacity miss)"
 sleep 20
-if [ -n "$(kubectl get pod e2e-overquota-pod \
+if [ -n "$(kubectl -n "${QNS}" get pod e2e-overquota-pod \
     -o jsonpath='{.spec.nodeName}')" ]; then
   echo "FAIL: over-quota pod was bound past the quota max"
   kubectl -n "${NS}" logs -l app=tpuscheduler --tail=50 || true
   exit 1
 fi
+# Distinguish the denial path: quota denials deliberately do NOT write
+# the Unschedulable condition (retiling can't create quota headroom,
+# cmd/tpuscheduler.py), so its presence means the capacity path ran and
+# this assertion would be vacuous.
+if kubectl -n "${QNS}" get pod e2e-overquota-pod \
+    -o jsonpath='{.status.conditions[?(@.reason=="Unschedulable")]}' \
+    | grep -q Unschedulable; then
+  echo "FAIL: over-quota pod hit the capacity path, not quota denial"
+  kubectl -n "${NS}" logs -l app=tpuscheduler --tail=50 || true
+  exit 1
+fi
+kubectl -n "${NS}" logs -l app=tpuscheduler --tail=200 \
+  | grep "quota-denied" | grep -q e2e-overquota-pod \
+  || { echo "FAIL: scheduler never logged a quota denial"; exit 1; }
 say "quota scenario PASS"
 
 say "PASS: e2e scenario complete"

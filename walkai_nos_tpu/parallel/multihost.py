@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+from dataclasses import dataclass
 from typing import Mapping, Sequence
 
 import jax
@@ -38,19 +39,13 @@ logger = logging.getLogger(__name__)
 DCN_FRIENDLY_AXES = ("pipe", "data")
 
 
+@dataclass(frozen=True)
 class DistributedConfig:
     """Resolved multi-process coordinates (pure data; no side effects)."""
 
-    def __init__(self, coordinator: str, process_id: int, num_processes: int):
-        self.coordinator = coordinator
-        self.process_id = process_id
-        self.num_processes = num_processes
-
-    def __repr__(self) -> str:  # pragma: no cover - debug aid
-        return (
-            f"DistributedConfig({self.coordinator!r}, "
-            f"{self.process_id}/{self.num_processes})"
-        )
+    coordinator: str
+    process_id: int
+    num_processes: int
 
 
 def resolve_distributed_config(
