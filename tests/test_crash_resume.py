@@ -134,3 +134,71 @@ class TestPartitionerCrashResume:
         assert {(s.mesh_index, s.profile, s.quantity) for s in spec1} == {
             (s.mesh_index, s.profile, s.quantity) for s in spec2
         }
+
+
+class TestChaosConvergence:
+    def test_randomized_crash_interleavings_converge(self):
+        """Seeded chaos sweep: interleave the partitioner/reporter/
+        actuator reconciles, kubelet re-advertising, transient native
+        failures, and agent-process restarts in random orders — then a
+        bounded settle pass must always converge spec==status with the
+        requested slice provided. The externalized-state claim, tested
+        as a property."""
+        import random
+
+        from walkai_nos_tpu.tpu.errors import TpuError
+
+        for seed in range(12):
+            rng = random.Random(seed)
+            kube = FakeKubeClient()
+            kube.create("Node", tiling_node(NODE))
+            tpudev = FailingCreateTpudev(fail_times=rng.choice([0, 1, 2]))
+            resources = FakeResourceClient()
+            ctrl = PodController(kube, plan_id_fn=lambda: "plan-chaos")
+            kube.create("Pod", pending_slice_pod("j1", "2x2"))
+
+            gen = {"agent": agent_generation(kube, tpudev, resources)}
+
+            def pod_ctrl():
+                ctrl.reconcile(Request(name="j1", namespace="default"))
+
+            def report():
+                gen["agent"][0].reconcile(Request(name=NODE))
+
+            def actuate():
+                gen["agent"][1].reconcile(Request(name=NODE))
+
+            def readvertise():
+                advertise(resources, tpudev)
+
+            def crash_restart():
+                gen["agent"] = agent_generation(kube, tpudev, resources)
+
+            actions = [pod_ctrl, report, actuate, readvertise, crash_restart]
+            for _ in range(rng.randrange(10, 40)):
+                try:
+                    rng.choice(actions)()
+                except TpuError:
+                    pass  # transient native failure, retried by requeue
+
+            # Settle: the steady-state loop a live cluster would run.
+            for _ in range(6):
+                try:
+                    report()
+                    pod_ctrl()
+                    actuate()
+                    readvertise()
+                except TpuError:
+                    continue
+            report()
+
+            status, spec = parse_node_annotations(
+                objects.annotations(kube.get("Node", NODE))
+            )
+            assert spec, f"seed {seed}: no spec written"
+            assert spec_matches_status(spec, status), (
+                f"seed {seed}: diverged: spec={spec} status={status}"
+            )
+            assert any(s.profile == "2x2" for s in spec), (
+                f"seed {seed}: requested 2x2 never planned"
+            )
