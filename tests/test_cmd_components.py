@@ -330,3 +330,19 @@ class TestMetricsExporter:
         assert m["installation_uuid"] == "u1"
         assert m["nodes"][0]["name"] == "n1"
         assert m["nodes"][0]["capacity"] == {"google.com/tpu": "8"}
+
+
+def test_pyproject_console_scripts_resolve():
+    """Every [project.scripts] entry must point at an importable
+    callable — packaging metadata can silently rot otherwise."""
+    import importlib
+    import tomllib
+    from pathlib import Path
+
+    pyproject = Path(__file__).resolve().parents[1] / "pyproject.toml"
+    scripts = tomllib.loads(pyproject.read_text())["project"]["scripts"]
+    assert len(scripts) == 6
+    for name, target in scripts.items():
+        module, _, attr = target.partition(":")
+        fn = getattr(importlib.import_module(module), attr)
+        assert callable(fn), (name, target)
