@@ -85,9 +85,7 @@ def make_generate_fn(
             nxt = _sample(logits[:, -1], temperature, sub)
             return (variables["cache"], nxt, rng), nxt
 
-        if max_new_tokens == 1:
-            return first[:, None]
-        (_, _, _), rest = jax.lax.scan(
+        _, rest = jax.lax.scan(
             step,
             (variables["cache"], first, rng),
             None,

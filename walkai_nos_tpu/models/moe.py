@@ -86,11 +86,12 @@ class MoEMlp(nn.Module):
             position = jnp.cumsum(mask, axis=0) - mask + occupancy
             mask = mask * (position < capacity)
             occupancy = occupancy + mask.sum(axis=0, keepdims=True)
-            weights.append((gates * mask).sum(axis=-1))  # [T]
+            kept = (gates * mask).sum(axis=-1)  # [T]
+            weights.append(kept)
             combine = combine + (
                 mask[:, :, None]
                 * jax.nn.one_hot(position.astype(jnp.int32), capacity)
-            ) * (gates * mask).sum(axis=-1)[:, None, None]
+            ) * kept[:, None, None]
         # Normalize the kept gate weights so routed mass sums to 1.
         denom = sum(weights)
         combine = combine / jnp.maximum(denom, 1e-9)[:, None, None]

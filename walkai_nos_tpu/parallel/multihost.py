@@ -24,8 +24,9 @@ import os
 from dataclasses import dataclass
 from typing import Mapping, Sequence
 
+import math
+
 import jax
-import numpy as np
 from jax.sharding import Mesh
 
 from walkai_nos_tpu.parallel.mesh import ALL_AXES, MeshAxes
@@ -120,31 +121,26 @@ def split_dcn_axes(
     """
     if num_hosts <= 0:
         raise ValueError(f"num_hosts must be positive, got {num_hosts}")
-    dcn = {a: 1 for a in ALL_AXES}
     ici = {
         "pipe": axes.pipe, "data": axes.data, "fsdp": axes.fsdp,
         "expert": axes.expert, "model": axes.model, "seq": axes.seq,
     }
+    dcn = {axis: 1 for axis in ici}
     remaining = num_hosts
     for axis in DCN_FRIENDLY_AXES:
         if remaining == 1:
             break
-        take = np.gcd(ici[axis], remaining)
-        dcn[axis] = int(take)
-        ici[axis] //= int(take)
-        remaining //= int(take)
+        take = math.gcd(ici[axis], remaining)
+        dcn[axis] = take
+        ici[axis] //= take
+        remaining //= take
     if remaining != 1:
         raise ValueError(
             f"cannot place {num_hosts} hosts on the DCN-friendly axes "
             f"{DCN_FRIENDLY_AXES} of {axes} — give pipe/data a degree "
             "divisible by the host count"
         )
-    return (
-        MeshAxes(**{k: dcn[k] for k in ("data", "fsdp", "model", "seq",
-                                        "expert", "pipe")}),
-        MeshAxes(**{k: ici[k] for k in ("data", "fsdp", "model", "seq",
-                                        "expert", "pipe")}),
-    )
+    return MeshAxes(**dcn), MeshAxes(**ici)
 
 
 def multihost_mesh(
