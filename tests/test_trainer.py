@@ -1,0 +1,137 @@
+"""Input pipeline and training loop: batching, prefetch, fit, resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.data import prefetch_to_device, token_batches
+from walkai_nos_tpu.models.lm import (
+    LMConfig,
+    init_lm_state,
+    make_lm_train_step,
+)
+from walkai_nos_tpu.models.trainer import fit
+from walkai_nos_tpu.parallel.mesh import MeshAxes, build_mesh
+from walkai_nos_tpu.parallel.sharding import batch_sharding
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2, max_seq_len=16
+)
+
+
+def _corpus(n=4096, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, CFG.vocab_size, n, dtype=np.int32
+    )
+
+
+class TestTokenBatches:
+    def test_shapes_and_dtype(self):
+        it = token_batches(
+            _corpus(), batch_size=4, seq_len=16, epochs=1
+        )
+        batches = list(it)
+        assert batches, "no batches yielded"
+        for b in batches:
+            assert b.shape == (4, 16) and b.dtype == np.int32
+
+    def test_deterministic_in_seed(self):
+        a = list(token_batches(
+            _corpus(), batch_size=4, seq_len=16, seed=3, epochs=1
+        ))
+        b = list(token_batches(
+            _corpus(), batch_size=4, seq_len=16, seed=3, epochs=1
+        ))
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+    def test_epoch_reshuffles(self):
+        it = token_batches(_corpus(), batch_size=4, seq_len=16, epochs=2)
+        per_epoch = (4096 // 16) // 4
+        batches = list(it)
+        assert len(batches) == 2 * per_epoch
+        assert not all(
+            np.array_equal(x, y)
+            for x, y in zip(batches[:per_epoch], batches[per_epoch:])
+        )
+
+    def test_windows_partition_the_corpus(self):
+        corpus = np.arange(256, dtype=np.int32)
+        batches = list(token_batches(
+            corpus, batch_size=2, seq_len=16, shuffle=False, epochs=1
+        ))
+        seen = np.sort(np.concatenate([b.ravel() for b in batches]))
+        assert np.array_equal(seen, corpus)
+
+    def test_too_small_corpus_rejected(self):
+        with pytest.raises(ValueError, match="at least batch_size"):
+            next(token_batches(_corpus(32), batch_size=4, seq_len=16))
+
+
+class TestPrefetch:
+    def test_prefetch_preserves_order_and_shards(self):
+        mesh = build_mesh(jax.devices(), axes=MeshAxes(data=8))
+        sharding = batch_sharding(mesh)
+        host = [
+            np.full((8, 4), i, dtype=np.int32) for i in range(5)
+        ]
+        out = list(prefetch_to_device(iter(host), sharding=sharding))
+        assert len(out) == 5
+        for i, batch in enumerate(out):
+            assert isinstance(batch, jax.Array)
+            assert batch.sharding == sharding
+            assert int(batch[0, 0]) == i
+
+    def test_bad_size_rejected(self):
+        with pytest.raises(ValueError, match="size"):
+            next(prefetch_to_device(iter([np.zeros(2)]), size=0))
+
+
+class TestFit:
+    def _pipeline(self, mesh, epochs=None):
+        return prefetch_to_device(
+            token_batches(
+                _corpus(), batch_size=8, seq_len=CFG.max_seq_len,
+                epochs=epochs,
+            ),
+            sharding=batch_sharding(mesh),
+        )
+
+    def test_loss_decreases(self):
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        result = fit(
+            state, make_lm_train_step(CFG, mesh), self._pipeline(mesh),
+            num_steps=12, log_every=4,
+        )
+        assert result.steps_run == 12
+        assert int(result.state.step) == 12
+        assert result.losses[-1] < result.losses[0]
+
+    def test_exhausted_iterator_stops_early(self):
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        result = fit(
+            state, make_lm_train_step(CFG, mesh),
+            self._pipeline(mesh, epochs=1), num_steps=10_000,
+        )
+        assert 0 < result.steps_run < 10_000
+
+    def test_checkpoint_resume_continues_counting(self, tmp_path):
+        mesh = build_mesh(jax.devices())
+        step_fn = make_lm_train_step(CFG, mesh)
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        first = fit(
+            state, step_fn, self._pipeline(mesh),
+            num_steps=5, checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        )
+        assert int(first.state.step) == 5
+
+        fresh = init_lm_state(CFG, mesh, jax.random.PRNGKey(1))
+        second = fit(
+            fresh, step_fn, self._pipeline(mesh),
+            num_steps=3, checkpoint_dir=str(tmp_path),
+        )
+        assert second.resumed_from == 5
+        assert int(second.state.step) == 8
+        assert second.steps_run == 3

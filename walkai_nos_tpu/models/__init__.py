@@ -25,3 +25,8 @@ from walkai_nos_tpu.models.lm import (  # noqa: F401
     make_lm_train_step,
 )
 from walkai_nos_tpu.models.decode import make_generate_fn  # noqa: F401
+from walkai_nos_tpu.models.data import (  # noqa: F401
+    prefetch_to_device,
+    token_batches,
+)
+from walkai_nos_tpu.models.trainer import fit  # noqa: F401

@@ -1,0 +1,90 @@
+"""Input pipeline: tokenized batches with device prefetch.
+
+Host-side batching stays NumPy (cheap, memmap-friendly for corpora
+bigger than RAM); the device boundary is a double-buffered
+`jax.device_put` prefetch so step N+1's transfer overlaps step N's
+compute — the standard TPU input idiom (device_put is async; the copy
+rides the wall-clock of the previous step's execution).
+
+No reference analogue — the reference is a control plane; this feeds
+the slice-consumer training loop (`models/trainer.py`).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+def token_batches(
+    tokens: np.ndarray,
+    *,
+    batch_size: int,
+    seq_len: int,
+    shuffle: bool = True,
+    seed: int = 0,
+    epochs: int | None = None,
+) -> Iterator[np.ndarray]:
+    """Yield [batch_size, seq_len] int32 windows from a flat token array.
+
+    Non-overlapping windows, remainder dropped; `epochs=None` cycles
+    forever with a fresh shuffle per epoch (deterministic in `seed`).
+    `tokens` may be a np.memmap — windows are copied out lazily.
+    """
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1:
+        raise ValueError(f"expected a flat token array, got {tokens.shape}")
+    n_windows = tokens.shape[0] // seq_len
+    if n_windows < batch_size:
+        raise ValueError(
+            f"{tokens.shape[0]} tokens yield {n_windows} windows of "
+            f"{seq_len}; need at least batch_size={batch_size}"
+        )
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = (
+            rng.permutation(n_windows) if shuffle else np.arange(n_windows)
+        )
+        for start in range(0, n_windows - batch_size + 1, batch_size):
+            idx = order[start : start + batch_size]
+            batch = np.stack(
+                [tokens[i * seq_len : (i + 1) * seq_len] for i in idx]
+            )
+            yield batch.astype(np.int32)
+        epoch += 1
+
+
+def prefetch_to_device(
+    iterator: Iterator,
+    *,
+    sharding=None,
+    size: int = 2,
+) -> Iterator[jax.Array]:
+    """Double-buffered device transfer: keep `size` batches in flight.
+
+    `device_put` is asynchronous — enqueueing the next transfer before
+    yielding the current batch overlaps H2D copies with compute. With
+    `sharding` (e.g. `batch_sharding(mesh)`) each batch lands already
+    distributed across the mesh.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    buffer: deque = deque()
+
+    def put(batch):
+        return (
+            jax.device_put(batch, sharding)
+            if sharding is not None
+            else jax.device_put(batch)
+        )
+
+    for batch in iterator:
+        buffer.append(put(batch))
+        if len(buffer) >= size:
+            yield buffer.popleft()
+    while buffer:
+        yield buffer.popleft()
