@@ -63,9 +63,10 @@ class TestTokenBatches:
         seen = np.sort(np.concatenate([b.ravel() for b in batches]))
         assert np.array_equal(seen, corpus)
 
-    def test_too_small_corpus_rejected(self):
+    def test_too_small_corpus_rejected_eagerly(self):
+        # At the call site, not deferred to the first next().
         with pytest.raises(ValueError, match="at least batch_size"):
-            next(token_batches(_corpus(32), batch_size=4, seq_len=16))
+            token_batches(_corpus(32), batch_size=4, seq_len=16)
 
 
 class TestPrefetch:
@@ -82,9 +83,9 @@ class TestPrefetch:
             assert batch.sharding == sharding
             assert int(batch[0, 0]) == i
 
-    def test_bad_size_rejected(self):
+    def test_bad_size_rejected_eagerly(self):
         with pytest.raises(ValueError, match="size"):
-            next(prefetch_to_device(iter([np.zeros(2)]), size=0))
+            prefetch_to_device(iter([np.zeros(2)]), size=0)
 
 
 class TestFit:
@@ -116,6 +117,26 @@ class TestFit:
             self._pipeline(mesh, epochs=1), num_steps=10_000,
         )
         assert 0 < result.steps_run < 10_000
+
+    def test_final_save_on_interval_boundary(self, tmp_path):
+        """num_steps a multiple of checkpoint_every: the interval save
+        already wrote the final step — the forced final save must not
+        crash with orbax StepAlreadyExists."""
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        result = fit(
+            state, make_lm_train_step(CFG, mesh), self._pipeline(mesh),
+            num_steps=4, checkpoint_dir=str(tmp_path), checkpoint_every=2,
+        )
+        assert int(result.state.step) == 4
+
+        # Resumed run that makes zero steps: same guard applies.
+        fresh = init_lm_state(CFG, mesh, jax.random.PRNGKey(1))
+        second = fit(
+            fresh, make_lm_train_step(CFG, mesh), iter(()),
+            num_steps=5, checkpoint_dir=str(tmp_path),
+        )
+        assert second.resumed_from == 4 and second.steps_run == 0
 
     def test_checkpoint_resume_continues_counting(self, tmp_path):
         mesh = build_mesh(jax.devices())

@@ -33,6 +33,7 @@ def token_batches(
     Non-overlapping windows, remainder dropped; `epochs=None` cycles
     forever with a fresh shuffle per epoch (deterministic in `seed`).
     `tokens` may be a np.memmap — windows are copied out lazily.
+    Argument errors raise here, at the call site (not at first next()).
     """
     tokens = np.asarray(tokens)
     if tokens.ndim != 1:
@@ -43,19 +44,24 @@ def token_batches(
             f"{tokens.shape[0]} tokens yield {n_windows} windows of "
             f"{seq_len}; need at least batch_size={batch_size}"
         )
-    rng = np.random.default_rng(seed)
-    epoch = 0
-    while epochs is None or epoch < epochs:
-        order = (
-            rng.permutation(n_windows) if shuffle else np.arange(n_windows)
-        )
-        for start in range(0, n_windows - batch_size + 1, batch_size):
-            idx = order[start : start + batch_size]
-            batch = np.stack(
-                [tokens[i * seq_len : (i + 1) * seq_len] for i in idx]
+
+    def generate() -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(seed)
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            order = (
+                rng.permutation(n_windows) if shuffle
+                else np.arange(n_windows)
             )
-            yield batch.astype(np.int32)
-        epoch += 1
+            for start in range(0, n_windows - batch_size + 1, batch_size):
+                idx = order[start : start + batch_size]
+                batch = np.stack(
+                    [tokens[i * seq_len : (i + 1) * seq_len] for i in idx]
+                )
+                yield batch.astype(np.int32)
+            epoch += 1
+
+    return generate()
 
 
 def prefetch_to_device(
@@ -69,11 +75,11 @@ def prefetch_to_device(
     `device_put` is asynchronous — enqueueing the next transfer before
     yielding the current batch overlaps H2D copies with compute. With
     `sharding` (e.g. `batch_sharding(mesh)`) each batch lands already
-    distributed across the mesh.
+    distributed across the mesh. Argument errors raise here, at the
+    call site (not at first next()).
     """
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
-    buffer: deque = deque()
 
     def put(batch):
         return (
@@ -82,9 +88,13 @@ def prefetch_to_device(
             else jax.device_put(batch)
         )
 
-    for batch in iterator:
-        buffer.append(put(batch))
-        if len(buffer) >= size:
+    def generate() -> Iterator[jax.Array]:
+        buffer: deque = deque()
+        for batch in iterator:
+            buffer.append(put(batch))
+            if len(buffer) >= size:
+                yield buffer.popleft()
+        while buffer:
             yield buffer.popleft()
-    while buffer:
-        yield buffer.popleft()
+
+    return generate()

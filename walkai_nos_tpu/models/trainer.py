@@ -92,6 +92,10 @@ def fit(
             result.losses.append(float(jax.device_get(loss)))
     finally:
         if manager:
-            manager.save(result.state, force=True, wait=True)
+            # Skip when the interval save (or the restore source) already
+            # wrote this exact step — orbax raises StepAlreadyExists
+            # otherwise, crashing a successful run from the finally.
+            if manager.latest_step() != int(result.state.step):
+                manager.save(result.state, force=True, wait=True)
             manager.close()
     return result
