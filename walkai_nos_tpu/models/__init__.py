@@ -30,3 +30,4 @@ from walkai_nos_tpu.models.data import (  # noqa: F401
     token_batches,
 )
 from walkai_nos_tpu.models.trainer import fit  # noqa: F401
+from walkai_nos_tpu.models.hf import load_gpt2  # noqa: F401

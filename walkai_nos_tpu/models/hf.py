@@ -1,0 +1,106 @@
+"""HuggingFace GPT-2 checkpoint import for the decoder LM.
+
+Maps a `transformers` GPT-2-family model (torch, CPU) onto
+`DecoderLM`'s parameter tree so existing checkpoints serve/fine-tune on
+TPU slices through this framework — the interop a user switching from
+the torch ecosystem expects. The architectures correspond exactly:
+pre-LN blocks, learned positions, fused qkv (HF Conv1D stores kernels
+[in, out], same orientation as flax Dense), gelu_new == flax's default
+tanh-approximated gelu, and a weight-tied LM head (wte^T).
+
+No reference analogue — compute-runtime interop, per the TPU mandate.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from walkai_nos_tpu.models.lm import LMConfig
+
+
+def config_from_gpt2(hf_config) -> LMConfig:
+    """LMConfig mirroring a `transformers.GPT2Config`."""
+    if getattr(hf_config, "activation_function", "gelu_new") != "gelu_new":
+        raise ValueError(
+            "only gelu_new GPT-2 variants map onto DecoderLM's gelu "
+            f"(got {hf_config.activation_function})"
+        )
+    return LMConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_dim=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        mlp_ratio=(getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd)
+        // hf_config.n_embd,
+        max_seq_len=hf_config.n_positions,
+        dtype="float32",
+        layer_norm_eps=hf_config.layer_norm_epsilon,
+    )
+
+
+def _np(tensor) -> np.ndarray:
+    return np.asarray(tensor.detach().cpu().numpy(), dtype=np.float32)
+
+
+def params_from_gpt2(state_dict: Mapping, cfg: LMConfig) -> dict:
+    """DecoderLM params pytree from a GPT2LMHeadModel state_dict."""
+    sd = {
+        k.removeprefix("transformer."): v for k, v in state_dict.items()
+    }
+
+    def ln(prefix: str) -> dict:
+        return {
+            "scale": jnp.asarray(_np(sd[f"{prefix}.weight"])),
+            "bias": jnp.asarray(_np(sd[f"{prefix}.bias"])),
+        }
+
+    def dense(prefix: str) -> dict:
+        # HF Conv1D kernels are [in_features, out_features] — the same
+        # orientation as flax Dense; no transpose.
+        return {
+            "kernel": jnp.asarray(_np(sd[f"{prefix}.weight"])),
+            "bias": jnp.asarray(_np(sd[f"{prefix}.bias"])),
+        }
+
+    wte = _np(sd["wte.weight"])  # [vocab, hidden]
+    params: dict = {
+        "embed": {"embedding": jnp.asarray(wte)},
+        "pos_embed": jnp.asarray(_np(sd["wpe.weight"]))[None],
+        "norm": ln("ln_f"),
+        # GPT-2 ties the LM head to the token embedding.
+        "head": {
+            "kernel": jnp.asarray(wte.T),
+            "bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+        },
+    }
+    for i in range(cfg.num_layers):
+        h = f"h.{i}"
+        params[f"block{i}"] = {
+            "norm1": ln(f"{h}.ln_1"),
+            "attn": {
+                "qkv": dense(f"{h}.attn.c_attn"),
+                "out_proj": dense(f"{h}.attn.c_proj"),
+            },
+            "norm2": ln(f"{h}.ln_2"),
+            "fc1": dense(f"{h}.mlp.c_fc"),
+            "fc2": dense(f"{h}.mlp.c_proj"),
+        }
+    return params
+
+
+def load_gpt2(model_or_name) -> tuple[LMConfig, dict]:
+    """(LMConfig, params) from a GPT2LMHeadModel instance or model name.
+
+    Pass an instantiated `transformers.GPT2LMHeadModel` (weights already
+    local) or a model name for `from_pretrained` (needs the weights on
+    disk or network access).
+    """
+    if isinstance(model_or_name, str):
+        from transformers import GPT2LMHeadModel
+
+        model_or_name = GPT2LMHeadModel.from_pretrained(model_or_name)
+    cfg = config_from_gpt2(model_or_name.config)
+    return cfg, params_from_gpt2(model_or_name.state_dict(), cfg)

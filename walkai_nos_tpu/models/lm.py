@@ -44,6 +44,9 @@ class LMConfig:
     moe_every: int = 2
     expert_top_k: int = 2
     capacity_factor: float = 1.25
+    # LayerNorm epsilon — 1e-6 (flax default); HF GPT-2 checkpoints
+    # use 1e-5 (models/hf.py sets this when importing weights).
+    layer_norm_eps: float = 1e-6
     # Rematerialization: recompute each block's activations in the
     # backward pass instead of storing them (jax.checkpoint) — the
     # standard HBM-for-FLOPs trade that lets long sequences / deep
@@ -136,9 +139,14 @@ class DecoderBlock(nn.Module):
     def __call__(self, x, *, decode: bool = False):
         c = self.cfg
         x = x + CausalAttention(c, self.mesh, name="attn")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x), decode=decode
+            nn.LayerNorm(
+                epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm1"
+            )(x),
+            decode=decode,
         )
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
+        h = nn.LayerNorm(
+            epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm2"
+        )(x)
         if self.use_moe:
             from walkai_nos_tpu.models.moe import MoEMlp
 
@@ -203,7 +211,9 @@ class DecoderLM(nn.Module):
             use_moe = c.num_experts > 0 and (i + 1) % c.moe_every == 0
             block = block_cls(c, self.mesh, use_moe, name=f"block{i}")
             x = block(x) if use_remat else block(x, decode=decode)
-        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
+        x = nn.LayerNorm(
+            epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm"
+        )(x)
         return nn.Dense(c.vocab_size, dtype=jnp.float32, name="head")(x)
 
     def init_params(self, rng: jax.Array):
