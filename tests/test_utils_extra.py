@@ -153,3 +153,48 @@ class TestCheckpoint:
         np.testing.assert_allclose(
             float(loss_resumed), float(loss_orig), rtol=1e-5
         )
+
+    def test_elastic_restore_across_mesh_shapes(self, tmp_path):
+        """Save on one mesh layout, resume on another — the re-tiled
+        slice scenario this control plane creates: a pod trained on a
+        2x4 slice gets rescheduled onto a 2x2-equivalent layout. The
+        checkpoint must land on the new mesh's shardings bit-identical."""
+        from walkai_nos_tpu.models.checkpoint import CheckpointManager
+        from walkai_nos_tpu.models.lm import (
+            LMConfig,
+            init_lm_state,
+            make_lm_train_step,
+        )
+        from walkai_nos_tpu.parallel.mesh import MeshAxes, build_mesh
+
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+            max_seq_len=16,
+        )
+        tokens = jax.numpy.asarray(
+            np.random.default_rng(0).integers(0, 64, (8, 16))
+        )
+
+        mesh_a = build_mesh(jax.devices(), axes=MeshAxes(data=2, model=4))
+        state = init_lm_state(cfg, mesh_a, jax.random.PRNGKey(0))
+        state, _ = make_lm_train_step(cfg, mesh_a)(state, tokens)
+        manager = CheckpointManager(tmp_path / "ckpt")
+        manager.save(state, force=True, wait=True)
+        manager.close()
+
+        mesh_b = build_mesh(jax.devices(), axes=MeshAxes(data=4, model=2))
+        template = init_lm_state(cfg, mesh_b, jax.random.PRNGKey(1))
+        manager_b = CheckpointManager(tmp_path / "ckpt")
+        restored = manager_b.restore(template)
+        manager_b.close()
+        assert restored is not None and int(restored.step) == 1
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state.params),
+            jax.tree_util.tree_leaves(restored.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # The restored params carry mesh_b shardings and keep training.
+        qkv = restored.params["block0"]["attn"]["qkv"]["kernel"]
+        assert qkv.sharding.mesh.shape["model"] == 2
+        _, loss = make_lm_train_step(cfg, mesh_b)(restored, tokens)
+        assert bool(jax.numpy.isfinite(loss))
