@@ -156,3 +156,19 @@ class TestFit:
         assert second.resumed_from == 5
         assert int(second.state.step) == 8
         assert second.steps_run == 3
+
+
+class TestProfiling:
+    def test_profile_window_produces_a_trace(self, tmp_path):
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        result = fit(
+            state, make_lm_train_step(CFG, mesh), self._pipeline(mesh),
+            num_steps=8, profile_dir=str(tmp_path / "trace"),
+            profile_steps=(2, 4),
+        )
+        assert result.steps_run == 8
+        produced = list((tmp_path / "trace").rglob("*"))
+        assert any(p.is_file() for p in produced), produced
+
+    _pipeline = TestFit._pipeline

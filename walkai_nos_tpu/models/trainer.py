@@ -42,6 +42,8 @@ def fit(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 100,
     log_every: int = 10,
+    profile_dir: str | None = None,
+    profile_steps: tuple[int, int] = (3, 6),
 ) -> FitResult:
     """Run `num_steps` optimizer steps (counted from state.step).
 
@@ -49,6 +51,10 @@ def fit(
     shardings before training and saves every `checkpoint_every` steps
     plus a final synchronous save. Loss is only synced to host on the
     logging interval — fetching it every step would serialize dispatch.
+
+    With `profile_dir`, captures an XLA/TPU profiler trace (viewable in
+    TensorBoard/Perfetto) over `profile_steps` — a [start, stop) window
+    of THIS RUN's step ordinals, past the compile-laden first steps.
     """
     manager = resumed = None
     if checkpoint_dir is not None:
@@ -63,6 +69,7 @@ def fit(
     target = int(state.step) + num_steps
     t0 = time.monotonic()
     loss = None
+    profiling = False
     try:
         while int(result.state.step) < target:
             try:
@@ -70,6 +77,14 @@ def fit(
             except StopIteration:
                 logger.info("data iterator exhausted; stopping early")
                 break
+            if profile_dir is not None:
+                if result.steps_run == profile_steps[0] and not profiling:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                elif result.steps_run == profile_steps[1] and profiling:
+                    jax.block_until_ready(loss)  # close the traced window
+                    jax.profiler.stop_trace()
+                    profiling = False
             result.state, loss = step_fn(result.state, batch)
             result.steps_run += 1
             step = int(result.state.step)
@@ -91,6 +106,8 @@ def fit(
         ):
             result.losses.append(float(jax.device_get(loss)))
     finally:
+        if profiling:
+            jax.profiler.stop_trace()
         if manager:
             # Skip when the interval save (or the restore source) already
             # wrote this exact step — orbax raises StepAlreadyExists
