@@ -1,0 +1,81 @@
+"""Supplementary LM benchmark: KV-cache decode throughput on one chip.
+
+Measures autoregressive generation (`models/decode.py`) for the
+decoder LM: one jitted program (prefill + lax.scan over steps) with a
+single fenced output, so the number reflects the chip, not dispatch
+plumbing. NOT the headline benchmark — `bench.py` owns the north-star
+serving/scheduling metrics the driver records.
+
+Training throughput is intentionally not measured here: on the
+tunneled dev runtime each output buffer crossing a dispatch boundary
+pays a ~20 ms round trip (fencing a ~150-leaf grad pytree costs ~3 s
+while the loss scalar is ready in ~200 ms), so a train-step timing
+would measure the tunnel, not the TPU. On a TPU VM's local runtime
+that overhead does not exist; `fit`'s profiler window
+(`models/trainer.py`) is the tool for measuring it there.
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def _fence(x) -> None:
+    """True completion: fetch one scalar (block_until_ready is not a
+    completion guarantee on remote/tunneled backends — same idiom as the
+    demo server's _fence)."""
+    np.asarray(jax.numpy.ravel(x)[0])
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from walkai_nos_tpu.models.decode import make_generate_fn
+    from walkai_nos_tpu.models.lm import LMConfig, DecoderLM
+
+    device = jax.devices()[0]
+    cfg = LMConfig(
+        vocab_size=32000, hidden_dim=512, num_layers=8, num_heads=8,
+        max_seq_len=1024, dtype="bfloat16",
+    )
+    batch, prompt_len, new_tokens = 8, 32, 128
+    model = DecoderLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    n_params = sum(
+        int(np.prod(p.shape))
+        for p in jax.tree_util.tree_leaves(params)
+    )
+
+    gen = make_generate_fn(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt_len)))
+    out = gen(params, prompt, max_new_tokens=new_tokens)  # compile
+    _fence(out)
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = gen(params, prompt, max_new_tokens=new_tokens)
+        _fence(out)
+    decode_s = (time.perf_counter() - t0) / reps
+
+    print(json.dumps({
+        "metric": "lm_decode_tokens_per_s",
+        "value": round(batch * new_tokens / decode_s, 1),
+        "unit": "tokens/s",
+        "device_kind": device.device_kind,
+        "decode_step_ms": round(decode_s / new_tokens * 1e3, 3),
+        "decode_batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "n_params": n_params,
+    }))
+
+
+if __name__ == "__main__":
+    main()

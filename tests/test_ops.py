@@ -67,3 +67,37 @@ def test_ring_attention_matches_reference(causal):
     out = ring_attention(q, k, v, mesh, causal=causal)
     ref = attn.attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+class TestFlashAttentionGrad:
+    def test_grad_matches_reference_in_interpret_mode(self):
+        """The custom VJP (pallas forward, XLA-reference backward) must
+        produce the reference's exact gradients — pallas kernels are not
+        auto-differentiable, so training correctness rides on this."""
+        rng = np.random.default_rng(5)
+        q, k, v = (
+            jnp.asarray(
+                rng.standard_normal((1, 2, 32, 16)), jnp.float32
+            )
+            for _ in range(3)
+        )
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                attn.flash_attention(
+                    q, k, v, causal=True, block_q=8, block_k=8,
+                    interpret=True,
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                attn.attention_reference(q, k, v, causal=True) ** 2
+            )
+
+        grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        grads_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr in zip(grads_flash, grads_ref):
+            assert jnp.allclose(gf, gr, atol=1e-4), (
+                float(jnp.max(jnp.abs(gf - gr)))
+            )

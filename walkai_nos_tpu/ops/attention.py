@@ -99,6 +99,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_pallas(q, k, v, causal, block_q, block_k, interpret):
+    """Differentiable wrapper: fused Pallas forward, XLA-reference
+    backward. Pallas kernels aren't auto-differentiable (grad tracing
+    dies in the grid context), and the standard move is a custom VJP —
+    the backward recomputes attention with plain einsums, so it
+    materializes the S x S matrix; training at sequence lengths where
+    that matters belongs on the ring-attention path, which is pure XLA
+    and differentiates natively."""
+    return _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_pallas_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda a, b, c: attention_reference(a, b, c, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
 )
@@ -150,6 +178,12 @@ def flash_attention(
         )
         return attention_reference(q, k, v, causal=causal)
 
+    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
