@@ -178,3 +178,24 @@ class TestMoEProperties:
             assert float(jnp.max(jnp.abs(g))) > 0.0, path
         g_router = grads["router"]["kernel"]
         assert float(jnp.max(jnp.abs(g_router))) > 0.0
+
+    def test_moe_composes_with_fsdp(self):
+        """expert=2 x fsdp=2 x data=2: expert stacks shard over both the
+        expert axis and (within each expert) the fsdp/model split."""
+        cfg = LMConfig(
+            vocab_size=128, hidden_dim=64, num_layers=2, num_heads=4,
+            max_seq_len=32, num_experts=2, moe_every=2,
+        )
+        mesh = build_mesh(
+            jax.devices(), axes=MeshAxes(data=2, fsdp=2, expert=2)
+        )
+        state = init_lm_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_lm_train_step(cfg, mesh)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 32))
+        )
+        state, loss0 = step(state, tokens)
+        state, loss1 = step(state, tokens)
+        assert float(loss1) < float(loss0)
+        up = state.params["block1"]["moe"]["experts_up"]
+        assert up.sharding.spec[0] == "expert"
