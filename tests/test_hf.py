@@ -74,3 +74,11 @@ class TestLogitParity:
             )
         )
         assert np.array_equal(ours, expected), (ours, expected)
+
+
+class TestConfigGuards:
+    def test_indivisible_n_inner_rejected(self):
+        hf = _hf_model()
+        hf.config.n_inner = 100  # not a multiple of n_embd=32
+        with pytest.raises(ValueError, match="multiple of n_embd"):
+            config_from_gpt2(hf.config)

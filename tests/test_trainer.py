@@ -172,3 +172,26 @@ class TestProfiling:
         assert any(p.is_file() for p in produced), produced
 
     _pipeline = TestFit._pipeline
+
+    def test_degenerate_window_rejected(self, tmp_path):
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="stop > start"):
+            fit(
+                state, make_lm_train_step(CFG, mesh),
+                self._pipeline(mesh), num_steps=4,
+                profile_dir=str(tmp_path), profile_steps=(3, 3),
+            )
+
+    def test_window_past_end_still_closes(self, tmp_path):
+        """Stop ordinal beyond the run: the finally block fences and
+        closes the trace instead of leaving the profiler dangling."""
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        result = fit(
+            state, make_lm_train_step(CFG, mesh), self._pipeline(mesh),
+            num_steps=3, profile_dir=str(tmp_path / "t"),
+            profile_steps=(1, 99),
+        )
+        assert result.steps_run == 3
+        assert any(p.is_file() for p in (tmp_path / "t").rglob("*"))

@@ -28,13 +28,19 @@ def config_from_gpt2(hf_config) -> LMConfig:
             "only gelu_new GPT-2 variants map onto DecoderLM's gelu "
             f"(got {hf_config.activation_function})"
         )
+    n_inner = getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd
+    if n_inner % hf_config.n_embd != 0:
+        raise ValueError(
+            f"n_inner {n_inner} is not a multiple of n_embd "
+            f"{hf_config.n_embd}; DecoderLM expresses the MLP width as "
+            "an integer mlp_ratio"
+        )
     return LMConfig(
         vocab_size=hf_config.vocab_size,
         hidden_dim=hf_config.n_embd,
         num_layers=hf_config.n_layer,
         num_heads=hf_config.n_head,
-        mlp_ratio=(getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd)
-        // hf_config.n_embd,
+        mlp_ratio=n_inner // hf_config.n_embd,
         max_seq_len=hf_config.n_positions,
         dtype="float32",
         layer_norm_eps=hf_config.layer_norm_epsilon,

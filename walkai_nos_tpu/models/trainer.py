@@ -56,6 +56,11 @@ def fit(
     TensorBoard/Perfetto) over `profile_steps` — a [start, stop) window
     of THIS RUN's step ordinals, past the compile-laden first steps.
     """
+    if profile_dir is not None and profile_steps[1] <= profile_steps[0]:
+        raise ValueError(
+            f"profile_steps must be a [start, stop) window with "
+            f"stop > start, got {profile_steps}"
+        )
     manager = resumed = None
     if checkpoint_dir is not None:
         manager = CheckpointManager(checkpoint_dir)
@@ -81,7 +86,7 @@ def fit(
                 if result.steps_run == profile_steps[0] and not profiling:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
-                elif result.steps_run == profile_steps[1] and profiling:
+                elif result.steps_run >= profile_steps[1] and profiling:
                     jax.block_until_ready(loss)  # close the traced window
                     jax.profiler.stop_trace()
                     profiling = False
@@ -107,7 +112,15 @@ def fit(
             result.losses.append(float(jax.device_get(loss)))
     finally:
         if profiling:
+            # Run ended inside the window (iterator exhausted or error):
+            # fence what we have and close the trace properly.
+            if loss is not None:
+                jax.block_until_ready(loss)
             jax.profiler.stop_trace()
+            logger.warning(
+                "profiler window %s closed early at step %d",
+                profile_steps, result.steps_run,
+            )
         if manager:
             # Skip when the interval save (or the restore source) already
             # wrote this exact step — orbax raises StepAlreadyExists
