@@ -1,0 +1,178 @@
+"""Shared watch multiplexer: one upstream stream, informer semantics."""
+
+import threading
+import time
+
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.kube.sharedwatch import SharedWatchClient
+
+
+class CountingClient(FakeKubeClient):
+    """Fake that counts watch() streams opened per kind."""
+
+    def __init__(self):
+        super().__init__()
+        self.watch_opens: dict[str, int] = {}
+
+    def watch(self, kind, namespace=None, stop=None):
+        self.watch_opens[kind] = self.watch_opens.get(kind, 0) + 1
+        return super().watch(kind, namespace, stop)
+
+
+def _collect(shared, kind, out, stop_flag, started):
+    it = shared.watch(kind, stop=lambda: stop_flag.is_set())
+    started.set()
+    for event in it:
+        out.append(event)
+
+
+def _eventually(fn, timeout=10.0, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out: {msg}")
+
+
+class TestSharedWatch:
+    def test_two_subscribers_one_upstream_stream(self):
+        upstream = CountingClient()
+        upstream.create("Pod", {"metadata": {"name": "a", "namespace": "d"}}, "d")
+        shared = SharedWatchClient(upstream)
+        stop = threading.Event()
+        outs: list[list] = [[], []]
+        threads = []
+        try:
+            for i in range(2):
+                started = threading.Event()
+                t = threading.Thread(
+                    target=_collect,
+                    args=(shared, "Pod", outs[i], stop, started),
+                    daemon=True,
+                )
+                t.start()
+                threads.append(t)
+                started.wait(5)
+
+            _eventually(
+                lambda: all(
+                    any(e == "ADDED" for e, _ in out) for out in outs
+                ),
+                msg="both subscribers saw the existing pod",
+            )
+            upstream.create(
+                "Pod", {"metadata": {"name": "b", "namespace": "d"}}, "d"
+            )
+            _eventually(
+                lambda: all(
+                    any(
+                        e == "ADDED"
+                        and o.get("metadata", {}).get("name") == "b"
+                        for e, o in out
+                    )
+                    for out in outs
+                ),
+                msg="both subscribers saw the live event",
+            )
+            assert upstream.watch_opens.get("Pod") == 1
+        finally:
+            stop.set()
+            shared.close()
+            for t in threads:
+                t.join(timeout=5)
+
+    def test_late_subscriber_replays_cache(self):
+        upstream = CountingClient()
+        upstream.create("Pod", {"metadata": {"name": "a", "namespace": "d"}}, "d")
+        shared = SharedWatchClient(upstream)
+        stop = threading.Event()
+        first: list = []
+        started = threading.Event()
+        t1 = threading.Thread(
+            target=_collect, args=(shared, "Pod", first, stop, started),
+            daemon=True,
+        )
+        t1.start()
+        started.wait(5)
+        try:
+            _eventually(
+                lambda: any(e == "SYNCED" for e, _ in first),
+                msg="first subscriber synced",
+            )
+            upstream.create(
+                "Pod", {"metadata": {"name": "b", "namespace": "d"}}, "d"
+            )
+            _eventually(
+                lambda: sum(1 for e, _ in first if e == "ADDED") >= 2,
+                msg="cache holds both pods",
+            )
+            # Late joiner: must see both pods from the replay cache,
+            # not a second upstream watch.
+            late: list = []
+            started2 = threading.Event()
+            t2 = threading.Thread(
+                target=_collect, args=(shared, "Pod", late, stop, started2),
+                daemon=True,
+            )
+            t2.start()
+            started2.wait(5)
+            _eventually(
+                lambda: sum(1 for e, _ in late if e == "ADDED") >= 2
+                and any(e == "SYNCED" for e, _ in late),
+                msg="late subscriber replayed both pods + SYNCED",
+            )
+            assert upstream.watch_opens.get("Pod") == 1
+            t2_ = t2
+        finally:
+            stop.set()
+            shared.close()
+            t1.join(timeout=5)
+            t2_.join(timeout=5)
+
+    def test_deletion_drops_from_replay(self):
+        upstream = CountingClient()
+        upstream.create("Pod", {"metadata": {"name": "a", "namespace": "d"}}, "d")
+        shared = SharedWatchClient(upstream)
+        stop = threading.Event()
+        first: list = []
+        started = threading.Event()
+        t1 = threading.Thread(
+            target=_collect, args=(shared, "Pod", first, stop, started),
+            daemon=True,
+        )
+        t1.start()
+        started.wait(5)
+        try:
+            _eventually(
+                lambda: any(e == "ADDED" for e, _ in first),
+                msg="subscriber saw pod",
+            )
+            upstream.delete("Pod", "a", "d")
+            _eventually(
+                lambda: any(e == "DELETED" for e, _ in first),
+                msg="subscriber saw deletion",
+            )
+            late: list = []
+            started2 = threading.Event()
+            t2 = threading.Thread(
+                target=_collect, args=(shared, "Pod", late, stop, started2),
+                daemon=True,
+            )
+            t2.start()
+            started2.wait(5)
+            time.sleep(0.3)
+            assert not any(e == "ADDED" for e, _ in late), late
+        finally:
+            stop.set()
+            shared.close()
+            t1.join(timeout=5)
+            t2.join(timeout=5)
+
+    def test_crud_delegates(self):
+        shared = SharedWatchClient(FakeKubeClient())
+        shared.create("Node", {"metadata": {"name": "n1"}})
+        assert shared.get("Node", "n1")["metadata"]["name"] == "n1"
+        assert len(shared.list("Node")) == 1
+        shared.delete("Node", "n1")
+        assert shared.list("Node") == []

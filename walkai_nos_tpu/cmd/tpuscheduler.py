@@ -177,6 +177,12 @@ class Scheduler:
 
 
 def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Manager:
+    from walkai_nos_tpu.kube.sharedwatch import SharedWatchClient
+
+    # Two controllers watch Pods (scheduler + capacity labeler); the
+    # shared-watch decorator gives them one upstream stream per kind,
+    # the informer property controller-runtime's manager provides.
+    kube = SharedWatchClient(kube)
     manager = Manager()
     manager.add(
         Controller(

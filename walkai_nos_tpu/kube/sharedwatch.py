@@ -1,0 +1,193 @@
+"""Shared watch streams: one upstream watch per kind, many consumers.
+
+The reference's controller-runtime manager backs every controller with
+a shared informer cache — one API-server watch per kind regardless of
+how many controllers consume it. Our `Controller` opens its own watch,
+which is fine for single-watch binaries but duplicates streams where
+one process runs several controllers over the same kind (the scheduler
+binary watches Pods for scheduling AND capacity labeling). This
+decorator restores the informer property: the first `watch(kind, ns)`
+starts one upstream stream + a pump thread; later subscribers replay
+the current cache as synthetic ADDED…SYNCED and then ride the same
+stream. Everything else delegates to the wrapped client.
+
+Reference: controller-runtime's shared cache
+(`cmd/gpupartitioner/gpupartitioner.go:49` builds every controller on
+one manager; SURVEY.md §2.12).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Iterator, Mapping
+
+from walkai_nos_tpu.kube.client import RESYNC, SYNCED, KubeClient, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+_SENTINEL = object()
+
+
+class _Stream:
+    """One upstream watch for a (kind, namespace) key."""
+
+    def __init__(self, client: KubeClient, kind: str, namespace):
+        self._client = client
+        self._kind = kind
+        self._namespace = namespace
+        self._lock = threading.Lock()
+        self._cache: dict[tuple[str, str], dict] = {}
+        self._resync_seen: set = set()
+        self._subscribers: list[queue.SimpleQueue] = []
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._pump, name=f"sharedwatch-{kind}", daemon=True
+        )
+        self._started = False
+
+    # ------------------------------------------------------------- upstream
+
+    def _pump(self) -> None:
+        try:
+            for event, obj in self._client.watch(
+                self._kind, self._namespace, stop=lambda: self._stopped
+            ):
+                with self._lock:
+                    self._apply(event, obj)
+                    targets = list(self._subscribers)
+                for q in targets:
+                    q.put((event, obj))
+        except Exception:
+            logger.exception(
+                "shared watch for %s died; subscribers unblocked",
+                self._kind,
+            )
+        finally:
+            with self._lock:
+                self._stopped = True
+                targets = list(self._subscribers)
+            for q in targets:
+                q.put(_SENTINEL)
+
+    def _apply(self, event: str, obj: dict) -> None:
+        """Mirror the upstream protocol into the replay cache. During a
+        RESYNC replay the stream re-mentions every survivor, so drop the
+        cache at RESYNC and rebuild from the replay (same semantics
+        Controller applies to its own cache)."""
+        if event == RESYNC:
+            self._resync_seen = set(self._cache)
+            return
+        if event == SYNCED:
+            for key in self._resync_seen:
+                self._cache.pop(key, None)
+            self._resync_seen = set()
+            return
+        meta = obj.get("metadata", {})
+        key = (meta.get("namespace", ""), meta.get("name", ""))
+        if event == "DELETED":
+            self._cache.pop(key, None)
+        else:
+            self._cache[key] = obj
+            self._resync_seen.discard(key)
+
+    # ----------------------------------------------------------- subscribers
+
+    def subscribe(
+        self, stop: Callable[[], bool]
+    ) -> Iterator[WatchEvent]:
+        q: queue.SimpleQueue = queue.SimpleQueue()
+        with self._lock:
+            if not self._started:
+                self._started = True
+                self._thread.start()
+            snapshot = list(self._cache.values())
+            dead = self._stopped
+            if not dead:
+                self._subscribers.append(q)
+        try:
+            # Late joiners see the informer's state as the standard
+            # initial ADDED…SYNCED framing; for the first subscriber the
+            # snapshot is empty and the upstream's own framing follows.
+            for obj in snapshot:
+                yield ("ADDED", obj)
+            if snapshot:
+                yield (SYNCED, {})
+            if dead:
+                return
+            while not stop():
+                try:
+                    item = q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item is _SENTINEL:
+                    return
+                yield item
+        finally:
+            with self._lock:
+                if q in self._subscribers:
+                    self._subscribers.remove(q)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+
+class SharedWatchClient(KubeClient):
+    """KubeClient decorator multiplexing watches per (kind, namespace)."""
+
+    def __init__(self, client: KubeClient):
+        self._client = client
+        self._streams: dict[tuple[str, str | None], _Stream] = {}
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------------- watch
+
+    def watch(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        stop: Callable[[], bool] | None = None,
+    ) -> Iterator[WatchEvent]:
+        stop = stop or (lambda: False)
+        key = (kind, namespace)
+        with self._lock:
+            stream = self._streams.get(key)
+            if stream is None or stream._stopped:
+                stream = _Stream(self._client, kind, namespace)
+                self._streams[key] = stream
+        return stream.subscribe(stop)
+
+    def close(self) -> None:
+        with self._lock:
+            for stream in self._streams.values():
+                stream.stop()
+
+    # ------------------------------------------------------------ delegates
+
+    def get(self, kind, name, namespace=None):
+        return self._client.get(kind, name, namespace)
+
+    def list(self, kind, namespace=None, label_selector=None,
+             field_selector=None):
+        return self._client.list(
+            kind, namespace, label_selector, field_selector
+        )
+
+    def create(self, kind, obj, namespace=None):
+        return self._client.create(kind, obj, namespace)
+
+    def update(self, kind, obj, namespace=None):
+        return self._client.update(kind, obj, namespace)
+
+    def patch(self, kind, name, patch, namespace=None):
+        return self._client.patch(kind, name, patch, namespace)
+
+    def patch_status(self, kind, name, patch, namespace=None):
+        return self._client.patch_status(kind, name, patch, namespace)
+
+    def delete(self, kind, name, namespace=None):
+        return self._client.delete(kind, name, namespace)
+
+    def bind_pod(self, name, namespace, node_name):
+        return self._client.bind_pod(name, namespace, node_name)
