@@ -1,0 +1,169 @@
+"""Quota scheduler chaos sweep: random churn, steady-state invariants.
+
+Random pod arrivals, deletions, and phase transitions across three
+quotas (one borrowing-capped, one uncapped, one at its min) against the
+full scheduler manager (scheduler + capacity labeler + quota
+reconcilers). At quiesce the cluster must satisfy the elastic-quota
+contract regardless of the interleaving:
+
+  1. node capacity is never oversubscribed,
+  2. a quota with `max` never holds more than max,
+  3. total over-quota usage never exceeds what other quotas' unused
+     min actually lends,
+  4. capacity labels agree with each quota's aggregate position.
+"""
+
+import random
+import time
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.cmd.tpuscheduler import build_manager
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.quota.labeler import LABEL_CAPACITY, OVER_QUOTA
+from walkai_nos_tpu.quota.resources import pod_quota_request
+from walkai_nos_tpu.quota.state import ClusterQuotaState, pod_holds_quota
+
+TPU = constants.RESOURCE_TPU
+CHIPS = constants.RESOURCE_TPU_CHIPS
+CAPACITY = 16
+
+
+def _quota(name, ns, min_chips, max_chips=None):
+    spec = {"min": {CHIPS: str(min_chips)}}
+    if max_chips is not None:
+        spec["max"] = {CHIPS: str(max_chips)}
+    return {
+        "kind": "ElasticQuota",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+def _pod(name, ns, chips, created):
+    return {
+        "metadata": {
+            "name": name, "namespace": ns,
+            "creationTimestamp": created, "labels": {},
+        },
+        "spec": {
+            "schedulerName": "walkai-nos-scheduler",
+            "containers": [
+                {"resources": {"requests": {TPU: str(chips)}}}
+            ],
+        },
+        "status": {"phase": "Pending"},
+    }
+
+
+def test_random_churn_preserves_quota_invariants():
+    for seed in range(6):
+        rng = random.Random(seed)
+        kube = FakeKubeClient()
+        kube.create("Node", {
+            "metadata": {"name": "host-a"},
+            "status": {"allocatable": {TPU: str(CAPACITY)}},
+        })
+        kube.create("ElasticQuota", _quota("qa", "team-a", 4, 8), "team-a")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        kube.create("ElasticQuota", _quota("qc", "team-c", 8, 8), "team-c")
+
+        counter = 0
+        with build_manager(kube):
+            for tick in range(rng.randrange(20, 40)):
+                op = rng.random()
+                pods = kube.list("Pod")
+                if op < 0.55 or not pods:
+                    counter += 1
+                    ns = rng.choice(["team-a", "team-b", "team-c"])
+                    kube.create("Pod", _pod(
+                        f"p{counter}", ns, rng.choice([1, 2, 4]),
+                        f"2026-01-01T00:{tick:02d}:{counter % 60:02d}Z",
+                    ), ns)
+                elif op < 0.8:
+                    victim = rng.choice(pods)
+                    try:
+                        kube.delete(
+                            "Pod", objects.name(victim),
+                            objects.namespace(victim),
+                        )
+                    except Exception:
+                        pass
+                else:
+                    pod = rng.choice(pods)
+                    if pod["spec"].get("nodeName"):
+                        try:
+                            kube.patch(
+                                "Pod", objects.name(pod),
+                                {"status": {"phase": "Running"}},
+                                objects.namespace(pod),
+                            )
+                        except Exception:
+                            pass
+                time.sleep(rng.random() * 0.03)
+
+            # Quiesce: bound pods all Running, then let the loops settle
+            # until the pod set is stable across a full settle window.
+            deadline = time.time() + 30
+            stable_since = None
+            snapshot = None
+            while time.time() < deadline:
+                for pod in kube.list("Pod"):
+                    if pod["spec"].get("nodeName") and (
+                        pod["status"].get("phase") == "Pending"
+                    ):
+                        kube.patch(
+                            "Pod", objects.name(pod),
+                            {"status": {"phase": "Running"}},
+                            objects.namespace(pod),
+                        )
+                view = sorted(
+                    (
+                        objects.namespace(p), objects.name(p),
+                        p["spec"].get("nodeName", ""),
+                        objects.labels(p).get(LABEL_CAPACITY, ""),
+                    )
+                    for p in kube.list("Pod")
+                )
+                if view == snapshot:
+                    if stable_since and time.time() - stable_since > 1.5:
+                        break
+                    stable_since = stable_since or time.time()
+                else:
+                    snapshot, stable_since = view, None
+                time.sleep(0.1)
+
+        pods = kube.list("Pod")
+        held = [p for p in pods if pod_holds_quota(p)]
+
+        # (1) capacity
+        total = sum(pod_quota_request(p).get(CHIPS, 0) for p in held)
+        assert total <= CAPACITY, (seed, total)
+
+        # (2) + (3) via the scheduler's own accounting
+        state = ClusterQuotaState.build(
+            kube.list("ElasticQuota"), pods
+        )
+        for q in state.quotas:
+            used = q.used.get(CHIPS, 0)
+            if q.max:
+                assert used <= q.max.get(CHIPS, CAPACITY), (seed, q.name, used)
+            over = q.over_quota_usage(CHIPS)
+            lendable = state.lendable_over_quotas(q, CHIPS)
+            assert over <= lendable, (seed, q.name, over, lendable)
+
+        # (4) labels agree with the aggregate position
+        for q in state.quotas:
+            ns = q.namespaces[0]
+            ns_pods = [
+                p for p in held if objects.namespace(p) == ns
+                and p["status"].get("phase") == "Running"
+            ]
+            over_labeled = [
+                p for p in ns_pods
+                if objects.labels(p).get(LABEL_CAPACITY) == OVER_QUOTA
+            ]
+            if q.used.get(CHIPS, 0) <= q.min.get(CHIPS, 0):
+                assert not over_labeled, (seed, q.name)
+            elif ns_pods:
+                assert over_labeled, (seed, q.name)
