@@ -123,12 +123,11 @@ class TestSharedWatch:
                 msg="late subscriber replayed both pods + SYNCED",
             )
             assert upstream.watch_opens.get("Pod") == 1
-            t2_ = t2
         finally:
             stop.set()
             shared.close()
             t1.join(timeout=5)
-            t2_.join(timeout=5)
+            t2.join(timeout=5)
 
     def test_deletion_drops_from_replay(self):
         upstream = CountingClient()
@@ -176,3 +175,55 @@ class TestSharedWatch:
         assert len(shared.list("Node")) == 1
         shared.delete("Node", "n1")
         assert shared.list("Node") == []
+
+
+    def test_empty_snapshot_still_emits_synced(self):
+        """The initial burst must END with SYNCED even with zero
+        objects — that marker is what lets a re-subscribing Controller
+        prune its stale cache (the upstream watch contract)."""
+        shared = SharedWatchClient(CountingClient())
+        stop = threading.Event()
+        events: list = []
+        started = threading.Event()
+        t = threading.Thread(
+            target=_collect, args=(shared, "Pod", events, stop, started),
+            daemon=True,
+        )
+        t.start()
+        started.wait(5)
+        try:
+            _eventually(
+                lambda: any(e == "SYNCED" for e, _ in events),
+                msg="empty stream still framed with SYNCED",
+            )
+            assert not any(e == "ADDED" for e, _ in events)
+        finally:
+            stop.set()
+            shared.close()
+            t.join(timeout=5)
+
+    def test_manager_stop_closes_shared_streams(self):
+        """build_manager wraps the client; manager exit must stop the
+        pump threads (no watch outliving the manager)."""
+        from walkai_nos_tpu.cmd.tpuscheduler import build_manager
+
+        upstream = CountingClient()
+        before = {
+            th.name for th in threading.enumerate()
+            if th.name.startswith("sharedwatch-")
+        }
+        with build_manager(upstream):
+            _eventually(
+                lambda: any(
+                    th.name.startswith("sharedwatch-")
+                    for th in threading.enumerate()
+                ),
+                msg="pump threads running under the manager",
+            )
+        _eventually(
+            lambda: {
+                th.name for th in threading.enumerate()
+                if th.name.startswith("sharedwatch-") and th.is_alive()
+            } <= before,
+            msg="pump threads stopped with the manager",
+        )

@@ -69,8 +69,14 @@ def build_manager(
     node_name: str,
     config: AgentConfig,
 ) -> tuple[Manager, SharedState]:
+    from walkai_nos_tpu.kube.sharedwatch import SharedWatchClient
+
+    # Reporter and Actuator both watch the agent's Node: share one
+    # upstream stream (informer semantics), owned by the manager.
+    kube = SharedWatchClient(kube)
     shared = SharedState()
     manager = Manager()
+    manager.own(kube)
     manager.add(
         Controller(
             constants.AGENT_REPORTER_NAME,

@@ -181,9 +181,11 @@ def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Man
 
     # Two controllers watch Pods (scheduler + capacity labeler); the
     # shared-watch decorator gives them one upstream stream per kind,
-    # the informer property controller-runtime's manager provides.
+    # the informer property controller-runtime's manager provides. The
+    # manager owns it: pump threads stop with the manager.
     kube = SharedWatchClient(kube)
     manager = Manager()
+    manager.own(kube)
     manager.add(
         Controller(
             "tpu-scheduler",

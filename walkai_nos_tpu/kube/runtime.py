@@ -322,9 +322,16 @@ class Manager:
     """Runs a set of controllers (`ctrl.Manager` analogue)."""
 
     controllers: list[Controller] = field(default_factory=list)
+    # Resources closed on stop (e.g. a SharedWatchClient's pump threads
+    # + upstream streams must not outlive the manager).
+    _owned: list = field(default_factory=list)
 
     def add(self, controller: Controller) -> None:
         self.controllers.append(controller)
+
+    def own(self, closeable) -> None:
+        """Register a resource whose close() is tied to this manager."""
+        self._owned.append(closeable)
 
     def start(self) -> None:
         for c in self.controllers:
@@ -333,6 +340,11 @@ class Manager:
     def stop(self) -> None:
         for c in self.controllers:
             c.stop()
+        for resource in self._owned:
+            try:
+                resource.close()
+            except Exception:
+                logger.exception("closing managed resource failed")
 
     def __enter__(self) -> "Manager":
         self.start()

@@ -38,8 +38,12 @@ class _Stream:
         self._kind = kind
         self._namespace = namespace
         self._lock = threading.Lock()
+        self._synced_cv = threading.Condition(self._lock)
         self._cache: dict[tuple[str, str], dict] = {}
         self._resync_seen: set = set()
+        # True once the stream reached a consistent point (initial
+        # ADDED…SYNCED complete, and not inside a RESYNC replay window).
+        self._synced = False
         self._subscribers: list[queue.SimpleQueue] = []
         self._stopped = False
         self._thread = threading.Thread(
@@ -68,21 +72,25 @@ class _Stream:
             with self._lock:
                 self._stopped = True
                 targets = list(self._subscribers)
+                self._synced_cv.notify_all()
             for q in targets:
                 q.put(_SENTINEL)
 
     def _apply(self, event: str, obj: dict) -> None:
         """Mirror the upstream protocol into the replay cache. During a
-        RESYNC replay the stream re-mentions every survivor, so drop the
-        cache at RESYNC and rebuild from the replay (same semantics
+        RESYNC replay the stream re-mentions every survivor, so drop
+        what the replay didn't re-mention at its SYNCED (same semantics
         Controller applies to its own cache)."""
         if event == RESYNC:
             self._resync_seen = set(self._cache)
+            self._synced = False
             return
         if event == SYNCED:
             for key in self._resync_seen:
                 self._cache.pop(key, None)
             self._resync_seen = set()
+            self._synced = True
+            self._synced_cv.notify_all()
             return
         meta = obj.get("metadata", {})
         key = (meta.get("namespace", ""), meta.get("name", ""))
@@ -97,23 +105,31 @@ class _Stream:
     def subscribe(
         self, stop: Callable[[], bool]
     ) -> Iterator[WatchEvent]:
+        """Yield the informer's state as the standard ADDED…SYNCED
+        framing, then live events. Joins wait for the stream to reach a
+        consistent point first — snapshotting mid-burst or mid-RESYNC
+        would hand the joiner a partial or stale world whose missing
+        objects its Controller would treat as deletions (or ghosts)."""
         q: queue.SimpleQueue = queue.SimpleQueue()
         with self._lock:
             if not self._started:
                 self._started = True
                 self._thread.start()
+            while not self._synced and not self._stopped:
+                if stop():
+                    return
+                self._synced_cv.wait(timeout=0.2)
             snapshot = list(self._cache.values())
             dead = self._stopped
             if not dead:
                 self._subscribers.append(q)
         try:
-            # Late joiners see the informer's state as the standard
-            # initial ADDED…SYNCED framing; for the first subscriber the
-            # snapshot is empty and the upstream's own framing follows.
             for obj in snapshot:
                 yield ("ADDED", obj)
-            if snapshot:
-                yield (SYNCED, {})
+            # Always close the initial burst — an empty SYNCED is what
+            # lets a re-subscribing Controller prune its stale cache
+            # (the upstream watch contract, client.py).
+            yield (SYNCED, {})
             if dead:
                 return
             while not stop():
@@ -130,7 +146,9 @@ class _Stream:
                     self._subscribers.remove(q)
 
     def stop(self) -> None:
-        self._stopped = True
+        with self._lock:
+            self._stopped = True
+            self._synced_cv.notify_all()
 
 
 class SharedWatchClient(KubeClient):
