@@ -687,3 +687,45 @@ def _quota_obj(name, namespace, min_chips):
     q = _quota(name, namespace, min_chips)
     q["metadata"] = {"name": name, "namespace": namespace}
     return q
+
+
+class TestQuotaValidation:
+    def test_invalid_spec_gets_condition_and_event(self):
+        from walkai_nos_tpu.quota.reconciler import QuotaReconciler
+
+        kube = FakeKubeClient()
+        kube.create("ElasticQuota", {
+            "kind": "ElasticQuota",
+            "metadata": {"name": "bad", "namespace": "team-x"},
+            "spec": {
+                "min": {CHIPS: "8"},
+                "max": {CHIPS: "4"},  # max below min: webhook-grade error
+            },
+        }, "team-x")
+        QuotaReconciler(kube, "ElasticQuota").reconcile(
+            Request(name="bad", namespace="team-x")
+        )
+        obj = kube.get("ElasticQuota", "bad", "team-x")
+        (condition,) = obj["status"]["conditions"]
+        assert condition["type"] == "Valid"
+        assert condition["status"] == "False"
+        assert "below min" in condition["message"]
+        events = kube.list("Event", "team-x")
+        assert any(e.get("reason") == "InvalidSpec" for e in events)
+
+    def test_valid_spec_gets_true_condition(self):
+        from walkai_nos_tpu.quota.reconciler import QuotaReconciler
+
+        kube = FakeKubeClient()
+        kube.create("ElasticQuota", {
+            "kind": "ElasticQuota",
+            "metadata": {"name": "ok", "namespace": "team-x"},
+            "spec": {"min": {CHIPS: "4"}, "max": {CHIPS: "8"}},
+        }, "team-x")
+        QuotaReconciler(kube, "ElasticQuota").reconcile(
+            Request(name="ok", namespace="team-x")
+        )
+        obj = kube.get("ElasticQuota", "ok", "team-x")
+        (condition,) = obj["status"]["conditions"]
+        assert condition["status"] == "True"
+        assert kube.list("Event", "team-x") == []

@@ -16,7 +16,7 @@ from __future__ import annotations
 import logging
 
 from walkai_nos_tpu.kube import objects
-from walkai_nos_tpu.kube.client import KubeClient, NotFound
+from walkai_nos_tpu.kube.client import ApiError, KubeClient, NotFound
 from walkai_nos_tpu.kube.runtime import Request, Result
 from walkai_nos_tpu.quota.labeler import (
     list_quota_objects,
@@ -24,8 +24,32 @@ from walkai_nos_tpu.quota.labeler import (
     update_quota_status,
 )
 from walkai_nos_tpu.quota.state import ClusterQuotaState
+from walkai_nos_tpu.utils.quantity import parse_quantity
 
 logger = logging.getLogger(__name__)
+
+
+def validate_quota_spec(obj: dict) -> list[str]:
+    """Spec errors a webhook would have rejected (the upstream operator
+    validated ElasticQuota via admission; reconciler-style here): every
+    max must be >= its resource's min."""
+    spec = obj.get("spec") or {}
+    errors = []
+    min_ = spec.get("min") or {}
+    max_ = spec.get("max") or {}
+    for resource, raw in max_.items():
+        try:
+            hi = parse_quantity(raw)
+            lo = parse_quantity(min_.get(resource, "0"))
+        except (ValueError, TypeError) as e:
+            errors.append(f"unparseable quantity for {resource}: {e}")
+            continue
+        if hi < lo:
+            errors.append(
+                f"max[{resource}]={raw} is below min[{resource}]="
+                f"{min_.get(resource)}"
+            )
+    return errors
 
 
 class QuotaReconciler:
@@ -45,6 +69,12 @@ class QuotaReconciler:
             )
         except NotFound:
             return Result()
+        errors = validate_quota_spec(obj)
+        self._set_valid_condition(obj, errors)
+        if errors:
+            # Surface the misconfiguration; the scheduler keeps applying
+            # the spec as written (each bound is enforced on its own).
+            return Result(requeue_after=self._interval)
         all_pods = self._kube.list("Pod")
         state = ClusterQuotaState.build(
             list_quota_objects(self._kube), all_pods
@@ -66,3 +96,51 @@ class QuotaReconciler:
         update_quota_status(self._kube, quota)
         relabel_quota_pods(self._kube, quota, all_pods)
         return Result(requeue_after=self._interval)
+
+    def _set_valid_condition(self, obj: dict, errors: list[str]) -> None:
+        name = objects.name(obj)
+        namespace = objects.namespace(obj) or "default"
+        condition = {
+            "type": "Valid",
+            "status": "False" if errors else "True",
+            "reason": "InvalidSpec" if errors else "SpecValid",
+            "message": "; ".join(errors),
+        }
+        current = (obj.get("status") or {}).get("conditions") or []
+        existing = next(
+            (c for c in current if c.get("type") == "Valid"), None
+        )
+        if existing and all(
+            existing.get(k) == condition[k]
+            for k in ("status", "reason", "message")
+        ):
+            return
+        try:
+            self._kube.patch_status(
+                self._kind, name,
+                {"status": {"conditions": [condition]}}, namespace,
+            )
+        except ApiError as e:
+            logger.warning("quota %s condition update failed: %s", name, e)
+        if errors:
+            logger.warning("quota %s/%s invalid: %s", namespace, name,
+                           condition["message"])
+            try:
+                # Idempotently named (same idiom as the partitioner's
+                # MultiHostTopology event): re-reconciles 409 harmlessly.
+                self._kube.create("Event", {
+                    "metadata": {
+                        "name": f"{name}.invalid-spec",
+                        "namespace": namespace,
+                    },
+                    "type": "Warning",
+                    "reason": "InvalidSpec",
+                    "message": condition["message"],
+                    "involvedObject": {
+                        "kind": self._kind, "name": name,
+                        "namespace": namespace,
+                    },
+                }, namespace)
+            except ApiError as e:
+                if e.status != 409:
+                    logger.debug("quota invalid event failed: %s", e)
