@@ -246,6 +246,38 @@ def main() -> None:
         f"model_ceiling={ceiling_img_s:.0f} img/s"
     )
 
+    # Optional LM text-generation endpoint (WALKAI_DEMO_LM=1): the same
+    # slice serves KV-cache decoding beside the vision dispatcher. Kept
+    # strictly out of the default path — the headline bench measures the
+    # vision pipeline and must not pay a second model's compile/memory.
+    lm_generate = lm_params = lm_cfg = None
+    lm_lock = threading.Lock()
+    lm_max_new = int(os.environ.get("WALKAI_LM_MAX_NEW", "64"))
+    if os.environ.get("WALKAI_DEMO_LM") == "1":
+        from walkai_nos_tpu.models.decode import make_generate_fn
+        from walkai_nos_tpu.models.lm import LM_TINY, LM_SMALL, DecoderLM
+
+        lm_cfg = (
+            LM_TINY
+            if os.environ.get("WALKAI_DEMO_MODEL") == "tiny"
+            else LM_SMALL
+        )
+        lm_params = jax.device_put(
+            DecoderLM(lm_cfg).init_params(jax.random.PRNGKey(0))
+        )
+        lm_generate = make_generate_fn(lm_cfg)
+        # Warm the common signature (prompt 16) so the first request
+        # isn't a compile.
+        warm_prompt = jnp.zeros((1, 16), jnp.int32)
+        _ = lm_generate(lm_params, warm_prompt, max_new_tokens=lm_max_new)
+        import numpy as _np
+
+        _np.asarray(jnp.ravel(_))
+        print(
+            f"lm generation enabled: {lm_cfg.num_layers} layers, "
+            f"max_new={lm_max_new}"
+        )
+
     stats = _Stats()
     requests_q: "queue.Queue[_Request]" = queue.Queue()
     fence_q: "queue.Queue[_Dispatched]" = queue.Queue()
@@ -330,6 +362,9 @@ def main() -> None:
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
+            if self.path == "/generate":
+                self._generate()
+                return
             if self.path != "/infer":
                 self.send_error(404)
                 return
@@ -349,6 +384,47 @@ def main() -> None:
                     "slice": slice_id,
                 },
             )
+
+        def _generate(self):
+            if lm_generate is None:
+                self.send_error(404, "set WALKAI_DEMO_LM=1 to enable")
+                return
+            import numpy as np
+
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = body.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                self.send_error(400, "prompt must be a non-empty list")
+                return
+            if len(prompt) + lm_max_new > lm_cfg.max_seq_len:
+                self.send_error(
+                    400,
+                    f"prompt {len(prompt)} + {lm_max_new} new tokens "
+                    f"exceeds max_seq_len {lm_cfg.max_seq_len}",
+                )
+                return
+            if any(
+                not isinstance(t, int) or not 0 <= t < lm_cfg.vocab_size
+                for t in prompt
+            ):
+                self.send_error(400, "prompt tokens out of vocab range")
+                return
+            arr = jnp.asarray([prompt], jnp.int32)
+            # Serialized: one generation at a time keeps decode latency
+            # predictable next to the vision dispatcher. A new prompt
+            # length compiles on first use.
+            with lm_lock:
+                t0 = time.perf_counter()
+                out = lm_generate(lm_params, arr, max_new_tokens=lm_max_new)
+                tokens = np.asarray(out)[0].tolist()  # fenced by fetch
+                dt = time.perf_counter() - t0
+            self._json(200, {
+                "tokens": tokens,
+                "generate_time_seconds": round(dt, 6),
+                "tokens_per_second": round(lm_max_new / dt, 1),
+                "slice": slice_id,
+            })
 
         def do_GET(self):
             if self.path == "/healthz":

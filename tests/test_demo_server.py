@@ -73,3 +73,55 @@ class TestDemoServer:
         assert s1["model_ceiling_images_per_s"] > 0
         assert s1["fence_rtt_s"] >= 0
         assert s1["flops_per_image"] > 0
+
+
+class TestGenerateEndpoint:
+    @pytest.fixture(scope="class")
+    def lm_server(self):
+        proc, base = spawn_server(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "WALKAI_DEMO_MODEL": "tiny",
+                "WALKAI_DEMO_LM": "1",
+                "WALKAI_LM_MAX_NEW": "8",
+                "WALKAI_MAX_BATCH": "8",
+                "WALKAI_WARM_BUCKETS": "1",
+                "WALKAI_CALIB_WINDOW_S": "0.2",
+            },
+            startup_timeout_s=300.0,
+            poll_s=0.25,
+        )
+        yield base
+        kill_server(proc)
+
+    def _post(self, base, payload):
+        import json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, {}
+
+    def test_generates_tokens(self, lm_server):
+        status, out = self._post(lm_server, {"prompt": [1, 2, 3, 4]})
+        assert status == 200
+        assert len(out["tokens"]) == 8
+        assert out["tokens_per_second"] > 0
+
+    def test_bad_prompt_rejected(self, lm_server):
+        assert self._post(lm_server, {"prompt": []})[0] == 400
+        assert self._post(lm_server, {"prompt": [999999]})[0] == 400
+        assert self._post(lm_server, {"prompt": list(range(125))})[0] == 400
+
+    def test_generate_disabled_by_default(self, server):
+        status, _ = self._post(server, {"prompt": [1, 2]})
+        assert status == 404
