@@ -729,3 +729,87 @@ class TestQuotaValidation:
         (condition,) = obj["status"]["conditions"]
         assert condition["status"] == "True"
         assert kube.list("Event", "team-x") == []
+
+    def test_unparseable_min_is_invalid(self):
+        """An unparseable min silently becomes 0 guaranteed in the
+        scheduler state — the validator must catch it, not bless it."""
+        from walkai_nos_tpu.quota.reconciler import QuotaReconciler
+
+        kube = FakeKubeClient()
+        kube.create("ElasticQuota", {
+            "kind": "ElasticQuota",
+            "metadata": {"name": "typo", "namespace": "team-x"},
+            "spec": {"min": {CHIPS: "abc"}},
+        }, "team-x")
+        QuotaReconciler(kube, "ElasticQuota").reconcile(
+            Request(name="typo", namespace="team-x")
+        )
+        obj = kube.get("ElasticQuota", "typo", "team-x")
+        valid = next(
+            c for c in obj["status"]["conditions"] if c["type"] == "Valid"
+        )
+        assert valid["status"] == "False"
+        assert "unparseable" in valid["message"]
+
+    def test_condition_preserves_other_types(self):
+        from walkai_nos_tpu.quota.reconciler import QuotaReconciler
+
+        kube = FakeKubeClient()
+        kube.create("ElasticQuota", {
+            "kind": "ElasticQuota",
+            "metadata": {"name": "q", "namespace": "team-x"},
+            "spec": {"min": {CHIPS: "4"}},
+            "status": {"conditions": [
+                {"type": "Other", "status": "True", "reason": "X"}
+            ]},
+        }, "team-x")
+        QuotaReconciler(kube, "ElasticQuota").reconcile(
+            Request(name="q", namespace="team-x")
+        )
+        conditions = kube.get(
+            "ElasticQuota", "q", "team-x"
+        )["status"]["conditions"]
+        types = {c["type"] for c in conditions}
+        assert types == {"Other", "Valid"}
+
+    def test_invalid_event_cleared_when_spec_fixed(self):
+        from walkai_nos_tpu.quota.reconciler import QuotaReconciler
+
+        kube = FakeKubeClient()
+        kube.create("ElasticQuota", {
+            "kind": "ElasticQuota",
+            "metadata": {"name": "fix", "namespace": "team-x"},
+            "spec": {"min": {CHIPS: "8"}, "max": {CHIPS: "4"}},
+        }, "team-x")
+        reconciler = QuotaReconciler(kube, "ElasticQuota")
+        reconciler.reconcile(Request(name="fix", namespace="team-x"))
+        assert kube.list("Event", "team-x")
+
+        obj = kube.get("ElasticQuota", "fix", "team-x")
+        obj["spec"]["max"] = {CHIPS: "8"}
+        kube.update("ElasticQuota", obj, "team-x")
+        reconciler.reconcile(Request(name="fix", namespace="team-x"))
+        assert kube.list("Event", "team-x") == []
+        obj = kube.get("ElasticQuota", "fix", "team-x")
+        valid = next(
+            c for c in obj["status"]["conditions"] if c["type"] == "Valid"
+        )
+        assert valid["status"] == "True"
+
+    def test_invalid_quota_status_still_refreshes(self):
+        """An invalid bound must not freeze status.used — the spec keeps
+        being enforced as written, so observability keeps converging."""
+        from walkai_nos_tpu.quota.reconciler import QuotaReconciler
+
+        kube = FakeKubeClient()
+        kube.create("ElasticQuota", {
+            "kind": "ElasticQuota",
+            "metadata": {"name": "live", "namespace": "team-x"},
+            "spec": {"min": {CHIPS: "8"}, "max": {CHIPS: "4"}},
+        }, "team-x")
+        kube.create("Pod", _pod("p1", "team-x", 4, node="host-a"), "team-x")
+        QuotaReconciler(kube, "ElasticQuota").reconcile(
+            Request(name="live", namespace="team-x")
+        )
+        obj = kube.get("ElasticQuota", "live", "team-x")
+        assert obj["status"]["used"] == {CHIPS: "4"}

@@ -32,22 +32,28 @@ logger = logging.getLogger(__name__)
 def validate_quota_spec(obj: dict) -> list[str]:
     """Spec errors a webhook would have rejected (the upstream operator
     validated ElasticQuota via admission; reconciler-style here): every
+    quantity must parse — an unparseable min silently becomes 0
+    guaranteed (state.py drops it), the worst kind of typo — and every
     max must be >= its resource's min."""
     spec = obj.get("spec") or {}
     errors = []
     min_ = spec.get("min") or {}
     max_ = spec.get("max") or {}
-    for resource, raw in max_.items():
-        try:
-            hi = parse_quantity(raw)
-            lo = parse_quantity(min_.get(resource, "0"))
-        except (ValueError, TypeError) as e:
-            errors.append(f"unparseable quantity for {resource}: {e}")
-            continue
+    parsed: dict[str, dict[str, int]] = {"min": {}, "max": {}}
+    for field, bounds in (("min", min_), ("max", max_)):
+        for resource, raw in bounds.items():
+            try:
+                parsed[field][resource] = parse_quantity(raw)
+            except (ValueError, TypeError) as e:
+                errors.append(
+                    f"unparseable {field}[{resource}]={raw!r}: {e}"
+                )
+    for resource, hi in parsed["max"].items():
+        lo = parsed["min"].get(resource, 0)
         if hi < lo:
             errors.append(
-                f"max[{resource}]={raw} is below min[{resource}]="
-                f"{min_.get(resource)}"
+                f"max[{resource}]={max_.get(resource)} is below "
+                f"min[{resource}]={min_.get(resource)}"
             )
     return errors
 
@@ -69,12 +75,12 @@ class QuotaReconciler:
             )
         except NotFound:
             return Result()
+        # Surface misconfigurations, then continue the normal refresh:
+        # the scheduler keeps applying the spec as written (each bound
+        # is enforced on its own), so status.used and capacity labels
+        # must keep converging even while the object is marked invalid.
         errors = validate_quota_spec(obj)
         self._set_valid_condition(obj, errors)
-        if errors:
-            # Surface the misconfiguration; the scheduler keeps applying
-            # the spec as written (each bound is enforced on its own).
-            return Result(requeue_after=self._interval)
         all_pods = self._kube.list("Pod")
         state = ClusterQuotaState.build(
             list_quota_objects(self._kube), all_pods
@@ -110,37 +116,71 @@ class QuotaReconciler:
         existing = next(
             (c for c in current if c.get("type") == "Valid"), None
         )
-        if existing and all(
-            existing.get(k) == condition[k]
-            for k in ("status", "reason", "message")
-        ):
-            return
-        try:
-            self._kube.patch_status(
-                self._kind, name,
-                {"status": {"conditions": [condition]}}, namespace,
+        changed = not (
+            existing
+            and all(
+                existing.get(k) == condition[k]
+                for k in ("status", "reason", "message")
             )
-        except ApiError as e:
-            logger.warning("quota %s condition update failed: %s", name, e)
-        if errors:
-            logger.warning("quota %s/%s invalid: %s", namespace, name,
-                           condition["message"])
+        )
+        if changed:
+            # Merge-patch replaces lists wholesale: carry every OTHER
+            # condition through and only swap Valid (same idiom as the
+            # scheduler's PodScheduled handling).
+            conditions = [
+                c for c in current if c.get("type") != "Valid"
+            ] + [condition]
             try:
-                # Idempotently named (same idiom as the partitioner's
-                # MultiHostTopology event): re-reconciles 409 harmlessly.
-                self._kube.create("Event", {
-                    "metadata": {
-                        "name": f"{name}.invalid-spec",
-                        "namespace": namespace,
-                    },
-                    "type": "Warning",
-                    "reason": "InvalidSpec",
-                    "message": condition["message"],
-                    "involvedObject": {
-                        "kind": self._kind, "name": name,
-                        "namespace": namespace,
-                    },
-                }, namespace)
+                self._kube.patch_status(
+                    self._kind, name,
+                    {"status": {"conditions": conditions}}, namespace,
+                )
             except ApiError as e:
-                if e.status != 409:
-                    logger.debug("quota invalid event failed: %s", e)
+                logger.warning(
+                    "quota %s condition update failed: %s", name, e
+                )
+        self._sync_invalid_event(name, namespace, condition, changed)
+
+    def _sync_invalid_event(
+        self, name: str, namespace: str, condition: dict, changed: bool
+    ) -> None:
+        """Keep the idempotently-named warning Event truthful: message
+        follows the current errors, and the event goes away when the
+        spec becomes valid (the docs point operators at it)."""
+        if not changed:
+            return
+        event_name = f"{name}.invalid-spec"
+        if condition["status"] == "True":
+            try:
+                self._kube.delete("Event", event_name, namespace)
+            except ApiError:
+                pass
+            return
+        logger.warning(
+            "quota %s/%s invalid: %s", namespace, name,
+            condition["message"],
+        )
+        try:
+            self._kube.create("Event", {
+                "metadata": {"name": event_name, "namespace": namespace},
+                "type": "Warning",
+                "reason": "InvalidSpec",
+                "message": condition["message"],
+                "involvedObject": {
+                    "kind": self._kind, "name": name,
+                    "namespace": namespace,
+                },
+            }, namespace)
+        except ApiError as e:
+            if e.status != 409:
+                logger.debug("quota invalid event failed: %s", e)
+                return
+            try:  # same spec object, new errors: refresh the message
+                self._kube.patch(
+                    "Event", event_name,
+                    {"message": condition["message"]}, namespace,
+                )
+            except ApiError as patch_err:
+                logger.debug(
+                    "quota invalid event refresh failed: %s", patch_err
+                )
