@@ -233,6 +233,9 @@ def scheduling_benchmark() -> dict:
         "p50_time_to_scheduled_s": round(r.p50_s, 4),
         "p90_time_to_scheduled_s": round(r.p90_s, 4),
         "max_time_to_scheduled_s": round(r.max_s, 4),
+        "share_pods_scheduled": r.share_scheduled,
+        "share_pods_unscheduled": r.share_unscheduled,
+        "share_p50_time_to_scheduled_s": round(r.share_p50_s, 4),
     }
 
 

@@ -23,3 +23,7 @@ class TestSchedulingBench:
         assert r.unscheduled == 0
         assert r.scheduled == len(_workload(2))
         assert 0 < r.p50_s <= r.p90_s <= r.max_s
+        # Sharing phase: every chip-count share pod binds too.
+        assert r.share_unscheduled == 0
+        assert r.share_scheduled > 0
+        assert 0 < r.share_p50_s <= r.share_p90_s
