@@ -4,12 +4,17 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test e2e e2e-kind native bench dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test smoke e2e e2e-kind native bench dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
 test:
 	python -m pytest tests/ -q
+
+# One-command product drive: library flow, controller loops, quota
+# scheduler, and the JAX entry points — hardware-free (CPU-pinned).
+smoke:
+	python hack/smoke.py
 
 # Envtest-grade e2e: real RestKubeClient wire path (HTTP watch framing,
 # merge patches, subresources, pods/binding) against the in-process API

@@ -1,0 +1,90 @@
+"""Smoke: library control-plane flow + real controller loops over SimCluster."""
+import time
+
+# ---- Surface 1: library flow ------------------------------------------------
+from walkai_nos_tpu.tpu.tiling.node import Node
+from walkai_nos_tpu.tpu.tiling.known_tilings import clear_known_geometries
+from walkai_nos_tpu.tpu.annotations import (
+    parse_node_annotations,
+    spec_annotations_from_node_partitioning,
+)
+from walkai_nos_tpu.tpu.tiling.profile import get_requested_profiles
+
+clear_known_geometries()
+
+labels = {
+    "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+    "cloud.google.com/gke-tpu-topology": "2x4",
+    "nos.walkai.io/tpu-partitioning": "tiling",
+}
+pod = {
+    "metadata": {"name": "j1", "namespace": "default"},
+    "spec": {
+        "containers": [
+            {"resources": {"requests": {"walkai.io/tpu-2x2": "1"}}}
+        ]
+    },
+}
+profiles = get_requested_profiles(pod)
+assert profiles == {"2x2": 1}, profiles
+node = Node.from_node("host-a", labels, {})
+ok = node.update_geometry_for(profiles)
+assert ok, "update_geometry_for failed"
+spec = spec_annotations_from_node_partitioning(node.geometry())
+assert spec, "no spec annotations"
+assert node.provides_profiles(profiles)
+print("surface1 ok:", [(a.mesh_index, a.profile, a.quantity) for a in spec])
+
+# ---- Surface 2: controller loops over SimCluster ---------------------------
+from walkai_nos_tpu.sim import SimCluster
+from walkai_nos_tpu.kube import objects
+
+
+def eventually(fn, timeout=30.0, interval=0.2, what=""):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            if fn():
+                return
+        except Exception as e:  # transient races are expected
+            last = e
+        time.sleep(interval)
+    raise AssertionError(f"eventually({what}) timed out; last={last}")
+
+
+sim = SimCluster()
+sim.add_node("host-a", mesh=(2, 4))
+with sim:
+    kube = sim.kube
+
+    def node_initialized():
+        node = kube.get("Node", "host-a")
+        anns = objects.annotations(node)
+        status, spec = parse_node_annotations(anns)
+        return any(s.profile == "2x4" and s.quantity == 1 for s in spec)
+
+    eventually(node_initialized, what="node init to fewest-slices 2x4")
+
+    sim.create_slice_pod("j1", "2x2")
+
+    def pod_scheduled():
+        return objects.pod_is_scheduled(kube.get("Pod", "j1", "default"))
+
+    eventually(pod_scheduled, what="pod j1 scheduled after retile")
+
+    def status_shows_used():
+        node = kube.get("Node", "host-a")
+        status, spec = parse_node_annotations(objects.annotations(node))
+        return any(
+            s.profile == "2x2" and s.status.value == "used" and s.quantity >= 1
+            for s in status
+        )
+
+    eventually(status_shows_used, what="status 2x2 used>=1")
+
+    node = kube.get("Node", "host-a")
+    status, spec = parse_node_annotations(objects.annotations(node))
+    print("surface2 ok: scheduled with status",
+          [(s.profile, s.status.value, s.quantity) for s in status])
+print("ALL OK")

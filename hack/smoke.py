@@ -1,0 +1,65 @@
+"""One-command product smoke: drive every runtime surface hardware-free.
+
+`make smoke` (or `python hack/smoke.py`) exercises, in order:
+  1. the library control-plane flow (tiling search -> spec annotations),
+  2. the real controller loops over the sim cluster (node init ->
+     pending pod -> retile -> bind -> status ack),
+  3. the quota scheduler (bind, over-quota labeling, fair-share
+     preemption) against the fake API server,
+  4. the JAX entry points (single-chip forward jit + the 8-device
+     multi-chip dryrun on a virtual CPU mesh).
+
+Pins JAX to CPU first — verification never touches the real chip
+(bench.py owns it).
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    for name in ("drive_nos", "drive_quota"):
+        print(f"=== {name}")
+        runpy.run_path(os.path.join(REPO, "hack", f"{name}.py"))
+    print("=== jax entry points (subprocess: needs the 8-device flag "
+          "before jax backend init)")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    )
+    subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            (
+                "import __graft_entry__ as g; g.dryrun_multichip(8); "
+                "fn, args = g.entry(); import jax; jax.jit(fn)(*args); "
+                "print('entry + dryrun OK')"
+            ),
+        ],
+        cwd=REPO,
+        env=env,
+        check=True,
+    )
+    print("SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
