@@ -86,8 +86,14 @@ def main(argv: list[str] | None = None) -> int:
     share_manager = SharePluginManager(len(host.chips))
     share_manager.start()
 
+    from walkai_nos_tpu.kube.sharedwatch import SharedWatchClient
+
+    # Reporter and ShareActuator both watch this Node: one upstream
+    # stream (informer semantics), owned by the manager.
+    kube = SharedWatchClient(kube)
     shared = SharedState()
     manager = Manager()
+    manager.own(kube)
     manager.add(
         Controller(
             "tpusharing-reporter",
