@@ -166,3 +166,82 @@ class TestAgentWiring:
                 ),
                 msg="reporter publishes free 2x4 status",
             )
+
+
+class TestLeaderElectedPartitioner:
+    def test_failover_hands_reconciling_to_the_standby(self):
+        """Two partitioner replicas, leader-elected: only the leader's
+        controllers run; when it dies, the standby's manager starts and
+        picks up pending work (the reference's leaderElect deployment
+        shape, 2 replicas)."""
+        import time
+
+        from walkai_nos_tpu.cmd.tpupartitioner import build_manager
+        from walkai_nos_tpu.config import PartitionerConfig
+        from walkai_nos_tpu.kube.leader import LeaderElector
+        from tests.test_pod_controller import pending_slice_pod, tiling_node
+
+        kube = FakeKubeClient()
+        kube.create("Node", tiling_node("host-a"))
+
+        def replica(identity):
+            manager = build_manager(kube, PartitionerConfig())
+            elector = LeaderElector(
+                kube, "partitioner-leader", identity=identity,
+                lease_duration=0.5, renew_interval=0.05,
+                on_started_leading=manager.start,
+                on_stopped_leading=manager.stop,
+            )
+            elector.start()
+            return manager, elector
+
+        def eventually(fn, timeout=15.0, msg=""):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if fn():
+                    return
+                time.sleep(0.05)
+            raise AssertionError(f"timed out: {msg}")
+
+        m1, e1 = replica("replica-1")
+        m2, e2 = replica("replica-2")
+        try:
+            eventually(
+                lambda: e1.is_leader.is_set() ^ e2.is_leader.is_set(),
+                msg="exactly one leader",
+            )
+            if e1.is_leader.is_set():
+                leader, standby = (m1, e1), (m2, e2)
+            else:
+                leader, standby = (m2, e2), (m1, e1)
+
+            # The leader initializes the node (NodeController running).
+            eventually(
+                lambda: any(
+                    k.startswith("nos.walkai.io/spec-tpu")
+                    for k in objects.annotations(kube.get("Node", "host-a"))
+                ),
+                msg="leader initialized the node",
+            )
+
+            # Kill the leader; the standby must take over and serve a
+            # pending pod's retile.
+            leader[1].stop()
+            leader[0].stop()
+            eventually(
+                lambda: standby[1].is_leader.is_set(),
+                msg="standby acquired the lease",
+            )
+            kube.create("Pod", pending_slice_pod("p1", "2x2"))
+            eventually(
+                lambda: any(
+                    "2x2" in k
+                    for k in objects.annotations(kube.get("Node", "host-a"))
+                    if k.startswith("nos.walkai.io/spec-tpu")
+                ),
+                msg="standby retiled for the pending pod",
+            )
+        finally:
+            for m, e in (m1, e1), (m2, e2):
+                e.stop()
+                m.stop()
