@@ -65,6 +65,34 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def _drive_pods(
+    sim: SimCluster,
+    plan: list[tuple[str, str]],
+    create,
+    stagger_s: float,
+    timeout_s: float,
+) -> list[float]:
+    """Create pods per `plan` (staggered), poll until bound or timeout;
+    returns sorted create->bind latencies (unbound pods are absent)."""
+    created: dict[str, float] = {}
+    bound: dict[str, float] = {}
+    for name, profile in plan:
+        create(name, profile)
+        created[name] = time.monotonic()
+        time.sleep(stagger_s)
+    stop_at = time.monotonic() + timeout_s
+    pending = set(created)
+    while pending and time.monotonic() < stop_at:
+        now = time.monotonic()
+        for pod in sim.kube.list("Pod", namespace="default"):
+            name = objects.name(pod)
+            if name in pending and objects.pod_is_scheduled(pod):
+                bound[name] = now
+                pending.discard(name)
+        time.sleep(0.002)
+    return sorted(bound[n] - created[n] for n in bound)
+
+
 def run_scheduling_benchmark(
     n_nodes: int = 10,
     report_interval: float = 0.02,
@@ -89,42 +117,27 @@ def run_scheduling_benchmark(
                 break
             time.sleep(report_interval)
 
-        created: dict[str, float] = {}
-        bound: dict[str, float] = {}
-        for name, profile in plan:
-            sim.create_slice_pod(name, profile)
-            created[name] = time.monotonic()
-            time.sleep(stagger_s)
+        lat = _drive_pods(
+            sim, plan, sim.create_slice_pod, stagger_s, timeout_s
+        )
 
-        stop_at = time.monotonic() + timeout_s
-        pending = set(created)
-        while pending and time.monotonic() < stop_at:
-            now = time.monotonic()
-            for pod in sim.kube.list("Pod", namespace="default"):
-                name = objects.name(pod)
-                if name in pending and objects.pod_is_scheduled(pod):
-                    bound[name] = now
-                    pending.discard(name)
-            time.sleep(0.002)
-
-    lat = sorted(bound[n] - created[n] for n in bound)
-    share = run_sharing_benchmark(
+    share_plan_len, share_lat = run_sharing_benchmark(
         n_nodes=max(1, n_nodes // 5),
         report_interval=report_interval,
         stagger_s=stagger_s,
         timeout_s=timeout_s,
     )
     return SchedulingBenchResult(
-        scheduled=len(bound),
-        unscheduled=len(created) - len(bound),
+        scheduled=len(lat),
+        unscheduled=len(plan) - len(lat),
         p50_s=_percentile(lat, 0.50),
         p90_s=_percentile(lat, 0.90),
         mean_s=sum(lat) / len(lat) if lat else 0.0,
         max_s=lat[-1] if lat else 0.0,
-        share_scheduled=share[0],
-        share_unscheduled=share[1],
-        share_p50_s=share[2],
-        share_p90_s=share[3],
+        share_scheduled=len(share_lat),
+        share_unscheduled=share_plan_len - len(share_lat),
+        share_p50_s=_percentile(share_lat, 0.50),
+        share_p90_s=_percentile(share_lat, 0.90),
     )
 
 
@@ -133,39 +146,20 @@ def run_sharing_benchmark(
     report_interval: float = 0.02,
     stagger_s: float = 0.01,
     timeout_s: float = 60.0,
-) -> tuple[int, int, float, float]:
-    """(scheduled, unscheduled, p50, p90) for chip-count share pods on
-    sharing-labeled hosts — plan -> ShareActuator -> share device
+) -> tuple[int, list[float]]:
+    """(planned count, sorted bind latencies) for chip-count share pods
+    on sharing-labeled hosts — plan -> ShareActuator -> share device
     plugins -> bind, the dynamic-MPS analogue."""
     sim = SimCluster(report_interval=report_interval)
     for i in range(n_nodes):
         sim.add_sharing_node(f"share-host-{i}", mesh=(2, 4))
+    # 3x 2c + 2x 1c per 8-chip host = 8 chips, full fill.
+    plan = []
+    for i in range(n_nodes):
+        plan += [(f"share-{i}-{j}", "2c") for j in range(3)]
+        plan += [(f"share-{i}-{j + 3}", "1c") for j in range(2)]
     with sim:
-        created: dict[str, float] = {}
-        bound: dict[str, float] = {}
-        # 3x 2c + 2x 1c per 8-chip host = 8 chips, full fill.
-        plan = []
-        for i in range(n_nodes):
-            plan += [(f"share-{i}-{j}", "2c") for j in range(3)]
-            plan += [(f"share-{i}-{j + 3}", "1c") for j in range(2)]
-        for name, profile in plan:
-            sim.create_shared_pod(name, profile)
-            created[name] = time.monotonic()
-            time.sleep(stagger_s)
-        stop_at = time.monotonic() + timeout_s
-        pending = set(created)
-        while pending and time.monotonic() < stop_at:
-            now = time.monotonic()
-            for pod in sim.kube.list("Pod", namespace="default"):
-                name = objects.name(pod)
-                if name in pending and objects.pod_is_scheduled(pod):
-                    bound[name] = now
-                    pending.discard(name)
-            time.sleep(0.002)
-    lat = sorted(bound[n] - created[n] for n in bound)
-    return (
-        len(bound),
-        len(created) - len(bound),
-        _percentile(lat, 0.50),
-        _percentile(lat, 0.90),
-    )
+        lat = _drive_pods(
+            sim, plan, sim.create_shared_pod, stagger_s, timeout_s
+        )
+    return len(plan), lat
