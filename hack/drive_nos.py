@@ -1,4 +1,16 @@
 """Smoke: library control-plane flow + real controller loops over SimCluster."""
+import os
+import sys
+
+# Standalone-runnable: bootstrap the repo root and pin JAX to CPU FIRST
+# (AGENTS.md rule: the interpreter may arrive pointed at the real TPU,
+# and bench.py owns that chip).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import time
 
 # ---- Surface 1: library flow ------------------------------------------------

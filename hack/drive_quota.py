@@ -3,6 +3,18 @@
 Mirrors the docs' worked example: team-b borrows team-a's unused min,
 gets labelled over-quota, and is preempted when team-a reclaims.
 """
+import os
+import sys
+
+# Standalone-runnable: bootstrap the repo root and pin JAX to CPU FIRST
+# (AGENTS.md rule: the interpreter may arrive pointed at the real TPU,
+# and bench.py owns that chip).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import time
 
 from walkai_nos_tpu.api import constants
@@ -92,9 +104,9 @@ with manager:
     def reclaimed():
         a0 = kube.get("Pod", "a-0", "team-a")
         try:
-            kube.get("Pod", "b-1", "team-b")
-            gone = True  # eviction may leave pod Failed/deleted; accept delete
-            gone = False
+            victim = kube.get("Pod", "b-1", "team-b")
+            # Eviction may delete the pod or leave it terminal.
+            gone = victim["status"].get("phase") in ("Failed", "Succeeded")
         except Exception:
             gone = True
         return bool(a0["spec"].get("nodeName")) and gone

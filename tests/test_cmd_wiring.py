@@ -174,8 +174,6 @@ class TestLeaderElectedPartitioner:
         controllers run; when it dies, the standby's manager starts and
         picks up pending work (the reference's leaderElect deployment
         shape, 2 replicas)."""
-        import time
-
         from walkai_nos_tpu.cmd.tpupartitioner import build_manager
         from walkai_nos_tpu.config import PartitionerConfig
         from walkai_nos_tpu.kube.leader import LeaderElector
@@ -195,18 +193,10 @@ class TestLeaderElectedPartitioner:
             elector.start()
             return manager, elector
 
-        def eventually(fn, timeout=15.0, msg=""):
-            deadline = time.time() + timeout
-            while time.time() < deadline:
-                if fn():
-                    return
-                time.sleep(0.05)
-            raise AssertionError(f"timed out: {msg}")
-
         m1, e1 = replica("replica-1")
         m2, e2 = replica("replica-2")
         try:
-            eventually(
+            _eventually(
                 lambda: e1.is_leader.is_set() ^ e2.is_leader.is_set(),
                 msg="exactly one leader",
             )
@@ -216,7 +206,7 @@ class TestLeaderElectedPartitioner:
                 leader, standby = (m2, e2), (m1, e1)
 
             # The leader initializes the node (NodeController running).
-            eventually(
+            _eventually(
                 lambda: any(
                     k.startswith("nos.walkai.io/spec-tpu")
                     for k in objects.annotations(kube.get("Node", "host-a"))
@@ -228,12 +218,12 @@ class TestLeaderElectedPartitioner:
             # pending pod's retile.
             leader[1].stop()
             leader[0].stop()
-            eventually(
+            _eventually(
                 lambda: standby[1].is_leader.is_set(),
                 msg="standby acquired the lease",
             )
             kube.create("Pod", pending_slice_pod("p1", "2x2"))
-            eventually(
+            _eventually(
                 lambda: any(
                     "2x2" in k
                     for k in objects.annotations(kube.get("Node", "host-a"))

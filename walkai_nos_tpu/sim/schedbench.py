@@ -159,6 +159,19 @@ def run_sharing_benchmark(
         plan += [(f"share-{i}-{j}", "2c") for j in range(3)]
         plan += [(f"share-{i}-{j + 3}", "1c") for j in range(2)]
     with sim:
+        # Same settle discipline as the tiling phase: wait for every
+        # node's first status report so latencies measure scheduling,
+        # not cluster bring-up.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            ready = 0
+            for i in range(n_nodes):
+                node = sim.kube.get("Node", f"share-host-{i}")
+                status, _ = parse_node_annotations(objects.annotations(node))
+                ready += bool(status)
+            if ready == n_nodes:
+                break
+            time.sleep(report_interval)
         lat = _drive_pods(
             sim, plan, sim.create_shared_pod, stagger_s, timeout_s
         )
