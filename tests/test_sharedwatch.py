@@ -227,3 +227,69 @@ class TestSharedWatch:
             } <= before,
             msg="pump threads stopped with the manager",
         )
+
+
+class TestSharedWatchOverTheWire:
+    """SharedWatchClient over the real RestKubeClient watch protocol,
+    including an outage: late subscribers must wait out the RESYNC
+    window and receive a clean post-outage snapshot."""
+
+    def test_late_join_during_outage_sees_pruned_world(self):
+        from tests.apiserver import MiniApiServer
+        from tests.test_rest_client import TestRestKubeClient
+        from walkai_nos_tpu.kube.rest import RestKubeClient
+
+        api = MiniApiServer()
+        url = api.start()
+        try:
+            client = RestKubeClient(server=url)
+            admin = RestKubeClient(server=url)
+            admin.create("Node", {"metadata": {"name": "n1"}})
+            admin.create("Node", {"metadata": {"name": "n2"}})
+            # One upstream outage during which n2 is deleted.
+            TestRestKubeClient._make_flaky(
+                client, lambda: admin.delete("Node", "n2")
+            )
+            shared = SharedWatchClient(client)
+            stop = threading.Event()
+            first: list = []
+            started = threading.Event()
+            t1 = threading.Thread(
+                target=_collect, args=(shared, "Node", first, stop, started),
+                daemon=True,
+            )
+            t1.start()
+            started.wait(5)
+            try:
+                # First subscriber rides the outage: RESYNC framing with
+                # only the survivor re-mentioned.
+                _eventually(
+                    lambda: sum(1 for e, _ in first if e == "SYNCED") >= 2,
+                    msg="outage resynced",
+                )
+                # Late joiner AFTER the outage: snapshot must contain
+                # only the survivor.
+                late: list = []
+                started2 = threading.Event()
+                t2 = threading.Thread(
+                    target=_collect,
+                    args=(shared, "Node", late, stop, started2),
+                    daemon=True,
+                )
+                t2.start()
+                started2.wait(5)
+                _eventually(
+                    lambda: any(e == "SYNCED" for e, _ in late),
+                    msg="late joiner synced",
+                )
+                added = [
+                    o["metadata"]["name"] for e, o in late if e == "ADDED"
+                ]
+                assert added == ["n1"], added
+            finally:
+                stop.set()
+                shared.close()
+                t1.join(timeout=5)
+                t2.join(timeout=5)
+        finally:
+            api.stop()
