@@ -40,11 +40,11 @@ def make_generate_fn(
     static argument of the returned function. Requires
     prompt_len + max_new_tokens <= cfg.max_seq_len (the cache size).
     """
-    if cfg.use_ring_attention:
+    if cfg.use_ring_attention or cfg.use_ulysses_attention:
         raise ValueError(
             "decode uses the KV-cache path; build the generate config "
-            "with use_ring_attention=False (ring is a training-time "
-            "sequence-parallel layout)"
+            "without ring/ulysses attention (those are training-time "
+            "sequence-parallel layouts)"
         )
     model = DecoderLM(cfg, mesh)
 

@@ -35,8 +35,11 @@ class LMConfig:
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
     # Sequence parallelism: shard the sequence over the mesh's `seq` axis
-    # and run ring attention instead of the local kernel.
+    # and run ring attention instead of the local kernel — or Ulysses
+    # all-to-all attention (heads must divide the seq axis; two
+    # collectives per call instead of P-1 ring steps).
     use_ring_attention: bool = False
+    use_ulysses_attention: bool = False
     # Mixture-of-Experts: 0 = dense MLP everywhere; >0 swaps the MLP of
     # every `moe_every`-th block for an expert-parallel MoEMlp
     # (models/moe.py), experts sharded over the mesh's `expert` axis.
@@ -81,6 +84,10 @@ class CausalAttention(nn.Module):
             o = self._decode_attention(q, k, v)
         elif c.use_ring_attention and self.mesh is not None:
             o = ring_attention(q, k, v, self.mesh, causal=True)
+        elif c.use_ulysses_attention and self.mesh is not None:
+            from walkai_nos_tpu.ops.ulysses import ulysses_attention
+
+            o = ulysses_attention(q, k, v, self.mesh, causal=True)
         else:
             o = flash_attention(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
@@ -263,7 +270,9 @@ def make_lm_train_step(cfg: LMConfig, mesh: Mesh, *, lr: float = 3e-4):
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
-    seq_axis = 1 if cfg.use_ring_attention else None
+    seq_axis = (
+        1 if cfg.use_ring_attention or cfg.use_ulysses_attention else None
+    )
     tokens_sharding = shardlib.batch_sharding(mesh, seq_axis=seq_axis)
     return jax.jit(
         step, in_shardings=(None, tokens_sharding), donate_argnums=(0,)

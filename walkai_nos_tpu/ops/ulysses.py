@@ -1,0 +1,89 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second context-parallel mode beside ring attention
+(`ops/ring_attention.py`): instead of rotating K/V around a ring, one
+`all_to_all` re-shards the sequence dimension into a head shard — each
+device then holds ALL positions for H/P heads, runs ordinary (fused)
+attention locally, and a reverse all_to_all restores the sequence
+shard. Two collectives total per attention call (vs P-1 ring steps):
+cheaper when the head count divides well across the mesh and the
+all-to-all bandwidth is good (single-host ICI), while the ring wins
+when sequence lengths dwarf what one device can hold for even a single
+head. Both modes shard activations over the same `seq` mesh axis, so
+models can switch per config.
+
+No reference analogue — long-context subsystem per the TPU mandate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from walkai_nos_tpu.ops.attention import flash_attention
+from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
+
+
+def _local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-device body: [B, H, S/P, D] -> swap to [B, H/P, S, D] ->
+    local fused attention over the full sequence -> swap back."""
+
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def scatter_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    q, k, v = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    o = flash_attention(q, k, v, causal=causal)
+    return scatter_seq(o)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    axis_name: str = AXIS_SEQ,
+    batch_axes: tuple[str, ...] | None = None,
+) -> jax.Array:
+    """Sequence-parallel attention via head/sequence all-to-alls.
+
+    Inputs are [batch, heads, seq, head_dim] global arrays with the seq
+    dim sharded over `axis_name`; `heads` must be divisible by that
+    axis's size. Batch sharding mirrors `ring_attention`'s rules.
+    """
+    n_shards = mesh.shape[axis_name]
+    heads = q.shape[1]
+    if heads % n_shards != 0:
+        raise ValueError(
+            f"{heads} heads do not split over the {n_shards}-way "
+            f"{axis_name!r} axis; use ring attention for this layout"
+        )
+    if batch_axes is None:
+        batch_axes = ()
+        shards = 1
+        for a in (AXIS_DATA, AXIS_FSDP):
+            if a in mesh.axis_names and a != axis_name:
+                size = shards * mesh.shape[a]
+                if size > 1 and q.shape[0] % size == 0:
+                    batch_axes += (a,)
+                    shards = size
+    spec = P(batch_axes if batch_axes else None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)
