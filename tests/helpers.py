@@ -20,3 +20,22 @@ def eventually(fn, timeout=10.0, interval=0.05, msg="condition"):
             last_exc = e
         time.sleep(interval)
     raise AssertionError(f"eventually timed out: {msg} (last: {last_exc})")
+
+
+def make_flaky_watch(client, on_outage):
+    """Patch a RestKubeClient's _watch_once to fail once, running
+    `on_outage` during the simulated stream outage (shared by the rest
+    client and shared-watch suites)."""
+    orig = client._watch_once
+    failed = []
+
+    def flaky(kind, namespace, rv_box, stop):
+        if not failed:
+            failed.append(True)
+            on_outage()
+            from walkai_nos_tpu.kube.client import ApiError
+
+            raise ApiError(410, "gone")
+        return orig(kind, namespace, rv_box, stop)
+
+    client._watch_once = flaky

@@ -79,19 +79,9 @@ class TestRestKubeClient:
 
     @staticmethod
     def _make_flaky(client, on_outage):
-        """Patch client._watch_once to fail once, running `on_outage`
-        during the simulated stream outage."""
-        orig = client._watch_once
-        failed = []
+        from tests.helpers import make_flaky_watch
 
-        def flaky(kind, namespace, rv_box, stop):
-            if not failed:
-                failed.append(True)
-                on_outage()
-                raise ApiError(410, "gone")
-            return orig(kind, namespace, rv_box, stop)
-
-        client._watch_once = flaky
+        make_flaky_watch(client, on_outage)
 
     def test_relist_is_framed_resync_to_synced(self, api):
         """After an outage the relist replay is framed RESYNC…SYNCED and

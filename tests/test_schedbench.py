@@ -32,8 +32,9 @@ class TestSchedulingBench:
 class TestScaleOut:
     def test_twenty_node_cluster_schedules_everything(self):
         """Scale-out proof: ~94 mixed-profile pods over 20 hosts all
-        bind, with sub-second p50 — the packer and the controller fabric
-        hold up under 20 concurrent agent loops and API churn."""
+        bind with bounded p50 — the packer and the controller fabric
+        hold up under 20 concurrent agent loops and API churn
+        (measured ~0.8 s p50; the bound leaves headroom for CI load)."""
         r = run_scheduling_benchmark(
             n_nodes=20, stagger_s=0.002, timeout_s=120.0
         )

@@ -95,6 +95,7 @@ class TestSharedWatch:
         )
         t1.start()
         started.wait(5)
+        t2 = None
         try:
             _eventually(
                 lambda: any(e == "SYNCED" for e, _ in first),
@@ -127,7 +128,8 @@ class TestSharedWatch:
             stop.set()
             shared.close()
             t1.join(timeout=5)
-            t2.join(timeout=5)
+            if t2 is not None:
+                t2.join(timeout=5)
 
     def test_deletion_drops_from_replay(self):
         upstream = CountingClient()
@@ -142,6 +144,7 @@ class TestSharedWatch:
         )
         t1.start()
         started.wait(5)
+        t2 = None
         try:
             _eventually(
                 lambda: any(e == "ADDED" for e, _ in first),
@@ -166,7 +169,8 @@ class TestSharedWatch:
             stop.set()
             shared.close()
             t1.join(timeout=5)
-            t2.join(timeout=5)
+            if t2 is not None:
+                t2.join(timeout=5)
 
     def test_crud_delegates(self):
         shared = SharedWatchClient(FakeKubeClient())
@@ -236,7 +240,7 @@ class TestSharedWatchOverTheWire:
 
     def test_late_join_during_outage_sees_pruned_world(self):
         from tests.apiserver import MiniApiServer
-        from tests.test_rest_client import TestRestKubeClient
+        from tests.helpers import make_flaky_watch
         from walkai_nos_tpu.kube.rest import RestKubeClient
 
         api = MiniApiServer()
@@ -247,9 +251,7 @@ class TestSharedWatchOverTheWire:
             admin.create("Node", {"metadata": {"name": "n1"}})
             admin.create("Node", {"metadata": {"name": "n2"}})
             # One upstream outage during which n2 is deleted.
-            TestRestKubeClient._make_flaky(
-                client, lambda: admin.delete("Node", "n2")
-            )
+            make_flaky_watch(client, lambda: admin.delete("Node", "n2"))
             shared = SharedWatchClient(client)
             stop = threading.Event()
             first: list = []
@@ -260,6 +262,7 @@ class TestSharedWatchOverTheWire:
             )
             t1.start()
             started.wait(5)
+            t2 = None
             try:
                 # First subscriber rides the outage: RESYNC framing with
                 # only the survivor re-mentioned.
@@ -290,6 +293,7 @@ class TestSharedWatchOverTheWire:
                 stop.set()
                 shared.close()
                 t1.join(timeout=5)
-                t2.join(timeout=5)
+                if t2 is not None:
+                    t2.join(timeout=5)
         finally:
             api.stop()

@@ -23,6 +23,7 @@ from jax.sharding import Mesh
 
 from walkai_nos_tpu.ops.attention import flash_attention
 from walkai_nos_tpu.ops.ring_attention import ring_attention
+from walkai_nos_tpu.ops.ulysses import ulysses_attention
 
 
 @dataclass(frozen=True)
@@ -85,8 +86,6 @@ class CausalAttention(nn.Module):
         elif c.use_ring_attention and self.mesh is not None:
             o = ring_attention(q, k, v, self.mesh, causal=True)
         elif c.use_ulysses_attention and self.mesh is not None:
-            from walkai_nos_tpu.ops.ulysses import ulysses_attention
-
             o = ulysses_attention(q, k, v, self.mesh, causal=True)
         else:
             o = flash_attention(q, k, v, causal=True)

@@ -11,3 +11,4 @@ from walkai_nos_tpu.ops.attention import (  # noqa: F401
     attention_reference,
 )
 from walkai_nos_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from walkai_nos_tpu.ops.ulysses import ulysses_attention  # noqa: F401

@@ -26,6 +26,26 @@ from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
 _NEG_INF = -1e30
 
 
+def infer_batch_axes(
+    mesh: Mesh, axis_name: str, batch_size: int
+) -> tuple[str, ...]:
+    """Batch-dim mesh axes for a sequence-parallel op: shard over the
+    data/fsdp axes present in the mesh, but only while the batch size
+    stays evenly divisible (shard_map rejects ragged shards). Shared by
+    ring and Ulysses attention so both modes always agree on the spec.
+    """
+    batch_axes: tuple[str, ...] = ()
+    shards = 1
+    for a in (AXIS_DATA, AXIS_FSDP):
+        if a in mesh.axis_names and a != axis_name:
+            size = shards * mesh.shape[a]
+            if size > 1 and batch_size % size == 0:
+                batch_axes += (a,)
+                shards = size
+    return batch_axes
+
+
+
 def _local_block(q, k, v, q_off, k_off, causal, align=0):
     """Scores of local Q against one K/V shard, with global-position mask.
     Shapes: q [b,h,sq,d], k/v [b,h,sk,d]; returns (scores-softmax stats).
@@ -133,17 +153,7 @@ def ring_attention(
     same sharding as Q.
     """
     if batch_axes is None:
-        # Shard batch over the data/fsdp axes present in the mesh, but only
-        # while the batch size stays evenly divisible (shard_map rejects
-        # ragged shards).
-        batch_axes = ()
-        shards = 1
-        for a in (AXIS_DATA, AXIS_FSDP):
-            if a in mesh.axis_names and a != axis_name:
-                size = shards * mesh.shape[a]
-                if size > 1 and q.shape[0] % size == 0:
-                    batch_axes += (a,)
-                    shards = size
+        batch_axes = infer_batch_axes(mesh, axis_name, q.shape[0])
     batch_dim = batch_axes if batch_axes else None
     spec = P(batch_dim, None, axis_name, None)
     fn = shard_map(

@@ -7,10 +7,14 @@ device then holds ALL positions for H/P heads, runs ordinary (fused)
 attention locally, and a reverse all_to_all restores the sequence
 shard. Two collectives total per attention call (vs P-1 ring steps):
 cheaper when the head count divides well across the mesh and the
-all-to-all bandwidth is good (single-host ICI), while the ring wins
-when sequence lengths dwarf what one device can hold for even a single
-head. Both modes shard activations over the same `seq` mesh axis, so
-models can switch per config.
+all-to-all bandwidth is good (single-host ICI). The ring is the
+long-context training mode: Ulysses needs the FULL sequence resident
+per device, and past the fused kernel's VMEM window the local call
+falls back to reference attention whose S x S scores (and the fused
+path's recomputed backward) scale quadratically — use it for moderate
+sequence lengths, the ring when S dwarfs per-device memory. Both modes
+shard activations over the same `seq` mesh axis, so models can switch
+per config.
 
 No reference analogue — long-context subsystem per the TPU mandate.
 """
@@ -24,26 +28,27 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from walkai_nos_tpu.ops.attention import flash_attention
-from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
+from walkai_nos_tpu.ops.ring_attention import infer_batch_axes
+from walkai_nos_tpu.parallel.mesh import AXIS_SEQ
 
 
 def _local(q, k, v, *, axis_name: str, causal: bool):
     """Per-device body: [B, H, S/P, D] -> swap to [B, H/P, S, D] ->
-    local fused attention over the full sequence -> swap back."""
+    local fused attention over the full sequence -> swap back.
 
-    def scatter_heads(x):
-        return jax.lax.all_to_all(
-            x, axis_name, split_axis=1, concat_axis=2, tiled=True
-        )
+    q/k/v are stacked into one array so the head scatter is a single
+    all_to_all — two collectives per call total, the cost model the
+    mode is chosen by."""
+    import jax.numpy as jnp
 
-    def scatter_seq(x):
-        return jax.lax.all_to_all(
-            x, axis_name, split_axis=2, concat_axis=1, tiled=True
-        )
-
-    q, k, v = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    o = flash_attention(q, k, v, causal=causal)
-    return scatter_seq(o)
+    qkv = jnp.stack([q, k, v])  # [3, B, H, S/P, D]
+    qkv = jax.lax.all_to_all(
+        qkv, axis_name, split_axis=2, concat_axis=3, tiled=True
+    )  # [3, B, H/P, S, D]
+    o = flash_attention(qkv[0], qkv[1], qkv[2], causal=causal)
+    return jax.lax.all_to_all(
+        o, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
 
 
 def ulysses_attention(
@@ -70,14 +75,7 @@ def ulysses_attention(
             f"{axis_name!r} axis; use ring attention for this layout"
         )
     if batch_axes is None:
-        batch_axes = ()
-        shards = 1
-        for a in (AXIS_DATA, AXIS_FSDP):
-            if a in mesh.axis_names and a != axis_name:
-                size = shards * mesh.shape[a]
-                if size > 1 and q.shape[0] % size == 0:
-                    batch_axes += (a,)
-                    shards = size
+        batch_axes = infer_batch_axes(mesh, axis_name, q.shape[0])
     spec = P(batch_axes if batch_axes else None, None, axis_name, None)
     fn = shard_map(
         functools.partial(_local, axis_name=axis_name, causal=causal),
