@@ -263,13 +263,25 @@ class TestSharedWatchOverTheWire:
             t1.start()
             started.wait(5)
             t2 = None
-            try:
-                # First subscriber rides the outage: RESYNC framing with
-                # only the survivor re-mentioned.
-                _eventually(
-                    lambda: sum(1 for e, _ in first if e == "SYNCED") >= 2,
-                    msg="outage resynced",
+
+            def outage_resolved():
+                # Two orderings are legitimate: the subscriber rides the
+                # outage (sees RESYNC framing, two SYNCEDs), or under
+                # load it only acquires the stream lock after the relist
+                # and replays the already-pruned world (one SYNCED, no
+                # n2). Either way the stream is post-outage.
+                synced = sum(1 for e, _ in first if e == "SYNCED")
+                if synced >= 2:
+                    return True
+                saw_n2 = any(
+                    o.get("metadata", {}).get("name") == "n2"
+                    for e, o in first
+                    if e in ("ADDED", "MODIFIED")
                 )
+                return synced >= 1 and not saw_n2
+
+            try:
+                _eventually(outage_resolved, msg="outage resolved")
                 # Late joiner AFTER the outage: snapshot must contain
                 # only the survivor.
                 late: list = []
