@@ -29,10 +29,57 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def _drive_compute() -> None:
+    """Train a tiny LM through the full loop (data pipeline -> sharded
+    step -> checkpoint) and generate from it with the KV cache."""
+    import numpy as np
+
+    from walkai_nos_tpu.models.data import prefetch_to_device, token_batches
+    from walkai_nos_tpu.models.decode import make_generate_fn
+    from walkai_nos_tpu.models.lm import (
+        LMConfig,
+        init_lm_state,
+        make_lm_train_step,
+    )
+    from walkai_nos_tpu.models.trainer import fit
+    from walkai_nos_tpu.parallel.mesh import build_mesh
+    from walkai_nos_tpu.parallel.sharding import batch_sharding
+
+    cfg = LMConfig(
+        vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+        max_seq_len=16,
+    )
+    mesh = build_mesh(jax.devices())
+    corpus = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, 4096, dtype=np.int32
+    )
+    batches = prefetch_to_device(
+        token_batches(corpus, batch_size=8, seq_len=cfg.max_seq_len),
+        sharding=batch_sharding(mesh),
+    )
+    state = init_lm_state(cfg, mesh, jax.random.PRNGKey(0))
+    result = fit(
+        state, make_lm_train_step(cfg, mesh), batches,
+        num_steps=8, log_every=0,
+    )
+    assert result.steps_run == 8, result.steps_run
+    import jax.numpy as jnp
+
+    out = make_generate_fn(cfg)(
+        result.state.params,
+        jnp.asarray([[1, 2, 3, 4]], jnp.int32),
+        max_new_tokens=4,
+    )
+    assert out.shape == (1, 4)
+    print("compute ok: trained 8 steps, generated", out[0].tolist())
+
+
 def main() -> int:
     for name in ("drive_nos", "drive_quota"):
         print(f"=== {name}")
         runpy.run_path(os.path.join(REPO, "hack", f"{name}.py"))
+    print("=== compute runtime (train loop + decode)")
+    _drive_compute()
     print("=== jax entry points (subprocess: needs the 8-device flag "
           "before jax backend init)")
     env = dict(
