@@ -359,6 +359,8 @@ bool cells_to_chips(const Slice& s, std::vector<int>* chips) {
 
 extern "C" {
 
+int tpudev_abi_version(void) { return TPUDEV_ABI_VERSION; }
+
 tpudev_status tpudev_init(void) {
   std::lock_guard<std::mutex> g(g_state.mu);
   if (g_state.initialized) return TPUDEV_OK;

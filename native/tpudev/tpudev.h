@@ -49,6 +49,14 @@ typedef enum {
   TPUDEV_EINVAL = 5,    /* malformed placement string                  */
 } tpudev_status;
 
+/* Bumped on any ABI-visible change (signatures, JSON schemas, the
+ * placement grammar). The Python wrapper refuses a mismatched .so at
+ * load — a stale library after a partial deploy fails loudly instead
+ * of corrupting slice records. */
+#define TPUDEV_ABI_VERSION 1
+
+int tpudev_abi_version(void);
+
 /* Enumerate chips + mesh, open state dir. Idempotent. */
 tpudev_status tpudev_init(void);
 void tpudev_shutdown(void);

@@ -226,3 +226,22 @@ class TestFakeGrammarParity:
             fake.create_slices([Placement("2x2", (0, 0), (2, 3))])
         with pytest.raises(GenericError):
             fake.create_slices([Placement("bogus", (0, 0), (2, 2))])
+
+
+class TestAbiHandshake:
+    def test_matching_version_loads(self, libtpudev):
+        # Every constructed client already passed the handshake; check
+        # the exported symbol agrees with the wrapper's constant.
+        import ctypes
+
+        from walkai_nos_tpu.tpudev import native
+
+        lib = ctypes.CDLL(str(libtpudev))
+        assert int(lib.tpudev_abi_version()) == native.EXPECTED_ABI_VERSION
+
+    def test_mismatch_refused(self, libtpudev, monkeypatch):
+        from walkai_nos_tpu.tpudev import native
+
+        monkeypatch.setattr(native, "EXPECTED_ABI_VERSION", 999)
+        with pytest.raises(GenericError, match="ABI mismatch"):
+            native.NativeTpudevClient(lib_path=str(libtpudev))

@@ -25,6 +25,9 @@ from walkai_nos_tpu.tpudev.client import (
     TpudevClient,
 )
 
+# Must match TPUDEV_ABI_VERSION in native/tpudev/tpudev.h.
+EXPECTED_ABI_VERSION = 1
+
 _OK = 0
 _ERR = 1
 _NOTFOUND = 2
@@ -64,6 +67,7 @@ class NativeTpudevClient(TpudevClient):
                 "`make -C native/tpudev`)"
             )
         self._lib = ctypes.CDLL(path)
+        self._check_abi(path)
         self._lib.tpudev_last_error.restype = ctypes.c_char_p
         self._lib.tpudev_get_topology.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
@@ -76,6 +80,20 @@ class NativeTpudevClient(TpudevClient):
         ]
         self._lib.tpudev_delete_slice.argtypes = [ctypes.c_char_p]
         self._check(self._lib.tpudev_init(), "tpudev_init")
+
+    def _check_abi(self, path: str) -> None:
+        """Refuse a mismatched .so at load: a stale library after a
+        partial deploy must fail loudly, not corrupt slice records."""
+        try:
+            version = int(self._lib.tpudev_abi_version())
+        except AttributeError:
+            version = 0  # predates the handshake entirely
+        if version != EXPECTED_ABI_VERSION:
+            raise GenericError(
+                f"libtpudev ABI mismatch at {path}: library reports "
+                f"{version}, wrapper expects {EXPECTED_ABI_VERSION} — "
+                "rebuild with `make -C native/tpudev`"
+            )
 
     # ----------------------------------------------------------------- errors
 
