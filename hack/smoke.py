@@ -57,12 +57,17 @@ def _drive_compute() -> None:
         token_batches(corpus, batch_size=8, seq_len=cfg.max_seq_len),
         sharding=batch_sharding(mesh),
     )
+    import tempfile
+
     state = init_lm_state(cfg, mesh, jax.random.PRNGKey(0))
-    result = fit(
-        state, make_lm_train_step(cfg, mesh), batches,
-        num_steps=8, log_every=0,
-    )
-    assert result.steps_run == 8, result.steps_run
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        result = fit(
+            state, make_lm_train_step(cfg, mesh), batches,
+            num_steps=8, log_every=0,
+            checkpoint_dir=ckpt_dir, checkpoint_every=4,
+        )
+        assert result.steps_run == 8, result.steps_run
+        assert any(os.scandir(ckpt_dir)), "no checkpoint written"
     import jax.numpy as jnp
 
     out = make_generate_fn(cfg)(

@@ -245,3 +245,15 @@ class TestAbiHandshake:
         monkeypatch.setattr(native, "EXPECTED_ABI_VERSION", 999)
         with pytest.raises(GenericError, match="ABI mismatch"):
             native.NativeTpudevClient(lib_path=str(libtpudev))
+
+    def test_load_client_does_not_stub_over_a_mismatch(
+        self, libtpudev, monkeypatch
+    ):
+        """The stub fallback is for a MISSING library; a present-but-
+        wrong-ABI one must stop the process, not degrade silently."""
+        from walkai_nos_tpu.tpudev import native
+
+        monkeypatch.setenv("WALKAI_TPUDEV_LIB", str(libtpudev))
+        monkeypatch.setattr(native, "EXPECTED_ABI_VERSION", 999)
+        with pytest.raises(native.AbiMismatchError):
+            native.load_client()

@@ -28,6 +28,13 @@ from walkai_nos_tpu.tpudev.client import (
 # Must match TPUDEV_ABI_VERSION in native/tpudev/tpudev.h.
 EXPECTED_ABI_VERSION = 1
 
+
+class AbiMismatchError(GenericError):
+    """The loaded libtpudev.so speaks a different ABI than this
+    wrapper. Deliberately NOT absorbed by load_client's stub fallback:
+    a stale library after a partial deploy must stop the agent, not
+    silently degrade it to the noop stub."""
+
 _OK = 0
 _ERR = 1
 _NOTFOUND = 2
@@ -89,7 +96,7 @@ class NativeTpudevClient(TpudevClient):
         except AttributeError:
             version = 0  # predates the handshake entirely
         if version != EXPECTED_ABI_VERSION:
-            raise GenericError(
+            raise AbiMismatchError(
                 f"libtpudev ABI mismatch at {path}: library reports "
                 f"{version}, wrapper expects {EXPECTED_ABI_VERSION} — "
                 "rebuild with `make -C native/tpudev`"
@@ -202,6 +209,8 @@ def load_client() -> TpudevClient:
     with the reason logged."""
     try:
         return NativeTpudevClient()
+    except AbiMismatchError:
+        raise  # fail loudly: the library exists but is the wrong build
     except (GenericError, OSError, AttributeError) as e:
         import logging
 
