@@ -3,7 +3,9 @@
 Hot ops for the flagship workloads. Every kernel ships with a pure-XLA
 reference implementation: the dispatcher uses Pallas on TPU backends and the
 reference elsewhere, and tests compare the two in Pallas interpret mode on
-the CPU mesh (no hardware in CI — SURVEY.md §4).
+the CPU mesh (no hardware in CI — SURVEY.md §4). Two sequence-parallel
+modes ride the same `seq` mesh axis: ring attention (K/V ppermute ring,
+the long-context mode) and Ulysses (head/sequence all-to-all swap).
 """
 
 from walkai_nos_tpu.ops.attention import (  # noqa: F401
