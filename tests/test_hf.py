@@ -82,3 +82,43 @@ class TestConfigGuards:
         hf.config.n_inner = 100  # not a multiple of n_embd=32
         with pytest.raises(ValueError, match="multiple of n_embd"):
             config_from_gpt2(hf.config)
+
+
+class TestExport:
+    def test_round_trip_through_torch(self):
+        """import -> export -> torch forward must equal the original
+        torch forward exactly (the TPU-trained weights land back in the
+        torch ecosystem unchanged)."""
+        from walkai_nos_tpu.models.hf import (
+            load_gpt2,
+            state_dict_from_params,
+        )
+
+        hf = _hf_model(seed=2)
+        cfg, params = load_gpt2(hf)
+        sd = state_dict_from_params(params, cfg)
+        clone = _hf_model(seed=3)  # different random init
+        clone.load_state_dict(sd, strict=False)
+        tokens = torch.tensor(
+            np.random.default_rng(2).integers(0, 64, (2, 12))
+        )
+        with torch.no_grad():
+            a = hf(tokens).logits.numpy()
+            b = clone(tokens).logits.numpy()
+        assert np.max(np.abs(a - b)) < 1e-5
+
+    def test_untied_head_rejected(self):
+        from walkai_nos_tpu.models.hf import (
+            load_gpt2,
+            state_dict_from_params,
+        )
+        import jax.numpy as jnp
+
+        hf = _hf_model()
+        cfg, params = load_gpt2(hf)
+        params = dict(params, head={
+            "kernel": jnp.asarray(params["head"]["kernel"]) + 1.0,
+            "bias": params["head"]["bias"],
+        })
+        with pytest.raises(ValueError, match="tied"):
+            state_dict_from_params(params, cfg)

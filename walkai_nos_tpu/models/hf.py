@@ -110,3 +110,55 @@ def load_gpt2(model_or_name) -> tuple[LMConfig, dict]:
         model_or_name = GPT2LMHeadModel.from_pretrained(model_or_name)
     cfg = config_from_gpt2(model_or_name.config)
     return cfg, params_from_gpt2(model_or_name.state_dict(), cfg)
+
+
+def state_dict_from_params(params: Mapping, cfg: LMConfig) -> dict:
+    """The reverse mapping: DecoderLM params -> a GPT2LMHeadModel
+    state_dict (torch tensors), so models trained or fine-tuned on TPU
+    slices round-trip back into the torch ecosystem.
+
+    The LM head must be tied to the token embedding (GPT-2's layout);
+    an untied head that diverged from wte^T cannot be represented and
+    is rejected rather than silently dropped.
+    """
+    import numpy as np
+    import torch
+
+    def t(x) -> "torch.Tensor":
+        return torch.from_numpy(np.asarray(x, dtype=np.float32).copy())
+
+    wte = np.asarray(params["embed"]["embedding"], np.float32)
+    head = np.asarray(params["head"]["kernel"], np.float32)
+    if not np.allclose(head, wte.T, atol=1e-5):
+        raise ValueError(
+            "head kernel is not tied to the token embedding (wte^T); "
+            "GPT-2's layout cannot represent an untied head"
+        )
+    if np.any(np.asarray(params["head"]["bias"], np.float32) != 0.0):
+        raise ValueError("GPT-2 has no LM-head bias; found a nonzero one")
+
+    sd = {
+        "transformer.wte.weight": t(wte),
+        "transformer.wpe.weight": t(params["pos_embed"][0]),
+        "transformer.ln_f.weight": t(params["norm"]["scale"]),
+        "transformer.ln_f.bias": t(params["norm"]["bias"]),
+        "lm_head.weight": t(wte),
+    }
+    for i in range(cfg.num_layers):
+        block = params[f"block{i}"]
+        h = f"transformer.h.{i}"
+        sd[f"{h}.ln_1.weight"] = t(block["norm1"]["scale"])
+        sd[f"{h}.ln_1.bias"] = t(block["norm1"]["bias"])
+        sd[f"{h}.attn.c_attn.weight"] = t(block["attn"]["qkv"]["kernel"])
+        sd[f"{h}.attn.c_attn.bias"] = t(block["attn"]["qkv"]["bias"])
+        sd[f"{h}.attn.c_proj.weight"] = t(
+            block["attn"]["out_proj"]["kernel"]
+        )
+        sd[f"{h}.attn.c_proj.bias"] = t(block["attn"]["out_proj"]["bias"])
+        sd[f"{h}.ln_2.weight"] = t(block["norm2"]["scale"])
+        sd[f"{h}.ln_2.bias"] = t(block["norm2"]["bias"])
+        sd[f"{h}.mlp.c_fc.weight"] = t(block["fc1"]["kernel"])
+        sd[f"{h}.mlp.c_fc.bias"] = t(block["fc1"]["bias"])
+        sd[f"{h}.mlp.c_proj.weight"] = t(block["fc2"]["kernel"])
+        sd[f"{h}.mlp.c_proj.bias"] = t(block["fc2"]["bias"])
+    return sd
