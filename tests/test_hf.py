@@ -84,41 +84,90 @@ class TestConfigGuards:
             config_from_gpt2(hf.config)
 
 
+def _untied_clone():
+    cfg = transformers.GPT2Config(
+        n_embd=32, n_layer=2, n_head=2, n_positions=32, vocab_size=64,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+        tie_word_embeddings=False,
+    )
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
 class TestExport:
-    def test_round_trip_through_torch(self):
-        """import -> export -> torch forward must equal the original
-        torch forward exactly (the TPU-trained weights land back in the
-        torch ecosystem unchanged)."""
+    def test_trained_model_round_trips_through_torch(self):
+        """The feature's actual use case: import, TRAIN (untying the
+        head from the embedding), export — the torch forward of the
+        exported model must match our jax forward of the trained one."""
+        import jax.numpy as jnp
+
         from walkai_nos_tpu.models.hf import (
             load_gpt2,
             state_dict_from_params,
         )
+        from walkai_nos_tpu.models.lm import (
+            init_lm_state,
+            make_lm_train_step,
+        )
+        from walkai_nos_tpu.parallel.mesh import build_mesh
 
         hf = _hf_model(seed=2)
         cfg, params = load_gpt2(hf)
-        sd = state_dict_from_params(params, cfg)
-        clone = _hf_model(seed=3)  # different random init
-        clone.load_state_dict(sd, strict=False)
-        tokens = torch.tensor(
-            np.random.default_rng(2).integers(0, 64, (2, 12))
-        )
-        with torch.no_grad():
-            a = hf(tokens).logits.numpy()
-            b = clone(tokens).logits.numpy()
-        assert np.max(np.abs(a - b)) < 1e-5
+        mesh = build_mesh(jax.devices()[:1])
+        from walkai_nos_tpu.models.train import TrainState, make_optimizer
 
-    def test_untied_head_rejected(self):
+        tx = make_optimizer(1e-3)
+        state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+        step = make_lm_train_step(cfg, mesh)
+        tokens_np = np.random.default_rng(2).integers(0, 64, (2, 16))
+        state, _ = step(state, jnp.asarray(tokens_np))
+        trained = jax.device_get(state.params)
+        # The head really diverged from the embedding (untied training).
+        assert not np.allclose(
+            np.asarray(trained["head"]["kernel"]),
+            np.asarray(trained["embed"]["embedding"]).T,
+            atol=1e-6,
+        )
+
+        sd = state_dict_from_params(trained, cfg)
+        clone = _untied_clone()
+        missing, unexpected = clone.load_state_dict(sd, strict=False)
+        assert not unexpected, unexpected
+        eval_tokens = np.random.default_rng(3).integers(0, 64, (2, 12))
+        with torch.no_grad():
+            theirs = clone(torch.tensor(eval_tokens)).logits.numpy()
+        ours = np.asarray(
+            DecoderLM(cfg).apply(
+                {"params": trained}, jnp.asarray(eval_tokens)
+            )
+        )
+        assert np.max(np.abs(ours - theirs)) < 5e-4
+
+    def test_moe_layout_rejected(self):
+        from dataclasses import replace
+
         from walkai_nos_tpu.models.hf import (
             load_gpt2,
             state_dict_from_params,
         )
+
+        hf = _hf_model()
+        cfg, params = load_gpt2(hf)
+        with pytest.raises(ValueError, match="MoE"):
+            state_dict_from_params(params, replace(cfg, num_experts=2))
+
+    def test_head_bias_rejected(self):
         import jax.numpy as jnp
+
+        from walkai_nos_tpu.models.hf import (
+            load_gpt2,
+            state_dict_from_params,
+        )
 
         hf = _hf_model()
         cfg, params = load_gpt2(hf)
         params = dict(params, head={
-            "kernel": jnp.asarray(params["head"]["kernel"]) + 1.0,
-            "bias": params["head"]["bias"],
+            "kernel": params["head"]["kernel"],
+            "bias": jnp.ones((cfg.vocab_size,), jnp.float32),
         })
-        with pytest.raises(ValueError, match="tied"):
+        with pytest.raises(ValueError, match="head_bias"):
             state_dict_from_params(params, cfg)

@@ -44,6 +44,7 @@ def config_from_gpt2(hf_config) -> LMConfig:
         max_seq_len=hf_config.n_positions,
         dtype="float32",
         layer_norm_eps=hf_config.layer_norm_epsilon,
+        head_bias=False,  # GPT-2's lm_head is bias-free
     )
 
 
@@ -76,11 +77,9 @@ def params_from_gpt2(state_dict: Mapping, cfg: LMConfig) -> dict:
         "embed": {"embedding": jnp.asarray(wte)},
         "pos_embed": jnp.asarray(_np(sd["wpe.weight"]))[None],
         "norm": ln("ln_f"),
-        # GPT-2 ties the LM head to the token embedding.
-        "head": {
-            "kernel": jnp.asarray(wte.T),
-            "bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
-        },
+        # GPT-2 ties the LM head to the token embedding at import;
+        # training may untie it (head_bias=False keeps it exportable).
+        "head": {"kernel": jnp.asarray(wte.T)},
     }
     for i in range(cfg.num_layers):
         h = f"h.{i}"
@@ -117,32 +116,39 @@ def state_dict_from_params(params: Mapping, cfg: LMConfig) -> dict:
     state_dict (torch tensors), so models trained or fine-tuned on TPU
     slices round-trip back into the torch ecosystem.
 
-    The LM head must be tied to the token embedding (GPT-2's layout);
-    an untied head that diverged from wte^T cannot be represented and
-    is rejected rather than silently dropped.
+    Training unties the head from the embedding — the export carries
+    the head as its own lm_head.weight, so load the result into a
+    GPT2LMHeadModel built with tie_word_embeddings=False (with tying
+    on, HF shares the tensor and the last load wins). GPT-2's lm_head
+    is bias-free: import with head_bias=False (config_from_gpt2 does)
+    to keep trained models representable; a dense-MLP DecoderLM is
+    required (MoE/pipelined layouts have no GPT-2 analogue).
     """
-    import numpy as np
     import torch
 
     def t(x) -> "torch.Tensor":
-        return torch.from_numpy(np.asarray(x, dtype=np.float32).copy())
+        return torch.from_numpy(np.array(x, dtype=np.float32))
 
-    wte = np.asarray(params["embed"]["embedding"], np.float32)
-    head = np.asarray(params["head"]["kernel"], np.float32)
-    if not np.allclose(head, wte.T, atol=1e-5):
+    if cfg.num_experts > 0:
         raise ValueError(
-            "head kernel is not tied to the token embedding (wte^T); "
-            "GPT-2's layout cannot represent an untied head"
+            "MoE blocks have no GPT-2 analogue; export a dense "
+            "(num_experts=0) DecoderLM"
         )
-    if np.any(np.asarray(params["head"]["bias"], np.float32) != 0.0):
-        raise ValueError("GPT-2 has no LM-head bias; found a nonzero one")
+    head = params["head"]
+    bias = np.asarray(head.get("bias", 0.0), np.float32)
+    if np.max(np.abs(bias), initial=0.0) > 1e-6:
+        raise ValueError(
+            "GPT-2 has no LM-head bias; train with head_bias=False "
+            "(config_from_gpt2 imports that way) to keep the model "
+            "exportable"
+        )
 
     sd = {
-        "transformer.wte.weight": t(wte),
+        "transformer.wte.weight": t(params["embed"]["embedding"]),
         "transformer.wpe.weight": t(params["pos_embed"][0]),
         "transformer.ln_f.weight": t(params["norm"]["scale"]),
         "transformer.ln_f.bias": t(params["norm"]["bias"]),
-        "lm_head.weight": t(wte),
+        "lm_head.weight": t(np.asarray(head["kernel"], np.float32).T),
     }
     for i in range(cfg.num_layers):
         block = params[f"block{i}"]

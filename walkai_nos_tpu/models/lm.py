@@ -51,6 +51,9 @@ class LMConfig:
     # LayerNorm epsilon — 1e-6 (flax default); HF GPT-2 checkpoints
     # use 1e-5 (models/hf.py sets this when importing weights).
     layer_norm_eps: float = 1e-6
+    # GPT-2's LM head is bias-free; models/hf.py imports with
+    # head_bias=False so a trained model exports back exactly.
+    head_bias: bool = True
     # Rematerialization: recompute each block's activations in the
     # backward pass instead of storing them (jax.checkpoint) — the
     # standard HBM-for-FLOPs trade that lets long sequences / deep
@@ -220,7 +223,10 @@ class DecoderLM(nn.Module):
         x = nn.LayerNorm(
             epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm"
         )(x)
-        return nn.Dense(c.vocab_size, dtype=jnp.float32, name="head")(x)
+        return nn.Dense(
+            c.vocab_size, dtype=jnp.float32, use_bias=c.head_bias,
+            name="head",
+        )(x)
 
     def init_params(self, rng: jax.Array):
         dummy = jnp.zeros((1, self.cfg.max_seq_len), jnp.int32)
