@@ -88,3 +88,48 @@ class TestGuards:
 
         with pytest.raises(ValueError, match="ring"):
             make_generate_fn(replace(CFG, use_ring_attention=True))
+
+
+class TestTruncatedSampling:
+    def _params(self):
+        model = DecoderLM(CFG)
+        return model.init_params(jax.random.PRNGKey(0))
+
+    def test_top_k_one_equals_greedy(self):
+        """top_k=1 collapses sampling to argmax at any temperature."""
+        params = self._params()
+        greedy = make_generate_fn(CFG)(
+            params, _prompt(), max_new_tokens=6
+        )
+        topk1 = make_generate_fn(CFG, temperature=1.0, top_k=1)(
+            params, _prompt(), max_new_tokens=6,
+            rng=jax.random.PRNGKey(5),
+        )
+        assert jnp.array_equal(greedy, topk1)
+
+    def test_top_p_tiny_equals_greedy(self):
+        """A nucleus smaller than the top token's mass keeps only it."""
+        params = self._params()
+        greedy = make_generate_fn(CFG)(
+            params, _prompt(), max_new_tokens=6
+        )
+        nucleus = make_generate_fn(CFG, temperature=1.0, top_p=1e-6)(
+            params, _prompt(), max_new_tokens=6,
+            rng=jax.random.PRNGKey(6),
+        )
+        assert jnp.array_equal(greedy, nucleus)
+
+    def test_truncated_sampling_stays_in_vocab(self):
+        params = self._params()
+        out = make_generate_fn(CFG, temperature=1.0, top_k=8, top_p=0.9)(
+            params, _prompt(), max_new_tokens=8,
+            rng=jax.random.PRNGKey(7),
+        )
+        assert out.shape == (2, 8)
+        assert bool(jnp.all((0 <= out) & (out < CFG.vocab_size)))
+
+    def test_bad_knobs_rejected(self):
+        with pytest.raises(ValueError, match="top_p"):
+            make_generate_fn(CFG, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            make_generate_fn(CFG, top_k=-1)

@@ -195,3 +195,71 @@ class TestProfiling:
         )
         assert result.steps_run == 3
         assert any(p.is_file() for p in (tmp_path / "t").rglob("*"))
+
+
+class TestEvaluate:
+    def test_mean_loss_over_batches(self):
+        from walkai_nos_tpu.models.lm import DecoderLM, lm_loss
+        from walkai_nos_tpu.models.trainer import evaluate
+
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        model = DecoderLM(CFG, mesh)
+
+        @jax.jit
+        def loss_fn(params, tokens):
+            return lm_loss(model.apply({"params": params}, tokens), tokens)
+
+        pipeline = TestFit._pipeline(None, mesh, epochs=1)
+        loss = evaluate(state, loss_fn, pipeline, max_batches=4)
+        assert 0.0 < loss < 20.0
+
+    def test_empty_iterator_rejected(self):
+        from walkai_nos_tpu.models.trainer import evaluate
+
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="no batches"):
+            evaluate(state, lambda p, b: jnp.zeros(()), iter(()))
+
+
+class TestOptimizerKnobs:
+    def test_clip_and_schedule_train(self):
+        from dataclasses import replace
+
+        from walkai_nos_tpu.models.lm import DecoderLM, lm_loss
+        from walkai_nos_tpu.models.train import (
+            TrainState,
+            make_optimizer,
+        )
+        import optax
+
+        mesh = build_mesh(jax.devices())
+        model = DecoderLM(CFG, mesh)
+        tx = make_optimizer(
+            1e-3, clip_norm=1.0, warmup_steps=2, decay_steps=10
+        )
+        params = model.init_params(jax.random.PRNGKey(0))
+        state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, CFG.vocab_size, (4, 16))
+        )
+
+        @jax.jit
+        def step(state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: lm_loss(model.apply({"params": p}, tokens), tokens)
+            )(state.params)
+            updates, opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            return TrainState(
+                optax.apply_updates(state.params, updates),
+                opt_state, state.step + 1,
+            ), loss
+
+        losses = []
+        for _ in range(6):
+            state, loss = step(state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

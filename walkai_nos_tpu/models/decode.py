@@ -20,11 +20,45 @@ from jax.sharding import Mesh
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
 
 
-def _sample(logits: jax.Array, temperature: float, rng: jax.Array):
-    """logits [batch, vocab] -> tokens [batch]."""
+def _sample(
+    logits: jax.Array,
+    temperature: float,
+    rng: jax.Array,
+    top_k: int = 0,
+    top_p: float = 1.0,
+):
+    """logits [batch, vocab] -> tokens [batch].
+
+    Greedy at temperature 0; otherwise temperature sampling, optionally
+    truncated to the `top_k` highest-probability tokens and/or the
+    `top_p` nucleus (smallest set with cumulative probability >= top_p).
+    Static-shaped: both filters are where-masks, no dynamic shapes.
+    """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    return jax.random.categorical(rng, logits / temperature, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # Keep every token whose PRECEDING cumulative mass is < top_p
+        # (always keeps the most probable token).
+        keep = jnp.concatenate(
+            [
+                jnp.ones((logits.shape[0], 1), bool),
+                cumulative[:, :-1] < top_p,
+            ],
+            axis=-1,
+        )
+        # Threshold = smallest kept logit per row.
+        threshold = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
 
 
 def make_generate_fn(
@@ -32,6 +66,8 @@ def make_generate_fn(
     mesh: Mesh | None = None,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Build a jitted `(params, prompt, rng) -> tokens` generator.
 
@@ -39,7 +75,13 @@ def make_generate_fn(
     [batch, max_new_tokens] (prompt not repeated). `max_new_tokens` is a
     static argument of the returned function. Requires
     prompt_len + max_new_tokens <= cfg.max_seq_len (the cache size).
+    Sampling: greedy at temperature 0, else temperature sampling with
+    optional top-k and/or nucleus (top-p) truncation.
     """
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"top_k must be >= 0 and top_p in (0, 1]; got {top_k}, {top_p}"
+        )
     if cfg.use_ring_attention or cfg.use_ulysses_attention:
         raise ValueError(
             "decode uses the KV-cache path; build the generate config "
@@ -73,7 +115,7 @@ def make_generate_fn(
             prompt, decode=True, mutable=["cache"],
         )
         rng, sub = jax.random.split(rng)
-        first = _sample(logits[:, -1], temperature, sub)
+        first = _sample(logits[:, -1], temperature, sub, top_k, top_p)
 
         def step(carry, _):
             cache, token, rng = carry
@@ -82,7 +124,7 @@ def make_generate_fn(
                 token[:, None], decode=True, mutable=["cache"],
             )
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits[:, -1], temperature, sub)
+            nxt = _sample(logits[:, -1], temperature, sub, top_k, top_p)
             return (variables["cache"], nxt, rng), nxt
 
         _, rest = jax.lax.scan(

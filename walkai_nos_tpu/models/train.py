@@ -45,8 +45,30 @@ def detection_loss(outputs, batch, *, num_classes: int) -> jax.Array:
     return ce + l1
 
 
-def make_optimizer(lr: float = 1e-4) -> optax.GradientTransformation:
-    return optax.adamw(lr, weight_decay=1e-4)
+def make_optimizer(
+    lr: float = 1e-4,
+    *,
+    weight_decay: float = 1e-4,
+    clip_norm: float | None = None,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+) -> optax.GradientTransformation:
+    """AdamW, optionally with global-norm clipping and a linear-warmup
+    cosine-decay schedule (`decay_steps` counts post-warmup steps;
+    either knob alone works, both zero keeps the constant rate)."""
+    schedule: optax.Schedule | float = lr
+    if warmup_steps or decay_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps else lr,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=max(warmup_steps + decay_steps, warmup_steps + 1),
+            end_value=0.0,
+        )
+    tx = optax.adamw(schedule, weight_decay=weight_decay)
+    if clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+    return tx
 
 
 def init_train_state(

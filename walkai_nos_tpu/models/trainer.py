@@ -33,6 +33,31 @@ class FitResult:
     resumed_from: int | None = None
 
 
+def evaluate(
+    state: TrainState,
+    loss_fn: Callable[[object, object], jax.Array],
+    batches: Iterator,
+    *,
+    max_batches: int | None = None,
+) -> float:
+    """Mean loss of `loss_fn(params, batch)` over `batches`.
+
+    `loss_fn` should be jitted by the caller (e.g. the model's loss
+    closed over with `jax.jit`); losses are fetched once at the end so
+    dispatch stays async across the evaluation.
+    """
+    losses = []
+    for i, batch in enumerate(batches):
+        if max_batches is not None and i >= max_batches:
+            break
+        losses.append(loss_fn(state.params, batch))
+    if not losses:
+        raise ValueError("evaluate() received no batches")
+    return float(
+        jax.device_get(sum(losses)) / len(losses)
+    )
+
+
 def fit(
     state: TrainState,
     step_fn: Callable[[TrainState, object], tuple[TrainState, jax.Array]],
