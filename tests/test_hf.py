@@ -84,15 +84,6 @@ class TestConfigGuards:
             config_from_gpt2(hf.config)
 
 
-def _untied_clone():
-    cfg = transformers.GPT2Config(
-        n_embd=32, n_layer=2, n_head=2, n_positions=32, vocab_size=64,
-        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
-        tie_word_embeddings=False,
-    )
-    return transformers.GPT2LMHeadModel(cfg).eval()
-
-
 class TestExport:
     def test_trained_model_round_trips_through_torch(self):
         """The feature's actual use case: import, TRAIN (untying the
@@ -128,10 +119,18 @@ class TestExport:
             atol=1e-6,
         )
 
-        sd = state_dict_from_params(trained, cfg)
-        clone = _untied_clone()
+        from walkai_nos_tpu.models.hf import export_gpt2
+
+        hf_config, sd = export_gpt2(trained, cfg)
+        assert hf_config.tie_word_embeddings is False
+        clone = transformers.GPT2LMHeadModel(hf_config).eval()
         missing, unexpected = clone.load_state_dict(sd, strict=False)
         assert not unexpected, unexpected
+
+        # The low-level path without acknowledgement refuses the
+        # untied head (loading it tied would corrupt the embedding).
+        with pytest.raises(ValueError, match="untied"):
+            state_dict_from_params(trained, cfg)
         eval_tokens = np.random.default_rng(3).integers(0, 64, (2, 12))
         with torch.no_grad():
             theirs = clone(torch.tensor(eval_tokens)).logits.numpy()

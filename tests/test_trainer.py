@@ -263,3 +263,21 @@ class TestOptimizerKnobs:
             state, loss = step(state, tokens)
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+    def test_warmup_alone_holds_peak_rate(self):
+        """warmup_steps without decay_steps must ramp to the peak and
+        HOLD it — a zero-length cosine tail would silently freeze the
+        rate at 0 one step past warmup."""
+        import optax
+
+        from walkai_nos_tpu.models.train import make_optimizer
+
+        tx = make_optimizer(1e-3, warmup_steps=5)
+        params = {"w": jnp.ones((3,))}
+        state = tx.state = tx.init(params)
+        grads = {"w": jnp.ones((3,))}
+        for _ in range(8):
+            updates, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        # Step 8 is past warmup: updates must still be nonzero.
+        assert float(jnp.max(jnp.abs(updates["w"]))) > 0.0

@@ -37,26 +37,33 @@ def _sample(
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
-    if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cumulative = jnp.cumsum(probs, axis=-1)
-        # Keep every token whose PRECEDING cumulative mass is < top_p
-        # (always keeps the most probable token).
-        keep = jnp.concatenate(
-            [
-                jnp.ones((logits.shape[0], 1), bool),
-                cumulative[:, :-1] < top_p,
-            ],
-            axis=-1,
-        )
-        # Threshold = smallest kept logit per row.
-        threshold = jnp.min(
-            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
-        )
+    if top_k > 0 or top_p < 1.0:
+        # One descending sort serves both filters.
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        threshold = jnp.full((logits.shape[0], 1), -jnp.inf)
+        if top_k > 0:
+            threshold = jnp.maximum(
+                threshold, sorted_desc[:, top_k - 1][:, None]
+            )
+        if top_p < 1.0:
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cumulative = jnp.cumsum(probs, axis=-1)
+            # Keep every token whose PRECEDING cumulative mass is
+            # < top_p (always keeps the most probable token).
+            keep = jnp.concatenate(
+                [
+                    jnp.ones((logits.shape[0], 1), bool),
+                    cumulative[:, :-1] < top_p,
+                ],
+                axis=-1,
+            )
+            threshold = jnp.maximum(
+                threshold,
+                jnp.min(
+                    jnp.where(keep, sorted_desc, jnp.inf),
+                    axis=-1, keepdims=True,
+                ),
+            )
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
@@ -78,6 +85,11 @@ def make_generate_fn(
     Sampling: greedy at temperature 0, else temperature sampling with
     optional top-k and/or nucleus (top-p) truncation.
     """
+    if temperature < 0.0:
+        raise ValueError(
+            f"temperature must be >= 0 (a negative one inverts the "
+            f"distribution); got {temperature}"
+        )
     if top_k < 0 or not 0.0 < top_p <= 1.0:
         raise ValueError(
             f"top_k must be >= 0 and top_p in (0, 1]; got {top_k}, {top_p}"

@@ -111,20 +111,68 @@ def load_gpt2(model_or_name) -> tuple[LMConfig, dict]:
     return cfg, params_from_gpt2(model_or_name.state_dict(), cfg)
 
 
-def state_dict_from_params(params: Mapping, cfg: LMConfig) -> dict:
+def heads_are_tied(params: Mapping, atol: float = 1e-5) -> bool:
+    """True when the LM head still equals the token embedding (wte^T)."""
+    return bool(np.allclose(
+        np.asarray(params["head"]["kernel"], np.float32),
+        np.asarray(params["embed"]["embedding"], np.float32).T,
+        atol=atol,
+    ))
+
+
+def export_gpt2(params: Mapping, cfg: LMConfig):
+    """(GPT2Config, state_dict): the safe export entry point.
+
+    Builds the config with `tie_word_embeddings` matching the actual
+    tie state of `params`, so `GPT2LMHeadModel(config)` +
+    `load_state_dict(sd, strict=False)` is always faithful — loading an
+    untied head into a TIED model would silently overwrite the token
+    embedding (HF shares the tensor; the last copy wins).
+    """
+    from transformers import GPT2Config
+
+    tied = heads_are_tied(params)
+    config = GPT2Config(
+        vocab_size=cfg.vocab_size,
+        n_embd=cfg.hidden_dim,
+        n_layer=cfg.num_layers,
+        n_head=cfg.num_heads,
+        n_inner=cfg.mlp_ratio * cfg.hidden_dim,
+        n_positions=cfg.max_seq_len,
+        layer_norm_epsilon=cfg.layer_norm_eps,
+        activation_function="gelu_new",
+        tie_word_embeddings=tied,
+    )
+    return config, state_dict_from_params(params, cfg, untied_ok=not tied)
+
+
+def state_dict_from_params(
+    params: Mapping, cfg: LMConfig, *, untied_ok: bool = False
+) -> dict:
     """The reverse mapping: DecoderLM params -> a GPT2LMHeadModel
     state_dict (torch tensors), so models trained or fine-tuned on TPU
     slices round-trip back into the torch ecosystem.
 
-    Training unties the head from the embedding — the export carries
-    the head as its own lm_head.weight, so load the result into a
-    GPT2LMHeadModel built with tie_word_embeddings=False (with tying
-    on, HF shares the tensor and the last load wins). GPT-2's lm_head
-    is bias-free: import with head_bias=False (config_from_gpt2 does)
-    to keep trained models representable; a dense-MLP DecoderLM is
-    required (MoE/pipelined layouts have no GPT-2 analogue).
+    Training unties the head from the embedding; an untied export is
+    only faithful when loaded into a GPT2LMHeadModel built with
+    tie_word_embeddings=False (with tying on, HF shares the tensor and
+    the last load silently overwrites the token embedding). Pass
+    `untied_ok=True` to acknowledge that, or use `export_gpt2`, which
+    builds the matching config for you. GPT-2's lm_head is bias-free:
+    import with head_bias=False (config_from_gpt2 does) to keep trained
+    models representable; a dense-MLP DecoderLM is required
+    (MoE/pipelined layouts have no GPT-2 analogue).
     """
     import torch
+
+    if not untied_ok and not heads_are_tied(params):
+        raise ValueError(
+            "the LM head has untied from the token embedding (training "
+            "does this); loading the export into a default tied "
+            "GPT2LMHeadModel would silently overwrite the embedding — "
+            "use export_gpt2() for a matching config, or pass "
+            "untied_ok=True"
+        )
 
     def t(x) -> "torch.Tensor":
         return torch.from_numpy(np.array(x, dtype=np.float32))

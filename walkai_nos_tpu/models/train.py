@@ -57,13 +57,23 @@ def make_optimizer(
     cosine-decay schedule (`decay_steps` counts post-warmup steps;
     either knob alone works, both zero keeps the constant rate)."""
     schedule: optax.Schedule | float = lr
-    if warmup_steps or decay_steps:
+    if decay_steps:
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0 if warmup_steps else lr,
             peak_value=lr,
             warmup_steps=warmup_steps,
-            decay_steps=max(warmup_steps + decay_steps, warmup_steps + 1),
+            decay_steps=warmup_steps + decay_steps,
             end_value=0.0,
+        )
+    elif warmup_steps:
+        # Warmup alone: ramp to peak, then HOLD — a cosine tail of
+        # length zero would pin the rate at 0 one step past warmup.
+        schedule = optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, lr, warmup_steps),
+                optax.constant_schedule(lr),
+            ],
+            [warmup_steps],
         )
     tx = optax.adamw(schedule, weight_decay=weight_decay)
     if clip_norm is not None:

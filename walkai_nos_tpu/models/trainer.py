@@ -46,11 +46,14 @@ def evaluate(
     closed over with `jax.jit`); losses are fetched once at the end so
     dispatch stays async across the evaluation.
     """
-    losses = []
-    for i, batch in enumerate(batches):
-        if max_batches is not None and i >= max_batches:
-            break
-        losses.append(loss_fn(state.params, batch))
+    import itertools
+
+    if max_batches is not None:
+        # islice consumes exactly max_batches — a manual break after
+        # next() would pull (and discard) one extra batch from a shared
+        # training iterator.
+        batches = itertools.islice(batches, max_batches)
+    losses = [loss_fn(state.params, batch) for batch in batches]
     if not losses:
         raise ValueError("evaluate() received no batches")
     return float(
