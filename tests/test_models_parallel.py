@@ -113,3 +113,35 @@ class TestModel:
         infer = make_infer_step(cfg, mesh)
         out = infer(params, _tiny_batch(cfg)["images"])
         assert out["logits"].shape[0] == 8
+
+
+class TestViTRemat:
+    def test_remat_matches_stored_activations(self):
+        """jax.checkpoint must not change the detector's math: same
+        params, same batch -> identical loss and gradients."""
+        from dataclasses import replace
+
+        from walkai_nos_tpu.models.train import detection_loss
+        from walkai_nos_tpu.models.vit import VIT_TINY, ViTDetector
+
+        batch = _tiny_batch(VIT_TINY, b=2)
+        results = []
+        for remat in (False, True):
+            cfg = replace(VIT_TINY, remat=remat, dtype="float32")
+            model = ViTDetector(cfg)
+            params = model.init_params(jax.random.PRNGKey(0))
+
+            def loss_fn(p, model=model, cfg=cfg):
+                out = model.apply({"params": p}, batch["images"])
+                return detection_loss(
+                    out, batch, num_classes=cfg.num_classes
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            results.append((float(loss), grads))
+        assert abs(results[0][0] - results[1][0]) < 1e-6
+        for a, b in zip(
+            jax.tree_util.tree_leaves(results[0][1]),
+            jax.tree_util.tree_leaves(results[1][1]),
+        ):
+            assert jnp.allclose(a, b, atol=1e-5)

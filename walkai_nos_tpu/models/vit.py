@@ -38,6 +38,9 @@ class ViTConfig:
     num_det_tokens: int = 100
     num_classes: int = 92  # COCO classes + no-object, as YOLOS
     dtype: str = "bfloat16"  # compute dtype; params stay float32
+    # Rematerialization: recompute block activations in backward
+    # (jax.checkpoint) — the HBM-for-FLOPs trade, same knob as the LM.
+    remat: bool = False
 
     @property
     def num_patches(self) -> int:
@@ -132,8 +135,11 @@ class ViTDetector(nn.Module):
         )
         x = x + pos.astype(x.dtype)
 
+        block_cls = (
+            nn.remat(Block, prevent_cse=False) if c.remat else Block
+        )
         for i in range(c.num_layers):
-            x = Block(c, name=f"block{i}")(x)
+            x = block_cls(c, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
 
         tokens = x[:, -c.num_det_tokens:, :]
