@@ -281,3 +281,29 @@ class TestOptimizerKnobs:
             params = optax.apply_updates(params, updates)
         # Step 8 is past warmup: updates must still be nonzero.
         assert float(jnp.max(jnp.abs(updates["w"]))) > 0.0
+
+
+class TestEvalDuringFit:
+    def test_eval_fn_runs_on_interval(self):
+        from walkai_nos_tpu.models.lm import DecoderLM, lm_loss
+        from walkai_nos_tpu.models.trainer import evaluate
+
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(CFG, mesh, jax.random.PRNGKey(0))
+        model = DecoderLM(CFG, mesh)
+
+        @jax.jit
+        def loss_fn(params, tokens):
+            return lm_loss(model.apply({"params": params}, tokens), tokens)
+
+        def eval_fn(state):
+            val = TestFit._pipeline(None, mesh, epochs=1)
+            return evaluate(state, loss_fn, val, max_batches=2)
+
+        result = fit(
+            state, make_lm_train_step(CFG, mesh),
+            TestFit._pipeline(None, mesh),
+            num_steps=6, eval_fn=eval_fn, eval_every=3, log_every=0,
+        )
+        assert [step for step, _ in result.eval_losses] == [3, 6]
+        assert all(v > 0 for _, v in result.eval_losses)

@@ -31,6 +31,8 @@ class FitResult:
     losses: list[float] = field(default_factory=list)
     steps_run: int = 0
     resumed_from: int | None = None
+    # (step, mean val loss) pairs when fit() ran with an eval_fn.
+    eval_losses: list[tuple[int, float]] = field(default_factory=list)
 
 
 def evaluate(
@@ -72,6 +74,8 @@ def fit(
     log_every: int = 10,
     profile_dir: str | None = None,
     profile_steps: tuple[int, int] = (3, 6),
+    eval_fn: Callable[[TrainState], float] | None = None,
+    eval_every: int = 100,
 ) -> FitResult:
     """Run `num_steps` optimizer steps (counted from state.step).
 
@@ -83,6 +87,10 @@ def fit(
     With `profile_dir`, captures an XLA/TPU profiler trace (viewable in
     TensorBoard/Perfetto) over `profile_steps` — a [start, stop) window
     of THIS RUN's step ordinals, past the compile-laden first steps.
+
+    With `eval_fn` (e.g. a closure over `evaluate` and a validation
+    stream factory), runs it every `eval_every` steps and records
+    (step, value) pairs in the result.
     """
     if profile_dir is not None and profile_steps[1] <= profile_steps[0]:
         raise ValueError(
@@ -129,6 +137,12 @@ def fit(
                 logger.info(
                     "step %d loss %.4f (%.1f steps/s)", step, value, rate
                 )
+            if eval_fn and eval_every and (
+                result.steps_run % eval_every == 0
+            ):
+                value = float(eval_fn(result.state))
+                result.eval_losses.append((step, value))
+                logger.info("step %d val loss %.4f", step, value)
             if manager and checkpoint_every and (
                 result.steps_run % checkpoint_every == 0
             ):
