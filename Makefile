@@ -4,12 +4,20 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test smoke e2e e2e-kind native bench dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
 test:
 	python -m pytest tests/ -q
+
+# The control-plane feedback loop: skips JAX compile-heavy modules
+# (marked `slow` in tests/conftest.py) — ~1 min instead of >10.
+test-fast:
+	python -m pytest tests/ -m "not slow" -q
+
+test-slow:
+	python -m pytest tests/ -m "slow" -q
 
 # One-command product drive: library flow, controller loops, quota
 # scheduler, and the JAX entry points — hardware-free (CPU-pinned).

@@ -1,5 +1,7 @@
 """Scheduling-latency benchmark harness (`walkai_nos_tpu/sim/schedbench.py`)."""
 
+import pytest
+
 from walkai_nos_tpu.sim.schedbench import _workload, run_scheduling_benchmark
 from walkai_nos_tpu.tpu.tiling.profile import Profile
 
@@ -15,6 +17,7 @@ class TestWorkload:
             assert sizes == sorted(sizes, reverse=True)
 
 
+@pytest.mark.slow
 class TestSchedulingBench:
     def test_small_cluster_end_to_end(self):
         r = run_scheduling_benchmark(
@@ -29,6 +32,7 @@ class TestSchedulingBench:
         assert 0 < r.share_p50_s <= r.share_p90_s
 
 
+@pytest.mark.slow
 class TestScaleOut:
     def test_twenty_node_cluster_schedules_everything(self):
         """Scale-out proof: ~94 mixed-profile pods over 20 hosts all

@@ -36,6 +36,36 @@ def _eventually(fn, timeout=10.0, msg=""):
 
 
 class TestSharedWatch:
+    def test_abandoned_subscriber_is_evicted(self, monkeypatch):
+        """A consumer that drops its iterator without closing it leaves
+        the queue registered until GC; once its backlog passes the cap
+        the pump evicts it instead of filling it forever."""
+        from walkai_nos_tpu.kube import sharedwatch
+
+        monkeypatch.setattr(sharedwatch, "MAX_SUBSCRIBER_BACKLOG", 5)
+        upstream = CountingClient()
+        shared = SharedWatchClient(upstream)
+        # An iterator never advanced past its snapshot: its queue is
+        # registered but nothing drains it.
+        it = shared.watch("Pod", stop=lambda: False)
+        next(it)  # SYNCED of the empty cache: now registered
+        try:
+            stream = shared._streams[("Pod", None)]
+            assert len(stream._subscribers) == 1
+            for i in range(20):
+                upstream.create(
+                    "Pod",
+                    {"metadata": {"name": f"p{i}", "namespace": "d"}},
+                    "d",
+                )
+            _eventually(
+                lambda: len(stream._subscribers) == 0,
+                msg="abandoned subscriber evicted",
+            )
+        finally:
+            it.close()
+            shared.close()
+
     def test_two_subscribers_one_upstream_stream(self):
         upstream = CountingClient()
         upstream.create("Pod", {"metadata": {"name": "a", "namespace": "d"}}, "d")

@@ -107,6 +107,7 @@ class TestFactory:
         assert node["status"]["allocatable"]["walkai.io/tpu-2x2"] == "2"
 
 
+@pytest.mark.slow
 class TestCheckpoint:
     def test_save_restore_roundtrip(self, tmp_path):
         from walkai_nos_tpu.models.checkpoint import CheckpointManager

@@ -20,6 +20,7 @@ from walkai_nos_tpu.config import (
 )
 from walkai_nos_tpu.controllers.partitioner.node_controller import NodeController
 from walkai_nos_tpu.controllers.partitioner.pod_controller import (
+    BatchingPodReconciler,
     PodController,
     make_node_event_mapper,
 )
@@ -32,11 +33,27 @@ logger = logging.getLogger("tpupartitioner")
 def build_manager(kube, config: PartitionerConfig) -> Manager:
     """Wire the two control loops (test seam: callers inject any KubeClient)."""
     manager = Manager()
+    pod_controller = PodController(kube)
+    if config.batch_window_timeout_s > 0:
+        # Upstream pending-pod batching (`gpu_partitioner_config.yaml:23-33`):
+        # a burst of pending pods is planned in one pass over one node
+        # snapshot, with one spec write per node.
+        batching = BatchingPodReconciler(
+            pod_controller,
+            timeout=config.batch_window_timeout_s,
+            idle=config.batch_window_idle_s,
+        )
+        # Added before the pod watch so its worker is draining by the
+        # time events flow; restarts with the manager on leader cycles.
+        manager.add(batching)
+        pod_reconcile = batching.reconcile
+    else:
+        pod_reconcile = pod_controller.reconcile
     pod_watch = Controller(
         constants.PARTITIONER_CONTROLLER_NAME,
         kube,
         "Pod",
-        PodController(kube).reconcile,
+        pod_reconcile,
         max_concurrent=1,  # `mig_controller.go:204`
     )
     manager.add(pod_watch)

@@ -55,16 +55,33 @@ class PartitionerConfig:
     device_plugin_delay_s: float = 5.0
     # Vestigial: pending-pod retry is event-driven since the node-event
     # mapper (pod_controller.make_node_event_mapper); the knob is kept so
-    # existing config files still parse — the same treatment the reference
-    # gives its orphaned batch-window knobs
-    # (`gpu_partitioner_config.yaml:23-33`).
+    # existing config files still parse.
     pod_retry_interval_s: float = 5.0
+    # Pending-pod batch windows (`gpu_partitioner_config.yaml:23-33`,
+    # upstream behavior the fork orphaned): the first pending pod opens a
+    # batch; the batch is planned when `timeout` elapses, or when no new
+    # pending pod arrives for `idle` seconds. Larger windows consider more
+    # pods per plan (fewer re-tile cycles for the agents); 0 disables
+    # batching and reconciles each pod immediately. Defaults are small:
+    # the event-driven mapper already coalesces retries, so the window
+    # only needs to catch a single submission burst.
+    batch_window_timeout_s: float = 5.0
+    batch_window_idle_s: float = 0.5
 
     def validate(self) -> None:
         if self.device_plugin_delay_s < 0:
             raise ValueError("device_plugin_delay_s must be >= 0")
         if self.pod_retry_interval_s <= 0:
             raise ValueError("pod_retry_interval_s must be > 0")
+        if self.batch_window_timeout_s < 0 or self.batch_window_idle_s < 0:
+            raise ValueError("batch windows must be >= 0")
+        # timeout == 0 alone disables batching (the idle value is then
+        # ignored); with batching on, the idle window must be real.
+        if self.batch_window_timeout_s > 0 and self.batch_window_idle_s <= 0:
+            raise ValueError(
+                "batch_window_idle_s must be > 0 when batching is enabled "
+                "(batch_window_timeout_s > 0); set timeout to 0 to disable"
+            )
         if (
             self.known_geometries_file
             and not Path(self.known_geometries_file).exists()
@@ -107,6 +124,10 @@ _KIND_LOADERS = {
                 d.get("devicePluginDelaySeconds", 5.0)
             ),
             pod_retry_interval_s=float(d.get("podRetryIntervalSeconds", 5.0)),
+            batch_window_timeout_s=float(
+                d.get("batchWindowTimeoutSeconds", 5.0)
+            ),
+            batch_window_idle_s=float(d.get("batchWindowIdleSeconds", 0.5)),
         ),
     ),
     "TpuAgentConfig": (

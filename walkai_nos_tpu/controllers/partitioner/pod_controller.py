@@ -18,6 +18,8 @@ slice pod on node add/annotation-change events instead of polling.
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from typing import Callable
 
 from walkai_nos_tpu.api import constants
@@ -32,6 +34,7 @@ from walkai_nos_tpu.tpu.sharing.node import SharingNode
 from walkai_nos_tpu.tpu.sharing.profile import get_requested_shared_profiles
 from walkai_nos_tpu.tpu.tiling.node import Node
 from walkai_nos_tpu.tpu.tiling.profile import get_requested_profiles
+from walkai_nos_tpu.utils.batcher import Batcher
 
 logger = logging.getLogger(__name__)
 
@@ -82,33 +85,133 @@ class PodController:
     # ------------------------------------------------------------- reconcile
 
     def reconcile(self, request: Request) -> Result:
-        try:
-            pod = self._kube.get("Pod", request.name, request.namespace or None)
-        except NotFound:
-            return Result()
-        if not self._should_consider_pod(pod):
-            return Result()
-        wanted = get_requested_profiles(pod)
-        if wanted:
-            nodes = self._list_tiling_nodes()
-            if not self._profiles_already_available(nodes, wanted):
-                # Otherwise the scheduler will bind the pod on its next
-                # cycle (`mig_controller.go:121-144`); its binding flips
-                # node usage, which flows back as a status-annotation
-                # event if anything else is still pending.
-                self._try_repartition(nodes, wanted, pod)
-        # Dynamic sharing: the capability the reference fork reduced to
-        # report-only (upstream nos planned MPS layouts alongside MIG);
-        # chip-count shares are planned the same way against
-        # sharing-labeled nodes.
-        wanted_shared = get_requested_shared_profiles(pod)
-        if wanted_shared:
-            nodes = self._list_sharing_nodes()
-            if not self._shared_profiles_already_available(
-                nodes, wanted_shared
-            ):
-                self._try_reshare(nodes, wanted_shared, pod)
+        """Single-pod mode: a one-element batch. Same decisions as the
+        batch-window path — no write when a node already provides the
+        wanted profiles free (the scheduler will bind the pod on its
+        next cycle, `mig_controller.go:121-144`), first-fit geometry
+        transition otherwise — with one planning implementation."""
+        self.reconcile_batch([request])
         return Result()
+
+    # ------------------------------------------------------------ batch mode
+
+    def reconcile_batch(self, requests: list[Request]) -> None:
+        """Plan a whole batch of pending pods in one pass (the upstream
+        batch-window behavior, `gpu_partitioner_config.yaml:23-33`, which
+        the reference fork orphaned along with its Batcher).
+
+        One node snapshot serves the entire batch, with simulated
+        placement (`Node.add_pod`) claiming free slices as pods are
+        satisfied — so two pods wanting the same free slice cannot both
+        be skipped as "already available" — and each node's spec is
+        written at most once per batch, however many pods land on it
+        (one plan cycle for the agents instead of one per pod)."""
+        pods: list[dict] = []
+        seen: set[tuple[str, str]] = set()
+        for req in requests:
+            key = (req.namespace, req.name)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                pod = self._kube.get(
+                    "Pod", req.name, req.namespace or None
+                )
+            except NotFound:
+                continue
+            if self._should_consider_pod(pod):
+                pods.append(pod)
+        # Deterministic order: oldest pending pod plans first (RFC3339
+        # creation timestamps sort lexicographically).
+        pods.sort(
+            key=lambda p: (
+                p.get("metadata", {}).get("creationTimestamp", ""),
+                objects.namespace(p),
+                objects.name(p),
+            )
+        )
+        if not pods:
+            return
+        self._plan_pass(
+            pods, get_requested_profiles, self._list_tiling_nodes,
+            Node.from_node, "repartitioned",
+        )
+        self._plan_pass(
+            pods, get_requested_shared_profiles, self._list_sharing_nodes,
+            SharingNode.from_node, "re-shared",
+        )
+
+    def _plan_pass(
+        self, pods: list[dict], wanted_fn, list_nodes, node_factory,
+        verb: str,
+    ) -> None:
+        wanted_pods = [
+            (pod, wanted) for pod in pods if (wanted := wanted_fn(pod))
+        ]
+        if not wanted_pods:
+            return
+        # Mutable views: [node_obj, simulated Node, changed?]. Claimed
+        # slices stay `used` in the simulation, which also protects them
+        # from eviction by later pods' geometry transitions (the mesh
+        # search never evicts used slices).
+        views: list[list] = [
+            [
+                node_obj,
+                node_factory(
+                    objects.name(node_obj),
+                    objects.labels(node_obj),
+                    objects.annotations(node_obj),
+                ),
+                False,
+            ]
+            for node_obj in list_nodes()
+        ]
+        for pod, wanted in wanted_pods:
+            if self._place_in_views(views, wanted):
+                continue
+            logger.info(
+                "pod controller: no node can provide %s for pod %s/%s",
+                wanted, objects.namespace(pod), objects.name(pod),
+            )
+        for node_obj, view, changed in views:
+            if not changed:
+                continue
+            plan_id = self._plan_id_fn()
+            self._partitioner.apply_partitioning(
+                node_obj, build_node_partitioning(view), plan_id
+            )
+            logger.info(
+                "pod controller: %s node %s for a batch of %d pending "
+                "pods (plan %s)",
+                verb, view.name, len(wanted_pods), plan_id,
+            )
+
+    @staticmethod
+    def _place_in_views(views: list[list], wanted: Geometry) -> bool:
+        """The first-fit planning loop (`mig_controller.go:121-207`),
+        shared by tiling and sharing — both node models expose the same
+        search surface (has_free_capacity / clone / update_geometry_for /
+        provides_profiles / add_pod)."""
+        # Already available on the (claimed) view: consume it so the
+        # next pod in the batch sees the truth.
+        for entry in views:
+            if entry[1].provides_profiles(wanted):
+                entry[1].add_pod(wanted)
+                return True
+        # First-fit geometry transition (`mig_controller.go:146-207`).
+        for entry in views:
+            if not entry[1].has_free_capacity():
+                continue
+            candidate = entry[1].clone()
+            if not candidate.update_geometry_for(wanted):
+                continue
+            if not candidate.provides_profiles(wanted):
+                continue
+            candidate.add_pod(wanted)
+            entry[1] = candidate
+            entry[2] = True
+            return True
+        return False
 
     # --------------------------------------------------------------- helpers
 
@@ -136,86 +239,83 @@ class PodController:
             },
         )
 
-    def _shared_profiles_already_available(
-        self, nodes: list[dict], wanted: Geometry
-    ) -> bool:
-        return self._available(nodes, wanted, SharingNode.from_node)
 
-    def _try_reshare(
-        self, nodes: list[dict], wanted: Geometry, pod: dict
-    ) -> bool:
-        """First-fit share planning over sharing nodes — the sharing twin
-        of `_try_repartition`, using the chip-count model
-        (`tpu/sharing/mesh.py` two-phase search)."""
-        return self._first_fit(
-            nodes, wanted, pod, SharingNode.from_node, "re-shared"
-        )
+class BatchingPodReconciler:
+    """Batch-window front of the pod controller.
 
-    def _profiles_already_available(
-        self, nodes: list[dict], wanted: Geometry
-    ) -> bool:
-        return self._available(nodes, wanted, Node.from_node)
+    Restores the upstream pending-pod batching the reference fork
+    orphaned (`pkg/util/batcher.go:25-130` + the batch-window knobs,
+    `gpu_partitioner_config.yaml:23-33`): reconcile requests from the
+    Controller land in a `Batcher` (first request opens the timeout
+    window, each request restarts the idle window) and a worker drains
+    whole batches into `PodController.reconcile_batch`.
 
-    def _available(
-        self, nodes: list[dict], wanted: Geometry, node_factory
-    ) -> bool:
-        for node_obj in nodes:
-            node = node_factory(
-                objects.name(node_obj),
-                objects.labels(node_obj),
-                objects.annotations(node_obj),
-            )
-            if node.provides_profiles(wanted):
-                return True
-        return False
+    The Controller's per-key retry/backoff does not apply here —
+    `reconcile` returns before planning runs. That is safe for this
+    loop: a planning decision is a pure function of pod + node state,
+    and the node-event mapper re-enqueues every still-pending slice pod
+    whenever a partitioned node changes, so failed batches are retried
+    by the same event-driven path that drives the unbatched mode.
+    """
 
-    def _try_repartition(
-        self, nodes: list[dict], wanted: Geometry, pod: dict
-    ) -> bool:
-        """First-fit over candidate nodes (`mig_controller.go:146-207`)."""
-        return self._first_fit(
-            nodes, wanted, pod, Node.from_node, "repartitioned"
-        )
+    def __init__(
+        self,
+        controller: PodController,
+        *,
+        timeout: float,
+        idle: float,
+    ) -> None:
+        self.name = "tpu-pod-batch-planner"
+        self._controller = controller
+        self._batcher: Batcher[Request] = Batcher(timeout=timeout, idle=idle)
+        self._stop = threading.Event()
+        # Serializes planning across worker generations: stop() joins
+        # with a timeout, so a leader-election stop/start cycle can
+        # briefly overlap an old worker finishing its batch with the new
+        # one — the lock keeps the single-planner invariant
+        # (max_concurrent=1) either way.
+        self._plan_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
 
-    def _first_fit(
-        self, nodes: list[dict], wanted: Geometry, pod: dict, node_factory,
-        verb: str,
-    ) -> bool:
-        """The first-fit planning loop shared by tiling and sharing: both
-        node models expose the same search surface (has_free_capacity /
-        clone / update_geometry_for / provides_profiles)."""
-        for node_obj in nodes:
-            node = node_factory(
-                objects.name(node_obj),
-                objects.labels(node_obj),
-                objects.annotations(node_obj),
-            )
-            if not node.has_free_capacity():
+    def reconcile(self, request: Request) -> Result:
+        """The Controller-facing reconciler: enqueue and return."""
+        self._batcher.add(request)
+        return Result()
+
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                batch = self._batcher.get_batch(timeout=0.2)
+            except queue.Empty:
                 continue
-            candidate = node.clone()
-            if not candidate.update_geometry_for(wanted):
-                continue
-            if not candidate.provides_profiles(wanted):
-                continue
-            plan_id = self._plan_id_fn()
-            self._partitioner.apply_partitioning(
-                node_obj, build_node_partitioning(candidate), plan_id
-            )
-            logger.info(
-                "pod controller: %s node %s for pod %s/%s "
-                "(wanted %s, plan %s)",
-                verb,
-                node.name,
-                objects.namespace(pod),
-                objects.name(pod),
-                wanted,
-                plan_id,
-            )
-            return True
-        logger.info(
-            "pod controller: no node can provide %s for pod %s/%s",
-            wanted,
-            objects.namespace(pod),
-            objects.name(pod),
+            try:
+                with self._plan_lock:
+                    self._controller.reconcile_batch(batch)
+            except Exception:
+                logger.exception(
+                    "pod controller: batch of %d requests failed; the "
+                    "node-event mapper will re-enqueue still-pending pods",
+                    len(batch),
+                )
+
+    def start(self) -> None:
+        # Fresh stop event per generation: the previous stop() set the
+        # old one, and a worker that outlived its join timeout must keep
+        # seeing it set rather than be resurrected by a clear().
+        self._stop = threading.Event()
+        self._batcher.start()
+        self._thread = threading.Thread(
+            target=self._run, args=(self._stop,), daemon=True,
+            name="pod-batch-planner",
         )
-        return False
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._batcher.stop()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    # Registered on the Manager like a controller (duck-typed start/stop)
+    # so leader-election stop/start cycles restart the batch worker too.
+    close = stop

@@ -29,6 +29,15 @@ logger = logging.getLogger(__name__)
 
 _SENTINEL = object()
 
+# A subscriber whose queue backs up past this many undelivered events is
+# evicted (its iterator ends with the sentinel). A live Controller drains
+# its queue every loop, so only an abandoned iterator — one the consumer
+# dropped without closing, leaving the generator (and its queue) alive
+# until GC — accumulates unboundedly. Eviction caps that leak; a consumer
+# that was merely slow re-subscribes and gets a fresh replay, which is
+# exactly the informer re-list contract it already handles.
+MAX_SUBSCRIBER_BACKLOG = 4096
+
 
 class _Stream:
     """One upstream watch for a (kind, namespace) key."""
@@ -62,6 +71,18 @@ class _Stream:
                     self._apply(event, obj)
                     targets = list(self._subscribers)
                 for q in targets:
+                    if q.qsize() >= MAX_SUBSCRIBER_BACKLOG:
+                        with self._lock:
+                            if q in self._subscribers:
+                                self._subscribers.remove(q)
+                        q.put(_SENTINEL)
+                        logger.warning(
+                            "sharedwatch %s: evicted a subscriber with "
+                            ">= %d undelivered events (abandoned or "
+                            "stalled iterator)",
+                            self._kind, MAX_SUBSCRIBER_BACKLOG,
+                        )
+                        continue
                     q.put((event, obj))
         except Exception:
             logger.exception(
@@ -109,7 +130,12 @@ class _Stream:
         framing, then live events. Joins wait for the stream to reach a
         consistent point first — snapshotting mid-burst or mid-RESYNC
         would hand the joiner a partial or stale world whose missing
-        objects its Controller would treat as deletions (or ghosts)."""
+        objects its Controller would treat as deletions (or ghosts).
+
+        Close the iterator when done (`with closing(...)` or exhaust
+        it); an abandoned-but-alive generator keeps its queue
+        registered until GC, and is evicted once its backlog exceeds
+        MAX_SUBSCRIBER_BACKLOG."""
         q: queue.SimpleQueue = queue.SimpleQueue()
         with self._lock:
             if not self._started:
@@ -209,3 +235,6 @@ class SharedWatchClient(KubeClient):
 
     def bind_pod(self, name, namespace, node_name):
         return self._client.bind_pod(name, namespace, node_name)
+
+    def evict_pod(self, name, namespace, grace_period_seconds=None):
+        return self._client.evict_pod(name, namespace, grace_period_seconds)

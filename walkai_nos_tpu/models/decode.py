@@ -90,9 +90,10 @@ def make_generate_fn(
             f"temperature must be >= 0 (a negative one inverts the "
             f"distribution); got {temperature}"
         )
-    if top_k < 0 or not 0.0 < top_p <= 1.0:
+    if not 0 <= top_k <= cfg.vocab_size or not 0.0 < top_p <= 1.0:
         raise ValueError(
-            f"top_k must be >= 0 and top_p in (0, 1]; got {top_k}, {top_p}"
+            f"top_k must be in [0, vocab_size={cfg.vocab_size}] and "
+            f"top_p in (0, 1]; got {top_k}, {top_p}"
         )
     if cfg.use_ring_attention or cfg.use_ulysses_attention:
         raise ValueError(

@@ -143,7 +143,10 @@ def export_gpt2(params: Mapping, cfg: LMConfig):
         activation_function="gelu_new",
         tie_word_embeddings=tied,
     )
-    return config, state_dict_from_params(params, cfg, untied_ok=not tied)
+    # The config above already encodes the tie verdict, so the export is
+    # faithful either way — untied_ok=True skips state_dict_from_params's
+    # O(vocab*hidden) re-check of what `tied` just measured.
+    return config, state_dict_from_params(params, cfg, untied_ok=True)
 
 
 def state_dict_from_params(

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from walkai_nos_tpu.parallel.mesh import AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL
+from walkai_nos_tpu.parallel.mesh import AXIS_EXPERT, AXIS_MODEL
 
 
 def _constrain(x: jax.Array, mesh: Mesh | None, spec: P) -> jax.Array:

@@ -54,8 +54,14 @@ class _Head(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
-        return nn.Dense(self.cfg.vocab_size, dtype=jnp.float32, name="head")(x)
+        c = self.cfg
+        x = nn.LayerNorm(
+            epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm"
+        )(x)
+        return nn.Dense(
+            c.vocab_size, dtype=jnp.float32, use_bias=c.head_bias,
+            name="head",
+        )(x)
 
 
 def _block(cfg: LMConfig) -> DecoderBlock:
