@@ -12,7 +12,7 @@ routes, merge-patch semantics) is what e2e tests exercise.
 Supported route shapes:
   /api/v1/<plural>[...]                          core kinds
   /apis/<group>/<version>/<plural>[...]          CRDs, coordination.k8s.io
-  .../namespaces/<ns>/<plural>/<name>[/status|/binding]
+  .../namespaces/<ns>/<plural>/<name>[/status|/binding|/eviction]
 """
 
 from __future__ import annotations
@@ -216,6 +216,36 @@ class MiniApiServer:
                             {"type": "PodScheduled", "status": "True"}
                         )
                         outer._bump(plural, key[1], "MODIFIED", obj)
+                        self._send(201, {})
+                        return
+                    if sub == "eviction":
+                        # pods/eviction: PDB-enforced graceful delete —
+                        # 429 when the budget is spent, like the real
+                        # subresource handler (kube/disruption.py).
+                        from walkai_nos_tpu.kube.disruption import (
+                            eviction_allowed,
+                        )
+
+                        key, obj = self._find(plural, ns, name)
+                        if obj is None:
+                            self._send(404, {"message": "not found"})
+                            return
+                        pdbs = [
+                            o
+                            for (p, ens, _), o in outer._objects.items()
+                            if p == "poddisruptionbudgets" and ens == ns
+                        ]
+                        pods = [
+                            o
+                            for (p, ens, _), o in outer._objects.items()
+                            if p == "pods" and ens == ns
+                        ]
+                        allowed, reason = eviction_allowed(obj, pdbs, pods)
+                        if not allowed:
+                            self._send(429, {"message": reason})
+                            return
+                        del outer._objects[key]
+                        outer._bump(plural, key[1], "DELETED", obj)
                         self._send(201, {})
                         return
                     name = body["metadata"]["name"]

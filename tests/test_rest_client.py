@@ -49,6 +49,43 @@ class TestRestKubeClient:
         with pytest.raises(NotFound):
             client.get("Pod", "p1", "default")
 
+    def test_eviction_subresource_enforces_pdb(self, api):
+        """The pods/eviction wire path: a PDB with no disruptions left
+        answers 429 (EvictionBlocked); with budget, the pod is deleted."""
+        from walkai_nos_tpu.kube.client import EvictionBlocked
+
+        client = RestKubeClient(server=api)
+        for i in range(2):
+            client.create(
+                "Pod",
+                {
+                    "metadata": {
+                        "name": f"p{i}", "namespace": "ml",
+                        "labels": {"app": "x"},
+                    },
+                    "spec": {"nodeName": "n1"},
+                    "status": {"phase": "Running"},
+                },
+                namespace="ml",
+            )
+        client.create(
+            "PodDisruptionBudget",
+            {
+                "metadata": {"name": "pdb", "namespace": "ml"},
+                "spec": {
+                    "minAvailable": 1,
+                    "selector": {"matchLabels": {"app": "x"}},
+                },
+            },
+            namespace="ml",
+        )
+        client.evict_pod("p0", "ml", grace_period_seconds=5)
+        with pytest.raises(NotFound):
+            client.get("Pod", "p0", "ml")
+        with pytest.raises(EvictionBlocked):
+            client.evict_pod("p1", "ml")
+        assert client.get("Pod", "p1", "ml")  # survived
+
     def test_list_all_namespaces_uses_cluster_path(self, api):
         """namespace=None on a namespaced kind must list ALL namespaces
         (the KubeClient contract) — not silently only 'default'."""

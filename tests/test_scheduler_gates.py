@@ -1,0 +1,551 @@
+"""Scheduler-framework gates: taints, affinity, PDB-aware eviction.
+
+The restored scheduler's parity with default kube-scheduling (VERDICT r2
+#5): the reference spec was a kube-scheduler plugin
+(`pkg/api/scheduler/v1beta3/types.go:26-30`) and inherited these gates;
+the standalone scheduler must provide them itself. Unit tables for the
+matchers (`quota/fit.py`, `kube/disruption.py`) + end-to-end scenarios
+through `build_manager` on the fake client.
+"""
+
+import time
+
+import pytest
+
+from tests.test_quota import _pod, _quota
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.cmd.tpuscheduler import build_manager
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.kube.client import EvictionBlocked
+from walkai_nos_tpu.kube.disruption import eviction_allowed
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.quota.fit import (
+    matches_node_affinity,
+    satisfies_pod_affinity,
+    tolerates_node_taints,
+)
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+def _eventually(fn, timeout=10.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out: {msg}")
+
+
+def _node(name, labels=None, taints=None, tpu=8):
+    node = {
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {"allocatable": {"google.com/tpu": str(tpu)}},
+    }
+    if taints:
+        node["spec"] = {"taints": taints}
+    return node
+
+
+# ------------------------------------------------------------------- units
+
+
+class TestTaintMatching:
+    NO_SCHED = {"key": "tpu", "value": "reserved", "effect": "NoSchedule"}
+
+    def test_untolerated_noschedule_blocks(self):
+        pod = {"spec": {}}
+        assert not tolerates_node_taints(pod, {"spec": {"taints": [self.NO_SCHED]}})
+
+    @pytest.mark.parametrize(
+        "toleration",
+        [
+            {"key": "tpu", "operator": "Equal", "value": "reserved"},
+            {"key": "tpu", "operator": "Exists"},
+            {"key": "tpu", "operator": "Exists", "effect": "NoSchedule"},
+            {"operator": "Exists"},  # empty key matches everything
+        ],
+    )
+    def test_matching_toleration_admits(self, toleration):
+        pod = {"spec": {"tolerations": [toleration]}}
+        assert tolerates_node_taints(pod, {"spec": {"taints": [self.NO_SCHED]}})
+
+    @pytest.mark.parametrize(
+        "toleration",
+        [
+            {"key": "tpu", "operator": "Equal", "value": "other"},
+            {"key": "other", "operator": "Exists"},
+            {"key": "tpu", "operator": "Exists", "effect": "NoExecute"},
+            {},  # empty key with default Equal operator matches nothing
+        ],
+    )
+    def test_non_matching_toleration_blocks(self, toleration):
+        pod = {"spec": {"tolerations": [toleration]}}
+        assert not tolerates_node_taints(
+            pod, {"spec": {"taints": [self.NO_SCHED]}}
+        )
+
+    def test_prefer_noschedule_is_soft(self):
+        taint = {"key": "tpu", "value": "x", "effect": "PreferNoSchedule"}
+        assert tolerates_node_taints({"spec": {}}, {"spec": {"taints": [taint]}})
+
+
+class TestNodeAffinity:
+    def _pod_with(self, terms):
+        return {
+            "spec": {
+                "affinity": {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": terms
+                        }
+                    }
+                }
+            }
+        }
+
+    def test_in_operator(self):
+        pod = self._pod_with(
+            [{"matchExpressions": [
+                {"key": "gen", "operator": "In", "values": ["v5p", "v6e"]}
+            ]}]
+        )
+        assert matches_node_affinity(pod, _node("a", {"gen": "v5p"}))
+        assert not matches_node_affinity(pod, _node("a", {"gen": "v5e"}))
+
+    def test_terms_are_ored(self):
+        pod = self._pod_with(
+            [
+                {"matchExpressions": [
+                    {"key": "gen", "operator": "In", "values": ["v5p"]}
+                ]},
+                {"matchExpressions": [
+                    {"key": "zone", "operator": "Exists"}
+                ]},
+            ]
+        )
+        assert matches_node_affinity(pod, _node("a", {"zone": "us-a"}))
+        assert not matches_node_affinity(pod, _node("a", {"gen": "v5e"}))
+
+    def test_gt_lt_and_absence(self):
+        pod = self._pod_with(
+            [{"matchExpressions": [
+                {"key": "chips", "operator": "Gt", "values": ["4"]},
+                {"key": "drained", "operator": "DoesNotExist"},
+            ]}]
+        )
+        assert matches_node_affinity(pod, _node("a", {"chips": "8"}))
+        assert not matches_node_affinity(pod, _node("a", {"chips": "4"}))
+        assert not matches_node_affinity(
+            pod, _node("a", {"chips": "8", "drained": "true"})
+        )
+
+    def test_match_fields_metadata_name(self):
+        pod = self._pod_with(
+            [{"matchFields": [
+                {"key": "metadata.name", "operator": "In", "values": ["a"]}
+            ]}]
+        )
+        assert matches_node_affinity(pod, _node("a"))
+        assert not matches_node_affinity(pod, _node("b"))
+
+
+class TestPodAffinity:
+    def _anti(self, match_labels, key="kubernetes.io/hostname"):
+        return {
+            "metadata": {"namespace": "d"},
+            "spec": {
+                "affinity": {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": match_labels},
+                                "topologyKey": key,
+                            }
+                        ]
+                    }
+                }
+            },
+        }
+
+    def test_anti_affinity_rejects_cohosting(self):
+        peer = {
+            "metadata": {"namespace": "d", "labels": {"app": "x"}},
+            "spec": {"nodeName": "a"},
+            "status": {"phase": "Running"},
+        }
+        nodes = {"a": _node("a"), "b": _node("b")}
+        pod = self._anti({"app": "x"})
+        assert not satisfies_pod_affinity(pod, nodes["a"], [peer], nodes)
+        assert satisfies_pod_affinity(pod, nodes["b"], [peer], nodes)
+
+    def test_affinity_requires_cohosting_by_topology(self):
+        peer = {
+            "metadata": {"namespace": "d", "labels": {"app": "x"}},
+            "spec": {"nodeName": "a"},
+            "status": {"phase": "Running"},
+        }
+        nodes = {
+            "a": _node("a", {"zone": "z1"}),
+            "b": _node("b", {"zone": "z1"}),
+            "c": _node("c", {"zone": "z2"}),
+        }
+        pod = {
+            "metadata": {"namespace": "d"},
+            "spec": {
+                "affinity": {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {
+                                    "matchLabels": {"app": "x"}
+                                },
+                                "topologyKey": "zone",
+                            }
+                        ]
+                    }
+                }
+            },
+        }
+        # Same zone as the peer (even a different host) satisfies it.
+        assert satisfies_pod_affinity(pod, nodes["b"], [peer], nodes)
+        assert not satisfies_pod_affinity(pod, nodes["c"], [peer], nodes)
+
+
+class TestDisruptionBudget:
+    def _pdb(self, name="pdb", min_available=None, max_unavailable=None,
+             labels=None):
+        spec = {"selector": {"matchLabels": labels or {"app": "x"}}}
+        if min_available is not None:
+            spec["minAvailable"] = min_available
+        if max_unavailable is not None:
+            spec["maxUnavailable"] = max_unavailable
+        return {
+            "metadata": {"name": name, "namespace": "d"},
+            "spec": spec,
+        }
+
+    def _pods(self, n, bound=True):
+        return [
+            {
+                "metadata": {
+                    "name": f"p{i}", "namespace": "d",
+                    "labels": {"app": "x"},
+                },
+                "spec": {"nodeName": "a"} if bound else {},
+                "status": {"phase": "Running" if bound else "Pending"},
+            }
+            for i in range(n)
+        ]
+
+    def test_min_available_blocks_at_floor(self):
+        pods = self._pods(2)
+        allowed, reason = eviction_allowed(
+            pods[0], [self._pdb(min_available=2)], pods
+        )
+        assert not allowed and "minAvailable" in reason
+
+    def test_min_available_allows_above_floor(self):
+        pods = self._pods(3)
+        allowed, _ = eviction_allowed(
+            pods[0], [self._pdb(min_available=2)], pods
+        )
+        assert allowed
+
+    def test_max_unavailable_percent(self):
+        pods = self._pods(4)
+        # 25% of 4 = 1: evicting one is allowed, but with one already
+        # unhealthy it is not.
+        allowed, _ = eviction_allowed(
+            pods[0], [self._pdb(max_unavailable="25%")], pods
+        )
+        assert allowed
+        pods[3]["spec"] = {}
+        pods[3]["status"] = {"phase": "Pending"}
+        allowed, _ = eviction_allowed(
+            pods[0], [self._pdb(max_unavailable="25%")], pods
+        )
+        assert not allowed
+
+    def test_non_matching_pdb_ignored(self):
+        pods = self._pods(1)
+        allowed, _ = eviction_allowed(
+            pods[0], [self._pdb(min_available=1, labels={"app": "y"})], pods
+        )
+        assert allowed
+
+    def test_fake_client_enforces_and_records_grace(self):
+        kube = FakeKubeClient()
+        for pod in self._pods(2):
+            pod["spec"]["terminationGracePeriodSeconds"] = 7
+            kube.create("Pod", pod, "d")
+        kube.create("PodDisruptionBudget", self._pdb(min_available=1), "d")
+        kube.evict_pod("p0", "d", grace_period_seconds=7)
+        assert kube.evictions == [("p0", "d", 7)]
+        with pytest.raises(EvictionBlocked):
+            kube.evict_pod("p1", "d")
+        assert kube.get("Pod", "p1", "d")  # survived
+
+
+# ------------------------------------------------------------------ e2e
+
+
+class TestSchedulerGatesE2E:
+    def test_tainted_node_skipped_tolerated_node_used(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            _node("host-a", taints=[
+                {"key": "reserved", "value": "infra", "effect": "NoSchedule"}
+            ]),
+        )
+        kube.create("Node", _node("host-b"))
+        with build_manager(kube):
+            kube.create(
+                "Pod",
+                _pod("j1", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler", node=""),
+            )
+            _eventually(
+                lambda: kube.get("Pod", "j1", "team-a")["spec"].get(
+                    "nodeName") == "host-b",
+                msg="tainted node skipped",
+            )
+
+    def test_toleration_admits_only_tainted_node(self):
+        kube = FakeKubeClient()
+        kube.create(
+            "Node",
+            _node("host-a", taints=[
+                {"key": "reserved", "value": "infra", "effect": "NoSchedule"}
+            ]),
+        )
+        with build_manager(kube):
+            pod = _pod("j1", "team-a", 4, phase="Pending",
+                       scheduler="walkai-nos-scheduler", node="")
+            pod["spec"]["tolerations"] = [
+                {"key": "reserved", "operator": "Equal", "value": "infra"}
+            ]
+            kube.create("Pod", pod)
+            _eventually(
+                lambda: kube.get("Pod", "j1", "team-a")["spec"].get(
+                    "nodeName") == "host-a",
+                msg="toleration admits",
+            )
+
+    def test_required_node_affinity_steers(self):
+        kube = FakeKubeClient()
+        kube.create("Node", _node("host-a", {"gen": "v5e"}))
+        kube.create("Node", _node("host-b", {"gen": "v5p"}))
+        with build_manager(kube):
+            pod = _pod("j1", "team-a", 4, phase="Pending",
+                       scheduler="walkai-nos-scheduler", node="")
+            pod["spec"]["affinity"] = {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchExpressions": [
+                                {"key": "gen", "operator": "In",
+                                 "values": ["v5p"]}
+                            ]}
+                        ]
+                    }
+                }
+            }
+            kube.create("Pod", pod)
+            _eventually(
+                lambda: kube.get("Pod", "j1", "team-a")["spec"].get(
+                    "nodeName") == "host-b",
+                msg="node affinity steers to v5p",
+            )
+
+    def test_pod_anti_affinity_spreads(self):
+        kube = FakeKubeClient()
+        kube.create("Node", _node("host-a"))
+        kube.create("Node", _node("host-b"))
+        with build_manager(kube):
+            first = _pod("j1", "team-a", 2, phase="Running", node="host-a",
+                         labels={"app": "trainer"})
+            kube.create("Pod", first)
+            pod = _pod("j2", "team-a", 2, phase="Pending",
+                       scheduler="walkai-nos-scheduler", node="")
+            pod["spec"]["affinity"] = {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {
+                                "matchLabels": {"app": "trainer"}
+                            },
+                            "topologyKey": "kubernetes.io/hostname",
+                        }
+                    ]
+                }
+            }
+            kube.create("Pod", pod)
+            _eventually(
+                lambda: kube.get("Pod", "j2", "team-a")["spec"].get(
+                    "nodeName") == "host-b",
+                msg="anti-affinity spreads off host-a",
+            )
+
+    def test_pdb_protected_victim_survives_preemption(self):
+        """The docs' reclaim scenario, but the borrower is covered by a
+        PodDisruptionBudget with no disruptions left: the victim stays,
+        the claimant stays pending (budget beats fair-share preemption,
+        as with kube-scheduler's PDB-aware preemption)."""
+        kube = FakeKubeClient()
+        kube.create("Node", _node("host-a"))
+        kube.create("ElasticQuota", _quota("qa", "team-a", 4), "team-a")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        with build_manager(kube):
+            for i in range(2):
+                kube.create(
+                    "Pod",
+                    _pod(f"b-{i}", "team-b", 4, phase="Pending",
+                         scheduler="walkai-nos-scheduler", node="",
+                         labels={"app": "b"},
+                         created=f"2026-01-01T00:0{i}:00Z"),
+                )
+            _eventually(
+                lambda: all(
+                    kube.get("Pod", f"b-{i}", "team-b")["spec"].get("nodeName")
+                    for i in range(2)
+                ),
+                msg="team-b pods bind (one borrowing)",
+            )
+            for i in range(2):
+                kube.patch("Pod", f"b-{i}",
+                           {"status": {"phase": "Running"}}, "team-b")
+            kube.create(
+                "PodDisruptionBudget",
+                {
+                    "metadata": {"name": "b-pdb", "namespace": "team-b"},
+                    "spec": {
+                        "minAvailable": 2,
+                        "selector": {"matchLabels": {"app": "b"}},
+                    },
+                },
+                "team-b",
+            )
+            kube.create(
+                "Pod",
+                _pod("a-0", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler", node="",
+                     created="2026-01-02T00:00:00Z"),
+            )
+            # Give the scheduler several cycles to (not) evict.
+            time.sleep(2.0)
+            remaining = {
+                objects.name(p) for p in kube.list("Pod", namespace="team-b")
+            }
+            assert {"b-0", "b-1"} <= remaining, "PDB-protected victims evicted"
+            assert not kube.get("Pod", "a-0", "team-a")["spec"].get("nodeName")
+            assert kube.evictions == []
+
+    def test_preemption_reselects_around_protected_victim(self):
+        """Victim selection is newest-first, but a PDB protecting the
+        newest over-quota pod must not livelock the claimant: the
+        scheduler re-selects excluding the refused victim and evicts the
+        older unprotected one instead."""
+        kube = FakeKubeClient()
+        kube.create("Node", _node("host-a", tpu=12))
+        kube.create("ElasticQuota", _quota("qa", "team-a", 4), "team-a")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        # A second lender so team-b can borrow 8 (qa's + qc's unused min).
+        kube.create("ElasticQuota", _quota("qc", "team-c", 4), "team-c")
+        with build_manager(kube):
+            for i in range(3):
+                labels = {"app": "protected"} if i == 2 else {"app": "b"}
+                kube.create(
+                    "Pod",
+                    _pod(f"b-{i}", "team-b", 4, phase="Pending",
+                         scheduler="walkai-nos-scheduler", node="",
+                         labels=labels,
+                         created=f"2026-01-01T00:0{i}:00Z"),
+                )
+            _eventually(
+                lambda: all(
+                    kube.get("Pod", f"b-{i}", "team-b")["spec"].get("nodeName")
+                    for i in range(3)
+                ),
+                msg="team-b fills the host (two borrowing)",
+            )
+            for i in range(3):
+                kube.patch("Pod", f"b-{i}",
+                           {"status": {"phase": "Running"}}, "team-b")
+            kube.create(
+                "PodDisruptionBudget",
+                {
+                    "metadata": {"name": "protect-newest",
+                                 "namespace": "team-b"},
+                    "spec": {
+                        "minAvailable": 1,
+                        "selector": {
+                            "matchLabels": {"app": "protected"}
+                        },
+                    },
+                },
+                "team-b",
+            )
+            kube.create(
+                "Pod",
+                _pod("a-0", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler", node="",
+                     created="2026-01-02T00:00:00Z"),
+            )
+            _eventually(
+                lambda: kube.get("Pod", "a-0", "team-a")["spec"].get(
+                    "nodeName") == "host-a",
+                msg="claimant binds via the unprotected older victim",
+                timeout=15.0,
+            )
+            remaining = {
+                objects.name(p) for p in kube.list("Pod", namespace="team-b")
+            }
+            assert "b-2" in remaining  # the protected newest survived
+            assert "b-1" not in remaining  # the alternative was evicted
+
+    def test_preemption_grants_victim_grace_period(self):
+        """A preempted victim goes through the Eviction API with its own
+        terminationGracePeriodSeconds — time to checkpoint (the trainer's
+        orbax checkpointing is the other half of this contract)."""
+        kube = FakeKubeClient()
+        kube.create("Node", _node("host-a"))
+        kube.create("ElasticQuota", _quota("qa", "team-a", 4), "team-a")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        with build_manager(kube):
+            for i in range(2):
+                pod = _pod(f"b-{i}", "team-b", 4, phase="Pending",
+                           scheduler="walkai-nos-scheduler", node="",
+                           created=f"2026-01-01T00:0{i}:00Z")
+                pod["spec"]["terminationGracePeriodSeconds"] = 30
+                kube.create("Pod", pod)
+            _eventually(
+                lambda: all(
+                    kube.get("Pod", f"b-{i}", "team-b")["spec"].get("nodeName")
+                    for i in range(2)
+                ),
+                msg="team-b pods bind",
+            )
+            for i in range(2):
+                kube.patch("Pod", f"b-{i}",
+                           {"status": {"phase": "Running"}}, "team-b")
+            kube.create(
+                "Pod",
+                _pod("a-0", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler", node="",
+                     created="2026-01-02T00:00:00Z"),
+            )
+            _eventually(
+                lambda: kube.get("Pod", "a-0", "team-a")["spec"].get(
+                    "nodeName") == "host-a",
+                msg="claimant binds after graceful eviction",
+                timeout=15.0,
+            )
+            assert any(
+                ns == "team-b" and grace == 30
+                for _, ns, grace in kube.evictions
+            ), kube.evictions

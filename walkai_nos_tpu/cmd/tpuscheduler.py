@@ -22,9 +22,14 @@ import sys
 
 from walkai_nos_tpu.cmd import _common
 from walkai_nos_tpu.kube import objects
-from walkai_nos_tpu.kube.client import KubeClient, NotFound
+from walkai_nos_tpu.kube.client import EvictionBlocked, KubeClient, NotFound
 from walkai_nos_tpu.kube.runtime import Controller, Manager, Request, Result
-from walkai_nos_tpu.quota.fit import fits_node
+from walkai_nos_tpu.quota.fit import (
+    fits_node,
+    matches_node_affinity,
+    satisfies_pod_affinity,
+    tolerates_node_taints,
+)
 from walkai_nos_tpu.quota.labeler import (
     LABEL_CAPACITY,
     CapacityLabeler,
@@ -79,11 +84,10 @@ class Scheduler:
                 # example, `key-concepts.md:31-46`). No node-locality
                 # (evictions anywhere shrink others' borrowing), and only
                 # the shortfall's worth of chips — not the full request.
-                victims = plugin.find_preemption_victims(
-                    pod, pods, needed_chips=decision.shortfall
-                )
-                self._evict(victims, request)
-                if victims:
+                if self._preempt(
+                    plugin, pod, pods, request,
+                    needed_chips=decision.shortfall,
+                ):
                     return Result(requeue_after=0.5)
             # Quota denials are NOT capacity problems: retiling can't
             # create quota headroom, so don't mark Unschedulable (the
@@ -91,8 +95,9 @@ class Scheduler:
             return Result(requeue_after=5.0)
 
         nodes = self._kube.list("Node")
+        nodes_by_name = {objects.name(n): n for n in nodes}
         for node in sorted(nodes, key=objects.name):
-            if not self._node_eligible(pod, node):
+            if not self._node_eligible(pod, node, pods, nodes_by_name):
                 continue
             if fits_node(pod, node, pods):
                 bind_pod(self._kube, pod, objects.name(node))
@@ -107,9 +112,7 @@ class Scheduler:
         # Physically unschedulable (PostFilter): fair-sharing preemption of
         # over-quota pods elsewhere (`key-concepts.md:31-40`), chosen
         # node-locally so the freed chips are actually usable.
-        victims = plugin.find_preemption_victims(pod, pods, nodes)
-        self._evict(victims, request)
-        if victims:
+        if self._preempt(plugin, pod, pods, request, nodes=nodes):
             return Result(requeue_after=0.5)  # re-fit after evictions
         # No fit anywhere: record the Unschedulable condition so the
         # partitioner considers re-tiling for this pod — kube-scheduler
@@ -120,23 +123,63 @@ class Scheduler:
 
     # ---------------------------------------------------------------- helpers
 
-    def _evict(self, victims: list[dict], request: Request) -> None:
-        for victim in victims:
-            logger.info(
-                "preempting over-quota pod %s/%s for %s/%s",
-                objects.namespace(victim),
-                objects.name(victim),
-                request.namespace,
-                request.name,
+    def _preempt(
+        self,
+        plugin: CapacityScheduling,
+        pod: dict,
+        pods: list[dict],
+        request: Request,
+        nodes: list[dict] | None = None,
+        needed_chips: int | None = None,
+    ) -> int:
+        """Select and evict victims, re-selecting around refusals.
+
+        Eviction goes through the Eviction API: graceful deletion with
+        the victim's own terminationGracePeriodSeconds (server default
+        when unset) and PodDisruptionBudgets respected. A budget-blocked
+        victim survives and is excluded from the next selection round,
+        so an unprotected alternative (if any) is still found instead of
+        hot-requeuing against the same protected pod forever. Returns
+        the number of evictions that actually succeeded — zero means no
+        progress, and the caller falls through to its no-victim path
+        (unschedulable condition / slow requeue)."""
+        excluded: set[tuple[str, str]] = set()
+        evicted = 0
+        while True:
+            victims = plugin.find_preemption_victims(
+                pod, pods, nodes, needed_chips, exclude=excluded
             )
-            try:
-                self._kube.delete(
-                    "Pod",
-                    objects.name(victim),
-                    objects.namespace(victim) or "default",
+            if not victims:
+                return evicted
+            blocked_this_round = 0
+            for victim in victims:
+                ns = objects.namespace(victim) or "default"
+                logger.info(
+                    "preempting over-quota pod %s/%s for %s/%s",
+                    ns, objects.name(victim),
+                    request.namespace, request.name,
                 )
-            except NotFound:
-                pass
+                grace = (victim.get("spec") or {}).get(
+                    "terminationGracePeriodSeconds"
+                )
+                try:
+                    self._kube.evict_pod(
+                        objects.name(victim), ns,
+                        grace_period_seconds=grace,
+                    )
+                    evicted += 1
+                except EvictionBlocked as e:
+                    logger.info(
+                        "victim %s/%s protected by a disruption budget, "
+                        "skipped: %s",
+                        ns, objects.name(victim), e.message,
+                    )
+                    excluded.add((ns, objects.name(victim)))
+                    blocked_this_round += 1
+                except NotFound:
+                    evicted += 1  # already gone: capacity freed anyway
+            if blocked_this_round == 0:
+                return evicted
 
     def _mark_unschedulable(self, pod: dict, request: Request) -> None:
         if objects.pod_is_unschedulable(pod):
@@ -163,9 +206,13 @@ class Scheduler:
             objects.namespace(pod) or "default",
         )
 
-    def _node_eligible(self, pod: dict, node: dict) -> bool:
-        """Basic scheduler-framework gates kube-scheduler would apply:
-        cordon, readiness, and the pod's nodeSelector."""
+    def _node_eligible(
+        self, pod: dict, node: dict, pods: list[dict],
+        nodes_by_name: dict[str, dict],
+    ) -> bool:
+        """The scheduler-framework gates kube-scheduler would apply:
+        cordon, readiness, nodeSelector, taints/tolerations, required
+        node affinity, and required pod (anti)affinity (`quota/fit.py`)."""
         if (node.get("spec") or {}).get("unschedulable"):
             return False
         for cond in (node.get("status") or {}).get("conditions") or []:
@@ -173,7 +220,13 @@ class Scheduler:
                 return False
         selector = (pod.get("spec") or {}).get("nodeSelector") or {}
         labels = objects.labels(node)
-        return all(labels.get(k) == v for k, v in selector.items())
+        if not all(labels.get(k) == v for k, v in selector.items()):
+            return False
+        return (
+            tolerates_node_taints(pod, node)
+            and matches_node_affinity(pod, node)
+            and satisfies_pod_affinity(pod, node, pods, nodes_by_name)
+        )
 
 
 def build_manager(kube: KubeClient, scheduler_name: str = SCHEDULER_NAME) -> Manager:

@@ -44,6 +44,14 @@ class Conflict(ApiError):
         super().__init__(409, message)
 
 
+class EvictionBlocked(ApiError):
+    """The Eviction API refused: a PodDisruptionBudget has no
+    disruptions left (HTTP 429 with a DisruptionBudget cause)."""
+
+    def __init__(self, message: str = "disruption budget exhausted"):
+        super().__init__(429, message)
+
+
 class KubeClient(abc.ABC):
     """CRUD + watch over dict-shaped objects.
 
@@ -103,6 +111,20 @@ class KubeClient(abc.ABC):
         subresource (overridden in RestKubeClient); the default mutates
         spec.nodeName directly, which is what fakes accept."""
         self.patch("Pod", name, {"spec": {"nodeName": node_name}}, namespace)
+
+    def evict_pod(
+        self,
+        name: str,
+        namespace: str,
+        grace_period_seconds: int | None = None,
+    ) -> None:
+        """Graceful, PDB-respecting deletion through the pods/eviction
+        subresource. Raises `EvictionBlocked` when a PodDisruptionBudget
+        has no disruptions left (real servers enforce this server-side;
+        `FakeKubeClient` emulates it via `kube.disruption`). The default
+        falls back to a plain delete for implementations without the
+        subresource."""
+        self.delete("Pod", name, namespace)
 
     @abc.abstractmethod
     def watch(

@@ -1,0 +1,94 @@
+"""PodDisruptionBudget evaluation for the Eviction API emulation.
+
+The real API server enforces PDBs inside the pods/eviction subresource
+handler (a 429 with a DisruptionBudget cause when the budget is spent).
+Our fake client and the in-process test API server share this module so
+both enforce the same semantics the scheduler's preemption path relies
+on; `RestKubeClient` defers to the real server instead.
+
+Reference frame: the restored scheduler spec inherits kube-scheduler's
+PDB-aware preemption (`docs/en/docs/elastic-resource-quota/
+key-concepts.md:27-75` — scheduling is delegated to the framework, which
+evicts through the Eviction API).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from walkai_nos_tpu.kube import objects
+
+
+def _parse_maybe_percent(value, total: int) -> int:
+    """An IntOrString PDB bound: ints pass through, "50%" rounds the way
+    the disruption controller does (minAvailable up, handled by caller
+    symmetry — we round half away from the budget, i.e. up, which is the
+    conservative direction for minAvailable and matches k8s for it)."""
+    if isinstance(value, str) and value.endswith("%"):
+        pct = int(value[:-1])
+        return -(-pct * total // 100)  # ceil
+    return int(value)
+
+
+def _pod_is_healthy(pod: Mapping) -> bool:
+    """The disruption controller counts a pod healthy when it is Ready;
+    without a kubelet in the loop, bound + Running (or bound + no phase
+    yet in fakes) is the closest observable."""
+    if (pod.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+        return False
+    return bool((pod.get("spec") or {}).get("nodeName"))
+
+
+def eviction_allowed(
+    pod: Mapping, pdbs: list[Mapping], pods: list[Mapping]
+) -> tuple[bool, str]:
+    """Whether evicting `pod` is allowed by every matching PDB.
+
+    Returns (allowed, reason). `pods` is the pod population to count
+    against (same namespace); a PDB whose selector matches the pod
+    blocks the eviction when disrupting one more healthy pod would
+    drop below minAvailable / exceed maxUnavailable.
+    """
+    pod_ns = objects.namespace(pod)
+    pod_labels = objects.labels(pod)
+    for pdb in pdbs:
+        if objects.namespace(pdb) != pod_ns:
+            continue
+        selector = (pdb.get("spec") or {}).get("selector")
+        if not objects.matches_label_selector(pod_labels, selector):
+            continue
+        matching = [
+            p
+            for p in pods
+            if objects.namespace(p) == pod_ns
+            and objects.matches_label_selector(objects.labels(p), selector)
+        ]
+        healthy = sum(1 for p in matching if _pod_is_healthy(p))
+        # Evicting an already-unhealthy pod does not reduce the healthy
+        # count — the real handler (IfHealthyBudget policy, the default)
+        # then only requires the budget to be currently met, so debit
+        # the eviction only when the victim is healthy.
+        delta = 1 if _pod_is_healthy(pod) else 0
+        spec = pdb.get("spec") or {}
+        if "minAvailable" in spec:
+            min_available = _parse_maybe_percent(
+                spec["minAvailable"], len(matching)
+            )
+            if healthy - delta < min_available:
+                return False, (
+                    f"pdb {objects.name(pdb)}: eviction would leave "
+                    f"{healthy - delta} healthy < minAvailable "
+                    f"{min_available}"
+                )
+        if "maxUnavailable" in spec:
+            max_unavailable = _parse_maybe_percent(
+                spec["maxUnavailable"], len(matching)
+            )
+            unavailable = len(matching) - healthy
+            if unavailable + delta > max_unavailable:
+                return False, (
+                    f"pdb {objects.name(pdb)}: eviction would make "
+                    f"{unavailable + delta} unavailable > maxUnavailable "
+                    f"{max_unavailable}"
+                )
+    return True, ""

@@ -39,6 +39,8 @@ class FakeKubeClient(KubeClient):
         self._objects: dict[tuple, dict] = {}
         self._watchers: dict[str, list[queue.Queue]] = {}
         self._rv = itertools.count(1)
+        # (name, namespace, grace_period_seconds) per successful eviction.
+        self.evictions: list[tuple[str, str, int | None]] = []
 
     # ------------------------------------------------------------------ CRUD
 
@@ -143,6 +145,39 @@ class FakeKubeClient(KubeClient):
             if obj is None:
                 raise NotFound(f"{kind} {namespace or ''}/{name}")
             self._notify(kind, ("DELETED", objects.deep_copy(obj)))
+
+    def evict_pod(
+        self,
+        name: str,
+        namespace: str,
+        grace_period_seconds: int | None = None,
+    ) -> None:
+        """pods/eviction emulation: enforce PodDisruptionBudgets the way
+        the real subresource handler does (`kube.disruption`), then
+        delete. Evictions are recorded (`self.evictions`) so tests can
+        assert the grace period the caller granted."""
+        from walkai_nos_tpu.kube.client import EvictionBlocked
+        from walkai_nos_tpu.kube.disruption import eviction_allowed
+
+        with self._lock:
+            pod = self._objects.get(_key("Pod", name, namespace))
+            if pod is None:
+                raise NotFound(f"Pod {namespace}/{name}")
+            pdbs = [
+                objects.deep_copy(o)
+                for (k, ns, _), o in self._objects.items()
+                if k == "PodDisruptionBudget" and ns == namespace
+            ]
+            pods = [
+                objects.deep_copy(o)
+                for (k, ns, _), o in self._objects.items()
+                if k == "Pod" and ns == namespace
+            ]
+            allowed, reason = eviction_allowed(pod, pdbs, pods)
+            if not allowed:
+                raise EvictionBlocked(reason)
+            self.evictions.append((name, namespace, grace_period_seconds))
+            self.delete("Pod", name, namespace)
 
     # ----------------------------------------------------------------- watch
 

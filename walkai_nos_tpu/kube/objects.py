@@ -47,6 +47,39 @@ def matches_labels(obj: Mapping, selector: Mapping[str, str]) -> bool:
     return all(lbls.get(k) == v for k, v in selector.items())
 
 
+def matches_label_selector(lbls: Mapping[str, str], selector: Mapping) -> bool:
+    """Full k8s LabelSelector semantics (matchLabels AND matchExpressions
+    with In/NotIn/Exists/DoesNotExist) — the `metav1.LabelSelector`
+    matching PDBs, pod (anti)affinity terms, and quota selectors use.
+    An empty/None selector matches nothing is the PDB convention for
+    `null`; here None matches nothing, `{}` matches everything (the
+    k8s convention for an empty selector object)."""
+    if selector is None:
+        return False
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if lbls.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key = expr.get("key")
+        op = expr.get("operator")
+        values = expr.get("values") or []
+        if op == "In":
+            if lbls.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if key in lbls and lbls[key] in values:
+                return False
+        elif op == "Exists":
+            if key not in lbls:
+                return False
+        elif op == "DoesNotExist":
+            if key in lbls:
+                return False
+        else:
+            return False  # unknown operator: fail closed
+    return True
+
+
 def set_annotations(obj: dict, new: Mapping[str, str | None]) -> dict:
     """Return a copy with annotation updates applied (None deletes)."""
     out = deep_copy(obj)

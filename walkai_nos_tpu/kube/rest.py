@@ -33,6 +33,7 @@ from walkai_nos_tpu.kube.client import (
     SYNCED,
     ApiError,
     Conflict,
+    EvictionBlocked,
     KubeClient,
     NotFound,
     WatchEvent,
@@ -51,6 +52,7 @@ _KINDS: dict[str, tuple[str, str, bool]] = {
     "Event": ("/api/v1", "events", True),
     "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
     "ResourceQuota": ("/api/v1", "resourcequotas", True),
+    "PodDisruptionBudget": ("/apis/policy/v1", "poddisruptionbudgets", True),
     "ElasticQuota": ("/apis/nos.walkai.io/v1alpha1", "elasticquotas", True),
     "CompositeElasticQuota": (
         "/apis/nos.walkai.io/v1alpha1",
@@ -202,6 +204,8 @@ class RestKubeClient(KubeClient):
                 raise NotFound(msg) from None
             if e.code == 409:
                 raise Conflict(msg) from None
+            if e.code == 429 and path.endswith("/eviction"):
+                raise EvictionBlocked(msg) from None
             raise ApiError(e.code, msg) from None
         except urllib.error.URLError as e:
             raise ApiError(500, f"{method} {path}: {e.reason}") from None
@@ -330,6 +334,28 @@ class RestKubeClient(KubeClient):
                 "metadata": {"name": name, "namespace": namespace},
                 "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
             },
+        )
+
+    def evict_pod(
+        self,
+        name: str,
+        namespace: str,
+        grace_period_seconds: int | None = None,
+    ) -> None:
+        """pods/eviction subresource — graceful, PDB-enforced deletion.
+        The server answers 429 when a PodDisruptionBudget has no
+        disruptions left; that surfaces as `EvictionBlocked`."""
+        body: dict = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        }
+        if grace_period_seconds is not None:
+            body["deleteOptions"] = {
+                "gracePeriodSeconds": grace_period_seconds
+            }
+        self._request(
+            "POST", self._path("Pod", namespace, name) + "/eviction", body=body
         )
 
     # ---------------------------------------------------------------- watch

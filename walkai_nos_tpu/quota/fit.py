@@ -78,3 +78,162 @@ def fits_node(pod: Mapping, node: Mapping, pods: list[Mapping]) -> bool:
         return True
     free = node_free_resources(node, pods)
     return all(free.get(res, 0) >= qty for res, qty in wanted.items())
+
+
+# --------------------------------------------------------------- eligibility
+# The scheduler-framework gates kube-scheduler applies before fitting.
+# The reference spec this scheduler restores was a kube-scheduler plugin
+# (`pkg/api/scheduler/v1beta3/types.go:26-30`) and so inherited these for
+# free; a standalone scheduler must provide them itself or pods opting in
+# silently lose taint/affinity guarantees.
+
+
+def _toleration_matches(tol: Mapping, taint: Mapping) -> bool:
+    op = tol.get("operator", "Equal")
+    if tol.get("key"):
+        if tol["key"] != taint.get("key"):
+            return False
+    elif op != "Exists":
+        # An empty key requires operator Exists (matches every taint).
+        return False
+    if op == "Equal" and tol.get("value") != taint.get("value"):
+        return False
+    if tol.get("effect") and tol["effect"] != taint.get("effect"):
+        return False
+    return True
+
+
+def tolerates_node_taints(pod: Mapping, node: Mapping) -> bool:
+    """False when the node carries a NoSchedule/NoExecute taint the pod
+    does not tolerate (PreferNoSchedule is soft — never blocks)."""
+    tolerations = (pod.get("spec") or {}).get("tolerations") or []
+    for taint in (node.get("spec") or {}).get("taints") or []:
+        if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(_toleration_matches(t, taint) for t in tolerations):
+            return False
+    return True
+
+
+def _node_values(node: Mapping, key: str) -> str | None:
+    if key == "metadata.name":
+        return objects.name(node)
+    return objects.labels(node).get(key)
+
+
+def _match_expressions(node: Mapping, exprs: list, field: bool) -> bool:
+    for expr in exprs or []:
+        key = expr.get("key")
+        op = expr.get("operator")
+        values = expr.get("values") or []
+        have = (
+            _node_values(node, key)
+            if field
+            else objects.labels(node).get(key)
+        )
+        if op == "In":
+            if have not in values:
+                return False
+        elif op == "NotIn":
+            if have is not None and have in values:
+                return False
+        elif op == "Exists":
+            if have is None:
+                return False
+        elif op == "DoesNotExist":
+            if have is not None:
+                return False
+        elif op in ("Gt", "Lt"):
+            try:
+                have_n, want_n = int(have), int(values[0])
+            except (TypeError, ValueError, IndexError):
+                return False
+            if op == "Gt" and not have_n > want_n:
+                return False
+            if op == "Lt" and not have_n < want_n:
+                return False
+        else:
+            return False  # unknown operator: fail closed
+    return True
+
+
+def matches_node_affinity(pod: Mapping, node: Mapping) -> bool:
+    """requiredDuringSchedulingIgnoredDuringExecution node affinity:
+    OR over nodeSelectorTerms, AND within a term (matchExpressions over
+    labels, matchFields over metadata.name)."""
+    affinity = (pod.get("spec") or {}).get("affinity") or {}
+    required = (affinity.get("nodeAffinity") or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    if not required:
+        return True
+    terms = required.get("nodeSelectorTerms") or []
+    if not terms:
+        return True
+    return any(
+        _match_expressions(node, term.get("matchExpressions"), field=False)
+        and _match_expressions(node, term.get("matchFields"), field=True)
+        for term in terms
+    )
+
+
+def _term_peers(
+    pod: Mapping, term: Mapping, pods: list[Mapping]
+) -> list[Mapping]:
+    """Bound pods matching an (anti)affinity term's labelSelector, in the
+    term's namespaces (defaults to the pod's own namespace). An absent
+    labelSelector matches NO pods (the k8s nil-selector convention —
+    `matches_label_selector(…, None)` is False); only an explicit `{}`
+    matches everything."""
+    namespaces = term.get("namespaces") or [objects.namespace(pod) or "default"]
+    selector = term.get("labelSelector")
+    return [
+        p
+        for p in pods
+        if (p.get("spec") or {}).get("nodeName")
+        and (objects.namespace(p) or "default") in namespaces
+        and objects.matches_label_selector(objects.labels(p), selector)
+        and (p.get("status") or {}).get("phase")
+        not in ("Succeeded", "Failed")
+    ]
+
+
+def satisfies_pod_affinity(
+    pod: Mapping,
+    node: Mapping,
+    pods: list[Mapping],
+    nodes_by_name: Mapping[str, Mapping],
+) -> bool:
+    """Required pod (anti)affinity: for each podAffinity term the node
+    must share the topologyKey value with at least one matching bound
+    pod's node; for each podAntiAffinity term it must share it with
+    none."""
+    affinity = (pod.get("spec") or {}).get("affinity") or {}
+
+    def topology_matches(term: Mapping) -> bool:
+        key = term.get("topologyKey") or ""
+        node_value = objects.labels(node).get(key)
+        if key == "kubernetes.io/hostname" and node_value is None:
+            node_value = objects.name(node)
+        for peer in _term_peers(pod, term, pods):
+            peer_node = nodes_by_name.get(peer["spec"]["nodeName"])
+            if peer_node is None:
+                continue
+            peer_value = objects.labels(peer_node).get(key)
+            if key == "kubernetes.io/hostname" and peer_value is None:
+                peer_value = objects.name(peer_node)
+            if node_value is not None and node_value == peer_value:
+                return True
+        return False
+
+    for term in (affinity.get("podAffinity") or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ) or []:
+        if not topology_matches(term):
+            return False
+    for term in (affinity.get("podAntiAffinity") or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ) or []:
+        if topology_matches(term):
+            return False
+    return True

@@ -94,6 +94,7 @@ class CapacityScheduling:
         pods: list[dict],
         nodes: list[dict] | None = None,
         needed_chips: int | None = None,
+        exclude: set[tuple[str, str]] | None = None,
     ) -> list[dict]:
         """Victims whose eviction lets `pod` schedule, fair-sharing rules.
 
@@ -106,7 +107,9 @@ class CapacityScheduling:
         partitioner's retile) can use. `needed_chips` overrides how many
         chips eviction must free (the borrowing shortfall on a quota
         denial — evicting a full request's worth there would kill more
-        workloads than the headroom requires).
+        workloads than the headroom requires). `exclude` drops named
+        (namespace, name) candidates — the scheduler re-selects around
+        victims whose eviction a PodDisruptionBudget refused.
         """
         from walkai_nos_tpu.quota.state import pod_holds_quota
 
@@ -138,6 +141,8 @@ class CapacityScheduling:
         candidates = []
         for p in pods:
             ns = objects.namespace(p) or "default"
+            if exclude and (ns, objects.name(p)) in exclude:
+                continue
             victim_quota = self._state.for_namespace(ns)
             if victim_quota is None or victim_quota.name == quota.name:
                 continue
