@@ -192,11 +192,17 @@ bool parse_placement(const std::string& text, Slice* out) {
   if (!parse_dims(text.substr(colon + 1), 'x', &out->orientation))
     return false;
   if (out->offset.size() != out->orientation.size()) return false;
-  // Orientation must be a permutation of the canonical profile shape.
+  // Orientation must be a permutation of the canonical profile shape —
+  // EXCEPT for a pool share, where the profile names a multi-host pool
+  // slice larger than any single host: there the orientation is the
+  // host's own mesh (cells_to_chips then requires it to cover the whole
+  // mesh at offset zero). Distinguished by chip count: a pool share's
+  // profile has strictly more chips than its orientation.
   std::vector<int> a = profile_dims, b = out->orientation;
   std::sort(a.begin(), a.end());
   std::sort(b.begin(), b.end());
-  if (a != b) return false;
+  if (a != b && product(profile_dims) <= product(out->orientation))
+    return false;
   out->slice_id = out->profile + "@" + [&] {
     std::ostringstream os;
     for (size_t i = 0; i < out->offset.size(); ++i)
@@ -338,6 +344,19 @@ tpudev_status emit(const std::string& json, char* buf, size_t buflen) {
 bool cells_to_chips(const Slice& s, std::vector<int>* chips) {
   const auto& mesh = g_state.mesh;
   if (s.offset.size() != mesh.size()) return false;
+  std::vector<int> profile_dims;
+  if (!parse_dims(s.profile, 'x', &profile_dims)) return false;
+  std::vector<int> a = profile_dims, b = s.orientation;
+  std::sort(a.begin(), a.end());
+  std::sort(b.begin(), b.end());
+  if (a != b) {
+    // Only a pool share may mismatch (see parse_placement), and it must
+    // cover the entire host mesh at offset zero.
+    if (product(profile_dims) <= product(mesh)) return false;
+    if (s.orientation != mesh) return false;
+    for (int o : s.offset)
+      if (o != 0) return false;
+  }
   for (size_t d = 0; d < mesh.size(); ++d)
     if (s.offset[d] + s.orientation[d] > mesh[d]) return false;
   chips->clear();

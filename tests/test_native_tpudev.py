@@ -91,6 +91,39 @@ class TestNativeTpudev:
         assert r.returncode == 0, r.stderr
         assert "OK" in r.stdout
 
+    def test_pool_share_covers_whole_host(self, libtpudev, host_env):
+        """A pool share (profile spanning more chips than the host has)
+        is valid only as a full-host placement at offset zero; partial
+        coverage is rejected (tpudev.cc pool-share rule)."""
+        r = _spawn_client_subprocess(
+            libtpudev,
+            # Valid: the host's 2x4 share of a 4x8 (4-host v5e) slice.
+            "p = Placement(profile='4x8', offset=(0, 0), orientation=(2, 4))\n"
+            "created = client.create_slices([p])\n"
+            "assert [s.slice_id for s in created] == ['4x8@0-0']\n"
+            "assert created[0].profile == '4x8'\n"
+            "assert len(created[0].chip_ids) == 8, created[0].chip_ids\n"
+            "client.delete_slice('4x8@0-0')\n"
+            # Invalid: pool profile on a partial placement.
+            "bad = Placement(profile='4x8', offset=(0, 0), orientation=(2, 2))\n"
+            "try:\n"
+            "    client.create_slices([bad])\n"
+            "    raise SystemExit('partial pool share accepted')\n"
+            "except GenericError:\n"
+            "    pass\n"
+            # Invalid: profile bigger than orientation but <= host chips
+            # must NOT slip through as a mislabeled slice.\n"
+            "bad2 = Placement(profile='2x4', offset=(0, 0), orientation=(1, 2))\n"
+            "try:\n"
+            "    client.create_slices([bad2])\n"
+            "    raise SystemExit('mislabeled slice accepted')\n"
+            "except GenericError:\n"
+            "    pass\n"
+            "print('OK')",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
+
     def test_overlap_and_duplicate_rejected(self, libtpudev, host_env):
         r = _spawn_client_subprocess(
             libtpudev,

@@ -1,0 +1,364 @@
+"""Multi-host pool partitioning: topology, planning model, and e2e.
+
+The TPU-native extension of `node_controller.go:56`'s premise (every
+labeled node is managed) to pools whose slice spans hosts — VERDICT r2's
+top capability gap. Unit tables over `topology.get_pool_topology` /
+`tiling.pool.PoolNode`, then the sim-harness e2e: a 2-host v5p pool
+initializes, re-tiles for pending pods, and binds gangs, with per-host
+agents actuating their own share.
+"""
+
+from tests.helpers import eventually
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.kube import objects
+from walkai_nos_tpu.sim import SimCluster
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.tiling.pool import (
+    PoolNode,
+    block_orientations,
+    group_pool_members,
+    is_pool_profile,
+    pool_profiles,
+)
+
+
+def _labels(
+    acc="tpu-v5p-slice", topo="2x2x2", pool="pool-a", worker=None
+):
+    labels = {
+        constants.LABEL_TPU_ACCELERATOR: acc,
+        constants.LABEL_TPU_TOPOLOGY: topo,
+        constants.LABEL_TPU_PARTITIONING: "tiling",
+    }
+    if pool:
+        labels[constants.LABEL_TPU_NODEPOOL] = pool
+    if worker is not None:
+        labels[constants.LABEL_TPU_WORKER_ID] = str(worker)
+    return labels
+
+
+def _member(name, worker, annotations=None, **kw):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": _labels(worker=worker, **kw),
+            "annotations": annotations or {},
+        }
+    }
+
+
+class TestPoolTopology:
+    def test_v5p_two_host_pool(self):
+        topo = topology.get_pool_topology(_labels(topo="2x2x2"))
+        assert topo is not None
+        assert topo.host_mesh == (2, 2, 1)
+        assert topo.host_grid == (1, 1, 2)
+        assert topo.num_hosts == 2
+        assert topo.pool_profile == "2x2x2"
+        assert topo.hosts_per_slice("2x2x2") == 2
+
+    def test_v5e_four_host_pool(self):
+        topo = topology.get_pool_topology(
+            _labels(acc="tpu-v5-lite-podslice", topo="4x8")
+        )
+        assert topo is not None
+        assert topo.num_hosts == 4
+        assert topo.host_grid in ((2, 2),)
+
+    def test_single_host_is_not_a_pool(self):
+        assert topology.get_pool_topology(
+            _labels(acc="tpu-v5-lite-podslice", topo="2x4")
+        ) is None
+
+    def test_indivisible_topology_refused(self):
+        # 3x4 = 12 chips > 8 per host, but no host-mesh orientation
+        # divides it: not coordinatable.
+        assert topology.get_pool_topology(
+            _labels(acc="tpu-v5-lite-podslice", topo="3x4")
+        ) is None
+
+    def test_pool_profiles_v5p_pair(self):
+        topo = topology.get_pool_topology(_labels(topo="2x2x2"))
+        assert pool_profiles(topo) == ["2x2x2"]
+
+    def test_pool_profiles_v5e_quad(self):
+        topo = topology.get_pool_topology(
+            _labels(acc="tpu-v5-lite-podslice", topo="4x8")
+        )
+        profiles = pool_profiles(topo)
+        # 2-host (16 chips) and 4-host (32 chips) blocks.
+        assert "4x8" in profiles
+        assert any(
+            topology.shape_chip_count(topology.parse_shape(p)) == 16
+            for p in profiles
+        )
+
+    def test_block_orientations(self):
+        topo = topology.get_pool_topology(_labels(topo="2x2x2"))
+        assert is_pool_profile("2x2x2", topo)
+        assert not is_pool_profile("1x2x2", topo)
+        orients = block_orientations("2x2x2", topo)
+        assert ((2, 2, 2), (1, 1, 2)) in orients
+
+
+class TestGroupPoolMembers:
+    def test_split(self):
+        single = {
+            "metadata": {
+                "name": "s1",
+                "labels": _labels(acc="tpu-v5-lite-podslice", topo="2x4"),
+            }
+        }
+        orphan = {  # multi-host but no pool label: refusal path
+            "metadata": {"name": "o1", "labels": _labels(pool=None)}
+        }
+        m0, m1 = _member("p-0", 0), _member("p-1", 1)
+        singles, pools = group_pool_members([single, orphan, m0, m1])
+        assert [objects.name(n) for n in singles] == ["s1"]
+        assert set(pools) == {"pool-a"}
+        assert len(pools["pool-a"]) == 2
+
+
+class TestPoolNode:
+    def _pool(self, annotations_by_worker=None):
+        annotations_by_worker = annotations_by_worker or {}
+        members = [
+            _member(f"p-{i}", i, annotations=annotations_by_worker.get(i))
+            for i in range(2)
+        ]
+        pool = PoolNode.from_nodes("pool-a", members)
+        assert pool is not None
+        return pool
+
+    def test_incomplete_pool_not_planned(self):
+        assert PoolNode.from_nodes("pool-a", [_member("p-0", 0)]) is None
+
+    def test_duplicate_worker_ids_rejected(self):
+        assert PoolNode.from_nodes(
+            "pool-a", [_member("p-0", 0), _member("p-1", 0)]
+        ) is None
+
+    def test_fresh_pool_retiles_to_pool_slice(self):
+        pool = self._pool()
+        assert pool.has_free_capacity()
+        assert not pool.provides_profiles({"2x2x2": 1})
+        assert pool.update_geometry_for({"2x2x2": 1})
+        assert pool.provides_profiles({"2x2x2": 1})
+        # Every member's share is the pool profile x1.
+        for _node_obj, part in pool.build_partitionings():
+            assert part.per_mesh_geometry() == {0: {"2x2x2": 1}}
+
+    def test_add_pod_consumes_one_share_per_gang_pod(self):
+        # Pool-profile quantities are SHARES: each gang pod consumes
+        # one; a 2-host instance serves a 2-pod gang.
+        pool = self._pool()
+        pool.update_geometry_for({"2x2x2": 1})
+        pool.add_pod({"2x2x2": 1})
+        assert pool.provides_profiles({"2x2x2": 1})  # one share left
+        pool.add_pod({"2x2x2": 1})
+        assert not pool.provides_profiles({"2x2x2": 1})
+
+    def test_batched_gang_carves_one_instance(self):
+        # A 2-pod gang planned in one batch must carve ONE instance,
+        # not one per pod (the over-partitioning bug class).
+        pool = self._pool()
+        assert pool.update_geometry_for({"2x2x2": 2})
+        for _node_obj, part in pool.build_partitionings():
+            assert part.per_mesh_geometry() == {0: {"2x2x2": 1}}
+        pool.add_pod({"2x2x2": 2})
+        assert not pool.provides_profiles({"2x2x2": 1})
+
+    def test_missing_worker_id_not_planned(self):
+        members = [
+            _member("p-0", 0),
+            {
+                "metadata": {
+                    "name": "p-1",
+                    "labels": _labels(worker=None),
+                    "annotations": {},
+                }
+            },
+        ]
+        assert PoolNode.from_nodes("pool-a", members) is None
+
+    def test_host_local_profile_reclaims_free_share(self):
+        free_share = {
+            f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2x2-free": "1"
+        }
+        pool = self._pool({0: dict(free_share), 1: dict(free_share)})
+        assert pool.update_geometry_for({"1x1x2": 1})
+        assert pool.provides_profiles({"1x1x2": 1})
+        # The reclaimed host dropped its share: no full gang remains.
+        assert not pool.provides_profiles({"2x2x2": 1})
+
+    def test_used_host_never_reassigned_to_pool_slice(self):
+        pool = self._pool(
+            {
+                0: {
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1x2-used": "1",
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1x2-free": "1",
+                },
+                1: {
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1x2-free": "2"
+                },
+            }
+        )
+        # host 0 has a used slice: no 2-host block is free.
+        assert not pool.update_geometry_for({"2x2x2": 1})
+        assert not pool.provides_profiles({"2x2x2": 1})
+
+    def test_free_hosts_reassigned_from_local_tilings(self):
+        # Both hosts fully host-locally tiled but free: a pending pool
+        # slice reclaims them (the VERDICT "re-tiles for a pending
+        # multi-host slice pod" core).
+        free_local = {
+            f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1x2-free": "2"
+        }
+        pool = self._pool({0: dict(free_local), 1: dict(free_local)})
+        assert pool.update_geometry_for({"2x2x2": 1})
+        assert pool.provides_profiles({"2x2x2": 1})
+
+
+class TestPoolEndToEnd:
+    def test_pool_init_gang_binds(self):
+        """Fresh 2-host v5p pool: members initialize to the whole-pool
+        share, agents materialize full-host slices advertised under the
+        pool profile, and a 2-pod gang binds one pod per host."""
+        cluster = SimCluster()
+        cluster.add_pool("pool-a", n_hosts=2)
+        with cluster:
+            def initialized():
+                for i in range(2):
+                    node = cluster.kube.get("Node", f"pool-a-{i}")
+                    _, spec = parse_node_annotations(
+                        objects.annotations(node)
+                    )
+                    if not any(
+                        s.profile == "2x2x2" and s.quantity == 1
+                        for s in spec
+                    ):
+                        return False
+                return True
+
+            eventually(initialized, msg="pool members initialize to pool share")
+
+            def reported_free():
+                for i in range(2):
+                    node = cluster.kube.get("Node", f"pool-a-{i}")
+                    status, _ = parse_node_annotations(
+                        objects.annotations(node)
+                    )
+                    if not any(
+                        s.profile == "2x2x2" and s.status.value == "free"
+                        for s in status
+                    ):
+                        return False
+                return True
+
+            eventually(reported_free, msg="agents report free pool shares")
+            # The device layer materialized one full-host share per host.
+            for i in range(2):
+                slices = cluster.nodes[f"pool-a-{i}"].tpudev.list_slices()
+                assert [s.profile for s in slices] == ["2x2x2"]
+                assert len(slices[0].chip_ids) == 4  # whole 2x2x1 host
+
+            # The gang: one pod per host, each consuming one share.
+            cluster.create_slice_pod("gang-0", "2x2x2")
+            cluster.create_slice_pod("gang-1", "2x2x2")
+
+            def gang_bound():
+                hosts = set()
+                for name in ("gang-0", "gang-1"):
+                    pod = cluster.kube.get("Pod", name, "default")
+                    if not objects.pod_is_scheduled(pod):
+                        return False
+                    hosts.add(pod["spec"]["nodeName"])
+                return hosts == {"pool-a-0", "pool-a-1"}
+
+            eventually(gang_bound, msg="gang binds one pod per member host")
+
+    def test_pool_retile_for_pending_pool_pod(self):
+        """The VERDICT done-criterion: a pool re-tiled into host-local
+        slices re-tiles BACK for a pending pool-slice gang and binds it;
+        host-local pods keep working first."""
+        cluster = SimCluster()
+        cluster.add_pool("pool-b", n_hosts=2)
+        with cluster:
+            # Host-local demand first: a 2-chip slice forces one host
+            # out of the pool-share layout.
+            cluster.create_slice_pod("local-1", "1x1x2")
+
+            def local_bound():
+                pod = cluster.kube.get("Pod", "local-1", "default")
+                return objects.pod_is_scheduled(pod)
+
+            eventually(local_bound, msg="host-local pod binds on a pool host")
+
+            # The other member's share is now STRANDED (its instance-mate
+            # was reclaimed); the planner's same pass re-tiled it to the
+            # host-local default — no host may keep advertising a share no
+            # complete block backs.
+            def no_stranded_share():
+                for i in range(2):
+                    if any(
+                        s.profile == "2x2x2"
+                        for s in cluster.nodes[
+                            f"pool-b-{i}"
+                        ].tpudev.list_slices()
+                    ):
+                        return False
+                return True
+
+            eventually(no_stranded_share, msg="stranded share re-tiled away")
+
+            # Terminate the pod and release its device (what the kubelet
+            # does when a pod ends); the pod may have landed on either
+            # host, so release everywhere.
+            cluster.kube.delete("Pod", "local-1", "default")
+            for i in range(2):
+                host = cluster.nodes[f"pool-b-{i}"]
+                for dev in host.resources.get_used_devices():
+                    host.resources.mark_free(dev.device_id)
+
+            # Now the pool gang.
+            cluster.create_slice_pod("gang-0", "2x2x2")
+            cluster.create_slice_pod("gang-1", "2x2x2")
+
+            def gang_bound():
+                hosts = set()
+                for name in ("gang-0", "gang-1"):
+                    pod = cluster.kube.get("Pod", name, "default")
+                    if not objects.pod_is_scheduled(pod):
+                        return False
+                    hosts.add(pod["spec"]["nodeName"])
+                return hosts == {"pool-b-0", "pool-b-1"}
+
+            eventually(
+                gang_bound, timeout=30.0,
+                msg="pool re-tiles back and the gang binds",
+            )
+
+    def test_unpoolable_multi_host_node_still_refused(self):
+        """A multi-host node without the nodepool label keeps the round-2
+        refusal path (event + schedulable whole)."""
+        cluster = SimCluster()
+        # Hand-create: multi-host labels, no pool membership.
+        cluster.kube.create(
+            "Node",
+            {
+                "metadata": {
+                    "name": "orphan-mh",
+                    "labels": _labels(pool=None),
+                },
+                "status": {},
+            },
+        )
+        with cluster:
+            def refused():
+                events = cluster.kube.list("Event", namespace="default")
+                return any(
+                    e.get("reason") == "MultiHostTopology" for e in events
+                )
+
+            eventually(refused, msg="refusal event emitted")

@@ -182,6 +182,36 @@ class TestPodAffinity:
         assert not satisfies_pod_affinity(pod, nodes["a"], [peer], nodes)
         assert satisfies_pod_affinity(pod, nodes["b"], [peer], nodes)
 
+    def test_first_pod_self_match_exception(self):
+        """A self-referential required affinity (colocate all app=x)
+        must not deadlock its own first pod: with no bound peers and a
+        self-matching selector the term is satisfied (kube-scheduler's
+        InterPodAffinity rule)."""
+        term = {
+            "labelSelector": {"matchLabels": {"app": "x"}},
+            "topologyKey": "kubernetes.io/hostname",
+        }
+        affinity = {
+            "podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [term]
+            }
+        }
+        self_matching = {
+            "metadata": {"namespace": "d", "labels": {"app": "x"}},
+            "spec": {"affinity": affinity},
+        }
+        assert satisfies_pod_affinity(
+            self_matching, _node("a"), [], {"a": _node("a")}
+        )
+        # A pod that does NOT match its own selector gets no exception.
+        non_matching = {
+            "metadata": {"namespace": "d", "labels": {"app": "y"}},
+            "spec": {"affinity": affinity},
+        }
+        assert not satisfies_pod_affinity(
+            non_matching, _node("a"), [], {"a": _node("a")}
+        )
+
     def test_affinity_requires_cohosting_by_topology(self):
         peer = {
             "metadata": {"namespace": "d", "labels": {"app": "x"}},

@@ -52,6 +52,11 @@ LABEL_TPU_PARTITIONING = f"{API_GROUP}/tpu-partitioning"
 # `nvidia.com/gpu.{product,count,memory}`, `pkg/constant/constants.go:64-77`).
 LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+# Multi-host pool membership + the host's position in the pool: every
+# node of a GKE multi-host podslice carries the node-pool name and a
+# stable worker index — the coordination keys for pool-level planning.
+LABEL_TPU_NODEPOOL = "cloud.google.com/gke-nodepool"
+LABEL_TPU_WORKER_ID = "cloud.google.com/gke-tpu-worker-id"
 
 # ---------------------------------------------------------------------------
 # Resource names

@@ -151,6 +151,7 @@ class Scheduler:
             )
             if not victims:
                 return evicted
+            evicted_this_round = 0
             blocked_this_round = 0
             for victim in victims:
                 ns = objects.namespace(victim) or "default"
@@ -168,6 +169,7 @@ class Scheduler:
                         grace_period_seconds=grace,
                     )
                     evicted += 1
+                    evicted_this_round += 1
                 except EvictionBlocked as e:
                     logger.info(
                         "victim %s/%s protected by a disruption budget, "
@@ -178,7 +180,15 @@ class Scheduler:
                     blocked_this_round += 1
                 except NotFound:
                     evicted += 1  # already gone: capacity freed anyway
+                    evicted_this_round += 1
             if blocked_this_round == 0:
+                return evicted
+            if evicted_this_round > 0:
+                # Partial progress invalidates the pod/quota snapshot
+                # this selection ran on; re-selecting against it could
+                # pile victims on a second node for capacity the first
+                # round already half-freed. Stop here — the caller
+                # requeues shortly and re-plans against fresh state.
                 return evicted
 
     def _mark_unschedulable(self, pod: dict, request: Request) -> None:

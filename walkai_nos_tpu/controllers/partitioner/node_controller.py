@@ -39,14 +39,41 @@ class NodeController:
             return Result()
         if not is_tiling_partitioning_enabled(objects.labels(node)):
             return Result()
-        if topology.is_multi_host(objects.labels(node)):
-            self._refuse_multi_host(node)
+        labels = objects.labels(node)
+        if topology.is_multi_host(labels):
+            pool_topo = topology.get_pool_topology(labels)
+            if (
+                pool_topo is None
+                or topology.pool_key(labels) is None
+                or topology.worker_id(labels) is None
+            ):
+                # Not coordinatable: topology the host mesh does not
+                # evenly tile, no pool-membership label to group by, or
+                # no worker-id giving the host's physical grid position
+                # (guessing it could hand out a slice with no ICI torus
+                # behind it — see PoolNode.from_nodes).
+                self._refuse_multi_host(node)
+                return Result()
+            if self._pool_member_initialized(node):
+                return Result()
+            logger.info(
+                "node controller: initializing pool member %s "
+                "(pool %s, share %s)",
+                request.name,
+                topology.pool_key(labels),
+                pool_topo.pool_profile,
+            )
+            self._initializer.init_pool_member(node, pool_topo)
             return Result()
         if self._is_initialized(node):
             return Result()
         logger.info("node controller: initializing node %s", request.name)
         self._initializer.init_node_partitioning(node)
         return Result()
+
+    def _pool_member_initialized(self, node: dict) -> bool:
+        _, spec = parse_node_annotations(objects.annotations(node))
+        return bool(spec)
 
     def _refuse_multi_host(self, node: dict) -> None:
         """Multi-host pool labeled for partitioning: refuse loudly (event +

@@ -134,7 +134,7 @@ class PodController:
             return
         self._plan_pass(
             pods, get_requested_profiles, self._list_tiling_nodes,
-            Node.from_node, "repartitioned",
+            Node.from_node, "repartitioned", include_pools=True,
         )
         self._plan_pass(
             pods, get_requested_shared_profiles, self._list_sharing_nodes,
@@ -143,20 +143,31 @@ class PodController:
 
     def _plan_pass(
         self, pods: list[dict], wanted_fn, list_nodes, node_factory,
-        verb: str,
+        verb: str, include_pools: bool = False,
     ) -> None:
+        from walkai_nos_tpu.tpu.tiling.pool import (
+            PoolNode,
+            group_pool_members,
+        )
+
         wanted_pods = [
             (pod, wanted) for pod in pods if (wanted := wanted_fn(pod))
         ]
         if not wanted_pods:
             return
-        # Mutable views: [node_obj, simulated Node, changed?]. Claimed
+        # Mutable views: [writes_fn, simulated view, changed?]. Claimed
         # slices stay `used` in the simulation, which also protects them
         # from eviction by later pods' geometry transitions (the mesh
-        # search never evicts used slices).
+        # search never evicts used slices). `writes_fn(view)` yields the
+        # (node object, NodePartitioning) writes realizing the view — one
+        # for a single-host node, one per member host for a pool.
+        node_objs = list_nodes()
+        pools: dict[str, list[dict]] = {}
+        if include_pools:
+            node_objs, pools = group_pool_members(node_objs)
         views: list[list] = [
             [
-                node_obj,
+                lambda v, obj=node_obj: [(obj, build_node_partitioning(v))],
                 node_factory(
                     objects.name(node_obj),
                     objects.labels(node_obj),
@@ -164,8 +175,13 @@ class PodController:
                 ),
                 False,
             ]
-            for node_obj in list_nodes()
+            for node_obj in node_objs
         ]
+        for pool_name in sorted(pools):
+            pool = PoolNode.from_nodes(pool_name, pools[pool_name])
+            if pool is None:
+                continue  # not coordinatable (yet): refusal path
+            views.append([lambda v: v.build_partitionings(), pool, False])
         for pod, wanted in wanted_pods:
             if self._place_in_views(views, wanted):
                 continue
@@ -173,15 +189,16 @@ class PodController:
                 "pod controller: no node can provide %s for pod %s/%s",
                 wanted, objects.namespace(pod), objects.name(pod),
             )
-        for node_obj, view, changed in views:
+        for writes_fn, view, changed in views:
             if not changed:
                 continue
             plan_id = self._plan_id_fn()
-            self._partitioner.apply_partitioning(
-                node_obj, build_node_partitioning(view), plan_id
-            )
+            for node_obj, partitioning in writes_fn(view):
+                self._partitioner.apply_partitioning(
+                    node_obj, partitioning, plan_id
+                )
             logger.info(
-                "pod controller: %s node %s for a batch of %d pending "
+                "pod controller: %s %s for a batch of %d pending "
                 "pods (plan %s)",
                 verb, view.name, len(wanted_pods), plan_id,
             )

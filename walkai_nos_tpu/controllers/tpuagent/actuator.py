@@ -210,6 +210,42 @@ class Actuator:
                 if s.mesh_index == mesh_index
             ]
             pinned = [placement_from_slice_info(s, host) for s in existing]
+            # A profile spanning more chips than this host holds is this
+            # host's SHARE of a pool-level (multi-host) slice: it
+            # occupies the entire host mesh, advertised under the pool
+            # profile's resource name (tpu/tiling/pool.py).
+            pool_ops = [
+                op for op in ops
+                if topo.shape_chip_count(topo.parse_shape(op.profile))
+                > host.chip_count
+            ]
+            local_ops = [op for op in ops if op not in pool_ops]
+            if pool_ops:
+                if (
+                    pinned
+                    or local_ops
+                    or len(pool_ops) > 1
+                    or pool_ops[0].quantity != 1
+                ):
+                    raise GenericError(
+                        f"mesh {mesh_index}: a pool share occupies the "
+                        f"whole host; spec mixes it with other slices "
+                        f"({[o.profile for o in ops]}, "
+                        f"{len(pinned)} existing)"
+                    )
+                share = Placement(
+                    profile=pool_ops[0].profile,
+                    offset=(0,) * len(host.mesh),
+                    orientation=host.mesh,
+                )
+                result = self._client.create_slices([share])
+                created.extend(result)
+                if not result:
+                    raise GenericError(
+                        f"mesh {mesh_index}: pool share "
+                        f"{pool_ops[0].profile} not created"
+                    )
+                continue
             geometry: dict[str, int] = {}
             for p in pinned:
                 geometry[p.profile] = geometry.get(p.profile, 0) + 1

@@ -45,3 +45,25 @@ class NodeInitializer:
         self._partitioner.apply_partitioning(
             node_obj, build_node_partitioning(node)
         )
+
+    def init_pool_member(self, node_obj: dict, pool_topo) -> None:
+        """First-touch init of one multi-host-pool member: the coarsest
+        pool layout is the whole-pool slice, so every member's share is
+        the pool profile x1 (the pool analogue of fewest-slices,
+        `initializer.go:40-79`). Per-member and idempotent — members
+        joining at different times converge to the same spec without
+        cross-node coordination."""
+        from walkai_nos_tpu.partitioning.state import (
+            MeshPartitioning,
+            NodePartitioning,
+        )
+
+        self._partitioner.apply_partitioning(
+            node_obj,
+            NodePartitioning(
+                name=objects.name(node_obj),
+                meshes=(
+                    MeshPartitioning.of(0, {pool_topo.pool_profile: 1}),
+                ),
+            ),
+        )

@@ -230,6 +230,23 @@ def satisfies_pod_affinity(
         "requiredDuringSchedulingIgnoredDuringExecution"
     ) or []:
         if not topology_matches(term):
+            # kube-scheduler's first-pod exception (InterPodAffinity):
+            # when NO bound pod matches the term anywhere but the
+            # incoming pod matches its own selector, the term is
+            # satisfied — otherwise a self-referential gang
+            # ("colocate all app=x pods") could never place its first
+            # member and would deadlock forever.
+            namespaces = term.get("namespaces") or [
+                objects.namespace(pod) or "default"
+            ]
+            if (
+                not _term_peers(pod, term, pods)
+                and (objects.namespace(pod) or "default") in namespaces
+                and objects.matches_label_selector(
+                    objects.labels(pod), term.get("labelSelector")
+                )
+            ):
+                continue
             return False
     for term in (affinity.get("podAntiAffinity") or {}).get(
         "requiredDuringSchedulingIgnoredDuringExecution"

@@ -138,6 +138,45 @@ class SimCluster:
         self._wire_agent(sim)
         return sim
 
+    def add_pool(
+        self,
+        pool_name: str,
+        n_hosts: int = 2,
+        host_mesh: Shape = (2, 2, 1),
+        pool_topology: str = "2x2x2",
+        accelerator: str = "tpu-v5p-slice",
+    ) -> list[SimNode]:
+        """A multi-host pool: N hosts sharing one `gke-tpu-topology`,
+        grouped by the nodepool label with stable worker indices — the
+        v5p/v4 pod-slice shape the pool-level planner manages
+        (`tpu/tiling/pool.py`). Each host runs its own agent over its own
+        tpudev, exactly like a single-host node."""
+        sims = []
+        for i in range(n_hosts):
+            node_name = f"{pool_name}-{i}"
+            sim = SimNode(node_name, mesh=host_mesh, accelerator=accelerator)
+            self.nodes[node_name] = sim
+            self.kube.create(
+                "Node",
+                {
+                    "metadata": {
+                        "name": node_name,
+                        "labels": {
+                            constants.LABEL_TPU_ACCELERATOR: accelerator,
+                            constants.LABEL_TPU_TOPOLOGY: pool_topology,
+                            constants.LABEL_TPU_PARTITIONING: "tiling",
+                            constants.LABEL_TPU_NODEPOOL: pool_name,
+                            constants.LABEL_TPU_WORKER_ID: str(i),
+                        },
+                    },
+                    "status": {"capacity": {}, "allocatable": {}},
+                },
+            )
+            self._create_plugin_pod(node_name)
+            self._wire_agent(sim)
+            sims.append(sim)
+        return sims
+
     def add_sharing_node(
         self,
         name: str,

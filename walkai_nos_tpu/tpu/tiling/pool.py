@@ -1,0 +1,527 @@
+"""Pool-level tiling: multi-host TPU pools as a mesh of whole hosts.
+
+The reference premise is that every labeled node is managed
+(`internal/controllers/gpupartitioner/node_controller.go:56`); one GPU
+never spans hosts, so it has no analogue of a v5p/v4 pod slice whose ICI
+torus crosses machines. This module is the TPU-native extension: a
+multi-host pool is ONE planning unit — a grid of whole hosts
+(`topology.PoolTopology.host_grid`) — and a pool-level slice is an
+axis-aligned contiguous block of whole hosts, so every slice keeps a
+torus-capable sub-mesh (the SURVEY §7.4 contiguity constraint; slices
+never wrap around or interleave hosts).
+
+Two kinds of profiles coexist in a pool:
+
+- **host-local** profiles (chips <= chips per host): planned per host by
+  the same `TpuMesh` search single-host nodes use;
+- **pool-level** profiles (chips > chips per host): span whole hosts.
+  Each member host of a pool slice carries the pool profile in its spec
+  and status annotations with quantity 1 — its *share*. The agent
+  actuates a share as a full-host slice named by the pool profile, and
+  the device plugin advertises `walkai.io/tpu-<pool-profile>` x1 per
+  member, so an N-host workload runs as N pods each consuming one share
+  (the GKE multi-host podslice consumption shape).
+
+`PoolNode` exposes the same search surface as `tiling.Node`
+(has_free_capacity / provides_profiles / update_geometry_for / add_pod /
+clone), so the partitioner's first-fit planning treats pools and
+single-host nodes uniformly.
+
+Known v1 simplification: share accounting is per-host; when a pool holds
+several free instances of the same pool profile, a gang's pods could in
+principle be placed across instances by a topology-unaware scheduler.
+Instance grouping is recoverable from slice placement (contiguous
+blocks); a topology-aware gang scheduler can use it.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Mapping
+
+from walkai_nos_tpu.tpu import topology
+from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.tpu.device import DeviceStatus
+from walkai_nos_tpu.tpu.errors import GenericError
+from walkai_nos_tpu.tpu.partitioning import Geometry, geometry_id
+from walkai_nos_tpu.tpu.tiling import grid as gridlib
+from walkai_nos_tpu.tpu.tiling.mesh import TpuMesh
+from walkai_nos_tpu.tpu.topology import PoolTopology, Shape
+
+logger = logging.getLogger(__name__)
+
+
+def is_pool_profile(profile: str, topo: PoolTopology) -> bool:
+    """True when `profile` spans more chips than one host holds."""
+    try:
+        shape = topology.parse_shape(profile)
+    except ValueError:
+        return False
+    return topology.shape_chip_count(shape) > topo.model.chips_per_host
+
+
+def block_orientations(
+    profile: str, topo: PoolTopology
+) -> list[tuple[Shape, Shape]]:
+    """(chip-orientation, host-block) pairs realizing a pool profile.
+
+    A pool profile's chip shape (in some axis orientation, padded to the
+    pool's dimensionality) must be divisible by the host mesh per axis;
+    the quotient is the block of whole hosts it occupies in the host
+    grid. Returns every distinct realization, deterministic order.
+    """
+    try:
+        shape = topology.parse_shape(profile)
+    except ValueError:
+        return []
+    if len(shape) > len(topo.pool_shape):
+        return []
+    padded = (1,) * (len(topo.pool_shape) - len(shape)) + tuple(shape)
+    out = []
+    for orient in gridlib.orientations(padded):
+        if all(o % h == 0 for o, h in zip(orient, topo.host_mesh)):
+            block = tuple(o // h for o, h in zip(orient, topo.host_mesh))
+            if all(b <= g for b, g in zip(block, topo.host_grid)):
+                out.append((orient, block))
+    return out
+
+
+def pool_profiles(topo: PoolTopology) -> list[str]:
+    """Every valid pool-level profile: axis-aligned whole-host blocks
+    with a power-of-two chip count, larger than one host."""
+    from walkai_nos_tpu.tpu.tiling.known_tilings import canonical_profile
+
+    seen: set[str] = set()
+    for block in gridlib.all_coords(
+        tuple(g + 1 for g in topo.host_grid)
+    ):
+        if any(b == 0 for b in block):
+            continue
+        chips = tuple(b * h for b, h in zip(block, topo.host_mesh))
+        n = topology.shape_chip_count(chips)
+        if n <= topo.model.chips_per_host:
+            continue
+        if n & (n - 1):
+            continue  # power-of-two chip counts only
+        seen.add(canonical_profile(chips))
+    return sorted(
+        seen,
+        key=lambda p: (
+            topology.shape_chip_count(topology.parse_shape(p)), p,
+        ),
+    )
+
+
+@dataclass
+class PoolHost:
+    node_obj: dict  # the member Node object (write target)
+    name: str
+    index: int  # position in the host grid (row-major)
+    mesh: TpuMesh  # host-local view; a pool share appears as its profile
+
+    @property
+    def coord(self) -> tuple[int, ...]:
+        return self._coord
+
+    def set_coord(self, coord: tuple[int, ...]) -> None:
+        self._coord = coord
+
+
+class PoolNode:
+    """One multi-host pool as a planning unit (same surface as
+    `tiling.Node`)."""
+
+    def __init__(
+        self, name: str, topo: PoolTopology, hosts: list[PoolHost]
+    ) -> None:
+        self.name = name
+        self.topo = topo
+        self.hosts = hosts
+        coords = gridlib.all_coords(topo.host_grid)
+        for h in hosts:
+            h.set_coord(coords[h.index])
+
+    # The partitioner treats `model` as "is this a TPU node" — any
+    # non-None value.
+    @property
+    def model(self):
+        return self.topo.model
+
+    @staticmethod
+    def from_nodes(
+        pool_name: str, members: list[dict]
+    ) -> "PoolNode | None":
+        """Build from the pool's member Node objects. Returns None when
+        the pool is not coordinatable: topology not host-divisible, the
+        member set does not cover every worker index exactly once (a
+        partially registered pool must not be planned — a spec write
+        would desync against hosts that appear later), or a member has
+        no worker-id label. Worker ids are the ONLY source of physical
+        grid position: guessing from name order would let the planner
+        carve a "contiguous" block out of physically non-adjacent hosts
+        and hand a workload a slice with no ICI torus behind it."""
+        from walkai_nos_tpu.kube import objects as kobjects
+
+        if not members:
+            return None
+        labels0 = kobjects.labels(members[0])
+        topo = topology.get_pool_topology(labels0)
+        if topo is None:
+            return None
+        hosts: list[PoolHost] = []
+        seen: set[int] = set()
+        ordered = sorted(members, key=kobjects.name)
+        for node_obj in ordered:
+            labels = kobjects.labels(node_obj)
+            idx = topology.worker_id(labels)
+            if idx is None:
+                return None
+            if idx in seen or not 0 <= idx < topo.num_hosts:
+                return None
+            seen.add(idx)
+            status, _ = parse_node_annotations(kobjects.annotations(node_obj))
+            used: Geometry = {}
+            free: Geometry = {}
+            for s in status:
+                if s.mesh_index != 0 or s.quantity <= 0:
+                    continue
+                target = used if s.status == DeviceStatus.USED else free
+                target[s.profile] = target.get(s.profile, 0) + s.quantity
+            host_model = topology.TpuModel(
+                topo.model.name,
+                topo.model.generation,
+                topo.host_mesh,
+                topo.model.hbm_gb_per_chip,
+            )
+            hosts.append(
+                PoolHost(
+                    node_obj=node_obj,
+                    name=kobjects.name(node_obj),
+                    index=idx,
+                    mesh=TpuMesh(
+                        model=host_model, mesh_index=0, used=used, free=free
+                    ),
+                )
+            )
+        if len(hosts) != topo.num_hosts:
+            return None
+        hosts.sort(key=lambda h: h.index)
+        return PoolNode(pool_name, topo, hosts)
+
+    # ----------------------------------------------------------------- state
+
+    def _host_geometry_valid(self, host: PoolHost) -> bool:
+        geom = host.mesh.geometry()
+        if not geom:
+            return False  # uninitialized
+        if len(geom) == 1:
+            (profile, qty), = geom.items()
+            if qty == 1 and is_pool_profile(profile, self.topo):
+                return True  # a pool-share host
+        return geometry_id(geom) in {
+            geometry_id(g) for g in host.mesh.allowed_geometries()
+        }
+
+    def has_free_capacity(self) -> bool:
+        return any(
+            h.mesh.has_free_devices() or not self._host_geometry_valid(h)
+            for h in self.hosts
+        )
+
+    def provides_profiles(self, wanted: Geometry) -> bool:
+        """Pool-profile quantities count SHARES (one per gang pod, the
+        consumption unit each member host advertises), not instances."""
+        remaining = {p: q for p, q in wanted.items() if q > 0}
+        for p in list(remaining):
+            if is_pool_profile(p, self.topo):
+                take = min(remaining[p], self._free_shares(p))
+                remaining[p] -= take
+                if remaining[p] == 0:
+                    del remaining[p]
+        for h in self.hosts:
+            if self._holds_pool_share(h):
+                continue
+            for p in list(remaining):
+                take = min(remaining[p], h.mesh.free_count(p))
+                if take:
+                    remaining[p] -= take
+                    if remaining[p] == 0:
+                        del remaining[p]
+        return not remaining
+
+    def _holds_pool_share(self, host: PoolHost) -> bool:
+        return any(
+            is_pool_profile(p, self.topo)
+            for p in list(host.mesh.used) + list(host.mesh.free)
+        )
+
+    def _pool_share_used(self, host: PoolHost) -> bool:
+        return any(is_pool_profile(p, self.topo) for p in host.mesh.used)
+
+    def _instance_partially_used(self, host: PoolHost, profile: str) -> bool:
+        """Heuristic (exact with a single instance per profile, the
+        common pool shape): some share of this profile is already
+        consumed somewhere, so fill alongside it."""
+        return any(profile in h.mesh.used for h in self.hosts)
+
+    def _free_shares(self, profile: str) -> int:
+        """Free shares of a pool profile. Stranded shares are re-tiled
+        away at planning time (_drop_stranded_shares), so every free
+        share is backed by a complete instance."""
+        return sum(
+            1 for h in self.hosts if h.mesh.free_count(profile) > 0
+        )
+
+    # ---------------------------------------------------------------- search
+
+    def update_geometry_for(self, wanted: Geometry) -> bool:
+        """Two-phase transition toward `wanted`: assign contiguous
+        whole-host blocks to wanted pool profiles, then run the host-
+        local mesh search for the rest. Never touches a host with any
+        used slice (the never-evict invariant, `gpu.go:99`)."""
+        remaining = {p: q for p, q in wanted.items() if q > 0}
+        self._subtract_available(remaining)
+        changed = False
+        # Phase A: pool-level profiles -> contiguous free host blocks.
+        # `remaining` counts SHARES; one carved block provides
+        # hosts_per_slice of them, so a gang's worth of share requests
+        # is served by ONE new instance, not one instance per pod.
+        for p in sorted(
+            (p for p in remaining if is_pool_profile(p, self.topo)),
+            key=lambda p: -topology.shape_chip_count(topology.parse_shape(p)),
+        ):
+            per = self.topo.hosts_per_slice(p)
+            while remaining.get(p, 0) > 0:
+                block = self._find_free_block(p)
+                if block is None:
+                    break
+                for h in block:
+                    h.mesh.used = {}
+                    h.mesh.free = {p: 1}
+                changed = True
+                remaining[p] -= min(remaining[p], per)
+                if remaining[p] == 0:
+                    del remaining[p]
+        # Phase B: host-local profiles. A host whose pool share is merely
+        # FREE is reclaimable (the mesh search drops free slices); only a
+        # USED share pins the host to its pool slice.
+        host_wanted = {
+            p: q for p, q in remaining.items()
+            if not is_pool_profile(p, self.topo)
+        }
+        for h in self.hosts:
+            if not host_wanted:
+                break
+            if self._pool_share_used(h):
+                continue
+            if h.mesh.update_geometry_for(host_wanted):
+                changed = True
+                for p in list(host_wanted):
+                    take = min(host_wanted[p], h.mesh.free_count(p))
+                    if take:
+                        host_wanted[p] -= take
+                        if host_wanted[p] == 0:
+                            del host_wanted[p]
+        if self._drop_stranded_shares():
+            changed = True
+        return changed
+
+    def _drop_stranded_shares(self) -> bool:
+        """Re-tile free pool shares whose slice instance is broken.
+
+        Reclaiming one member of a pool slice (Phase B above, or a
+        previous plan) leaves its instance-mates holding free shares
+        that no complete block can ever satisfy — and a pool-unaware
+        scheduler could bind half a gang onto one, pinning the pool in a
+        broken layout. Group the remaining free shares into complete
+        contiguous blocks; hosts left over fall back to the fewest-
+        slices host-local tiling so their capacity stays usable."""
+        changed = False
+        profiles = {
+            p
+            for h in self.hosts
+            for p in h.mesh.free
+            if is_pool_profile(p, self.topo)
+        }
+        for p in profiles:
+            by_coord = {
+                h.coord: h
+                for h in self.hosts
+                if h.mesh.free_count(p) > 0 and not h.mesh.used
+            }
+            free_coords = set(by_coord)
+            used_coords = {
+                h.coord for h in self.hosts if p in h.mesh.used
+            }
+            # Disjoint complete blocks over free + used shares; blocks
+            # covering a USED share first (a half-consumed instance must
+            # keep its free mates for the rest of the gang).
+            candidates = free_coords | used_coords
+            kept: set[tuple[int, ...]] = set()
+            placements = [
+                [
+                    tuple(a + o for a, o in zip(anchor, off))
+                    for off in gridlib.all_coords(block)
+                ]
+                for _orient, block in block_orientations(p, self.topo)
+                for anchor in gridlib.all_coords(
+                    tuple(
+                        g - b + 1
+                        for g, b in zip(self.topo.host_grid, block)
+                    )
+                )
+            ]
+            for pass_used_first in (True, False):
+                for cells in placements:
+                    covers_used = any(c in used_coords for c in cells)
+                    if covers_used != pass_used_first:
+                        continue
+                    if all(c in candidates for c in cells):
+                        kept.update(cells)
+                        candidates.difference_update(cells)
+            for coord in free_coords - kept:
+                host = by_coord[coord]
+                host.mesh.used = {}
+                host.mesh.free = {}
+                host.mesh.init_geometry()
+                changed = True
+        return changed
+
+    def _subtract_available(self, remaining: Geometry) -> None:
+        for p in list(remaining):
+            if is_pool_profile(p, self.topo):
+                take = min(remaining[p], self._free_shares(p))
+            else:
+                take = sum(
+                    h.mesh.free_count(p)
+                    for h in self.hosts
+                    if not self._holds_pool_share(h)
+                )
+                take = min(remaining[p], take)
+            if take:
+                remaining[p] -= take
+                if remaining[p] == 0:
+                    del remaining[p]
+
+    def _find_free_block(self, profile: str) -> list[PoolHost] | None:
+        """First (row-major) contiguous block of reassignable hosts that
+        realizes `profile`. A host is reassignable when nothing on it is
+        used — free slices (including a free pool share from a previous
+        layout) may be re-tiled away."""
+        by_coord = {h.coord: h for h in self.hosts}
+        reassignable = {
+            h.coord for h in self.hosts if not h.mesh.used
+        }
+        for _orient, block in block_orientations(profile, self.topo):
+            for anchor in gridlib.all_coords(
+                tuple(g - b + 1 for g, b in zip(self.topo.host_grid, block))
+            ):
+                cells = [
+                    tuple(a + o for a, o in zip(anchor, off))
+                    for off in gridlib.all_coords(block)
+                ]
+                if all(c in reassignable for c in cells):
+                    return [by_coord[c] for c in cells]
+        return None
+
+    # ------------------------------------------------------------------ pods
+
+    def add_pod(self, profiles: Geometry) -> None:
+        """Simulated placement, atomic like `tiling.Node.add_pod`."""
+        if not self.provides_profiles(profiles):
+            raise GenericError(
+                f"pool {self.name}: cannot place "
+                f"{ {p: q for p, q in profiles.items() if q > 0} }"
+            )
+        remaining = {p: q for p, q in profiles.items() if q > 0}
+        for p in list(remaining):
+            if not is_pool_profile(p, self.topo):
+                continue
+            # One share per requested unit (one gang pod each), hosts
+            # with a partially-consumed instance first so a gang fills
+            # one instance before touching the next.
+            shares = remaining.pop(p)
+            takers = sorted(
+                (h for h in self.hosts if h.mesh.free_count(p) > 0),
+                key=lambda h: (
+                    not self._instance_partially_used(h, p),
+                    h.index,
+                ),
+            )[:shares]
+            for h in takers:
+                h.mesh.add_pod(p)
+        for h in self.hosts:
+            if self._holds_pool_share(h):
+                continue
+            for p in list(remaining):
+                take = min(remaining[p], h.mesh.free_count(p))
+                for _ in range(take):
+                    h.mesh.add_pod(p)
+                remaining[p] -= take
+                if remaining[p] == 0:
+                    del remaining[p]
+
+    def clone(self) -> "PoolNode":
+        return PoolNode(
+            self.name,
+            self.topo,
+            [
+                PoolHost(
+                    node_obj=h.node_obj,
+                    name=h.name,
+                    index=h.index,
+                    mesh=h.mesh.clone(),
+                )
+                for h in self.hosts
+            ],
+        )
+
+    # ---------------------------------------------------------------- writes
+
+    def build_partitionings(self) -> list[tuple[dict, "object"]]:
+        """(member node object, its NodePartitioning) per host — the pool
+        plan is N per-host spec writes sharing one plan ID."""
+        from walkai_nos_tpu.partitioning.state import (
+            MeshPartitioning,
+            NodePartitioning,
+        )
+
+        out = []
+        for h in self.hosts:
+            out.append(
+                (
+                    h.node_obj,
+                    NodePartitioning(
+                        name=h.name,
+                        meshes=(
+                            MeshPartitioning.of(0, h.mesh.geometry()),
+                        ),
+                    ),
+                )
+            )
+        return out
+
+
+def group_pool_members(
+    nodes: list[dict],
+) -> tuple[list[dict], dict[str, list[dict]]]:
+    """Split a node list into (single-host nodes, pool-name -> members).
+
+    Multi-host nodes without a coordinatable pool (no pool label) stay
+    OUT of both buckets — the refusal path handles them.
+    """
+    from walkai_nos_tpu.kube import objects as kobjects
+
+    singles: list[dict] = []
+    pools: dict[str, list[dict]] = {}
+    for node_obj in nodes:
+        labels = kobjects.labels(node_obj)
+        if not topology.is_multi_host(labels):
+            singles.append(node_obj)
+            continue
+        key = topology.pool_key(labels)
+        if key is None or topology.get_pool_topology(labels) is None:
+            continue  # refusal path
+        pools.setdefault(key, []).append(node_obj)
+    return singles, pools

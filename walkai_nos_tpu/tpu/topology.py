@@ -97,6 +97,103 @@ def is_multi_host(node_labels: Mapping[str, str]) -> bool:
     return shape_chip_count(shape) > model.chips_per_host
 
 
+@dataclass(frozen=True)
+class PoolTopology:
+    """A multi-host TPU pool: a grid of identical hosts forming one slice.
+
+    `host_mesh` is the per-host chip mesh (axis-aligned with `pool_shape`,
+    left-padded with 1s when the pool has more dimensions); `host_grid` is
+    the pool shape divided by the host mesh per axis — the mesh of WHOLE
+    HOSTS the pool-level planner tiles. Example: a v5p `2x2x2` pool of
+    `2x2x1` hosts has host_grid `(1, 1, 2)` — two hosts along z.
+
+    No reference analogue (one GPU never spans hosts); this is the
+    TPU-native extension of `node_controller.go:56`'s premise that every
+    labeled node is managed.
+    """
+
+    model: TpuModel  # per-host model (KNOWN_MODELS entry)
+    pool_shape: Shape  # full pool topology, e.g. (2, 2, 2)
+    host_mesh: Shape  # per-host mesh aligned to pool dims, e.g. (2, 2, 1)
+    host_grid: Shape  # hosts per axis, e.g. (1, 1, 2)
+
+    @property
+    def num_hosts(self) -> int:
+        return shape_chip_count(self.host_grid)
+
+    @property
+    def chips(self) -> int:
+        return shape_chip_count(self.pool_shape)
+
+    @property
+    def pool_profile(self) -> str:
+        """Canonical profile of the whole pool (dims sorted ascending)."""
+        return format_shape(tuple(sorted(self.pool_shape)))
+
+    def hosts_per_slice(self, profile: str) -> int:
+        """How many whole hosts a pool-level profile spans."""
+        chips = shape_chip_count(parse_shape(profile))
+        return max(1, chips // self.model.chips_per_host)
+
+
+def _align_host_mesh(host_mesh: Shape, pool_shape: Shape) -> Shape | None:
+    """Left-pad the host mesh with 1s to the pool's dimensionality and
+    orient it so every axis divides the pool axis. Tries the identity
+    padding first, then axis permutations (the GKE label axis order for
+    pools does not always match the per-host mesh order)."""
+    import itertools
+
+    if len(host_mesh) > len(pool_shape):
+        return None
+    padded = (1,) * (len(pool_shape) - len(host_mesh)) + tuple(host_mesh)
+    candidates = [padded]
+    candidates.extend(
+        p for p in itertools.permutations(padded) if p != padded
+    )
+    for cand in candidates:
+        if all(p % h == 0 for p, h in zip(pool_shape, cand)):
+            return cand
+    return None
+
+
+def get_pool_topology(node_labels: Mapping[str, str]) -> PoolTopology | None:
+    """Pool topology of a multi-host node, or None when the labels do not
+    describe a partitionable pool (single-host node, unknown model, or a
+    topology the host mesh does not evenly tile — the refusal path)."""
+    if not is_multi_host(node_labels):
+        return None
+    model = KNOWN_MODELS[node_labels[constants.LABEL_TPU_ACCELERATOR]]
+    pool_shape = parse_shape(node_labels[constants.LABEL_TPU_TOPOLOGY])
+    host_mesh = _align_host_mesh(model.host_mesh, pool_shape)
+    if host_mesh is None:
+        return None
+    host_grid = tuple(p // h for p, h in zip(pool_shape, host_mesh))
+    return PoolTopology(
+        model=model,
+        pool_shape=pool_shape,
+        host_mesh=host_mesh,
+        host_grid=host_grid,
+    )
+
+
+def pool_key(node_labels: Mapping[str, str]) -> str | None:
+    """The grouping key tying a pool's member nodes together (the
+    node-pool label); None when absent — an unpoolable multi-host node
+    keeps the refusal path."""
+    return node_labels.get(constants.LABEL_TPU_NODEPOOL) or None
+
+
+def worker_id(node_labels: Mapping[str, str]) -> int | None:
+    """The host's stable position index within its pool."""
+    raw = node_labels.get(constants.LABEL_TPU_WORKER_ID)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
 def pool_model(node_labels: Mapping[str, str]) -> TpuModel | None:
     """The model of a multi-host pool, with the FULL pool topology as its
     mesh — for consumers that must account a never-partitioned pool's

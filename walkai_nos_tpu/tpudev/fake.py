@@ -69,7 +69,21 @@ class FakeTpudevClient(TpudevClient):
                 except ValueError:
                     errors.append(f"{p.slice_id()}: malformed profile")
                     continue
-                if sorted(p.orientation) != profile_dims:
+                profile_chips = topo.shape_chip_count(tuple(profile_dims))
+                if profile_chips > len(self._chips):
+                    # Pool share: this host's slice of a multi-host pool
+                    # profile — must cover the entire host mesh (mirrors
+                    # the native layer's pool-share rule, tpudev.cc).
+                    if (
+                        tuple(p.orientation) != self._mesh
+                        or any(o != 0 for o in p.offset)
+                    ):
+                        errors.append(
+                            f"{p.slice_id()}: pool share must cover the "
+                            f"whole host mesh {self._mesh}"
+                        )
+                        continue
+                elif sorted(p.orientation) != profile_dims:
                     errors.append(
                         f"{p.slice_id()}: orientation {p.orientation} is "
                         f"not a permutation of profile {p.profile}"
