@@ -70,36 +70,78 @@ def test_ring_attention_matches_reference(causal):
 
 
 class TestFlashAttentionGrad:
-    def test_grad_matches_reference_in_interpret_mode(self):
-        """The custom VJP (pallas forward, XLA-reference backward) must
-        produce the reference's exact gradients — pallas kernels are not
-        auto-differentiable, so training correctness rides on this."""
+    """The fused Pallas backward (block-recompute from the saved
+    logsumexp, no S x S materialization) must produce the reference's
+    gradients — pallas kernels are not auto-differentiable, so training
+    correctness rides on this hand-written VJP."""
+
+    @pytest.mark.parametrize(
+        "causal,shape,block_q,block_k",
+        [
+            (True, (1, 2, 32, 16), 8, 8),
+            (False, (1, 2, 32, 16), 8, 8),
+            (True, (2, 3, 64, 32), 16, 8),   # uneven blocks
+            (False, (2, 1, 48, 16), 8, 16),  # block_k > block_q
+            (True, (1, 2, 64, 16), 32, 32),
+        ],
+    )
+    def test_grad_matches_reference_in_interpret_mode(
+        self, causal, shape, block_q, block_k
+    ):
         rng = np.random.default_rng(5)
         q, k, v = (
-            jnp.asarray(
-                rng.standard_normal((1, 2, 32, 16)), jnp.float32
-            )
+            jnp.asarray(rng.standard_normal(shape), jnp.float32)
             for _ in range(3)
         )
+        # A non-symmetric loss so dq/dk/dv all get distinct cotangents.
+        w = jnp.asarray(rng.standard_normal(shape), jnp.float32)
 
         def loss_flash(q, k, v):
             return jnp.sum(
-                attn.flash_attention(
-                    q, k, v, causal=True, block_q=8, block_k=8,
-                    interpret=True,
+                w * attn.flash_attention(
+                    q, k, v, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=True,
                 ) ** 2
             )
 
         def loss_ref(q, k, v):
             return jnp.sum(
-                attn.attention_reference(q, k, v, causal=True) ** 2
+                w * attn.attention_reference(q, k, v, causal=causal) ** 2
             )
 
         grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         grads_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-        for gf, gr in zip(grads_flash, grads_ref):
+        for name, gf, gr in zip("qkv", grads_flash, grads_ref):
             assert jnp.allclose(gf, gr, atol=1e-4), (
-                float(jnp.max(jnp.abs(gf - gr)))
+                name, float(jnp.max(jnp.abs(gf - gr)))
+            )
+
+    def test_grad_causal_cross_length(self):
+        """Cross-attention with sq < sk exercises the bottom-right-
+        aligned diagonal in both backward kernels."""
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.standard_normal((1, 2, 16, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 32, 16)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 32, 16)), jnp.float32)
+
+        def loss(fn):
+            def inner(q, k, v):
+                return jnp.sum(fn(q, k, v) ** 2)
+            return inner
+
+        flash = loss(
+            lambda q, k, v: attn.flash_attention(
+                q, k, v, causal=True, block_q=8, block_k=8, interpret=True
+            )
+        )
+        ref = loss(
+            lambda q, k, v: attn.attention_reference(q, k, v, causal=True)
+        )
+        gf = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert jnp.allclose(a, b, atol=1e-4), (
+                float(jnp.max(jnp.abs(a - b)))
             )
 
 

@@ -24,6 +24,32 @@ logger = logging.getLogger(__name__)
 _NEG_INF = -1e30
 
 
+def _causal_mask(x, fill, q_start, k_start, shape, offset):
+    """Bottom-right-aligned causal mask shared by the forward and both
+    backward kernels — ONE definition of visibility (row q sees keys
+    k <= q + offset, matching the reference's tril(k=sk-sq)), so the
+    forward lse and the backward P-recompute can never drift apart."""
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return jnp.where(q_pos + offset >= k_pos, x, fill)
+
+
+def _last_visible_k_block(q_blk, block_q, offset, block_k, num_k_blocks):
+    """Exclusive upper K-block bound for a causal Q block (max visible
+    k_pos is (q_blk+1)*block_q - 1 + offset)."""
+    return jnp.clip(
+        ((q_blk + 1) * block_q + offset + block_k - 1) // block_k,
+        0,
+        num_k_blocks,
+    )
+
+
+def _first_visible_q_block(k_blk, block_k, offset, block_q, num_q_blocks):
+    """First Q block with any row seeing a causal K block (rows q with
+    q + offset >= k_blk * block_k)."""
+    return jnp.clip((k_blk * block_k - offset) // block_q, 0, num_q_blocks)
+
+
 def attention_reference(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False
 ) -> jax.Array:
@@ -40,10 +66,13 @@ def attention_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  seq_k: int, block_q: int, seq_q: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, seq_k: int, block_q: int, seq_q: int):
     """One (batch*head, q-block) grid cell: scan K/V blocks with online
-    softmax. Refs are [block_q, d] for q/o and [seq_k, d] for k/v."""
+    softmax. Refs are [block_q, d] for q/o and [seq_k, d] for k/v;
+    lse_ref is [1, block_q] — the per-row logsumexp the fused backward
+    needs (saving it costs O(seq); recomputing it would cost another
+    full pass)."""
     q = q_ref[...].astype(jnp.float32)
     scale = q.shape[-1] ** -0.5
     q = q * scale
@@ -62,13 +91,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
         if causal:
-            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (q.shape[0], block_k), 0
+            s = _causal_mask(
+                s, _NEG_INF, q_blk * block_q, i * block_k,
+                (q.shape[0], block_k), offset,
             )
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (q.shape[0], block_k), 1
-            )
-            s = jnp.where(q_pos + offset >= k_pos, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -82,12 +108,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
 
     num_k_blocks = seq_k // block_k
     if causal:
-        # Last K block with any visible key for this Q block: max visible
-        # k_pos is (q_blk+1)*block_q - 1 + offset.
-        last = jnp.clip(
-            ((q_blk + 1) * block_q + offset + block_k - 1) // block_k,
-            0,
-            num_k_blocks,
+        last = _last_visible_k_block(
+            q_blk, block_q, offset, block_k, num_k_blocks
         )
     else:
         last = num_k_blocks
@@ -95,36 +117,207 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     acc0 = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
     m0 = jnp.full((q.shape[0],), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((q.shape[0],), jnp.float32)
-    acc, _m, l = jax.lax.fori_loop(0, last, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(0, last, body, (acc0, m0, l0))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l))[None, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_pallas(q, k, v, causal, block_q, block_k, interpret):
-    """Differentiable wrapper: fused Pallas forward, XLA-reference
-    backward. Pallas kernels aren't auto-differentiable (grad tracing
-    dies in the grid context), and the standard move is a custom VJP —
-    the backward recomputes attention with plain einsums, so it
-    materializes the S x S matrix; training at sequence lengths where
-    that matters belongs on the ring-attention path, which is pure XLA
-    and differentiates natively."""
-    return _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    """Differentiable wrapper: fused Pallas forward AND backward.
+    Pallas kernels aren't auto-differentiable (grad tracing dies in the
+    grid context), so the VJP is hand-written: the standard
+    FlashAttention backward with block-recompute — P is rebuilt per
+    (q-block, k-block) tile from the saved logsumexp, so the S x S
+    matrix never materializes in either pass and backward memory stays
+    O(block), which is what makes long-sequence LM training fit."""
+    out, _lse = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_pallas_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda a, b, c: attention_reference(a, b, c, causal=causal), q, k, v
+    q, k, v, out, lse = residuals
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, *,
+                     block_k: int, causal: bool, seq_k: int, block_q: int,
+                     seq_q: int):
+    """dQ for one (batch*head, q-block) cell: rescan K/V tiles, rebuild
+    P = exp(S - lse) per tile, dS = P*(g V^T - D), dq += dS K * scale.
+    Nothing bigger than [block_q, block_k] lives at once."""
+    q = q_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    lse = lse_ref[0, :]
+    dcap = d_ref[0, :]
+    scale = q.shape[-1] ** -0.5
+    q_blk = pl.program_id(1)
+    offset = seq_k - seq_q
+
+    def body(i, dq):
+        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = _causal_mask(
+                p, 0.0, q_blk * block_q, i * block_k,
+                (q.shape[0], block_k), offset,
+            )
+        dp = jax.lax.dot_general(
+            g, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dcap[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    num_k_blocks = seq_k // block_k
+    if causal:
+        last = _last_visible_k_block(
+            q_blk, block_q, offset, block_k, num_k_blocks
+        )
+    else:
+        last = num_k_blocks
+    dq0 = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, last, body, dq0)
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dk_ref,
+                      dv_ref, *, block_q: int, causal: bool, seq_q: int,
+                      block_k: int, seq_k: int):
+    """dK/dV for one (batch*head, k-block) cell: scan Q tiles, rebuild P
+    per tile, dv += P^T g, dk += dS^T q * scale."""
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    scale = q_ref.shape[-1] ** -0.5
+    k_blk = pl.program_id(1)
+    offset = seq_k - seq_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        g = g_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]
+        dcap = d_ref[0, pl.dslice(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = _causal_mask(
+                p, 0.0, i * block_q, k_blk * block_k,
+                (block_q, k.shape[0]), offset,
+            )
+        dv = dv + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dcap[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    num_q_blocks = seq_q // block_q
+    if causal:
+        first = _first_visible_q_block(
+            k_blk, block_k, offset, block_q, num_q_blocks
+        )
+    else:
+        first = 0
+    dk0 = jnp.zeros((k.shape[0], k.shape[1]), jnp.float32)
+    dv0 = jnp.zeros((v.shape[0], v.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first, num_q_blocks, body, (dk0, dv0))
+    dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
+                    interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    gr = g.reshape(b * h, sq, d)
+    lser = lse.reshape(b * h, 1, sq)
+    # D = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it.
+    dcap = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(b * h, 1, sq)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, block_k=block_k, causal=causal, seq_k=sk,
+            block_q=block_q, seq_q=sq,
+        ),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, i: (bh, 0, i)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, i: (bh, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, dcap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, block_q=block_q, causal=causal, seq_q=sq,
+            block_k=block_k, seq_k=sk,
+        ),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, sq, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, sq), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, sq), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, i: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, dcap)
+
+    return (
+        dq.reshape(b, h, sq, d),
+        dk.reshape(b, h, sk, d),
+        dv.reshape(b, h, sk, d),
+    )
 
 
 @functools.partial(
@@ -164,9 +357,12 @@ def flash_attention(
         # causal with sq > sk would leave rows with zero visible keys
         # (l == 0); the reference defines that edge, so defer to it.
         or (causal and sq > sk)
-        # The kernel stages the whole K/V in VMEM per grid cell (~16 MB
-        # per core); beyond this the ring/chunked paths are the answer.
+        # VMEM staging bounds (~16 MB per core): the forward and dq
+        # kernels stage the whole K/V per grid cell, and the dk/dv
+        # backward kernel symmetrically stages the whole Q/dO — both
+        # sides must fit or the ring/chunked paths are the answer.
         or sk * d * 8 > 8 * 2**20
+        or sq * d * 8 > 8 * 2**20
     ):
         # Not silent: the flagship ViT (seq 296) takes this path — its
         # S^2 matrix is small enough that XLA's fusion is fine, but the
@@ -192,7 +388,7 @@ def _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret):
         _flash_kernel, block_k=block_k, causal=causal, seq_k=sk,
         block_q=block_q, seq_q=sq,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -200,8 +396,14 @@ def _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, i: (bh, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
