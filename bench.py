@@ -19,6 +19,11 @@ Both are measured here through the product, not a bare jit loop:
   runtimes where wall-clock busy time is unmeasurable. Model-FLOPs
   utilization (MFU) over the theoretical bf16 peak is also reported;
   for a memory-bound model the two differ by design.
+- Decode: LM KV-cache generation throughput on the same chip
+  (`bench_lm.measure_decode`), with the chip's HBM roofline (analytic
+  per-step bytes — weights + the full padded KV cache the program
+  reads — over published bandwidth) as the stated baseline;
+  `vs_decode_ceiling` is the fraction attained.
 - Scheduling: runs ~50 slice pods through the REAL controllers (node
   init, retile, actuate, report, advertise, bind) over the sim harness
   and reports p50/p90 create->bind (`walkai_nos_tpu/sim/schedbench.py`).
@@ -44,6 +49,7 @@ import threading
 import time
 
 from walkai_nos_tpu.utils.httpbench import (
+    InferClient,
     get_json,
     kill_server,
     post_infer,
@@ -53,9 +59,11 @@ from walkai_nos_tpu.utils.httpbench import (
 N_STREAMS = 4
 # Outstanding requests each stream keeps in flight (an async client's
 # pipeline depth) — keeps the device fed across completion-fence
-# round-trips on remote runtimes. Measured on v5e through the tunneled
-# runtime: depth 16 -> 92.7% utilization (dispatcher starved 95% of the
-# wall), depth 24 -> 96.0% (starved 14%).
+# round-trips on remote runtimes. Depth 16 left visible device-feed
+# droughts on the tunneled v5e runtime; 24+ keeps `device_starved_pct`
+# (time with zero dispatched-but-unfenced batches — the honest
+# device-drought measure; `dispatcher_idle_pct` is expected to be high
+# under pipelining and is NOT a starvation signal) near zero.
 STREAM_PIPELINE = int(os.environ.get("WALKAI_BENCH_PIPELINE", "24"))
 REQUEST_BATCH = int(os.environ.get("WALKAI_BENCH_REQUEST_BATCH", "32"))
 MAX_BATCH = int(os.environ.get("WALKAI_BENCH_MAX_BATCH", "128"))
@@ -101,34 +109,43 @@ def serving_benchmark() -> dict:
         halt = threading.Event()
 
         def stream() -> None:
-            while not halt.is_set():
-                t0 = time.perf_counter()
-                try:
-                    post_infer(base, REQUEST_BATCH)
-                except Exception:
+            client = InferClient(base)
+            try:
+                while not halt.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        client.post_infer(REQUEST_BATCH)
+                    except Exception:
+                        with lock:
+                            errors[0] += 1
+                        time.sleep(0.2)  # back off, keep the stream alive
+                        continue
+                    dt = time.perf_counter() - t0
                     with lock:
-                        errors[0] += 1
-                    time.sleep(0.2)  # back off, keep the stream alive
-                    continue
-                dt = time.perf_counter() - t0
-                with lock:
-                    samples.append((time.monotonic(), dt))
+                        samples.append((time.monotonic(), dt))
+            finally:
+                client.close()
 
         threads = [
             threading.Thread(target=stream, daemon=True)
             for _ in range(N_STREAMS * STREAM_PIPELINE)
         ]
-        for t in threads:
-            t.start()
-        time.sleep(WARMUP_SECONDS)
-        stats0 = get_json(f"{base}/stats")
-        measure_start = time.monotonic()
-        time.sleep(MEASURE_SECONDS)
-        stats1 = get_json(f"{base}/stats")
-        measure_end = time.monotonic()
-        halt.set()
-        for t in threads:
-            t.join(timeout=160.0)
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(WARMUP_SECONDS)
+            stats0 = get_json(f"{base}/stats")
+            measure_start = time.monotonic()
+            time.sleep(MEASURE_SECONDS)
+            stats1 = get_json(f"{base}/stats")
+            measure_end = time.monotonic()
+        finally:
+            # Always stop the streams: leaked threads would spin
+            # connect-refused against a dead server for the rest of the
+            # process, contaminating the decode phase that runs next.
+            halt.set()
+            for t in threads:
+                t.join(timeout=160.0)
 
         # Separate UN-pipelined latency probe, comparable to the
         # reference's sequential per-pod client (one outstanding batch=1
@@ -139,6 +156,12 @@ def serving_benchmark() -> dict:
         probe_halt = threading.Event()
 
         def probe_stream() -> None:
+            # Fresh connection per request, like the reference's
+            # sequential client. NOT an oversight: a zero-turnaround
+            # keep-alive probe phase-aligns each request to just miss
+            # the in-flight fence window and reads ~2 fence RTTs; the
+            # per-request turnaround of a realistic sequential client
+            # (conn setup + think time) lands near fence completion.
             while not probe_halt.is_set():
                 t0 = time.perf_counter()
                 try:
@@ -198,20 +221,35 @@ def serving_benchmark() -> dict:
         if probe_mean > 0
         else None,
         "client_errors": errors[0],
-        # Gap diagnostics: fraction of dispatched images that were padding,
-        # and dispatcher starvation per measured second.
+        # Gap diagnostics: fraction of dispatched images that were
+        # padding; time the DEVICE had nothing queued (the real drought
+        # signal); and time the dispatcher thread idled waiting for a
+        # first request — large under deep pipelining BY DESIGN (the
+        # device holds a queue of in-flight batches), so only
+        # device_starved_pct indicates a feed problem.
         "padding_pct": round(
             100.0
             * (stats1["padded_images"] - stats0["padded_images"])
             / max(1, images + stats1["padded_images"] - stats0["padded_images"]),
             2,
         ),
-        "worker_starved_pct": round(
+        "device_starved_pct": round(
             100.0
-            * (stats1["worker_starved_s"] - stats0["worker_starved_s"])
+            * (stats1["device_starved_s"] - stats0["device_starved_s"])
             / max(1e-9, wall),
             2,
         ),
+        "dispatcher_idle_pct": round(
+            100.0
+            * (stats1["dispatcher_idle_s"] - stats0["dispatcher_idle_s"])
+            / max(1e-9, wall),
+            2,
+        ),
+        # Roofline: which wall bounds the served model on this chip —
+        # quantifies how much of the peak-MFU gap is physics (memory
+        # bound) vs occupancy/shape slack (compute bound).
+        "bytes_per_image": stats1.get("bytes_per_image"),
+        "roofline": stats1.get("roofline"),
         "request_batch": REQUEST_BATCH,
         "device_kind": stats1.get("device_kind"),
         "streams": N_STREAMS,
@@ -239,14 +277,27 @@ def scheduling_benchmark() -> dict:
     }
 
 
+def decode_benchmark() -> dict:
+    """LM KV-cache decode on the same chip, with its HBM-roofline
+    ceiling as the stated baseline (`bench_lm.measure_decode`). Runs
+    after the serving phase so the two never contend for the device."""
+    from bench_lm import measure_decode
+
+    return measure_decode()
+
+
 def main() -> None:
     result: dict = {}
     err = None
     try:
         result.update(serving_benchmark())
-    except Exception as e:  # still emit the line (and the sched phase)
+    except Exception as e:  # still emit the line (and the other phases)
         err = f"serving: {e}"
         result.setdefault("utilization_pct", 0.0)
+    try:
+        result.update(decode_benchmark())
+    except Exception as e:
+        err = (err + "; " if err else "") + f"decode: {e}"
     try:
         result.update(scheduling_benchmark())
     except Exception as e:

@@ -3,8 +3,16 @@
 Measures autoregressive generation (`models/decode.py`) for the
 decoder LM: one jitted program (prefill + lax.scan over steps) with a
 single fenced output, so the number reflects the chip, not dispatch
-plumbing. NOT the headline benchmark — `bench.py` owns the north-star
-serving/scheduling metrics the driver records.
+plumbing. `bench.py` folds `measure_decode()` into the headline JSON
+(the driver-recorded artifact); this entry point prints it standalone.
+
+The stated baseline is the chip's own memory roofline: decode is
+bandwidth-bound (every step re-reads the weights and the KV cache), so
+the ceiling is an ANALYTIC per-step byte count (weights + the full
+padded KV cache this implementation's dense masked attention reads —
+XLA cost analysis is unusable here: it counts a lax.scan body once, not
+times its length) over published HBM bandwidth; `vs_decode_ceiling` is
+the fraction attained.
 
 Training throughput is intentionally not measured here: on the
 tunneled dev runtime each output buffer crossing a dispatch boundary
@@ -22,7 +30,6 @@ from __future__ import annotations
 import json
 import time
 
-import jax
 import numpy as np
 
 
@@ -30,21 +37,27 @@ def _fence(x) -> None:
     """True completion: fetch one scalar (block_until_ready is not a
     completion guarantee on remote/tunneled backends — same idiom as the
     demo server's _fence)."""
+    import jax
+
     np.asarray(jax.numpy.ravel(x)[0])
 
 
-def main() -> None:
+def measure_decode(
+    *, batch: int = 8, prompt_len: int = 32, new_tokens: int = 128,
+) -> dict:
+    """Decode throughput + its HBM roofline ceiling, as a flat dict."""
+    import jax
     import jax.numpy as jnp
 
     from walkai_nos_tpu.models.decode import make_generate_fn
-    from walkai_nos_tpu.models.lm import LMConfig, DecoderLM
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.utils.flops import hbm_bytes_per_s
 
     device = jax.devices()[0]
     cfg = LMConfig(
         vocab_size=32000, hidden_dim=512, num_layers=8, num_heads=8,
         max_seq_len=1024, dtype="bfloat16",
     )
-    batch, prompt_len, new_tokens = 8, 32, 128
     model = DecoderLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     n_params = sum(
@@ -55,6 +68,33 @@ def main() -> None:
     gen = make_generate_fn(cfg)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt_len)))
+
+    # Roofline ceiling, analytic: every decode step re-reads the full
+    # weights from HBM plus the KV cache. XLA cost analysis is NOT
+    # usable here — it counts a lax.scan body once, not times its
+    # length, so it underestimates decode traffic by ~the step count.
+    # The cache term uses max_seq_len, not the valid prefix: this
+    # implementation's decode attends densely over the whole padded
+    # cache every step (models/lm.py, masked beyond the position), so
+    # that IS this program's traffic — the ceiling bounds the program
+    # actually measured, and the gap to a length-proportional cache is
+    # an implementation headroom (paged/windowed caches), not chip slack.
+    ceiling_tok_s = None
+    bytes_per_step = None
+    param_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+    )
+    kv_dim = cfg.num_heads * (cfg.hidden_dim // cfg.num_heads)
+    cache_dtype_bytes = 2 if "bfloat16" in str(cfg.dtype) else 4
+    kv_bytes = (
+        cfg.num_layers * 2 * batch * cfg.max_seq_len * kv_dim
+        * cache_dtype_bytes
+    )
+    bw = hbm_bytes_per_s(device.device_kind)
+    if bw:
+        bytes_per_step = float(param_bytes + kv_bytes)
+        ceiling_tok_s = batch / (bytes_per_step / bw)
+
     out = gen(params, prompt, max_new_tokens=new_tokens)  # compile
     _fence(out)
     reps = 5
@@ -63,17 +103,33 @@ def main() -> None:
         out = gen(params, prompt, max_new_tokens=new_tokens)
         _fence(out)
     decode_s = (time.perf_counter() - t0) / reps
+    tok_s = batch * new_tokens / decode_s
 
-    print(json.dumps({
-        "metric": "lm_decode_tokens_per_s",
-        "value": round(batch * new_tokens / decode_s, 1),
-        "unit": "tokens/s",
-        "device_kind": device.device_kind,
+    result = {
+        "decode_tokens_per_s": round(tok_s, 1),
         "decode_step_ms": round(decode_s / new_tokens * 1e3, 3),
         "decode_batch": batch,
-        "prompt_len": prompt_len,
-        "new_tokens": new_tokens,
-        "n_params": n_params,
+        "decode_prompt_len": prompt_len,
+        "decode_new_tokens": new_tokens,
+        "decode_n_params": n_params,
+    }
+    if ceiling_tok_s:
+        result["decode_ceiling_tokens_per_s"] = round(ceiling_tok_s, 1)
+        result["decode_hbm_bytes_per_step"] = bytes_per_step
+        result["vs_decode_ceiling"] = round(tok_s / ceiling_tok_s, 4)
+    return result
+
+
+def main() -> None:
+    import jax
+
+    r = measure_decode()
+    print(json.dumps({
+        "metric": "lm_decode_tokens_per_s",
+        "value": r["decode_tokens_per_s"],
+        "unit": "tokens/s",
+        "device_kind": jax.devices()[0].device_kind,
+        **r,
     }))
 
 

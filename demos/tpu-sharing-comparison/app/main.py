@@ -77,12 +77,23 @@ class _Stats:
         self.flops = 0.0
         # Diagnostics for the utilization gap: padded images dispatched
         # (bucket - actual, counted at fence time with the images they
-        # belong to) and time the dispatcher spent starved (blocked
-        # waiting for the FIRST request of a batch — device-feed droughts;
-        # an in-progress wait is included in snapshots).
+        # belong to) and two DISTINCT idleness measures —
+        # `dispatcher_idle_s`: time the dispatcher thread spent waiting
+        #   for the first request of a batch. With deep pipelining this
+        #   can be large while the device stays fully fed (up to
+        #   max_inflight batches are queued on-device), so it is NOT a
+        #   device-starvation signal;
+        # `device_starved_s`: time with ZERO dispatched-but-unfenced
+        #   batches — the device truly had nothing queued. Slightly
+        #   underestimates idleness (a batch counts as in-flight until
+        #   the fencer acks it, after completion), so treat small values
+        #   as "fed", not as an exact busy integral.
         self.padded_images = 0
-        self.worker_starved_s = 0.0
+        self.dispatcher_idle_s = 0.0
         self.worker_waiting_since: float | None = None
+        self.inflight = 0
+        self.device_starved_s = 0.0
+        self.device_idle_since: float | None = time.monotonic()
 
     def record(self, images, requests, padded, flops) -> None:
         with self._lock:
@@ -99,24 +110,44 @@ class _Stats:
     def wait_ended(self) -> None:
         with self._lock:
             if self.worker_waiting_since is not None:
-                self.worker_starved_s += (
+                self.dispatcher_idle_s += (
                     time.monotonic() - self.worker_waiting_since
                 )
                 self.worker_waiting_since = None
 
+    def mark_dispatch(self) -> None:
+        with self._lock:
+            if self.inflight == 0 and self.device_idle_since is not None:
+                self.device_starved_s += (
+                    time.monotonic() - self.device_idle_since
+                )
+                self.device_idle_since = None
+            self.inflight += 1
+
+    def mark_fenced(self, n: int) -> None:
+        with self._lock:
+            self.inflight -= n
+            if self.inflight == 0:
+                self.device_idle_since = time.monotonic()
+
     def snapshot(self) -> dict:
         with self._lock:
-            starved = self.worker_starved_s
+            now = time.monotonic()
+            idle = self.dispatcher_idle_s
             if self.worker_waiting_since is not None:
-                starved += time.monotonic() - self.worker_waiting_since
+                idle += now - self.worker_waiting_since
+            starved = self.device_starved_s
+            if self.inflight == 0 and self.device_idle_since is not None:
+                starved += now - self.device_idle_since
             return {
                 "images": self.images,
                 "requests": self.requests,
                 "batches": self.batches,
                 "flops": self.flops,
                 "padded_images": self.padded_images,
-                "worker_starved_s": starved,
-                "monotonic_s": time.monotonic(),
+                "dispatcher_idle_s": idle,
+                "device_starved_s": starved,
+                "monotonic_s": now,
             }
 
 
@@ -215,16 +246,31 @@ def main() -> None:
             )
         return inputs[batch]
 
-    # Per-image FLOPs: prefer XLA's own cost analysis of the compiled
-    # forward, fall back to the analytic count.
+    # Per-image FLOPs and bytes: prefer XLA's own cost analysis of the
+    # compiled forward AT THE SERVING BATCH (per-image traffic shrinks
+    # with batch as weight reads amortize), fall back to the analytic
+    # FLOP count with no byte estimate. The AOT executable this builds
+    # is REUSED for max_batch dispatches (a jit call would compile the
+    # same most-expensive shape a second time — the AOT cache and the
+    # jit dispatch cache don't share entries).
     flops_per_image = vit_flops_per_image(cfg)
+    bytes_per_image = 0.0
     try:
-        cost = infer.lower(params, images_of(1)).compile().cost_analysis()
+        compiled_max = infer.lower(params, images_of(max_batch)).compile()
+        cost = compiled_max.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         analyzed = float(cost.get("flops", 0.0))
         if analyzed > 0:
-            flops_per_image = analyzed
+            flops_per_image = analyzed / max_batch
+        bytes_per_image = float(cost.get("bytes accessed", 0.0)) / max_batch
+
+        jit_infer = infer
+
+        def infer(params, images, _c=compiled_max, _j=jit_infer):  # noqa: F811
+            if images.shape[0] == max_batch:
+                return _c(params, images)
+            return _j(params, images)
     except Exception:
         pass
 
@@ -319,6 +365,7 @@ def main() -> None:
             inflight.acquire()
             bucket = _bucket(total, max_batch)
             out = infer(params, images_of(bucket))
+            stats.mark_dispatch()
             fence_q.put(_Dispatched(batch_reqs, total, bucket, out))
 
     def fencer() -> None:
@@ -332,6 +379,7 @@ def main() -> None:
                 except queue.Empty:
                     break
             _fence(drained[-1].output)
+            stats.mark_fenced(len(drained))
             now = time.monotonic()
             for d in drained:
                 inflight.release()
@@ -349,6 +397,8 @@ def main() -> None:
     threading.Thread(target=device_worker, daemon=True).start()
     threading.Thread(target=fencer, daemon=True).start()
 
+    from walkai_nos_tpu.utils.flops import roofline
+
     device_info = {
         "device_kind": device.device_kind,
         "device_count": jax.device_count(),
@@ -356,11 +406,25 @@ def main() -> None:
         "model_ceiling_images_per_s": ceiling_img_s,
         "fence_rtt_s": fence_rtt,
         "flops_per_image": flops_per_image,
+        "bytes_per_image": bytes_per_image,
+        # Which wall bounds the served model on this chip: memory
+        # (intensity below the ridge) or compute — in which case any
+        # MFU gap is occupancy/shape-bound, not a bandwidth story.
+        "roofline": roofline(
+            flops_per_image, bytes_per_image, device.device_kind
+        ),
         "max_batch": max_batch,
         "slice": slice_id,
     }
 
     class Handler(BaseHTTPRequestHandler):
+        # Keep-alive: without it every request pays a TCP handshake AND
+        # a fresh server thread (ThreadingHTTPServer threads are
+        # per-connection), which under ~100 concurrent pipelined clients
+        # makes request arrival jitter the measured bottleneck. All
+        # responses carry Content-Length, so 1.1 persistence is safe.
+        protocol_version = "HTTP/1.1"
+
         def do_POST(self):
             if self.path == "/generate":
                 self._generate()

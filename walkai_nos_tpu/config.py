@@ -65,8 +65,8 @@ class PartitionerConfig:
     # batching and reconciles each pod immediately. Defaults are small:
     # the event-driven mapper already coalesces retries, so the window
     # only needs to catch a single submission burst.
-    batch_window_timeout_s: float = 5.0
-    batch_window_idle_s: float = 0.5
+    batch_window_timeout_s: float = 2.0
+    batch_window_idle_s: float = 0.2
 
     def validate(self) -> None:
         if self.device_plugin_delay_s < 0:
@@ -125,9 +125,9 @@ _KIND_LOADERS = {
             ),
             pod_retry_interval_s=float(d.get("podRetryIntervalSeconds", 5.0)),
             batch_window_timeout_s=float(
-                d.get("batchWindowTimeoutSeconds", 5.0)
+                d.get("batchWindowTimeoutSeconds", 2.0)
             ),
-            batch_window_idle_s=float(d.get("batchWindowIdleSeconds", 0.5)),
+            batch_window_idle_s=float(d.get("batchWindowIdleSeconds", 0.2)),
         ),
     ),
     "TpuAgentConfig": (

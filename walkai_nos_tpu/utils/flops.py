@@ -23,6 +23,20 @@ _PEAK_BF16_FLOPS: tuple[tuple[str, float], ...] = (
 )
 
 
+# Published HBM bandwidth (bytes/s) per chip, same matching rules.
+_HBM_BYTES_PER_S: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 1640e9),  # v6e (Trillium)
+    ("v6e", 1640e9),
+    ("v5 lite", 819e9),  # v5e
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
 def peak_bf16_flops(device_kind: str) -> float | None:
     """Peak dense bf16 FLOP/s for a jax `device_kind` string, else None."""
     kind = device_kind.lower()
@@ -30,6 +44,45 @@ def peak_bf16_flops(device_kind: str) -> float | None:
         if marker in kind:
             return peak
     return None
+
+
+def hbm_bytes_per_s(device_kind: str) -> float | None:
+    """Published HBM bandwidth for a jax `device_kind` string, else None."""
+    kind = device_kind.lower()
+    for marker, bw in _HBM_BYTES_PER_S:
+        if marker in kind:
+            return bw
+    return None
+
+
+def roofline(
+    flops_per_item: float,
+    bytes_per_item: float,
+    device_kind: str,
+) -> dict | None:
+    """Roofline characterization of one model pass on one chip.
+
+    arithmetic_intensity (FLOPs/byte) against the chip's ridge point
+    (peak / HBM bandwidth) says WHICH wall bounds the pass:
+    below the ridge the attainable rate is bandwidth * intensity
+    (memory-bound); above it, the bf16 peak (compute-bound — any
+    remaining MFU gap is then occupancy/shape-bound, not a memory wall).
+    Returns None when the device kind or byte count is unknown.
+    """
+    peak = peak_bf16_flops(device_kind)
+    bw = hbm_bytes_per_s(device_kind)
+    if peak is None or bw is None or bytes_per_item <= 0:
+        return None
+    intensity = flops_per_item / bytes_per_item
+    ridge = peak / bw
+    attainable = min(peak, bw * intensity)
+    return {
+        "arithmetic_intensity_flops_per_byte": round(intensity, 2),
+        "ridge_flops_per_byte": round(ridge, 2),
+        "bound": "memory" if intensity < ridge else "compute",
+        "attainable_flops_per_s": attainable,
+        "roofline_mfu_ceiling_pct": round(100.0 * attainable / peak, 2),
+    }
 
 
 def vit_flops_per_image(cfg) -> float:

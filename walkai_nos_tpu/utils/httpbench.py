@@ -43,6 +43,56 @@ def post_infer(base: str, batch: int, timeout: float = 150.0) -> dict:
         return json.loads(resp.read())
 
 
+class InferClient:
+    """A persistent-connection client for one bench stream thread.
+
+    urllib opens a new TCP connection per request; at ~100 concurrent
+    pipelined streams the handshake + per-connection server thread
+    churn becomes the bottleneck being measured. One keep-alive
+    connection per stream matches how a real async client drives a
+    server. Not thread-safe — one instance per thread."""
+
+    def __init__(self, base: str, timeout: float = 150.0) -> None:
+        import http.client
+        from urllib.parse import urlparse
+
+        self._netloc = urlparse(base).netloc
+        self._timeout = timeout
+        self._http = http.client
+        self._conn = None
+
+    def post_infer(self, batch: int) -> dict:
+        body = json.dumps({"batch": batch})
+        headers = {"Content-Type": "application/json"}
+        if self._conn is None:
+            self._conn = self._http.HTTPConnection(
+                self._netloc, timeout=self._timeout
+            )
+        try:
+            self._conn.request("POST", "/infer", body, headers)
+            resp = self._conn.getresponse()
+            data = resp.read()
+        except Exception:
+            # Dead keep-alive (server restart, timeout): drop and let
+            # the caller retry on a fresh connection.
+            self.close()
+            raise
+        if resp.status != 200:
+            # Error responses (send_error) close the server side; keep
+            # the client symmetric so the next request reconnects
+            # instead of failing once more on a dead socket.
+            self.close()
+            raise RuntimeError(f"/infer -> {resp.status}")
+        return json.loads(data)
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+
 def spawn_server(
     env_overrides: dict[str, str],
     startup_timeout_s: float,
