@@ -1,0 +1,105 @@
+"""Dependency-free docs-site structural check (mkdocs --strict analogue).
+
+The reference builds its docs with mkdocs (`docs/mkdocs.yaml`); this repo
+mirrors that config, and CI runs the real `mkdocs build --strict` when
+mkdocs is installed. This checker is the always-available half — stdlib
+only, run by CI and `tests/test_manifests.py` — so links rot loudly even
+where mkdocs cannot be installed:
+
+1. every nav entry in mkdocs.yaml points at an existing file;
+2. every markdown file under docs/ is reachable from the nav or the
+   docs index (no orphan pages);
+3. every relative markdown link in every docs page resolves to a file.
+
+Exit code 0 = clean; prints each violation otherwise.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+import yaml
+
+DOCS = Path(__file__).resolve().parent.parent / "docs"
+
+# In-page http(s)/mail/anchor links are out of scope; relative .md links
+# (optionally with an #anchor) must resolve.
+_LINK_RE = re.compile(r"\[[^\]]*\]\(([^)\s]+)\)")
+
+
+def nav_files(nav) -> list[str]:
+    out: list[str] = []
+    for entry in nav:
+        if isinstance(entry, str):
+            out.append(entry)
+        elif isinstance(entry, dict):
+            for value in entry.values():
+                if isinstance(value, str):
+                    out.append(value)
+                else:
+                    out.extend(nav_files(value))
+    return out
+
+
+def main() -> int:
+    errors: list[str] = []
+    config = yaml.safe_load(
+        (DOCS.parent / "mkdocs.yaml").read_text()
+    )
+    nav = nav_files(config.get("nav") or [])
+
+    # 1. Nav entries exist.
+    for rel in nav:
+        if not (DOCS / rel).is_file():
+            errors.append(f"nav entry missing: docs/{rel}")
+
+    # 2. No orphan pages: every docs/*.md is in nav or linked from the
+    # docs index (README.md, the repo-browsing entry point).
+    reachable = {str(Path(rel)) for rel in nav}
+    index = DOCS / "README.md"
+    if index.is_file():
+        reachable.add("README.md")
+        for link in _LINK_RE.findall(index.read_text()):
+            target = link.split("#", 1)[0]
+            if target.endswith(".md"):
+                reachable.add(str(Path(target)))
+    for page in sorted(DOCS.rglob("*.md")):
+        rel = str(page.relative_to(DOCS))
+        if rel not in reachable:
+            errors.append(
+                f"orphan page (not in mkdocs nav or docs/README.md): "
+                f"docs/{rel}"
+            )
+
+    # 3. Relative markdown links resolve.
+    for page in sorted(DOCS.rglob("*.md")):
+        for link in _LINK_RE.findall(page.read_text()):
+            target = link.split("#", 1)[0]
+            if (
+                not target
+                or "://" in target
+                or target.startswith("mailto:")
+            ):
+                continue
+            if not target.endswith((".md", ".yaml", ".yml", ".py", ".sh")):
+                continue
+            resolved = (page.parent / target).resolve()
+            if not resolved.exists():
+                errors.append(
+                    f"broken link in docs/{page.relative_to(DOCS)}: "
+                    f"{link}"
+                )
+
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"{len(errors)} docs problem(s)")
+        return 1
+    print(f"docs OK: {len(nav)} nav pages, no orphans, links resolve")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

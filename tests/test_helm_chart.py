@@ -172,3 +172,53 @@ class TestComponentTemplates:
             "elasticquotas.nos.walkai.io",
             "compositeelasticquotas.nos.walkai.io",
         }
+
+
+class TestValuesSweepKnobs:
+    """Knobs adopted in the reference values sweep (VALUES_SWEEP.md)."""
+
+    def test_pull_secrets_rendered_in_every_pod_spec(self):
+        values = _values()
+        assert values["imagePullSecrets"] == []
+        pod_templates = [
+            "partitioner.yaml",
+            "daemonset_agent.yaml",
+            "daemonset_sharing-agent.yaml",
+            "deployment_scheduler.yaml",
+            "deployment_clusterinfoexporter.yaml",
+            "pod_metrics-exporter.yaml",
+        ]
+        for name in pod_templates:
+            text = (CHART / "templates" / name).read_text()
+            assert ".Values.imagePullSecrets" in text, name
+
+    def test_service_account_annotations_per_component(self):
+        values = _values()
+        rbac = (CHART / "templates" / "rbac.yaml").read_text()
+        for comp in COMPONENTS:
+            assert values[comp]["serviceAccountAnnotations"] == {}, comp
+            assert f".Values.{comp}.serviceAccountAnnotations" in rbac, comp
+
+    def test_agent_runtime_class_knob(self):
+        values = _values()
+        for comp, tpl in (
+            ("agent", "daemonset_agent.yaml"),
+            ("sharingAgent", "daemonset_sharing-agent.yaml"),
+        ):
+            assert values[comp]["runtimeClassName"] == ""
+            text = (CHART / "templates" / tpl).read_text()
+            assert f".Values.{comp}.runtimeClassName" in text
+
+    def test_scheduler_extra_args(self):
+        assert _values()["scheduler"]["extraArgs"] == []
+        text = (CHART / "templates" / "deployment_scheduler.yaml").read_text()
+        assert ".Values.scheduler.extraArgs" in text
+
+    def test_fullname_override(self):
+        assert _values()["fullnameOverride"] == ""
+        helpers = (CHART / "templates" / "_helpers.tpl").read_text()
+        assert ".Values.fullnameOverride" in helpers
+
+    def test_sweep_log_exists_and_linked(self):
+        assert (CHART / "VALUES_SWEEP.md").is_file()
+        assert "VALUES_SWEEP.md" in (CHART / "README.md").read_text()

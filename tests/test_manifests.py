@@ -120,3 +120,20 @@ def test_prometheus_monitors_target_real_apps():
                 all(labels.get(k) == v for k, v in want.items())
                 for labels in service_labels
             ), want
+
+
+def test_docs_site_structure():
+    """The docs tree is a buildable site: nav complete, no orphan
+    pages, relative links resolve (hack/check_docs.py — the stdlib half
+    of CI's `mkdocs build --strict`)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    r = subprocess.run(
+        [sys.executable, str(repo / "hack" / "check_docs.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
