@@ -76,6 +76,57 @@ class TestE2EOverApiServer:
 
             eventually(status_used, timeout=30.0, msg="status 2x2 used")
 
+    def test_multi_host_pool_gang_over_http(self, api):
+        """Pool lifecycle over the REAL wire path: a 2-host v5p pool
+        initializes to the whole-pool share under one coordinated plan,
+        each member's agent actuates its share, and a 2-pod gang binds
+        one pod per host via pods/binding."""
+        kube = RestKubeClient(server=api)
+        sim = SimCluster(report_interval=0.1, kube=kube)
+        sim.add_pool("pool-w", n_hosts=2)
+        with sim:
+            def shares_reported():
+                for i in range(2):
+                    node = kube.get("Node", f"pool-w-{i}")
+                    status, spec = parse_node_annotations(
+                        objects.annotations(node)
+                    )
+                    if not any(
+                        s.profile == "2x2x2" and s.quantity == 1
+                        for s in spec
+                    ):
+                        return False
+                    if not any(
+                        s.profile == "2x2x2"
+                        and s.status == DeviceStatus.FREE
+                        for s in status
+                    ):
+                        return False
+                return True
+
+            eventually(
+                shares_reported, timeout=30.0,
+                msg="pool members init + report free shares over HTTP",
+            )
+
+            sim.create_slice_pod("gang-0", "2x2x2")
+            sim.create_slice_pod("gang-1", "2x2x2")
+
+            def gang_bound():
+                hosts = set()
+                for name in ("gang-0", "gang-1"):
+                    pod = kube.get("Pod", name, "default")
+                    node = (pod.get("spec") or {}).get("nodeName")
+                    if not node:
+                        return False
+                    hosts.add(node)
+                return hosts == {"pool-w-0", "pool-w-1"}
+
+            eventually(
+                gang_bound, timeout=30.0,
+                msg="gang binds one pod per member host",
+            )
+
     def test_second_pod_lands_on_remaining_capacity(self, api):
         kube = RestKubeClient(server=api)
         sim = SimCluster(report_interval=0.1, kube=kube)

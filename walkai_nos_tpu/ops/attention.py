@@ -150,6 +150,36 @@ def _flash_pallas_bwd(causal, block_q, block_k, interpret, residuals, g):
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal, block_q, block_k, interpret):
+    """Fused attention that ALSO returns the per-row logsumexp —
+    the building block for composing flash with outer online-softmax
+    accumulators (ring attention merges per-shard partial results by
+    lse weighting). Differentiable in both outputs: the lse cotangent
+    folds into the backward kernels as D' = D - g_lse.
+
+    Callers are responsible for shape/tiling checks (`flash_attention`
+    does them for the public path)."""
+    return _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_with_lse_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    g_out, g_lse = g
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g_out, causal, block_q, block_k, interpret,
+        g_lse=g_lse,
+    )
+
+
+flash_attention_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
 def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, *,
                      block_k: int, causal: bool, seq_k: int, block_q: int,
                      seq_q: int):
@@ -256,7 +286,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dk_ref,
 
 
 def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
-                    interpret):
+                    interpret, g_lse=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     qr = q.reshape(b * h, sq, d)
@@ -264,10 +294,16 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     vr = v.reshape(b * h, sk, d)
     gr = g.reshape(b * h, sq, d)
     lser = lse.reshape(b * h, 1, sq)
-    # D = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it.
+    # D = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it. An lse
+    # cotangent (flash_attention_with_lse) folds in for free: d lse/dS
+    # is the softmax P, so dS = P*(dP - D + g_lse) — i.e. the kernels
+    # just see D' = D - g_lse.
     dcap = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    ).reshape(b * h, 1, sq)
+    )
+    if g_lse is not None:
+        dcap = dcap - g_lse.astype(jnp.float32)
+    dcap = dcap.reshape(b * h, 1, sq)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -320,6 +356,32 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     )
 
 
+def flash_tiles(
+    sq: int, sk: int, d: int, block_q: int, block_k: int, causal: bool
+) -> bool:
+    """Whether the fused kernels can serve this shape — the ONE dispatch
+    predicate (`flash_attention`'s fallback gate and the ring's
+    per-shard check both use it, so the two paths cannot drift).
+    Callers clamp blocks to the sequence first (min(block, seq))."""
+    return not (
+        sq % block_q
+        or sk % block_k
+        # Clamped blocks must still satisfy the f32 sublane multiple (8).
+        or block_q % 8
+        or block_k % 8
+        or (causal and block_q % block_k)
+        # causal with sq > sk would leave rows with zero visible keys
+        # (l == 0); the reference defines that edge, so defer to it.
+        or (causal and sq > sk)
+        # VMEM staging bounds (~16 MB per core): the forward and dq
+        # kernels stage the whole K/V per grid cell, and the dk/dv
+        # backward kernel symmetrically stages the whole Q/dO — both
+        # sides must fit or the ring/chunked paths are the answer.
+        or sk * d * 8 > 8 * 2**20
+        or sq * d * 8 > 8 * 2**20
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
 )
@@ -347,23 +409,7 @@ def flash_attention(
     sk = k.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if (
-        sq % block_q
-        or sk % block_k
-        # Clamped blocks must still satisfy the f32 sublane multiple (8).
-        or block_q % 8
-        or block_k % 8
-        or (causal and block_q % block_k)
-        # causal with sq > sk would leave rows with zero visible keys
-        # (l == 0); the reference defines that edge, so defer to it.
-        or (causal and sq > sk)
-        # VMEM staging bounds (~16 MB per core): the forward and dq
-        # kernels stage the whole K/V per grid cell, and the dk/dv
-        # backward kernel symmetrically stages the whole Q/dO — both
-        # sides must fit or the ring/chunked paths are the answer.
-        or sk * d * 8 > 8 * 2**20
-        or sq * d * 8 > 8 * 2**20
-    ):
+    if not flash_tiles(sq, sk, d, block_q, block_k, causal):
         # Not silent: the flagship ViT (seq 296) takes this path — its
         # S^2 matrix is small enough that XLA's fusion is fine, but the
         # dispatch decision should be observable.

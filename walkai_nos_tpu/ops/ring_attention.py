@@ -21,6 +21,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from walkai_nos_tpu.ops.attention import (
+    flash_attention_with_lse,
+    flash_tiles,
+)
 from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
 
 _NEG_INF = -1e30
@@ -108,6 +112,93 @@ def _ring_body(i, carry, *, axis_name, axis_size, q, causal, q_off, sk,
     return acc, m_new, l_new, k_nxt, v_nxt, src_nxt
 
 
+def _ring_body_flash(i, carry, *, axis_name, axis_size, q, causal, q_off,
+                     block_q, block_k, interpret):
+    """Flash-kernel ring step: each incoming K/V shard is attended with
+    the fused Pallas kernel (nothing bigger than [block_q, block_k]
+    materializes on-chip) and merged into the running output by
+    logsumexp weighting — FlashAttention memory behavior at BOTH levels
+    (the einsum body materializes the [sq_local, sk_local] score block,
+    which at long context is (S/N)^2 per device).
+
+    Equal self-attention shards mean a ring step is exactly one of:
+    fully past (un-masked), the diagonal (standard causal), or fully
+    future (skipped) — so the per-step kernel only ever needs the
+    aligned causal mode it already supports.
+    """
+    out_run, lse_run, k_cur, v_cur, src_idx = carry
+    sq = q.shape[2]
+    k_off = src_idx * sq
+
+    def merge(operands, is_causal):
+        out_run, lse_run = operands
+        out_i, lse_i = flash_attention_with_lse(
+            q, k_cur, v_cur, is_causal, block_q, block_k, interpret
+        )
+        lse_new = jnp.logaddexp(lse_run, lse_i)
+        w_run = jnp.exp(lse_run - lse_new)[..., None]
+        w_i = jnp.exp(lse_i - lse_new)[..., None]
+        return out_run * w_run + out_i.astype(jnp.float32) * w_i, lse_new
+
+    if causal:
+        # branch 0: fully past -> plain; 1: diagonal -> causal; 2: fully
+        # future -> skip. Shards are equal, so k_off vs q_off decides.
+        branch = jnp.where(
+            k_off < q_off, 0, jnp.where(k_off == q_off, 1, 2)
+        )
+        out_run, lse_run = jax.lax.switch(
+            branch,
+            [
+                lambda ops: merge(ops, False),
+                lambda ops: merge(ops, True),
+                lambda ops: ops,
+            ],
+            (out_run, lse_run),
+        )
+    else:
+        out_run, lse_run = merge((out_run, lse_run), False)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+    v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+    src_nxt = jax.lax.ppermute(src_idx, axis_name, perm)
+    return out_run, lse_run, k_nxt, v_nxt, src_nxt
+
+
+def _ring_attn_local_flash(q, k, v, *, axis_name, causal, block_q, block_k,
+                           interpret):
+    """Per-device body using the fused kernel per ring step."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    sq = q.shape[2]
+    q_off = my_idx * sq
+
+    b, h, _, _ = q.shape
+    d_v = v.shape[-1]
+    out0 = jnp.zeros((b, h, sq, d_v), jnp.float32)
+    lse0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+
+    body = functools.partial(
+        _ring_body_flash, axis_name=axis_name, axis_size=axis_size, q=q,
+        causal=causal, q_off=q_off, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    out, _lse, _k, _v, _s = jax.lax.fori_loop(
+        0, axis_size, body, (out0, lse0, k, v, my_idx)
+    )
+    return out.astype(q.dtype)
+
+
+def _flash_shards_tile(sq: int, sk: int, d: int, block_q: int,
+                       block_k: int) -> bool:
+    """`flash_tiles` per local ring shard. Equal shards (sq == sk) are
+    required for the three-way past/diagonal/future step split, and the
+    diagonal step runs the kernel in causal mode, so the causal block
+    constraint applies."""
+    return sq == sk and flash_tiles(
+        sq, sk, d, min(block_q, sq), min(block_k, sk), causal=True
+    )
+
+
 def _ring_attn_local(q, k, v, *, axis_name, causal):
     """Per-device body under shard_map: q/k/v are the local sequence shards."""
     axis_size = jax.lax.psum(1, axis_name)
@@ -142,6 +233,10 @@ def ring_attention(
     causal: bool = False,
     axis_name: str = AXIS_SEQ,
     batch_axes: tuple[str, ...] | None = None,
+    use_flash: bool | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `axis_name` ring.
 
@@ -151,13 +246,45 @@ def ring_attention(
     replicated here would force an all-gather of the full batch onto every
     device on entry, defeating data parallelism). Returns output with the
     same sharding as Q.
+
+    `use_flash` runs each ring step through the fused Pallas kernel
+    (`flash_attention_with_lse`) instead of the einsum body, so the
+    per-device (S/N)^2 score block never materializes either — flash
+    memory behavior at both the inter- and intra-chip level. Default
+    (None) auto-enables on TPU when the local shards tile the kernel's
+    block constraints; True forces it (e.g. with `interpret` for CPU
+    tests), False forces the einsum body.
     """
     if batch_axes is None:
         batch_axes = infer_batch_axes(mesh, axis_name, q.shape[0])
     batch_dim = batch_axes if batch_axes else None
     spec = P(batch_dim, None, axis_name, None)
+
+    n_shards = mesh.shape[axis_name]
+    sq_local = q.shape[2] // max(1, n_shards)
+    sk_local = k.shape[2] // max(1, n_shards)
+    bq = min(block_q, sq_local)
+    bk = min(block_k, sk_local)
+    tiles = _flash_shards_tile(sq_local, sk_local, q.shape[3], bq, bk)
+    if use_flash is None:
+        use_flash = tiles and jax.default_backend() == "tpu"
+    elif use_flash and not tiles:
+        raise ValueError(
+            f"ring local shards (sq={sq_local}, sk={sk_local}, "
+            f"d={q.shape[3]}) do not tile the flash kernel blocks "
+            f"({bq}, {bk}); use the einsum body (use_flash=False)"
+        )
+    if use_flash:
+        local = functools.partial(
+            _ring_attn_local_flash, axis_name=axis_name, causal=causal,
+            block_q=bq, block_k=bk, interpret=interpret,
+        )
+    else:
+        local = functools.partial(
+            _ring_attn_local, axis_name=axis_name, causal=causal
+        )
     fn = shard_map(
-        functools.partial(_ring_attn_local, axis_name=axis_name, causal=causal),
+        local,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
