@@ -73,12 +73,13 @@ def measure_decode(
     # weights from HBM plus the KV cache. XLA cost analysis is NOT
     # usable here — it counts a lax.scan body once, not times its
     # length, so it underestimates decode traffic by ~the step count.
-    # The cache term uses max_seq_len, not the valid prefix: this
-    # implementation's decode attends densely over the whole padded
-    # cache every step (models/lm.py, masked beyond the position), so
-    # that IS this program's traffic — the ceiling bounds the program
-    # actually measured, and the gap to a length-proportional cache is
-    # an implementation headroom (paged/windowed caches), not chip slack.
+    # The cache term uses the LENGTH-BUCKETED cache the generate fn
+    # actually allocates (`decode.cache_bucket` — dense masked
+    # attention reads the whole padded cache every step, so that IS the
+    # program's traffic; bucketing the cache to the generation is what
+    # keeps it proportional instead of the model's full context).
+    from walkai_nos_tpu.models.decode import cache_bucket
+
     ceiling_tok_s = None
     bytes_per_step = None
     param_bytes = sum(
@@ -86,8 +87,9 @@ def measure_decode(
     )
     kv_dim = cfg.num_heads * (cfg.hidden_dim // cfg.num_heads)
     cache_dtype_bytes = 2 if "bfloat16" in str(cfg.dtype) else 4
+    cache_len = cache_bucket(prompt_len + new_tokens, cfg.max_seq_len)
     kv_bytes = (
-        cfg.num_layers * 2 * batch * cfg.max_seq_len * kv_dim
+        cfg.num_layers * 2 * batch * cache_len * kv_dim
         * cache_dtype_bytes
     )
     bw = hbm_bytes_per_s(device.device_kind)

@@ -43,6 +43,33 @@ class TestGreedyDecode:
         out = generate(params, _prompt(), max_new_tokens=1)
         assert out.shape == (2, 1)
 
+    def test_bucketed_cache_matches_full_forward(self):
+        """A short generation under a LONG context must still match the
+        uncached forward exactly: the length-bucketed cache (128 wide
+        here, not the model's 512) is an optimization, never a semantic
+        change — and the full-context pos_embed params are used as-is."""
+        from walkai_nos_tpu.models.decode import cache_bucket
+
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+            max_seq_len=512,
+        )
+        assert cache_bucket(4 + 6, cfg.max_seq_len) == 128  # < 512
+        model = DecoderLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        generate = make_generate_fn(cfg)
+        rng = np.random.default_rng(1)
+        prompt = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32
+        )
+        out = generate(params, prompt, max_new_tokens=6)
+        seq = prompt
+        for t in range(6):
+            logits = model.apply({"params": params}, seq)
+            expect = jnp.argmax(logits[:, -1], axis=-1)
+            assert jnp.array_equal(expect, out[:, t]), t
+            seq = jnp.concatenate([seq, out[:, t : t + 1]], axis=1)
+
     def test_moe_model_decodes(self):
         """Decoding composes with MoE blocks (routing is per-token)."""
         cfg = LMConfig(

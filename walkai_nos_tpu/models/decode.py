@@ -11,6 +11,7 @@ No reference analogue — serving-side companion of `models/lm.py`.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -18,6 +19,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+
+
+def cache_bucket(total_len: int, max_seq_len: int) -> int:
+    """KV-cache length for a generation of `total_len` tokens: rounded
+    up to a 128 multiple (MXU lane width), capped at the model's
+    context. Decode attends densely over the whole cache every step, so
+    sizing it to the generation — not the model's full context — cuts
+    per-step HBM traffic proportionally (a 160-token generation under a
+    2048 context reads 13x less cache)."""
+    return min(max_seq_len, ((total_len + 127) // 128) * 128)
 
 
 def _sample(
@@ -81,7 +92,9 @@ def make_generate_fn(
     `prompt` is [batch, prompt_len] int32; the result is
     [batch, max_new_tokens] (prompt not repeated). `max_new_tokens` is a
     static argument of the returned function. Requires
-    prompt_len + max_new_tokens <= cfg.max_seq_len (the cache size).
+    prompt_len + max_new_tokens <= cfg.max_seq_len (the position-table
+    limit; the KV cache itself is sized to the generation via
+    `cache_bucket`, not to max_seq_len).
     Sampling: greedy at temperature 0, else temperature sampling with
     optional top-k and/or nucleus (top-p) truncation.
     """
@@ -101,7 +114,6 @@ def make_generate_fn(
             "without ring/ulysses attention (those are training-time "
             "sequence-parallel layouts)"
         )
-    model = DecoderLM(cfg, mesh)
 
     @functools.partial(jax.jit, static_argnames=("max_new_tokens",))
     def generate(
@@ -116,6 +128,14 @@ def make_generate_fn(
             )
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        # Length-bucketed cache: cache_len drives only the cache
+        # allocation and attention width; params (pos_embed sized to
+        # max_seq_len) are untouched. One compiled program per
+        # (batch, prompt, new) signature, as before.
+        bucket = cache_bucket(prompt_len + max_new_tokens, cfg.max_seq_len)
+        model = DecoderLM(
+            dataclasses.replace(cfg, cache_len=bucket), mesh
+        )
         cache = model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((batch, 1), jnp.int32),

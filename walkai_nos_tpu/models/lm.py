@@ -59,6 +59,12 @@ class LMConfig:
     # standard HBM-for-FLOPs trade that lets long sequences / deep
     # stacks fit chip memory.
     remat: bool = False
+    # KV-cache length for decoding; None = max_seq_len. Decode attends
+    # densely over the whole cache every step, so a cache sized to the
+    # actual generation (decode.cache_bucket) cuts per-step HBM traffic
+    # proportionally without touching params (pos_embed stays sized to
+    # max_seq_len).
+    cache_len: int | None = None
 
     @property
     def compute_dtype(self):
@@ -99,18 +105,21 @@ class CausalAttention(nn.Module):
         """KV-cache attention for autoregressive decoding (the flax
         `cache` collection idiom): new K/V land at `cache_index` via a
         static-shaped dynamic_update_slice, the query attends to every
-        cached position up to its own. Dense masked attention over
-        `max_seq_len` — decoding works on single steps or prefill
-        chunks, where flashing buys nothing."""
+        cached position up to its own. Dense masked attention over the
+        cache width (`cache_len` when set — decode.cache_bucket sizes
+        it to the generation so per-step HBM traffic is proportional to
+        what is generated, not to `max_seq_len`) — decoding works on
+        single steps or prefill chunks, where flashing buys nothing."""
         c = self.cfg
+        cache_len = c.cache_len or c.max_seq_len
         batch, heads, steps, head_dim = q.shape
         cached_k = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (batch, heads, c.max_seq_len, head_dim), c.compute_dtype,
+            (batch, heads, cache_len, head_dim), c.compute_dtype,
         )
         cached_v = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (batch, heads, c.max_seq_len, head_dim), c.compute_dtype,
+            (batch, heads, cache_len, head_dim), c.compute_dtype,
         )
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -127,8 +136,8 @@ class CausalAttention(nn.Module):
         cached_k.value, cached_v.value = k_all, v_all
         index.value = idx + steps
         q_pos = idx + jnp.arange(steps)
-        k_pos = jnp.arange(c.max_seq_len)
-        mask = k_pos[None, :] <= q_pos[:, None]  # [steps, max_seq_len]
+        k_pos = jnp.arange(cache_len)
+        mask = k_pos[None, :] <= q_pos[:, None]  # [steps, cache_len]
         scale = head_dim ** -0.5
         logits = jnp.einsum(
             "bhqd,bhkd->bhqk", q.astype(jnp.float32),
