@@ -239,6 +239,25 @@ class TestClusterInfoCollector:
         assert "2x2x2-pool chips" in inv.tpu
         assert inv.allocated == 4 and inv.available == 0
 
+    def test_managed_pool_member_reports_from_annotations(self):
+        """A pool member MANAGED by pool-level partitioning carries
+        status annotations (its pool share or host-local slices); those
+        are the inventory truth, not the whole-host capacity fallback."""
+        kube = FakeKubeClient()
+        node = _node(
+            "mh3", accelerator="tpu-v5p-slice",
+            capacity={"google.com/tpu": "4"},
+            annotations={
+                "nos.walkai.io/status-tpu-0-2x2x2-used": "1"
+            },
+        )
+        node["metadata"]["labels"]["cloud.google.com/gke-tpu-topology"] = "2x2x2"
+        kube.create("Node", node)
+        snap = Collector(kube).collect()
+        inv = next(t for t in snap.tpus if t.tpu.startswith("mh3"))
+        assert "2x2x2" in inv.tpu
+        assert inv.allocated == 1 and inv.available == 0
+
     def test_idle_multi_host_pool_reports_chip_units(self):
         kube = FakeKubeClient()
         node = _node("mh2", accelerator="tpu-v5p-slice",

@@ -72,12 +72,16 @@ class Collector:
             if model is None:
                 whole = topology.pool_model(labels)
                 if whole is not None:
-                    # Multi-host pool: never partitioned, but this host's
-                    # chips still count. Units are CHIPS (the node's
-                    # google.com/tpu capacity covers one host, not the
-                    # whole pool), so say so in the label.
-                    out.extend(
-                        self._inventory_from_capacity(
+                    # Multi-host pool member. A MANAGED member (pool-level
+                    # partitioning, tpu/tiling/pool.py) carries status
+                    # annotations — its pool shares / host-local slices
+                    # report through the primary path like any managed
+                    # node. Unmanaged members fall back to capacity;
+                    # units are CHIPS (the node's google.com/tpu covers
+                    # one host, not the whole pool), so say so.
+                    entries = self._inventory_from_annotations(node, whole)
+                    if not entries:
+                        entries = self._inventory_from_capacity(
                             node,
                             whole,
                             pods,
@@ -86,7 +90,7 @@ class Collector:
                                 "-pool chips"
                             ),
                         )
-                    )
+                    out.extend(entries)
                 continue
             entries = self._inventory_from_annotations(node, model)
             if not entries:
