@@ -320,6 +320,125 @@ class TestDisruptionBudget:
         assert kube.get("Pod", "p1", "d")  # survived
 
 
+class TestGangAwareOrder:
+    """Gang pods requesting a pool profile fill a partially-consumed
+    instance's grid-adjacent hosts before fragmenting another instance
+    (`Scheduler._gang_aware_order`)."""
+
+    def _pool_member(self, pool, idx, used_share=False, free_share=True):
+        annotations = {}
+        if used_share:
+            annotations[
+                "nos.walkai.io/status-tpu-0-4x4-used"
+            ] = "1"
+        if free_share:
+            annotations[
+                "nos.walkai.io/status-tpu-0-4x4-free"
+            ] = "1"
+        return {
+            "metadata": {
+                "name": f"{pool}-{idx}",
+                "labels": {
+                    constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                    constants.LABEL_TPU_TOPOLOGY: "4x8",
+                    constants.LABEL_TPU_PARTITIONING: "tiling",
+                    constants.LABEL_TPU_NODEPOOL: pool,
+                    constants.LABEL_TPU_WORKER_ID: str(idx),
+                },
+                "annotations": annotations,
+            },
+            "status": {
+                "allocatable": (
+                    {} if used_share else {"walkai.io/tpu-4x4": "1"}
+                )
+            },
+        }
+
+    def test_instance_mate_preferred(self):
+        """4-host 4x8 pool (host grid 2x2, '4x4' spans a 2-host column).
+        Host 2 (coord (1,0)) holds a used share; its instance-mate host 0
+        (coord (0,0)) sits at Manhattan distance 1 and must be tried
+        before host 1 (coord (0,1), distance 2). Grid coords come from
+        worker ids in row-major order."""
+        from walkai_nos_tpu.cmd.tpuscheduler import Scheduler
+
+        kube = FakeKubeClient()
+        for idx in range(4):
+            kube.create(
+                "Node",
+                self._pool_member("pool-g", idx, used_share=(idx == 2),
+                                  free_share=(idx != 2)),
+            )
+        pod = {
+            "metadata": {"name": "g2", "namespace": "d"},
+            "spec": {
+                "schedulerName": "walkai-nos-scheduler",
+                "containers": [
+                    {
+                        "name": "main",
+                        "resources": {
+                            "requests": {"walkai.io/tpu-4x4": "1"}
+                        },
+                    }
+                ],
+            },
+            "status": {"phase": "Pending"},
+        }
+        scheduler = Scheduler(kube)
+        ordered = scheduler._gang_aware_order(pod, kube.list("Node"))
+        names = [n["metadata"]["name"] for n in ordered]
+        # Distances to the used share at (1,0): host2=0 (skipped by fit
+        # later — no free capacity), host0=1, host3=1, host1=2; ties
+        # break by name. The far host (g-1) must come last.
+        assert names == ["pool-g-2", "pool-g-0", "pool-g-3", "pool-g-1"]
+
+    def test_fresh_pools_after_partial_pools(self):
+        from walkai_nos_tpu.cmd.tpuscheduler import Scheduler
+
+        kube = FakeKubeClient()
+        # pool-a untouched; pool-b has a used share.
+        for idx in range(2):
+            kube.create(
+                "Node", self._pool_member("pool-a", idx)
+            )
+        kube.create(
+            "Node",
+            self._pool_member("pool-b", 0, used_share=True,
+                              free_share=False),
+        )
+        kube.create("Node", self._pool_member("pool-b", 1))
+        pod = {
+            "metadata": {"name": "g", "namespace": "d"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "main",
+                        "resources": {
+                            "requests": {"walkai.io/tpu-4x4": "1"}
+                        },
+                    }
+                ]
+            },
+            "status": {"phase": "Pending"},
+        }
+        ordered = Scheduler(kube)._gang_aware_order(pod, kube.list("Node"))
+        names = [n["metadata"]["name"] for n in ordered]
+        # pool-b (partially consumed) members come before fresh pool-a.
+        assert names.index("pool-b-1") < names.index("pool-a-0")
+
+    def test_non_pool_requests_keep_name_order(self):
+        from walkai_nos_tpu.cmd.tpuscheduler import Scheduler
+
+        kube = FakeKubeClient()
+        kube.create("Node", _node("host-b"))
+        kube.create("Node", _node("host-a"))
+        pod = _pod("j", "team-a", 4, phase="Pending", node="")
+        ordered = Scheduler(kube)._gang_aware_order(pod, kube.list("Node"))
+        assert [n["metadata"]["name"] for n in ordered] == [
+            "host-a", "host-b"
+        ]
+
+
 # ------------------------------------------------------------------ e2e
 
 

@@ -96,7 +96,7 @@ class Scheduler:
 
         nodes = self._kube.list("Node")
         nodes_by_name = {objects.name(n): n for n in nodes}
-        for node in sorted(nodes, key=objects.name):
+        for node in self._gang_aware_order(pod, nodes):
             if not self._node_eligible(pod, node, pods, nodes_by_name):
                 continue
             if fits_node(pod, node, pods):
@@ -215,6 +215,70 @@ class Scheduler:
             {"status": {"conditions": conditions}},
             objects.namespace(pod) or "default",
         )
+
+    def _gang_aware_order(self, pod: dict, nodes: list[dict]) -> list[dict]:
+        """Node order for the first-fit bind loop: name order, EXCEPT
+        for pods requesting multi-host pool profiles, where gang pods
+        should fill the hosts of one pool-slice instance before touching
+        another. Pool-share instances are contiguous host-grid blocks
+        (`tpu/tiling/pool.py`), so a free share GRID-ADJACENT to a used
+        share of the same profile is its instance-mate: order pool
+        members by Manhattan distance to the nearest used share in
+        their pool, then pools with no consumption, then everything
+        else. Exact with one in-flight gang per pool; a placement-aware
+        gang scheduler is the strict upgrade."""
+        from walkai_nos_tpu.tpu.tiling.pool import (
+            is_pool_profile,
+            member_grid_info,
+        )
+        from walkai_nos_tpu.tpu.tiling.profile import get_requested_profiles
+
+        by_name = sorted(nodes, key=objects.name)
+        wanted = get_requested_profiles(pod)
+        if not wanted:
+            return by_name
+        # Pool-member geometry via the shared mapping (pool.py — the
+        # planner and this ordering must agree on instance layout).
+        infos: dict[str, tuple[str, tuple[int, ...], set[str]]] = {}
+        pool_wanted: set[str] = set()
+        wanted_by_chips: dict[int, set[str]] = {}
+        for n in nodes:
+            info = member_grid_info(
+                objects.labels(n), objects.annotations(n)
+            )
+            if info is None:
+                continue
+            key, coord, used, topo = info
+            infos[objects.name(n)] = (key, coord, used)
+            per_host = topo.model.chips_per_host
+            if per_host not in wanted_by_chips:
+                wanted_by_chips[per_host] = {
+                    p for p in wanted if is_pool_profile(p, topo)
+                }
+            pool_wanted.update(wanted_by_chips[per_host])
+        if not pool_wanted:
+            return by_name
+        used_coords: dict[str, list[tuple[int, ...]]] = {}
+        for key, coord, used in infos.values():
+            if pool_wanted & used:
+                used_coords.setdefault(key, []).append(coord)
+
+        def sort_key(n):
+            name = objects.name(n)
+            info = infos.get(name)
+            if info is None:
+                return (2, 0, name)  # cannot hold a pool share anyway
+            key, coord, _used = info
+            anchors = used_coords.get(key)
+            if anchors:
+                dist = min(
+                    sum(abs(a - b) for a, b in zip(coord, anchor))
+                    for anchor in anchors
+                )
+                return (0, dist, name)
+            return (1, 0, name)
+
+        return sorted(nodes, key=sort_key)
 
     def _node_eligible(
         self, pod: dict, node: dict, pods: list[dict],

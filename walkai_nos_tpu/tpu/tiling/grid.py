@@ -26,8 +26,12 @@ def orientations(shape: Shape) -> tuple[Shape, ...]:
     return tuple(sorted({p for p in itertools.permutations(shape)}))
 
 
-def all_coords(mesh: Shape) -> list[tuple[int, ...]]:
-    return list(itertools.product(*[range(d) for d in mesh]))
+@lru_cache(maxsize=None)
+def all_coords(mesh: Shape) -> tuple[tuple[int, ...], ...]:
+    """Row-major coordinates of a mesh — THE worker-index ↔ grid-coord
+    convention (pool planning and the scheduler's gang-adjacency
+    ordering both index into this). Cached; treat as immutable."""
+    return tuple(itertools.product(*[range(d) for d in mesh]))
 
 
 def first_empty(grid: list[bool], coords: list[tuple[int, ...]], mesh: Shape):

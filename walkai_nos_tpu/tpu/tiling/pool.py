@@ -113,6 +113,32 @@ def pool_profiles(topo: PoolTopology) -> list[str]:
     )
 
 
+def member_grid_info(
+    labels: Mapping[str, str], annotations: Mapping[str, str]
+) -> tuple[str, tuple[int, ...], set[str], PoolTopology] | None:
+    """(pool key, grid coord, used profiles, topology) of a pool member
+    node, or None when it is not a coordinatable member. The ONE
+    worker-id -> grid-coordinate mapping (row-major `gridlib.all_coords`)
+    shared by the pool planner and the scheduler's gang-adjacency
+    ordering, so the two can never disagree about instance layout."""
+    topo = topology.get_pool_topology(labels)
+    key = topology.pool_key(labels)
+    idx = topology.worker_id(labels)
+    if topo is None or key is None or idx is None:
+        return None
+    if not 0 <= idx < topo.num_hosts:
+        return None
+    status, _ = parse_node_annotations(annotations)
+    used = {
+        s.profile
+        for s in status
+        if s.mesh_index == 0
+        and s.status == DeviceStatus.USED
+        and s.quantity > 0
+    }
+    return key, gridlib.all_coords(topo.host_grid)[idx], used, topo
+
+
 @dataclass
 class PoolHost:
     node_obj: dict  # the member Node object (write target)
