@@ -220,6 +220,120 @@ class TestPoolNode:
         assert pool.provides_profiles({"2x2x2": 1})
 
 
+class TestPoolInvariants:
+    """Property sweep: random plan/place sequences never violate the
+    pool invariants — used slices never evicted, every free pool share
+    backed by a complete contiguous block, share counts consistent."""
+
+    def _fresh_pool(self, n_hosts=4, topo="4x8",
+                    acc="tpu-v5-lite-podslice"):
+        members = [
+            _member(f"p-{i}", i, acc=acc, topo=topo, pool="pool-a")
+            for i in range(n_hosts)
+        ]
+        pool = PoolNode.from_nodes("pool-a", members)
+        assert pool is not None
+        return pool
+
+    def _check_invariants(self, pool):
+        topo = pool.topo
+        for p in pool_profiles(topo):
+            per = topo.hosts_per_slice(p)
+            free = [h for h in pool.hosts if h.mesh.free_count(p) > 0]
+            used = [h for h in pool.hosts if p in h.mesh.used]
+            # Shares exist in whole-instance multiples.
+            assert (len(free) + len(used)) % per == 0, (
+                p, len(free), len(used),
+            )
+
+    def test_random_operation_sequences(self):
+        import random
+
+        rng = random.Random(7)
+        profiles = ["4x8", "4x4", "2x4"]  # pool, pool, host-local
+        for trial in range(30):
+            pool = self._fresh_pool()
+            totals: dict[str, int] = {}
+            for _ in range(rng.randint(2, 8)):
+                p = rng.choice(profiles)
+                wanted = {p: rng.randint(1, 2)}
+                if pool.provides_profiles(wanted):
+                    pool.add_pod(wanted)
+                else:
+                    pool.update_geometry_for(wanted)
+                    if pool.provides_profiles(wanted):
+                        pool.add_pod(wanted)
+                self._check_invariants(pool)
+                # Used slices never evicted: per-profile used totals may
+                # only grow or stay across every operation.
+                new_totals: dict[str, int] = {}
+                for h in pool.hosts:
+                    for prof, q in h.mesh.used.items():
+                        new_totals[prof] = new_totals.get(prof, 0) + q
+                for prof, q in totals.items():
+                    assert new_totals.get(prof, 0) >= q, (
+                        trial, prof, totals, new_totals,
+                    )
+                totals = new_totals
+            # Geometry writes are renderable for every member.
+            for _node_obj, part in pool.build_partitionings():
+                for _idx, geom in part.per_mesh_geometry().items():
+                    assert all(q > 0 for q in geom.values())
+
+    def test_multi_instance_demand_carves_distinct_blocks(self):
+        """{'4x4': 4} on a 4-host pool needs TWO instances; the carving
+        loop must claim distinct blocks, not re-carve the first."""
+        pool = self._fresh_pool()
+        assert pool.update_geometry_for({"4x4": 4})
+        assert pool.provides_profiles({"4x4": 4})
+        assert sum(
+            h.mesh.free_count("4x4") for h in pool.hosts
+        ) == 4
+
+    def test_mixed_request_keeps_earmarked_instance(self):
+        """A request satisfied partly by an existing free instance must
+        not retile that instance for its host-local part."""
+        free_share = {
+            f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-4x4-free": "1"
+        }
+        members = [
+            _member(
+                f"p-{i}", i, acc="tpu-v5-lite-podslice", topo="4x8",
+                pool="pool-a",
+                annotations=dict(free_share) if i in (0, 2) else None,
+            )
+            for i in range(4)
+        ]
+        pool = PoolNode.from_nodes("pool-a", members)
+        assert pool is not None
+        assert pool.provides_profiles({"4x4": 2})
+        pool.update_geometry_for({"4x4": 2, "2x4": 1})
+        assert pool.provides_profiles({"4x4": 2, "2x4": 1})
+
+    def test_used_totals_never_shrink(self):
+        import random
+
+        rng = random.Random(11)
+        pool = self._fresh_pool()
+        totals: dict[str, int] = {}
+        for _ in range(12):
+            p = rng.choice(["4x8", "4x4", "2x4", "1x4"])
+            wanted = {p: 1}
+            if not pool.provides_profiles(wanted):
+                pool.update_geometry_for(wanted)
+            if pool.provides_profiles(wanted):
+                pool.add_pod(wanted)
+            new_totals: dict[str, int] = {}
+            for h in pool.hosts:
+                for prof, q in h.mesh.used.items():
+                    new_totals[prof] = new_totals.get(prof, 0) + q
+            for prof, q in totals.items():
+                assert new_totals.get(prof, 0) >= q, (
+                    prof, totals, new_totals,
+                )
+            totals = new_totals
+
+
 class TestPoolEndToEnd:
     def test_pool_init_gang_binds(self):
         """Fresh 2-host v5p pool: members initialize to the whole-pool

@@ -27,15 +27,20 @@ Two kinds of profiles coexist in a pool:
 clone), so the partitioner's first-fit planning treats pools and
 single-host nodes uniformly.
 
-Known v1 simplification: share accounting is per-host; when a pool holds
-several free instances of the same pool profile, a gang's pods could in
-principle be placed across instances by a topology-unaware scheduler.
-Instance grouping is recoverable from slice placement (contiguous
-blocks); a topology-aware gang scheduler can use it.
+Instance identity is recovered from placement: shares group into
+disjoint contiguous blocks (`_group_instances`), with blocks covering a
+USED share chosen first. An in-flight gang therefore pins its whole
+instance — neither block carving, host-local retiling, nor strand
+cleanup may take a used instance's free mates — and simulated placement
+fills those mates before opening another instance. A topology-unaware
+EXTERNAL scheduler can still spread a gang across free instances of the
+same profile; the quota scheduler's gang-aware ordering
+(`cmd/tpuscheduler.py`) closes that for pods that opt in.
 """
 
 from __future__ import annotations
 
+import functools
 import logging
 from dataclasses import dataclass
 from typing import Mapping
@@ -85,6 +90,25 @@ def block_orientations(
             if all(b <= g for b, g in zip(block, topo.host_grid)):
                 out.append((orient, block))
     return out
+
+
+@functools.lru_cache(maxsize=None)
+def _profile_placements(
+    profile: str, topo: PoolTopology
+) -> tuple[tuple[tuple[int, ...], ...], ...]:
+    """Every placement (cell tuple) of a profile's host blocks in the
+    grid — static per (profile, topology), shared by the grouping and
+    block-search paths."""
+    return tuple(
+        tuple(
+            tuple(a + o for a, o in zip(anchor, off))
+            for off in gridlib.all_coords(block)
+        )
+        for _orient, block in block_orientations(profile, topo)
+        for anchor in gridlib.all_coords(
+            tuple(g - b + 1 for g, b in zip(topo.host_grid, block))
+        )
+    )
 
 
 def pool_profiles(topo: PoolTopology) -> list[str]:
@@ -285,11 +309,6 @@ class PoolNode:
     def _pool_share_used(self, host: PoolHost) -> bool:
         return any(is_pool_profile(p, self.topo) for p in host.mesh.used)
 
-    def _instance_partially_used(self, host: PoolHost, profile: str) -> bool:
-        """Heuristic (exact with a single instance per profile, the
-        common pool shape): some share of this profile is already
-        consumed somewhere, so fill alongside it."""
-        return any(profile in h.mesh.used for h in self.hosts)
 
     def _free_shares(self, profile: str) -> int:
         """Free shares of a pool profile. Stranded shares are re-tiled
@@ -307,8 +326,14 @@ class PoolNode:
         local mesh search for the rest. Never touches a host with any
         used slice (the never-evict invariant, `gpu.go:99`)."""
         remaining = {p: q for p, q in wanted.items() if q > 0}
-        self._subtract_available(remaining)
+        earmarked = self._subtract_available(remaining)
         changed = False
+        # Hosts this pass must not repurpose: free shares whose instance
+        # has a USED mate (an in-flight gang owns them), plus free
+        # shares just counted as satisfying `wanted` (retiling one for
+        # the host-local part of the SAME request would un-satisfy the
+        # pool part it was credited against).
+        protected = self._protected_free_hosts() | earmarked
         # Phase A: pool-level profiles -> contiguous free host blocks.
         # `remaining` counts SHARES; one carved block provides
         # hosts_per_slice of them, so a gang's worth of share requests
@@ -319,19 +344,24 @@ class PoolNode:
         ):
             per = self.topo.hosts_per_slice(p)
             while remaining.get(p, 0) > 0:
-                block = self._find_free_block(p)
+                block = self._find_free_block(p, protected)
                 if block is None:
                     break
                 for h in block:
                     h.mesh.used = {}
                     h.mesh.free = {p: 1}
+                    # Freshly carved hosts are claimed by this request:
+                    # without this the next loop iteration re-carves the
+                    # SAME block and under-provisions multi-instance
+                    # demands.
+                    protected.add(h.name)
                 changed = True
                 remaining[p] -= min(remaining[p], per)
                 if remaining[p] == 0:
                     del remaining[p]
         # Phase B: host-local profiles. A host whose pool share is merely
-        # FREE is reclaimable (the mesh search drops free slices); only a
-        # USED share pins the host to its pool slice.
+        # FREE is reclaimable (the mesh search drops free slices) —
+        # UNLESS its instance has a USED mate (`protected` above).
         host_wanted = {
             p: q for p, q in remaining.items()
             if not is_pool_profile(p, self.topo)
@@ -339,7 +369,7 @@ class PoolNode:
         for h in self.hosts:
             if not host_wanted:
                 break
-            if self._pool_share_used(h):
+            if self._pool_share_used(h) or h.name in protected:
                 continue
             if h.mesh.update_geometry_for(host_wanted):
                 changed = True
@@ -353,59 +383,72 @@ class PoolNode:
             changed = True
         return changed
 
-    def _drop_stranded_shares(self) -> bool:
-        """Re-tile free pool shares whose slice instance is broken.
-
-        Reclaiming one member of a pool slice (Phase B above, or a
-        previous plan) leaves its instance-mates holding free shares
-        that no complete block can ever satisfy — and a pool-unaware
-        scheduler could bind half a gang onto one, pinning the pool in a
-        broken layout. Group the remaining free shares into complete
-        contiguous blocks; hosts left over fall back to the fewest-
-        slices host-local tiling so their capacity stays usable."""
-        changed = False
-        profiles = {
+    def _free_share_profiles(self) -> set[str]:
+        return {
             p
             for h in self.hosts
             for p in h.mesh.free
             if is_pool_profile(p, self.topo)
         }
-        for p in profiles:
-            by_coord = {
-                h.coord: h
-                for h in self.hosts
-                if h.mesh.free_count(p) > 0 and not h.mesh.used
-            }
-            free_coords = set(by_coord)
-            used_coords = {
-                h.coord for h in self.hosts if p in h.mesh.used
-            }
-            # Disjoint complete blocks over free + used shares; blocks
-            # covering a USED share first (a half-consumed instance must
-            # keep its free mates for the rest of the gang).
-            candidates = free_coords | used_coords
-            kept: set[tuple[int, ...]] = set()
-            placements = [
-                [
-                    tuple(a + o for a, o in zip(anchor, off))
-                    for off in gridlib.all_coords(block)
-                ]
-                for _orient, block in block_orientations(p, self.topo)
-                for anchor in gridlib.all_coords(
-                    tuple(
-                        g - b + 1
-                        for g, b in zip(self.topo.host_grid, block)
-                    )
-                )
-            ]
-            for pass_used_first in (True, False):
-                for cells in placements:
-                    covers_used = any(c in used_coords for c in cells)
-                    if covers_used != pass_used_first:
-                        continue
-                    if all(c in candidates for c in cells):
-                        kept.update(cells)
-                        candidates.difference_update(cells)
+
+    def _group_instances(
+        self, profile: str
+    ) -> tuple[set, set, set, dict]:
+        """Group a profile's shares into disjoint complete contiguous
+        blocks: (free coords, kept free coords, free coords protected by
+        a used mate, free-host by coord). Blocks covering a USED share
+        are chosen first — a half-consumed instance must keep its free
+        mates for the rest of the gang."""
+        by_coord = {
+            h.coord: h
+            for h in self.hosts
+            if h.mesh.free_count(profile) > 0 and not h.mesh.used
+        }
+        free_coords = set(by_coord)
+        used_coords = {
+            h.coord for h in self.hosts if profile in h.mesh.used
+        }
+        candidates = free_coords | used_coords
+        kept: set[tuple[int, ...]] = set()
+        protected: set[tuple[int, ...]] = set()
+        placements = _profile_placements(profile, self.topo)
+        for pass_used_first in (True, False):
+            for cells in placements:
+                covers_used = any(c in used_coords for c in cells)
+                if covers_used != pass_used_first:
+                    continue
+                if all(c in candidates for c in cells):
+                    kept.update(cells)
+                    if covers_used:
+                        protected.update(
+                            c for c in cells if c in free_coords
+                        )
+                    candidates.difference_update(cells)
+        return free_coords, kept, protected, by_coord
+
+    def _protected_free_hosts(self) -> set[str]:
+        """Names of hosts whose free pool share is instance-mate to a
+        USED share — pinned: the in-flight gang owns those shares."""
+        out: set[str] = set()
+        for p in self._free_share_profiles():
+            _free, _kept, protected, by_coord = self._group_instances(p)
+            out.update(by_coord[c].name for c in protected)
+        return out
+
+    def _drop_stranded_shares(self) -> bool:
+        """Re-tile free pool shares whose slice instance is broken.
+
+        Reclaiming one member of a pool slice leaves its instance-mates
+        holding free shares that no complete block can ever satisfy —
+        and a pool-unaware scheduler could bind half a gang onto one,
+        pinning the pool in a broken layout. Shares outside the complete
+        blocks (`_group_instances`) fall back to the fewest-slices
+        host-local tiling so their capacity stays usable."""
+        changed = False
+        for p in self._free_share_profiles():
+            free_coords, kept, _protected, by_coord = (
+                self._group_instances(p)
+            )
             for coord in free_coords - kept:
                 host = by_coord[coord]
                 host.mesh.used = {}
@@ -414,10 +457,22 @@ class PoolNode:
                 changed = True
         return changed
 
-    def _subtract_available(self, remaining: Geometry) -> None:
+    def _subtract_available(self, remaining: Geometry) -> set[str]:
+        """Deduct already-available capacity from `remaining`; returns
+        the names of hosts whose free pool shares were counted
+        (earmarked — the caller must not repurpose them this pass).
+        Conservatively earmarks every free share of a credited profile:
+        surplus shares stay reclaimable in later passes."""
+        earmarked: set[str] = set()
         for p in list(remaining):
             if is_pool_profile(p, self.topo):
                 take = min(remaining[p], self._free_shares(p))
+                if take:
+                    earmarked.update(
+                        h.name
+                        for h in self.hosts
+                        if h.mesh.free_count(p) > 0
+                    )
             else:
                 take = sum(
                     h.mesh.free_count(p)
@@ -429,26 +484,25 @@ class PoolNode:
                 remaining[p] -= take
                 if remaining[p] == 0:
                     del remaining[p]
+        return earmarked
 
-    def _find_free_block(self, profile: str) -> list[PoolHost] | None:
+    def _find_free_block(
+        self, profile: str, protected: set[str] = frozenset()
+    ) -> list[PoolHost] | None:
         """First (row-major) contiguous block of reassignable hosts that
         realizes `profile`. A host is reassignable when nothing on it is
         used — free slices (including a free pool share from a previous
-        layout) may be re-tiled away."""
+        layout) may be re-tiled away — and it is not `protected` (a
+        free share pinned by an in-flight gang's used mate)."""
         by_coord = {h.coord: h for h in self.hosts}
         reassignable = {
-            h.coord for h in self.hosts if not h.mesh.used
+            h.coord
+            for h in self.hosts
+            if not h.mesh.used and h.name not in protected
         }
-        for _orient, block in block_orientations(profile, self.topo):
-            for anchor in gridlib.all_coords(
-                tuple(g - b + 1 for g, b in zip(self.topo.host_grid, block))
-            ):
-                cells = [
-                    tuple(a + o for a, o in zip(anchor, off))
-                    for off in gridlib.all_coords(block)
-                ]
-                if all(c in reassignable for c in cells):
-                    return [by_coord[c] for c in cells]
+        for cells in _profile_placements(profile, self.topo):
+            if all(c in reassignable for c in cells):
+                return [by_coord[c] for c in cells]
         return None
 
     # ------------------------------------------------------------------ pods
@@ -464,16 +518,16 @@ class PoolNode:
         for p in list(remaining):
             if not is_pool_profile(p, self.topo):
                 continue
-            # One share per requested unit (one gang pod each), hosts
-            # with a partially-consumed instance first so a gang fills
-            # one instance before touching the next.
+            # One share per requested unit (one gang pod each). Free
+            # shares whose instance already has a used mate fill first
+            # (exact via the instance grouping), so a gang completes one
+            # instance before touching the next.
             shares = remaining.pop(p)
+            _free, _kept, protected, by_coord = self._group_instances(p)
+            mates = {by_coord[c].name for c in protected}
             takers = sorted(
                 (h for h in self.hosts if h.mesh.free_count(p) > 0),
-                key=lambda h: (
-                    not self._instance_partially_used(h, p),
-                    h.index,
-                ),
+                key=lambda h: (h.name not in mates, h.index),
             )[:shares]
             for h in takers:
                 h.mesh.add_pod(p)
