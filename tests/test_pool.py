@@ -310,6 +310,50 @@ class TestPoolInvariants:
         pool.update_geometry_for({"4x4": 2, "2x4": 1})
         assert pool.provides_profiles({"4x4": 2, "2x4": 1})
 
+    def test_surplus_instance_serves_mixed_request(self):
+        """Two free 4x4 instances + a request needing only one of them
+        plus a host-local slice: the surplus instance must be retiled
+        for the host-local part, not earmarked into a dead end."""
+        free_share = {
+            f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-4x4-free": "1"
+        }
+        members = [
+            _member(
+                f"p-{i}", i, acc="tpu-v5-lite-podslice", topo="4x8",
+                pool="pool-a", annotations=dict(free_share),
+            )
+            for i in range(4)
+        ]
+        pool = PoolNode.from_nodes("pool-a", members)
+        assert pool is not None
+        assert pool.update_geometry_for({"4x4": 2, "2x4": 1})
+        assert pool.provides_profiles({"4x4": 2, "2x4": 1})
+
+    def test_fresh_gang_stays_within_one_instance(self):
+        """add_pod of one gang's worth of shares on a pool with two
+        whole free instances must consume ONE instance whole — never
+        one share in each (half a slice has no ICI torus behind it)."""
+        free_share = {
+            f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-4x4-free": "1"
+        }
+        members = [
+            _member(
+                f"p-{i}", i, acc="tpu-v5-lite-podslice", topo="4x8",
+                pool="pool-a", annotations=dict(free_share),
+            )
+            for i in range(4)
+        ]
+        pool = PoolNode.from_nodes("pool-a", members)
+        assert pool is not None
+        pool.add_pod({"4x4": 2})
+        used = {h.index for h in pool.hosts if "4x4" in h.mesh.used}
+        # Instances are column blocks of the 2x2 host grid: {0, 2} and
+        # {1, 3}. The gang must land on exactly one of them.
+        assert used in ({0, 2}, {1, 3}), used
+        # And a second gang takes the OTHER whole instance.
+        pool.add_pod({"4x4": 2})
+        assert all("4x4" in h.mesh.used for h in pool.hosts)
+
     def test_used_totals_never_shrink(self):
         import random
 
@@ -452,6 +496,56 @@ class TestPoolEndToEnd:
                 gang_bound, timeout=30.0,
                 msg="pool re-tiles back and the gang binds",
             )
+
+    def test_lifecycle_churn_gang_reforms(self):
+        """Full churn cycle through the real controllers: a gang binds,
+        tears down, host-local pods take the hosts, tear down, and a
+        NEW gang re-forms the pool — no stranded shares or stuck state
+        at any stage."""
+        cluster = SimCluster()
+        cluster.add_pool("pool-c", n_hosts=2)
+        with cluster:
+            def bound(*names):
+                def check():
+                    for n in names:
+                        pod = cluster.kube.get("Pod", n, "default")
+                        if not objects.pod_is_scheduled(pod):
+                            return False
+                    return True
+                return check
+
+            def release(*names):
+                for n in names:
+                    pod = cluster.kube.get("Pod", n, "default")
+                    host = cluster.nodes[pod["spec"]["nodeName"]]
+                    cluster.kube.delete("Pod", n, "default")
+                    for dev in host.resources.get_used_devices():
+                        host.resources.mark_free(dev.device_id)
+
+            # Cycle 1: gang.
+            cluster.create_slice_pod("g1-0", "2x2x2")
+            cluster.create_slice_pod("g1-1", "2x2x2")
+            eventually(bound("g1-0", "g1-1"), timeout=30.0,
+                       msg="first gang binds")
+            release("g1-0", "g1-1")
+
+            # Cycle 2: host-local demand takes both hosts.
+            cluster.create_slice_pod("l-0", "1x1x2")
+            cluster.create_slice_pod("l-1", "1x1x2")
+            eventually(bound("l-0", "l-1"), timeout=30.0,
+                       msg="host-local pods bind after gang teardown")
+            release("l-0", "l-1")
+
+            # Cycle 3: a new gang re-forms the pool slice.
+            cluster.create_slice_pod("g2-0", "2x2x2")
+            cluster.create_slice_pod("g2-1", "2x2x2")
+            eventually(bound("g2-0", "g2-1"), timeout=30.0,
+                       msg="pool re-forms for the second gang")
+            hosts = {
+                cluster.kube.get("Pod", n, "default")["spec"]["nodeName"]
+                for n in ("g2-0", "g2-1")
+            }
+            assert hosts == {"pool-c-0", "pool-c-1"}
 
     def test_unpoolable_multi_host_node_still_refused(self):
         """A multi-host node without the nodepool label keeps the round-2

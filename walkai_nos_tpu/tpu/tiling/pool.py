@@ -393,12 +393,15 @@ class PoolNode:
 
     def _group_instances(
         self, profile: str
-    ) -> tuple[set, set, set, dict]:
+    ) -> tuple[set, set, set, dict, list]:
         """Group a profile's shares into disjoint complete contiguous
         blocks: (free coords, kept free coords, free coords protected by
-        a used mate, free-host by coord). Blocks covering a USED share
-        are chosen first — a half-consumed instance must keep its free
-        mates for the rest of the gang."""
+        a used mate, free-host by coord, chosen blocks in order). Blocks
+        covering a USED share are chosen first — a half-consumed
+        instance must keep its free mates for the rest of the gang —
+        and the returned block order IS the share-selection order
+        (`_select_share_hosts`): fill open instances, then whole free
+        instances in grid order."""
         by_coord = {
             h.coord: h
             for h in self.hosts
@@ -411,6 +414,7 @@ class PoolNode:
         candidates = free_coords | used_coords
         kept: set[tuple[int, ...]] = set()
         protected: set[tuple[int, ...]] = set()
+        blocks: list[tuple[tuple[int, ...], ...]] = []
         placements = _profile_placements(profile, self.topo)
         for pass_used_first in (True, False):
             for cells in placements:
@@ -419,19 +423,42 @@ class PoolNode:
                     continue
                 if all(c in candidates for c in cells):
                     kept.update(cells)
+                    blocks.append(cells)
                     if covers_used:
                         protected.update(
                             c for c in cells if c in free_coords
                         )
                     candidates.difference_update(cells)
-        return free_coords, kept, protected, by_coord
+        return free_coords, kept, protected, by_coord, blocks
+
+    def _select_share_hosts(
+        self, profile: str, count: int
+    ) -> list[PoolHost]:
+        """The first `count` free shares in instance-coherent order:
+        open (partially-used) instances fill before a whole free
+        instance opens, and shares of one instance are taken together —
+        the ONE selection order shared by simulated placement and
+        availability earmarking, so the two can never disagree."""
+        _free, _kept, _prot, by_coord, blocks = self._group_instances(
+            profile
+        )
+        out: list[PoolHost] = []
+        for cells in blocks:
+            for c in cells:
+                if c in by_coord and len(out) < count:
+                    out.append(by_coord[c])
+            if len(out) >= count:
+                break
+        return out
 
     def _protected_free_hosts(self) -> set[str]:
         """Names of hosts whose free pool share is instance-mate to a
         USED share — pinned: the in-flight gang owns those shares."""
         out: set[str] = set()
         for p in self._free_share_profiles():
-            _free, _kept, protected, by_coord = self._group_instances(p)
+            _free, _kept, protected, by_coord, _blocks = (
+                self._group_instances(p)
+            )
             out.update(by_coord[c].name for c in protected)
         return out
 
@@ -446,7 +473,7 @@ class PoolNode:
         host-local tiling so their capacity stays usable."""
         changed = False
         for p in self._free_share_profiles():
-            free_coords, kept, _protected, by_coord = (
+            free_coords, kept, _protected, by_coord, _blocks = (
                 self._group_instances(p)
             )
             for coord in free_coords - kept:
@@ -468,10 +495,12 @@ class PoolNode:
             if is_pool_profile(p, self.topo):
                 take = min(remaining[p], self._free_shares(p))
                 if take:
+                    # Exactly the shares placement would take (same
+                    # order), so surplus instances stay reclaimable for
+                    # the rest of this request.
                     earmarked.update(
                         h.name
-                        for h in self.hosts
-                        if h.mesh.free_count(p) > 0
+                        for h in self._select_share_hosts(p, take)
                     )
             else:
                 take = sum(
@@ -518,18 +547,11 @@ class PoolNode:
         for p in list(remaining):
             if not is_pool_profile(p, self.topo):
                 continue
-            # One share per requested unit (one gang pod each). Free
-            # shares whose instance already has a used mate fill first
-            # (exact via the instance grouping), so a gang completes one
-            # instance before touching the next.
-            shares = remaining.pop(p)
-            _free, _kept, protected, by_coord = self._group_instances(p)
-            mates = {by_coord[c].name for c in protected}
-            takers = sorted(
-                (h for h in self.hosts if h.mesh.free_count(p) > 0),
-                key=lambda h: (h.name not in mates, h.index),
-            )[:shares]
-            for h in takers:
+            # One share per requested unit (one gang pod each), in the
+            # instance-coherent order: open instances complete before a
+            # fresh one opens, and a gang's shares stay within blocks —
+            # never one share in each of two instances.
+            for h in self._select_share_hosts(p, remaining.pop(p)):
                 h.mesh.add_pod(p)
         for h in self.hosts:
             if self._holds_pool_share(h):
