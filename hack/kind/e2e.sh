@@ -21,6 +21,18 @@ WORKER="${CLUSTER}-worker"
 
 say() { echo ">>> $*"; }
 
+# Poll a node's JSON for a marker string (annotation prefix) for up to
+# 120s; FAIL with the given message if it never appears.
+wait_for_node_annotation() {
+  local node=$1 marker=$2 what=$3
+  for i in $(seq 1 60); do
+    kubectl get node "${node}" -o json | grep -q "${marker}" && return 0
+    sleep 2
+  done
+  echo "FAIL: ${what}"
+  exit 1
+}
+
 say "building image ${IMG}"
 docker build -f build/Dockerfile -t "${IMG}" .
 kind load docker-image --name "${CLUSTER}" "${IMG}"
@@ -67,22 +79,12 @@ if kubectl get node "${WORKER2}" >/dev/null 2>&1; then
 fi
 
 say "waiting for node init (spec annotations)"
-for i in $(seq 1 60); do
-  kubectl get node "${WORKER}" -o json \
-    | grep -q 'nos.walkai.io/spec-tpu' && break
-  sleep 2
-done
-kubectl get node "${WORKER}" -o json | grep -q 'nos.walkai.io/spec-tpu' \
-  || { echo "FAIL: node never initialized"; exit 1; }
+wait_for_node_annotation "${WORKER}" 'nos.walkai.io/spec-tpu' \
+  "node never initialized"
 
 say "waiting for agent status report"
-for i in $(seq 1 60); do
-  kubectl get node "${WORKER}" -o json \
-    | grep -q 'nos.walkai.io/status-tpu' && break
-  sleep 2
-done
-kubectl get node "${WORKER}" -o json | grep -q 'nos.walkai.io/status-tpu' \
-  || { echo "FAIL: agent never reported"; exit 1; }
+wait_for_node_annotation "${WORKER}" 'nos.walkai.io/status-tpu' \
+  "agent never reported"
 
 say "creating a pending 2x2 slice pod"
 kubectl apply -f - <<EOF
@@ -147,6 +149,61 @@ EOF
   say "sharing scenario PASS"
 else
   say "no ${WORKER2} in this cluster; skipping the sharing scenario"
+fi
+
+# ---- multi-host pool scenario (workers 3+4, labeled by cluster.yaml) --
+WORKER3="${CLUSTER}-worker3"
+WORKER4="${CLUSTER}-worker4"
+if kubectl get node "${WORKER3}" >/dev/null 2>&1 \
+    && kubectl get node "${WORKER4}" >/dev/null 2>&1; then
+  say "pool scenario: waiting for pool members to init (share spec 2x8)"
+  for node in "${WORKER3}" "${WORKER4}"; do
+    wait_for_node_annotation "${node}" 'nos.walkai.io/spec-tpu-0-2x8' \
+      "pool member ${node} never initialized"
+  done
+
+  say "creating a 2-pod gang, each consuming one 2x8 share"
+  for idx in 0 1; do
+    kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-gang-${idx}
+  namespace: default
+spec:
+  restartPolicy: Never
+  containers:
+    - name: main
+      image: busybox:1.36
+      command: ["sleep", "300"]
+      resources:
+        requests: {"walkai.io/tpu-2x8": "1"}
+        limits: {"walkai.io/tpu-2x8": "1"}
+EOF
+  done
+
+  say "waiting for the gang to bind one pod per member host"
+  for idx in 0 1; do
+    if ! kubectl wait pod/e2e-gang-${idx} \
+        --for=condition=PodScheduled --timeout=180s; then
+      echo "FAIL: gang pod ${idx} never scheduled"
+      kubectl describe pod e2e-gang-${idx} | tail -20
+      # Pool-share actuation failures surface in the AGENT logs
+      # (actuator pool-share path), not the partitioner's.
+      kubectl -n "${NS}" logs -l app=tpuagent --tail=50 || true
+      kubectl -n "${NS}" logs \
+        -l app.kubernetes.io/component=partitioner --tail=50 || true
+      exit 1
+    fi
+  done
+  HOSTS=$(kubectl get pod e2e-gang-0 e2e-gang-1 \
+    -o jsonpath='{.items[*].spec.nodeName}' | tr ' ' '\n' | sort -u \
+    | wc -l)
+  [ "${HOSTS}" -eq 2 ] \
+    || { echo "FAIL: gang pods share a host"; exit 1; }
+  say "pool scenario PASS"
+else
+  say "no ${WORKER3}/${WORKER4} in this cluster; skipping the pool scenario"
 fi
 
 # ---- elastic-quota scenario (tpuscheduler binds, denies over-max) -----
