@@ -1,0 +1,155 @@
+"""Speculative decoding: exact greedy equivalence for ANY draft.
+
+The defining property of greedy speculative decoding with exact-match
+acceptance: the output is identical to greedy decoding of the target
+model alone — the draft only buys speed. The tests pin that with a
+RANDOM (useless) draft, a shared-architecture (perfect) draft, and
+boundary ks, so acceptance paths from a=0 to a=k all execute.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.speculative import make_speculative_generate_fn
+
+TARGET = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+    max_seq_len=128,
+)
+DRAFT = LMConfig(
+    vocab_size=64, hidden_dim=16, num_layers=1, num_heads=2,
+    max_seq_len=128,
+)
+
+
+def _prompt(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, TARGET.vocab_size, (1, n)), jnp.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    target = DecoderLM(TARGET).init_params(jax.random.PRNGKey(0))
+    draft = DecoderLM(DRAFT).init_params(jax.random.PRNGKey(1))
+    return target, draft
+
+
+class TestExactGreedyEquivalence:
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_random_draft_matches_target_greedy(self, params, k):
+        """A draft with RANDOM weights (near-zero acceptance) must still
+        produce the target's exact greedy sequence."""
+        target_params, draft_params = params
+        prompt = _prompt()
+        reference = make_generate_fn(TARGET)(
+            target_params, prompt, max_new_tokens=12
+        )
+        spec = make_speculative_generate_fn(TARGET, DRAFT, k=k)(
+            target_params, draft_params, prompt, max_new_tokens=12
+        )
+        assert jnp.array_equal(spec, reference), (spec, reference)
+
+    def test_perfect_draft_matches_target_greedy(self, params):
+        """Draft == target (same params): every round fully accepts
+        (a = k, the bonus-token path) and the output is still exact."""
+        target_params, _ = params
+        prompt = _prompt(seed=3)
+        reference = make_generate_fn(TARGET)(
+            target_params, prompt, max_new_tokens=10
+        )
+        spec = make_speculative_generate_fn(TARGET, TARGET, k=3)(
+            target_params, target_params, prompt, max_new_tokens=10
+        )
+        assert jnp.array_equal(spec, reference), (spec, reference)
+
+    def test_partial_acceptance_matches_target_greedy(self, params):
+        """A near-target draft (target weights + small noise) produces
+        MIXED acceptance — the dominant real-world case. The histogram
+        proves a=0, 0<a<k, and a=k all executed in one run, and the
+        output still equals stepwise target greedy exactly (the
+        mid-prefix rewind path cannot hide behind the extremes)."""
+        target_params, _ = params
+        noisy_draft = jax.tree_util.tree_map(
+            lambda leaf, key=jax.random.PRNGKey(3): leaf
+            + 0.01
+            * jax.random.normal(
+                jax.random.fold_in(key, hash(str(leaf.shape)) % 1000),
+                leaf.shape, leaf.dtype,
+            ),
+            target_params,
+        )
+        prompt = _prompt(seed=3)
+        reference = make_generate_fn(TARGET)(
+            target_params, prompt, max_new_tokens=24
+        )
+        gen = make_speculative_generate_fn(
+            TARGET, TARGET, k=4, return_stats=True
+        )
+        spec, stats = gen(
+            target_params, noisy_draft, prompt, max_new_tokens=24
+        )
+        assert jnp.array_equal(spec, reference), (spec, reference)
+        hist = np.asarray(stats["acceptance_hist"])
+        assert hist[0] > 0, hist       # full-rejection rounds
+        assert hist[1:-1].sum() > 0, hist  # PARTIAL acceptance rounds
+        assert hist[-1] > 0, hist      # full-acceptance rounds
+
+    def test_single_new_token(self, params):
+        target_params, draft_params = params
+        prompt = _prompt(seed=5)
+        reference = make_generate_fn(TARGET)(
+            target_params, prompt, max_new_tokens=1
+        )
+        spec = make_speculative_generate_fn(TARGET, DRAFT, k=2)(
+            target_params, draft_params, prompt, max_new_tokens=1
+        )
+        assert jnp.array_equal(spec, reference)
+
+
+class TestGuards:
+    def test_batch_rejected(self, params):
+        target_params, draft_params = params
+        gen = make_speculative_generate_fn(TARGET, DRAFT, k=2)
+        with pytest.raises(ValueError, match="single-sequence"):
+            gen(
+                target_params, draft_params,
+                jnp.zeros((2, 4), jnp.int32), max_new_tokens=4,
+            )
+
+    def test_overflow_rejected(self, params):
+        target_params, draft_params = params
+        gen = make_speculative_generate_fn(TARGET, DRAFT, k=2)
+        with pytest.raises(ValueError, match="exceeds"):
+            gen(
+                target_params, draft_params,
+                jnp.zeros((1, 4), jnp.int32), max_new_tokens=126,
+            )
+
+    def test_boundary_generation_allowed(self, params):
+        """The guard is exact: prompt + new + k == max_seq_len runs
+        (positions stay < the limit); one more is rejected."""
+        target_params, draft_params = params
+        gen = make_speculative_generate_fn(TARGET, DRAFT, k=2)
+        prompt = _prompt(seed=7)
+        out = gen(
+            target_params, draft_params, prompt,
+            max_new_tokens=TARGET.max_seq_len - prompt.shape[1] - 2,
+        )
+        assert out.shape == (1, TARGET.max_seq_len - prompt.shape[1] - 2)
+        with pytest.raises(ValueError, match="exceeds"):
+            gen(
+                target_params, draft_params, prompt,
+                max_new_tokens=TARGET.max_seq_len - prompt.shape[1] - 1,
+            )
+
+    def test_vocab_mismatch_rejected(self):
+        import dataclasses
+
+        with pytest.raises(ValueError, match="vocabulary"):
+            make_speculative_generate_fn(
+                TARGET, dataclasses.replace(DRAFT, vocab_size=32)
+            )

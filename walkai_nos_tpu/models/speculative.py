@@ -1,0 +1,220 @@
+"""Speculative decoding: draft-model lookahead with exact verification.
+
+Decode is memory-bound (the bench's roofline: every step re-reads the
+full weights for one token per sequence). Speculative decoding attacks
+exactly that wall: a small DRAFT model proposes `k` tokens
+autoregressively, then the TARGET model verifies all of them in ONE
+forward — k+1 positions amortize a single weights-read, so accepted
+tokens cost a fraction of a normal decode step.
+
+This is the greedy variant with exact-match acceptance: the emitted
+sequence is greedy decoding of the target model, for ANY draft params —
+draft quality affects only speed (the acceptance rate), never the
+output distribution. On a deterministic backend the output is BITWISE
+identical to stepwise greedy (pinned by tests on CPU, including the
+full-acceptance and zero-acceptance paths). On TPU, the chunked
+verification forward and a stepwise forward round differently
+(shape-dependent MXU tiling; measured ~4e-2 logit noise at 512-dim),
+so tokens whose top-1/top-2 logit gap is below that noise can flip —
+with an UNTRAINED model logits are near-flat and flips are common,
+while a trained model's peaked logits make them rare. Every emitted
+token is still the target's argmax under the forward that verified it.
+
+Position bookkeeping (cache index n = tokens 0..n-1 processed; the next
+input is the last emitted token, index n):
+
+- one round feeds the target `[cur, d_0 .. d_{k-1}]` (positions
+  n..n+k); logits at position n+j predict token n+j+1 = P_j
+- accept a = longest prefix with d_j == P_j; emit P_0..P_a (the
+  matched drafts plus the free "bonus" token — between 1 and k+1
+  tokens per round)
+- both caches hold valid K/V exactly through position n+a (inputs
+  cur, d_0..d_{a-1}), so their indices rewind to n+a+1; stale entries
+  beyond are invisible (causal masking) until overwritten in order.
+
+Single-sequence (batch 1): acceptance length is data-dependent PER ROW,
+so batching requires per-row cache indices — out of scope here.
+
+No reference analogue — serving-side companion of `models/decode.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from walkai_nos_tpu.models.decode import cache_bucket
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+
+
+def _rewind_cache(cache, new_index):
+    """Set every cache_index / pos_index leaf to `new_index`, leaving
+    the K/V buffers in place (stale tail entries are masked until
+    overwritten)."""
+
+    def fix(path, leaf):
+        name = path[-1].key if path else ""
+        if name in ("cache_index", "pos_index"):
+            return jnp.asarray(new_index, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def make_speculative_generate_fn(
+    target_cfg: LMConfig,
+    draft_cfg: LMConfig,
+    mesh: Mesh | None = None,
+    *,
+    k: int = 4,
+    return_stats: bool = False,
+):
+    """Build a jitted `(target_params, draft_params, prompt,
+    max_new_tokens) -> tokens` speculative generator (greedy; exact
+    target-greedy output). `prompt` is [1, prompt_len] int32; result is
+    [1, max_new_tokens]. With `return_stats` the result is
+    `(tokens, {"acceptance_hist": [k+1] int32})` — rounds per accepted
+    prefix length, the telemetry that says whether the draft is earning
+    its keep (mean accepted + 1 tokens amortize one target forward)."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            "target and draft must share a vocabulary "
+            f"({target_cfg.vocab_size} != {draft_cfg.vocab_size})"
+        )
+    for cfg, name in ((target_cfg, "target"), (draft_cfg, "draft")):
+        if cfg.use_ring_attention or cfg.use_ulysses_attention:
+            raise ValueError(
+                f"{name} config uses a training-time sequence-parallel "
+                "layout; decode needs the KV-cache path"
+            )
+
+    @functools.partial(jax.jit, static_argnames=("max_new_tokens",))
+    def generate(
+        target_params, draft_params, prompt: jax.Array,
+        max_new_tokens: int,
+    ) -> jax.Array:
+        batch, prompt_len = prompt.shape
+        if batch != 1:
+            raise ValueError(
+                "speculative decoding is single-sequence (acceptance "
+                f"length is data-dependent per row); got batch {batch}"
+            )
+        limit = min(target_cfg.max_seq_len, draft_cfg.max_seq_len)
+        # Worst-case position touched: the last round enters with
+        # emitted <= max_new - 1 (n = prompt + emitted) and verifies
+        # positions n..n+k, so indices stay < prompt + max_new + k.
+        if prompt_len + max_new_tokens + k > limit:
+            raise ValueError(
+                f"prompt {prompt_len} + {max_new_tokens} new + {k} "
+                f"lookahead exceeds max_seq_len {limit}"
+            )
+        bucket = cache_bucket(prompt_len + max_new_tokens + k, limit)
+        target = DecoderLM(
+            dataclasses.replace(target_cfg, cache_len=bucket), mesh
+        )
+        draft = DecoderLM(
+            dataclasses.replace(draft_cfg, cache_len=bucket), mesh
+        )
+
+        def init_cache(model):
+            return model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 1), jnp.int32),
+                decode=True,
+            )["cache"]
+
+        # Prefill both models on the whole prompt.
+        t_logits, t_vars = target.apply(
+            {"params": target_params, "cache": init_cache(target)},
+            prompt, decode=True, mutable=["cache"],
+        )
+        d_logits, d_vars = draft.apply(
+            {"params": draft_params, "cache": init_cache(draft)},
+            prompt, decode=True, mutable=["cache"],
+        )
+        cur = jnp.argmax(t_logits[:, -1], axis=-1)  # token idx prompt_len
+
+        out0 = jnp.zeros((1, max_new_tokens + k + 1), jnp.int32)
+        out0 = jax.lax.dynamic_update_slice(out0, cur[None], (0, 0))
+        # n = positions processed by both caches (== prompt_len).
+        state0 = (
+            t_vars["cache"], d_vars["cache"], cur,
+            jnp.asarray(prompt_len, jnp.int32),
+            jnp.asarray(1, jnp.int32),  # emitted (incl. first token)
+            out0,
+            jnp.zeros((k + 1,), jnp.int32),  # acceptance histogram
+        )
+
+        def round_(state):
+            t_cache, d_cache, cur, n, emitted, out, hist = state
+
+            # 1. Draft k tokens autoregressively.
+            def draft_step(carry, _):
+                cache, tok = carry
+                logits, vs = draft.apply(
+                    {"params": draft_params, "cache": cache},
+                    tok[:, None], decode=True, mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits[:, -1], axis=-1)
+                return (vs["cache"], nxt), nxt
+
+            (d_cache, _), drafts = jax.lax.scan(
+                draft_step, (d_cache, cur), None, length=k
+            )
+            drafts = drafts.transpose(1, 0)  # [1, k]
+            # The scan feeds cur..d_{k-2} (k inputs); d_{k-1}'s K/V is
+            # still missing, and on full acceptance the rewind point
+            # n+k+1 requires it. One extra (cheap) draft step writes it;
+            # the logits are discarded.
+            _, d_vs = draft.apply(
+                {"params": draft_params, "cache": d_cache},
+                drafts[:, k - 1:], decode=True, mutable=["cache"],
+            )
+            d_cache = d_vs["cache"]
+
+            # 2. Target verifies all k+1 positions in one forward.
+            t_in = jnp.concatenate([cur[:, None], drafts], axis=1)
+            t_logits, t_vs = target.apply(
+                {"params": target_params, "cache": t_cache},
+                t_in, decode=True, mutable=["cache"],
+            )
+            preds = jnp.argmax(t_logits, axis=-1)  # [1, k+1] = P_0..P_k
+
+            # 3. Acceptance: longest prefix with d_j == P_j.
+            match = drafts[0] == preds[0, :k]
+            a = jnp.argmin(
+                jnp.concatenate(
+                    [match, jnp.zeros((1,), bool)]
+                ).astype(jnp.int32)
+            )
+            n_emit = a + 1  # P_0..P_a
+
+            # 4. Emit and rewind both caches to n + a + 1.
+            out = jax.lax.dynamic_update_slice(
+                out, preds.astype(jnp.int32), (0, emitted)
+            )
+            new_index = n + n_emit
+            t_cache = _rewind_cache(t_vs["cache"], new_index)
+            d_cache = _rewind_cache(d_cache, new_index)
+            last = preds[:, a]
+            return (
+                t_cache, d_cache, last, new_index,
+                emitted + n_emit, out, hist.at[a].add(1),
+            )
+
+        def cond(state):
+            return state[4] < max_new_tokens
+
+        final = jax.lax.while_loop(cond, round_, state0)
+        tokens = final[5][:, :max_new_tokens]
+        if return_stats:
+            return tokens, {"acceptance_hist": final[6]}
+        return tokens
+
+    return generate
