@@ -208,6 +208,32 @@ class TestPoolNode:
         assert not pool.update_geometry_for({"2x2x2": 1})
         assert not pool.provides_profiles({"2x2x2": 1})
 
+    def test_stranded_share_not_promised(self):
+        # Snapshot between planning and actuation: host 0 still reports
+        # a free pool share but host 1's mate is gone (used by a
+        # host-local slice). The share is stranded — no complete
+        # instance backs it — so provides_profiles must not promise it
+        # and add_pod must refuse rather than place half a gang
+        # (ADVICE r3: _free_shares counted it, selection couldn't
+        # take it, and the pod was silently marked satisfied).
+        import pytest
+
+        from walkai_nos_tpu.tpu.errors import GenericError
+
+        pool = self._pool(
+            {
+                0: {
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2x2-free": "1"
+                },
+                1: {
+                    f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1x2-used": "1"
+                },
+            }
+        )
+        assert not pool.provides_profiles({"2x2x2": 1})
+        with pytest.raises(GenericError):
+            pool.add_pod({"2x2x2": 1})
+
     def test_free_hosts_reassigned_from_local_tilings(self):
         # Both hosts fully host-locally tiled but free: a pending pool
         # slice reclaims them (the VERDICT "re-tiles for a pending

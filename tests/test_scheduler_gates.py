@@ -307,6 +307,21 @@ class TestDisruptionBudget:
         )
         assert allowed
 
+    @pytest.mark.parametrize("bound", ["abc%", "1.5", [1], 1.9, -1, "-50%"])
+    def test_malformed_bound_fails_closed(self, bound):
+        """A bound the real API server would reject at admission must
+        not crash eviction evaluation; it blocks (fail closed), the way
+        an unevaluable budget should."""
+        pods = self._pods(3)
+        allowed, reason = eviction_allowed(
+            pods[0], [self._pdb(min_available=bound)], pods
+        )
+        assert not allowed and "malformed" in reason
+        allowed, reason = eviction_allowed(
+            pods[0], [self._pdb(max_unavailable=bound)], pods
+        )
+        assert not allowed and "malformed" in reason
+
     def test_fake_client_enforces_and_records_grace(self):
         kube = FakeKubeClient()
         for pod in self._pods(2):
@@ -655,6 +670,62 @@ class TestSchedulerGatesE2E:
                 objects.name(p) for p in kube.list("Pod", namespace="team-b")
             }
             assert "b-2" in remaining  # the protected newest survived
+            assert "b-1" not in remaining  # the alternative was evicted
+
+    def test_preemption_survives_api_refused_eviction(self):
+        """An eviction the API server refuses for a non-budget reason
+        (403 from missing pods/eviction RBAC, admission webhook, ...)
+        must not abort the reconcile: the scheduler skips that victim
+        and re-selects, exactly as for a budget block (ADVICE r3)."""
+        from walkai_nos_tpu.kube.client import ApiError
+
+        kube = FakeKubeClient()
+        kube.create("Node", _node("host-a", tpu=12))
+        kube.create("ElasticQuota", _quota("qa", "team-a", 4), "team-a")
+        kube.create("ElasticQuota", _quota("qb", "team-b", 4), "team-b")
+        kube.create("ElasticQuota", _quota("qc", "team-c", 4), "team-c")
+        real_evict = kube.evict_pod
+
+        def evict(name, namespace, grace_period_seconds=None):
+            if name == "b-2":
+                raise ApiError(403, "pods/eviction is forbidden")
+            return real_evict(name, namespace, grace_period_seconds)
+
+        kube.evict_pod = evict
+        with build_manager(kube):
+            for i in range(3):
+                kube.create(
+                    "Pod",
+                    _pod(f"b-{i}", "team-b", 4, phase="Pending",
+                         scheduler="walkai-nos-scheduler", node="",
+                         created=f"2026-01-01T00:0{i}:00Z"),
+                )
+            _eventually(
+                lambda: all(
+                    kube.get("Pod", f"b-{i}", "team-b")["spec"].get("nodeName")
+                    for i in range(3)
+                ),
+                msg="team-b fills the host (two borrowing)",
+            )
+            for i in range(3):
+                kube.patch("Pod", f"b-{i}",
+                           {"status": {"phase": "Running"}}, "team-b")
+            kube.create(
+                "Pod",
+                _pod("a-0", "team-a", 4, phase="Pending",
+                     scheduler="walkai-nos-scheduler", node="",
+                     created="2026-01-02T00:00:00Z"),
+            )
+            _eventually(
+                lambda: kube.get("Pod", "a-0", "team-a")["spec"].get(
+                    "nodeName") == "host-a",
+                msg="claimant binds via the next victim after the 403",
+                timeout=15.0,
+            )
+            remaining = {
+                objects.name(p) for p in kube.list("Pod", namespace="team-b")
+            }
+            assert "b-2" in remaining  # the 403'd victim survived
             assert "b-1" not in remaining  # the alternative was evicted
 
     def test_preemption_grants_victim_grace_period(self):

@@ -22,7 +22,12 @@ import sys
 
 from walkai_nos_tpu.cmd import _common
 from walkai_nos_tpu.kube import objects
-from walkai_nos_tpu.kube.client import EvictionBlocked, KubeClient, NotFound
+from walkai_nos_tpu.kube.client import (
+    ApiError,
+    EvictionBlocked,
+    KubeClient,
+    NotFound,
+)
 from walkai_nos_tpu.kube.runtime import Controller, Manager, Request, Result
 from walkai_nos_tpu.quota.fit import (
     fits_node,
@@ -181,6 +186,18 @@ class Scheduler:
                 except NotFound:
                     evicted += 1  # already gone: capacity freed anyway
                     evicted_this_round += 1
+                except ApiError as e:
+                    # An eviction the API server refuses for any other
+                    # reason (403 from missing pods/eviction RBAC, 500,
+                    # admission webhook...) must not abort the whole
+                    # reconcile: skip this victim and let re-selection
+                    # find an alternative, as for a budget block.
+                    logger.warning(
+                        "evicting %s/%s failed (%s), skipped",
+                        ns, objects.name(victim), e,
+                    )
+                    excluded.add((ns, objects.name(victim)))
+                    blocked_this_round += 1
             if blocked_this_round == 0:
                 return evicted
             if evicted_this_round > 0:

@@ -19,15 +19,24 @@ from typing import Mapping
 from walkai_nos_tpu.kube import objects
 
 
-def _parse_maybe_percent(value, total: int) -> int:
+def _parse_maybe_percent(value, total: int) -> int | None:
     """An IntOrString PDB bound: ints pass through, "50%" rounds the way
     the disruption controller does (minAvailable up, handled by caller
     symmetry — we round half away from the budget, i.e. up, which is the
-    conservative direction for minAvailable and matches k8s for it)."""
-    if isinstance(value, str) and value.endswith("%"):
-        pct = int(value[:-1])
-        return -(-pct * total // 100)  # ceil
-    return int(value)
+    conservative direction for minAvailable and matches k8s for it).
+    A bound the real API server would have rejected at admission
+    ("abc%", a float, a negative) returns None; callers fail closed."""
+    try:
+        if isinstance(value, str) and value.endswith("%"):
+            pct = int(value[:-1])
+            out = -(-pct * total // 100)  # ceil
+        elif isinstance(value, (bool, float)):
+            return None  # IntOrString admits neither; int() would mangle
+        else:
+            out = int(value)
+    except (ValueError, TypeError):
+        return None
+    return out if out >= 0 else None
 
 
 def _pod_is_healthy(pod: Mapping) -> bool:
@@ -74,6 +83,11 @@ def eviction_allowed(
             min_available = _parse_maybe_percent(
                 spec["minAvailable"], len(matching)
             )
+            if min_available is None:
+                return False, (
+                    f"pdb {objects.name(pdb)}: malformed minAvailable "
+                    f"{spec['minAvailable']!r}, failing closed"
+                )
             if healthy - delta < min_available:
                 return False, (
                     f"pdb {objects.name(pdb)}: eviction would leave "
@@ -84,6 +98,11 @@ def eviction_allowed(
             max_unavailable = _parse_maybe_percent(
                 spec["maxUnavailable"], len(matching)
             )
+            if max_unavailable is None:
+                return False, (
+                    f"pdb {objects.name(pdb)}: malformed maxUnavailable "
+                    f"{spec['maxUnavailable']!r}, failing closed"
+                )
             unavailable = len(matching) - healthy
             if unavailable + delta > max_unavailable:
                 return False, (

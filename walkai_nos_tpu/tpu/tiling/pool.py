@@ -311,12 +311,13 @@ class PoolNode:
 
 
     def _free_shares(self, profile: str) -> int:
-        """Free shares of a pool profile. Stranded shares are re-tiled
-        away at planning time (_drop_stranded_shares), so every free
-        share is backed by a complete instance."""
-        return sum(
-            1 for h in self.hosts if h.mesh.free_count(profile) > 0
-        )
+        """Free shares of a pool profile that selection can actually
+        take: only shares backed by a complete contiguous instance
+        block. Stranded shares (retile written but not yet actuated)
+        exist on snapshots between planning and reporting; counting
+        them here would promise capacity `_select_share_hosts` then
+        silently fails to claim."""
+        return len(self._selectable_shares(profile))
 
     # ---------------------------------------------------------------- search
 
@@ -431,25 +432,27 @@ class PoolNode:
                     candidates.difference_update(cells)
         return free_coords, kept, protected, by_coord, blocks
 
-    def _select_share_hosts(
-        self, profile: str, count: int
-    ) -> list[PoolHost]:
-        """The first `count` free shares in instance-coherent order:
-        open (partially-used) instances fill before a whole free
-        instance opens, and shares of one instance are taken together —
-        the ONE selection order shared by simulated placement and
-        availability earmarking, so the two can never disagree."""
+    def _selectable_shares(self, profile: str) -> list[PoolHost]:
+        """All takeable free shares of a pool profile, in the ONE
+        instance-coherent selection order: open (partially-used)
+        instances fill before a whole free instance opens, and shares
+        of one instance stay together. Counting (`_free_shares`) and
+        selection (`_select_share_hosts`) both derive from this list,
+        so the two can never disagree."""
         _free, _kept, _prot, by_coord, blocks = self._group_instances(
             profile
         )
-        out: list[PoolHost] = []
-        for cells in blocks:
-            for c in cells:
-                if c in by_coord and len(out) < count:
-                    out.append(by_coord[c])
-            if len(out) >= count:
-                break
-        return out
+        return [
+            by_coord[c] for cells in blocks for c in cells if c in by_coord
+        ]
+
+    def _select_share_hosts(
+        self, profile: str, count: int
+    ) -> list[PoolHost]:
+        """The first `count` free shares in instance-coherent order —
+        the order shared by simulated placement and availability
+        earmarking (`_subtract_available`)."""
+        return self._selectable_shares(profile)[:count]
 
     def _protected_free_hosts(self) -> set[str]:
         """Names of hosts whose free pool share is instance-mate to a
@@ -551,7 +554,14 @@ class PoolNode:
             # instance-coherent order: open instances complete before a
             # fresh one opens, and a gang's shares stay within blocks —
             # never one share in each of two instances.
-            for h in self._select_share_hosts(p, remaining.pop(p)):
+            want = remaining.pop(p)
+            hosts = self._select_share_hosts(p, want)
+            if len(hosts) < want:
+                raise GenericError(
+                    f"pool {self.name}: selected {len(hosts)}/{want} "
+                    f"shares of {p} — free shares not instance-backed"
+                )
+            for h in hosts:
                 h.mesh.add_pod(p)
         for h in self.hosts:
             if self._holds_pool_share(h):
