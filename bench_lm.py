@@ -43,13 +43,40 @@ def _fence(x) -> None:
 
 
 def measure_decode(
-    *, batch: int = 8, prompt_len: int = 32, new_tokens: int = 128,
+    *, batch: int = 128, prompt_len: int = 32, new_tokens: int = 128,
+    pipeline: int = 4, compare_batch: int | None = 8,
 ) -> dict:
-    """Decode throughput + its HBM roofline ceiling, as a flat dict."""
+    """Decode throughput + its HBM roofline ceiling, as a flat dict.
+
+    Round-4 methodology (closing VERDICT r3 weak #3, which measured
+    31.4% of ceiling at batch 8):
+
+    - **Weights are served in bf16.** Flax init stores f32; a server
+      casts once at load time, halving the per-step weight traffic.
+      The ceiling uses the bytes of the params actually passed.
+    - **Serving batch (128), not probe batch (8).** The step is
+      memory-bound, so per-token cost falls almost linearly with
+      batch until KV traffic dominates; 8 measured dispatch latency,
+      not the chip. `compare_batch` keeps the old point reported for
+      round-over-round continuity.
+    - **Sustained (pipelined) throughput is the headline.** On the
+      tunneled dev runtime each generate() call pays ~80-100 ms of
+      dispatch+fence round trips — at batch 8 x 128 tokens that was
+      ~70% of the measured time. Issuing `pipeline` calls back to
+      back and fencing once overlaps that overhead exactly the way
+      the serving dispatcher overlaps requests; the per-call fenced
+      latency is still reported (`decode_call_latency_s`).
+
+    The ceiling itself is unchanged from round 3: analytic bytes
+    (full weight re-read + the LENGTH-BUCKETED KV cache the generate
+    fn actually allocates) over published HBM bandwidth. XLA cost
+    analysis stays unusable here — it counts a lax.scan body once,
+    not times its length.
+    """
     import jax
     import jax.numpy as jnp
 
-    from walkai_nos_tpu.models.decode import make_generate_fn
+    from walkai_nos_tpu.models.decode import cache_bucket, make_generate_fn
     from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
     from walkai_nos_tpu.utils.flops import hbm_bytes_per_s
 
@@ -59,66 +86,71 @@ def measure_decode(
         max_seq_len=1024, dtype="bfloat16",
     )
     model = DecoderLM(cfg)
-    params = model.init_params(jax.random.PRNGKey(0))
+    params = jax.tree.map(
+        lambda p: p.astype(jnp.bfloat16),
+        model.init_params(jax.random.PRNGKey(0)),
+    )
     n_params = sum(
         int(np.prod(p.shape))
         for p in jax.tree_util.tree_leaves(params)
     )
-
-    gen = make_generate_fn(cfg)
-    rng = np.random.default_rng(0)
-    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt_len)))
-
-    # Roofline ceiling, analytic: every decode step re-reads the full
-    # weights from HBM plus the KV cache. XLA cost analysis is NOT
-    # usable here — it counts a lax.scan body once, not times its
-    # length, so it underestimates decode traffic by ~the step count.
-    # The cache term uses the LENGTH-BUCKETED cache the generate fn
-    # actually allocates (`decode.cache_bucket` — dense masked
-    # attention reads the whole padded cache every step, so that IS the
-    # program's traffic; bucketing the cache to the generation is what
-    # keeps it proportional instead of the model's full context).
-    from walkai_nos_tpu.models.decode import cache_bucket
-
-    ceiling_tok_s = None
-    bytes_per_step = None
     param_bytes = sum(
         leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
     )
+
+    gen = make_generate_fn(cfg)
+    rng = np.random.default_rng(0)
     kv_dim = cfg.num_heads * (cfg.hidden_dim // cfg.num_heads)
     cache_dtype_bytes = 2 if "bfloat16" in str(cfg.dtype) else 4
     cache_len = cache_bucket(prompt_len + new_tokens, cfg.max_seq_len)
+    bw = hbm_bytes_per_s(device.device_kind)
+
+    def run(b: int) -> tuple[float, float]:
+        """(sustained tokens/s, fenced per-call seconds) at batch b."""
+        prompt = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (b, prompt_len))
+        )
+        _fence(gen(params, prompt, max_new_tokens=new_tokens))  # compile
+        t0 = time.perf_counter()
+        _fence(gen(params, prompt, max_new_tokens=new_tokens))
+        call_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        outs = [
+            gen(params, prompt, max_new_tokens=new_tokens)
+            for _ in range(pipeline)
+        ]
+        _fence(outs[-1])
+        sustained_s = (time.perf_counter() - t0) / pipeline
+        return b * new_tokens / sustained_s, call_s
+
+    tok_s, call_s = run(batch)
     kv_bytes = (
         cfg.num_layers * 2 * batch * cache_len * kv_dim
         * cache_dtype_bytes
     )
-    bw = hbm_bytes_per_s(device.device_kind)
-    if bw:
-        bytes_per_step = float(param_bytes + kv_bytes)
-        ceiling_tok_s = batch / (bytes_per_step / bw)
-
-    out = gen(params, prompt, max_new_tokens=new_tokens)  # compile
-    _fence(out)
-    reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = gen(params, prompt, max_new_tokens=new_tokens)
-        _fence(out)
-    decode_s = (time.perf_counter() - t0) / reps
-    tok_s = batch * new_tokens / decode_s
-
     result = {
         "decode_tokens_per_s": round(tok_s, 1),
-        "decode_step_ms": round(decode_s / new_tokens * 1e3, 3),
+        "decode_step_ms": round(1e3 * batch / tok_s, 4),
+        "decode_call_latency_s": round(call_s, 4),
+        "decode_pipeline": pipeline,
         "decode_batch": batch,
         "decode_prompt_len": prompt_len,
         "decode_new_tokens": new_tokens,
         "decode_n_params": n_params,
+        "decode_params_dtype": "bfloat16",
     }
-    if ceiling_tok_s:
+    if bw:
+        bytes_per_step = float(param_bytes + kv_bytes)
+        ceiling_tok_s = batch / (bytes_per_step / bw)
         result["decode_ceiling_tokens_per_s"] = round(ceiling_tok_s, 1)
         result["decode_hbm_bytes_per_step"] = bytes_per_step
         result["vs_decode_ceiling"] = round(tok_s / ceiling_tok_s, 4)
+    if compare_batch:
+        cmp_tok_s, cmp_call_s = run(compare_batch)
+        result[f"decode_b{compare_batch}_tokens_per_s"] = round(cmp_tok_s, 1)
+        result[f"decode_b{compare_batch}_call_latency_s"] = round(
+            cmp_call_s, 4
+        )
     return result
 
 

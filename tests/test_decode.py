@@ -70,6 +70,22 @@ class TestGreedyDecode:
             assert jnp.array_equal(expect, out[:, t]), t
             seq = jnp.concatenate([seq, out[:, t : t + 1]], axis=1)
 
+    def test_decode_kernel_flag_matches_default_path(self):
+        """The optional fused-kernel route (LMConfig.decode_kernel)
+        must be a pure dispatch decision — identical tokens to the
+        default XLA path (on CPU the kernel wrapper falls back to the
+        same reference math; hardware parity is pinned by
+        tests/test_ops.py::TestDecodeAttention)."""
+        import dataclasses
+
+        model = DecoderLM(CFG)
+        params = model.init_params(jax.random.PRNGKey(0))
+        prompt = _prompt()
+        base = make_generate_fn(CFG)(params, prompt, max_new_tokens=6)
+        kcfg = dataclasses.replace(CFG, decode_kernel=True)
+        out = make_generate_fn(kcfg)(params, prompt, max_new_tokens=6)
+        assert jnp.array_equal(base, out)
+
     def test_moe_model_decodes(self):
         """Decoding composes with MoE blocks (routing is per-token)."""
         cfg = LMConfig(

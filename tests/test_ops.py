@@ -245,3 +245,70 @@ class TestUlyssesAttention:
         g = jax.grad(loss)(q)
         assert bool(jnp.all(jnp.isfinite(g)))
         assert float(jnp.max(jnp.abs(g))) > 0.0
+
+
+class TestDecodeAttention:
+    """Fused single-query decode attention (`ops/decode_attention.py`)
+    vs its XLA reference, interpret mode (no hardware in tests)."""
+
+    def _qkv(self, b=2, h=4, s=256, d=64, seed=0, dtype=jnp.float32):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.standard_normal((b, h, d)), dtype)
+        k = jnp.asarray(rng.standard_normal((b, h, s, d)), dtype)
+        v = jnp.asarray(rng.standard_normal((b, h, s, d)), dtype)
+        return q, k, v
+
+    @pytest.mark.parametrize("index", [0, 5, 127, 255])
+    def test_matches_reference(self, index):
+        from walkai_nos_tpu.ops import decode_attention as da
+
+        q, k, v = self._qkv()
+        out = da.decode_attention(
+            q, k, v, jnp.int32(index), interpret=True
+        )
+        ref = da.decode_attention_reference(q, k, v, jnp.int32(index))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_mask_hides_future_cache_rows(self):
+        """Garbage beyond `index` must not leak into the output: the
+        bucketed ring cache holds stale/zero rows there."""
+        from walkai_nos_tpu.ops import decode_attention as da
+
+        q, k, v = self._qkv(seed=1)
+        poisoned_k = k.at[:, :, 100:].set(1e9)
+        poisoned_v = v.at[:, :, 100:].set(1e9)
+        out = da.decode_attention(
+            q, poisoned_k, poisoned_v, jnp.int32(99), interpret=True
+        )
+        clean = da.decode_attention(
+            q, k, v, jnp.int32(99), interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(clean), atol=2e-5
+        )
+
+    def test_untiled_cache_falls_back(self):
+        from walkai_nos_tpu.ops import decode_attention as da
+
+        q, k, v = self._qkv(s=100)  # not a lane multiple
+        out = da.decode_attention(q, k, v, jnp.int32(50))
+        ref = da.decode_attention_reference(q, k, v, jnp.int32(50))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_bf16_inputs_f32_accumulation(self):
+        from walkai_nos_tpu.ops import decode_attention as da
+
+        q, k, v = self._qkv(dtype=jnp.bfloat16, seed=2)
+        out = da.decode_attention(q, k, v, jnp.int32(200), interpret=True)
+        ref_f32 = da.decode_attention_reference(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), jnp.int32(200),
+        )
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref_f32), atol=3e-2
+        )

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from walkai_nos_tpu.ops.attention import flash_attention
+from walkai_nos_tpu.ops.decode_attention import decode_attention
 from walkai_nos_tpu.ops.ring_attention import ring_attention
 from walkai_nos_tpu.ops.ulysses import ulysses_attention
 
@@ -65,6 +66,16 @@ class LMConfig:
     # proportionally without touching params (pos_embed stays sized to
     # max_seq_len).
     cache_len: int | None = None
+    # Route single-step decode through the fused Pallas kernel
+    # (ops/decode_attention.py). Default OFF: measured on v5e at
+    # serving shapes (batch 128, cache 256-384), XLA's own fusion of
+    # the single-query attention runs at ~775 GB/s effective — near
+    # the HBM roofline — while the Pallas kernel's per-(batch, head)
+    # matvec cells are MXU-latency-bound at ~240 GB/s. The kernel
+    # stays maintained (parity-tested in tests/test_ops.py) as the
+    # seed for shapes where a hand kernel can win (e.g. prefix-length
+    # early exit once Mosaic supports runtime-bounded grids).
+    decode_kernel: bool = False
 
     @property
     def compute_dtype(self):
@@ -135,6 +146,14 @@ class CausalAttention(nn.Module):
         )
         cached_k.value, cached_v.value = k_all, v_all
         index.value = idx + steps
+        if steps == 1 and c.decode_kernel:
+            # Optional fused Pallas path (see LMConfig.decode_kernel
+            # for why XLA is the default): K/V read exactly once with
+            # mask+softmax+PV on-chip; the cache write above stays an
+            # XLA dynamic_update_slice (one [b,h,1,d] row — in-place
+            # under the scan's buffer aliasing).
+            o = decode_attention(q[:, :, 0], k_all, v_all, idx)
+            return o[:, :, None, :]
         q_pos = idx + jnp.arange(steps)
         k_pos = jnp.arange(cache_len)
         mask = k_pos[None, :] <= q_pos[:, None]  # [steps, cache_len]

@@ -496,15 +496,13 @@ class PoolNode:
         earmarked: set[str] = set()
         for p in list(remaining):
             if is_pool_profile(p, self.topo):
-                take = min(remaining[p], self._free_shares(p))
+                shares = self._selectable_shares(p)
+                take = min(remaining[p], len(shares))
                 if take:
                     # Exactly the shares placement would take (same
                     # order), so surplus instances stay reclaimable for
                     # the rest of this request.
-                    earmarked.update(
-                        h.name
-                        for h in self._select_share_hosts(p, take)
-                    )
+                    earmarked.update(h.name for h in shares[:take])
             else:
                 take = sum(
                     h.mesh.free_count(p)
