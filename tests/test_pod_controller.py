@@ -257,6 +257,72 @@ class TestBatchReconcile:
         finally:
             batching.stop()
 
+    def test_drain_mode_plans_without_idle_wait(self):
+        """idle == 0 (the production default): the worker plans the
+        moment it is free — a lone pod must not wait for any window.
+        The generous assertion bound is scheduling noise, not a window:
+        the old idle-window default (0.2 s) made this take >= 0.2 s."""
+        kube = FakeKubeClient()
+        kube.create("Node", tiling_node("n1"))
+        ctrl = self._controller(kube)
+        batching = BatchingPodReconciler(ctrl, timeout=5.0, idle=0.0)
+        batching.start()
+        try:
+            kube.create("Pod", pending_slice_pod("p1", "2x2"))
+            t0 = time.monotonic()
+            batching.reconcile(Request(name="p1", namespace="default"))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if spec_of(kube, "n1"):
+                    break
+                time.sleep(0.002)
+            planned_after = time.monotonic() - t0
+            assert spec_of(kube, "n1").get((0, "2x2"), 0) >= 1
+            assert planned_after < 0.15, planned_after
+        finally:
+            batching.stop()
+
+    def test_drain_mode_coalesces_queued_requests(self):
+        """Requests that queue while the planner is busy land in ONE
+        reconcile_batch call (the natural coalescing that replaces the
+        idle window)."""
+        import threading
+
+        kube = FakeKubeClient()
+        kube.create("Node", tiling_node("n1"))
+        ctrl = self._controller(kube)
+        batches: list[int] = []
+        release = threading.Event()
+        orig = ctrl.reconcile_batch
+
+        def slow_batch(requests):
+            batches.append(len(requests))
+            if len(batches) == 1:
+                release.wait(timeout=5.0)
+            orig(requests)
+
+        ctrl.reconcile_batch = slow_batch
+        batching = BatchingPodReconciler(ctrl, timeout=5.0, idle=0.0)
+        batching.start()
+        try:
+            for name in ("p1", "p2", "p3"):
+                kube.create("Pod", pending_slice_pod(name, "1x1"))
+            batching.reconcile(Request(name="p1", namespace="default"))
+            deadline = time.monotonic() + 2.0
+            while not batches and time.monotonic() < deadline:
+                time.sleep(0.002)
+            # Planner is now blocked inside batch 1; these two queue up.
+            batching.reconcile(Request(name="p2", namespace="default"))
+            batching.reconcile(Request(name="p3", namespace="default"))
+            release.set()
+            deadline = time.monotonic() + 5.0
+            while len(batches) < 2 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert batches[0] == 1
+            assert batches[1] == 2  # coalesced into one batch
+        finally:
+            batching.stop()
+
     def test_restart_after_stop(self):
         # Leader-election cycles stop and restart the manager; the batch
         # worker must come back with it.
