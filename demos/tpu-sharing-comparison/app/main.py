@@ -227,8 +227,15 @@ def main() -> None:
         if os.environ.get("WALKAI_DEMO_MODEL") == "tiny"
         else VIT_SMALL
     )
+    # Serving precision policy: weights are cast to bf16 ONCE at load
+    # (training keeps f32 masters). The forward computes in bf16 with
+    # f32 accumulation either way; f32 weights would double the
+    # per-batch weight traffic and add a cast pass per dispatch.
     params = jax.device_put(
-        ViTDetector(cfg).init_params(jax.random.PRNGKey(0))
+        jax.tree.map(
+            lambda p: p.astype(jnp.bfloat16),
+            ViTDetector(cfg).init_params(jax.random.PRNGKey(0)),
+        )
     )
     infer = make_infer_step(cfg)
     max_batch = int(os.environ.get("WALKAI_MAX_BATCH", "32"))
@@ -241,8 +248,10 @@ def main() -> None:
 
     def images_of(batch: int):
         if batch not in inputs:
+            # bf16 inputs: the model's first act is the cast anyway;
+            # staging f32 would double the input read per dispatch.
             inputs[batch] = jnp.zeros(
-                (batch, cfg.image_size, cfg.image_size, 3), jnp.float32
+                (batch, cfg.image_size, cfg.image_size, 3), jnp.bfloat16
             )
         return inputs[batch]
 

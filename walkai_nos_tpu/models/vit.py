@@ -8,7 +8,8 @@ and MLP heads predicting class logits + boxes per detection token
 (YOLOS, Fang et al. 2021). Design choices for the MXU/HBM:
 
 - all matmuls in bfloat16 with f32 accumulation (`preferred_element_type`),
-  params kept f32;
+  params kept f32 for training (the demo server casts them to bf16 once
+  at load — serving precision policy);
 - attention via the fused Pallas kernel (`walkai_nos_tpu/ops/attention.py`)
   on TPU, XLA reference elsewhere;
 - module/param names line up with the tensor-parallel rules in
@@ -91,11 +92,18 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = x + Attention(self.cfg, name="attn")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
+        # LayerNorms run in the compute dtype: flax computes the
+        # mean/var statistics in f32 internally either way, so a
+        # dtype=f32 norm here would only widen the OUTPUT — bouncing
+        # the whole residual stream bf16->f32->bf16 at every block
+        # (measured ~2x activation bytes/image on the serving path)
+        # for no extra statistical precision.
+        c = self.cfg
+        x = x + Attention(c, name="attn")(
+            nn.LayerNorm(dtype=c.compute_dtype, name="norm1")(x)
         )
-        x = x + Mlp(self.cfg, name="mlp")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
+        x = x + Mlp(c, name="mlp")(
+            nn.LayerNorm(dtype=c.compute_dtype, name="norm2")(x)
         )
         return x
 
@@ -140,7 +148,7 @@ class ViTDetector(nn.Module):
         )
         for i in range(c.num_layers):
             x = block_cls(c, name=f"block{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
+        x = nn.LayerNorm(dtype=c.compute_dtype, name="norm")(x)
 
         tokens = x[:, -c.num_det_tokens:, :]
         logits = nn.Dense(c.num_classes, dtype=jnp.float32,

@@ -67,12 +67,15 @@ def attention_reference(
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  causal: bool, seq_k: int, block_q: int, seq_q: int):
+                  causal: bool, seq_k: int, block_q: int, seq_q: int,
+                  kv_len: int):
     """One (batch*head, q-block) grid cell: scan K/V blocks with online
     softmax. Refs are [block_q, d] for q/o and [seq_k, d] for k/v;
     lse_ref is [1, block_q] — the per-row logsumexp the fused backward
     needs (saving it costs O(seq); recomputing it would cost another
-    full pass)."""
+    full pass). `kv_len < seq_k` masks the K/V tail (the zero rows a
+    padded-to-tile dispatch appends, `flash_attention`'s untiled-seq
+    path) out of the softmax."""
     q = q_ref[...].astype(jnp.float32)
     scale = q.shape[-1] ** -0.5
     q = q * scale
@@ -95,6 +98,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                 s, _NEG_INF, q_blk * block_q, i * block_k,
                 (q.shape[0], block_k), offset,
             )
+        if kv_len < seq_k:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], block_k), 1
+            )
+            s = jnp.where(k_pos < kv_len, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -106,7 +114,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         )
         return acc, m_new, l_new
 
-    num_k_blocks = seq_k // block_k
+    # Fully-masked K blocks (entirely past kv_len) are skipped, not
+    # just masked: the DMA still lands (the BlockSpec stages all of
+    # K/V) but no MXU work is spent on them.
+    num_k_blocks = -(-kv_len // block_k)
     if causal:
         last = _last_visible_k_block(
             q_blk, block_q, offset, block_k, num_k_blocks
@@ -122,28 +133,42 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[...] = (m + jnp.log(l))[None, :]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_pallas(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_pallas(q, k, v, causal, block_q, block_k, interpret, kv_len):
     """Differentiable wrapper: fused Pallas forward AND backward.
     Pallas kernels aren't auto-differentiable (grad tracing dies in the
     grid context), so the VJP is hand-written: the standard
     FlashAttention backward with block-recompute — P is rebuilt per
     (q-block, k-block) tile from the saved logsumexp, so the S x S
     matrix never materializes in either pass and backward memory stays
-    O(block), which is what makes long-sequence LM training fit."""
-    out, _lse = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    O(block), which is what makes long-sequence LM training fit.
+
+    `kv_len < sk` contract: rows [kv_len:] of k and v MUST be zero
+    (the padded dispatch guarantees it). The forward masks them out of
+    the softmax; the backward kernels mask the tail's recomputed p too
+    — algebraically its gradients are killed by k=0/v=0 or land in
+    dk/dv rows the caller slices away, but exp(0 - lse) overflows to
+    inf for rows with lse < ~-88 and inf * 0 would NaN the row."""
+    out, _lse = _flash_pallas_impl(
+        q, k, v, causal, block_q, block_k, interpret, kv_len
+    )
     return out
 
 
-def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    out, lse = _flash_pallas_impl(
+        q, k, v, causal, block_q, block_k, interpret, kv_len
+    )
     return out, (q, k, v, out, lse)
 
 
-def _flash_pallas_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_pallas_bwd(
+    causal, block_q, block_k, interpret, kv_len, residuals, g
+):
     q, k, v, out, lse = residuals
     return _flash_bwd_impl(
-        q, k, v, out, lse, g, causal, block_q, block_k, interpret
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret,
+        kv_len=kv_len,
     )
 
 
@@ -160,11 +185,15 @@ def flash_attention_with_lse(q, k, v, causal, block_q, block_k, interpret):
 
     Callers are responsible for shape/tiling checks (`flash_attention`
     does them for the public path)."""
-    return _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_pallas_impl(
+        q, k, v, causal, block_q, block_k, interpret, k.shape[2]
+    )
 
 
 def _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret)
+    out, lse = _flash_pallas_impl(
+        q, k, v, causal, block_q, block_k, interpret, k.shape[2]
+    )
     return (out, lse), (q, k, v, out, lse)
 
 
@@ -182,10 +211,13 @@ flash_attention_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, *,
                      block_k: int, causal: bool, seq_k: int, block_q: int,
-                     seq_q: int):
+                     seq_q: int, kv_len: int):
     """dQ for one (batch*head, q-block) cell: rescan K/V tiles, rebuild
     P = exp(S - lse) per tile, dS = P*(g V^T - D), dq += dS K * scale.
-    Nothing bigger than [block_q, block_k] lives at once."""
+    Nothing bigger than [block_q, block_k] lives at once. The padded
+    K/V tail (kv_len < seq_k) is masked out of P: its zero rows kill
+    the dq contribution algebraically, but the recomputed
+    exp(0 - lse) overflows to inf when lse < ~-88 and inf * 0 = NaN."""
     q = q_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
     lse = lse_ref[0, :]
@@ -207,6 +239,11 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, *,
                 p, 0.0, q_blk * block_q, i * block_k,
                 (q.shape[0], block_k), offset,
             )
+        if kv_len < seq_k:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], block_k), 1
+            )
+            p = jnp.where(k_pos < kv_len, p, 0.0)
         dp = jax.lax.dot_general(
             g, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -217,7 +254,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, *,
             preferred_element_type=jnp.float32,
         )
 
-    num_k_blocks = seq_k // block_k
+    num_k_blocks = -(-kv_len // block_k)  # skip fully-masked tail blocks
     if causal:
         last = _last_visible_k_block(
             q_blk, block_q, offset, block_k, num_k_blocks
@@ -231,9 +268,11 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dq_ref, *,
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dk_ref,
                       dv_ref, *, block_q: int, causal: bool, seq_q: int,
-                      block_k: int, seq_k: int):
+                      block_k: int, seq_k: int, kv_len: int):
     """dK/dV for one (batch*head, k-block) cell: scan Q tiles, rebuild P
-    per tile, dv += P^T g, dk += dS^T q * scale."""
+    per tile, dv += P^T g, dk += dS^T q * scale. P over the padded K/V
+    tail is masked for the same inf-overflow reason as the dq kernel
+    (its dk/dv rows are sliced away, but inf * 0 inside ds would NaN)."""
     k = k_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
     scale = q_ref.shape[-1] ** -0.5
@@ -256,6 +295,11 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dk_ref,
                 p, 0.0, i * block_q, k_blk * block_k,
                 (block_q, k.shape[0]), offset,
             )
+        if kv_len < seq_k:
+            k_pos = k_blk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, k.shape[0]), 1
+            )
+            p = jnp.where(k_pos < kv_len, p, 0.0)
         dv = dv + jax.lax.dot_general(
             p, g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -286,9 +330,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, d_ref, dk_ref,
 
 
 def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
-                    interpret, g_lse=None):
+                    interpret, kv_len=None, g_lse=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    kv_len = sk if kv_len is None else kv_len
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
@@ -308,7 +353,7 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _flash_dq_kernel, block_k=block_k, causal=causal, seq_k=sk,
-            block_q=block_q, seq_q=sq,
+            block_q=block_q, seq_q=sq, kv_len=kv_len,
         ),
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -327,7 +372,7 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_dkv_kernel, block_q=block_q, causal=causal, seq_q=sq,
-            block_k=block_k, seq_k=sk,
+            block_k=block_k, seq_k=sk, kv_len=kv_len,
         ),
         grid=(b * h, sk // block_k),
         in_specs=[
@@ -391,15 +436,29 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused attention. Shapes: [batch, heads, seq, head_dim].
 
-    Uses the Pallas kernel on TPU (or in interpret mode when forced); falls
-    back to the XLA reference when the sequence doesn't tile or the backend
-    is not TPU.
+    Uses the Pallas kernel on TPU (or in interpret mode when forced).
+    A non-causal sequence that doesn't tile the blocks (the flagship
+    ViT's 296 = 196 patches + 100 det tokens) is zero-padded to the
+    next block multiple and the padded keys masked inside the kernel
+    (`kv_len`) — materializing the S^2 score matrix through the XLA
+    reference cost ~100 MB/image of HBM traffic at serving shapes.
+    Falls back to the XLA reference off-TPU, for causal untiled shapes,
+    and for shapes whose K/V staging exceeds VMEM bounds.
+
+    Default blocking (block_q/block_k None): for a NON-CAUSAL sequence
+    whose full score tile fits VMEM, the whole (padded) extent is one
+    block each way — a single MXU matmul per (batch, head) cell, no
+    serial K loop. The kernel already stages all of K/V per cell, so
+    full-extent blocks cost no extra staging, and at the ViT's serving
+    shape they measured 1.9x the throughput of 128x128 blocking
+    (pipelined MXU work instead of a fori_loop). Causal shapes keep
+    128x128: triangle skipping needs real blocks to skip.
     """
     if interpret is None:
         interpret = False
@@ -407,23 +466,44 @@ def flash_attention(
             return attention_reference(q, k, v, causal=causal)
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if not flash_tiles(sq, sk, d, block_q, block_k, causal):
-        # Not silent: the flagship ViT (seq 296) takes this path — its
-        # S^2 matrix is small enough that XLA's fusion is fine, but the
-        # dispatch decision should be observable.
-        logger.debug(
-            "flash_attention: falling back to XLA reference "
-            "(sq=%d sk=%d block_q=%d block_k=%d causal=%s)",
-            sq, sk, block_q, block_k, causal,
+    full_q = -(-sq // 8) * 8       # sublane multiple
+    full_k = -(-sk // 128) * 128   # lane multiple
+    if (
+        block_q is None and block_k is None
+        and not causal
+        # The backward kernels hold ~4 [block_q, block_k] f32 tiles at
+        # once (s, p, dp, ds), so the auto choice is bounded by THAT
+        # footprint, not the forward's single score tile — a shape that
+        # compiles forward-only must not fail under jax.grad.
+        and full_q * full_k * 4 * 4 <= 4 * 2**20
+    ):
+        block_q, block_k = full_q, full_k
+    else:
+        block_q = min(block_q or 128, sq)
+        block_k = min(block_k or 128, sk)
+    if flash_tiles(sq, sk, d, block_q, block_k, causal):
+        return _flash_pallas(q, k, v, causal, block_q, block_k, interpret, sk)
+
+    sq_p = -(-sq // block_q) * block_q
+    sk_p = -(-sk // block_k) * block_k
+    if not causal and flash_tiles(sq_p, sk_p, d, block_q, block_k, False):
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        out = _flash_pallas(
+            qp, kp, vp, False, block_q, block_k, interpret, sk
         )
-        return attention_reference(q, k, v, causal=causal)
+        return out[:, :, :sq]
 
-    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    logger.debug(
+        "flash_attention: falling back to XLA reference "
+        "(sq=%d sk=%d block_q=%d block_k=%d causal=%s)",
+        sq, sk, block_q, block_k, causal,
+    )
+    return attention_reference(q, k, v, causal=causal)
 
 
-def _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret, kv_len):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     qr = q.reshape(b * h, sq, d)
@@ -432,7 +512,7 @@ def _flash_pallas_impl(q, k, v, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, seq_k=sk,
-        block_q=block_q, seq_q=sq,
+        block_q=block_q, seq_q=sq, kv_len=kv_len,
     )
     out, lse = pl.pallas_call(
         kernel,
