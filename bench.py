@@ -221,29 +221,38 @@ def serving_benchmark() -> dict:
         if probe_mean > 0
         else None,
         "client_errors": errors[0],
-        # Gap diagnostics: fraction of dispatched images that were
-        # padding; time the DEVICE had nothing queued (the real drought
-        # signal); and time the dispatcher thread idled waiting for a
-        # first request — large under deep pipelining BY DESIGN (the
-        # device holds a queue of in-flight batches), so only
-        # device_starved_pct indicates a feed problem.
-        "padding_pct": round(
-            100.0
-            * (stats1["padded_images"] - stats0["padded_images"])
-            / max(1, images + stats1["padded_images"] - stats0["padded_images"]),
-            2,
+        # Gap decomposition, one story: ceiling − achieved =
+        # padding (MXU work spent on bucket fill) + device starvation
+        # (time with nothing queued on-chip) + residual (dispatch
+        # scheduling slack and the ±2-3% ceiling-calibration noise —
+        # a small NEGATIVE residual means the serving path sustained
+        # the ceiling and the calibration's noise went the other way).
+        # The dispatcher thread's own idle time is NOT here: under deep
+        # pipelining it idles by design while the device stays fed; it
+        # remains visible in the server's /stats (dispatcher_idle_s)
+        # with that documentation.
+        "utilization_gap_pct": round(100.0 - util_pct, 2),
+        "padding_pct": (
+            padding_pct := round(
+                100.0
+                * (stats1["padded_images"] - stats0["padded_images"])
+                / max(
+                    1,
+                    images + stats1["padded_images"] - stats0["padded_images"],
+                ),
+                2,
+            )
         ),
-        "device_starved_pct": round(
-            100.0
-            * (stats1["device_starved_s"] - stats0["device_starved_s"])
-            / max(1e-9, wall),
-            2,
+        "device_starved_pct": (
+            starved_pct := round(
+                100.0
+                * (stats1["device_starved_s"] - stats0["device_starved_s"])
+                / max(1e-9, wall),
+                2,
+            )
         ),
-        "dispatcher_idle_pct": round(
-            100.0
-            * (stats1["dispatcher_idle_s"] - stats0["dispatcher_idle_s"])
-            / max(1e-9, wall),
-            2,
+        "gap_residual_pct": round(
+            100.0 - util_pct - padding_pct - starved_pct, 2
         ),
         # Roofline: which wall bounds the served model on this chip —
         # quantifies how much of the peak-MFU gap is physics (memory

@@ -209,7 +209,20 @@ def _calibrate(jnp, jax, infer, params, images_of, max_batch):
         if wall > window or n >= 1024:
             break
         n *= 2
-    return rtt, max_batch * n / max(wall - rtt, 1e-9)
+    # Best of two windows: a single window's downward noise (a slow
+    # dispatch, a GC pause) understates the ceiling and shows up as
+    # >100% utilization; the max of two independent windows halves that
+    # bias while an overstated ceiling remains impossible (the chip
+    # cannot run faster than itself). Residual noise is ~±2-3%.
+    best = max(wall - rtt, 1e-9)
+    if wall > 0.5:  # skip for test-sized windows
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = infer(params, images)
+        _fence(out)
+        best = min(best, max(time.perf_counter() - t0 - rtt, 1e-9))
+    return rtt, max_batch * n / best
 
 
 def main() -> None:
