@@ -288,11 +288,17 @@ def scheduling_benchmark() -> dict:
 
 def decode_benchmark() -> dict:
     """LM KV-cache decode on the same chip, with its HBM-roofline
-    ceiling as the stated baseline (`bench_lm.measure_decode`). Runs
-    after the serving phase so the two never contend for the device."""
-    from bench_lm import measure_decode
+    ceiling as the stated baseline (`bench_lm.measure_decode`), plus
+    the speculative-decoding path (`bench_lm.measure_speculative`:
+    briefly trains a target+draft pair on-chip so acceptance measures
+    draft quality, then times spec vs plain greedy on the same target).
+    Runs after the serving phase so phases never contend for the
+    device."""
+    from bench_lm import measure_decode, measure_speculative
 
-    return measure_decode()
+    result = measure_decode()
+    result.update(measure_speculative())
+    return result
 
 
 def main() -> None:

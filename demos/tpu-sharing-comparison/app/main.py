@@ -319,6 +319,8 @@ def main() -> None:
     # strictly out of the default path — the headline bench measures the
     # vision pipeline and must not pay a second model's compile/memory.
     lm_generate = lm_params = lm_cfg = None
+    lm_spec_generate = spec_draft_params = None
+    spec_k = 0
     lm_lock = threading.Lock()
     lm_max_new = int(os.environ.get("WALKAI_LM_MAX_NEW", "64"))
     if os.environ.get("WALKAI_DEMO_LM") == "1":
@@ -345,6 +347,38 @@ def main() -> None:
             f"lm generation enabled: {lm_cfg.num_layers} layers, "
             f"max_new={lm_max_new}"
         )
+        if os.environ.get("WALKAI_DEMO_SPEC") == "1":
+            # Speculative path for {"speculative": true} requests: a
+            # 1-layer draft proposes, the target verifies — the output
+            # stays the target's greedy sequence for ANY draft weights
+            # (models/speculative.py), so serving it untrained is
+            # correct; a deployment would load a distilled draft here.
+            import dataclasses as _dc
+
+            from walkai_nos_tpu.models.speculative import (
+                make_speculative_generate_fn,
+            )
+
+            spec_k = int(os.environ.get("WALKAI_SPEC_K", "6"))
+            spec_draft_cfg = _dc.replace(
+                lm_cfg,
+                num_layers=1,
+                hidden_dim=max(32, lm_cfg.hidden_dim // 4),
+                num_heads=max(2, lm_cfg.num_heads // 4),
+            )
+            spec_draft_params = jax.device_put(
+                DecoderLM(spec_draft_cfg).init_params(
+                    jax.random.PRNGKey(1)
+                )
+            )
+            lm_spec_generate = make_speculative_generate_fn(
+                lm_cfg, spec_draft_cfg, k=spec_k, return_stats=True,
+            )
+            _spec_out, _ = lm_spec_generate(
+                lm_params, spec_draft_params, warm_prompt, lm_max_new
+            )
+            _np.asarray(jnp.ravel(_spec_out))
+            print(f"speculative generation enabled: k={spec_k}")
 
     stats = _Stats()
     requests_q: "queue.Queue[_Request]" = queue.Queue()
@@ -480,13 +514,20 @@ def main() -> None:
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n) or b"{}")
             prompt = body.get("prompt")
+            speculative = bool(body.get("speculative"))
+            if speculative and lm_spec_generate is None:
+                self.send_error(404, "set WALKAI_DEMO_SPEC=1 to enable")
+                return
             if not isinstance(prompt, list) or not prompt:
                 self.send_error(400, "prompt must be a non-empty list")
                 return
-            if len(prompt) + lm_max_new > lm_cfg.max_seq_len:
+            # The speculative round verifies up to k positions past the
+            # last emitted token, so its position budget is tighter.
+            budget = lm_max_new + (spec_k if speculative else 0)
+            if len(prompt) + budget > lm_cfg.max_seq_len:
                 self.send_error(
                     400,
-                    f"prompt {len(prompt)} + {lm_max_new} new tokens "
+                    f"prompt {len(prompt)} + {budget} positions "
                     f"exceeds max_seq_len {lm_cfg.max_seq_len}",
                 )
                 return
@@ -500,9 +541,32 @@ def main() -> None:
             # Serialized: one generation at a time keeps decode latency
             # predictable next to the vision dispatcher. A new prompt
             # length compiles on first use.
+            extra = {}
             with lm_lock:
                 t0 = time.perf_counter()
-                out = lm_generate(lm_params, arr, max_new_tokens=lm_max_new)
+                if speculative:
+                    out, sstats = lm_spec_generate(
+                        lm_params, spec_draft_params, arr, lm_max_new
+                    )
+                    hist = np.asarray(sstats["acceptance_hist"])
+                    rounds = int(hist.sum())
+                    accepted = float(
+                        (np.arange(spec_k + 1) * hist).sum()
+                    )
+                    extra = {
+                        "speculative": True,
+                        "spec_k": spec_k,
+                        "acceptance_rate": round(
+                            accepted / max(1, rounds * spec_k), 4
+                        ),
+                        "tokens_per_round": round(
+                            (accepted + rounds) / max(1, rounds), 2
+                        ),
+                    }
+                else:
+                    out = lm_generate(
+                        lm_params, arr, max_new_tokens=lm_max_new
+                    )
                 tokens = np.asarray(out)[0].tolist()  # fenced by fetch
                 dt = time.perf_counter() - t0
             self._json(200, {
@@ -510,6 +574,7 @@ def main() -> None:
                 "generate_time_seconds": round(dt, 6),
                 "tokens_per_second": round(lm_max_new / dt, 1),
                 "slice": slice_id,
+                **extra,
             })
 
         def do_GET(self):

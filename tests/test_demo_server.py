@@ -125,3 +125,61 @@ class TestGenerateEndpoint:
     def test_generate_disabled_by_default(self, server):
         status, _ = self._post(server, {"prompt": [1, 2]})
         assert status == 404
+
+    def test_speculative_requires_opt_in(self, lm_server):
+        status, _ = self._post(
+            lm_server, {"prompt": [1, 2], "speculative": True}
+        )
+        assert status == 404
+
+
+class TestSpeculativeEndpoint:
+    @pytest.fixture(scope="class")
+    def spec_server(self):
+        proc, base = spawn_server(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "WALKAI_DEMO_MODEL": "tiny",
+                "WALKAI_DEMO_LM": "1",
+                "WALKAI_DEMO_SPEC": "1",
+                "WALKAI_SPEC_K": "3",
+                "WALKAI_LM_MAX_NEW": "8",
+                "WALKAI_MAX_BATCH": "8",
+                "WALKAI_WARM_BUCKETS": "1",
+                "WALKAI_CALIB_WINDOW_S": "0.2",
+            },
+            startup_timeout_s=300.0,
+            poll_s=0.25,
+        )
+        yield base
+        kill_server(proc)
+
+    def test_speculative_generates_target_greedy(self, spec_server):
+        """The speculative path emits the SAME tokens as the plain
+        target-greedy path (exactness contract, CPU-deterministic) and
+        reports acceptance telemetry."""
+        post = TestGenerateEndpoint._post
+        prompt = {"prompt": [1, 2, 3, 4]}
+        status, plain = post(self, spec_server, prompt)
+        assert status == 200
+        status, spec = post(
+            self, spec_server, {**prompt, "speculative": True}
+        )
+        assert status == 200
+        assert spec["speculative"] is True
+        assert spec["tokens"] == plain["tokens"]
+        assert 0.0 <= spec["acceptance_rate"] <= 1.0
+        assert spec["tokens_per_round"] >= 1.0
+        assert spec["spec_k"] == 3
+
+    def test_speculative_position_budget(self, spec_server):
+        # The speculative budget is k tighter: prompt 119 + 8 new fits
+        # the tiny model's 128 positions plain, but + k 3 does not.
+        post = TestGenerateEndpoint._post
+        prompt = [1] * 119
+        status, _ = post(
+            self, spec_server, {"prompt": prompt, "speculative": True}
+        )
+        assert status == 400
+        status, _ = post(self, spec_server, {"prompt": prompt})
+        assert status == 200
