@@ -40,6 +40,7 @@ _SLOW_FILES = {
     "test_multihost.py",
     "test_ops.py",
     "test_pipeline.py",
+    "test_pool_seam.py",
     "test_speculative.py",
     "test_trainer.py",
 }

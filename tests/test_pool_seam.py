@@ -1,0 +1,249 @@
+"""The pool-share consumer seam, driven end to end (VERDICT r3 #6).
+
+Half one: two pool-member hosts serve their pool shares through the
+REAL device plugin (gRPC, fake kubelet) with `pool_worker_source`
+merging the multi-host worker coordinates into the Allocate env —
+asserted field-by-field against the `tpudev/env.py` contract.
+
+Half two: two actual OS processes take those Allocate envs, bootstrap
+through `parallel/multihost.py` (`resolve_distributed_config` ->
+`initialize_distributed` -> `multihost_mesh`) on a CPU backend, and run
+a real collective over the combined 2-host mesh — proving the env the
+plugin injects is sufficient for a gang worker to join its slice.
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import grpc
+import pytest
+
+from walkai_nos_tpu.api import constants
+from walkai_nos_tpu.deviceplugin import SliceDevicePlugin, pool_worker_source
+from walkai_nos_tpu.kube.fake import FakeKubeClient
+from walkai_nos_tpu.protos_gen import deviceplugin_pb2 as dp
+from walkai_nos_tpu.resource.fake_kubelet import FakeKubelet
+from walkai_nos_tpu.tpu.tiling.packing import Placement
+from walkai_nos_tpu.tpudev.env import make_pool_worker_env, make_slice_env
+from walkai_nos_tpu.tpudev.fake import FakeTpudevClient
+
+POOL = "pool-a"
+POOL_PROFILE = "2x4"  # 8 chips over two (2, 2) hosts
+HOST_MESH = (2, 2)
+
+
+def _member_node(i: int) -> dict:
+    return {
+        "metadata": {
+            "name": f"{POOL}-{i}",
+            "labels": {
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: POOL_PROFILE,
+                constants.LABEL_TPU_PARTITIONING: "tiling",
+                constants.LABEL_TPU_NODEPOOL: POOL,
+                constants.LABEL_TPU_WORKER_ID: str(i),
+            },
+        }
+    }
+
+
+def _pool_share_allocate_env(kube, worker: int) -> dict:
+    """One member host's Allocate env for its pool share, through the
+    real plugin gRPC surface."""
+    tpudev = FakeTpudevClient(mesh=HOST_MESH)
+    tpudev.create_slices([Placement(POOL_PROFILE, (0, 0), HOST_MESH)])
+    root = tempfile.mkdtemp(prefix="ps-", dir="/tmp")
+    kubelet = FakeKubelet(root)
+    kubelet.start()
+    plugin = SliceDevicePlugin(
+        f"walkai.io/tpu-{POOL_PROFILE}",
+        None,
+        plugin_dir=kubelet.plugin_dir,
+        source=pool_worker_source(
+            tpudev.list_slices, kube, f"{POOL}-{worker}"
+        ),
+    )
+    plugin.start()
+    try:
+        plugin.register(kubelet.registration_socket)
+        channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+        resp = channel.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=dp.AllocateRequest.SerializeToString,
+            response_deserializer=dp.AllocateResponse.FromString,
+        )(
+            dp.AllocateRequest(
+                container_requests=[
+                    dp.ContainerAllocateRequest(
+                        devicesIDs=[f"{POOL_PROFILE}@0-0"]
+                    )
+                ]
+            )
+        )
+        return dict(resp.container_responses[0].envs)
+    finally:
+        plugin.stop()
+        kubelet.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+class TestPoolShareEnvContract:
+    def test_allocate_env_matches_contract_field_by_field(self):
+        kube = FakeKubeClient()
+        for i in range(2):
+            kube.create("Node", _member_node(i))
+        hostnames = [f"{POOL}-0", f"{POOL}-1"]
+        for worker in range(2):
+            got = _pool_share_allocate_env(kube, worker)
+            placement = Placement(POOL_PROFILE, (0, 0), HOST_MESH)
+            want = {
+                **make_slice_env(placement, (0, 1, 2, 3)),
+                **make_pool_worker_env(worker, hostnames),
+            }
+            assert got == want, worker
+            # The contract spelled out, so a drift in either helper is
+            # caught against the literal wire values:
+            assert got["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+            assert got["TPU_PROCESS_BOUNDS"] == "1,1,1"
+            assert got["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+            assert got["TPU_SLICE_ID"] == f"{POOL_PROFILE}@0-0"
+            assert got["TPU_WORKER_ID"] == str(worker)
+            assert got["TPU_WORKER_HOSTNAMES"] == f"{POOL}-0,{POOL}-1"
+            assert (
+                got["MEGASCALE_COORDINATOR_ADDRESS"] == f"{POOL}-0:8476"
+            )
+
+    def test_host_local_slices_untouched(self):
+        kube = FakeKubeClient()
+        for i in range(2):
+            kube.create("Node", _member_node(i))
+        tpudev = FakeTpudevClient(mesh=HOST_MESH)
+        tpudev.create_slices([Placement("2x2", (0, 0), (2, 2))])
+        source = pool_worker_source(
+            tpudev.list_slices, kube, f"{POOL}-0"
+        )
+        (s,) = source()
+        assert "TPU_WORKER_ID" not in s.env
+        assert "TPU_WORKER_HOSTNAMES" not in s.env
+
+    def test_incomplete_membership_serves_visibility_only(self):
+        # A member without a worker-id label: don't guess coordinates.
+        kube = FakeKubeClient()
+        kube.create("Node", _member_node(0))
+        broken = _member_node(1)
+        del broken["metadata"]["labels"][constants.LABEL_TPU_WORKER_ID]
+        kube.create("Node", broken)
+        tpudev = FakeTpudevClient(mesh=HOST_MESH)
+        tpudev.create_slices([Placement(POOL_PROFILE, (0, 0), HOST_MESH)])
+        source = pool_worker_source(
+            tpudev.list_slices, kube, f"{POOL}-0"
+        )
+        (s,) = source()
+        assert "TPU_WORKER_ID" not in s.env
+        assert "TPU_VISIBLE_CHIPS" in s.env
+
+
+_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os
+    import sys
+
+    import numpy as np
+
+    from walkai_nos_tpu.parallel.mesh import MeshAxes
+    from walkai_nos_tpu.parallel.multihost import (
+        initialize_distributed,
+        multihost_mesh,
+        resolve_distributed_config,
+    )
+
+    cfg = resolve_distributed_config()
+    assert cfg is not None, "allocate env carried no multi-host contract"
+    assert cfg.num_processes == 2, cfg
+    assert cfg.process_id == int(os.environ["TPU_WORKER_ID"])
+
+    initialize_distributed()
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == 2
+    assert jax.device_count() == 8  # 4 visible chips per worker
+
+    from jax.experimental import multihost_utils
+
+    ids = multihost_utils.process_allgather(
+        np.asarray([cfg.process_id], np.int32)
+    )
+    assert sorted(np.ravel(ids).tolist()) == [0, 1], ids
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = multihost_mesh(MeshAxes(data=8))
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    x = jax.make_array_from_callback(
+        (8,), sharding, lambda idx: np.ones((1,), np.float32)
+    )
+    total = jax.jit(lambda a: a.sum(), out_shardings=None)(x)
+    assert float(total) == 8.0, total
+    print("POOL-SEAM-OK", cfg.process_id)
+    """
+)
+
+
+class TestPoolGangConsumesAllocateEnv:
+    def test_two_process_collective_over_combined_mesh(self):
+        """Two worker processes bootstrap from their Allocate envs and
+        run a collective over the combined mesh."""
+        kube = FakeKubeClient()
+        for i in range(2):
+            kube.create("Node", _member_node(i))
+        envs = [_pool_share_allocate_env(kube, w) for w in range(2)]
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        procs = []
+        for w, alloc_env in enumerate(envs):
+            env = dict(os.environ)
+            env.update(alloc_env)
+            # The node names in the contract aren't resolvable in the
+            # test network; point the coordinator at loopback (a real
+            # cluster resolves the worker-0 hostname). Chip visibility
+            # maps to the CPU device count so the combined mesh has the
+            # gang's true shape.
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+            n_chips = len(alloc_env["TPU_VISIBLE_CHIPS"].split(","))
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={n_chips}"
+            )
+            env["PYTHONPATH"] = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", _WORKER_SCRIPT],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=180)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"gang worker hung; partial output: {outs}")
+        for w, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {w} failed:\n{out}"
+            assert f"POOL-SEAM-OK {w}" in out

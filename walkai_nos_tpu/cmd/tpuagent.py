@@ -149,9 +149,14 @@ def main(argv: list[str] | None = None) -> int:
         config.manager.health_probe_addr, config.manager.metrics_addr
     )
 
-    from walkai_nos_tpu.deviceplugin import PluginManager
+    from walkai_nos_tpu.deviceplugin import PluginManager, pool_worker_source
 
-    plugins = PluginManager(tpudev)
+    # Pool shares are served with the multi-host worker env merged in
+    # (worker id / hostnames / coordinator from the pool labels), so a
+    # gang's JAX processes bootstrap straight from their Allocate env.
+    plugins = PluginManager(
+        None, source=pool_worker_source(tpudev.list_slices, kube, node_name)
+    )
     plugins.start()
 
     manager, _shared = build_manager(

@@ -10,4 +10,5 @@ runtime env and the chips' /dev/accel* device nodes.
 from walkai_nos_tpu.deviceplugin.plugin import (  # noqa: F401
     PluginManager,
     SliceDevicePlugin,
+    pool_worker_source,
 )

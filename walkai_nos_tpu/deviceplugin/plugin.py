@@ -24,6 +24,7 @@ import grpc
 from walkai_nos_tpu.api import constants
 from walkai_nos_tpu.protos_gen import deviceplugin_pb2 as pb
 from walkai_nos_tpu.tpudev.client import SliceInfo, TpudevClient
+from walkai_nos_tpu.tpudev.env import make_pool_worker_env
 
 logger = logging.getLogger(__name__)
 
@@ -213,6 +214,77 @@ class SliceDevicePlugin:
             self._server.stop(grace=0.5)
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
+
+
+def pool_worker_source(
+    base_source: "Callable[[], list[SliceInfo]]",
+    kube,
+    node_name: str,
+) -> "Callable[[], list[SliceInfo]]":
+    """Wrap a slice source so POOL shares carry the multi-host worker
+    env beside their visibility env.
+
+    A pool share is recognizable by its profile naming more chips than
+    the host holds (the same marker the native layer uses,
+    `native/tpudev/tpudev.cc` parse_placement). For those, the worker
+    coordinates are derived from this node's GKE pool labels and its
+    fellow members (same nodepool label, ordered by worker id), so the
+    gang's JAX processes can run `initialize_distributed()` straight
+    from the Allocate env (`tpudev/env.make_pool_worker_env` is the
+    contract; `parallel/multihost.py` is the consumer). Host-local
+    slices pass through untouched.
+    """
+    import dataclasses
+
+    from walkai_nos_tpu.kube import objects
+    from walkai_nos_tpu.tpu import topology
+
+    def is_pool_share(s: SliceInfo) -> bool:
+        try:
+            chips = topology.shape_chip_count(
+                topology.parse_shape(s.profile)
+            )
+        except ValueError:
+            return False
+        return chips > len(s.chip_ids)
+
+    def source() -> list[SliceInfo]:
+        slices = base_source()
+        if not any(is_pool_share(s) for s in slices):
+            return slices
+        try:
+            node = kube.get("Node", node_name)
+            labels = objects.labels(node)
+            pool = labels.get(constants.LABEL_TPU_NODEPOOL)
+            if not pool:
+                return slices
+            members = kube.list(
+                "Node",
+                label_selector={constants.LABEL_TPU_NODEPOOL: pool},
+            )
+            by_worker: dict[int, str] = {}
+            for m in members:
+                raw = objects.labels(m).get(constants.LABEL_TPU_WORKER_ID)
+                if raw is None:
+                    return slices  # membership incomplete: don't guess
+                by_worker[int(raw)] = objects.name(m)
+            hostnames = [by_worker[i] for i in sorted(by_worker)]
+            worker_id = int(labels[constants.LABEL_TPU_WORKER_ID])
+            extra = make_pool_worker_env(worker_id, hostnames)
+        except Exception:
+            logger.exception(
+                "pool worker env for %s unavailable; serving shares "
+                "with visibility env only", node_name,
+            )
+            return slices
+        return [
+            dataclasses.replace(s, env={**s.env, **extra})
+            if is_pool_share(s)
+            else s
+            for s in slices
+        ]
+
+    return source
 
 
 class PluginManager:

@@ -169,10 +169,45 @@ def multihost_mesh(
 
         return build_mesh(devs, axes=axes)
     dcn, ici = split_dcn_axes(axes, num_hosts)
-    arr = mesh_utils.create_hybrid_device_mesh(
-        ici.as_shape(),
-        dcn.as_shape(),
-        devices=devs,
-        allow_split_physical_axes=True,
-    )
+    slice_ids = {getattr(d, "slice_index", None) for d in devs}
+    if len(slice_ids) == num_hosts and None not in slice_ids:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici.as_shape(),
+            dcn.as_shape(),
+            devices=devs,
+            allow_split_physical_axes=True,
+        )
+        return Mesh(arr, ALL_AXES)
+    # CPU/emulated multi-process backends (the pool-seam test, the
+    # driver's virtual-device dry run) don't populate slice_index, which
+    # create_hybrid_device_mesh groups by. Same layout, grouped by
+    # process_index instead: per-host sub-meshes reshaped to the ICI
+    # shape, hosts arranged on the DCN shape, then the two interleaved
+    # per axis (dcn outer, ici inner) — each final axis k has extent
+    # dcn[k] * ici[k] with cross-host hops only on the dcn factor.
+    import numpy as np
+
+    by_host: dict[int, list[jax.Device]] = {}
+    for d in devs:
+        by_host.setdefault(d.process_index, []).append(d)
+    per_host = [
+        sorted(by_host[h], key=lambda d: d.id) for h in sorted(by_host)
+    ]
+    if len(per_host) != num_hosts or len(
+        {len(p) for p in per_host}
+    ) != 1:
+        raise ValueError(
+            f"devices group into {len(per_host)} hosts with uneven "
+            f"sizes; expected {num_hosts} equal hosts"
+        )
+    ici_shape = tuple(ici.as_shape())
+    dcn_shape = tuple(dcn.as_shape())
+    arr = np.empty((num_hosts,) + ici_shape, dtype=object)
+    for i, host_devs in enumerate(per_host):
+        arr[i] = np.asarray(host_devs, dtype=object).reshape(ici_shape)
+    arr = arr.reshape(dcn_shape + ici_shape)
+    n = len(ici_shape)
+    arr = arr.transpose(
+        [axis for k in range(n) for axis in (k, n + k)]
+    ).reshape([dcn_shape[k] * ici_shape[k] for k in range(n)])
     return Mesh(arr, ALL_AXES)

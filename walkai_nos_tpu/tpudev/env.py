@@ -12,6 +12,9 @@ real native client (`tpudev/native.py`) and the in-memory fake
 from __future__ import annotations
 
 
+from typing import Sequence
+
+
 def make_slice_env(placement, chip_ids: tuple[int, ...]) -> dict:
     """TPU runtime env for a slice: what the device plugin injects so a JAX
     process only initializes its sub-slice."""
@@ -22,4 +25,30 @@ def make_slice_env(placement, chip_ids: tuple[int, ...]) -> dict:
             str(d) for d in (tuple(placement.orientation) + (1, 1, 1))[:3]
         ),
         "TPU_SLICE_ID": placement.slice_id(),
+    }
+
+
+def make_pool_worker_env(
+    worker_id: int, worker_hostnames: Sequence[str], port: int = 8476
+) -> dict:
+    """Multi-host coordinates for a POOL share — the other half of the
+    slice contract. A pool share's visibility env (`make_slice_env`)
+    covers this host's chips; the gang's processes additionally need to
+    find each other, and these are exactly the fields
+    `parallel/multihost.resolve_distributed_config` consumes (the same
+    env GKE injects on native podslices): worker id = this host's
+    `gke-tpu-worker-id` label, hostnames = the pool members in worker
+    order, coordinator = worker 0.
+    """
+    hosts = [h for h in worker_hostnames if h]
+    if not hosts:
+        raise ValueError("worker_hostnames must be non-empty")
+    if not 0 <= worker_id < len(hosts):
+        raise ValueError(
+            f"worker_id {worker_id} out of range for {len(hosts)} hosts"
+        )
+    return {
+        "TPU_WORKER_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(hosts),
+        "MEGASCALE_COORDINATOR_ADDRESS": f"{hosts[0]}:{port}",
     }
