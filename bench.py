@@ -71,10 +71,67 @@ WARMUP_SECONDS = 5.0
 MEASURE_SECONDS = float(os.environ.get("WALKAI_BENCH_SECONDS", "15"))
 LATENCY_PROBE_SECONDS = float(os.environ.get("WALKAI_BENCH_PROBE_SECONDS", "5"))
 SERVER_STARTUP_TIMEOUT_S = 420.0
+QOS_SECONDS = float(os.environ.get("WALKAI_BENCH_QOS_SECONDS", "8"))
 # Reference MPS result interpolated to 4 pods, per single-image inference
 # ((0.1640 + 0.2409) / 2, `demos/gpu-sharing-comparison/README.md:70`).
 BASELINE_MPS_4POD_S = (0.1640 + 0.2409) / 2
 TARGET_UTILIZATION_PCT = 85.0
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def _qos_phase(base: str, seconds: float, *, noisy: bool) -> list[list[float]]:
+    """Per-stream latencies for N_STREAMS sequential batch=1 tenants.
+
+    With `noisy`, stream 0 is replaced by an aggressor at ~4x its fair
+    share (4 pipelined batch-32 connections); the returned lists then
+    cover only the victim streams. Sequential probes use a fresh
+    connection per request (same rationale as the latency probe)."""
+    halt = threading.Event()
+    n_victims = N_STREAMS - 1 if noisy else N_STREAMS
+    lat: list[list[float]] = [[] for _ in range(n_victims)]
+
+    def victim(idx: int) -> None:
+        while not halt.is_set():
+            t0 = time.perf_counter()
+            try:
+                post_infer(base, 1)
+            except Exception:
+                continue
+            lat[idx].append(time.perf_counter() - t0)
+
+    def aggressor() -> None:
+        client = InferClient(base)
+        try:
+            while not halt.is_set():
+                try:
+                    client.post_infer(REQUEST_BATCH)
+                except Exception:
+                    time.sleep(0.1)
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=victim, args=(i,), daemon=True)
+        for i in range(n_victims)
+    ]
+    if noisy:
+        threads += [
+            threading.Thread(target=aggressor, daemon=True)
+            for _ in range(4)
+        ]
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    halt.set()
+    for t in threads:
+        t.join(timeout=60.0)
+    return [sorted(stream) for stream in lat]
 
 
 def serving_benchmark() -> dict:
@@ -183,6 +240,14 @@ def serving_benchmark() -> dict:
         probe_halt.set()
         for t in probe_threads:
             t.join(timeout=160.0)
+        # QoS / isolation: the reference's MIG table shows flat latency
+        # at any co-tenant count (BASELINE.md, 0.34 s from 1 to 7 pods).
+        # The TPU sharing analogue: per-stream p99 under fair 4-way
+        # co-tenancy, then the noisy-neighbor variant — one tenant at
+        # ~4x its fair share (pipelined batch-32) while the victims
+        # stay sequential batch=1 — and the victims' p99 degradation.
+        fair_lat = _qos_phase(base, QOS_SECONDS, noisy=False)
+        noisy_lat = _qos_phase(base, QOS_SECONDS, noisy=True)
     finally:
         kill_server(proc)
 
@@ -263,6 +328,28 @@ def serving_benchmark() -> dict:
         "device_kind": stats1.get("device_kind"),
         "streams": N_STREAMS,
         "stream_pipeline": STREAM_PIPELINE,
+        **_qos_fields(fair_lat, noisy_lat),
+    }
+
+
+def _qos_fields(
+    fair_lat: list[list[float]], noisy_lat: list[list[float]]
+) -> dict:
+    fair_p99 = [_percentile(s, 0.99) for s in fair_lat]
+    victim_p99 = [_percentile(s, 0.99) for s in noisy_lat]
+    fair_med = statistics.median(fair_p99) if fair_p99 else 0.0
+    noisy_med = statistics.median(victim_p99) if victim_p99 else 0.0
+    return {
+        # Flat-latency property under fair 4-way co-tenancy, and the
+        # victims' degradation with one tenant at ~4x its share.
+        "qos_p99_per_stream_s": [round(p, 4) for p in fair_p99],
+        "qos_p50_per_stream_s": [
+            round(_percentile(s, 0.50), 4) for s in fair_lat
+        ],
+        "qos_noisy_victim_p99_s": [round(p, 4) for p in victim_p99],
+        "noisy_neighbor_degradation_pct": round(
+            100.0 * (noisy_med - fair_med) / fair_med, 2
+        ) if fair_med > 0 else None,
     }
 
 
