@@ -11,7 +11,6 @@ from walkai_nos_tpu.api import constants
 from walkai_nos_tpu.cmd.tpuagent import build_manager as build_agent_manager
 from walkai_nos_tpu.cmd.tpupartitioner import build_manager as build_part_manager
 from walkai_nos_tpu.config import AgentConfig, PartitionerConfig
-from walkai_nos_tpu.controllers.tpuagent.shared import SharedState
 from walkai_nos_tpu.kube import objects
 from walkai_nos_tpu.kube.fake import FakeKubeClient
 from walkai_nos_tpu.tpu.annotations import parse_node_annotations

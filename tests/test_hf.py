@@ -95,10 +95,7 @@ class TestExport:
             load_gpt2,
             state_dict_from_params,
         )
-        from walkai_nos_tpu.models.lm import (
-            init_lm_state,
-            make_lm_train_step,
-        )
+        from walkai_nos_tpu.models.lm import make_lm_train_step
         from walkai_nos_tpu.parallel.mesh import build_mesh
 
         hf = _hf_model(seed=2)

@@ -6,7 +6,6 @@ The analogue of the reference's envtest suites
 on node-annotation / pod-scheduling side effects with eventually-semantics.
 """
 
-import time
 
 from tests.helpers import eventually
 from walkai_nos_tpu.api import constants
@@ -43,7 +42,8 @@ class TestEndToEnd:
                 )
 
             eventually(status_reported, msg="agent reports free 2x4")
-            assert [s.profile for s in cluster.nodes["tpu-node-a"].tpudev.list_slices()] == ["2x4"]
+            node_dev = cluster.nodes["tpu-node-a"].tpudev
+            assert [s.profile for s in node_dev.list_slices()] == ["2x4"]
 
             # 3. A pod requesting a 2x2 (not exposed) goes pending; the
             #    partitioner re-tiles; the pod schedules.
@@ -124,7 +124,8 @@ class TestEndToEnd:
                 pods = cluster.kube.list(
                     "Pod",
                     label_selector={
-                        constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                        constants.DEVICE_PLUGIN_LABEL_KEY:
+                            constants.DEVICE_PLUGIN_LABEL_VALUE
                     },
                 )
                 return len(pods) == 1 and any(
@@ -135,7 +136,8 @@ class TestEndToEnd:
             plugin_before = cluster.kube.list(
                 "Pod",
                 label_selector={
-                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                    constants.DEVICE_PLUGIN_LABEL_KEY:
+                        constants.DEVICE_PLUGIN_LABEL_VALUE
                 },
             )
             uid_before = objects.uid(plugin_before[0])
@@ -146,7 +148,8 @@ class TestEndToEnd:
                 pods = cluster.kube.list(
                     "Pod",
                     label_selector={
-                        constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                        constants.DEVICE_PLUGIN_LABEL_KEY:
+                            constants.DEVICE_PLUGIN_LABEL_VALUE
                     },
                 )
                 return (

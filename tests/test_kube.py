@@ -72,8 +72,10 @@ class TestFakeCrud:
 
     def test_field_selector(self):
         c = FakeKubeClient()
-        c.create("Pod", {"metadata": {"name": "p1", "namespace": "d"}, "spec": {"nodeName": "n1"}})
-        c.create("Pod", {"metadata": {"name": "p2", "namespace": "d"}, "spec": {"nodeName": "n2"}})
+        c.create("Pod", {"metadata": {"name": "p1", "namespace": "d"},
+                         "spec": {"nodeName": "n1"}})
+        c.create("Pod", {"metadata": {"name": "p2", "namespace": "d"},
+                         "spec": {"nodeName": "n2"}})
         got = c.list("Pod", field_selector={"spec.nodeName": "n1"})
         assert [objects.name(p) for p in got] == ["p1"]
 
@@ -124,9 +126,14 @@ class TestPredicates:
 
     def test_node_resources_changed(self):
         p = predicates.node_resources_changed()
-        old = {"metadata": {"name": "n"}, "status": {"capacity": {"x": "1"}, "allocatable": {"x": "1"}}}
-        cap_changed = {"metadata": {"name": "n"}, "status": {"capacity": {"x": "2"}, "allocatable": {"x": "1"}}}
-        both_changed = {"metadata": {"name": "n"}, "status": {"capacity": {"x": "2"}, "allocatable": {"x": "2"}}}
+        old = {"metadata": {"name": "n"},
+               "status": {"capacity": {"x": "1"}, "allocatable": {"x": "1"}}}
+        cap_changed = {"metadata": {"name": "n"},
+                       "status": {"capacity": {"x": "2"},
+                                  "allocatable": {"x": "1"}}}
+        both_changed = {"metadata": {"name": "n"},
+                        "status": {"capacity": {"x": "2"},
+                                   "allocatable": {"x": "2"}}}
         assert p("MODIFIED", cap_changed, old)
         assert not p("MODIFIED", both_changed, old)
 

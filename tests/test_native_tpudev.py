@@ -11,7 +11,7 @@ from pathlib import Path
 
 import pytest
 
-from walkai_nos_tpu.tpu.errors import GenericError, NotFoundError
+from walkai_nos_tpu.tpu.errors import GenericError
 from walkai_nos_tpu.tpu.tiling.packing import Placement
 
 REPO = Path(__file__).resolve().parents[1]

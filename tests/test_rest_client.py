@@ -11,7 +11,7 @@ import time
 import pytest
 
 
-from walkai_nos_tpu.kube.client import ApiError, NotFound
+from walkai_nos_tpu.kube.client import NotFound
 from walkai_nos_tpu.kube.rest import RestKubeClient
 from walkai_nos_tpu.kube.runtime import Controller, Request, Result
 

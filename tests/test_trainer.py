@@ -225,8 +225,6 @@ class TestEvaluate:
 
 class TestOptimizerKnobs:
     def test_clip_and_schedule_train(self):
-        from dataclasses import replace
-
         from walkai_nos_tpu.models.lm import DecoderLM, lm_loss
         from walkai_nos_tpu.models.train import (
             TrainState,

@@ -98,7 +98,8 @@ class Actuator:
         if plan.is_empty():
             self._shared.last_parsed_plan_id = plan_id
             return Result()
-        logger.info("actuator: node %s applying plan %s", self._node_name, plan.summary())
+        logger.info("actuator: node %s applying plan %s",
+                    self._node_name, plan.summary())
         self._apply(plan)
         # Ack only plans that actually actuated: a failed apply must not
         # be echoed into status-partitioning-plan, or the partitioner

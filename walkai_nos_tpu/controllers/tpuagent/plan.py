@@ -80,7 +80,8 @@ class TilingPlan:
 
     def summary(self) -> str:
         return (
-            f"create={[(o.mesh_index, o.profile, o.quantity) for o in self.create_ops]} "
+            "create="
+            f"{[(o.mesh_index, o.profile, o.quantity) for o in self.create_ops]} "
             f"delete={[(o.mesh_index, o.profile, o.quantity) for o in self.delete_ops]}"
         )
 

@@ -173,7 +173,9 @@ class SliceDevicePlugin:
                 "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
                     self._preferred_allocation,
                     request_deserializer=pb.PreferredAllocationRequest.FromString,
-                    response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+                    response_serializer=(
+                        pb.PreferredAllocationResponse.SerializeToString
+                    ),
                 ),
                 "Allocate": grpc.unary_unary_rpc_method_handler(
                     self._allocate,

@@ -9,7 +9,12 @@ requeue-after) without external dependencies.
 """
 
 from walkai_nos_tpu.kube import objects  # noqa: F401
-from walkai_nos_tpu.kube.client import KubeClient, ApiError, NotFound, Conflict  # noqa: F401
+from walkai_nos_tpu.kube.client import (  # noqa: F401
+    ApiError,
+    Conflict,
+    KubeClient,
+    NotFound,
+)
 from walkai_nos_tpu.kube.fake import FakeKubeClient  # noqa: F401
 from walkai_nos_tpu.kube.runtime import (  # noqa: F401
     Controller,

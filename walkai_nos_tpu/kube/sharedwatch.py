@@ -21,7 +21,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Callable, Iterator, Mapping
+from typing import Callable, Iterator
 
 from walkai_nos_tpu.kube.client import RESYNC, SYNCED, KubeClient, WatchEvent
 

@@ -113,12 +113,18 @@ class FakeKubelet:
                         "List": grpc.unary_unary_rpc_method_handler(
                             self._list,
                             request_deserializer=pr.ListPodResourcesRequest.FromString,
-                            response_serializer=pr.ListPodResourcesResponse.SerializeToString,
+                            response_serializer=(
+                                pr.ListPodResourcesResponse.SerializeToString
+                            ),
                         ),
                         "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
                             self._get_allocatable,
-                            request_deserializer=pr.AllocatableResourcesRequest.FromString,
-                            response_serializer=pr.AllocatableResourcesResponse.SerializeToString,
+                            request_deserializer=(
+                                pr.AllocatableResourcesRequest.FromString
+                            ),
+                            response_serializer=(
+                                pr.AllocatableResourcesResponse.SerializeToString
+                            ),
                         ),
                     },
                 ),

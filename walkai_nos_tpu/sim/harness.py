@@ -212,12 +212,19 @@ class SimCluster:
             "Pod",
             {
                 "metadata": {
-                    "name": f"walkai-tpu-device-plugin-{node_name}-{uuid.uuid4().hex[:5]}",
+                    "name": (
+                        f"walkai-tpu-device-plugin-{node_name}"
+                        f"-{uuid.uuid4().hex[:5]}"
+                    ),
                     "namespace": "kube-system",
                     "labels": {
-                        constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                        constants.DEVICE_PLUGIN_LABEL_KEY:
+                            constants.DEVICE_PLUGIN_LABEL_VALUE
                     },
-                    "ownerReferences": [{"kind": "DaemonSet", "name": "walkai-tpu-device-plugin"}],
+                    "ownerReferences": [
+                        {"kind": "DaemonSet",
+                         "name": "walkai-tpu-device-plugin"}
+                    ],
                 },
                 "spec": {"nodeName": node_name},
                 "status": {"phase": "Running"},
@@ -365,7 +372,8 @@ class SimCluster:
             plugin_pods = self.kube.list(
                 "Pod",
                 label_selector={
-                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                    constants.DEVICE_PLUGIN_LABEL_KEY:
+                        constants.DEVICE_PLUGIN_LABEL_VALUE
                 },
             )
             nodes_with_plugin = {

@@ -71,7 +71,9 @@ def get_fewest_slices_geometry(geometries: list[Geometry]) -> Geometry | None:
     return min(geometries, key=lambda g: (geometry_total_slices(g), geometry_id(g)))
 
 
-def partitioning_kind_of_node(node_labels: Mapping[str, str]) -> PartitioningKind | None:
+def partitioning_kind_of_node(
+    node_labels: Mapping[str, str],
+) -> PartitioningKind | None:
     """Read the partitioning kind from node labels; None if absent/unknown.
 
     Reference: `partitioning.go:91-106`.

@@ -107,7 +107,8 @@ class DevicePluginClient:
             for p in self._kube.list(
                 "Pod",
                 label_selector={
-                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                    constants.DEVICE_PLUGIN_LABEL_KEY:
+                        constants.DEVICE_PLUGIN_LABEL_VALUE
                 },
             )
             if (p.get("spec") or {}).get("nodeName") == node_name
@@ -129,7 +130,8 @@ class DevicePluginClient:
             for p in self._kube.list(
                 "Pod",
                 label_selector={
-                    constants.DEVICE_PLUGIN_LABEL_KEY: constants.DEVICE_PLUGIN_LABEL_VALUE
+                    constants.DEVICE_PLUGIN_LABEL_KEY:
+                        constants.DEVICE_PLUGIN_LABEL_VALUE
                 },
             ):
                 if (
