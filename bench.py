@@ -71,7 +71,7 @@ WARMUP_SECONDS = 5.0
 MEASURE_SECONDS = float(os.environ.get("WALKAI_BENCH_SECONDS", "15"))
 LATENCY_PROBE_SECONDS = float(os.environ.get("WALKAI_BENCH_PROBE_SECONDS", "5"))
 SERVER_STARTUP_TIMEOUT_S = 420.0
-QOS_SECONDS = float(os.environ.get("WALKAI_BENCH_QOS_SECONDS", "8"))
+QOS_SECONDS = float(os.environ.get("WALKAI_BENCH_QOS_SECONDS", "12"))
 # Reference MPS result interpolated to 4 pods, per single-image inference
 # ((0.1640 + 0.2409) / 2, `demos/gpu-sharing-comparison/README.md:70`).
 BASELINE_MPS_4POD_S = (0.1640 + 0.2409) / 2
@@ -246,8 +246,23 @@ def serving_benchmark() -> dict:
         # co-tenancy, then the noisy-neighbor variant — one tenant at
         # ~4x its fair share (pipelined batch-32) while the victims
         # stay sequential batch=1 — and the victims' p99 degradation.
-        fair_lat = _qos_phase(base, QOS_SECONDS, noisy=False)
-        noisy_lat = _qos_phase(base, QOS_SECONDS, noisy=True)
+        # Fair/noisy run as INTERLEAVED segments pooled per condition:
+        # the tunnel's fence RTT drifts by tens of ms across minutes,
+        # which back-to-back phases would read as (de)gradation.
+        n_segments = 4
+        fair_lat: list[list[float]] = [[] for _ in range(N_STREAMS)]
+        noisy_lat: list[list[float]] = [[] for _ in range(N_STREAMS - 1)]
+        for _ in range(n_segments):
+            for pooled, seg in (
+                (fair_lat, _qos_phase(
+                    base, QOS_SECONDS / n_segments, noisy=False)),
+                (noisy_lat, _qos_phase(
+                    base, QOS_SECONDS / n_segments, noisy=True)),
+            ):
+                for stream, samples in zip(pooled, seg):
+                    stream.extend(samples)
+        fair_lat = [sorted(s) for s in fair_lat]
+        noisy_lat = [sorted(s) for s in noisy_lat]
     finally:
         kill_server(proc)
 
@@ -337,8 +352,20 @@ def _qos_fields(
 ) -> dict:
     fair_p99 = [_percentile(s, 0.99) for s in fair_lat]
     victim_p99 = [_percentile(s, 0.99) for s in noisy_lat]
-    fair_med = statistics.median(fair_p99) if fair_p99 else 0.0
-    noisy_med = statistics.median(victim_p99) if victim_p99 else 0.0
+    # The degradation scalar uses POOLED samples (all streams of a
+    # condition together): a per-stream p99 over ~100 samples is a
+    # top-2 order statistic, and on a tunneled runtime the tail is
+    # quantized in whole fence RTTs (~0.1 s) whose alignment flips run
+    # to run — pooling triples the tail sample count. p95 is reported
+    # beside p99 because the tail mode is discrete: p99 says whether
+    # the slow mode has >1% mass, p95 whether it has >5%.
+    fair_all = sorted(s for stream in fair_lat for s in stream)
+    noisy_all = sorted(s for stream in noisy_lat for s in stream)
+
+    def deg(q: float) -> float | None:
+        f, n = _percentile(fair_all, q), _percentile(noisy_all, q)
+        return round(100.0 * (n - f) / f, 2) if f > 0 else None
+
     return {
         # Flat-latency property under fair 4-way co-tenancy, and the
         # victims' degradation with one tenant at ~4x its share.
@@ -347,9 +374,9 @@ def _qos_fields(
             round(_percentile(s, 0.50), 4) for s in fair_lat
         ],
         "qos_noisy_victim_p99_s": [round(p, 4) for p in victim_p99],
-        "noisy_neighbor_degradation_pct": round(
-            100.0 * (noisy_med - fair_med) / fair_med, 2
-        ) if fair_med > 0 else None,
+        "noisy_neighbor_degradation_pct": deg(0.99),
+        "noisy_neighbor_degradation_p95_pct": deg(0.95),
+        "noisy_neighbor_degradation_p50_pct": deg(0.50),
     }
 
 

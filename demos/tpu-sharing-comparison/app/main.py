@@ -472,6 +472,11 @@ def main() -> None:
                 r.done.set()
 
     threading.Thread(target=device_worker, daemon=True).start()
+    # Pool size 8: enough overlap that an ack costs completion + one
+    # RTT (fence-thread demand is ~batch rate x RTT ~ 6), and no more —
+    # a fencer per in-flight batch (24) was measured WORSE under
+    # co-tenant load (more concurrent host fetches contending on the
+    # GIL/tunnel raised victims' p50 by ~20%).
     for _ in range(min(8, max_inflight)):
         threading.Thread(target=fencer, daemon=True).start()
 
