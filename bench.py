@@ -67,7 +67,7 @@ N_STREAMS = 4
 STREAM_PIPELINE = int(os.environ.get("WALKAI_BENCH_PIPELINE", "24"))
 REQUEST_BATCH = int(os.environ.get("WALKAI_BENCH_REQUEST_BATCH", "32"))
 MAX_BATCH = int(os.environ.get("WALKAI_BENCH_MAX_BATCH", "128"))
-WARMUP_SECONDS = 5.0
+WARMUP_SECONDS = float(os.environ.get("WALKAI_BENCH_WARMUP_S", "5"))
 MEASURE_SECONDS = float(os.environ.get("WALKAI_BENCH_SECONDS", "15"))
 LATENCY_PROBE_SECONDS = float(os.environ.get("WALKAI_BENCH_PROBE_SECONDS", "5"))
 SERVER_STARTUP_TIMEOUT_S = 420.0
@@ -259,8 +259,8 @@ def serving_benchmark() -> dict:
                 (noisy_lat, _qos_phase(
                     base, QOS_SECONDS / n_segments, noisy=True)),
             ):
-                for stream, samples in zip(pooled, seg):
-                    stream.extend(samples)
+                for pooled_stream, seg_samples in zip(pooled, seg):
+                    pooled_stream.extend(seg_samples)
         fair_lat = [sorted(s) for s in fair_lat]
         noisy_lat = [sorted(s) for s in noisy_lat]
     finally:

@@ -29,6 +29,7 @@ from walkai_nos_tpu.tpu.tiling import known_tilings  # noqa: E402
 # (`pytest -m "not slow"`) skips them; CI runs both halves. File-level
 # because the compile cost is per-module (model init + jit), not per-test.
 _SLOW_FILES = {
+    "test_bench_serving.py",
     "test_decode.py",
     "test_demo_server.py",
     "test_e2e_apiserver.py",

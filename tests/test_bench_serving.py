@@ -1,0 +1,58 @@
+"""End-to-end smoke of `bench.py`'s serving phase on the tiny CPU model.
+
+The headline bench is the round artifact; a crash in `serving_benchmark`
+records 0% utilization for the round, so the whole phase — pipelined
+throughput window, sequential latency probe, interleaved fair/noisy QoS
+segments, and the stats math over all of them — must execute in CI, not
+only on the real chip. (A variable-shadowing bug in the QoS pooling loop
+once broke the throughput-sample unpack only at the very end of the
+phase; this test exists so that class of failure fails in CI first.)
+"""
+
+import importlib
+
+import pytest
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch):
+    # Bench knobs are read at import time; set them, then (re)load.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("WALKAI_DEMO_MODEL", "tiny")
+    monkeypatch.setenv("WALKAI_CALIB_WINDOW_S", "0.2")
+    monkeypatch.setenv("WALKAI_BENCH_WARMUP_S", "1")
+    monkeypatch.setenv("WALKAI_BENCH_SECONDS", "2")
+    monkeypatch.setenv("WALKAI_BENCH_PROBE_SECONDS", "1")
+    monkeypatch.setenv("WALKAI_BENCH_QOS_SECONDS", "2")
+    monkeypatch.setenv("WALKAI_BENCH_PIPELINE", "2")
+    monkeypatch.setenv("WALKAI_BENCH_REQUEST_BATCH", "4")
+    monkeypatch.setenv("WALKAI_BENCH_MAX_BATCH", "8")
+    monkeypatch.setenv("WALKAI_BENCH_WINDOW_MS", "5.0")
+    import bench
+
+    bench = importlib.reload(bench)
+    yield bench
+    # Leave a clean module for any later importer. monkeypatch's own
+    # teardown runs AFTER this fixture's (reverse setup order), so undo
+    # the env explicitly first — reloading before the undo would re-bake
+    # the tiny test knobs into the module for the rest of the session.
+    monkeypatch.undo()
+    importlib.reload(bench)
+
+
+def test_serving_benchmark_runs_end_to_end(bench_mod):
+    r = bench_mod.serving_benchmark()
+    # The phase completed: throughput, probe, and QoS sections all
+    # produced real numbers (a crash anywhere raises instead).
+    assert r["throughput_images_per_s"] > 0
+    assert r["latency_mean_request_s"] > 0
+    assert r["latency_probe_p50_s"] > 0
+    assert r["client_errors"] == 0
+    assert len(r["qos_p99_per_stream_s"]) == bench_mod.N_STREAMS
+    assert len(r["qos_noisy_victim_p99_s"]) == bench_mod.N_STREAMS - 1
+    assert all(p > 0 for p in r["qos_p99_per_stream_s"])
+    assert r["noisy_neighbor_degradation_pct"] is not None
+    # Gap decomposition stays one consistent story.
+    assert r["utilization_gap_pct"] == pytest.approx(
+        100.0 - r["utilization_pct"], abs=0.02
+    )
