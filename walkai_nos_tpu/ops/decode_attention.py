@@ -7,15 +7,27 @@ attention for one decode step in ONE pass over the cache per
 [1, cache_len] score vector never leaves VMEM, and accumulation is f32
 regardless of the cache dtype.
 
-**Measured verdict (v5e, batch 128, cache 256-384): XLA wins.** XLA's
-own fusion of the single-query chain (QK einsum -> mask -> softmax ->
-PV) also reads K/V exactly once and sustains ~775 GB/s effective; the
-kernel's per-(batch, head) [1, d] x [d, s] matvecs are MXU-latency-
-bound at ~240 GB/s — a single query gives the systolic array no
-sublane depth to pipeline. `LMConfig.decode_kernel` therefore defaults
-to the XLA path; the kernel stays parity-tested as the base for
-variants XLA cannot express (prefix-length early exit needs a
-runtime-bounded grid).
+**Measured verdict (v5e, batch 128, cache 256-384): XLA wins for MHA,
+the kernel wins for GQA.** XLA's own fusion of the single-query chain
+(QK einsum -> mask -> softmax -> PV) also reads K/V exactly once and
+sustains ~775 GB/s effective; a one-cell-per-grid-step kernel's
+[1, d] x [d, s] matvecs were MXU-latency-bound at ~240 GB/s — a
+single query gives the systolic array no sublane depth to pipeline.
+`LMConfig.decode_kernel` therefore defaults to the XLA path for
+standard multi-head attention.
+
+Grouped-query attention flips the verdict. XLA has no fast lowering
+for the grouped shape (every formulation tried — rank-3 bmm, 4-D
+einsum, broadcast-expand, explicit mul-reduce — measured 1.5-2.1
+ms/step in the serving model vs MHA's 1.05), but the BLOCKED kernel
+here (`_gqa_block_kernel`: several (batch, kv-head) cells per grid
+step, statically unrolled [group, d] x [d, s] dots, so DMA amortizes
+and the MXU pipeline stays full) reaches 0.98 ms/step — decode with a
+4x-smaller cache becomes FASTER than MHA (130k vs 122k tok/s,
+per-call latency 0.16 vs 0.21 s) instead of 1.5x slower. GQA decode
+therefore ALWAYS routes through this kernel on TPU. MHA is the same
+kernel at group=1 (one code path, one parity surface), used when
+`decode_kernel=True` opts out of the XLA default.
 
 Masking uses the cache index (a runtime scalar, prefetched to SMEM):
 position p is visible iff p <= index. The cache rows above `index` are
@@ -49,9 +61,15 @@ def decode_attention_reference(
     """Plain XLA single-query attention over a cache.
 
     q: [batch, heads, head_dim] (the one new query, at position `index`);
-    k/v: [batch, heads, cache_len, head_dim]; index: int32 scalar.
+    k/v: [batch, kv_heads, cache_len, head_dim] where kv_heads divides
+    heads (kv_heads < heads = grouped-query attention: query head i
+    reads KV head i // group); index: int32 scalar.
     Returns [batch, heads, head_dim]. Positions > index are masked.
     """
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum(
         "bhd,bhkd->bhk", q, k, preferred_element_type=jnp.float32
@@ -65,58 +83,82 @@ def decode_attention_reference(
     ).astype(q.dtype)
 
 
-def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, o_ref):
-    """One (batch*head) grid cell: single-query attention in one pass.
+# (batch * kv_heads) cells fused per grid step in the blocked kernel:
+# amortizes per-cell DMA/dispatch latency (the limiter for one-cell
+# grids). 8/16/32 measured within 1% of each other on v5e; smaller
+# divisors cover odd batch sizes. The choice is additionally capped so
+# one grid step's K+V blocks (double-buffered) fit a conservative VMEM
+# budget — long caches shrink the block instead of failing to compile.
+_GQA_BLOCK_CANDIDATES = (16, 8, 4, 2, 1)
+_VMEM_BLOCK_BUDGET_BYTES = 8 * 1024 * 1024
 
-    Refs are [1, head_dim] for q/o and [cache_len, head_dim] for k/v;
-    idx_ref is the SMEM-prefetched cache index. Everything — scores,
-    mask, softmax, weighted sum — stays in VMEM/registers. (Plain 2-D
-    dots: Mosaic's dot lowering rejects head-batched dimension
-    numbers, so heads live on the grid, as in `ops/attention.py`.)
-    """
+
+def _gqa_block_kernel(n_blk, idx_ref, q_ref, k_ref, v_ref, o_ref):
+    """One grid step: `n_blk` independent (batch, kv-head) cells,
+    statically unrolled. Refs are [n_blk, group, d] (q/o) and
+    [n_blk, cache_len, d] (k/v); each cell is one [group, d] x [d, s]
+    dot -> mask -> softmax -> [group, s] x [s, d] dot, f32 accumulation,
+    everything in VMEM. The unrolled dots pipeline through the MXU
+    back-to-back — one cell's [group, d] matvec alone would leave the
+    systolic array latency-bound (see module docstring). group=1 is
+    plain multi-head single-query attention — the MHA kernel is this
+    kernel. (Per-cell 2-D dots: Mosaic's dot lowering rejects
+    head-batched dimension numbers, so cells live on the grid and the
+    unrolled loop, as in `ops/attention.py`. K/V/q stay in their
+    storage dtype: the MXU multiplies bf16 natively with f32
+    accumulation — an astype(f32) here would spend VPU cycles
+    converting the whole cache block and double its vreg footprint.
+    The softmax scale is applied to the f32 scores, not pre-applied to
+    a bf16 q, which would round the scaled query.)"""
     idx = idx_ref[0]
-    # K/V/q stay in their storage dtype: the MXU multiplies bf16
-    # natively with f32 accumulation (preferred_element_type) — an
-    # explicit astype(f32) here would spend VPU cycles converting the
-    # whole cache block and double its vreg footprint. The softmax
-    # scale is applied to the f32 scores (not pre-applied to a bf16 q,
-    # which would round the scaled query), matching the reference.
-    s = jax.lax.dot_general(
-        q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * (q_ref.shape[-1] ** -0.5)  # [1, cache_len] f32
-    pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(pos <= idx, s, _NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        (p / l).astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [1, head_dim] f32
-    o_ref[...] = o.astype(o_ref.dtype)
+    scale = q_ref.shape[-1] ** -0.5
+    for i in range(n_blk):
+        s = jax.lax.dot_general(
+            q_ref[i], k_ref[i], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [group, cache_len] f32
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= idx, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(
+            (p / l).astype(v_ref.dtype), v_ref[i],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[i] = o.astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _decode_pallas(q, k, v, index, interpret=False):
-    b, h, s, d = k.shape
-    qr = q.reshape(b * h, 1, d)
-    kr = k.reshape(b * h, s, d)
-    vr = v.reshape(b * h, s, d)
+def _gqa_pallas(q, k, v, index, interpret=False):
+    b, kvh, s, d = k.shape
+    h = q.shape[1]
+    g = h // kvh
+    n = b * kvh
+    # K+V per cell, double-buffered by the Mosaic pipeline.
+    cell_bytes = 2 * 2 * s * d * k.dtype.itemsize
+    max_blk = max(1, _VMEM_BLOCK_BUDGET_BYTES // cell_bytes)
+    blk = next(
+        c for c in _GQA_BLOCK_CANDIDATES if c <= max_blk and n % c == 0
+    )
+    qr = q.reshape(n, g, d)
+    kr = k.reshape(n, s, d)
+    vr = v.reshape(n, s, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b * h,),
+        grid=(n // blk,),
         in_specs=[
-            pl.BlockSpec((None, 1, d), lambda i, idx: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, idx: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, idx: (i, 0, 0)),
+            pl.BlockSpec((blk, g, d), lambda i, idx: (i, 0, 0)),
+            pl.BlockSpec((blk, s, d), lambda i, idx: (i, 0, 0)),
+            pl.BlockSpec((blk, s, d), lambda i, idx: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, 1, d), lambda i, idx: (i, 0, 0)),
+        out_specs=pl.BlockSpec((blk, g, d), lambda i, idx: (i, 0, 0)),
     )
     out = pl.pallas_call(
-        _decode_kernel,
+        functools.partial(_gqa_block_kernel, blk),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, g, d), q.dtype),
         interpret=interpret,
     )(jnp.reshape(index, (1,)).astype(jnp.int32), qr, kr, vr)
     return out.reshape(b, h, d)
@@ -132,11 +174,13 @@ def decode_attention(
 ) -> jax.Array:
     """Fused single-query cache attention for the decode step.
 
-    q: [batch, heads, head_dim]; k/v: [batch, heads, cache_len,
-    head_dim]; index: int32 scalar — the position of `q`, and the last
-    visible cache row. Uses the Pallas kernel on TPU (or in interpret
-    mode when forced); falls back to the XLA reference otherwise or
-    when the cache length doesn't tile the VPU lane width.
+    q: [batch, heads, head_dim]; k/v: [batch, kv_heads, cache_len,
+    head_dim] with kv_heads dividing heads (kv_heads < heads = GQA,
+    kv_heads == heads = plain MHA — both run the same blocked kernel,
+    MHA being group=1); index: int32 scalar — the position of `q`, and
+    the last visible cache row. Uses the Pallas kernel on TPU (or in
+    interpret mode when forced); falls back to the XLA reference
+    otherwise or when the cache length doesn't tile the VPU lane width.
     """
     if interpret is None:
         interpret = False
@@ -144,4 +188,4 @@ def decode_attention(
             return decode_attention_reference(q, k, v, index)
     if k.shape[2] % 128 != 0:
         return decode_attention_reference(q, k, v, index)
-    return _decode_pallas(q, k, v, index, interpret=interpret)
+    return _gqa_pallas(q, k, v, index, interpret=interpret)
