@@ -213,3 +213,25 @@ class TestGroupedQueryDecode:
         k = cache["block0"]["attn"]["cached_key"]
         head_dim = cfg.hidden_dim // cfg.num_heads
         assert k.shape == (2, 1, 16, head_dim)
+
+
+class TestLlamaFamilyDecode:
+    def test_rope_rmsnorm_swiglu_matches_full_forward(self):
+        """RoPE decode (rotate at cache index, cache stores rotated
+        keys) + RMSNorm + SwiGLU must keep the exact-greedy-equivalence
+        property of every other decode path."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            CFG, norm="rmsnorm", mlp="swiglu", rope=True,
+            use_bias=False, head_bias=False, num_kv_heads=1,
+        )
+        model = DecoderLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        out = make_generate_fn(cfg)(params, _prompt(), max_new_tokens=6)
+        seq = _prompt()
+        for t in range(6):
+            logits = model.apply({"params": params}, seq)
+            expect = jnp.argmax(logits[:, -1], axis=-1)
+            assert jnp.array_equal(expect, out[:, t]), t
+            seq = jnp.concatenate([seq, out[:, t : t + 1]], axis=1)

@@ -167,3 +167,107 @@ class TestExport:
         })
         with pytest.raises(ValueError, match="head_bias"):
             state_dict_from_params(params, cfg)
+
+
+def _hf_llama(seed=0, kv_heads=2):
+    torch.manual_seed(seed)
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=kv_heads,
+        intermediate_size=48, max_position_embeddings=64,
+        rms_norm_eps=1e-6, attention_dropout=0.0,
+        tie_word_embeddings=False,
+    )
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+class TestLlamaImport:
+    def test_config_mapping(self):
+        from walkai_nos_tpu.models.hf import config_from_llama
+
+        hf = _hf_llama()
+        cfg = config_from_llama(hf.config)
+        assert cfg.norm == "rmsnorm"
+        assert cfg.mlp == "swiglu"
+        assert cfg.rope and not cfg.use_bias and not cfg.head_bias
+        assert cfg.num_kv_heads == 2
+        assert cfg.mlp_dim == 48
+        assert cfg.layer_norm_eps == hf.config.rms_norm_eps
+
+    @pytest.mark.parametrize("kv_heads", [1, 2, 4])
+    def test_forward_matches_torch(self, kv_heads):
+        """Exact logit parity incl. GQA/MQA variants: RMSNorm, RoPE,
+        SwiGLU, grouped heads all in agreement with transformers."""
+        from walkai_nos_tpu.models.hf import load_llama
+
+        hf = _hf_llama(kv_heads=kv_heads)
+        cfg, params = load_llama(hf)
+        tokens = np.random.default_rng(0).integers(0, 64, (2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.numpy()
+        ours = np.asarray(
+            DecoderLM(cfg).apply({"params": params}, jnp.asarray(tokens))
+        )
+        assert np.max(np.abs(ours - expected)) < 5e-4
+
+    def test_greedy_generation_matches_torch(self):
+        """KV-cache decode (RoPE offsets, grouped cache) must produce
+        HF's own greedy continuation."""
+        from walkai_nos_tpu.models.hf import load_llama
+
+        hf = _hf_llama(seed=1)
+        cfg, params = load_llama(hf)
+        prompt = np.random.default_rng(1).integers(0, 64, (1, 4))
+        with torch.no_grad():
+            expected = hf.generate(
+                torch.tensor(prompt), max_new_tokens=6, do_sample=False,
+                pad_token_id=0,
+            ).numpy()[:, 4:]
+        ours = np.asarray(
+            make_generate_fn(cfg)(
+                params, jnp.asarray(prompt), max_new_tokens=6
+            )
+        )
+        assert np.array_equal(ours, expected), (ours, expected)
+
+    def test_rope_scaling_rejected(self):
+        from walkai_nos_tpu.models.hf import config_from_llama
+
+        hf = _hf_llama()
+        hf.config.rope_scaling = {"rope_type": "linear", "factor": 2.0}
+        with pytest.raises(ValueError, match="rope_scaling"):
+            config_from_llama(hf.config)
+
+    def test_export_round_trips(self):
+        """import -> export -> torch forward equals our forward."""
+        from walkai_nos_tpu.models.hf import export_llama, load_llama
+
+        hf = _hf_llama(seed=2)
+        cfg, params = load_llama(hf)
+        hf_config, sd = export_llama(params, cfg)
+        clone = transformers.LlamaForCausalLM(hf_config).eval()
+        missing, unexpected = clone.load_state_dict(sd, strict=False)
+        assert not unexpected, unexpected
+        tokens = np.random.default_rng(3).integers(0, 64, (2, 8))
+        with torch.no_grad():
+            theirs = clone(torch.tensor(tokens)).logits.numpy()
+        ours = np.asarray(
+            DecoderLM(cfg).apply({"params": params}, jnp.asarray(tokens))
+        )
+        assert np.max(np.abs(ours - theirs)) < 5e-4
+
+    def test_mlp_bias_rejected(self):
+        from walkai_nos_tpu.models.hf import config_from_llama
+
+        hf = _hf_llama()
+        hf.config.mlp_bias = True
+        with pytest.raises(ValueError, match="mlp_bias"):
+            config_from_llama(hf.config)
+
+    def test_export_rejects_gpt2_family_config(self):
+        from walkai_nos_tpu.models.hf import export_llama
+
+        hf_gpt2 = _hf_model()
+        cfg, params = load_gpt2(hf_gpt2)
+        with pytest.raises(ValueError, match="llama-family"):
+            export_llama(params, cfg)

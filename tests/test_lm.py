@@ -228,3 +228,91 @@ class TestGroupedQueryAttention:
             LMConfig(num_heads=8, num_kv_heads=3)
         with pytest.raises(ValueError, match="num_kv_heads"):
             LMConfig(num_heads=8, num_kv_heads=0)
+
+
+class TestLlamaFamilyConfig:
+    """RMSNorm + RoPE + SwiGLU + no-bias (the llama layout) as pure
+    model knobs, independent of checkpoint import (tests/test_hf.py
+    pins exact parity against transformers)."""
+
+    def _cfg(self):
+        from dataclasses import replace
+
+        return replace(
+            LM_TINY, norm="rmsnorm", mlp="swiglu", mlp_dim=96,
+            rope=True, use_bias=False, head_bias=False, num_kv_heads=2,
+        )
+
+    def test_forward_and_causality(self):
+        cfg = self._cfg()
+        model = DecoderLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        toks = _tokens(cfg, b=2)
+        logits = model.apply({"params": params}, toks)
+        assert logits.shape == (2, cfg.max_seq_len, cfg.vocab_size)
+        toks_b = toks.at[0, -1].set((int(toks[0, -1]) + 1) % cfg.vocab_size)
+        logits_b = model.apply({"params": params}, toks_b)
+        np.testing.assert_allclose(
+            np.asarray(logits[0, :-1]), np.asarray(logits_b[0, :-1]),
+            atol=1e-5,
+        )
+
+    def test_no_pos_embed_and_no_biases(self):
+        params = DecoderLM(self._cfg()).init_params(jax.random.PRNGKey(0))
+        assert "pos_embed" not in params
+        block = params["block0"]
+        assert "bias" not in block["attn"]["qkv"]
+        assert "bias" not in block["gate"] and "bias" not in block["fc2"]
+        assert "bias" not in params["norm"]  # RMSNorm is scale-only
+        assert block["gate"]["kernel"].shape == (LM_TINY.hidden_dim, 96)
+
+    def test_trains(self):
+        cfg = self._cfg()
+        mesh = build_mesh(jax.devices())
+        state = init_lm_state(cfg, mesh, jax.random.PRNGKey(0), lr=1e-2)
+        step = make_lm_train_step(cfg, mesh, lr=1e-2)
+        toks = _tokens(cfg)
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, toks)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_bad_knobs_rejected(self):
+        import pytest
+        from dataclasses import replace
+
+        with pytest.raises(ValueError, match="norm"):
+            replace(LM_TINY, norm="batchnorm")
+        with pytest.raises(ValueError, match="mlp"):
+            replace(LM_TINY, mlp="relu")
+
+    def test_rope_properties(self):
+        """apply_rope is a rotation (norm-preserving), identity at
+        position 0, and relative: q.k after rotation depends only on
+        the position DIFFERENCE — the property that makes rotary
+        embeddings a position encoding at all."""
+        from walkai_nos_tpu.models.lm import apply_rope
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((1, 2, 6, 32)), jnp.float32)
+        pos = jnp.arange(6)
+        rot = apply_rope(x, pos, 10000.0)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(rot), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(rot[:, :, 0]), np.asarray(x[:, :, 0]), atol=1e-6
+        )
+        q = jnp.asarray(rng.standard_normal((1, 1, 1, 32)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 1, 1, 32)), jnp.float32)
+
+        def dot_at(pq, pk):
+            rq = apply_rope(q, jnp.array([pq]), 10000.0)
+            rk = apply_rope(k, jnp.array([pk]), 10000.0)
+            return float(jnp.sum(rq * rk))
+
+        assert abs(dot_at(5, 3) - dot_at(9, 7)) < 1e-3  # same offset
+        assert abs(dot_at(5, 3) - dot_at(5, 4)) > 1e-3  # different

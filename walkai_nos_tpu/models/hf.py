@@ -1,12 +1,21 @@
-"""HuggingFace GPT-2 checkpoint import for the decoder LM.
+"""HuggingFace checkpoint import (GPT-2 and llama families) for the
+decoder LM.
 
-Maps a `transformers` GPT-2-family model (torch, CPU) onto
-`DecoderLM`'s parameter tree so existing checkpoints serve/fine-tune on
-TPU slices through this framework — the interop a user switching from
-the torch ecosystem expects. The architectures correspond exactly:
-pre-LN blocks, learned positions, fused qkv (HF Conv1D stores kernels
-[in, out], same orientation as flax Dense), gelu_new == flax's default
-tanh-approximated gelu, and a weight-tied LM head (wte^T).
+Maps a `transformers` model (torch, CPU) onto `DecoderLM`'s parameter
+tree so existing checkpoints serve/fine-tune on TPU slices through this
+framework — the interop a user switching from the torch ecosystem
+expects.
+
+GPT-2 family: pre-LN blocks, learned positions, fused qkv (HF Conv1D
+stores kernels [in, out], same orientation as flax Dense), gelu_new ==
+flax's default tanh-approximated gelu, weight-tied LM head (wte^T).
+
+Llama family (`load_llama`): RMSNorm, RoPE (HF half-split rotary),
+SwiGLU MLP, grouped-query attention, no biases — DecoderLM expresses
+all of these via LMConfig (norm/mlp/rope/use_bias/num_kv_heads); the
+separate q/k/v/o Linear weights ([out, in], transposed on import)
+concatenate into the fused qkv kernel in the same [q | k | v] channel
+order the model slices.
 
 No reference analogue — compute-runtime interop, per the TPU mandate.
 """
@@ -111,6 +120,178 @@ def load_gpt2(model_or_name) -> tuple[LMConfig, dict]:
     return cfg, params_from_gpt2(model_or_name.state_dict(), cfg)
 
 
+def config_from_llama(hf_config) -> LMConfig:
+    """LMConfig mirroring a `transformers.LlamaConfig`."""
+    if getattr(hf_config, "rope_scaling", None) is not None:
+        raise ValueError(
+            "rope_scaling variants (linear/dynamic/yarn) are not "
+            "supported; only default rotary embeddings map onto "
+            "DecoderLM's apply_rope"
+        )
+    if getattr(hf_config, "attention_bias", False) or getattr(
+        hf_config, "mlp_bias", False
+    ):
+        raise ValueError(
+            "attention_bias/mlp_bias llama variants are not supported: "
+            "DecoderLM expresses the llama family bias-free "
+            "(use_bias=False); importing would silently drop the biases"
+        )
+    if getattr(hf_config, "hidden_act", "silu") != "silu":
+        raise ValueError(
+            f"only silu llama variants map onto DecoderLM's swiglu "
+            f"(got {hf_config.hidden_act})"
+        )
+    return LMConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_dim=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=hf_config.num_key_value_heads,
+        mlp_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        dtype="float32",
+        layer_norm_eps=hf_config.rms_norm_eps,
+        norm="rmsnorm",
+        mlp="swiglu",
+        rope=True,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        use_bias=False,
+        head_bias=False,
+    )
+
+
+def params_from_llama(state_dict: Mapping, cfg: LMConfig) -> dict:
+    """DecoderLM params pytree from a LlamaForCausalLM state_dict."""
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def linear(prefix: str) -> jnp.ndarray:
+        # torch Linear stores [out_features, in_features]; flax Dense
+        # kernels are [in, out].
+        return jnp.asarray(_np(sd[f"{prefix}.weight"]).T)
+
+    embed = _np(sd["embed_tokens.weight"])  # [vocab, hidden]
+    if "lm_head.weight" in state_dict:
+        head = jnp.asarray(_np(state_dict["lm_head.weight"]).T)
+    else:  # tie_word_embeddings checkpoints ship no separate head
+        head = jnp.asarray(embed.T)
+    params: dict = {
+        "embed": {"embedding": jnp.asarray(embed)},
+        "norm": {"scale": jnp.asarray(_np(sd["norm.weight"]))},
+        "head": {"kernel": head},
+    }
+    for i in range(cfg.num_layers):
+        h = f"layers.{i}"
+        qkv = jnp.concatenate(
+            [
+                linear(f"{h}.self_attn.q_proj"),
+                linear(f"{h}.self_attn.k_proj"),
+                linear(f"{h}.self_attn.v_proj"),
+            ],
+            axis=1,
+        )  # [hidden, d + 2 * kv_dim] — the fused [q | k | v] layout
+        params[f"block{i}"] = {
+            "norm1": {
+                "scale": jnp.asarray(
+                    _np(sd[f"{h}.input_layernorm.weight"])
+                )
+            },
+            "attn": {
+                "qkv": {"kernel": qkv},
+                "out_proj": {"kernel": linear(f"{h}.self_attn.o_proj")},
+            },
+            "norm2": {
+                "scale": jnp.asarray(
+                    _np(sd[f"{h}.post_attention_layernorm.weight"])
+                )
+            },
+            "gate": {"kernel": linear(f"{h}.mlp.gate_proj")},
+            "fc1": {"kernel": linear(f"{h}.mlp.up_proj")},
+            "fc2": {"kernel": linear(f"{h}.mlp.down_proj")},
+        }
+    return params
+
+
+def load_llama(model_or_name) -> tuple[LMConfig, dict]:
+    """(LMConfig, params) from a LlamaForCausalLM instance or name."""
+    if isinstance(model_or_name, str):
+        from transformers import LlamaForCausalLM
+
+        model_or_name = LlamaForCausalLM.from_pretrained(model_or_name)
+    cfg = config_from_llama(model_or_name.config)
+    return cfg, params_from_llama(model_or_name.state_dict(), cfg)
+
+
+def export_llama(params: Mapping, cfg: LMConfig):
+    """(LlamaConfig, state_dict): round-trip back to torch.
+
+    The config mirrors `config_from_llama`'s mapping;
+    `tie_word_embeddings` is set from the params' actual tie state
+    (same rationale as `export_gpt2`).
+    """
+    import torch
+    from transformers import LlamaConfig
+
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "MoE blocks have no llama analogue; export a dense "
+            "(num_experts=0) DecoderLM"
+        )
+    if cfg.norm != "rmsnorm" or cfg.mlp != "swiglu" or not cfg.rope:
+        raise ValueError(
+            "not a llama-family config (needs rmsnorm/swiglu/rope); "
+            "use export_gpt2 for GPT-2-family models"
+        )
+
+    def t(x, transpose=True) -> "torch.Tensor":
+        # copy: jax arrays view as non-writable numpy; torch wants
+        # owned memory.
+        arr = np.array(x, np.float32)
+        return torch.from_numpy(arr.T.copy() if transpose else arr)
+
+    config = LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_dim,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.kv_heads,
+        intermediate_size=cfg.mlp_width,
+        max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.layer_norm_eps,
+        rope_theta=cfg.rope_theta,
+        attention_bias=False,
+        tie_word_embeddings=heads_are_tied(params),
+    )
+    d = cfg.hidden_dim
+    kv_dim = cfg.kv_heads * (d // cfg.num_heads)
+    sd = {
+        "model.embed_tokens.weight": t(
+            params["embed"]["embedding"], transpose=False
+        ),
+        "model.norm.weight": t(params["norm"]["scale"], transpose=False),
+        "lm_head.weight": t(params["head"]["kernel"]),
+    }
+    for i in range(cfg.num_layers):
+        block = params[f"block{i}"]
+        h = f"model.layers.{i}"
+        qkv = np.asarray(block["attn"]["qkv"]["kernel"], np.float32)
+        sd[f"{h}.self_attn.q_proj.weight"] = t(qkv[:, :d])
+        sd[f"{h}.self_attn.k_proj.weight"] = t(qkv[:, d:d + kv_dim])
+        sd[f"{h}.self_attn.v_proj.weight"] = t(qkv[:, d + kv_dim:])
+        sd[f"{h}.self_attn.o_proj.weight"] = t(
+            block["attn"]["out_proj"]["kernel"]
+        )
+        sd[f"{h}.input_layernorm.weight"] = t(
+            block["norm1"]["scale"], transpose=False
+        )
+        sd[f"{h}.post_attention_layernorm.weight"] = t(
+            block["norm2"]["scale"], transpose=False
+        )
+        sd[f"{h}.mlp.gate_proj.weight"] = t(block["gate"]["kernel"])
+        sd[f"{h}.mlp.up_proj.weight"] = t(block["fc1"]["kernel"])
+        sd[f"{h}.mlp.down_proj.weight"] = t(block["fc2"]["kernel"])
+    return config, sd
+
+
 def heads_are_tied(params: Mapping, atol: float = 1e-5) -> bool:
     """True when the LM head still equals the token embedding (wte^T)."""
     return bool(np.allclose(
@@ -168,6 +349,11 @@ def state_dict_from_params(
     """
     import torch
 
+    if cfg.norm != "layernorm" or cfg.mlp != "gelu" or cfg.rope:
+        raise ValueError(
+            "not a GPT-2-family config (rmsnorm/swiglu/rope); use "
+            "export_llama for llama-family models"
+        )
     if not untied_ok and not heads_are_tied(params):
         raise ValueError(
             "the LM head has untied from the token embedding (training "

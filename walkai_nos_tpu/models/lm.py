@@ -45,6 +45,27 @@ class LMConfig:
     # attention has one. Training repeats K/V to full heads before the
     # fused kernels (the repeat is free relative to a training step).
     num_kv_heads: int | None = None
+    # Architecture family knobs (defaults = the GPT-2 family; the
+    # llama family is norm="rmsnorm", mlp="swiglu", rope=True,
+    # use_bias=False — models/hf.py's config_from_llama sets them from
+    # a transformers LlamaConfig):
+    # - norm: "layernorm" (learned scale+bias, mean-subtracted) or
+    #   "rmsnorm" (scale only, RMS-scaled; llama).
+    # - mlp: "gelu" (fc1 -> gelu -> fc2) or "swiglu"
+    #   (silu(gate) * fc1 -> fc2; llama).
+    # - mlp_dim: explicit MLP width (llama's intermediate_size is not
+    #   a multiple of hidden_dim); None = mlp_ratio * hidden_dim.
+    # - rope: rotary position embeddings applied to q/k per absolute
+    #   position (HF half-split convention) instead of a learned
+    #   pos_embed table; cached keys are stored rotated.
+    # - use_bias: biases on the attention/MLP projections (llama has
+    #   none; the LM head keeps its separate head_bias flag).
+    norm: str = "layernorm"
+    mlp: str = "gelu"
+    mlp_dim: int | None = None
+    rope: bool = False
+    rope_theta: float = 10000.0
+    use_bias: bool = True
     # Sequence parallelism: shard the sequence over the mesh's `seq` axis
     # and run ring attention instead of the local kernel — or Ulysses
     # all-to-all attention (heads must divide the seq axis; two
@@ -97,6 +118,10 @@ class LMConfig:
                 f"num_kv_heads must divide num_heads="
                 f"{self.num_heads}; got {self.num_kv_heads}"
             )
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"unknown norm {self.norm!r}")
+        if self.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown mlp {self.mlp!r}")
 
     @property
     def compute_dtype(self):
@@ -106,12 +131,54 @@ class LMConfig:
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
 
+    @property
+    def mlp_width(self) -> int:
+        return self.mlp_dim or self.mlp_ratio * self.hidden_dim
+
 
 LM_TINY = LMConfig(
     vocab_size=256, hidden_dim=128, num_layers=2, num_heads=4,
     max_seq_len=128,
 )
 LM_SMALL = LMConfig()
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float
+) -> jax.Array:
+    """Rotary position embedding, HF half-split convention.
+
+    x: [batch, heads, seq, head_dim]; positions: [seq] absolute token
+    positions. Pairs dimension i with i + head_dim/2 (rotate_half), the
+    layout transformers uses for llama-family checkpoints — imported
+    weights must rotate exactly the way they were trained. Angles are
+    computed in f32 (bf16 loses position resolution fast) and the
+    result cast back to x's dtype.
+    """
+    d = x.shape[-1]
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)[None, None]
+    sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)[None, None]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (
+        x.astype(jnp.float32) * cos + rotated.astype(jnp.float32) * sin
+    ).astype(x.dtype)
+
+
+def _make_norm(cfg: LMConfig, name: str):
+    """LayerNorm or RMSNorm per the config (f32 compute either way —
+    norms are where bf16 error compounds)."""
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name=name
+        )
+    return nn.LayerNorm(
+        epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name=name
+    )
 
 
 class CausalAttention(nn.Module):
@@ -128,7 +195,10 @@ class CausalAttention(nn.Module):
         # Fused projection: [q | k | v] channel blocks. With GQA the
         # K/V blocks are kv_heads wide; at kv_heads == num_heads this
         # is the same 3d-channel kernel (and layout) as always.
-        qkv = nn.Dense(d + 2 * kv_dim, dtype=c.compute_dtype, name="qkv")(x)
+        qkv = nn.Dense(
+            d + 2 * kv_dim, dtype=c.compute_dtype, use_bias=c.use_bias,
+            name="qkv",
+        )(x)
         b, s = x.shape[0], x.shape[1]
         q = qkv[..., :d].reshape(
             b, s, c.num_heads, head_dim
@@ -142,6 +212,13 @@ class CausalAttention(nn.Module):
         if decode:
             o = self._decode_attention(q, k, v)
         else:
+            if c.rope:
+                # Training/full-forward path rotates by sequence
+                # position here; the decode path rotates inside
+                # _decode_attention, offset by the cache index.
+                pos = jnp.arange(s)
+                q = apply_rope(q, pos, c.rope_theta)
+                k = apply_rope(k, pos, c.rope_theta)
             if kv_heads != c.num_heads:
                 # Training reads the whole sequence anyway; repeat K/V
                 # to full heads (query head i uses KV head i // group)
@@ -151,7 +228,9 @@ class CausalAttention(nn.Module):
                 v = jnp.repeat(v, c.num_heads // kv_heads, axis=1)
             o = self._sequence_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
-        return nn.Dense(d, dtype=c.compute_dtype, name="out_proj")(o)
+        return nn.Dense(
+            d, dtype=c.compute_dtype, use_bias=c.use_bias, name="out_proj"
+        )(o)
 
     def _sequence_attention(self, q, k, v):
         c = self.cfg
@@ -188,6 +267,13 @@ class CausalAttention(nn.Module):
         if self.is_initializing():
             return jnp.zeros_like(q)
         idx = index.value
+        if c.rope:
+            # Rotate by absolute position before caching: stored keys
+            # are rotated once, forever — exactly the full-forward
+            # semantics, with no re-rotation of the cache per step.
+            pos = idx + jnp.arange(steps)
+            q = apply_rope(q, pos, c.rope_theta)
+            k = apply_rope(k, pos, c.rope_theta)
         k_all = jax.lax.dynamic_update_slice(
             cached_k.value, k.astype(cached_k.value.dtype), (0, 0, idx, 0)
         )
@@ -196,15 +282,16 @@ class CausalAttention(nn.Module):
         )
         cached_k.value, cached_v.value = k_all, v_all
         index.value = idx + steps
-        if steps == 1 and c.decode_kernel and kv_heads == heads:
-            # Optional fused Pallas path (see LMConfig.decode_kernel
-            # for why XLA is the default): K/V read exactly once with
-            # mask+softmax+PV on-chip; the cache write above stays an
-            # XLA dynamic_update_slice (one [b,h,1,d] row — in-place
-            # under the scan's buffer aliasing). GQA takes the grouped
-            # einsum below instead (its group-of-queries matmul rows
-            # are exactly the sublane depth the kernel's single-query
-            # cells lack).
+        if steps == 1 and (kv_heads != heads or c.decode_kernel):
+            # Fused Pallas path (ops/decode_attention.py): K/V read
+            # exactly once with mask+softmax+PV on-chip; the cache
+            # write above stays an XLA dynamic_update_slice (one
+            # [b,h,1,d] row — in-place under the scan's buffer
+            # aliasing). GQA single steps ALWAYS route here — XLA has
+            # no fast lowering for the grouped shape (every einsum
+            # formulation measured 1.5-2x slower than the blocked
+            # kernel) — while MHA opts in via decode_kernel (XLA's
+            # single-query fusion wins there; see LMConfig).
             o = decode_attention(q[:, :, 0], k_all, v_all, idx)
             return o[:, :, None, :]
         q_pos = idx + jnp.arange(steps)
@@ -212,18 +299,10 @@ class CausalAttention(nn.Module):
         mask = k_pos[None, :] <= q_pos[:, None]  # [steps, cache_len]
         scale = head_dim ** -0.5
         if kv_heads != heads:
-            # Grouped-query attention: query head i reads KV head
-            # i // group; the K/V cache is read once at kv_heads width
-            # (the whole point: the decode step's HBM traffic shrinks
-            # by the group factor). Single steps ALWAYS use the fused
-            # blocked kernel on TPU — unlike MHA, XLA has no fast
-            # lowering for the grouped shape (every einsum formulation
-            # measured 1.5-2x slower than the kernel; see
-            # ops/decode_attention.py). Prefill (steps > 1) uses the
-            # grouped einsum below, a one-time cost per call.
-            if steps == 1:
-                o = decode_attention(q[:, :, 0], k_all, v_all, idx)
-                return o[:, :, None, :]
+            # Grouped-query attention prefill (single steps returned
+            # above): query head i reads KV head i // group; the K/V
+            # cache is read once at kv_heads width — the decode step's
+            # HBM traffic shrinks by the group factor.
             group = heads // kv_heads
             # Rank-3 batched matmuls ([b*kv_heads] batch cells, group*
             # steps query rows each): K/V stream once in their storage
@@ -263,20 +342,15 @@ class DecoderBlock(nn.Module):
     def __call__(self, x, *, decode: bool = False):
         c = self.cfg
         x = x + CausalAttention(c, self.mesh, name="attn")(
-            nn.LayerNorm(
-                epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm1"
-            )(x),
-            decode=decode,
+            _make_norm(c, "norm1")(x), decode=decode,
         )
-        h = nn.LayerNorm(
-            epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm2"
-        )(x)
+        h = _make_norm(c, "norm2")(x)
         if self.use_moe:
             from walkai_nos_tpu.models.moe import MoEMlp
 
             return x + MoEMlp(
                 hidden_dim=c.hidden_dim,
-                mlp_dim=c.mlp_ratio * c.hidden_dim,
+                mlp_dim=c.mlp_width,
                 num_experts=c.num_experts,
                 top_k=c.expert_top_k,
                 capacity_factor=c.capacity_factor,
@@ -284,10 +358,26 @@ class DecoderBlock(nn.Module):
                 mesh=self.mesh,
                 name="moe",
             )(h)
-        h = nn.Dense(c.mlp_ratio * c.hidden_dim, dtype=c.compute_dtype,
-                     name="fc1")(h)
-        h = nn.gelu(h)
-        return x + nn.Dense(c.hidden_dim, dtype=c.compute_dtype, name="fc2")(h)
+        if c.mlp == "swiglu":
+            gate = nn.Dense(
+                c.mlp_width, dtype=c.compute_dtype, use_bias=c.use_bias,
+                name="gate",
+            )(h)
+            up = nn.Dense(
+                c.mlp_width, dtype=c.compute_dtype, use_bias=c.use_bias,
+                name="fc1",
+            )(h)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.Dense(
+                c.mlp_width, dtype=c.compute_dtype, use_bias=c.use_bias,
+                name="fc1",
+            )(h)
+            h = nn.gelu(h)
+        return x + nn.Dense(
+            c.hidden_dim, dtype=c.compute_dtype, use_bias=c.use_bias,
+            name="fc2",
+        )(h)
 
 
 class DecoderLM(nn.Module):
@@ -307,22 +397,26 @@ class DecoderLM(nn.Module):
             c.vocab_size, c.hidden_dim,
             dtype=c.compute_dtype, name="embed",
         )(tokens)
-        pos = self.param(
-            "pos_embed", nn.initializers.normal(0.02),
-            (1, c.max_seq_len, c.hidden_dim),
-        )
-        if decode:
-            pos_index = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+        if not c.rope:
+            # Learned absolute positions; with RoPE the position signal
+            # is applied to q/k inside attention instead and no table
+            # exists (llama layout).
+            pos = self.param(
+                "pos_embed", nn.initializers.normal(0.02),
+                (1, c.max_seq_len, c.hidden_dim),
             )
-            offset = pos_index.value
-            if not self.is_initializing():
-                pos_index.value = offset + tokens.shape[1]
-            x = x + jax.lax.dynamic_slice(
-                pos, (0, offset, 0), (1, tokens.shape[1], c.hidden_dim)
-            ).astype(x.dtype)
-        else:
-            x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
+            if decode:
+                pos_index = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                offset = pos_index.value
+                if not self.is_initializing():
+                    pos_index.value = offset + tokens.shape[1]
+                x = x + jax.lax.dynamic_slice(
+                    pos, (0, offset, 0), (1, tokens.shape[1], c.hidden_dim)
+                ).astype(x.dtype)
+            else:
+                x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
         # Remat only matters for training's backward pass; decode mode
         # caches anyway — and remat would trace the static decode kwarg,
         # so the rematted call omits it (default False).
@@ -335,9 +429,7 @@ class DecoderLM(nn.Module):
             use_moe = c.num_experts > 0 and (i + 1) % c.moe_every == 0
             block = block_cls(c, self.mesh, use_moe, name=f"block{i}")
             x = block(x) if use_remat else block(x, decode=decode)
-        x = nn.LayerNorm(
-            epsilon=c.layer_norm_eps, dtype=jnp.float32, name="norm"
-        )(x)
+        x = _make_norm(c, "norm")(x)
         return nn.Dense(
             c.vocab_size, dtype=jnp.float32, use_bias=c.head_bias,
             name="head",

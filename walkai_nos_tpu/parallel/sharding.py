@@ -34,14 +34,14 @@ _PARAM_RULES: list[tuple[str, P]] = [
     # then the usual Megatron column/row split within each expert.
     (r"experts_up", P(AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL)),
     (r"experts_down", P(AXIS_EXPERT, AXIS_MODEL, AXIS_FSDP)),
-    # Column-parallel: attention qkv + MLP up-projection.
-    (r"(qkv|query|key|value|fc1|up)/kernel", P(AXIS_FSDP, AXIS_MODEL)),
+    # Column-parallel: attention qkv + MLP up/gate-projections.
+    (r"(qkv|query|key|value|fc1|gate|up)/kernel", P(AXIS_FSDP, AXIS_MODEL)),
     # Row-parallel: attention output proj + MLP down-projection.
     (r"(out_proj|proj|fc2|down)/kernel", P(AXIS_MODEL, AXIS_FSDP)),
     # Detection/classifier heads: column-parallel.
     (r"(class_head|box_head|head)/.*kernel", P(AXIS_FSDP, AXIS_MODEL)),
     # Biases of column-parallel layers follow their kernel's output split.
-    (r"(qkv|query|key|value|fc1|up|class_head|box_head|head)/.*bias", P(AXIS_MODEL)),
+    (r"(qkv|query|key|value|fc1|gate|up|class_head|box_head|head)/.*bias", P(AXIS_MODEL)),
     # Everything else (layernorms, row-parallel biases, cls/det tokens,
     # position embeddings) is replicated.
     (r".*", P()),
