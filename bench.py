@@ -405,13 +405,20 @@ def decode_benchmark() -> dict:
     ceiling as the stated baseline (`bench_lm.measure_decode`), plus
     the speculative-decoding path (`bench_lm.measure_speculative`:
     briefly trains a target+draft pair on-chip so acceptance measures
-    draft quality, then times spec vs plain greedy on the same target).
-    Runs after the serving phase so phases never contend for the
-    device."""
-    from bench_lm import measure_decode, measure_speculative
+    draft quality, then times spec vs plain greedy on the same target)
+    and continuous batching (`bench_lm.measure_continuous_batching`:
+    slot-pool engine vs the naive serialized endpoint under the same
+    concurrent workload). Runs after the serving phase so phases never
+    contend for the device."""
+    from bench_lm import (
+        measure_continuous_batching,
+        measure_decode,
+        measure_speculative,
+    )
 
     result = measure_decode()
     result.update(measure_speculative())
+    result.update(measure_continuous_batching())
     return result
 
 

@@ -42,6 +42,7 @@ _SLOW_FILES = {
     "test_ops.py",
     "test_pipeline.py",
     "test_pool_seam.py",
+    "test_serve.py",
     "test_speculative.py",
     "test_trainer.py",
 }

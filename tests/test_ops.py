@@ -475,3 +475,27 @@ class TestGqaDecodeAttention:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=2e-5
         )
+
+    @pytest.mark.parametrize("kvh", [1, 2, 8])
+    def test_per_row_index_matches_reference(self, kvh):
+        """Ragged decoding (continuous batching) hands the kernel a
+        [batch] index vector — each cell masks at its own row's
+        position."""
+        from walkai_nos_tpu.ops import decode_attention as da
+
+        q, k, v = self._qkv(b=4, kvh=kvh)
+        idx = jnp.asarray([0, 17, 128, 255], jnp.int32)
+        out = da.decode_attention(q, k, v, idx, interpret=True)
+        ref = da.decode_attention_reference(q, k, v, idx)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+        # And the reference itself: row i must equal a scalar-index
+        # reference on that row alone.
+        for i, ix in enumerate([0, 17, 128, 255]):
+            solo = da.decode_attention_reference(
+                q[i : i + 1], k[i : i + 1], v[i : i + 1], jnp.int32(ix)
+            )
+            np.testing.assert_allclose(
+                np.asarray(ref[i : i + 1]), np.asarray(solo), atol=2e-5
+            )

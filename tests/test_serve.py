@@ -1,0 +1,156 @@
+"""Continuous batching (`models/serve.py`): exact parity with one-shot
+greedy generation, under staggered admission, slot reuse, and EOS."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2, max_seq_len=64
+)
+
+
+def _params(cfg=CFG, seed=0):
+    return DecoderLM(cfg).init_params(jax.random.PRNGKey(seed))
+
+
+def _prompts(n, seed=0, lo=2, hi=9):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, CFG.vocab_size, rng.integers(lo, hi))
+        .astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def _expected(cfg, params, prompt, max_new):
+    gen = make_generate_fn(cfg)
+    out = gen(params, jnp.asarray(prompt[None]), max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+class TestExactParity:
+    def test_concurrent_requests_match_standalone_greedy(self):
+        """Five ragged requests sharing 2 slots, all token-identical to
+        independent generate() calls — batch composition must never
+        leak into any sequence's output."""
+        params = _params()
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=64, prompt_bucket=16,
+            chunk_steps=4,
+        )
+        prompts = _prompts(5)
+        rids = {
+            engine.submit(p, max_new_tokens=7): p for p in prompts
+        }
+        results = engine.run()
+        for rid, p in rids.items():
+            assert results[rid] == _expected(CFG, params, p, 7), rid
+
+    def test_staggered_admission(self):
+        """Requests submitted while the batch is mid-flight join at a
+        chunk boundary and still decode exactly."""
+        params = _params()
+        engine = ContinuousBatcher(
+            CFG, params, slots=4, cache_len=64, chunk_steps=2,
+        )
+        early = _prompts(2, seed=1)
+        late = _prompts(2, seed=2)
+        rids = {engine.submit(p, max_new_tokens=9): p for p in early}
+        engine.step()
+        engine.step()
+        rids.update({engine.submit(p, max_new_tokens=5): p for p in late})
+        results = engine.run()
+        for rid, p in rids.items():
+            expect = _expected(
+                CFG, params, p, 9 if any(p is e for e in early) else 5
+            )
+            assert results[rid] == expect, rid
+
+    @pytest.mark.parametrize(
+        "variant",
+        [
+            dict(num_kv_heads=1),
+            dict(norm="rmsnorm", mlp="swiglu", rope=True,
+                 use_bias=False, head_bias=False, num_kv_heads=1),
+        ],
+        ids=["gqa", "llama"],
+    )
+    def test_architecture_variants(self, variant):
+        cfg = dataclasses.replace(CFG, **variant)
+        params = _params(cfg)
+        engine = ContinuousBatcher(cfg, params, slots=2, cache_len=64)
+        prompts = _prompts(3, seed=3)
+        rids = {engine.submit(p, max_new_tokens=6): p for p in prompts}
+        results = engine.run()
+        for rid, p in rids.items():
+            assert results[rid] == _expected(cfg, params, p, 6), rid
+
+
+class TestLifecycle:
+    def test_eos_frees_the_slot_early(self):
+        """A sequence hitting EOS leaves mid-stream; its output stops
+        at the EOS token and the freed slot serves the queue."""
+        params = _params()
+        prompts = _prompts(3, seed=4)
+        full = _expected(CFG, params, prompts[0], 8)
+        eos = full[2]  # force an early exit at the third token
+        engine = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=64, chunk_steps=2,
+        )
+        r0 = engine.submit(prompts[0], max_new_tokens=8, eos_id=eos)
+        r1 = engine.submit(prompts[1], max_new_tokens=4)
+        results = engine.run()
+        assert results[r0] == full[:3]  # truncated at EOS, inclusive
+        assert results[r1] == _expected(CFG, params, prompts[1], 4)
+
+    def test_single_token_request(self):
+        params = _params()
+        engine = ContinuousBatcher(CFG, params, slots=1, cache_len=64)
+        p = _prompts(1, seed=5)[0]
+        rid = engine.submit(p, max_new_tokens=1)
+        assert engine.run()[rid] == _expected(CFG, params, p, 1)
+
+    def test_more_requests_than_slots_queue(self):
+        params = _params()
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=64, chunk_steps=3,
+        )
+        prompts = _prompts(7, seed=6)
+        rids = {engine.submit(p, max_new_tokens=5): p for p in prompts}
+        results = engine.run()
+        assert len(results) == 7
+        for rid, p in rids.items():
+            assert results[rid] == _expected(CFG, params, p, 5), rid
+
+
+class TestGuards:
+    def test_oversized_prompt_rejected(self):
+        engine = ContinuousBatcher(
+            CFG, _params(), slots=1, cache_len=64, prompt_bucket=8,
+        )
+        with pytest.raises(ValueError, match="prompt_bucket"):
+            engine.submit(np.arange(9), max_new_tokens=2)
+
+    def test_cache_overflow_rejected(self):
+        engine = ContinuousBatcher(CFG, _params(), slots=1, cache_len=32)
+        with pytest.raises(ValueError, match="cache_len"):
+            engine.submit(np.arange(4), max_new_tokens=40)
+
+    def test_empty_prompt_rejected(self):
+        engine = ContinuousBatcher(CFG, _params(), slots=1, cache_len=32)
+        with pytest.raises(ValueError, match="empty"):
+            engine.submit(np.array([], np.int32), max_new_tokens=2)
+
+    def test_prompt_bucket_exceeding_cache_rejected(self):
+        with pytest.raises(ValueError, match="prompt_bucket"):
+            ContinuousBatcher(
+                CFG, _params(), slots=1, cache_len=32, prompt_bucket=64
+            )

@@ -108,6 +108,13 @@ class LMConfig:
     # blocked grouped kernel on TPU, where the verdict inverts (XLA
     # has no fast grouped lowering — ops/decode_attention.py).
     decode_kernel: bool = False
+    # Ragged (per-slot) decoding for continuous batching
+    # (models/serve.py): the cache index becomes a [batch] vector so
+    # every batch row sits at its own generation position — sequences
+    # join and leave the running batch at step boundaries. Cache
+    # writes become per-row scatters and the causal mask per-row;
+    # scalar-index decoding (the default) is untouched.
+    ragged_decode: bool = False
 
     def __post_init__(self):
         if self.num_kv_heads is not None and (
@@ -149,19 +156,25 @@ def apply_rope(
     """Rotary position embedding, HF half-split convention.
 
     x: [batch, heads, seq, head_dim]; positions: [seq] absolute token
-    positions. Pairs dimension i with i + head_dim/2 (rotate_half), the
-    layout transformers uses for llama-family checkpoints — imported
-    weights must rotate exactly the way they were trained. Angles are
-    computed in f32 (bf16 loses position resolution fast) and the
-    result cast back to x's dtype.
+    positions shared by the batch, or [batch, seq] per-row positions
+    (ragged decoding, where every slot sits at its own offset). Pairs
+    dimension i with i + head_dim/2 (rotate_half), the layout
+    transformers uses for llama-family checkpoints — imported weights
+    must rotate exactly the way they were trained. Angles are computed
+    in f32 (bf16 loses position resolution fast) and the result cast
+    back to x's dtype.
     """
     d = x.shape[-1]
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     )
-    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
-    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)[None, None]
-    sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)[None, None]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)
+    sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)
+    if positions.ndim == 1:  # [seq, d] -> broadcast over batch, heads
+        cos, sin = cos[None, None], sin[None, None]
+    else:  # [batch, seq, d] -> broadcast over heads
+        cos, sin = cos[:, None], sin[:, None]
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
     return (
@@ -262,24 +275,44 @@ class CausalAttention(nn.Module):
             (batch, kv_heads, cache_len, head_dim), c.compute_dtype,
         )
         index = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "cache_index",
+            lambda: jnp.zeros(
+                (batch,) if c.ragged_decode else (), jnp.int32
+            ),
         )
         if self.is_initializing():
             return jnp.zeros_like(q)
-        idx = index.value
+        idx = index.value  # [] scalar, or [batch] when ragged
+        ragged = c.ragged_decode
         if c.rope:
             # Rotate by absolute position before caching: stored keys
             # are rotated once, forever — exactly the full-forward
             # semantics, with no re-rotation of the cache per step.
-            pos = idx + jnp.arange(steps)
+            # Ragged: per-row offsets -> per-row position grids.
+            pos = (
+                idx[:, None] + jnp.arange(steps) if ragged
+                else idx + jnp.arange(steps)
+            )
             q = apply_rope(q, pos, c.rope_theta)
             k = apply_rope(k, pos, c.rope_theta)
-        k_all = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cached_k.value.dtype), (0, 0, idx, 0)
-        )
-        v_all = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cached_v.value.dtype), (0, 0, idx, 0)
-        )
+        if ragged:
+            # Per-row scatter: every slot writes at its own index.
+            write = jax.vmap(
+                lambda cache_row, new_row, i: jax.lax.dynamic_update_slice(
+                    cache_row, new_row, (0, i, 0)
+                )
+            )
+            k_all = write(cached_k.value, k.astype(cached_k.value.dtype), idx)
+            v_all = write(cached_v.value, v.astype(cached_v.value.dtype), idx)
+        else:
+            k_all = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cached_k.value.dtype),
+                (0, 0, idx, 0),
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cached_v.value.dtype),
+                (0, 0, idx, 0),
+            )
         cached_k.value, cached_v.value = k_all, v_all
         index.value = idx + steps
         if steps == 1 and (kv_heads != heads or c.decode_kernel):
@@ -291,12 +324,17 @@ class CausalAttention(nn.Module):
             # no fast lowering for the grouped shape (every einsum
             # formulation measured 1.5-2x slower than the blocked
             # kernel) — while MHA opts in via decode_kernel (XLA's
-            # single-query fusion wins there; see LMConfig).
+            # single-query fusion wins there; see LMConfig). The
+            # kernel takes scalar or per-row indices alike.
             o = decode_attention(q[:, :, 0], k_all, v_all, idx)
             return o[:, :, None, :]
-        q_pos = idx + jnp.arange(steps)
+        q_pos = (
+            idx[:, None] + jnp.arange(steps) if ragged
+            else idx + jnp.arange(steps)
+        )  # [batch, steps] or [steps]
         k_pos = jnp.arange(cache_len)
-        mask = k_pos[None, :] <= q_pos[:, None]  # [steps, cache_len]
+        # [steps, cache_len], or [batch, steps, cache_len] when ragged.
+        mask = k_pos[None, :] <= q_pos[..., None]
         scale = head_dim ** -0.5
         if kv_heads != heads:
             # Grouped-query attention prefill (single steps returned
@@ -316,8 +354,14 @@ class CausalAttention(nn.Module):
                 "xrd,xkd->xrk", qg, kg,
                 preferred_element_type=jnp.float32,
             ) * scale
-            gmask = jnp.tile(mask, (group, 1))  # [group*steps, cache]
-            logits = jnp.where(gmask[None], logits, -1e30)
+            if ragged:  # [b, steps, cache] -> per-cell rows
+                gmask = jnp.broadcast_to(
+                    mask[:, None, None],
+                    (batch, kv_heads, group, steps, cache_len),
+                ).reshape(batch * kv_heads, group * steps, cache_len)
+            else:  # [steps, cache] -> same rows for every cell
+                gmask = jnp.tile(mask, (group, 1))[None]
+            logits = jnp.where(gmask, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum(
                 "xrk,xkd->xrd", probs.astype(vg.dtype), vg,
@@ -328,7 +372,9 @@ class CausalAttention(nn.Module):
             "bhqd,bhkd->bhqk", q.astype(jnp.float32),
             k_all.astype(jnp.float32),
         ) * scale
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        logits = jnp.where(
+            mask[:, None] if ragged else mask[None, None], logits, -1e30
+        )
         probs = jax.nn.softmax(logits, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_all.dtype), v_all)
 
@@ -407,14 +453,28 @@ class DecoderLM(nn.Module):
             )
             if decode:
                 pos_index = self.variable(
-                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                    "cache", "pos_index",
+                    lambda: jnp.zeros(
+                        (tokens.shape[0],) if c.ragged_decode else (),
+                        jnp.int32,
+                    ),
                 )
                 offset = pos_index.value
                 if not self.is_initializing():
                     pos_index.value = offset + tokens.shape[1]
-                x = x + jax.lax.dynamic_slice(
-                    pos, (0, offset, 0), (1, tokens.shape[1], c.hidden_dim)
-                ).astype(x.dtype)
+                if c.ragged_decode:
+                    # Per-row offsets into the position table.
+                    x = x + jax.vmap(
+                        lambda i: jax.lax.dynamic_slice(
+                            pos[0], (i, 0),
+                            (tokens.shape[1], c.hidden_dim),
+                        )
+                    )(offset).astype(x.dtype)
+                else:
+                    x = x + jax.lax.dynamic_slice(
+                        pos, (0, offset, 0),
+                        (1, tokens.shape[1], c.hidden_dim),
+                    ).astype(x.dtype)
             else:
                 x = x + pos[:, : tokens.shape[1]].astype(x.dtype)
         # Remat only matters for training's backward pass; decode mode

@@ -1,0 +1,295 @@
+"""Continuous batching for LM serving: concurrent generations share one
+running decode batch.
+
+A fixed pool of `slots` sequences advances together, one token per
+step, through a single jitted program — sequences JOIN at step
+boundaries (prefill into a free slot) and LEAVE when they hit EOS or
+their token budget, without ever stopping the batch. This is the
+serving pattern that keeps a device busy under ragged, asynchronous
+request arrival (one-at-a-time `generate()` calls leave the chip idle
+whenever a sequence ends; batched `generate()` waits for the longest
+sequence).
+
+TPU-first mechanics (everything static-shaped, nothing recompiles as
+requests come and go):
+
+- **Ragged KV cache** (`LMConfig.ragged_decode`): the cache index is a
+  [slots] vector — each row sits at its own position; writes are
+  per-row scatters and the causal mask per-row. The fused decode
+  kernels take the per-row index (`ops/decode_attention.py`).
+- **Prefill into a slot**: the prompt (padded to a bucket, so prompt
+  lengths share compiled programs) runs through a batch-1 cache; its
+  rows are then written into the pool cache at the slot index with one
+  donated `tree_map` of dynamic_update_slices, and the slot's first
+  token (argmax at the true prompt length) lands in the device-side
+  token vector — admission never synchronizes with the host. Pad rows
+  write garbage K/V beyond the true length — invisible (masked by the
+  per-row index) and overwritten row-by-row as generation proceeds, so
+  bucketing is exact, not approximate.
+- **Chunked, pipelined stepping**: the step program scans
+  `chunk_steps` decode steps on-device and carries the token vector in
+  device state; the host keeps ONE chunk in flight and fetches chunk
+  N-1's tokens while chunk N computes, so on a remote/tunneled runtime
+  the per-chunk host round-trip overlaps compute instead of adding to
+  it. Admission and slot-freeing decisions run one chunk behind the
+  device — freed slots idle for one extra chunk (their output is
+  discarded), which costs bounded wasted work, never correctness.
+
+Greedy only (the exactness property below is the point); sampling
+belongs to `models/decode.py`'s one-shot path.
+
+**Exactness**: every request's output is token-identical to a
+standalone `make_generate_fn` greedy call on the same weights
+(tests/test_serve.py), regardless of what else shares the batch.
+
+No reference analogue — the reference is a k8s control plane; this is
+the serving-side engine of the TPU compute runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray  # [len] int32
+    max_new_tokens: int
+    eos_id: int | None
+    tokens: list = field(default_factory=list)
+    done: bool = False
+
+
+class ContinuousBatcher:
+    """Greedy continuous-batching engine over a slot pool.
+
+    Usage:
+        engine = ContinuousBatcher(cfg, params, slots=8, cache_len=256)
+        rid = engine.submit(prompt_ids, max_new_tokens=64, eos_id=2)
+        ...more submits at any time...
+        results = engine.run()   # {rid: [token, ...]}
+
+    `submit` only queues; `run` (or repeated `step()`) drives
+    admission + decoding until every queued request finishes.
+    """
+
+    def __init__(
+        self,
+        cfg: LMConfig,
+        params,
+        *,
+        slots: int = 8,
+        cache_len: int | None = None,
+        prompt_bucket: int = 16,
+        chunk_steps: int = 8,
+    ) -> None:
+        cache_len = cache_len or cfg.max_seq_len
+        if prompt_bucket > cache_len:
+            raise ValueError(
+                f"prompt_bucket {prompt_bucket} exceeds cache_len "
+                f"{cache_len}: prefilled rows would not fit the cache"
+            )
+        self.cfg = dataclasses.replace(
+            cfg, ragged_decode=True, cache_len=cache_len
+        )
+        self.slots = slots
+        self.cache_len = cache_len
+        self.prompt_bucket = prompt_bucket
+        self.chunk_steps = chunk_steps
+        self.params = params
+        self._model = DecoderLM(self.cfg)
+        self._requests: dict[int, _Request] = {}
+        self._pending: list[_Request] = []
+        self._slot_req: list[_Request | None] = [None] * slots
+        self._slot_new: list[bool] = [False] * slots
+        self._next_rid = 0
+        self._budget = np.zeros(slots, np.int64)  # tokens still owed
+        # In-flight chunk: (device tokens handle, slot->req snapshot,
+        # per-slot "first token expected" flags).
+        self._inflight: tuple | None = None
+
+        cache = self._model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((slots, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+        # Device state: (cache, next-input token per slot).
+        self._state = (cache, jnp.zeros(slots, jnp.int32))
+
+        model = self._model
+
+        @jax.jit
+        def prefill(params, prompt):
+            """prompt [1, bucket] -> (batch-1 cache, logits [bucket, V])."""
+            fresh = model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 1), jnp.int32),
+                decode=True,
+            )["cache"]
+            logits, variables = model.apply(
+                {"params": params, "cache": fresh},
+                prompt, decode=True, mutable=["cache"],
+            )
+            return variables["cache"], logits[0]
+
+        @jax.jit
+        def admit(state, small, logits, slot, true_len):
+            """Write prefilled rows + the slot's first token into the
+            pool state. Index leaves (ndim 1) get the TRUE prompt
+            length, not the bucket the prefill ran at — rows past
+            true_len are pad garbage the per-row mask hides until
+            decoding overwrites them."""
+            cache, tokens = state
+
+            def put(big, row):
+                if big.ndim == 1:  # cache_index / pos_index vectors
+                    return big.at[slot].set(true_len)
+                return jax.lax.dynamic_update_slice(
+                    big, row, (slot,) + (0,) * (big.ndim - 1)
+                )
+
+            first = jnp.argmax(logits[true_len - 1]).astype(jnp.int32)
+            return (
+                jax.tree.map(put, cache, small),
+                tokens.at[slot].set(first),
+            )
+
+        @jax.jit
+        def step_chunk(params, state):
+            """Advance every slot `chunk_steps` greedy tokens.
+
+            Returns the new state and [slots, 1 + chunk_steps] tokens:
+            column 0 is the chunk's INPUT token per slot (how the host
+            learns a newly admitted slot's first token without its own
+            fetch), the rest are the generated tokens.
+            """
+            cache, tokens = state
+
+            def one(carry, _):
+                cache, tok = carry
+                logits, variables = model.apply(
+                    {"params": params, "cache": cache},
+                    tok[:, None], decode=True, mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (variables["cache"], nxt), nxt
+
+            (cache, last), out = jax.lax.scan(
+                one, (cache, tokens), None, length=self.chunk_steps
+            )
+            emitted = jnp.concatenate(
+                [tokens[:, None], out.transpose(1, 0)], axis=1
+            )
+            return (cache, last), emitted
+
+        self._prefill_fn = prefill
+        self._admit_fn = admit
+        self._step_fn = step_chunk
+
+    # -- public API ----------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        eos_id: int | None = None,
+    ) -> int:
+        """Queue a generation; returns a request id."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.prompt_bucket:
+            raise ValueError(
+                f"prompt len {len(prompt)} exceeds prompt_bucket "
+                f"{self.prompt_bucket}"
+            )
+        total = len(prompt) + max_new_tokens
+        if total > self.cache_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds cache_len "
+                f"{self.cache_len}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, prompt, max_new_tokens, eos_id)
+        self._requests[rid] = req
+        self._pending.append(req)
+        return rid
+
+    def step(self) -> bool:
+        """One pipeline turn: admit, dispatch a chunk, process the
+        PREVIOUS chunk's tokens (the host fetch overlaps the chunk
+        just dispatched). True while work remains."""
+        self._admit()
+        if any(self._slot_req):
+            handle = self._dispatch()
+        else:
+            handle = None
+        if self._inflight is not None:
+            self._process(*self._inflight)
+        self._inflight = handle
+        if handle is None:
+            return bool(self._pending)
+        return True
+
+    def run(self) -> dict[int, list[int]]:
+        """Drive until every submitted request finishes."""
+        while self._pending or any(self._slot_req) or self._inflight:
+            self.step()
+        out = {r.rid: r.tokens for r in self._requests.values()}
+        self._requests = {}
+        return out
+
+    # -- internals -----------------------------------------------------
+
+    def _dispatch(self):
+        self._state, emitted = self._step_fn(self.params, self._state)
+        snapshot = list(self._slot_req)
+        fresh = list(self._slot_new)
+        self._slot_new = [False] * self.slots
+        return emitted, snapshot, fresh
+
+    def _process(self, emitted, snapshot, fresh) -> None:
+        tokens = np.asarray(emitted)  # [slots, 1 + chunk] — the sync
+        for s, req in enumerate(snapshot):
+            if req is None or req.done:
+                continue
+            emit = tokens[s] if fresh[s] else tokens[s, 1:]
+            for t in emit:
+                req.tokens.append(int(t))
+                self._budget[s] -= 1
+                if (
+                    req.eos_id is not None and int(t) == req.eos_id
+                ) or self._budget[s] <= 0:
+                    req.done = True
+                    if self._slot_req[s] is req:
+                        self._slot_req[s] = None
+                        self._budget[s] = 0
+                    break
+
+    def _admit(self) -> None:
+        for s in range(self.slots):
+            if self._slot_req[s] is not None or not self._pending:
+                continue
+            req = self._pending.pop(0)
+            true_len = len(req.prompt)
+            padded = np.zeros(self.prompt_bucket, np.int32)
+            padded[:true_len] = req.prompt
+            small, logits = self._prefill_fn(
+                self.params, jnp.asarray(padded[None])
+            )
+            self._state = self._admit_fn(
+                self._state, small, logits, s, true_len
+            )
+            self._slot_req[s] = req
+            self._slot_new[s] = True
+            self._budget[s] = req.max_new_tokens

@@ -63,7 +63,8 @@ def decode_attention_reference(
     q: [batch, heads, head_dim] (the one new query, at position `index`);
     k/v: [batch, kv_heads, cache_len, head_dim] where kv_heads divides
     heads (kv_heads < heads = grouped-query attention: query head i
-    reads KV head i // group); index: int32 scalar.
+    reads KV head i // group); index: int32 scalar, or a [batch]
+    vector for ragged decoding (each row at its own position).
     Returns [batch, heads, head_dim]. Positions > index are masked.
     """
     if k.shape[1] != q.shape[1]:
@@ -74,8 +75,11 @@ def decode_attention_reference(
     logits = jnp.einsum(
         "bhd,bhkd->bhk", q, k, preferred_element_type=jnp.float32
     ) * scale
-    mask = jnp.arange(k.shape[2]) <= index
-    logits = jnp.where(mask[None, None, :], logits, _NEG_INF)
+    if jnp.ndim(index) == 0:
+        mask = (jnp.arange(k.shape[2]) <= index)[None, None]
+    else:  # per-row positions -> [batch, 1, cache_len]
+        mask = (jnp.arange(k.shape[2]) <= index[:, None])[:, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum(
         "bhk,bhkd->bhd", probs.astype(v.dtype), v,
@@ -93,7 +97,7 @@ _GQA_BLOCK_CANDIDATES = (16, 8, 4, 2, 1)
 _VMEM_BLOCK_BUDGET_BYTES = 8 * 1024 * 1024
 
 
-def _gqa_block_kernel(n_blk, idx_ref, q_ref, k_ref, v_ref, o_ref):
+def _gqa_block_kernel(n_blk, per_cell_idx, idx_ref, q_ref, k_ref, v_ref, o_ref):
     """One grid step: `n_blk` independent (batch, kv-head) cells,
     statically unrolled. Refs are [n_blk, group, d] (q/o) and
     [n_blk, cache_len, d] (k/v); each cell is one [group, d] x [d, s]
@@ -110,9 +114,12 @@ def _gqa_block_kernel(n_blk, idx_ref, q_ref, k_ref, v_ref, o_ref):
     converting the whole cache block and double its vreg footprint.
     The softmax scale is applied to the f32 scores, not pre-applied to
     a bf16 q, which would round the scaled query.)"""
-    idx = idx_ref[0]
+    pid = pl.program_id(0)
     scale = q_ref.shape[-1] ** -0.5
     for i in range(n_blk):
+        # Ragged decoding prefetches one index per cell; scalar
+        # decoding one for the whole grid.
+        idx = idx_ref[pid * n_blk + i] if per_cell_idx else idx_ref[0]
         s = jax.lax.dot_general(
             q_ref[i], k_ref[i], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -142,6 +149,11 @@ def _gqa_pallas(q, k, v, index, interpret=False):
     blk = next(
         c for c in _GQA_BLOCK_CANDIDATES if c <= max_blk and n % c == 0
     )
+    per_cell = jnp.ndim(index) != 0
+    idx_arr = (
+        jnp.repeat(index.astype(jnp.int32), kvh) if per_cell
+        else jnp.reshape(index, (1,)).astype(jnp.int32)
+    )
     qr = q.reshape(n, g, d)
     kr = k.reshape(n, s, d)
     vr = v.reshape(n, s, d)
@@ -156,11 +168,11 @@ def _gqa_pallas(q, k, v, index, interpret=False):
         out_specs=pl.BlockSpec((blk, g, d), lambda i, idx: (i, 0, 0)),
     )
     out = pl.pallas_call(
-        functools.partial(_gqa_block_kernel, blk),
+        functools.partial(_gqa_block_kernel, blk, per_cell),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, g, d), q.dtype),
         interpret=interpret,
-    )(jnp.reshape(index, (1,)).astype(jnp.int32), qr, kr, vr)
+    )(idx_arr, qr, kr, vr)
     return out.reshape(b, h, d)
 
 
