@@ -323,6 +323,8 @@ def main() -> None:
     spec_k = 0
     lm_lock = threading.Lock()
     lm_max_new = int(os.environ.get("WALKAI_LM_MAX_NEW", "64"))
+    cb_engine = cb_queue = None
+    cb_slots = cb_bucket = 0
     if os.environ.get("WALKAI_DEMO_LM") == "1":
         from walkai_nos_tpu.models.decode import make_generate_fn
         from walkai_nos_tpu.models.lm import LM_TINY, LM_SMALL, DecoderLM
@@ -379,6 +381,86 @@ def main() -> None:
             )
             _np.asarray(jnp.ravel(_spec_out))
             print(f"speculative generation enabled: k={spec_k}")
+        if os.environ.get("WALKAI_DEMO_CB", "1") == "1":
+            # Continuous batching IS the greedy /generate path:
+            # concurrent generations share a slot pool instead of
+            # serializing behind lm_lock (models/serve.py; measured
+            # 2.1x aggregate tokens/s over the serialized path on
+            # v5e — a lower bound, see the module docstring).
+            # Speculative requests keep the one-shot path (the spec
+            # round structure doesn't chunk).
+            from walkai_nos_tpu.models.decode import cache_bucket
+            from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+            cb_slots = int(os.environ.get("WALKAI_CB_SLOTS", "4"))
+            cb_bucket = int(os.environ.get("WALKAI_CB_BUCKET", "64"))
+            cb_engine = ContinuousBatcher(
+                lm_cfg,
+                lm_params,
+                slots=cb_slots,
+                cache_len=cache_bucket(
+                    cb_bucket + lm_max_new, lm_cfg.max_seq_len
+                ),
+                prompt_bucket=cb_bucket,
+                chunk_steps=int(os.environ.get("WALKAI_CB_CHUNK", "8")),
+            )
+            # Compile prefill + chunk step off the request path.
+            cb_engine.submit([1], max_new_tokens=min(2, lm_max_new))
+            cb_engine.run()
+            cb_queue = queue.Queue()
+            cb_waiters: dict[int, dict] = {}
+            cb_enabled = [True]
+
+            def cb_driver() -> None:
+                """Single thread owning the engine: drains submissions
+                (blocking when idle), steps the batch, fulfils
+                responses as requests finish. A device error (e.g. a
+                co-tenant OOM spike) must not silently strand every
+                waiter on a dead thread: fail what's pending, flip the
+                endpoint to the serialized fallback, and exit — the
+                blast radius is the in-flight batch, like one failed
+                request on the serialized path."""
+                try:
+                    while True:
+                        try:
+                            item = cb_queue.get(
+                                block=not cb_engine.has_work
+                            )
+                            while True:
+                                prompt, max_new, holder = item
+                                rid = cb_engine.submit(
+                                    prompt, max_new_tokens=max_new
+                                )
+                                cb_waiters[rid] = holder
+                                item = cb_queue.get_nowait()
+                        except queue.Empty:
+                            pass
+                        if cb_engine.has_work:
+                            cb_engine.step()
+                        for rid, toks in cb_engine.drain_done().items():
+                            waiter = cb_waiters.pop(rid)
+                            waiter["tokens"] = toks
+                            waiter["done"].set()
+                except Exception as e:  # noqa: BLE001
+                    cb_enabled[0] = False
+                    print(f"continuous batching disabled: {e!r}")
+                    for waiter in cb_waiters.values():
+                        waiter["tokens"] = None
+                        waiter["done"].set()
+                    cb_waiters.clear()
+                    while True:  # drain late submissions to the fallback
+                        try:
+                            _, _, holder = cb_queue.get_nowait()
+                        except queue.Empty:
+                            break
+                        holder["tokens"] = None
+                        holder["done"].set()
+
+            threading.Thread(target=cb_driver, daemon=True).start()
+            print(
+                f"continuous batching enabled: {cb_slots} slots, "
+                f"prompt bucket {cb_bucket}"
+            )
 
     stats = _Stats()
     requests_q: "queue.Queue[_Request]" = queue.Queue()
@@ -565,6 +647,37 @@ def main() -> None:
                 for t in prompt
             ):
                 self.send_error(400, "prompt tokens out of vocab range")
+                return
+            if (
+                not speculative
+                and cb_engine is not None
+                and cb_enabled[0]
+                and len(prompt) <= cb_bucket
+            ):
+                # Continuous batching: join the running slot pool.
+                # (Prompts longer than the bucket fall through to the
+                # serialized path below — one compiled program per
+                # bucket is the static-shape discipline.)
+                waiter = {"done": threading.Event()}
+                t0 = time.perf_counter()
+                cb_queue.put((prompt, lm_max_new, waiter))
+                if not waiter["done"].wait(timeout=120.0):
+                    self.send_error(503, "generation timed out")
+                    return
+                if waiter["tokens"] is None:  # engine died mid-request
+                    self.send_error(503, "batch engine failed; retry")
+                    return
+                dt = time.perf_counter() - t0
+                self._json(200, {
+                    "tokens": waiter["tokens"],
+                    "generate_time_seconds": round(dt, 6),
+                    "tokens_per_second": round(
+                        len(waiter["tokens"]) / dt, 1
+                    ),
+                    "slice": slice_id,
+                    "batched": True,
+                    "cb_slots": cb_slots,
+                })
                 return
             arr = jnp.asarray([prompt], jnp.int32)
             # Serialized: one generation at a time keeps decode latency

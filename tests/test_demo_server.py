@@ -183,3 +183,70 @@ class TestSpeculativeEndpoint:
         assert status == 400
         status, _ = post(self, spec_server, {"prompt": prompt})
         assert status == 200
+
+
+class TestContinuousBatchingEndpoint:
+    """Greedy /generate rides the slot-pool batcher (WALKAI_DEMO_CB,
+    on by default with the LM): concurrent requests share the running
+    batch and still return exactly the standalone greedy tokens."""
+
+    @pytest.fixture(scope="class")
+    def cb_server(self):
+        proc, base = spawn_server(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "WALKAI_DEMO_MODEL": "tiny",
+                "WALKAI_DEMO_LM": "1",
+                "WALKAI_LM_MAX_NEW": "6",
+                "WALKAI_CB_SLOTS": "2",
+                "WALKAI_CB_CHUNK": "2",
+                "WALKAI_MAX_BATCH": "8",
+                "WALKAI_WARM_BUCKETS": "1",
+                "WALKAI_CALIB_WINDOW_S": "0.2",
+            },
+            startup_timeout_s=300.0,
+            poll_s=0.25,
+        )
+        yield base
+        kill_server(proc)
+
+    _post = TestGenerateEndpoint._post
+
+    def test_concurrent_generations_are_batched_and_exact(self, cb_server):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from walkai_nos_tpu.models.decode import make_generate_fn
+        from walkai_nos_tpu.models.lm import LM_TINY, DecoderLM
+
+        # The server builds its LM from PRNGKey(0) on LM_TINY — the
+        # expected continuations are reproducible here.
+        params = DecoderLM(LM_TINY).init_params(jax.random.PRNGKey(0))
+        gen = make_generate_fn(LM_TINY)
+        rng = np.random.default_rng(7)
+        prompts = [
+            rng.integers(0, LM_TINY.vocab_size, n).tolist()
+            for n in (3, 5, 4, 6, 2)
+        ]
+        results = [None] * len(prompts)
+
+        def hit(i):
+            results[i] = self._post(cb_server, {"prompt": prompts[i]})[1]
+
+        threads = [
+            threading.Thread(target=hit, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=150)
+        for i, p in enumerate(prompts):
+            out = results[i]
+            assert out is not None and out.get("batched") is True, out
+            expect = np.asarray(
+                gen(params, jnp.asarray([p], jnp.int32), max_new_tokens=6)
+            )[0].tolist()
+            assert out["tokens"] == expect, (i, out["tokens"], expect)

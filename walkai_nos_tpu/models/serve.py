@@ -241,12 +241,33 @@ class ContinuousBatcher:
             return bool(self._pending)
         return True
 
+    @property
+    def has_work(self) -> bool:
+        """True while any request is queued, running, or in flight."""
+        return bool(
+            self._pending
+            or any(self._slot_req)
+            or self._inflight is not None
+        )
+
+    def drain_done(self) -> dict[int, list[int]]:
+        """Pop and return every finished request's tokens (for callers
+        driving `step()` themselves, e.g. a serving thread fulfilling
+        responses as they complete)."""
+        done = {
+            rid: r.tokens for rid, r in self._requests.items() if r.done
+        }
+        for rid in done:
+            del self._requests[rid]
+        return done
+
     def run(self) -> dict[int, list[int]]:
         """Drive until every submitted request finishes."""
-        while self._pending or any(self._slot_req) or self._inflight:
+        out: dict[int, list[int]] = {}
+        while self.has_work:
             self.step()
-        out = {r.rid: r.tokens for r in self._requests.values()}
-        self._requests = {}
+            out.update(self.drain_done())
+        out.update(self.drain_done())
         return out
 
     # -- internals -----------------------------------------------------
