@@ -661,9 +661,17 @@ def main() -> None:
                 waiter = {"done": threading.Event()}
                 t0 = time.perf_counter()
                 cb_queue.put((prompt, lm_max_new, waiter))
-                if not waiter["done"].wait(timeout=120.0):
-                    self.send_error(503, "generation timed out")
-                    return
+                # Re-check the enabled flag while waiting: a request
+                # enqueued just as the driver dies can miss its final
+                # queue drain and would otherwise burn the whole
+                # timeout before failing.
+                while not waiter["done"].wait(timeout=1.0):
+                    if not cb_enabled[0]:
+                        self.send_error(503, "batch engine failed; retry")
+                        return
+                    if time.perf_counter() - t0 > 120.0:
+                        self.send_error(503, "generation timed out")
+                        return
                 if waiter["tokens"] is None:  # engine died mid-request
                     self.send_error(503, "batch engine failed; retry")
                     return
