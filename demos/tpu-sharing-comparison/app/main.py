@@ -427,11 +427,20 @@ def main() -> None:
                                 block=not cb_engine.has_work
                             )
                             while True:
-                                prompt, max_new, holder = item
-                                rid = cb_engine.submit(
-                                    prompt, max_new_tokens=max_new
-                                )
-                                cb_waiters[rid] = holder
+                                prompt, max_new, knobs, holder = item
+                                try:
+                                    rid = cb_engine.submit(
+                                        prompt, max_new_tokens=max_new,
+                                        **knobs,
+                                    )
+                                except ValueError as bad:
+                                    # Bad per-request knobs fail THAT
+                                    # request, never the engine thread.
+                                    holder["error"] = str(bad)
+                                    holder["tokens"] = None
+                                    holder["done"].set()
+                                else:
+                                    cb_waiters[rid] = holder
                                 item = cb_queue.get_nowait()
                         except queue.Empty:
                             pass
@@ -450,7 +459,7 @@ def main() -> None:
                     cb_waiters.clear()
                     while True:  # drain late submissions to the fallback
                         try:
-                            _, _, holder = cb_queue.get_nowait()
+                            _, _, _, holder = cb_queue.get_nowait()
                         except queue.Empty:
                             break
                         holder["tokens"] = None
@@ -648,19 +657,50 @@ def main() -> None:
             ):
                 self.send_error(400, "prompt tokens out of vocab range")
                 return
-            if (
+            try:
+                knobs = {
+                    "temperature": float(body.get("temperature", 0.0)),
+                    "top_k": int(body.get("top_k", 0)),
+                    "top_p": float(body.get("top_p", 1.0)),
+                }
+                if body.get("seed") is not None:
+                    knobs["seed"] = int(body["seed"])
+            except (TypeError, ValueError):
+                self.send_error(400, "malformed sampling knobs")
+                return
+            wants_sampling = (
+                knobs["temperature"] != 0.0
+                or knobs["top_k"] != 0
+                or knobs["top_p"] != 1.0
+                or "seed" in knobs
+            )
+            on_batched_path = (
                 not speculative
                 and cb_engine is not None
                 and cb_enabled[0]
                 and len(prompt) <= cb_bucket
-            ):
+            )
+            if wants_sampling and not on_batched_path:
+                # Never silently return greedy tokens for a sampling
+                # request: the serialized fallback and the speculative
+                # path are greedy-only.
+                self.send_error(
+                    400,
+                    "sampling knobs are served by the batched path "
+                    "only (greedy fallback: speculative, over-bucket "
+                    "prompt, or batching disabled)",
+                )
+                return
+            if on_batched_path:
                 # Continuous batching: join the running slot pool.
                 # (Prompts longer than the bucket fall through to the
                 # serialized path below — one compiled program per
-                # bucket is the static-shape discipline.)
+                # bucket is the static-shape discipline.) Per-request
+                # sampling knobs ride along; the engine validates them
+                # and a bad value fails only this request (400).
                 waiter = {"done": threading.Event()}
                 t0 = time.perf_counter()
-                cb_queue.put((prompt, lm_max_new, waiter))
+                cb_queue.put((prompt, lm_max_new, knobs, waiter))
                 # Re-check the enabled flag while waiting: a request
                 # enqueued just as the driver dies can miss its final
                 # queue drain and would otherwise burn the whole
@@ -672,7 +712,10 @@ def main() -> None:
                     if time.perf_counter() - t0 > 120.0:
                         self.send_error(503, "generation timed out")
                         return
-                if waiter["tokens"] is None:  # engine died mid-request
+                if waiter["tokens"] is None:
+                    if waiter.get("error"):  # rejected knobs
+                        self.send_error(400, waiter["error"])
+                        return
                     self.send_error(503, "batch engine failed; retry")
                     return
                 dt = time.perf_counter() - t0

@@ -154,3 +154,67 @@ class TestGuards:
             ContinuousBatcher(
                 CFG, _params(), slots=1, cache_len=32, prompt_bucket=64
             )
+
+
+class TestPerRequestSampling:
+    def test_mixed_batch_keeps_greedy_exact(self):
+        """A sampling co-tenant must not perturb greedy slots."""
+        params = _params()
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=64, chunk_steps=2,
+        )
+        prompts = _prompts(3, seed=8)
+        greedy_rids = {
+            engine.submit(p, max_new_tokens=6): p for p in prompts[:2]
+        }
+        sampled = engine.submit(
+            prompts[2], max_new_tokens=6, temperature=1.0, seed=11
+        )
+        results = engine.run()
+        for rid, p in greedy_rids.items():
+            assert results[rid] == _expected(CFG, params, p, 6), rid
+        toks = results[sampled]
+        assert len(toks) == 6
+        assert all(0 <= t < CFG.vocab_size for t in toks)
+
+    def test_sampling_deterministic_across_batch_compositions(self):
+        """(prompt, knobs, seed) fully determines the output — the
+        per-slot key schedule makes sampling independent of co-tenants,
+        slot index, and admission timing."""
+        params = _params()
+        target = _prompts(1, seed=9)[0]
+
+        def run_with_cotenants(n_cotenants, slots):
+            engine = ContinuousBatcher(
+                CFG, params, slots=slots, cache_len=64, chunk_steps=3,
+            )
+            for p in _prompts(n_cotenants, seed=10):
+                engine.submit(p, max_new_tokens=8, temperature=0.7)
+            rid = engine.submit(
+                target, max_new_tokens=8, temperature=0.9, top_k=16,
+                top_p=0.95, seed=123,
+            )
+            return engine.run()[rid]
+
+        a = run_with_cotenants(0, slots=1)
+        b = run_with_cotenants(3, slots=4)
+        assert a == b
+        assert len(a) == 8
+
+    def test_top_k_one_collapses_to_greedy(self):
+        params = _params()
+        engine = ContinuousBatcher(CFG, params, slots=1, cache_len=64)
+        p = _prompts(1, seed=12)[0]
+        rid = engine.submit(
+            p, max_new_tokens=6, temperature=1.0, top_k=1, seed=5
+        )
+        assert engine.run()[rid] == _expected(CFG, params, p, 6)
+
+    def test_bad_knobs_rejected(self):
+        engine = ContinuousBatcher(CFG, _params(), slots=1, cache_len=64)
+        with pytest.raises(ValueError, match="temperature"):
+            engine.submit([1], max_new_tokens=2, temperature=-1.0)
+        with pytest.raises(ValueError, match="top_p"):
+            engine.submit([1], max_new_tokens=2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            engine.submit([1], max_new_tokens=2, top_k=-2)

@@ -79,6 +79,54 @@ def _sample(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def sample_rows(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    rngs: jax.Array,
+) -> jax.Array:
+    """Per-row sampling: each row carries its own knobs and PRNG key —
+    the continuous batcher's per-request sampling (models/serve.py).
+
+    Row semantics match `_sample`: greedy at temperature 0, else
+    temperature sampling with optional top-k (0 = off) and/or nucleus
+    truncation (1.0 = off). logits [rows, vocab]; temperature/top_p
+    f32 [rows]; top_k int32 [rows]; rngs [rows, 2] split PRNG keys.
+    Unlike `_sample` (whose knobs are compile-time Python scalars, so
+    unused filters cost nothing), every filter here is computed and
+    where-selected — the price of serving mixed per-request knobs in
+    one compiled program.
+    """
+    rows, vocab = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / t
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_k - 1, 0, vocab - 1)[:, None], axis=1
+    )
+    threshold = jnp.where(top_k[:, None] > 0, kth, -jnp.inf)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose PRECEDING cumulative mass is < top_p (always
+    # keeps the most probable). Guarded at top_p >= 1: float cumsum
+    # can hit 1.0 early and would otherwise truncate the tail.
+    keep = jnp.concatenate(
+        [jnp.ones((rows, 1), bool), cumulative[:, :-1] < top_p[:, None]],
+        axis=-1,
+    )
+    p_thr = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    threshold = jnp.maximum(
+        threshold, jnp.where(top_p[:, None] < 1.0, p_thr, -jnp.inf)
+    )
+    scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    sampled = jax.vmap(jax.random.categorical)(rngs, scaled)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 def make_generate_fn(
     cfg: LMConfig,
     mesh: Mesh | None = None,

@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
 
 
@@ -64,12 +65,16 @@ class _Request:
     prompt: np.ndarray  # [len] int32
     max_new_tokens: int
     eos_id: int | None
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
     tokens: list = field(default_factory=list)
     done: bool = False
 
 
 class ContinuousBatcher:
-    """Greedy continuous-batching engine over a slot pool.
+    """Continuous-batching engine over a slot pool.
 
     Usage:
         engine = ContinuousBatcher(cfg, params, slots=8, cache_len=256)
@@ -79,6 +84,14 @@ class ContinuousBatcher:
 
     `submit` only queues; `run` (or repeated `step()`) drives
     admission + decoding until every queued request finishes.
+
+    Sampling is per request (`temperature`/`top_k`/`top_p`/`seed` on
+    `submit`; default greedy): the knobs and a per-slot PRNG key live
+    in device state, so mixed greedy-and-sampled batches run in one
+    compiled program. A slot's key starts at PRNGKey(seed) and splits
+    once per emitted token, so a request's output is a deterministic
+    function of (weights, prompt, knobs, seed) — independent of batch
+    composition, admission timing, or which slot it lands in.
     """
 
     def __init__(
@@ -121,8 +134,16 @@ class ContinuousBatcher:
             jnp.zeros((slots, 1), jnp.int32),
             decode=True,
         )["cache"]
-        # Device state: (cache, next-input token per slot).
-        self._state = (cache, jnp.zeros(slots, jnp.int32))
+        # Device state: (cache, next-input token per slot, per-slot
+        # sampling knobs, per-slot PRNG key).
+        self._state = (
+            cache,
+            jnp.zeros(slots, jnp.int32),
+            jnp.zeros(slots, jnp.float32),       # temperature
+            jnp.zeros(slots, jnp.int32),         # top_k
+            jnp.ones(slots, jnp.float32),        # top_p
+            jax.random.split(jax.random.PRNGKey(0), slots),
+        )
 
         model = self._model
 
@@ -141,13 +162,15 @@ class ContinuousBatcher:
             return variables["cache"], logits[0]
 
         @jax.jit
-        def admit(state, small, logits, slot, true_len):
-            """Write prefilled rows + the slot's first token into the
-            pool state. Index leaves (ndim 1) get the TRUE prompt
-            length, not the bucket the prefill ran at — rows past
-            true_len are pad garbage the per-row mask hides until
-            decoding overwrites them."""
-            cache, tokens = state
+        def admit(
+            state, small, logits, slot, true_len, temp, topk, topp, seed
+        ):
+            """Write prefilled rows, sampling knobs, and the slot's
+            first token into the pool state. Index leaves (ndim 1) get
+            the TRUE prompt length, not the bucket the prefill ran at —
+            rows past true_len are pad garbage the per-row mask hides
+            until decoding overwrites them."""
+            cache, tokens, temps, topks, topps, keys = state
 
             def put(big, row):
                 if big.ndim == 1:  # cache_index / pos_index vectors
@@ -156,39 +179,52 @@ class ContinuousBatcher:
                     big, row, (slot,) + (0,) * (big.ndim - 1)
                 )
 
-            first = jnp.argmax(logits[true_len - 1]).astype(jnp.int32)
+            key, sub = jax.random.split(jax.random.PRNGKey(seed))
+            first = sample_rows(
+                logits[true_len - 1][None].astype(jnp.float32),
+                temp[None], topk[None], topp[None], sub[None],
+            )[0].astype(jnp.int32)
             return (
                 jax.tree.map(put, cache, small),
                 tokens.at[slot].set(first),
+                temps.at[slot].set(temp),
+                topks.at[slot].set(topk),
+                topps.at[slot].set(topp),
+                keys.at[slot].set(key),
             )
 
         @jax.jit
         def step_chunk(params, state):
-            """Advance every slot `chunk_steps` greedy tokens.
+            """Advance every slot `chunk_steps` tokens (greedy or
+            sampled per the slot's knobs; one key split per token).
 
             Returns the new state and [slots, 1 + chunk_steps] tokens:
             column 0 is the chunk's INPUT token per slot (how the host
             learns a newly admitted slot's first token without its own
             fetch), the rest are the generated tokens.
             """
-            cache, tokens = state
+            cache, tokens, temps, topks, topps, keys = state
 
             def one(carry, _):
-                cache, tok = carry
+                cache, tok, keys = carry
                 logits, variables = model.apply(
                     {"params": params, "cache": cache},
                     tok[:, None], decode=True, mutable=["cache"],
                 )
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return (variables["cache"], nxt), nxt
+                split = jax.vmap(jax.random.split)(keys)
+                nxt = sample_rows(
+                    logits[:, -1].astype(jnp.float32),
+                    temps, topks, topps, split[:, 1],
+                ).astype(jnp.int32)
+                return (variables["cache"], nxt, split[:, 0]), nxt
 
-            (cache, last), out = jax.lax.scan(
-                one, (cache, tokens), None, length=self.chunk_steps
+            (cache, last, keys), out = jax.lax.scan(
+                one, (cache, tokens, keys), None, length=self.chunk_steps
             )
             emitted = jnp.concatenate(
                 [tokens[:, None], out.transpose(1, 0)], axis=1
             )
-            return (cache, last), emitted
+            return (cache, last, temps, topks, topps, keys), emitted
 
         self._prefill_fn = prefill
         self._admit_fn = admit
@@ -202,8 +238,29 @@ class ContinuousBatcher:
         *,
         max_new_tokens: int,
         eos_id: int | None = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int | None = None,
     ) -> int:
-        """Queue a generation; returns a request id."""
+        """Queue a generation; returns a request id.
+
+        temperature 0 (default) is greedy; otherwise temperature
+        sampling with optional top-k / nucleus truncation, seeded per
+        request (`seed` defaults to the request id, so every request
+        is deterministic AND distinct)."""
+        if not temperature >= 0.0:  # NaN-proof: NaN fails >= too
+            raise ValueError(f"temperature must be >= 0; got {temperature}")
+        if not 0 <= top_k <= self.cfg.vocab_size or not 0.0 < top_p <= 1.0:
+            raise ValueError(
+                f"top_k must be in [0, vocab_size={self.cfg.vocab_size}] "
+                f"and top_p in (0, 1]; got {top_k}, {top_p}"
+            )
+        if seed is not None and not -(2**31) <= seed < 2**31:
+            # The seed crosses into jit as an int32 argument; an
+            # out-of-range value must fail HERE (a per-request error),
+            # not later inside the engine's step thread.
+            raise ValueError(f"seed must fit int32; got {seed}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -220,7 +277,11 @@ class ContinuousBatcher:
             )
         rid = self._next_rid
         self._next_rid += 1
-        req = _Request(rid, prompt, max_new_tokens, eos_id)
+        req = _Request(
+            rid, prompt, max_new_tokens, eos_id,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=rid if seed is None else seed,
+        )
         self._requests[rid] = req
         self._pending.append(req)
         return rid
@@ -309,7 +370,9 @@ class ContinuousBatcher:
                 self.params, jnp.asarray(padded[None])
             )
             self._state = self._admit_fn(
-                self._state, small, logits, s, true_len
+                self._state, small, logits, s, true_len,
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), req.seed,
             )
             self._slot_req[s] = req
             self._slot_new[s] = True
