@@ -78,6 +78,24 @@ def _drive_compute() -> None:
     assert out.shape == (1, 4)
     print("compute ok: trained 8 steps, generated", out[0].tolist())
 
+    # Continuous batching: two concurrent requests (one greedy, one
+    # sampled) through the slot-pool engine; the greedy one must match
+    # the one-shot generate above token for token.
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+    engine = ContinuousBatcher(
+        cfg, result.state.params, slots=2, cache_len=16, prompt_bucket=8,
+        chunk_steps=2,
+    )
+    greedy_rid = engine.submit([1, 2, 3, 4], max_new_tokens=4)
+    sampled_rid = engine.submit(
+        [2, 3], max_new_tokens=4, temperature=0.8, seed=7
+    )
+    results = engine.run()
+    assert results[greedy_rid] == out[0].tolist(), results[greedy_rid]
+    assert len(results[sampled_rid]) == 4
+    print("serve ok: batched greedy == one-shot, sampled co-tenant ran")
+
 
 def main() -> int:
     for name in ("drive_nos", "drive_quota"):
