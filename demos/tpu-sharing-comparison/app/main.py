@@ -385,8 +385,9 @@ def main() -> None:
             # Continuous batching IS the greedy /generate path:
             # concurrent generations share a slot pool instead of
             # serializing behind lm_lock (models/serve.py; measured
-            # 2.1x aggregate tokens/s over the serialized path on
-            # v5e — a lower bound, see the module docstring).
+            # 2.1x/3.4x/5.2x aggregate tokens/s over the serialized
+            # path at 8/16/32 slots on v5e — lower bounds, see the
+            # module docstring).
             # Speculative requests keep the one-shot path (the spec
             # round structure doesn't chunk).
             from walkai_nos_tpu.models.decode import cache_bucket
