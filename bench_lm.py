@@ -250,12 +250,14 @@ def measure_continuous_batching(
     # Warm the compiled programs (prefill + chunk step) off the clock.
     engine.submit(prompts[0], max_new_tokens=new_tokens)
     engine.run()
+    engine.drain_latencies()  # discard the warm-up request's sample
     for p in prompts:
         engine.submit(p, max_new_tokens=new_tokens)
     t0 = time.perf_counter()
     results = engine.run()
     cb_s = time.perf_counter() - t0
     cb_tokens = sum(len(v) for v in results.values())
+    lat = sorted(engine.drain_latencies())
 
     gen = make_generate_fn(cfg)
     _fence(gen(params, jnp.asarray(prompts[0][None]),
@@ -266,7 +268,7 @@ def measure_continuous_batching(
     # whole workload for admission churn.
     t0 = time.perf_counter()
     serial_tokens = 0
-    for p in prompts[: min(len(prompts), 16)]:
+    for p in prompts[:16]:
         out = gen(params, jnp.asarray(p[None]), max_new_tokens=new_tokens)
         _fence(out)
         serial_tokens += out.shape[1]
@@ -278,6 +280,16 @@ def measure_continuous_batching(
         "cb_tokens_per_s": round(cb_tok_s, 1),
         "cb_serial_tokens_per_s": round(serial_tok_s, 1),
         "cb_vs_serial_speedup": round(cb_tok_s / serial_tok_s, 3),
+        # Per-request submit->completion wall time under the full
+        # concurrent load (queueing included: n_requests > slots, so
+        # later requests wait for a free slot — that wait is the
+        # latency cost the throughput above buys).
+        "cb_request_p50_s": round(lat[len(lat) // 2], 4) if lat else None,
+        # Nearest-rank percentile: ceil(q*n)-1 (int(q*n) overshoots a
+        # rank whenever q*n is exact).
+        "cb_request_p90_s": round(
+            lat[max(0, -(-9 * len(lat) // 10) - 1)], 4
+        ) if lat else None,
         "cb_slots": slots,
         "cb_requests": n_requests,
         "cb_chunk_steps": chunk_steps,

@@ -218,3 +218,18 @@ class TestPerRequestSampling:
             engine.submit([1], max_new_tokens=2, top_p=0.0)
         with pytest.raises(ValueError, match="top_k"):
             engine.submit([1], max_new_tokens=2, top_k=-2)
+
+
+class TestLatencyTelemetry:
+    def test_drain_latencies_one_sample_per_request(self):
+        params = _params()
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=64, chunk_steps=2,
+        )
+        for p in _prompts(5, seed=13):
+            engine.submit(p, max_new_tokens=4)
+        engine.run()
+        lat = engine.drain_latencies()
+        assert len(lat) == 5
+        assert all(t > 0 for t in lat)
+        assert engine.drain_latencies() == []  # drained means drained

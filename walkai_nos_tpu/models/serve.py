@@ -49,6 +49,8 @@ the serving-side engine of the TPU compute runtime.
 from __future__ import annotations
 
 import dataclasses
+import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -71,6 +73,8 @@ class _Request:
     seed: int = 0
     tokens: list = field(default_factory=list)
     done: bool = False
+    submitted_at: float = 0.0
+    completed_at: float = 0.0
 
 
 class ContinuousBatcher:
@@ -125,6 +129,9 @@ class ContinuousBatcher:
         self._slot_new: list[bool] = [False] * slots
         self._next_rid = 0
         self._budget = np.zeros(slots, np.int64)  # tokens still owed
+        # Bounded: a long-running server may drive the engine without
+        # ever draining latency samples; keep only the newest window.
+        self._latencies: deque[float] = deque(maxlen=4096)
         # In-flight chunk: (device tokens handle, slot->req snapshot,
         # per-slot "first token expected" flags).
         self._inflight: tuple | None = None
@@ -281,10 +288,19 @@ class ContinuousBatcher:
             rid, prompt, max_new_tokens, eos_id,
             temperature=temperature, top_k=top_k, top_p=top_p,
             seed=rid if seed is None else seed,
+            submitted_at=time.monotonic(),
         )
         self._requests[rid] = req
         self._pending.append(req)
         return rid
+
+    def drain_latencies(self) -> list[float]:
+        """Pop submit->completion wall seconds of finished requests
+        drained so far (recorded host-side at the chunk sync, so each
+        includes up to one chunk of pipelining slack by design)."""
+        out = list(self._latencies)
+        self._latencies.clear()
+        return out
 
     def step(self) -> bool:
         """One pipeline turn: admit, dispatch a chunk, process the
@@ -319,6 +335,10 @@ class ContinuousBatcher:
             rid: r.tokens for rid, r in self._requests.items() if r.done
         }
         for rid in done:
+            self._latencies.append(
+                self._requests[rid].completed_at
+                - self._requests[rid].submitted_at
+            )
             del self._requests[rid]
         return done
 
@@ -353,6 +373,7 @@ class ContinuousBatcher:
                     req.eos_id is not None and int(t) == req.eos_id
                 ) or self._budget[s] <= 0:
                     req.done = True
+                    req.completed_at = time.monotonic()
                     if self._slot_req[s] is req:
                         self._slot_req[s] = None
                         self._budget[s] = 0
