@@ -297,13 +297,18 @@ class CausalAttention(nn.Module):
             k = apply_rope(k, pos, c.rope_theta)
         if ragged:
             # Per-row scatter: every slot writes at its own index.
+            # Freed serving slots keep stepping past cache_len (the
+            # engine discards their output); clamp the write so an
+            # idle row overwrites its own last cell rather than
+            # relying on XLA's OOB start-index clamping semantics.
+            widx = jnp.minimum(idx, cache_len - steps)
             write = jax.vmap(
                 lambda cache_row, new_row, i: jax.lax.dynamic_update_slice(
                     cache_row, new_row, (0, i, 0)
                 )
             )
-            k_all = write(cached_k.value, k.astype(cached_k.value.dtype), idx)
-            v_all = write(cached_v.value, v.astype(cached_v.value.dtype), idx)
+            k_all = write(cached_k.value, k.astype(cached_k.value.dtype), widx)
+            v_all = write(cached_v.value, v.astype(cached_v.value.dtype), widx)
         else:
             k_all = jax.lax.dynamic_update_slice(
                 cached_k.value, k.astype(cached_k.value.dtype),

@@ -49,6 +49,7 @@ the serving-side engine of the TPU compute runtime.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -168,7 +169,7 @@ class ContinuousBatcher:
             )
             return variables["cache"], logits[0]
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def admit(
             state, small, logits, slot, true_len, temp, topk, topp, seed
         ):
@@ -200,7 +201,7 @@ class ContinuousBatcher:
                 keys.at[slot].set(key),
             )
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def step_chunk(params, state):
             """Advance every slot `chunk_steps` tokens (greedy or
             sampled per the slot's knobs; one key split per token).
@@ -268,9 +269,20 @@ class ContinuousBatcher:
             # out-of-range value must fail HERE (a per-request error),
             # not later inside the engine's step thread.
             raise ValueError(f"seed must fit int32; got {seed}")
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        prompt = np.asarray(prompt).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        # Validate BEFORE the int32 cast (which would silently wrap
+        # wide values, e.g. 2**32+5 -> 5): the embedding gather clamps
+        # out-of-vocab ids into garbage tokens, so direct engine users
+        # (no demo server in front) must get a per-request error.
+        if prompt.min() < 0 or prompt.max() >= self.cfg.vocab_size:
+            raise ValueError(
+                f"prompt ids must be in [0, vocab_size="
+                f"{self.cfg.vocab_size}); got range "
+                f"[{prompt.min()}, {prompt.max()}]"
+            )
+        prompt = prompt.astype(np.int32)
         if len(prompt) > self.prompt_bucket:
             raise ValueError(
                 f"prompt len {len(prompt)} exceeds prompt_bucket "
