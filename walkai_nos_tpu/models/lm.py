@@ -280,10 +280,10 @@ class CausalAttention(nn.Module):
                 (batch,) if c.ragged_decode else (), jnp.int32
             ),
         )
+        ragged = c.ragged_decode
         if self.is_initializing():
             return jnp.zeros_like(q)
         idx = index.value  # [] scalar, or [batch] when ragged
-        ragged = c.ragged_decode
         if c.rope:
             # Rotate by absolute position before caching: stored keys
             # are rotated once, forever — exactly the full-forward

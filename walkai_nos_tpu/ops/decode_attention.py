@@ -19,15 +19,26 @@ standard multi-head attention.
 Grouped-query attention flips the verdict. XLA has no fast lowering
 for the grouped shape (every formulation tried — rank-3 bmm, 4-D
 einsum, broadcast-expand, explicit mul-reduce — measured 1.5-2.1
-ms/step in the serving model vs MHA's 1.05), but the BLOCKED kernel
-here (`_gqa_block_kernel`: several (batch, kv-head) cells per grid
-step, statically unrolled [group, d] x [d, s] dots, so DMA amortizes
-and the MXU pipeline stays full) reaches 0.98 ms/step — decode with a
-4x-smaller cache becomes FASTER than MHA (130k vs 122k tok/s,
-per-call latency 0.16 vs 0.21 s) instead of 1.5x slower. GQA decode
-therefore ALWAYS routes through this kernel on TPU. MHA is the same
-kernel at group=1 (one code path, one parity surface), used when
+ms/step in the serving model vs MHA's 1.05), but the ALL-PAIRS
+blocked kernel here (`_gqa_block_kernel`: the whole grid-step block
+of (batch, kv-head) cells flattened into TWO large MXU dots with a
+block-diagonal mask) streams the cache at its HBM bound — 18.1
+us/invocation vs the 20.5 us analytic bound at b=128, kv=2, s=256,
+where round 4's per-cell unrolled-dots version measured 71 us
+(MXU issue latency on 2*n_blk tiny dots). In the serving model that
+is 0.74 ms/step, 174k tok/s — decode with a 4x-smaller cache runs
+1.4x FASTER than MHA instead of 1.5x slower. GQA decode therefore
+ALWAYS routes through this kernel on TPU. MHA is the same kernel at
+group=1 (one code path, one parity surface), used when
 `decode_kernel=True` opts out of the XLA default.
+
+A side-buffer variant (append new K/V rows to a small buffer, merge
+every 16 steps, two-segment kernel) was built and measured in round
+5 to attack the ~16 us/layer/step XLA spends around the per-step
+cache dynamic_update_slice: the two-segment kernel's in-kernel
+concat cost (+0.12 ms/step) and the merge cond (+0.10 ms/step)
+cancelled the saving, so it was removed — the measured verdict
+discipline, applied to our own idea.
 
 Masking uses the cache index (a runtime scalar, prefetched to SMEM):
 position p is visible iff p <= index. The cache rows above `index` are
@@ -89,52 +100,83 @@ def decode_attention_reference(
 
 # (batch * kv_heads) cells fused per grid step in the blocked kernel:
 # amortizes per-cell DMA/dispatch latency (the limiter for one-cell
-# grids). 8/16/32 measured within 1% of each other on v5e; smaller
-# divisors cover odd batch sizes. The choice is additionally capped so
-# one grid step's K+V blocks (double-buffered) fit a conservative VMEM
-# budget — long caches shrink the block instead of failing to compile.
+# grids). The choice is additionally capped so one grid step's K+V
+# blocks (double-buffered) and its f32 all-pairs score matrix fit a
+# conservative VMEM budget — long caches shrink the block instead of
+# failing to compile.
 _GQA_BLOCK_CANDIDATES = (16, 8, 4, 2, 1)
 _VMEM_BLOCK_BUDGET_BYTES = 8 * 1024 * 1024
+_VMEM_SCORE_BUDGET_BYTES = 2 * 1024 * 1024
 
 
 def _gqa_block_kernel(n_blk, per_cell_idx, idx_ref, q_ref, k_ref, v_ref, o_ref):
-    """One grid step: `n_blk` independent (batch, kv-head) cells,
-    statically unrolled. Refs are [n_blk, group, d] (q/o) and
-    [n_blk, cache_len, d] (k/v); each cell is one [group, d] x [d, s]
-    dot -> mask -> softmax -> [group, s] x [s, d] dot, f32 accumulation,
-    everything in VMEM. The unrolled dots pipeline through the MXU
-    back-to-back — one cell's [group, d] matvec alone would leave the
-    systolic array latency-bound (see module docstring). group=1 is
-    plain multi-head single-query attention — the MHA kernel is this
-    kernel. (Per-cell 2-D dots: Mosaic's dot lowering rejects
-    head-batched dimension numbers, so cells live on the grid and the
-    unrolled loop, as in `ops/attention.py`. K/V/q stay in their
-    storage dtype: the MXU multiplies bf16 natively with f32
-    accumulation — an astype(f32) here would spend VPU cycles
-    converting the whole cache block and double its vreg footprint.
-    The softmax scale is applied to the f32 scores, not pre-applied to
-    a bf16 q, which would round the scaled query.)"""
+    """One grid step: `n_blk` independent (batch, kv-head) cells in TWO
+    MXU dots (the "all-pairs" formulation). Refs are [n_blk, group, d]
+    (q/o) and [n_blk, cache_len, d] (k/v).
+
+    The cells' queries and caches are flattened into single matrices
+    and attention runs as one [n_blk*group, d] x [d, n_blk*s] score
+    dot and one [n_blk*group, n_blk*s] x [n_blk*s, d] PV dot, with a
+    BLOCK-DIAGONAL mask (query row of cell i sees only key columns of
+    cell i, up to the cell's own cache index). Off-block scores mask to
+    -inf, so after the softmax their probabilities are exactly 0 and
+    the PV dot reduces to the per-cell product — the formulation is
+    exact, not approximate (pinned against the XLA reference in
+    tests/test_ops.py).
+
+    Why all-pairs: the per-cell [group, d] x [d, s] dot is too small
+    for the MXU — a round-5 chained microbench measured the unrolled
+    per-cell version at 71 us/invocation (b=128, kv=2, s=256), ~3.5x
+    its 20.5 us HBM-streaming bound, flat in `n_blk` (8/16/32 within
+    1%) and nearly flat in s beyond 256: MXU issue latency on 2*n_blk
+    tiny dots, not bandwidth. The two big dots trade n_blk-fold wasted
+    MACs (masked away) for full systolic pipelining — measured 18.1
+    us/invocation, AT the HBM bound: FLOPs are free here, dot issues
+    are not. group=1 is plain multi-head single-query attention — the
+    MHA kernel is this kernel at the same two dots.
+
+    K/V/q stay in their storage dtype: the MXU multiplies bf16
+    natively with f32 accumulation — an astype(f32) here would spend
+    VPU cycles converting the whole cache block and double its vreg
+    footprint. The softmax scale is applied to the f32 scores, not
+    pre-applied to a bf16 q, which would round the scaled query."""
     pid = pl.program_id(0)
-    scale = q_ref.shape[-1] ** -0.5
-    for i in range(n_blk):
-        # Ragged decoding prefetches one index per cell; scalar
-        # decoding one for the whole grid.
-        idx = idx_ref[pid * n_blk + i] if per_cell_idx else idx_ref[0]
-        s = jax.lax.dot_general(
-            q_ref[i], k_ref[i], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [group, cache_len] f32
-        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos <= idx, s, _NEG_INF)
-        m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        o = jax.lax.dot_general(
-            (p / l).astype(v_ref.dtype), v_ref[i],
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        o_ref[i] = o.astype(o_ref.dtype)
+    g = q_ref.shape[1]
+    d = q_ref.shape[-1]
+    s_len = k_ref.shape[1]
+    scale = d ** -0.5
+    qf = q_ref[...].reshape(n_blk * g, d)
+    kf = k_ref[...].reshape(n_blk * s_len, d)
+    vf = v_ref[...].reshape(n_blk * s_len, d)
+    sc = jax.lax.dot_general(
+        qf, kf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [n_blk*g, n_blk*s] f32
+    rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+    cell_r = rows // g
+    cell_c = cols // s_len
+    pos = cols - cell_c * s_len
+    if per_cell_idx:
+        # Ragged decoding: one index per cell. Build the per-column
+        # visibility limit from the prefetched scalars (static unroll
+        # over n_blk; SMEM scalar reads are free next to the dots).
+        lim = jnp.concatenate([
+            jnp.full((1, s_len), idx_ref[pid * n_blk + i], jnp.int32)
+            for i in range(n_blk)
+        ], axis=1)  # [1, n_blk*s]
+        visible = (cell_r == cell_c) & (pos <= lim)
+    else:
+        visible = (cell_r == cell_c) & (pos <= idx_ref[0])
+    sc = jnp.where(visible, sc, _NEG_INF)
+    m = jnp.max(sc, axis=-1, keepdims=True)
+    p = jnp.exp(sc - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        (p / l).astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = o.reshape(n_blk, g, d).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -143,12 +185,18 @@ def _gqa_pallas(q, k, v, index, interpret=False):
     h = q.shape[1]
     g = h // kvh
     n = b * kvh
-    # K+V per cell, double-buffered by the Mosaic pipeline.
+    # K+V per cell, double-buffered by the Mosaic pipeline; the f32
+    # all-pairs score matrix grows with blk^2 and is capped separately.
     cell_bytes = 2 * 2 * s * d * k.dtype.itemsize
     max_blk = max(1, _VMEM_BLOCK_BUDGET_BYTES // cell_bytes)
     blk = next(
-        c for c in _GQA_BLOCK_CANDIDATES if c <= max_blk and n % c == 0
+        (c for c in _GQA_BLOCK_CANDIDATES
+         if c <= max_blk and n % c == 0
+         and c * g * c * s * 4 <= _VMEM_SCORE_BUDGET_BYTES),
+        None,
     )
+    if blk is None:  # pathological shapes: no block fits VMEM
+        return decode_attention_reference(q, k, v, index)
     per_cell = jnp.ndim(index) != 0
     idx_arr = (
         jnp.repeat(index.astype(jnp.int32), kvh) if per_cell
