@@ -71,7 +71,12 @@ WARMUP_SECONDS = float(os.environ.get("WALKAI_BENCH_WARMUP_S", "5"))
 MEASURE_SECONDS = float(os.environ.get("WALKAI_BENCH_SECONDS", "15"))
 LATENCY_PROBE_SECONDS = float(os.environ.get("WALKAI_BENCH_PROBE_SECONDS", "5"))
 SERVER_STARTUP_TIMEOUT_S = 420.0
-QOS_SECONDS = float(os.environ.get("WALKAI_BENCH_QOS_SECONDS", "12"))
+QOS_SECONDS = float(os.environ.get("WALKAI_BENCH_QOS_SECONDS", "60"))
+# Interleaved fair/noisy repeats; each contributes one per-arm
+# degradation estimate to the 95% t-interval (round-5 ask #6).
+QOS_REPEATS = int(os.environ.get("WALKAI_BENCH_QOS_REPEATS", "5"))
+# Per-width window of the 1/2/4/8-stream co-tenancy sweep.
+SWEEP_SECONDS = float(os.environ.get("WALKAI_BENCH_SWEEP_SECONDS", "6"))
 # Reference MPS result interpolated to 4 pods, per single-image inference
 # ((0.1640 + 0.2409) / 2, `demos/gpu-sharing-comparison/README.md:70`).
 BASELINE_MPS_4POD_S = (0.1640 + 0.2409) / 2
@@ -85,15 +90,20 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
-def _qos_phase(base: str, seconds: float, *, noisy: bool) -> list[list[float]]:
-    """Per-stream latencies for N_STREAMS sequential batch=1 tenants.
+def _qos_phase(
+    base: str, seconds: float, *, noisy: bool,
+    n_streams: int | None = None,
+) -> list[list[float]]:
+    """Per-stream latencies for `n_streams` sequential batch=1 tenants
+    (default N_STREAMS).
 
     With `noisy`, stream 0 is replaced by an aggressor at ~4x its fair
     share (4 pipelined batch-32 connections); the returned lists then
     cover only the victim streams. Sequential probes use a fresh
     connection per request (same rationale as the latency probe)."""
     halt = threading.Event()
-    n_victims = N_STREAMS - 1 if noisy else N_STREAMS
+    n_streams = n_streams or N_STREAMS
+    n_victims = n_streams - 1 if noisy else n_streams
     lat: list[list[float]] = [[] for _ in range(n_victims)]
 
     def victim(idx: int) -> None:
@@ -151,10 +161,12 @@ def serving_benchmark() -> dict:
                     for i in range(8)
                     if (b := REQUEST_BATCH * (2**i)) <= MAX_BATCH
                 ]
-                # The sequential latency probe posts batch=1 from
-                # N_STREAMS clients; coalescing can produce any
-                # power-of-two bucket up to N_STREAMS.
-                + [str(2**i) for i in range(N_STREAMS.bit_length())]
+                # Sequential batch=1 clients run at up to 8-way
+                # co-tenancy (the sweep's widest point), so coalescing
+                # can produce any power-of-two bucket up to 8 — a cold
+                # bucket compile (~12 s) inside a 6 s sweep window
+                # would measure the compiler, not the serving path.
+                + [str(2**i) for i in range(4)]
             ),
         },
         startup_timeout_s=SERVER_STARTUP_TIMEOUT_S,
@@ -240,27 +252,61 @@ def serving_benchmark() -> dict:
         probe_halt.set()
         for t in probe_threads:
             t.join(timeout=160.0)
+        # Co-tenancy scaling sweep (round-5 missing #2): per-stream
+        # latency at 1/2/4/8 concurrent sequential batch=1 tenants —
+        # the TPU analogue of the reference's 1/3/5/7-pod table
+        # (demos/gpu-sharing-comparison/README.md:69-71). The
+        # reference's headline exhibit is that the CURVE is flat.
+        sweep: list[dict] = []
+        for width in (1, 2, 4, 8):
+            seg = _qos_phase(
+                base, SWEEP_SECONDS, noisy=False, n_streams=width
+            )
+            pooled = sorted(s for stream in seg for s in stream)
+            sweep.append({
+                "streams": width,
+                "requests": len(pooled),
+                # None (not a flat 0.0) when a window completed no
+                # requests: missing data must not read as perfect.
+                "p50_s": round(_percentile(pooled, 0.50), 4)
+                if pooled else None,
+                "p99_s": round(_percentile(pooled, 0.99), 4)
+                if pooled else None,
+                "mean_s": round(
+                    statistics.fmean(pooled), 4
+                ) if pooled else None,
+            })
         # QoS / isolation: the reference's MIG table shows flat latency
         # at any co-tenant count (BASELINE.md, 0.34 s from 1 to 7 pods).
         # The TPU sharing analogue: per-stream p99 under fair 4-way
         # co-tenancy, then the noisy-neighbor variant — one tenant at
         # ~4x its fair share (pipelined batch-32) while the victims
         # stay sequential batch=1 — and the victims' p99 degradation.
-        # Fair/noisy run as INTERLEAVED segments pooled per condition:
-        # the tunnel's fence RTT drifts by tens of ms across minutes,
-        # which back-to-back phases would read as (de)gradation.
-        n_segments = 4
+        # Fair/noisy run as N >= 5 INTERLEAVED repeats (round-5 ask
+        # #6): the tunnel's fence RTT drifts by tens of ms across
+        # minutes, which back-to-back phases would read as
+        # (de)gradation, and a single window per arm cannot
+        # distinguish +-4% run noise from a <=10% effect — the
+        # degradation is now a mean over per-repeat estimates with a
+        # 95% t-interval, and "no degradation" is claimed only when
+        # the interval's upper bound clears 10%.
+        n_repeats = QOS_REPEATS
         fair_lat: list[list[float]] = [[] for _ in range(N_STREAMS)]
         noisy_lat: list[list[float]] = [[] for _ in range(N_STREAMS - 1)]
-        for _ in range(n_segments):
-            for pooled, seg in (
-                (fair_lat, _qos_phase(
-                    base, QOS_SECONDS / n_segments, noisy=False)),
-                (noisy_lat, _qos_phase(
-                    base, QOS_SECONDS / n_segments, noisy=True)),
+        fair_reps: list[list[float]] = []
+        noisy_reps: list[list[float]] = []
+        for _ in range(n_repeats):
+            for pooled, reps, seg in (
+                (fair_lat, fair_reps, _qos_phase(
+                    base, QOS_SECONDS / n_repeats, noisy=False)),
+                (noisy_lat, noisy_reps, _qos_phase(
+                    base, QOS_SECONDS / n_repeats, noisy=True)),
             ):
                 for pooled_stream, seg_samples in zip(pooled, seg):
                     pooled_stream.extend(seg_samples)
+                reps.append(sorted(
+                    s for stream in seg for s in stream
+                ))
         fair_lat = [sorted(s) for s in fair_lat]
         noisy_lat = [sorted(s) for s in noisy_lat]
     finally:
@@ -343,12 +389,26 @@ def serving_benchmark() -> dict:
         "device_kind": stats1.get("device_kind"),
         "streams": N_STREAMS,
         "stream_pipeline": STREAM_PIPELINE,
-        **_qos_fields(fair_lat, noisy_lat),
+        "cotenancy_sweep": sweep,
+        **_qos_fields(fair_lat, noisy_lat, fair_reps, noisy_reps),
     }
 
 
+# Two-sided 95% t critical values by degrees of freedom (repeats - 1).
+# Beyond the table, fall back to the LAST tabulated value (2.262, df=9)
+# rather than the normal 1.96: t decreases in df, so the df=9 value is
+# conservative — more repeats must never make the interval (and the
+# no-degradation claim riding its upper bound) laxer than tabulated.
+_T95 = {1: 12.706, 2: 4.303, 3: 3.182, 4: 2.776, 5: 2.571,
+        6: 2.447, 7: 2.365, 8: 2.306, 9: 2.262}
+_T95_FALLBACK = 2.262
+
+
 def _qos_fields(
-    fair_lat: list[list[float]], noisy_lat: list[list[float]]
+    fair_lat: list[list[float]],
+    noisy_lat: list[list[float]],
+    fair_reps: list[list[float]] | None = None,
+    noisy_reps: list[list[float]] | None = None,
 ) -> dict:
     fair_p99 = [_percentile(s, 0.99) for s in fair_lat]
     victim_p99 = [_percentile(s, 0.99) for s in noisy_lat]
@@ -366,6 +426,35 @@ def _qos_fields(
         f, n = _percentile(fair_all, q), _percentile(noisy_all, q)
         return round(100.0 * (n - f) / f, 2) if f > 0 else None
 
+    # Powered verdict (round-5 ask #6): one degradation estimate per
+    # interleaved repeat, mean +- 95% t-interval. The single pooled
+    # number above stays for round-over-round continuity; the CLAIM
+    # ("no degradation") now rides the interval, which a +-4%
+    # run-to-run sign flip cannot satisfy by luck.
+    ci_fields: dict = {}
+    if fair_reps and noisy_reps and len(fair_reps) >= 3:
+        degs = []
+        for f_seg, n_seg in zip(fair_reps, noisy_reps):
+            f99 = _percentile(f_seg, 0.99)
+            n99 = _percentile(n_seg, 0.99)
+            if f99 > 0:
+                degs.append(100.0 * (n99 - f99) / f99)
+        if len(degs) >= 3:
+            mean = statistics.fmean(degs)
+            sd = statistics.stdev(degs)
+            t = _T95.get(len(degs) - 1, _T95_FALLBACK)
+            half = t * sd / (len(degs) ** 0.5)
+            ci_fields = {
+                "noisy_neighbor_degradation_mean_pct": round(mean, 2),
+                "noisy_neighbor_degradation_ci95_pct": [
+                    round(mean - half, 2), round(mean + half, 2),
+                ],
+                "noisy_neighbor_repeats": len(degs),
+                "noisy_neighbor_no_degradation": bool(
+                    mean + half < 10.0
+                ),
+            }
+
     return {
         # Flat-latency property under fair 4-way co-tenancy, and the
         # victims' degradation with one tenant at ~4x its share.
@@ -377,6 +466,7 @@ def _qos_fields(
         "noisy_neighbor_degradation_pct": deg(0.99),
         "noisy_neighbor_degradation_p95_pct": deg(0.95),
         "noisy_neighbor_degradation_p50_pct": deg(0.50),
+        **ci_fields,
     }
 
 
@@ -422,6 +512,19 @@ def decode_benchmark() -> dict:
     return result
 
 
+def cb_serving_benchmark() -> dict:
+    """Continuous batching measured as SERVING, not throughput
+    (round-5): Poisson arrivals at ~0.7x measured capacity, mixed
+    prompt/max_new, EOS-terminating sampled sequences, through the
+    demo server's HTTP /generate — TTFT, per-token pace, tail
+    latency, goodput, slot occupancy (`bench_lm.measure_cb_serving`).
+    Spawns its own server (chip-exclusive), so it runs as its own
+    phase after decode."""
+    from bench_lm import measure_cb_serving
+
+    return measure_cb_serving()
+
+
 def main() -> None:
     result: dict = {}
     err = None
@@ -434,6 +537,10 @@ def main() -> None:
         result.update(decode_benchmark())
     except Exception as e:
         err = (err + "; " if err else "") + f"decode: {e}"
+    try:
+        result.update(cb_serving_benchmark())
+    except Exception as e:
+        err = (err + "; " if err else "") + f"cb-serving: {e}"
     try:
         result.update(scheduling_benchmark())
     except Exception as e:

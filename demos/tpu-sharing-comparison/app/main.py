@@ -329,11 +329,23 @@ def main() -> None:
         from walkai_nos_tpu.models.decode import make_generate_fn
         from walkai_nos_tpu.models.lm import LM_TINY, LM_SMALL, DecoderLM
 
-        lm_cfg = (
-            LM_TINY
-            if os.environ.get("WALKAI_DEMO_MODEL") == "tiny"
-            else LM_SMALL
+        # WALKAI_LM_MODEL decouples the LM size from the vision model
+        # (the CB serving benchmark wants a tiny ViT beside the real
+        # serving LM); WALKAI_LM_VOCAB shrinks the vocab so sampled
+        # workloads hit EOS with measurable probability — a bench/test
+        # seam, not a serving feature.
+        lm_choice = os.environ.get(
+            "WALKAI_LM_MODEL",
+            "tiny" if os.environ.get("WALKAI_DEMO_MODEL") == "tiny"
+            else "small",
         )
+        lm_cfg = LM_TINY if lm_choice == "tiny" else LM_SMALL
+        if os.environ.get("WALKAI_LM_VOCAB"):
+            import dataclasses as _dcv
+
+            lm_cfg = _dcv.replace(
+                lm_cfg, vocab_size=int(os.environ["WALKAI_LM_VOCAB"])
+            )
         lm_params = jax.device_put(
             DecoderLM(lm_cfg).init_params(jax.random.PRNGKey(0))
         )
@@ -447,9 +459,12 @@ def main() -> None:
                             pass
                         if cb_engine.has_work:
                             cb_engine.step()
-                        for rid, toks in cb_engine.drain_done().items():
+                        for rid, rec in (
+                            cb_engine.drain_done_records().items()
+                        ):
                             waiter = cb_waiters.pop(rid)
-                            waiter["tokens"] = toks
+                            waiter["tokens"] = rec["tokens"]
+                            waiter["ttft_s"] = rec["ttft_s"]
                             waiter["done"].set()
                 except Exception as e:  # noqa: BLE001
                     cb_enabled[0] = False
@@ -666,14 +681,36 @@ def main() -> None:
                 }
                 if body.get("seed") is not None:
                     knobs["seed"] = int(body["seed"])
+                req_max_new = (
+                    int(body["max_new_tokens"])
+                    if body.get("max_new_tokens") is not None else None
+                )
+                req_eos = (
+                    int(body["eos_id"])
+                    if body.get("eos_id") is not None else None
+                )
             except (TypeError, ValueError):
                 self.send_error(400, "malformed sampling knobs")
+                return
+            if req_max_new is not None and not 1 <= req_max_new <= lm_max_new:
+                self.send_error(
+                    400,
+                    f"max_new_tokens must be in [1, {lm_max_new}]",
+                )
+                return
+            if req_eos is not None and not 0 <= req_eos < lm_cfg.vocab_size:
+                self.send_error(400, "eos_id out of vocab range")
                 return
             wants_sampling = (
                 knobs["temperature"] != 0.0
                 or knobs["top_k"] != 0
                 or knobs["top_p"] != 1.0
                 or "seed" in knobs
+                # Per-request budget/EOS ride the slot pool too: the
+                # one-shot paths compile per max_new signature and
+                # have no EOS scan.
+                or req_max_new is not None
+                or req_eos is not None
             )
             on_batched_path = (
                 not speculative
@@ -699,9 +736,13 @@ def main() -> None:
                 # bucket is the static-shape discipline.) Per-request
                 # sampling knobs ride along; the engine validates them
                 # and a bad value fails only this request (400).
+                if req_eos is not None:
+                    knobs["eos_id"] = req_eos
                 waiter = {"done": threading.Event()}
                 t0 = time.perf_counter()
-                cb_queue.put((prompt, lm_max_new, knobs, waiter))
+                cb_queue.put(
+                    (prompt, req_max_new or lm_max_new, knobs, waiter)
+                )
                 # Re-check the enabled flag while waiting: a request
                 # enqueued just as the driver dies can miss its final
                 # queue drain and would otherwise burn the whole
@@ -723,6 +764,7 @@ def main() -> None:
                 self._json(200, {
                     "tokens": waiter["tokens"],
                     "generate_time_seconds": round(dt, 6),
+                    "ttft_seconds": round(waiter.get("ttft_s", 0.0), 6),
                     "tokens_per_second": round(
                         len(waiter["tokens"]) / dt, 1
                     ),
@@ -775,7 +817,10 @@ def main() -> None:
             if self.path == "/healthz":
                 self._json(200, {"ok": True})
             elif self.path == "/stats":
-                self._json(200, {**stats.snapshot(), **device_info})
+                payload = {**stats.snapshot(), **device_info}
+                if cb_engine is not None:
+                    payload["cb_occupancy"] = cb_engine.occupancy()
+                self._json(200, payload)
             else:
                 self.send_error(404)
 

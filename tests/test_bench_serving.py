@@ -23,7 +23,9 @@ def bench_mod(monkeypatch):
     monkeypatch.setenv("WALKAI_BENCH_WARMUP_S", "1")
     monkeypatch.setenv("WALKAI_BENCH_SECONDS", "2")
     monkeypatch.setenv("WALKAI_BENCH_PROBE_SECONDS", "1")
-    monkeypatch.setenv("WALKAI_BENCH_QOS_SECONDS", "2")
+    monkeypatch.setenv("WALKAI_BENCH_QOS_SECONDS", "3")
+    monkeypatch.setenv("WALKAI_BENCH_QOS_REPEATS", "3")
+    monkeypatch.setenv("WALKAI_BENCH_SWEEP_SECONDS", "0.5")
     monkeypatch.setenv("WALKAI_BENCH_PIPELINE", "2")
     monkeypatch.setenv("WALKAI_BENCH_REQUEST_BATCH", "4")
     monkeypatch.setenv("WALKAI_BENCH_MAX_BATCH", "8")
@@ -40,6 +42,31 @@ def bench_mod(monkeypatch):
     importlib.reload(bench)
 
 
+def test_cb_serving_benchmark_runs_end_to_end(monkeypatch):
+    """The round-5 CB serving phase (Poisson arrivals over HTTP
+    /generate, TTFT/goodput/occupancy math) must execute in CI on the
+    tiny CPU models — a crash here would erase the whole cb block from
+    the round artifact."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from bench_lm import measure_cb_serving
+
+    r = measure_cb_serving(
+        slots=2, lm_max_new=8, prompt_bucket=8, vocab=64,
+        capacity_seconds=1.0, measure_seconds=3.0, load_fraction=0.5,
+        server_env={
+            "WALKAI_LM_MODEL": "tiny",
+            "WALKAI_CALIB_WINDOW_S": "0.2",
+        },
+        startup_timeout_s=180.0,
+    )
+    assert r["cb_requests_completed"] > 0
+    assert r["cb_request_errors"] == 0
+    assert r["cb_ttft_p50"] > 0
+    assert r["cb_goodput_tokens_per_s"] > 0
+    assert r["cb_slot_occupancy"] is not None
+    assert r["cb_serving_request_p90_s"] >= r["cb_serving_request_p50_s"]
+
+
 def test_serving_benchmark_runs_end_to_end(bench_mod):
     r = bench_mod.serving_benchmark()
     # The phase completed: throughput, probe, and QoS sections all
@@ -52,6 +79,13 @@ def test_serving_benchmark_runs_end_to_end(bench_mod):
     assert len(r["qos_noisy_victim_p99_s"]) == bench_mod.N_STREAMS - 1
     assert all(p > 0 for p in r["qos_p99_per_stream_s"])
     assert r["noisy_neighbor_degradation_pct"] is not None
+    # Powered QoS verdict: per-repeat mean and 95% interval present.
+    assert r["noisy_neighbor_repeats"] >= 3
+    lo, hi = r["noisy_neighbor_degradation_ci95_pct"]
+    assert lo <= r["noisy_neighbor_degradation_mean_pct"] <= hi
+    # Co-tenancy sweep covers the four widths with real samples.
+    assert [row["streams"] for row in r["cotenancy_sweep"]] == [1, 2, 4, 8]
+    assert all(row["requests"] > 0 for row in r["cotenancy_sweep"])
     # Gap decomposition stays one consistent story.
     assert r["utilization_gap_pct"] == pytest.approx(
         100.0 - r["utilization_pct"], abs=0.02

@@ -314,6 +314,86 @@ class TestDecodeAttention:
         )
 
 
+class TestFlashAttentionPacked:
+    """flash_attention_packed: attention straight off the fused qkv
+    projection ([b, s, 3d] -> [b, s, d], the serving ViT's layout) must
+    match unpacking + reference attention in value AND gradient."""
+
+    def _qkv(self, b=2, s=24, heads=4, head_dim=16, seed=0):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(
+            rng.standard_normal((b, s, 3 * heads * head_dim)),
+            jnp.float32,
+        )
+
+    def test_matches_reference(self):
+        from walkai_nos_tpu.ops.attention import (
+            _packed_reference,
+            flash_attention_packed,
+        )
+
+        qkv = self._qkv()
+        out = flash_attention_packed(qkv, 4, interpret=True)
+        ref = _packed_reference(qkv, 4)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_matches_unpacked_flash_path(self):
+        """Same math as the [b, h, s, d] kernel the rest of the stack
+        uses: the packed layout is a storage choice, not a model
+        change."""
+        from walkai_nos_tpu.ops.attention import (
+            _packed_unpack,
+            flash_attention,
+            flash_attention_packed,
+        )
+
+        qkv = self._qkv(seed=1)
+        out = flash_attention_packed(qkv, 4, interpret=True)
+        q, k, v = _packed_unpack(qkv, 4)
+        o = flash_attention(q, k, v, interpret=True)
+        b, s, _ = qkv.shape
+        ref = o.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_grad_matches_reference(self):
+        from walkai_nos_tpu.ops.attention import (
+            _packed_reference,
+            flash_attention_packed,
+        )
+
+        qkv = self._qkv(seed=2)
+        w = jnp.asarray(
+            np.random.default_rng(3).standard_normal((2, 24, 64)),
+            jnp.float32,
+        )
+
+        def loss_packed(qkv):
+            return jnp.sum(
+                w * flash_attention_packed(qkv, 4, interpret=True) ** 2
+            )
+
+        def loss_ref(qkv):
+            return jnp.sum(w * _packed_reference(qkv, 4) ** 2)
+
+        gp = jax.grad(loss_packed)(qkv)
+        gr = jax.grad(loss_ref)(qkv)
+        np.testing.assert_allclose(
+            np.asarray(gp), np.asarray(gr), atol=1e-4
+        )
+
+    def test_bad_minor_dim_raises(self):
+        from walkai_nos_tpu.ops.attention import flash_attention_packed
+
+        with pytest.raises(ValueError, match="3 \\* num_heads"):
+            flash_attention_packed(
+                jnp.zeros((1, 8, 100)), 4, interpret=True
+            )
+
+
 class TestFlashPaddedDispatch:
     """Untiled non-causal sequences go through the zero-pad + kv-mask
     kernel path (the ViT's 296-token serving shape), not the XLA

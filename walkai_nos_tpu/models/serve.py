@@ -75,6 +75,7 @@ class _Request:
     tokens: list = field(default_factory=list)
     done: bool = False
     submitted_at: float = 0.0
+    first_token_at: float = 0.0
     completed_at: float = 0.0
 
 
@@ -133,6 +134,11 @@ class ContinuousBatcher:
         # Bounded: a long-running server may drive the engine without
         # ever draining latency samples; keep only the newest window.
         self._latencies: deque[float] = deque(maxlen=4096)
+        # Slot occupancy: busy vs total slot-steps across dispatched
+        # chunks — the utilization of the pool the serving benchmark
+        # reports (idle slots still burn a row of every compiled step).
+        self._busy_slot_steps = 0
+        self._total_slot_steps = 0
         # In-flight chunk: (device tokens handle, slot->req snapshot,
         # per-slot "first token expected" flags).
         self._inflight: tuple | None = None
@@ -343,16 +349,38 @@ class ContinuousBatcher:
         """Pop and return every finished request's tokens (for callers
         driving `step()` themselves, e.g. a serving thread fulfilling
         responses as they complete)."""
+        return {
+            rid: rec["tokens"]
+            for rid, rec in self.drain_done_records().items()
+        }
+
+    def drain_done_records(self) -> dict[int, dict]:
+        """Like `drain_done`, with per-request serving telemetry:
+        {"tokens", "ttft_s" (submit -> first token KNOWN to the host,
+        i.e. at its chunk sync — the moment a streaming server could
+        first emit it), "wall_s"}."""
         done = {
-            rid: r.tokens for rid, r in self._requests.items() if r.done
+            rid: {
+                "tokens": r.tokens,
+                "ttft_s": r.first_token_at - r.submitted_at,
+                "wall_s": r.completed_at - r.submitted_at,
+            }
+            for rid, r in self._requests.items()
+            if r.done
         }
         for rid in done:
-            self._latencies.append(
-                self._requests[rid].completed_at
-                - self._requests[rid].submitted_at
-            )
+            self._latencies.append(done[rid]["wall_s"])
             del self._requests[rid]
         return done
+
+    def occupancy(self) -> dict:
+        """Cumulative slot-pool occupancy over dispatched chunks."""
+        total = max(1, self._total_slot_steps)
+        return {
+            "busy_slot_steps": self._busy_slot_steps,
+            "total_slot_steps": self._total_slot_steps,
+            "occupancy": round(self._busy_slot_steps / total, 4),
+        }
 
     def run(self) -> dict[int, list[int]]:
         """Drive until every submitted request finishes."""
@@ -370,6 +398,9 @@ class ContinuousBatcher:
         snapshot = list(self._slot_req)
         fresh = list(self._slot_new)
         self._slot_new = [False] * self.slots
+        busy = sum(1 for r in snapshot if r is not None)
+        self._busy_slot_steps += busy * self.chunk_steps
+        self._total_slot_steps += self.slots * self.chunk_steps
         return emitted, snapshot, fresh
 
     def _process(self, emitted, snapshot, fresh) -> None:
@@ -379,6 +410,8 @@ class ContinuousBatcher:
                 continue
             emit = tokens[s] if fresh[s] else tokens[s, 1:]
             for t in emit:
+                if not req.tokens:
+                    req.first_token_at = time.monotonic()
                 req.tokens.append(int(t))
                 self._budget[s] -= 1
                 if (
