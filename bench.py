@@ -84,10 +84,12 @@ TARGET_UTILIZATION_PCT = 85.0
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
-    return sorted_vals[idx]
+    """Shared nearest-rank percentile (fractional q; 0.0 on empty —
+    legacy call sites round the result unconditionally)."""
+    from walkai_nos_tpu.utils.stats import percentile
+
+    p = percentile(sorted_vals, q * 100)
+    return 0.0 if p is None else p
 
 
 def _qos_phase(
@@ -434,11 +436,21 @@ def _qos_fields(
     ci_fields: dict = {}
     if fair_reps and noisy_reps and len(fair_reps) >= 3:
         degs = []
+        skipped = 0
         for f_seg, n_seg in zip(fair_reps, noisy_reps):
+            # A repeat whose arm completed ZERO requests is missing
+            # data, not evidence: an empty noisy arm would read as
+            # -100% "improvement" exactly when the aggressor starved
+            # the victims completely (same rule as the sweep rows).
+            if not f_seg or not n_seg:
+                skipped += 1
+                continue
             f99 = _percentile(f_seg, 0.99)
             n99 = _percentile(n_seg, 0.99)
             if f99 > 0:
                 degs.append(100.0 * (n99 - f99) / f99)
+            else:
+                skipped += 1
         if len(degs) >= 3:
             mean = statistics.fmean(degs)
             sd = statistics.stdev(degs)
@@ -450,8 +462,11 @@ def _qos_fields(
                     round(mean - half, 2), round(mean + half, 2),
                 ],
                 "noisy_neighbor_repeats": len(degs),
+                "noisy_neighbor_skipped_repeats": skipped,
+                # The claim requires every repeat to have produced
+                # data AND the interval's upper bound to clear 10%.
                 "noisy_neighbor_no_degradation": bool(
-                    mean + half < 10.0
+                    skipped == 0 and mean + half < 10.0
                 ),
             }
 

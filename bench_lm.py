@@ -370,12 +370,8 @@ def measure_continuous_batching(
         # concurrent load (queueing included: n_requests > slots, so
         # later requests wait for a free slot — that wait is the
         # latency cost the throughput above buys).
-        "cb_request_p50_s": round(lat[len(lat) // 2], 4) if lat else None,
-        # Nearest-rank percentile: ceil(q*n)-1 (int(q*n) overshoots a
-        # rank whenever q*n is exact).
-        "cb_request_p90_s": round(
-            lat[max(0, -(-9 * len(lat) // 10) - 1)], 4
-        ) if lat else None,
+        "cb_request_p50_s": round(_pctl(lat, 50), 4) if lat else None,
+        "cb_request_p90_s": round(_pctl(lat, 90), 4) if lat else None,
         "cb_slots": slots,
         "cb_requests": n_requests,
         "cb_chunk_steps": chunk_steps,
@@ -384,10 +380,10 @@ def measure_continuous_batching(
 
 
 def _pctl(sorted_vals, q):
-    """Nearest-rank percentile (q in percent): rank ceil(q/100*n)-1."""
-    if not sorted_vals:
-        return None
-    return sorted_vals[max(0, -(-q * len(sorted_vals) // 100) - 1)]
+    """Shared nearest-rank percentile (q in percent)."""
+    from walkai_nos_tpu.utils.stats import percentile
+
+    return percentile(sorted_vals, q)
 
 
 def measure_cb_serving(
@@ -540,6 +536,7 @@ def measure_cb_serving(
                     "wall_s": done_at - t0,
                     "done_at": done_at,
                     "ttft_s": out.get("ttft_seconds", 0.0),
+                    "engine_wall_s": out.get("engine_wall_seconds", 0.0),
                     "tokens": n,
                     "budget": payload["max_new_tokens"],
                 })
@@ -569,11 +566,17 @@ def measure_cb_serving(
 
     walls = sorted(r["wall_s"] for r in records)
     ttfts = sorted(r["ttft_s"] for r in records)
-    # Post-TTFT decode pace; requests that finished within their first
-    # chunk have no post-TTFT tokens to pace.
+    # Post-TTFT decode pace from the ENGINE-side wall (same clock
+    # origin as ttft: engine submit): the client wall includes
+    # pre-submit HTTP/queue wait, which would misattribute queueing —
+    # exactly what rises under this benchmark's own load — to decode
+    # pace. Requests finishing within their first chunk have no
+    # post-TTFT tokens to pace.
     token_paces = sorted(
-        (r["wall_s"] - r["ttft_s"]) / (r["tokens"] - 1)
-        for r in records if r["tokens"] > 1 and r["ttft_s"] > 0
+        (r["engine_wall_s"] - r["ttft_s"]) / (r["tokens"] - 1)
+        for r in records
+        if r["tokens"] > 1 and r["ttft_s"] > 0
+        and r["engine_wall_s"] > r["ttft_s"]
     )
     # Goodput counts only tokens whose request COMPLETED inside the
     # arrival window: in-flight stragglers joined after the cutoff

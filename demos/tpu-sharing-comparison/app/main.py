@@ -465,6 +465,7 @@ def main() -> None:
                             waiter = cb_waiters.pop(rid)
                             waiter["tokens"] = rec["tokens"]
                             waiter["ttft_s"] = rec["ttft_s"]
+                            waiter["wall_s"] = rec["wall_s"]
                             waiter["done"].set()
                 except Exception as e:  # noqa: BLE001
                     cb_enabled[0] = False
@@ -765,6 +766,12 @@ def main() -> None:
                     "tokens": waiter["tokens"],
                     "generate_time_seconds": round(dt, 6),
                     "ttft_seconds": round(waiter.get("ttft_s", 0.0), 6),
+                    # Engine-side wall (submit -> done, same clock
+                    # origin as ttft_seconds): lets clients separate
+                    # queueing from decode pace.
+                    "engine_wall_seconds": round(
+                        waiter.get("wall_s", 0.0), 6
+                    ),
                     "tokens_per_second": round(
                         len(waiter["tokens"]) / dt, 1
                     ),

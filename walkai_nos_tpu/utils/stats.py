@@ -1,0 +1,22 @@
+"""Shared benchmark statistics helpers.
+
+One percentile definition for every benchmark surface (bench.py,
+bench_lm.py): nearest-rank on a pre-sorted sample (rank
+ceil(q/100 * n), 1-based). Three diverging inline implementations
+(floor-rank vs ceil-rank, fractional vs percent q, 0.0 vs None on
+empty) previously made same-named metrics incomparable at small n.
+"""
+
+from __future__ import annotations
+
+
+def percentile(sorted_vals, q_pct: float):
+    """Nearest-rank percentile of a pre-sorted sequence; None if empty.
+
+    `q_pct` is in percent (50 = median, 99 = p99).
+    """
+    if not sorted_vals:
+        return None
+    n = len(sorted_vals)
+    rank = -(-int(q_pct * n) // 100)  # ceil(q/100 * n), 1-based
+    return sorted_vals[min(n, max(1, rank)) - 1]
