@@ -57,7 +57,7 @@ def test_cb_serving_benchmark_runs_end_to_end(monkeypatch):
             "WALKAI_LM_MODEL": "tiny",
             "WALKAI_CALIB_WINDOW_S": "0.2",
         },
-        startup_timeout_s=180.0,
+        startup_timeout_s=300.0,
     )
     assert r["cb_requests_completed"] > 0
     assert r["cb_request_errors"] == 0

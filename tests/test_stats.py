@@ -1,0 +1,46 @@
+"""The shared percentile helpers: one definition for every benchmark
+surface, pinned by value — the interpolated variant decides the
+noisy-neighbor CI, so an indexing drift must fail here, not shift the
+published verdict silently."""
+
+from walkai_nos_tpu.utils.stats import percentile, percentile_interp
+
+
+class TestPercentile:
+    def test_empty_is_none(self):
+        assert percentile([], 50) is None
+        assert percentile_interp([], 99) is None
+
+    def test_singleton(self):
+        assert percentile([7], 99) == 7
+        assert percentile_interp([7], 1) == 7
+
+    def test_nearest_rank(self):
+        vals = list(range(1, 101))  # 1..100
+        assert percentile(vals, 50) == 50
+        assert percentile(vals, 99) == 99
+        assert percentile(vals, 100) == 100
+        assert percentile([1, 2, 3], 50) == 2
+        assert percentile([1, 2, 3], 90) == 3
+
+    def test_interpolated(self):
+        assert percentile_interp([0, 10], 50) == 5.0
+        assert percentile_interp([1, 2, 3, 4], 50) == 2.5
+        # 0..100: position q maps exactly onto the value q.
+        vals = list(range(101))
+        for q in (0, 25, 50, 95, 99, 100):
+            assert abs(percentile_interp(vals, q) - q) < 1e-9
+        # Between order statistics: linear blend.
+        assert abs(percentile_interp([0, 100], 75) - 75.0) < 1e-9
+
+    def test_interp_smoother_than_rank(self):
+        """The property the CI path relies on: a small sample change
+        moves the interpolated estimate continuously, not by a whole
+        order statistic."""
+        a = [0.1] * 99 + [0.2]
+        b = [0.1] * 98 + [0.2, 0.2]
+        jump_rank = abs(percentile(b, 99) - percentile(a, 99))
+        jump_interp = abs(
+            percentile_interp(b, 99) - percentile_interp(a, 99)
+        )
+        assert jump_interp <= jump_rank

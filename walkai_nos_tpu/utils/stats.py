@@ -20,3 +20,26 @@ def percentile(sorted_vals, q_pct: float):
     n = len(sorted_vals)
     rank = -(-int(q_pct * n) // 100)  # ceil(q/100 * n), 1-based
     return sorted_vals[min(n, max(1, rank)) - 1]
+
+
+def percentile_interp(sorted_vals, q_pct: float):
+    """Linearly interpolated percentile; None if empty.
+
+    For ESTIMATION (e.g. a per-repeat tail statistic feeding a
+    confidence interval): nearest-rank jumps between adjacent order
+    statistics — on a tunneled runtime those are quantized in whole
+    fence RTTs (~0.1 s), which inflates the between-repeat variance
+    with pure rank noise. Interpolating between the bracketing order
+    statistics is the standard lower-variance estimator. Reported
+    headline percentiles stay nearest-rank (a value that actually
+    occurred)."""
+    if not sorted_vals:
+        return None
+    n = len(sorted_vals)
+    if n == 1:
+        return sorted_vals[0]
+    pos = (q_pct / 100.0) * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
