@@ -424,6 +424,17 @@ def main() -> None:
             cb_waiters: dict[int, dict] = {}
             cb_enabled = [True]
 
+            def cb_fail_waiter(holder, error=None) -> None:
+                """Failure notification, one definition: tokens=None
+                (the handlers' failure marker), optional error text,
+                end-of-stream sentinel for SSE waiters, then wake."""
+                if error is not None:
+                    holder["error"] = error
+                holder["tokens"] = None
+                if holder.get("queue") is not None:
+                    holder["queue"].put(None)
+                holder["done"].set()
+
             def cb_driver() -> None:
                 """Single thread owning the engine: drains submissions
                 (blocking when idle), steps the batch, fulfils
@@ -449,9 +460,7 @@ def main() -> None:
                                 except ValueError as bad:
                                     # Bad per-request knobs fail THAT
                                     # request, never the engine thread.
-                                    holder["error"] = str(bad)
-                                    holder["tokens"] = None
-                                    holder["done"].set()
+                                    cb_fail_waiter(holder, str(bad))
                                 else:
                                     cb_waiters[rid] = holder
                                 item = cb_queue.get_nowait()
@@ -459,6 +468,14 @@ def main() -> None:
                             pass
                         if cb_engine.has_work:
                             cb_engine.step()
+                        # Streaming feed: push newly visible tokens to
+                        # SSE waiters as each chunk syncs.
+                        for rid, delta in (
+                            cb_engine.drain_new_tokens().items()
+                        ):
+                            w = cb_waiters.get(rid)
+                            if w is not None and w.get("queue") is not None:
+                                w["queue"].put(delta)
                         for rid, rec in (
                             cb_engine.drain_done_records().items()
                         ):
@@ -466,21 +483,21 @@ def main() -> None:
                             waiter["tokens"] = rec["tokens"]
                             waiter["ttft_s"] = rec["ttft_s"]
                             waiter["wall_s"] = rec["wall_s"]
+                            if waiter.get("queue") is not None:
+                                waiter["queue"].put(None)  # end of stream
                             waiter["done"].set()
                 except Exception as e:  # noqa: BLE001
                     cb_enabled[0] = False
                     print(f"continuous batching disabled: {e!r}")
                     for waiter in cb_waiters.values():
-                        waiter["tokens"] = None
-                        waiter["done"].set()
+                        cb_fail_waiter(waiter)
                     cb_waiters.clear()
                     while True:  # drain late submissions to the fallback
                         try:
                             _, _, _, holder = cb_queue.get_nowait()
                         except queue.Empty:
                             break
-                        holder["tokens"] = None
-                        holder["done"].set()
+                        cb_fail_waiter(holder)
 
             threading.Thread(target=cb_driver, daemon=True).start()
             print(
@@ -702,16 +719,18 @@ def main() -> None:
             if req_eos is not None and not 0 <= req_eos < lm_cfg.vocab_size:
                 self.send_error(400, "eos_id out of vocab range")
                 return
+            req_stream = bool(body.get("stream"))
             wants_sampling = (
                 knobs["temperature"] != 0.0
                 or knobs["top_k"] != 0
                 or knobs["top_p"] != 1.0
                 or "seed" in knobs
-                # Per-request budget/EOS ride the slot pool too: the
-                # one-shot paths compile per max_new signature and
-                # have no EOS scan.
+                # Per-request budget/EOS/streaming ride the slot pool:
+                # the one-shot paths compile per max_new signature,
+                # have no EOS scan, and produce tokens all at once.
                 or req_max_new is not None
                 or req_eos is not None
+                or req_stream
             )
             on_batched_path = (
                 not speculative
@@ -739,6 +758,9 @@ def main() -> None:
                 # and a bad value fails only this request (400).
                 if req_eos is not None:
                     knobs["eos_id"] = req_eos
+                if req_stream:
+                    self._generate_stream(prompt, knobs, req_max_new)
+                    return
                 waiter = {"done": threading.Event()}
                 t0 = time.perf_counter()
                 cb_queue.put(
@@ -819,6 +841,92 @@ def main() -> None:
                 "slice": slice_id,
                 **extra,
             })
+
+        def _generate_stream(self, prompt, knobs, req_max_new):
+            """Server-sent events: tokens stream as each engine chunk
+            syncs (up to chunk_steps per event), then a final event
+            with the request telemetry. The connection closes at end
+            of stream (no Content-Length on an open-ended body)."""
+            waiter = {
+                "done": threading.Event(),
+                "queue": queue.SimpleQueue(),
+            }
+            t0 = time.perf_counter()
+            cb_queue.put(
+                (prompt, req_max_new or lm_max_new, knobs, waiter)
+            )
+            # Hold the status line until the FIRST queue item: the
+            # engine's submit-time validation runs in the driver
+            # thread, and a rejected request must fail with the same
+            # 400 the non-streaming path returns — not a 200 wearing
+            # an SSE error event.
+            while True:
+                try:
+                    item = waiter["queue"].get(timeout=1.0)
+                    break
+                except queue.Empty:
+                    if not cb_enabled[0]:
+                        self.send_error(503, "batch engine failed; retry")
+                        return
+                    if time.perf_counter() - t0 > 120.0:
+                        self.send_error(503, "generation timed out")
+                        return
+            if item is None and waiter.get("error"):
+                self.send_error(400, waiter["error"])
+                return
+            if item is None and waiter.get("tokens") is None:
+                self.send_error(503, "batch engine failed; retry")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+
+            def event(payload: dict) -> None:
+                self.wfile.write(
+                    b"data: " + json.dumps(payload).encode() + b"\n\n"
+                )
+                self.wfile.flush()
+
+            try:
+                while True:
+                    if item is None:  # end of stream (or failure)
+                        if waiter.get("tokens") is None:
+                            event({"error": "batch engine failed; retry"})
+                        else:
+                            event({
+                                "done": True,
+                                "n_tokens": len(waiter["tokens"]),
+                                "ttft_seconds": round(
+                                    waiter.get("ttft_s", 0.0), 6
+                                ),
+                                "engine_wall_seconds": round(
+                                    waiter.get("wall_s", 0.0), 6
+                                ),
+                                "slice": slice_id,
+                                "batched": True,
+                            })
+                        return
+                    event({"tokens": item})
+                    while True:
+                        try:
+                            item = waiter["queue"].get(timeout=1.0)
+                            break
+                        except queue.Empty:
+                            if not cb_enabled[0]:
+                                event({
+                                    "error": "batch engine failed; retry"
+                                })
+                                return
+                            if time.perf_counter() - t0 > 120.0:
+                                event({"error": "generation timed out"})
+                                return
+            except (BrokenPipeError, ConnectionResetError):
+                # Client went away mid-stream: the engine finishes the
+                # request on its own; nothing to clean up here.
+                pass
 
         def do_GET(self):
             if self.path == "/healthz":

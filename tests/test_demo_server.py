@@ -268,6 +268,74 @@ class TestContinuousBatchingEndpoint:
         )
         assert again["tokens"] == out["tokens"]
 
+    def test_per_request_budget_and_eos(self, cb_server):
+        _, out = self._post(
+            cb_server, {"prompt": [1, 2, 3], "max_new_tokens": 3}
+        )
+        assert out.get("batched") is True
+        assert len(out["tokens"]) == 3
+        status, _ = self._post(
+            cb_server, {"prompt": [1, 2], "max_new_tokens": 99}
+        )
+        assert status == 400
+        # EOS set to the first greedy token: generation stops at it.
+        _, plain = self._post(cb_server, {"prompt": [1, 2, 3]})
+        eos = plain["tokens"][0]
+        _, out = self._post(
+            cb_server, {"prompt": [1, 2, 3], "eos_id": eos}
+        )
+        assert out["tokens"] == [eos]
+
+    def test_streaming_generation(self, cb_server):
+        """SSE streaming: token events as chunks sync, a final event
+        with telemetry, and the concatenation equals the
+        non-streaming (= standalone greedy) output."""
+        import http.client
+        import json as _json
+        from urllib.parse import urlparse
+
+        _, plain = self._post(cb_server, {"prompt": [1, 2, 3, 4]})
+        conn = http.client.HTTPConnection(
+            urlparse(cb_server).netloc, timeout=150
+        )
+        conn.request(
+            "POST", "/generate",
+            _json.dumps({"prompt": [1, 2, 3, 4], "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events = []
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if line.startswith(b"data: "):
+                events.append(_json.loads(line[6:]))
+        conn.close()
+        token_events = [e for e in events if "tokens" in e]
+        streamed = [t for e in token_events for t in e["tokens"]]
+        final = events[-1]
+        assert final.get("done") is True, events
+        assert final["n_tokens"] == 6
+        assert final["engine_wall_seconds"] >= final["ttft_seconds"] >= 0
+        assert streamed == plain["tokens"]
+        # Chunked delivery (chunk_steps=2, 6 tokens): tokens arrive
+        # across multiple events, not one blob at the end.
+        assert len(token_events) >= 2
+
+    def test_streaming_bad_knobs_same_400_as_nonstreaming(self, cb_server):
+        """Engine-side validation failures must carry the same HTTP
+        status either way: the streaming path holds its status line
+        until the first engine event."""
+        status, _ = self._post(
+            cb_server,
+            {"prompt": [1, 2], "stream": True, "top_p": 0.0},
+        )
+        assert status == 400
+
     def test_bad_sampling_knobs_rejected(self, cb_server):
         status, _ = self._post(
             cb_server, {"prompt": [1, 2], "temperature": -1.0}

@@ -77,6 +77,7 @@ class _Request:
     submitted_at: float = 0.0
     first_token_at: float = 0.0
     completed_at: float = 0.0
+    streamed: int = 0  # tokens already handed out via drain_new_tokens
 
 
 class ContinuousBatcher:
@@ -353,6 +354,19 @@ class ContinuousBatcher:
             rid: rec["tokens"]
             for rid, rec in self.drain_done_records().items()
         }
+
+    def drain_new_tokens(self) -> dict[int, list[int]]:
+        """Tokens newly visible since the last call, per request —
+        the STREAMING feed (active and just-finished requests alike;
+        tokens become visible at their chunk's host sync, so a
+        streaming server emits up to `chunk_steps` tokens per event).
+        Orthogonal to `drain_done*`: this never removes requests."""
+        out = {}
+        for rid, r in self._requests.items():
+            if len(r.tokens) > r.streamed:
+                out[rid] = r.tokens[r.streamed:]
+                r.streamed = len(r.tokens)
+        return out
 
     def drain_done_records(self) -> dict[int, dict]:
         """Like `drain_done`, with per-request serving telemetry:
