@@ -307,9 +307,11 @@ def measure_continuous_batching(
     for a TPU VM's local runtime, where the chunk sync is ~free and
     the batcher's advantage approaches the slot count. The chunk
     round-trip is fixed-cost, so the advantage scales with the pool:
-    measured 2.1x at 8 slots, 3.4x at 16, 5.2x at 32 (the default
-    operating point; the decode step is memory-bound, so wider batches
-    are ~free until the weights stop dominating the step).
+    measured 2.1x at 8 slots, 3.4x at 16, 5.3x at 32 (the default
+    operating point), 6.0x at 64 — still unsaturated, but the 64-slot
+    point pays ~1.8x the per-request p50 (0.63 -> 1.16 s at 2x-slots
+    queued requests), so 32 stays the default throughput/latency
+    trade.
     """
     import jax.numpy as jnp
 

@@ -83,6 +83,13 @@ def test_serving_benchmark_runs_end_to_end(bench_mod):
     assert r["noisy_neighbor_repeats"] >= 3
     lo, hi = r["noisy_neighbor_degradation_ci95_pct"]
     assert lo <= r["noisy_neighbor_degradation_mean_pct"] <= hi
+    # The claim rides the p95-tail interval: its fields must exist,
+    # cohere, and agree with the claim expression.
+    lo95, hi95 = r["noisy_neighbor_degradation_p95_ci95_pct"]
+    assert lo95 <= r["noisy_neighbor_degradation_p95_mean_pct"] <= hi95
+    assert r["noisy_neighbor_no_degradation"] == (
+        r["noisy_neighbor_skipped_repeats"] == 0 and hi95 < 10.0
+    )
     # Co-tenancy sweep covers the four widths with real samples.
     assert [row["streams"] for row in r["cotenancy_sweep"]] == [1, 2, 4, 8]
     assert all(row["requests"] > 0 for row in r["cotenancy_sweep"])
