@@ -478,6 +478,10 @@ def _qos_fields(
         if len(degs_p99) >= 3:
             mean99, half99 = mean_ci(degs_p99)
             mean95, half95 = mean_ci(degs_p95)
+            # The claim is computed from the ROUNDED upper bound so
+            # the published JSON is self-consistent (a reader checking
+            # ci95[1] < 10 must reach the same verdict).
+            hi95 = round(mean95 + half95, 2)
             ci_fields = {
                 # p99-tail interval: reported for transparency, but a
                 # per-repeat p99 over ~300 samples is a top-3 order
@@ -496,14 +500,14 @@ def _qos_fields(
                     mean95, 2
                 ),
                 "noisy_neighbor_degradation_p95_ci95_pct": [
-                    round(mean95 - half95, 2), round(mean95 + half95, 2),
+                    round(mean95 - half95, 2), hi95,
                 ],
                 "noisy_neighbor_repeats": len(degs_p99),
                 "noisy_neighbor_skipped_repeats": skipped,
                 # Claim: every repeat produced data AND the p95-tail
                 # interval's upper bound clears 10%.
                 "noisy_neighbor_no_degradation": bool(
-                    skipped == 0 and mean95 + half95 < 10.0
+                    skipped == 0 and hi95 < 10.0
                 ),
             }
 
@@ -598,15 +602,39 @@ def main() -> None:
     except Exception as e:
         err = (err + "; " if err else "") + f"scheduling: {e}"
     util = result.get("utilization_pct", 0.0)
+    # Headline keys lead the line: the round-4 driver truncated the
+    # recorded tail of a ~4 KB JSON line, losing whatever sat last —
+    # every per-phase headline now lands in the first few hundred
+    # bytes, and the full result is ALSO written to bench_last.json.
+    headline = {
+        k: result[k]
+        for k in (
+            "utilization_pct", "mfu_pct", "p50_time_to_scheduled_s",
+            "vs_decode_ceiling", "vs_decode_gqa_ceiling",
+            "vs_decode_gqa_ceiling_adjusted", "decode_gqa_tokens_per_s",
+            "cb_vs_serial_speedup", "cb_ttft_p50", "cb_token_p99",
+            "noisy_neighbor_no_degradation", "spec_speedup",
+        )
+        if k in result
+    }
     out = {
         "metric": "aggregate_chip_utilization_4streams",
         "value": util,
         "unit": "%",
         "vs_baseline": round(util / TARGET_UTILIZATION_PCT, 4),
-        **result,
+        # An error must survive tail truncation too.
+        **({"error": err} if err else {}),
+        **headline,
+        **{k: v for k, v in result.items() if k not in headline},
     }
-    if err:
-        out["error"] = err
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_last.json"), "w",
+        ) as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass  # sidecar is best-effort; the stdout line is the contract
     print(json.dumps(out))
 
 
