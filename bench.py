@@ -612,6 +612,7 @@ def main() -> None:
             "utilization_pct", "mfu_pct", "p50_time_to_scheduled_s",
             "vs_decode_ceiling", "vs_decode_gqa_ceiling",
             "vs_decode_gqa_ceiling_adjusted", "decode_gqa_tokens_per_s",
+            "decode_gqa_roofline_fraction", "decode_tokens_per_dispatch",
             "cb_vs_serial_speedup", "cb_ttft_p50", "cb_token_p99",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )

@@ -67,6 +67,47 @@ def test_cb_serving_benchmark_runs_end_to_end(monkeypatch):
     assert r["cb_serving_request_p90_s"] >= r["cb_serving_request_p50_s"]
 
 
+def test_decode_bench_emits_roofline_fields(monkeypatch):
+    """The decode phase's new first-class fields — the roofline
+    attainment of the measured attention chain and the dispatch
+    amortization operating point — must be emitted by
+    `measure_decode`, not derived by hand from the step breakdown.
+    Runs the tiny CPU model with a stubbed HBM bandwidth (the CPU
+    device kind has none published); the VALUES are meaningless here —
+    the field contract is what CI pins."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    import bench_lm
+    from walkai_nos_tpu.models.lm import LM_TINY
+
+    monkeypatch.setattr(
+        "walkai_nos_tpu.utils.flops.hbm_bytes_per_s", lambda kind: 1e12
+    )
+    r = bench_lm.measure_decode(
+        cfg=LM_TINY, batch=2, prompt_len=4, new_tokens=8,
+        pipeline=1, compare_batch=None, tokens_per_dispatch=4,
+    )
+    assert r["decode_tokens_per_dispatch"] == 4
+    assert 0 < r["decode_gqa_roofline_fraction"] <= 1.0
+    bd = r["decode_gqa_step_breakdown"]
+    assert set(bd) >= {
+        "attention_ms", "non_attention_ms", "host_dispatch_ms",
+        "attention_hbm_ideal_ms", "device_step_ms",
+    }
+    # The fraction is the breakdown's own ratio, rounded.
+    assert r["decode_gqa_roofline_fraction"] == pytest.approx(
+        bd["attention_hbm_ideal_ms"] / bd["attention_ms"], abs=2e-3
+    )
+    # And both new fields are headline keys in bench.py's emitted
+    # line (they must survive driver-side tail truncation).
+    import inspect
+
+    import bench
+
+    src = inspect.getsource(bench.main)
+    assert "decode_gqa_roofline_fraction" in src
+    assert "decode_tokens_per_dispatch" in src
+
+
 def test_serving_benchmark_runs_end_to_end(bench_mod):
     r = bench_mod.serving_benchmark()
     # The phase completed: throughput, probe, and QoS sections all

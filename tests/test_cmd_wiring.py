@@ -125,7 +125,11 @@ class TestPartitionerWiring:
             },
         )
         mapper(Request("host-a"))
-        assert [(r.namespace, r.name) for r in enqueued] == [("default", "j1")]
+        # The pending pod, plus the planner wake-up sentinel (empty
+        # name) driving the stranded-pool-share sweep.
+        assert [(r.namespace, r.name) for r in enqueued] == [
+            ("default", "j1"), ("", ""),
+        ]
 
 
 class TestAgentWiring:

@@ -1,0 +1,237 @@
+"""Streamed decode kernel + amortized-dispatch generate loop (PR 1).
+
+Tier-1 surface for the decode hot path: the streamed Pallas kernel
+(`ops/decode_attention.py`) runs here in interpreter mode on CPU (no
+hardware in tests — SURVEY.md §4), and the chunked generate loop
+(`models/decode.py`) is pinned token-identical across every
+`tokens_per_dispatch`, including EOS landing mid-chunk. This file is
+deliberately NOT in conftest's `_SLOW_FILES`: the fast control-plane
+loop must exercise the serving hot path's correctness surface, so the
+shapes here stay small; microbenchmark-scale shapes carry an explicit
+`slow` mark instead.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.ops import decode_attention as da
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2, max_seq_len=64
+)
+
+
+def _qkv(b=2, h=4, kvh=2, s=256, d=64, steps=None, seed=0,
+         dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    qshape = (b, h, d) if steps is None else (b, h, steps, d)
+    q = jnp.asarray(rng.standard_normal(qshape), dtype)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, d)), dtype)
+    return q, k, v
+
+
+def _prompt(b=2, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, CFG.vocab_size, (b, n)), jnp.int32)
+
+
+class TestStreamedKernelParity:
+    """The streamed kernel (blocked cache iteration, logsumexp-combined
+    partial softmax, skipped tail blocks) vs the XLA reference."""
+
+    @pytest.mark.parametrize("kvh", [1, 2, 4])
+    @pytest.mark.parametrize("index", [0, 127, 128, 255])
+    def test_gqa_shapes_and_bucket_boundaries(self, kvh, index):
+        """kv_heads ∈ {1, 2, 4} across cache-block boundary indices
+        (127/128: the skip decision flips exactly here)."""
+        q, k, v = _qkv(kvh=kvh)
+        out = da.decode_attention(q, k, v, jnp.int32(index), interpret=True)
+        ref = da.decode_attention_reference(q, k, v, jnp.int32(index))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_uneven_per_slot_cache_lengths(self):
+        """Ragged decoding: each row at its own position, spanning
+        different visible block counts within one grid block."""
+        q, k, v = _qkv(b=4, kvh=2, s=384)
+        idx = jnp.asarray([0, 17, 129, 383], jnp.int32)
+        out = da.decode_attention(q, k, v, idx, interpret=True)
+        ref = da.decode_attention_reference(q, k, v, idx)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_skipped_tail_blocks_never_leak(self):
+        """Cache rows in blocks wholly past the index must not affect
+        the output — they are skipped, not read-and-masked, so poison
+        there must be invisible."""
+        q, k, v = _qkv(s=384, seed=1)
+        pk = k.at[:, :, 128:].set(jnp.inf)  # blocks 1 and 2 poisoned
+        pv = v.at[:, :, 128:].set(jnp.inf)
+        out = da.decode_attention(q, pk, pv, jnp.int32(99), interpret=True)
+        clean = da.decode_attention(q, k, v, jnp.int32(99), interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(clean))
+
+    @pytest.mark.parametrize("steps", [2, 7])
+    def test_multi_step_queries(self, steps):
+        """steps query positions per head (the speculative verify
+        shape): row r at position index + r sees cache rows
+        <= index + r."""
+        q, k, v = _qkv(steps=steps)
+        out = da.decode_attention(q, k, v, jnp.int32(120), interpret=True)
+        ref = da.decode_attention_reference(q, k, v, jnp.int32(120))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_multi_step_crosses_block_boundary(self):
+        """Queries whose positions straddle a 128-row block edge keep
+        the boundary block visible for the later rows only."""
+        q, k, v = _qkv(steps=4, seed=2)
+        out = da.decode_attention(q, k, v, jnp.int32(126), interpret=True)
+        ref = da.decode_attention_reference(q, k, v, jnp.int32(126))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_multi_step_ragged(self):
+        q, k, v = _qkv(b=4, kvh=2, steps=3, seed=3)
+        idx = jnp.asarray([0, 100, 126, 250], jnp.int32)
+        out = da.decode_attention(q, k, v, idx, interpret=True)
+        ref = da.decode_attention_reference(q, k, v, idx)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_bf16_inputs_f32_accumulation(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16, seed=4)
+        out = da.decode_attention(q, k, v, jnp.int32(200), interpret=True)
+        ref = da.decode_attention_reference(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), jnp.int32(200),
+        )
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+        )
+
+    def test_untiled_cache_falls_back(self):
+        q, k, v = _qkv(s=100)
+        out = da.decode_attention(q, k, v, jnp.int32(50))
+        ref = da.decode_attention_reference(q, k, v, jnp.int32(50))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    @pytest.mark.slow
+    def test_serving_scale_shape(self):
+        """Microbenchmark-scale parity (the bench's b=128, kv=2 serving
+        point, interpreted): slow — the interpreter walks 256 grid
+        steps of 16-cell blocks."""
+        q, k, v = _qkv(b=128, h=8, kvh=2, s=256, seed=5)
+        out = da.decode_attention(q, k, v, jnp.int32(160), interpret=True)
+        ref = da.decode_attention_reference(q, k, v, jnp.int32(160))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+
+class TestAmortizedDispatch:
+    """`tokens_per_dispatch` changes WHEN the host syncs, never the
+    tokens: every chunk size must be bit-identical to the single-step
+    path."""
+
+    @pytest.fixture(scope="class")
+    def params(self):
+        return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+    @pytest.mark.parametrize("tpd", [1, 4, 8])
+    def test_greedy_token_identical_across_dispatch_sizes(
+        self, params, tpd
+    ):
+        base = make_generate_fn(CFG, tokens_per_dispatch=1)(
+            params, _prompt(), max_new_tokens=11
+        )
+        out = make_generate_fn(CFG, tokens_per_dispatch=tpd)(
+            params, _prompt(), max_new_tokens=11
+        )
+        assert jnp.array_equal(base, out), (tpd, base, out)
+
+    def test_one_shot_default_matches_chunked(self, params):
+        """tokens_per_dispatch=None (whole generation per dispatch,
+        the bench's shape) emits the same tokens as chunked."""
+        one_shot = make_generate_fn(CFG)(
+            params, _prompt(), max_new_tokens=11
+        )
+        chunked = make_generate_fn(CFG, tokens_per_dispatch=4)(
+            params, _prompt(), max_new_tokens=11
+        )
+        assert jnp.array_equal(one_shot, chunked)
+
+    @pytest.mark.parametrize("tpd", [1, 4, 8])
+    def test_eos_mid_chunk_token_identical(self, params, tpd):
+        """EOS landing mid-chunk: finished rows pad deterministically
+        with eos_id, so every dispatch size agrees — including the
+        early-exit host path (all rows done before the budget)."""
+        full = make_generate_fn(CFG)(params, _prompt(), max_new_tokens=11)
+        eos = int(full[0, 5])  # row 0 finishes mid-generation
+        base = make_generate_fn(CFG, tokens_per_dispatch=1, eos_id=eos)(
+            params, _prompt(), max_new_tokens=11
+        )
+        out = make_generate_fn(CFG, tokens_per_dispatch=tpd, eos_id=eos)(
+            params, _prompt(), max_new_tokens=11
+        )
+        assert jnp.array_equal(base, out), (tpd, base, out)
+        # Post-EOS suffix is all-eos in every row that hit it.
+        arr = np.asarray(out)
+        for row in arr:
+            hits = np.where(row == eos)[0]
+            if len(hits):
+                assert (row[hits[0]:] == eos).all(), row
+
+    def test_sampling_deterministic_across_dispatch_sizes(self, params):
+        a = make_generate_fn(CFG, temperature=1.0, tokens_per_dispatch=3)(
+            params, _prompt(), max_new_tokens=9,
+            rng=jax.random.PRNGKey(7),
+        )
+        b = make_generate_fn(CFG, temperature=1.0, tokens_per_dispatch=1)(
+            params, _prompt(), max_new_tokens=9,
+            rng=jax.random.PRNGKey(7),
+        )
+        assert jnp.array_equal(a, b)
+        assert bool(jnp.all((0 <= a) & (a < CFG.vocab_size)))
+
+    def test_generator_is_reusable(self, params):
+        """The donated carry is engine-internal: back-to-back calls on
+        one generator (fresh prefill each) must agree — donation must
+        never consume the params or leak state across calls."""
+        gen = make_generate_fn(CFG, tokens_per_dispatch=4)
+        a = gen(params, _prompt(), max_new_tokens=7)
+        b = gen(params, _prompt(), max_new_tokens=7)
+        assert jnp.array_equal(a, b)
+
+    def test_bad_tokens_per_dispatch_rejected(self):
+        with pytest.raises(ValueError, match="tokens_per_dispatch"):
+            make_generate_fn(CFG, tokens_per_dispatch=0)
+
+
+class TestKernelThroughModel:
+    """End-to-end greedy decode THROUGH the streamed kernel (interpret
+    mode forced via WALKAI_DECODE_INTERPRET — the CPU seam): the kernel
+    path must emit exactly the tokens the XLA reference path does."""
+
+    def test_gqa_generate_matches_reference_path(self, monkeypatch):
+        cfg = dataclasses.replace(CFG, num_kv_heads=1, max_seq_len=256)
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        ref = make_generate_fn(cfg)(params, _prompt(), max_new_tokens=6)
+        monkeypatch.setenv("WALKAI_DECODE_INTERPRET", "1")
+        out = make_generate_fn(cfg)(params, _prompt(), max_new_tokens=6)
+        assert jnp.array_equal(ref, out), (ref, out)

@@ -364,6 +364,9 @@ class TestNodeEventMapper:
         enqueued: list[Request] = []
         mapper = make_node_event_mapper(kube, enqueued.append)
         mapper(Request(name="n1"))
+        # The pending pod, plus the planner wake-up sentinel (empty
+        # name) that drives the stranded-pool-share sweep even when
+        # nothing is pending.
         assert [(r.name, r.namespace) for r in enqueued] == [
-            ("p1", "default")
+            ("p1", "default"), ("", ""),
         ]

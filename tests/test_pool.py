@@ -234,6 +234,67 @@ class TestPoolNode:
         with pytest.raises(GenericError):
             pool.add_pod({"2x2x2": 1})
 
+    def test_stranded_share_retile_sweep(self):
+        """The event-driven janitor (`stranded_share_retiles`): a
+        reported free share whose mate was re-tiled away (spec AND
+        status) is retired to the host-local default — the race the
+        in-pass drop cannot see (the strand surfaces only after the
+        pass that created it, when nothing is pending)."""
+        from walkai_nos_tpu.tpu.tiling.pool import stranded_share_retiles
+
+        spec_share = {
+            f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x2x2": "1"
+        }
+        members = [
+            _member("p-0", 0, annotations={  # re-tiled host-locally
+                f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1x2-used": "1",
+                f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-1x1x2": "1",
+            }),
+            _member("p-1", 1, annotations={  # stranded share
+                f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2x2-free": "1",
+                **spec_share,
+            }),
+        ]
+        writes = stranded_share_retiles("pool-a", members)
+        assert [obj["metadata"]["name"] for obj, _ in writes] == ["p-1"]
+        (_obj, part), = writes
+        geom = part.per_mesh_geometry()[0]
+        assert "2x2x2" not in geom and geom  # host-local default
+
+    def test_sweep_leaves_initializing_pool_alone(self):
+        """Mid-initialization — the mate's spec already carries the
+        share but its report is still in flight — is NOT a strand: the
+        janitor must never fight pool setup."""
+        from walkai_nos_tpu.tpu.tiling.pool import stranded_share_retiles
+
+        members = [
+            _member("p-0", 0, annotations={  # reported first
+                f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2x2-free": "1",
+                f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x2x2": "1",
+            }),
+            _member("p-1", 1, annotations={  # planned, not yet reported
+                f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x2x2": "1",
+            }),
+        ]
+        assert stranded_share_retiles("pool-a", members) == []
+
+    def test_sweep_never_touches_used_shares(self):
+        """A USED share is a running gang member — even with its mate
+        gone, eviction is never the janitor's call."""
+        from walkai_nos_tpu.tpu.tiling.pool import stranded_share_retiles
+
+        members = [
+            _member("p-0", 0, annotations={
+                f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-1x1x2-used": "1",
+                f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-1x1x2": "1",
+            }),
+            _member("p-1", 1, annotations={
+                f"{constants.ANNOTATION_TPU_STATUS_PREFIX}-0-2x2x2-used": "1",
+                f"{constants.ANNOTATION_TPU_SPEC_PREFIX}-0-2x2x2": "1",
+            }),
+        ]
+        assert stranded_share_retiles("pool-a", members) == []
+
     def test_free_hosts_reassigned_from_local_tilings(self):
         # Both hosts fully host-locally tiled but free: a pending pool
         # slice reclaims them (the VERDICT "re-tiles for a pending

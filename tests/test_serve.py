@@ -101,14 +101,20 @@ class TestLifecycle:
         params = _params()
         prompts = _prompts(3, seed=4)
         full = _expected(CFG, params, prompts[0], 8)
-        eos = full[2]  # force an early exit at the third token
+        # Force an early exit mid-stream: the chosen eos token's FIRST
+        # occurrence must be at its index (a repeat earlier in the
+        # sequence would legitimately end the request there instead).
+        eos, cut = next(
+            (t, i) for i, t in enumerate(full)
+            if 1 <= i < 7 and t not in full[:i]
+        )
         engine = ContinuousBatcher(
             CFG, params, slots=1, cache_len=64, chunk_steps=2,
         )
         r0 = engine.submit(prompts[0], max_new_tokens=8, eos_id=eos)
         r1 = engine.submit(prompts[1], max_new_tokens=4)
         results = engine.run()
-        assert results[r0] == full[:3]  # truncated at EOS, inclusive
+        assert results[r0] == full[:cut + 1]  # truncated at EOS, inclusive
         assert results[r1] == _expected(CFG, params, prompts[1], 4)
 
     def test_single_token_request(self):

@@ -65,6 +65,12 @@ def make_node_event_mapper(
                     name=objects.name(pod), namespace=objects.namespace(pod)
                 )
             )
+        # Always wake the planner once per node event, pods or not: the
+        # pool-consistency sweep (`reconcile_batch`'s janitor) must see
+        # a share REPORT that lands after the plan pass that stranded
+        # it — with only pending-pod wakeups, a strand surfacing when
+        # nothing is pending would be advertised forever.
+        enqueue(Request(name="", namespace=""))
         return Result()
 
     return reconcile
@@ -109,6 +115,8 @@ class PodController:
         pods: list[dict] = []
         seen: set[tuple[str, str]] = set()
         for req in requests:
+            if not req.name:
+                continue  # planner wake-up sentinel (node event mapper)
             key = (req.namespace, req.name)
             if key in seen:
                 continue
@@ -130,16 +138,22 @@ class PodController:
                 objects.name(p),
             )
         )
-        if not pods:
-            return
-        self._plan_pass(
-            pods, get_requested_profiles, self._list_tiling_nodes,
-            Node.from_node, "repartitioned", include_pools=True,
-        )
-        self._plan_pass(
-            pods, get_requested_shared_profiles, self._list_sharing_nodes,
-            SharingNode.from_node, "re-shared",
-        )
+        if pods:
+            self._plan_pass(
+                pods, get_requested_profiles, self._list_tiling_nodes,
+                Node.from_node, "repartitioned", include_pools=True,
+            )
+            self._plan_pass(
+                pods, get_requested_shared_profiles,
+                self._list_sharing_nodes, SharingNode.from_node,
+                "re-shared",
+            )
+        # Pool-consistency janitor, pending pods or not: a plan pass
+        # whose snapshot predated a mate's share report leaves that
+        # share stranded AFTER the pass — only an event-driven sweep
+        # can retire it (`pool.stranded_share_retiles`, which refuses
+        # to touch pools mid-initialization or mid-plan).
+        self._sweep_stranded_pool_shares()
 
     def _plan_pass(
         self, pods: list[dict], wanted_fn, list_nodes, node_factory,
@@ -231,6 +245,36 @@ class PodController:
         return False
 
     # --------------------------------------------------------------- helpers
+
+    def _sweep_stranded_pool_shares(self) -> None:
+        """Re-tile reported free pool shares no complete block can back
+        (see `pool.stranded_share_retiles` for the race this closes).
+
+        Lists nodes FRESH rather than reusing a plan pass's snapshot:
+        the pass may just have written specs, and the janitor's
+        mid-plan guard reads them. Cost when nothing is wrong: one
+        node list + a label check per node (annotation parsing happens
+        only for pool members) — per planner wake-up, not per pod."""
+        from walkai_nos_tpu.tpu.tiling.pool import (
+            group_pool_members,
+            stranded_share_retiles,
+        )
+
+        _singles, pools = group_pool_members(self._list_tiling_nodes())
+        for pool_name in sorted(pools):
+            writes = stranded_share_retiles(pool_name, pools[pool_name])
+            if not writes:
+                continue
+            plan_id = self._plan_id_fn()
+            for node_obj, partitioning in writes:
+                self._partitioner.apply_partitioning(
+                    node_obj, partitioning, plan_id
+                )
+            logger.info(
+                "pod controller: re-tiled %d stranded pool share(s) "
+                "in %s (plan %s)",
+                len(writes), pool_name, plan_id,
+            )
 
     def _should_consider_pod(self, pod: dict) -> bool:
         """Re-tiling only helps pods that new slice resources could

@@ -1,10 +1,30 @@
 """Autoregressive generation for the decoder LM (KV-cache decoding).
 
-Prefill runs the whole prompt through the cache-writing path once, then
-a `lax.scan` emits one token per step — everything static-shaped, one
-compiled program per (batch, prompt_len, max_new_tokens) signature, no
-Python in the decode loop. Greedy when temperature == 0, otherwise
-temperature sampling with a caller-provided PRNG key.
+Two jitted programs, the same amortized-dispatch structure
+`models/serve.py`'s engine uses: a PREFILL program runs the whole
+prompt through the cache-writing path once, then a STEP-CHUNK program
+scans `tokens_per_dispatch` decode steps with the KV cache DONATED in
+the carry (`donate_argnums` — the cache advances in place across
+dispatches instead of being copied per call), and a thin host loop
+dispatches chunks until the budget is spent. Everything stays
+static-shaped: the step program compiles once per
+(batch, chunk, bucket) signature and is REUSED across generation
+lengths, where the old whole-generation-in-one-program design
+recompiled for every distinct max_new_tokens. The per-dispatch host
+cost (~30 ms/call on a tunneled runtime, ~us on a TPU VM) amortizes
+across the chunk, and with `eos_id` set the host stops dispatching as
+soon as every row has finished — work the one-shot program always paid
+to the full budget. `tokens_per_dispatch=None` (the default) keeps one
+chunk covering the whole generation: one-shot callers enqueue three
+programs (prefill, the chunk, the concat) instead of the old one, but
+the enqueues are asynchronous — the caller still pays ONE fence round
+trip per generation, and the per-token device work is unchanged.
+
+Greedy when temperature == 0, otherwise temperature sampling with a
+caller-provided PRNG key. The emitted tokens are bit-identical for any
+`tokens_per_dispatch` (chunking changes WHEN the host syncs, never the
+per-step math — pinned by tests/test_decode_stream.py, including EOS
+landing mid-chunk).
 
 No reference analogue — serving-side companion of `models/lm.py`.
 """
@@ -16,6 +36,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh
 
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
@@ -134,15 +155,33 @@ def make_generate_fn(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    tokens_per_dispatch: int | None = None,
+    eos_id: int | None = None,
 ):
-    """Build a jitted `(params, prompt, rng) -> tokens` generator.
+    """Build a `(params, prompt, max_new_tokens, rng) -> tokens`
+    generator over two jitted programs (prefill + donated-cache step
+    chunk — see the module docstring).
 
     `prompt` is [batch, prompt_len] int32; the result is
-    [batch, max_new_tokens] (prompt not repeated). `max_new_tokens` is a
-    static argument of the returned function. Requires
+    [batch, max_new_tokens] (prompt not repeated). Requires
     prompt_len + max_new_tokens <= cfg.max_seq_len (the position-table
     limit; the KV cache itself is sized to the generation via
     `cache_bucket`, not to max_seq_len).
+
+    `tokens_per_dispatch`: decode steps scanned per host dispatch.
+    None (default) = one chunk covering the whole generation — the
+    one-shot shape `bench_lm.measure_decode` times (asynchronously
+    enqueued prefill + chunk + concat, one fence round trip). A fixed
+    chunk (serve.py uses 8-32) compiles the step program ONCE per
+    (batch, chunk, bucket) and reuses it across generation lengths.
+    The emitted tokens are identical either way.
+
+    `eos_id`: when set, a row that emits it keeps emitting it (the
+    device masks the row, so chunked and stepwise paths agree exactly)
+    and the host stops dispatching once every row has finished —
+    with chunking this turns the token budget into a cap instead of a
+    cost.
+
     Sampling: greedy at temperature 0, else temperature sampling with
     optional top-k and/or nucleus (top-p) truncation.
     """
@@ -156,6 +195,10 @@ def make_generate_fn(
             f"top_k must be in [0, vocab_size={cfg.vocab_size}] and "
             f"top_p in (0, 1]; got {top_k}, {top_p}"
         )
+    if tokens_per_dispatch is not None and tokens_per_dispatch < 1:
+        raise ValueError(
+            f"tokens_per_dispatch must be >= 1; got {tokens_per_dispatch}"
+        )
     if cfg.use_ring_attention or cfg.use_ulysses_attention:
         raise ValueError(
             "decode uses the KV-cache path; build the generate config "
@@ -163,7 +206,64 @@ def make_generate_fn(
             "sequence-parallel layouts)"
         )
 
-    @functools.partial(jax.jit, static_argnames=("max_new_tokens",))
+    def model_at(bucket: int) -> DecoderLM:
+        # Length-bucketed cache: cache_len drives only the cache
+        # allocation and attention width; params (pos_embed sized to
+        # max_seq_len) are untouched.
+        return DecoderLM(dataclasses.replace(cfg, cache_len=bucket), mesh)
+
+    def sample_next(logits, rng, done):
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits, temperature, sub, top_k, top_p)
+        if eos_id is not None:
+            # A finished row keeps emitting eos_id: deterministic
+            # padding on-device, so any dispatch chunking yields the
+            # same tokens even when EOS lands mid-chunk.
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return nxt, rng, done
+
+    @functools.partial(jax.jit, static_argnames=("bucket",))
+    def prefill(params, prompt, rng, bucket: int):
+        """One pass over the whole prompt populates a fresh cache and
+        samples the first token. Returns the step-chunk carry."""
+        model = model_at(bucket)
+        cache = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((prompt.shape[0], 1), jnp.int32),
+            decode=True,
+        )["cache"]
+        logits, variables = model.apply(
+            {"params": params, "cache": cache},
+            prompt, decode=True, mutable=["cache"],
+        )
+        done = jnp.zeros((prompt.shape[0],), bool)
+        first, rng, done = sample_next(logits[:, -1], rng, done)
+        return variables["cache"], first, rng, done
+
+    @functools.partial(
+        jax.jit, static_argnames=("steps", "bucket"), donate_argnums=(1,)
+    )
+    def step_chunk(params, carry, steps: int, bucket: int):
+        """Scan `steps` decode steps on-device. The carry (cache, last
+        token, rng, done mask) is DONATED: the cache buffers advance in
+        place across dispatches — the old one-shot design got this
+        aliasing for free inside its scan; the chunked program must ask
+        for it, or every dispatch would copy the full cache."""
+        model = model_at(bucket)
+
+        def one(c, _):
+            cache, tok, rng, done = c
+            logits, variables = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None], decode=True, mutable=["cache"],
+            )
+            nxt, rng, done = sample_next(logits[:, -1], rng, done)
+            return (variables["cache"], nxt, rng, done), nxt
+
+        carry, out = jax.lax.scan(one, carry, None, length=steps)
+        return carry, out.transpose(1, 0)
+
     def generate(
         params, prompt: jax.Array, max_new_tokens: int,
         rng: jax.Array | None = None,
@@ -176,46 +276,34 @@ def make_generate_fn(
             )
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        # Length-bucketed cache: cache_len drives only the cache
-        # allocation and attention width; params (pos_embed sized to
-        # max_seq_len) are untouched. One compiled program per
-        # (batch, prompt, new) signature, as before.
         bucket = cache_bucket(prompt_len + max_new_tokens, cfg.max_seq_len)
-        model = DecoderLM(
-            dataclasses.replace(cfg, cache_len=bucket), mesh
-        )
-        cache = model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((batch, 1), jnp.int32),
-            decode=True,
-        )["cache"]
-
-        # Prefill: one pass over the whole prompt populates the cache.
-        logits, variables = model.apply(
-            {"params": params, "cache": cache},
-            prompt, decode=True, mutable=["cache"],
-        )
-        rng, sub = jax.random.split(rng)
-        first = _sample(logits[:, -1], temperature, sub, top_k, top_p)
-
-        def step(carry, _):
-            cache, token, rng = carry
-            logits, variables = model.apply(
-                {"params": params, "cache": cache},
-                token[:, None], decode=True, mutable=["cache"],
+        carry = prefill(params, prompt, rng, bucket=bucket)
+        pieces = [carry[1][:, None]]  # the prefill-sampled first token
+        remaining = max_new_tokens - 1
+        chunk = tokens_per_dispatch or max(1, remaining)
+        while remaining > 0:
+            # The last chunk may overshoot the budget by < chunk steps
+            # (one compiled step program, not one per remainder); the
+            # overshoot is trimmed below, and its cache/position writes
+            # clamp at the bucket edge — garbage only ever lands in
+            # rows no kept token reads.
+            carry, toks = step_chunk(
+                params, carry, steps=chunk, bucket=bucket
             )
-            rng, sub = jax.random.split(rng)
-            nxt = _sample(logits[:, -1], temperature, sub, top_k, top_p)
-            return (variables["cache"], nxt, rng), nxt
-
-        _, rest = jax.lax.scan(
-            step,
-            (variables["cache"], first, rng),
-            None,
-            length=max_new_tokens - 1,
-        )
-        return jnp.concatenate(
-            [first[:, None], rest.transpose(1, 0)], axis=1
-        )
+            pieces.append(toks)
+            remaining -= chunk
+            if (
+                eos_id is not None and remaining > 0
+                and bool(np.all(jax.device_get(carry[3])))
+            ):
+                # Every row finished: stop dispatching and pad the
+                # budget with eos_id — exactly what further chunks
+                # would emit (finished rows are device-masked to
+                # eos_id), minus the device time.
+                pieces.append(jnp.full(
+                    (batch, remaining), eos_id, pieces[0].dtype
+                ))
+                remaining = 0
+        return jnp.concatenate(pieces, axis=1)[:, :max_new_tokens]
 
     return generate

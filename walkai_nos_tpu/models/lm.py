@@ -22,7 +22,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from walkai_nos_tpu.ops.attention import flash_attention
-from walkai_nos_tpu.ops.decode_attention import decode_attention
+from walkai_nos_tpu.ops.decode_attention import (
+    MAX_KERNEL_STEPS,
+    decode_attention,
+)
 from walkai_nos_tpu.ops.ring_attention import ring_attention
 from walkai_nos_tpu.ops.ulysses import ulysses_attention
 
@@ -320,19 +323,29 @@ class CausalAttention(nn.Module):
             )
         cached_k.value, cached_v.value = k_all, v_all
         index.value = idx + steps
-        if steps == 1 and (kv_heads != heads or c.decode_kernel):
-            # Fused Pallas path (ops/decode_attention.py): K/V read
-            # exactly once with mask+softmax+PV on-chip; the cache
-            # write above stays an XLA dynamic_update_slice (one
-            # [b,h,1,d] row — in-place under the scan's buffer
-            # aliasing). GQA single steps ALWAYS route here — XLA has
-            # no fast lowering for the grouped shape (every einsum
-            # formulation measured 1.5-2x slower than the blocked
-            # kernel) — while MHA opts in via decode_kernel (XLA's
-            # single-query fusion wins there; see LMConfig). The
-            # kernel takes scalar or per-row indices alike.
-            o = decode_attention(q[:, :, 0], k_all, v_all, idx)
-            return o[:, :, None, :]
+        if steps <= MAX_KERNEL_STEPS and (
+            kv_heads != heads or c.decode_kernel
+        ):
+            # Fused streamed Pallas path (ops/decode_attention.py):
+            # K/V stream through VMEM in 128-row blocks read exactly
+            # once (padded bucket tail blocks skipped, not masked),
+            # with mask+softmax+PV on-chip; the cache write above
+            # stays an XLA dynamic_update_slice (one [b,h,steps,d]
+            # row-slab — in-place under the scan's buffer aliasing).
+            # GQA routes here for single steps AND short multi-step
+            # calls (speculative decoding's k+1-position target-verify
+            # forward) — XLA has no fast lowering for the grouped
+            # shape (every einsum formulation measured 1.5-2x slower
+            # than the blocked kernel) — while MHA opts in via
+            # decode_kernel (XLA's single-query fusion wins there; see
+            # LMConfig). Wider chunks (prompt prefill) fall through to
+            # the dense path below. The kernel takes scalar or per-row
+            # indices alike.
+            if steps == 1:
+                return decode_attention(
+                    q[:, :, 0], k_all, v_all, idx
+                )[:, :, None, :]
+            return decode_attention(q, k_all, v_all, idx)
         q_pos = (
             idx[:, None] + jnp.arange(steps) if ragged
             else idx + jnp.arange(steps)

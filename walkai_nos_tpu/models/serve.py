@@ -15,8 +15,12 @@ requests come and go):
 
 - **Ragged KV cache** (`LMConfig.ragged_decode`): the cache index is a
   [slots] vector — each row sits at its own position; writes are
-  per-row scatters and the causal mask per-row. The fused decode
-  kernels take the per-row index (`ops/decode_attention.py`).
+  per-row scatters and the causal mask per-row. `step_chunk`'s decode
+  steps run the streamed decode kernel with the per-row index
+  (`ops/decode_attention.py`): each slot's cache streams through VMEM
+  in 128-row blocks, and bucket tail blocks past every slot in a grid
+  block are skipped, not read — freshly admitted short slots don't pay
+  for the pool's longest resident.
 - **Prefill into a slot**: the prompt (padded to a bucket, so prompt
   lengths share compiled programs) runs through a batch-1 cache; its
   rows are then written into the pool cache at the slot index with one

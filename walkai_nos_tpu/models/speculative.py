@@ -24,7 +24,13 @@ Position bookkeeping (cache index n = tokens 0..n-1 processed; the next
 input is the last emitted token, index n):
 
 - one round feeds the target `[cur, d_0 .. d_{k-1}]` (positions
-  n..n+k); logits at position n+j predict token n+j+1 = P_j
+  n..n+k); logits at position n+j predict token n+j+1 = P_j. For GQA
+  targets this k+1-position verify forward routes through the same
+  streamed decode kernel as the serving step
+  (`ops/decode_attention.py` multi-step queries, k+1 <=
+  `MAX_KERNEL_STEPS`): the verify pass streams each cache block once
+  for all k+1 queries instead of paying the dense grouped einsum XLA
+  has no fast lowering for
 - accept a = longest prefix with d_j == P_j; emit P_0..P_a (the
   matched drafts plus the free "bonus" token — between 1 and k+1
   tokens per round)

@@ -1,53 +1,60 @@
-"""Fused single-query decode attention: Pallas TPU kernel + XLA reference.
+"""Fused decode attention over a KV cache: streamed Pallas TPU kernel
++ XLA reference.
 
 The serving decode step is memory-bound: each generated token re-reads
-the whole KV cache once. This kernel does the entire masked-softmax
-attention for one decode step in ONE pass over the cache per
-(batch*head) grid cell: K and V stream through VMEM exactly once, the
-[1, cache_len] score vector never leaves VMEM, and accumulation is f32
-regardless of the cache dtype.
+the whole KV cache once, so the HBM roofline — cache bytes over
+published bandwidth — is the per-step floor. The kernel here is built
+around that roofline:
+
+- **Streamed over cache blocks.** The grid is (cell-blocks,
+  cache-blocks): each grid step reads one 128-row K/V block per cell
+  into VMEM (double-buffered by the Mosaic pipeline — block N+1's
+  HBM->VMEM copy overlaps block N's compute) and folds it into running
+  (max, sum, acc) statistics; partial softmaxes combine by logsumexp
+  on-chip, so no score row wider than a block ever materializes and
+  VMEM stays O(block) at any cache length.
+- **Padded tail blocks are SKIPPED, not read-and-masked.** The cache
+  index (scalar-prefetched to SMEM) bounds the visible cache; blocks
+  wholly past it contribute nothing, so their BlockSpec index clamps to
+  the last visible block — consecutive grid steps then map to the same
+  block and the pipeline elides the copy — and `pl.when` skips their
+  compute. A 256-bucket cache at index 90 streams 128 rows, not 256:
+  the win every length-bucketed generation (`models/decode.cache_bucket`
+  rounds up to 128) collects on its early steps.
+- **KV-head-packed GQA.** q arrives grouped per KV head, so every
+  cache byte is read exactly once for ALL query heads that share it,
+  and the per-grid-step block of (batch, kv-head) cells is flattened
+  into TWO large MXU dots with a block-diagonal mask (the "all-pairs"
+  formulation). Why: the per-cell [group, d] x [d, s] dot is too small
+  for the MXU — a round-5 chained microbench measured the unrolled
+  per-cell version at 71 us/invocation (b=128, kv=2, s=256), ~3.5x its
+  HBM-streaming bound, flat in block count: MXU issue latency on many
+  tiny dots, not bandwidth. Two big dots trade block-fold wasted MACs
+  (masked away) for full systolic pipelining — FLOPs are free here,
+  dot issues are not. group=1 is plain multi-head single-query
+  attention: the MHA kernel is this kernel at the same two dots.
+- **Multi-step queries.** q may carry `steps` query positions per head
+  (speculative decoding's target-verify forward feeds k+1 positions
+  through the decode path in one call); query row r at position
+  index + r sees cache rows <= index + r. steps=1 is the serving
+  decode step.
 
 **Measured verdict (v5e, batch 128, cache 256-384): XLA wins for MHA,
 the kernel wins for GQA.** XLA's own fusion of the single-query chain
-(QK einsum -> mask -> softmax -> PV) also reads K/V exactly once and
-sustains ~775 GB/s effective; a one-cell-per-grid-step kernel's
-[1, d] x [d, s] matvecs were MXU-latency-bound at ~240 GB/s — a
-single query gives the systolic array no sublane depth to pipeline.
+also reads K/V exactly once and sustains ~775 GB/s effective;
 `LMConfig.decode_kernel` therefore defaults to the XLA path for
-standard multi-head attention.
+standard multi-head attention. GQA flips the verdict — XLA has no fast
+lowering for the grouped shape (every formulation tried measured
+1.5-2.1 ms/step vs MHA's 1.05) — so GQA decode ALWAYS routes through
+this kernel on TPU.
 
-Grouped-query attention flips the verdict. XLA has no fast lowering
-for the grouped shape (every formulation tried — rank-3 bmm, 4-D
-einsum, broadcast-expand, explicit mul-reduce — measured 1.5-2.1
-ms/step in the serving model vs MHA's 1.05), but the ALL-PAIRS
-blocked kernel here (`_gqa_block_kernel`: the whole grid-step block
-of (batch, kv-head) cells flattened into TWO large MXU dots with a
-block-diagonal mask) streams the cache at its HBM bound — 18.1
-us/invocation vs the 20.5 us analytic bound at b=128, kv=2, s=256,
-where round 4's per-cell unrolled-dots version measured 71 us
-(MXU issue latency on 2*n_blk tiny dots). In the serving model that
-is 0.74 ms/step, 174k tok/s — decode with a 4x-smaller cache runs
-1.4x FASTER than MHA instead of 1.5x slower. GQA decode therefore
-ALWAYS routes through this kernel on TPU. MHA is the same kernel at
-group=1 (one code path, one parity surface), used when
-`decode_kernel=True` opts out of the XLA default.
+Masking uses the cache index (runtime scalar or [batch] vector for
+ragged decoding, prefetched to SMEM): position p is visible to query
+row r iff p <= index + r. Rows above the index hold whatever the ring
+buffer holds — typically zeros — and are never read past the block
+boundary, so the kernel is exact for any cache length bucket.
 
-A side-buffer variant (append new K/V rows to a small buffer, merge
-every 16 steps, two-segment kernel) was built and measured in round
-5 to attack the ~16 us/layer/step XLA spends around the per-step
-cache dynamic_update_slice: the two-segment kernel's in-kernel
-concat cost (+0.12 ms/step) and the merge cond (+0.10 ms/step)
-cancelled the saving, so it was removed — the measured verdict
-discipline, applied to our own idea.
-
-Masking uses the cache index (a runtime scalar, prefetched to SMEM):
-position p is visible iff p <= index. The cache rows above `index` are
-whatever the ring buffer holds — typically zeros — and are masked out,
-so the kernel is exact for any cache length bucket
-(`models/decode.cache_bucket`).
-
-Inference-only by design: no VJP (decoding never differentiates), which
-keeps the kernel a single forward pass.
+Inference-only by design: no VJP (decoding never differentiates).
 
 No reference-repo analogue (the reference is a k8s control plane); this
 is the serving-side hot op of the TPU compute layer, the decode
@@ -57,6 +64,7 @@ counterpart of `ops/attention.py`'s training kernels.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -65,134 +73,191 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Cache rows streamed per grid step (the VPU lane width — also the
+# `cache_bucket` rounding quantum, so the skip granularity matches the
+# padding granularity: a generation at index i reads ceil((i+1)/128)
+# blocks, exactly the rows a 128-bucketed cache has filled).
+_STREAM_BLOCK_S = 128
+
+# Decode-path query positions per call the kernel accepts before the
+# dense prefill path takes over (speculative verify feeds k+1 <= 8;
+# prompt prefill chunks are wider and better served by one big dot).
+MAX_KERNEL_STEPS = 8
+
 
 def decode_attention_reference(
     q: jax.Array, k: jax.Array, v: jax.Array, index: jax.Array
 ) -> jax.Array:
-    """Plain XLA single-query attention over a cache.
+    """Plain XLA decode attention over a cache.
 
-    q: [batch, heads, head_dim] (the one new query, at position `index`);
-    k/v: [batch, kv_heads, cache_len, head_dim] where kv_heads divides
-    heads (kv_heads < heads = grouped-query attention: query head i
-    reads KV head i // group); index: int32 scalar, or a [batch]
-    vector for ragged decoding (each row at its own position).
-    Returns [batch, heads, head_dim]. Positions > index are masked.
+    q: [batch, heads, head_dim] (one new query, at position `index`) or
+    [batch, heads, steps, head_dim] (steps queries at positions
+    index..index+steps-1 — the speculative verify shape); k/v:
+    [batch, kv_heads, cache_len, head_dim] where kv_heads divides heads
+    (kv_heads < heads = grouped-query attention: query head i reads KV
+    head i // group); index: int32 scalar, or a [batch] vector for
+    ragged decoding (each row at its own position). Returns q's shape.
+    Position p is visible to the query at index + r iff p <= index + r.
     """
+    single = q.ndim == 3
+    if single:
+        q = q[:, :, None, :]
+    steps = q.shape[2]
     if k.shape[1] != q.shape[1]:
         rep = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum(
-        "bhd,bhkd->bhk", q, k, preferred_element_type=jnp.float32
+        "bhsd,bhkd->bhsk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    pos = jnp.arange(k.shape[2])
+    off = jnp.arange(steps)
     if jnp.ndim(index) == 0:
-        mask = (jnp.arange(k.shape[2]) <= index)[None, None]
-    else:  # per-row positions -> [batch, 1, cache_len]
-        mask = (jnp.arange(k.shape[2]) <= index[:, None])[:, None]
+        # [steps, cache_len] -> broadcast over batch, heads.
+        mask = (pos[None] <= (index + off)[:, None])[None, None]
+    else:  # per-row positions -> [batch, 1, steps, cache_len]
+        mask = (
+            pos[None, None] <= (index[:, None] + off[None])[..., None]
+        )[:, None]
     logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum(
-        "bhk,bhkd->bhd", probs.astype(v.dtype), v,
+    out = jnp.einsum(
+        "bhsk,bhkd->bhsd", probs.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)
+    return out[:, :, 0] if single else out
 
 
-# (batch * kv_heads) cells fused per grid step in the blocked kernel:
-# amortizes per-cell DMA/dispatch latency (the limiter for one-cell
-# grids). The choice is additionally capped so one grid step's K+V
-# blocks (double-buffered) and its f32 all-pairs score matrix fit a
-# conservative VMEM budget — long caches shrink the block instead of
-# failing to compile.
+# (batch * kv_heads) cells fused per grid step: amortizes per-cell
+# DMA/dispatch latency (the limiter for one-cell grids). The choice is
+# capped so one grid step's K+V stream blocks (double-buffered) and its
+# f32 all-pairs score block fit a conservative VMEM budget — big
+# batches shrink the block instead of failing to compile. Budgets are
+# per 128-row stream block now, not per full cache, so long caches no
+# longer shrink the cell block.
 _GQA_BLOCK_CANDIDATES = (16, 8, 4, 2, 1)
 _VMEM_BLOCK_BUDGET_BYTES = 8 * 1024 * 1024
 _VMEM_SCORE_BUDGET_BYTES = 2 * 1024 * 1024
 
 
-def _gqa_block_kernel(n_blk, per_cell_idx, idx_ref, q_ref, k_ref, v_ref, o_ref):
-    """One grid step: `n_blk` independent (batch, kv-head) cells in TWO
-    MXU dots (the "all-pairs" formulation). Refs are [n_blk, group, d]
-    (q/o) and [n_blk, cache_len, d] (k/v).
+def _gqa_stream_kernel(
+    n_blk, steps, per_cell, idx_ref, nblk_ref,
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+):
+    """One (cell-block, cache-block) grid step: fold one 128-row K/V
+    block of `n_blk` independent (batch, kv-head) cells into the
+    running softmax statistics, as TWO MXU dots.
 
-    The cells' queries and caches are flattened into single matrices
-    and attention runs as one [n_blk*group, d] x [d, n_blk*s] score
-    dot and one [n_blk*group, n_blk*s] x [n_blk*s, d] PV dot, with a
-    BLOCK-DIAGONAL mask (query row of cell i sees only key columns of
-    cell i, up to the cell's own cache index). Off-block scores mask to
-    -inf, so after the softmax their probabilities are exactly 0 and
-    the PV dot reduces to the per-cell product — the formulation is
-    exact, not approximate (pinned against the XLA reference in
-    tests/test_ops.py).
+    Refs: q/o [n_blk, g*steps, d] (rows ordered (group, step) within a
+    cell), k/v [n_blk, _STREAM_BLOCK_S, d]; m/l [rows, 128] and acc
+    [rows, d] are f32 VMEM scratch carried across the cache-block grid
+    dimension (the grid iterates cache blocks innermost, so each cell
+    block's statistics initialize at block 0 and finalize at its last
+    visible block).
 
-    Why all-pairs: the per-cell [group, d] x [d, s] dot is too small
-    for the MXU — a round-5 chained microbench measured the unrolled
-    per-cell version at 71 us/invocation (b=128, kv=2, s=256), ~3.5x
-    its 20.5 us HBM-streaming bound, flat in `n_blk` (8/16/32 within
-    1%) and nearly flat in s beyond 256: MXU issue latency on 2*n_blk
-    tiny dots, not bandwidth. The two big dots trade n_blk-fold wasted
-    MACs (masked away) for full systolic pipelining — measured 18.1
-    us/invocation, AT the HBM bound: FLOPs are free here, dot issues
-    are not. group=1 is plain multi-head single-query attention — the
-    MHA kernel is this kernel at the same two dots.
+    The cells' queries and cache blocks are flattened into single
+    matrices: one [n_blk*g*steps, d] x [d, n_blk*128] score dot and one
+    [rows, n_blk*128] x [n_blk*128, d] PV dot, with a BLOCK-DIAGONAL
+    mask (query rows of cell i see only key columns of cell i, up to
+    the cell's own cache index + the row's step offset). Off-block
+    scores mask to -inf, so after the softmax their probabilities are
+    exactly 0 and the PV dot reduces to the per-cell product — exact,
+    not approximate (pinned against the XLA reference in
+    tests/test_decode_stream.py).
+
+    Blocks wholly past every cell's index never reach this body
+    (`pl.when` guard) and never stream (their BlockSpec index clamps to
+    the last visible block, so the pipeline elides the copy).
 
     K/V/q stay in their storage dtype: the MXU multiplies bf16
     natively with f32 accumulation — an astype(f32) here would spend
     VPU cycles converting the whole cache block and double its vreg
     footprint. The softmax scale is applied to the f32 scores, not
     pre-applied to a bf16 q, which would round the scaled query."""
-    pid = pl.program_id(0)
-    g = q_ref.shape[1]
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    gs = q_ref.shape[1]  # g * steps rows per cell
     d = q_ref.shape[-1]
-    s_len = k_ref.shape[1]
-    scale = d ** -0.5
-    qf = q_ref[...].reshape(n_blk * g, d)
-    kf = k_ref[...].reshape(n_blk * s_len, d)
-    vf = v_ref[...].reshape(n_blk * s_len, d)
-    sc = jax.lax.dot_general(
-        qf, kf, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # [n_blk*g, n_blk*s] f32
-    rows = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-    cell_r = rows // g
-    cell_c = cols // s_len
-    pos = cols - cell_c * s_len
-    if per_cell_idx:
-        # Ragged decoding: one index per cell. Build the per-column
-        # visibility limit from the prefetched scalars (static unroll
-        # over n_blk; SMEM scalar reads are free next to the dots).
-        lim = jnp.concatenate([
-            jnp.full((1, s_len), idx_ref[pid * n_blk + i], jnp.int32)
-            for i in range(n_blk)
-        ], axis=1)  # [1, n_blk*s]
-        visible = (cell_r == cell_c) & (pos <= lim)
-    else:
-        visible = (cell_r == cell_c) & (pos <= idx_ref[0])
-    sc = jnp.where(visible, sc, _NEG_INF)
-    m = jnp.max(sc, axis=-1, keepdims=True)
-    p = jnp.exp(sc - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        (p / l).astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[...] = o.reshape(n_blk, g, d).astype(o_ref.dtype)
+    s_blk = k_ref.shape[1]
+    rows = n_blk * gs
+    last = nblk_ref[i] - 1  # last visible cache block for this cell block
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j <= last)
+    def _fold():
+        scale = d ** -0.5
+        qf = q_ref[...].reshape(rows, d)
+        kf = k_ref[...].reshape(n_blk * s_blk, d)
+        vf = v_ref[...].reshape(n_blk * s_blk, d)
+        sc = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [rows, n_blk*s_blk] f32
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        cell_r = row_ids // gs
+        cell_c = col_ids // s_blk
+        # Global cache position of each column, and each query row's
+        # step offset ((group, step) row order -> offset = row % steps).
+        pos = j * s_blk + col_ids - cell_c * s_blk
+        off = row_ids % steps if steps > 1 else 0
+        if per_cell:
+            # Ragged decoding: one index per cell. Build the per-column
+            # visibility limit from the prefetched scalars (static
+            # unroll over n_blk; SMEM scalar reads are free next to the
+            # dots).
+            lim = jnp.concatenate([
+                jnp.full((1, s_blk), idx_ref[i * n_blk + c], jnp.int32)
+                for c in range(n_blk)
+            ], axis=1)  # [1, n_blk*s_blk]
+        else:
+            lim = idx_ref[0]
+        visible = (cell_r == cell_c) & (pos <= lim + off)
+        sc = jnp.where(visible, sc, _NEG_INF)
+        m_prev = m_ref[:, :1]  # [rows, 1] (lanes replicated)
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sc - m_new)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        acc_ref[...] = acc_new
+
+        @pl.when(j == last)
+        def _finish():
+            o_ref[...] = (
+                acc_new / l_new
+            ).reshape(n_blk, gs, d).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _gqa_pallas(q, k, v, index, interpret=False):
+    """q: [b, h, steps, d]; k/v: [b, kvh, s, d]; s % 128 == 0."""
     b, kvh, s, d = k.shape
-    h = q.shape[1]
+    h, steps = q.shape[1], q.shape[2]
     g = h // kvh
     n = b * kvh
-    # K+V per cell, double-buffered by the Mosaic pipeline; the f32
-    # all-pairs score matrix grows with blk^2 and is capped separately.
-    cell_bytes = 2 * 2 * s * d * k.dtype.itemsize
+    s_blk = _STREAM_BLOCK_S
+    # K+V stream blocks per cell, double-buffered by the Mosaic
+    # pipeline; the f32 all-pairs score block grows with blk^2 and is
+    # capped separately.
+    cell_bytes = 2 * 2 * s_blk * d * k.dtype.itemsize
     max_blk = max(1, _VMEM_BLOCK_BUDGET_BYTES // cell_bytes)
     blk = next(
         (c for c in _GQA_BLOCK_CANDIDATES
          if c <= max_blk and n % c == 0
-         and c * g * c * s * 4 <= _VMEM_SCORE_BUDGET_BYTES),
+         and c * g * steps * c * s_blk * 4 <= _VMEM_SCORE_BUDGET_BYTES),
         None,
     )
     if blk is None:  # pathological shapes: no block fits VMEM
@@ -202,26 +267,56 @@ def _gqa_pallas(q, k, v, index, interpret=False):
         jnp.repeat(index.astype(jnp.int32), kvh) if per_cell
         else jnp.reshape(index, (1,)).astype(jnp.int32)
     )
-    qr = q.reshape(n, g, d)
+    # Visible cache blocks per cell block: the max index over the
+    # block's cells (its highest query position is index + steps - 1),
+    # clamped to the cache — serving slots freed mid-chunk keep
+    # stepping with index past cache_len (models/serve.py).
+    n_s_blocks = s // s_blk
+    top = jnp.max(idx_arr.reshape(-1, blk), axis=1) if per_cell else (
+        jnp.broadcast_to(idx_arr, (n // blk,))
+    )
+    nblk_arr = jnp.minimum(
+        (top + steps - 1) // s_blk + 1, n_s_blocks
+    ).astype(jnp.int32)
+    # (group, step) row order within a cell: head-major flatten of
+    # [b, kvh, g, steps, d].
+    qr = q.reshape(b, kvh, g, steps, d).reshape(n, g * steps, d)
     kr = k.reshape(n, s, d)
     vr = v.reshape(n, s, d)
+    rows = blk * g * steps
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n // blk,),
+        num_scalar_prefetch=2,
+        grid=(n // blk, n_s_blocks),
         in_specs=[
-            pl.BlockSpec((blk, g, d), lambda i, idx: (i, 0, 0)),
-            pl.BlockSpec((blk, s, d), lambda i, idx: (i, 0, 0)),
-            pl.BlockSpec((blk, s, d), lambda i, idx: (i, 0, 0)),
+            pl.BlockSpec((blk, g * steps, d), lambda i, j, idx, nb: (i, 0, 0)),
+            # Tail blocks past the cell block's limit clamp to the last
+            # visible block: same index as the previous grid step, so
+            # the pipeline skips the HBM read entirely.
+            pl.BlockSpec(
+                (blk, s_blk, d),
+                lambda i, j, idx, nb: (i, jnp.minimum(j, nb[i] - 1), 0),
+            ),
+            pl.BlockSpec(
+                (blk, s_blk, d),
+                lambda i, j, idx, nb: (i, jnp.minimum(j, nb[i] - 1), 0),
+            ),
         ],
-        out_specs=pl.BlockSpec((blk, g, d), lambda i, idx: (i, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (blk, g * steps, d), lambda i, j, idx, nb: (i, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 128), jnp.float32),  # running max
+            pltpu.VMEM((rows, 128), jnp.float32),  # running sum
+            pltpu.VMEM((rows, d), jnp.float32),    # running PV acc
+        ],
     )
     out = pl.pallas_call(
-        functools.partial(_gqa_block_kernel, blk, per_cell),
+        functools.partial(_gqa_stream_kernel, blk, steps, per_cell),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n, g, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, g * steps, d), q.dtype),
         interpret=interpret,
-    )(idx_arr, qr, kr, vr)
-    return out.reshape(b, h, d)
+    )(idx_arr, nblk_arr, qr, kr, vr)
+    return out.reshape(b, kvh, g, steps, d).reshape(b, h, steps, d)
 
 
 def decode_attention(
@@ -232,20 +327,29 @@ def decode_attention(
     *,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Fused single-query cache attention for the decode step.
+    """Fused cache attention for the decode step.
 
-    q: [batch, heads, head_dim]; k/v: [batch, kv_heads, cache_len,
-    head_dim] with kv_heads dividing heads (kv_heads < heads = GQA,
-    kv_heads == heads = plain MHA — both run the same blocked kernel,
-    MHA being group=1); index: int32 scalar — the position of `q`, and
-    the last visible cache row. Uses the Pallas kernel on TPU (or in
-    interpret mode when forced); falls back to the XLA reference
-    otherwise or when the cache length doesn't tile the VPU lane width.
+    q: [batch, heads, head_dim], or [batch, heads, steps, head_dim]
+    for a multi-position decode call (speculative verify); k/v:
+    [batch, kv_heads, cache_len, head_dim] with kv_heads dividing heads
+    (kv_heads < heads = GQA, kv_heads == heads = plain MHA — both run
+    the same streamed kernel, MHA being group=1); index: int32 scalar
+    or [batch] vector — the position of q's first step, and the last
+    cache row visible to it. Uses the streamed Pallas kernel on TPU
+    (or in interpret mode when forced via the argument or
+    WALKAI_DECODE_INTERPRET=1 — the CPU-test seam); falls back to the
+    XLA reference otherwise or when the cache length doesn't tile the
+    128-row stream block.
     """
     if interpret is None:
-        interpret = False
-        if jax.default_backend() != "tpu":
+        interpret = os.environ.get("WALKAI_DECODE_INTERPRET") == "1"
+        if not interpret and jax.default_backend() != "tpu":
             return decode_attention_reference(q, k, v, index)
-    if k.shape[2] % 128 != 0:
+    if k.shape[2] % _STREAM_BLOCK_S != 0:
         return decode_attention_reference(q, k, v, index)
-    return _gqa_pallas(q, k, v, index, interpret=interpret)
+    single = q.ndim == 3
+    out = _gqa_pallas(
+        q[:, :, None, :] if single else q, k, v, index,
+        interpret=interpret,
+    )
+    return out[:, :, 0] if single else out

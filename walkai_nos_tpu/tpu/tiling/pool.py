@@ -613,6 +613,120 @@ class PoolNode:
         return out
 
 
+def stranded_share_retiles(
+    pool_name: str, members: list[dict]
+) -> list[tuple[dict, "object"]]:
+    """Per-host retile writes for REPORTED free pool shares that no
+    complete instance block can ever back again.
+
+    The planner's in-pass strand drop (`_drop_stranded_shares`) only
+    runs while a pending pod forces a plan. When a member host is
+    reclaimed by a pass whose node snapshot predates its mate's share
+    REPORT (agent actuation and reporting race the plan), the mate's
+    share becomes stranded only after the pass completes — and with no
+    pending pod left, nothing ever replans, so the host advertises a
+    share no gang can consume forever (and a pool-unaware scheduler
+    could bind half a gang onto it). This janitor judges strandedness
+    against mates' reported AND planned (spec) shares: a pool
+    mid-initialization — specs written, reports still in flight — is
+    never mistaken for a strand, so the sweep is safe to run on every
+    node event. Only hosts whose status and spec are exactly the lone
+    free share are touched (a host with a plan already in flight is
+    left to its agent); used shares are never evicted.
+
+    Returns (member node object, NodePartitioning) writes re-tiling
+    each stranded host to the default host-local geometry.
+    """
+    from walkai_nos_tpu.kube import objects as kobjects
+    from walkai_nos_tpu.partitioning.state import (
+        MeshPartitioning,
+        NodePartitioning,
+    )
+
+    topo = topology.get_pool_topology(
+        kobjects.labels(members[0])
+    ) if members else None
+    if topo is None:
+        return []
+    coords = gridlib.all_coords(topo.host_grid)
+    # coord -> (node_obj, status free profiles, status used profiles,
+    # spec profiles), one entry per coordinatable member.
+    info: dict[tuple[int, ...], tuple] = {}
+    for node_obj in members:
+        idx = topology.worker_id(kobjects.labels(node_obj))
+        if idx is None or not 0 <= idx < topo.num_hosts:
+            return []  # not coordinatable: the refusal path owns it
+        status, spec = parse_node_annotations(
+            kobjects.annotations(node_obj)
+        )
+        free = {
+            s.profile for s in status
+            if s.mesh_index == 0 and s.quantity > 0
+            and s.status == DeviceStatus.FREE
+        }
+        used = {
+            s.profile for s in status
+            if s.mesh_index == 0 and s.quantity > 0
+            and s.status == DeviceStatus.USED
+        }
+        planned = {
+            s.profile for s in spec if s.mesh_index == 0 and s.quantity > 0
+        }
+        if coords[idx] in info:
+            return []
+        info[coords[idx]] = (node_obj, free, used, planned)
+    host_model = topology.TpuModel(
+        topo.model.name, topo.model.generation, topo.host_mesh,
+        topo.model.hbm_gb_per_chip,
+    )
+    out: list[tuple[dict, "object"]] = []
+    profiles = {
+        p
+        for _obj, free, _used, _planned in info.values()
+        for p in free
+        if is_pool_profile(p, topo)
+    }
+    for p in sorted(profiles):
+        candidates = {
+            c
+            for c, (_obj, free, used, planned) in info.items()
+            if p in free or p in used or p in planned
+        }
+        covered: set[tuple[int, ...]] = set()
+        for cells in _profile_placements(p, topo):
+            if all(c in candidates for c in cells):
+                covered.update(cells)
+        for c, (node_obj, free, used, planned) in info.items():
+            if p not in free or c in covered:
+                continue
+            # Touch only a host that IS exactly the lone stranded
+            # share, in both report and plan.
+            if used or free != {p} or planned != {p}:
+                continue
+            mesh = TpuMesh(
+                model=host_model, mesh_index=0, used={}, free={}
+            )
+            mesh.init_geometry()
+            out.append(
+                (
+                    node_obj,
+                    NodePartitioning(
+                        name=kobjects.name(node_obj),
+                        meshes=(
+                            MeshPartitioning.of(0, mesh.geometry()),
+                        ),
+                    ),
+                )
+            )
+            logger.info(
+                "pool %s: host %s holds a stranded free %s share "
+                "(no complete block can back it); re-tiling to the "
+                "host-local default",
+                pool_name, kobjects.name(node_obj), p,
+            )
+    return out
+
+
 def group_pool_members(
     nodes: list[dict],
 ) -> tuple[list[dict], dict[str, list[dict]]]:
