@@ -4,7 +4,7 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
@@ -41,6 +41,13 @@ native:
 
 bench: native
 	python bench.py
+
+# Regression gate: compare bench_last.json headline keys against the
+# BASELINE.json published baselines (fails on >25% regression of
+# cb_serving_capacity_tokens_per_s / decode_gqa_roofline_fraction,
+# and on cb_ttft_p99 inflating past its band).
+bench-check:
+	python hack/bench_check.py
 
 dryrun:
 	python __graft_entry__.py

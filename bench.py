@@ -614,6 +614,8 @@ def main() -> None:
             "vs_decode_gqa_ceiling_adjusted", "decode_gqa_tokens_per_s",
             "decode_gqa_roofline_fraction", "decode_tokens_per_dispatch",
             "cb_vs_serial_speedup", "cb_ttft_p50", "cb_token_p99",
+            "cb_serving_capacity_tokens_per_s", "cb_admission_stall_ms",
+            "cb_kv_hbm_bytes_per_resident_token",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )
         if k in result

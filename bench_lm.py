@@ -285,7 +285,7 @@ def _measure_gqa(
     saved = lm_mod.CausalAttention._decode_attention
     try:
         lm_mod.CausalAttention._decode_attention = (
-            lambda self, q, k, v: jnp.zeros_like(q)
+            lambda self, q, k, v, block_table=None: jnp.zeros_like(q)
         )
         gen_na = make_generate_fn(
             cfg_g, tokens_per_dispatch=tokens_per_dispatch
@@ -473,7 +473,11 @@ def measure_cb_serving(
     Reported: realized arrival rate, TTFT p50/p99 (server-side:
     submit -> first token at its chunk sync), per-token p99
     (post-TTFT decode pace per request), request latency percentiles
-    (p90 != p50 is the point), goodput, slot occupancy.
+    (p90 != p50 is the point), goodput, slot occupancy,
+    `cb_admission_stall_ms` (host time in admission dispatches per
+    measured second — the stall the paged engine's fused prefill lane
+    removes) and `cb_kv_hbm_bytes_per_resident_token` (the paged
+    pool's memory-per-token snapshot under load).
     """
     import threading
     import urllib.request
@@ -575,7 +579,9 @@ def measure_cb_serving(
         rec_lock = threading.Lock()
         errors = [0]
         inflight = threading.Semaphore(8 * slots)
-        occ0 = get_json(f"{base}/stats").get("cb_occupancy", {})
+        stats0 = get_json(f"{base}/stats")
+        occ0 = stats0.get("cb_occupancy", {})
+        kv0 = stats0.get("cb_kv", {})
 
         def fire(payload: dict) -> None:
             t0 = time.perf_counter()
@@ -616,6 +622,11 @@ def measure_cb_serving(
             workers.append(th)
             n_fired += 1
         window_s = time.perf_counter() - t_start
+        # KV/stall deltas snapshot AT WINDOW END, before the queue
+        # drain: the engine keeps admitting (and, dense, stalling)
+        # for the up-to-160 s it takes stragglers to finish, and that
+        # tail must not be divided by a window that excludes it.
+        kv1 = get_json(f"{base}/stats").get("cb_kv", {})
         for th in workers:
             th.join(timeout=160.0)
         occ1 = get_json(f"{base}/stats").get("cb_occupancy", {})
@@ -652,6 +663,28 @@ def measure_cb_serving(
     total = (occ1.get("total_slot_steps", 0) or 0) - (
         occ0.get("total_slot_steps", 0) or 0
     )
+    # Host time spent inside admission dispatch work per measured
+    # second — the stall the fused chunked-prefill lane removes (the
+    # dense engine's blocking prefill+admit pairs serialized against
+    # decode chunks; r5 drove cb_ttft_p99 to 0.38 s with it).
+    stall_delta_s = (kv1.get("admission_stall_s", 0.0) or 0.0) - (
+        kv0.get("admission_stall_s", 0.0) or 0.0
+    )
+    # Dispatch-weighted average over the measurement window (delta of
+    # the engine's cumulative sums), not a point snapshot — a lone
+    # drain-tail or mid-prefill dispatch would misrepresent the
+    # under-load memory ratio.
+    kv_bytes_delta = (kv1.get("kv_bytes_dispatch_acc", 0.0) or 0.0) - (
+        kv0.get("kv_bytes_dispatch_acc", 0.0) or 0.0
+    )
+    kv_resident_delta = (
+        kv1.get("kv_resident_dispatch_acc", 0) or 0
+    ) - (kv0.get("kv_resident_dispatch_acc", 0) or 0)
+    kv_per_token = (
+        round(kv_bytes_delta / kv_resident_delta, 1)
+        if kv_resident_delta > 0
+        else kv1.get("kv_hbm_bytes_per_resident_token")
+    )
     return {
         "cb_serving_capacity_tokens_per_s": round(capacity_tok_s, 1),
         "cb_arrival_rate": round(n_fired / window_s, 2),
@@ -672,6 +705,13 @@ def measure_cb_serving(
         "cb_serving_request_p99_s": round(_pctl(walls, 99), 4)
         if walls else None,
         "cb_slot_occupancy": round(busy / total, 4) if total else None,
+        # Host ms spent in admission dispatches per measured second
+        # (fused-lane admission makes this bookkeeping-only), and the
+        # latest KV cache HBM bytes backing each resident token (the
+        # paged pool's memory win over slots x cache_len).
+        "cb_admission_stall_ms": round(1e3 * stall_delta_s / window_s, 2),
+        "cb_kv_hbm_bytes_per_resident_token": kv_per_token,
+        "cb_kv_paged": kv1.get("paged"),
         "cb_eos_terminated_pct": round(
             100.0 * eos_terminated / len(records), 1
         ) if records else None,

@@ -422,6 +422,14 @@ def main() -> None:
                 # 16 is the balanced default; on a local runtime the
                 # chunk sync is ~free and smaller chunks cost little.
                 chunk_steps=int(os.environ.get("WALKAI_CB_CHUNK", "16")),
+                # Paged KV block pool + fused chunked-prefill lane
+                # (models/serve.py): admission rides the step program
+                # instead of blocking prefill+admit dispatch pairs.
+                paged=os.environ.get("WALKAI_CB_PAGED", "1") == "1",
+                prefill_lanes=int(os.environ.get("WALKAI_CB_LANES", "4")),
+                prefill_chunk=int(
+                    os.environ.get("WALKAI_CB_PFCHUNK", "64")
+                ),
             )
             # Compile prefill + chunk step off the request path.
             cb_engine.submit([1], max_new_tokens=min(2, lm_max_new))
@@ -742,7 +750,14 @@ def main() -> None:
                 not speculative
                 and cb_engine is not None
                 and cb_enabled[0]
-                and len(prompt) <= cb_bucket
+                # Any prompt whose footprint fits the engine cache is
+                # served by the slot pool: the paged engine streams
+                # long prompts through the chunked-prefill lane, and
+                # the dense engine buckets them to the next power of
+                # two — over-bucket prompts are no longer bounced to
+                # the serialized path.
+                and len(prompt) + (req_max_new or lm_max_new)
+                <= cb_engine.cache_len
             )
             if wants_sampling and not on_batched_path:
                 # Never silently return greedy tokens for a sampling
@@ -941,6 +956,7 @@ def main() -> None:
                 payload = {**stats.snapshot(), **device_info}
                 if cb_engine is not None:
                     payload["cb_occupancy"] = cb_engine.occupancy()
+                    payload["cb_kv"] = cb_engine.kv_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

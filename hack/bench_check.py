@@ -1,0 +1,102 @@
+"""Regression gate for the headline bench keys.
+
+Compares `bench_last.json` (the sidecar the last `python bench.py` run
+wrote) against the baselines recorded in `BASELINE.json`'s `published`
+map and exits nonzero when any key regresses past its tolerance band —
+the `make bench-check` target, and a tier-1 test
+(tests/test_bench_check.py) pins the comparison logic plus the repo's
+own current files.
+
+`published` entries are either a bare number (higher-is-better, the
+default 25% band) or a spec:
+
+    "cb_serving_capacity_tokens_per_s":
+        {"value": 3583.7, "direction": "higher", "tolerance": 0.25}
+
+- direction "higher": fail when measured < value * (1 - tolerance)
+- direction "lower"  (latencies): fail when measured > value * (1 + tolerance)
+- value null: baseline not yet recorded (the key postdates the last
+  recorded round) — skipped with a note, never a failure, so new
+  metrics can be declared before a chip run exists to anchor them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_TOLERANCE = 0.25
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check(
+    bench: dict, baseline: dict, *, tolerance: float = DEFAULT_TOLERANCE
+) -> tuple[list[str], list[str]]:
+    """(failures, notes) from comparing a bench result against the
+    baseline's `published` map. Pure — the testable core."""
+    failures: list[str] = []
+    notes: list[str] = []
+    published = baseline.get("published") or {}
+    for key, spec in sorted(published.items()):
+        if isinstance(spec, dict):
+            base = spec.get("value")
+            direction = spec.get("direction", "higher")
+            tol = spec.get("tolerance", tolerance)
+        else:
+            base, direction, tol = spec, "higher", tolerance
+        if base is None:
+            notes.append(f"{key}: no recorded baseline yet — skipped")
+            continue
+        got = bench.get(key)
+        if not isinstance(got, (int, float)):
+            failures.append(
+                f"{key}: missing from bench output "
+                f"(baseline {base}, {direction} is better)"
+            )
+            continue
+        if direction == "higher" and got < base * (1 - tol):
+            failures.append(
+                f"{key}: {got} is {100 * (1 - got / base):.1f}% below "
+                f"baseline {base} (tolerance {tol:.0%})"
+            )
+        elif direction == "lower" and got > base * (1 + tol):
+            failures.append(
+                f"{key}: {got} is {100 * (got / base - 1):.1f}% above "
+                f"baseline {base} (tolerance {tol:.0%}, lower is better)"
+            )
+        else:
+            notes.append(f"{key}: {got} vs baseline {base} — ok")
+    return failures, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--bench", default=os.path.join(_ROOT, "bench_last.json"),
+        help="bench result JSON (default: repo bench_last.json)",
+    )
+    ap.add_argument(
+        "--baseline", default=os.path.join(_ROOT, "BASELINE.json"),
+        help="baseline JSON with a `published` map",
+    )
+    args = ap.parse_args(argv)
+    with open(args.bench) as f:
+        bench = json.load(f)
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    failures, notes = check(bench, baseline)
+    for line in notes:
+        print(f"  {line}")
+    if failures:
+        print("bench-check FAILED:")
+        for line in failures:
+            print(f"  {line}")
+        return 1
+    print("bench-check ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

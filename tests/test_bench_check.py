@@ -1,0 +1,116 @@
+"""`make bench-check` (hack/bench_check.py): the headline-key
+regression gate must pass on the repo's own current artifacts and
+fail on a synthetic >25% regression — a broken comparator would wave
+real regressions through silently, so the logic itself is tier-1."""
+
+import importlib.util
+import json
+import pathlib
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+spec = importlib.util.spec_from_file_location(
+    "bench_check", _ROOT / "hack" / "bench_check.py"
+)
+bench_check = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench_check)
+
+BASELINE = {
+    "published": {
+        "cb_serving_capacity_tokens_per_s": {
+            "value": 1000.0, "direction": "higher", "tolerance": 0.25,
+        },
+        "cb_ttft_p99": {
+            "value": 0.4, "direction": "lower", "tolerance": 0.25,
+        },
+        "decode_gqa_roofline_fraction": {
+            "value": None, "direction": "higher",
+        },
+    }
+}
+
+
+class TestCheckLogic:
+    def test_within_band_passes(self):
+        failures, notes = bench_check.check(
+            {"cb_serving_capacity_tokens_per_s": 800.0,
+             "cb_ttft_p99": 0.49},
+            BASELINE,
+        )
+        assert failures == []
+        # The unrecorded baseline is skipped with a note, not failed.
+        assert any("no recorded baseline" in n for n in notes)
+
+    def test_regression_past_band_fails(self):
+        failures, _ = bench_check.check(
+            {"cb_serving_capacity_tokens_per_s": 700.0,  # -30%
+             "cb_ttft_p99": 0.1},
+            BASELINE,
+        )
+        assert len(failures) == 1
+        assert "cb_serving_capacity_tokens_per_s" in failures[0]
+
+    def test_lower_is_better_direction(self):
+        failures, _ = bench_check.check(
+            {"cb_serving_capacity_tokens_per_s": 1200.0,
+             "cb_ttft_p99": 0.51},  # +27.5% latency
+            BASELINE,
+        )
+        assert len(failures) == 1
+        assert "cb_ttft_p99" in failures[0]
+
+    def test_missing_key_fails(self):
+        failures, _ = bench_check.check(
+            {"cb_ttft_p99": 0.3}, BASELINE
+        )
+        assert any(
+            "cb_serving_capacity_tokens_per_s" in f and "missing" in f
+            for f in failures
+        )
+
+    def test_bare_number_baseline_defaults_higher(self):
+        failures, _ = bench_check.check(
+            {"x": 70.0}, {"published": {"x": 100.0}}
+        )
+        assert failures and "x" in failures[0]
+        failures, _ = bench_check.check(
+            {"x": 80.0}, {"published": {"x": 100.0}}
+        )
+        assert failures == []
+
+
+class TestRepoArtifacts:
+    def test_repo_baseline_vs_last_bench_passes(self):
+        """The committed bench_last.json must satisfy the committed
+        BASELINE.json published bands — the gate ships green (the
+        baselines ARE the r5 numbers bench_last records)."""
+        with open(_ROOT / "bench_last.json") as f:
+            bench = json.load(f)
+        with open(_ROOT / "BASELINE.json") as f:
+            baseline = json.load(f)
+        assert baseline.get("published"), "BASELINE.json published empty"
+        failures, _ = bench_check.check(bench, baseline)
+        assert failures == [], failures
+
+    def test_main_exit_codes(self, tmp_path):
+        good = tmp_path / "good.json"
+        bad = tmp_path / "bad.json"
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(BASELINE))
+        good.write_text(json.dumps(
+            {"cb_serving_capacity_tokens_per_s": 1000.0,
+             "cb_ttft_p99": 0.4}
+        ))
+        bad.write_text(json.dumps(
+            {"cb_serving_capacity_tokens_per_s": 100.0,
+             "cb_ttft_p99": 0.4}
+        ))
+        assert bench_check.main(
+            ["--bench", str(good), "--baseline", str(base)]
+        ) == 0
+        assert bench_check.main(
+            ["--bench", str(bad), "--baseline", str(base)]
+        ) == 1
+
+    def test_makefile_has_bench_check_target(self):
+        assert "bench-check:" in (_ROOT / "Makefile").read_text()

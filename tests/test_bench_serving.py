@@ -65,6 +65,22 @@ def test_cb_serving_benchmark_runs_end_to_end(monkeypatch):
     assert r["cb_goodput_tokens_per_s"] > 0
     assert r["cb_slot_occupancy"] is not None
     assert r["cb_serving_request_p90_s"] >= r["cb_serving_request_p50_s"]
+    # The paged-pool rework's first-class fields: admission stall per
+    # measured second and KV HBM bytes per resident token — both must
+    # be emitted (and the engine must be running the paged pool).
+    assert r["cb_admission_stall_ms"] >= 0
+    assert r["cb_kv_hbm_bytes_per_resident_token"] > 0
+    assert r["cb_kv_paged"] is True
+    # And they are headline keys in bench.py's emitted line (they
+    # must survive driver-side tail truncation).
+    import inspect
+
+    import bench
+
+    src = inspect.getsource(bench.main)
+    assert "cb_admission_stall_ms" in src
+    assert "cb_kv_hbm_bytes_per_resident_token" in src
+    assert "cb_serving_capacity_tokens_per_s" in src
 
 
 def test_decode_bench_emits_roofline_fields(monkeypatch):

@@ -355,8 +355,11 @@ def test_pyproject_console_scripts_resolve():
     """Every [project.scripts] entry must point at an importable
     callable — packaging metadata can silently rot otherwise."""
     import importlib
-    import tomllib
     from pathlib import Path
+
+    tomllib = pytest.importorskip(
+        "tomllib", reason="stdlib tomllib needs Python >= 3.11"
+    )
 
     pyproject = Path(__file__).resolve().parents[1] / "pyproject.toml"
     scripts = tomllib.loads(pyproject.read_text())["project"]["scripts"]

@@ -144,6 +144,128 @@ class TestStreamedKernelParity:
         )
 
 
+def _paged_pool(k, v, nlog, extra_blocks=4, seed=0):
+    """Scatter a dense [b, kvh, nlog*128, d] cache into a SHUFFLED
+    block pool + per-slot table (physical order deliberately unlike
+    logical order, plus unreferenced garbage blocks)."""
+    b, kvh, s, d = k.shape
+    assert s == nlog * 128
+    rng = np.random.default_rng(seed)
+    nb = 1 + b * nlog + extra_blocks  # block 0 reserved, like serve.py
+    k_pool = rng.standard_normal((nb, kvh, 128, d))
+    v_pool = rng.standard_normal((nb, kvh, 128, d))
+    table = np.zeros((b, nlog), np.int32)
+    perm = rng.permutation(np.arange(1, nb))[: b * nlog]
+    for bi in range(b):
+        for j in range(nlog):
+            p = perm[bi * nlog + j]
+            table[bi, j] = p
+            k_pool[p] = np.asarray(k, np.float64)[bi, :, j*128:(j+1)*128]
+            v_pool[p] = np.asarray(v, np.float64)[bi, :, j*128:(j+1)*128]
+    return (
+        jnp.asarray(k_pool, k.dtype), jnp.asarray(v_pool, v.dtype),
+        jnp.asarray(table),
+    )
+
+
+class TestPagedKernelParity:
+    """The table-indexed (gather-grid) variant of the streamed kernel
+    vs the dense XLA reference: block indirection must change WHERE a
+    block is read from, never what the softmax sees."""
+
+    @pytest.mark.parametrize("kvh", [1, 2, 4])
+    def test_shuffled_pool_matches_dense_reference(self, kvh):
+        q, k, v = _qkv(b=3, kvh=kvh, s=384)
+        idx = jnp.asarray([0, 129, 383], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=3)
+        out = da.paged_decode_attention(
+            q, k_pool, v_pool, table, idx, interpret=True
+        )
+        ref = da.decode_attention_reference(q, k, v, idx)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_unreferenced_pool_blocks_never_leak(self):
+        """Pool blocks no table entry references — and referenced
+        blocks wholly past a slot's index — must not affect output:
+        poison there must be invisible (tail blocks are skipped via
+        the clamped table lookup, not read-and-masked)."""
+        q, k, v = _qkv(b=2, kvh=2, s=384, seed=1)
+        idx = jnp.asarray([64, 130], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=3)
+        referenced = set(np.asarray(table).ravel().tolist())
+        tbl = np.asarray(table)
+        poison_k = np.array(k_pool)
+        poison_v = np.array(v_pool)
+        for p in range(k_pool.shape[0]):
+            if p not in referenced:
+                poison_k[p] = poison_v[p] = np.inf
+        # Slot 0 at index 64 sees only its logical block 0: poison its
+        # blocks 1 and 2 as well.
+        poison_k[tbl[0, 1]] = poison_k[tbl[0, 2]] = np.inf
+        poison_v[tbl[0, 1]] = poison_v[tbl[0, 2]] = np.inf
+        out = da.paged_decode_attention(
+            q, jnp.asarray(poison_k, k.dtype), jnp.asarray(poison_v, v.dtype),
+            table, idx, interpret=True,
+        )
+        clean = da.paged_decode_attention(
+            q, k_pool, v_pool, table, idx, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(clean))
+
+    @pytest.mark.parametrize("steps", [3, 7])
+    def test_multi_step_crosses_block_boundary(self, steps):
+        q, k, v = _qkv(b=2, kvh=2, s=256, steps=steps, seed=2)
+        idx = jnp.asarray([126, 40], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=2)
+        out = da.paged_decode_attention(
+            q, k_pool, v_pool, table, idx, interpret=True
+        )
+        ref = da.decode_attention_reference(q, k, v, idx)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_index_past_logical_capacity_clamps(self):
+        """Freed serving slots keep stepping with index past their
+        logical capacity (models/serve.py parks them on the scratch
+        block): the visible-block count must clamp to the table width
+        instead of reading out of bounds."""
+        q, k, v = _qkv(b=2, kvh=2, s=256, seed=3)
+        idx = jnp.asarray([255, 1000], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=2)
+        out = da.paged_decode_attention(
+            q, k_pool, v_pool, table, idx, interpret=True
+        )
+        # Past-capacity index sees the whole gathered view — same as
+        # the reference at a full-cache index.
+        ref = da.decode_attention_reference(
+            q, k, v, jnp.asarray([255, 255], jnp.int32)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_bf16_pool_f32_accumulation(self):
+        q, k, v = _qkv(b=2, kvh=2, s=256, dtype=jnp.bfloat16, seed=4)
+        idx = jnp.asarray([200, 77], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=2)
+        out = da.paged_decode_attention(
+            q, k_pool, v_pool, table, idx, interpret=True
+        )
+        ref = da.decode_attention_reference(
+            q.astype(jnp.float32),
+            da.gather_paged_cache(k_pool, table).astype(jnp.float32),
+            da.gather_paged_cache(v_pool, table).astype(jnp.float32),
+            idx,
+        )
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+        )
+
+
 class TestAmortizedDispatch:
     """`tokens_per_dispatch` changes WHEN the host syncs, never the
     tokens: every chunk size must be bit-identical to the single-step

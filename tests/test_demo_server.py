@@ -356,11 +356,23 @@ class TestContinuousBatchingEndpoint:
         assert status == 200 and out.get("batched") is True
 
     def test_sampling_on_fallback_path_rejected(self, cb_server):
-        # Prompt longer than the CB bucket would fall back to the
-        # greedy serialized path; with sampling knobs that must be a
-        # 400, not silent greedy output. (Bucket defaults to 64.)
+        # A prompt whose footprint exceeds the ENGINE CACHE falls back
+        # to the greedy serialized path; with sampling knobs that must
+        # be a 400, not silent greedy output. (Engine cache is 128
+        # here: bucket 64 + max_new 6 bucketed up; the paged prefill
+        # lane serves any prompt that FITS the cache — over-bucket no
+        # longer means fallback.)
         status, _ = self._post(
             cb_server,
-            {"prompt": [1] * 80, "temperature": 0.9},
+            {"prompt": [1] * 125, "temperature": 0.9},
         )
         assert status == 400
+
+    def test_over_bucket_prompt_served_by_slot_pool(self, cb_server):
+        # Prompts longer than the prompt bucket (64) but fitting the
+        # engine cache stream in through the chunked prefill lane —
+        # served batched, not bounced to the serialized path.
+        status, out = self._post(cb_server, {"prompt": [1] * 80})
+        assert status == 200
+        assert out.get("batched") is True
+        assert len(out["tokens"]) > 0

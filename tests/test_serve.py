@@ -138,12 +138,20 @@ class TestLifecycle:
 
 
 class TestGuards:
-    def test_oversized_prompt_rejected(self):
+    @pytest.mark.parametrize("paged", [True, False], ids=["paged", "dense"])
+    def test_over_bucket_prompt_served_not_rejected(self, paged):
+        """Prompts longer than `prompt_bucket` are served — the paged
+        lane streams them in chunks; dense mode picks the smallest
+        power-of-two bucket that fits — and stay token-identical to
+        standalone greedy generation."""
+        params = _params()
         engine = ContinuousBatcher(
-            CFG, _params(), slots=1, cache_len=64, prompt_bucket=8,
+            CFG, params, slots=1, cache_len=64, prompt_bucket=8,
+            paged=paged,
         )
-        with pytest.raises(ValueError, match="prompt_bucket"):
-            engine.submit(np.arange(9), max_new_tokens=2)
+        prompt = _prompts(1, seed=20, lo=13, hi=14)[0]  # 13 > bucket 8
+        rid = engine.submit(prompt, max_new_tokens=4)
+        assert engine.run()[rid] == _expected(CFG, params, prompt, 4)
 
     def test_cache_overflow_rejected(self):
         engine = ContinuousBatcher(CFG, _params(), slots=1, cache_len=32)

@@ -1,0 +1,157 @@
+"""Paged KV slot pool + chunked-prefill lane (`models/serve.py`).
+
+Tier-1 surface for the serving memory/admission rework: paged-cache
+greedy decode must be TOKEN-IDENTICAL to the dense cache and to
+standalone generation for mixed ragged lengths crossing 128-row block
+boundaries; the streaming feed must agree with the completion records
+(including mid-chunk EOS and budget exhaustion); the block allocator
+must recycle and bound the pool. Deliberately NOT in conftest's
+`_SLOW_FILES` (tests/test_serve.py is) — the fast control-plane loop
+must exercise the serving engine's correctness surface, so the shapes
+here stay tiny.
+"""
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+    max_seq_len=512,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG.vocab_size, n).astype(np.int32)
+
+
+def _expected(params, prompt, max_new):
+    gen = make_generate_fn(CFG)
+    out = gen(params, jnp.asarray(prompt[None]), max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+class TestPagedDenseParity:
+    def test_mixed_ragged_lengths_crossing_block_boundaries(self, params):
+        """Prompts of 3/20/100/140 tokens with budgets that cross the
+        128-row block edge mid-prefill (140 > 128, streamed in
+        32-token lane chunks) and mid-decode (100 + 40 crosses at
+        step 28), sharing 2 slots: the paged engine, the dense engine,
+        and standalone generation must agree token for token."""
+        specs = [(3, 9), (20, 17), (100, 40), (140, 11)]
+        outs = {}
+        for paged in (True, False):
+            engine = ContinuousBatcher(
+                CFG, params, slots=2, cache_len=384, prompt_bucket=16,
+                chunk_steps=3, paged=paged, prefill_chunk=32,
+                prefill_lanes=2,
+            )
+            rids = {
+                engine.submit(_prompt(n, seed=n), max_new_tokens=m): (n, m)
+                for n, m in specs
+            }
+            res = engine.run()
+            outs[paged] = {rids[r]: toks for r, toks in res.items()}
+        for n, m in specs:
+            want = _expected(params, _prompt(n, seed=n), m)
+            assert outs[True][(n, m)] == want, (n, m)
+            assert outs[False][(n, m)] == want, (n, m)
+
+    def test_sampled_request_identical_across_cache_layouts(self, params):
+        """(prompt, knobs, seed) fully determines sampled output in
+        BOTH cache layouts — the lane's finishing scatter must seed
+        the slot's PRNG key exactly like the dense admit program."""
+        p = _prompt(11, seed=42)
+        toks = {}
+        for paged in (True, False):
+            engine = ContinuousBatcher(
+                CFG, params, slots=2, cache_len=256, chunk_steps=4,
+                paged=paged, prefill_chunk=8,
+            )
+            rid = engine.submit(
+                p, max_new_tokens=8, temperature=0.9, top_k=16,
+                top_p=0.95, seed=123,
+            )
+            toks[paged] = engine.run()[rid]
+        assert toks[True] == toks[False]
+        assert len(toks[True]) == 8
+
+
+class TestStreamingParity:
+    def test_drain_new_tokens_accumulates_to_done_output(self, params):
+        """The streaming feed, accumulated across manual step() turns,
+        must equal each request's completion record — including a
+        request ending on mid-chunk EOS and one exhausting its budget."""
+        full = _expected(params, _prompt(6, seed=6), 10)
+        # An EOS token whose first occurrence is mid-generation forces
+        # the early-exit path (same construction as test_serve.py).
+        eos, cut = next(
+            (t, i) for i, t in enumerate(full)
+            if 1 <= i < 9 and t not in full[:i]
+        )
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=128, chunk_steps=4,
+            prefill_chunk=8,
+        )
+        r_eos = engine.submit(_prompt(6, seed=6), max_new_tokens=10,
+                              eos_id=eos)
+        r_budget = engine.submit(_prompt(5, seed=8), max_new_tokens=9)
+        streamed: dict[int, list[int]] = {r_eos: [], r_budget: []}
+        records: dict[int, dict] = {}
+        while engine.has_work:
+            engine.step()
+            for rid, delta in engine.drain_new_tokens().items():
+                streamed[rid].extend(delta)
+            records.update(engine.drain_done_records())
+        records.update(engine.drain_done_records())
+        assert streamed[r_eos] == records[r_eos]["tokens"] == full[:cut + 1]
+        assert streamed[r_budget] == records[r_budget]["tokens"]
+        assert records[r_budget]["tokens"] == _expected(
+            params, _prompt(5, seed=8), 9
+        )
+        for rec in records.values():
+            assert 0 < rec["ttft_s"] <= rec["wall_s"]
+
+
+class TestBlockAllocator:
+    def test_pool_exhaustion_queues_then_recycles(self, params):
+        """A pool sized for ONE resident request at a time: the second
+        request waits for the first's blocks, both decode exactly, and
+        every block returns to the free list afterward."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=256, chunk_steps=4,
+            pool_blocks=3, prefill_chunk=8,
+        )
+        p0, p1 = _prompt(4, seed=1), _prompt(7, seed=2)
+        r0 = engine.submit(p0, max_new_tokens=130)  # 134 rows -> 2 blocks
+        r1 = engine.submit(p1, max_new_tokens=126)  # 133 rows -> 2 blocks
+        res = engine.run()
+        assert res[r0] == _expected(params, p0, 130)
+        assert res[r1] == _expected(params, p1, 126)
+        assert sorted(engine._free_blocks) == [1, 2]
+        assert not engine._table.any()
+
+    def test_request_larger_than_pool_rejected(self, params):
+        engine = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=256, pool_blocks=2,
+            prefill_chunk=8,
+        )
+        with pytest.raises(ValueError, match="pool"):
+            engine.submit(_prompt(4, seed=3), max_new_tokens=130)
+
+    def test_pending_queue_is_a_deque(self, params):
+        engine = ContinuousBatcher(CFG, params, slots=1, cache_len=128)
+        assert isinstance(engine._pending, deque)

@@ -26,6 +26,13 @@ caller-provided PRNG key. The emitted tokens are bit-identical for any
 per-step math — pinned by tests/test_decode_stream.py, including EOS
 landing mid-chunk).
 
+This one-shot path keeps the DENSE bucketed cache (`cache_bucket`):
+a single generation owns its whole cache, so paging buys nothing
+here. The serving engine reuses this module's amortized-dispatch
+structure and `sample_rows`, but stores KV in the shared paged block
+pool (`models/serve.py`, `LMConfig.paged_decode`) where many ragged
+co-tenant sequences must share cache memory.
+
 No reference analogue — serving-side companion of `models/lm.py`.
 """
 

@@ -24,7 +24,10 @@ from jax.sharding import Mesh
 from walkai_nos_tpu.ops.attention import flash_attention
 from walkai_nos_tpu.ops.decode_attention import (
     MAX_KERNEL_STEPS,
+    PAGE_ROWS,
     decode_attention,
+    gather_paged_cache,
+    paged_decode_attention,
 )
 from walkai_nos_tpu.ops.ring_attention import ring_attention
 from walkai_nos_tpu.ops.ulysses import ulysses_attention
@@ -118,6 +121,19 @@ class LMConfig:
     # writes become per-row scatters and the causal mask per-row;
     # scalar-index decoding (the default) is untouched.
     ragged_decode: bool = False
+    # Paged KV cache (requires ragged_decode): instead of a dense
+    # [batch, kv_heads, cache_len, d] cache per layer, each layer
+    # holds a SHARED pool of `paged_blocks` physical 128-row blocks
+    # ([paged_blocks, kv_heads, PAGE_ROWS, d]) with no batch
+    # dimension; the caller threads a [batch, max_logical_blocks]
+    # block table through `apply(..., block_table=...)` mapping each
+    # slot's logical cache block to a pool block. Cache memory and
+    # per-step HBM traffic then scale with tokens RESIDENT, not
+    # batch x cache_len — the PagedAttention memory model
+    # (models/serve.py owns the allocator; block 0 is its reserved
+    # scratch block for idle slots).
+    paged_decode: bool = False
+    paged_blocks: int = 0
 
     def __post_init__(self):
         if self.num_kv_heads is not None and (
@@ -132,6 +148,17 @@ class LMConfig:
             raise ValueError(f"unknown norm {self.norm!r}")
         if self.mlp not in ("gelu", "swiglu"):
             raise ValueError(f"unknown mlp {self.mlp!r}")
+        if self.paged_decode:
+            if not self.ragged_decode:
+                raise ValueError(
+                    "paged_decode requires ragged_decode (the block "
+                    "table is per-slot, so the cache index must be too)"
+                )
+            if self.paged_blocks < 2:
+                raise ValueError(
+                    f"paged_decode needs paged_blocks >= 2 (block 0 is "
+                    f"the reserved scratch block); got {self.paged_blocks}"
+                )
 
     @property
     def compute_dtype(self):
@@ -202,7 +229,7 @@ class CausalAttention(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False):
+    def __call__(self, x, *, decode: bool = False, block_table=None):
         c = self.cfg
         d = c.hidden_dim
         head_dim = d // c.num_heads
@@ -226,7 +253,7 @@ class CausalAttention(nn.Module):
             b, s, kv_heads, head_dim
         ).transpose(0, 2, 1, 3)
         if decode:
-            o = self._decode_attention(q, k, v)
+            o = self._decode_attention(q, k, v, block_table)
         else:
             if c.rope:
                 # Training/full-forward path rotates by sequence
@@ -256,7 +283,7 @@ class CausalAttention(nn.Module):
             return ulysses_attention(q, k, v, self.mesh, causal=True)
         return flash_attention(q, k, v, causal=True)
 
-    def _decode_attention(self, q, k, v):
+    def _decode_attention(self, q, k, v, block_table=None):
         """KV-cache attention for autoregressive decoding (the flax
         `cache` collection idiom): new K/V land at `cache_index` via a
         static-shaped dynamic_update_slice, the query attends to every
@@ -264,8 +291,12 @@ class CausalAttention(nn.Module):
         cache width (`cache_len` when set — decode.cache_bucket sizes
         it to the generation so per-step HBM traffic is proportional to
         what is generated, not to `max_seq_len`) — decoding works on
-        single steps or prefill chunks, where flashing buys nothing."""
+        single steps or prefill chunks, where flashing buys nothing.
+        With `paged_decode` the dense per-batch cache is replaced by
+        the shared block pool (`_paged_decode_attention`)."""
         c = self.cfg
+        if c.paged_decode:
+            return self._paged_decode_attention(q, k, v, block_table)
         cache_len = c.cache_len or c.max_seq_len
         batch, heads, steps, head_dim = q.shape
         kv_heads = k.shape[1]
@@ -346,55 +377,139 @@ class CausalAttention(nn.Module):
                     q[:, :, 0], k_all, v_all, idx
                 )[:, :, None, :]
             return decode_attention(q, k_all, v_all, idx)
-        q_pos = (
-            idx[:, None] + jnp.arange(steps) if ragged
-            else idx + jnp.arange(steps)
-        )  # [batch, steps] or [steps]
-        k_pos = jnp.arange(cache_len)
-        # [steps, cache_len], or [batch, steps, cache_len] when ragged.
-        mask = k_pos[None, :] <= q_pos[..., None]
-        scale = head_dim ** -0.5
-        if kv_heads != heads:
-            # Grouped-query attention prefill (single steps returned
-            # above): query head i reads KV head i // group; the K/V
-            # cache is read once at kv_heads width — the decode step's
-            # HBM traffic shrinks by the group factor.
-            group = heads // kv_heads
-            # Rank-3 batched matmuls ([b*kv_heads] batch cells, group*
-            # steps query rows each): K/V stream once in their storage
-            # dtype with f32 MXU accumulation — an astype(f32) of the
-            # cache here would materialize it at twice the bytes,
-            # forfeiting exactly the traffic GQA removes.
-            qg = q.reshape(batch * kv_heads, group * steps, head_dim)
-            kg = k_all.reshape(batch * kv_heads, cache_len, head_dim)
-            vg = v_all.reshape(batch * kv_heads, cache_len, head_dim)
-            logits = jnp.einsum(
-                "xrd,xkd->xrk", qg, kg,
-                preferred_element_type=jnp.float32,
-            ) * scale
-            if ragged:  # [b, steps, cache] -> per-cell rows
-                gmask = jnp.broadcast_to(
-                    mask[:, None, None],
-                    (batch, kv_heads, group, steps, cache_len),
-                ).reshape(batch * kv_heads, group * steps, cache_len)
-            else:  # [steps, cache] -> same rows for every cell
-                gmask = jnp.tile(mask, (group, 1))[None]
-            logits = jnp.where(gmask, logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            o = jnp.einsum(
-                "xrk,xkd->xrd", probs.astype(vg.dtype), vg,
-                preferred_element_type=jnp.float32,
-            ).astype(q.dtype)
-            return o.reshape(batch, heads, steps, head_dim)
-        logits = jnp.einsum(
-            "bhqd,bhkd->bhqk", q.astype(jnp.float32),
-            k_all.astype(jnp.float32),
-        ) * scale
-        logits = jnp.where(
-            mask[:, None] if ragged else mask[None, None], logits, -1e30
+        return _masked_cache_attention(q, k_all, v_all, idx, ragged)
+
+    def _paged_decode_attention(self, q, k, v, block_table):
+        """Paged-cache decoding: each layer holds a shared pool of
+        128-row K/V blocks; `block_table` (threaded through `apply`,
+        not a cache variable — the serving engine recomputes it
+        host-side per dispatch) maps logical cache block j of slot b
+        to pool block table[b, j]. New rows scatter through the table
+        (a step may straddle a block edge, so positions map per row);
+        single/short-step reads run the table-indexed streamed kernel
+        (`ops/decode_attention.paged_decode_attention`), wide prefill
+        chunks gather the slot's blocks into a dense view once and
+        reuse the masked-attention tail. Positions past a slot's
+        logical capacity clamp to its last table entry — idle serving
+        slots (table rows parked on scratch block 0) keep stepping
+        harmlessly."""
+        c = self.cfg
+        batch, heads, steps, head_dim = q.shape
+        kv_heads = k.shape[1]
+        pool_shape = (c.paged_blocks, kv_heads, PAGE_ROWS, head_dim)
+        pool_k = self.variable(
+            "cache", "cached_key", jnp.zeros, pool_shape, c.compute_dtype
         )
+        pool_v = self.variable(
+            "cache", "cached_value", jnp.zeros, pool_shape, c.compute_dtype
+        )
+        index = self.variable(
+            "cache", "cache_index",
+            lambda: jnp.zeros((batch,), jnp.int32),
+        )
+        if self.is_initializing():
+            return jnp.zeros_like(q)
+        if block_table is None:
+            raise ValueError(
+                "paged_decode requires block_table= at apply time"
+            )
+        idx = index.value  # [batch]
+        nlog = block_table.shape[1]
+        pos = idx[:, None] + jnp.arange(steps)  # [batch, steps]
+        if c.rope:
+            q = apply_rope(q, pos, c.rope_theta)
+            k = apply_rope(k, pos, c.rope_theta)
+        logical = jnp.clip(pos // PAGE_ROWS, 0, nlog - 1)
+        phys = jnp.take_along_axis(block_table, logical, axis=1)
+        row = pos % PAGE_ROWS
+
+        def put(pool, new):  # new: [batch, kv_heads, steps, d]
+            rows = new.transpose(0, 2, 1, 3).reshape(
+                batch * steps, kv_heads, head_dim
+            )
+            return pool.at[
+                phys.reshape(-1), :, row.reshape(-1), :
+            ].set(rows.astype(pool.dtype))
+
+        k_pool = put(pool_k.value, k)
+        v_pool = put(pool_v.value, v)
+        pool_k.value, pool_v.value = k_pool, v_pool
+        index.value = idx + steps
+        if steps <= MAX_KERNEL_STEPS:
+            # The table-indexed streamed kernel reads each referenced
+            # block exactly once (on CPU it falls back to the gather
+            # reference internally). MHA takes this path too in paged
+            # mode: the gather alternative would copy the cache.
+            if steps == 1:
+                return paged_decode_attention(
+                    q[:, :, 0], k_pool, v_pool, block_table, idx
+                )[:, :, None, :]
+            return paged_decode_attention(
+                q, k_pool, v_pool, block_table, idx
+            )
+        k_all = gather_paged_cache(k_pool, block_table)
+        v_all = gather_paged_cache(v_pool, block_table)
+        return _masked_cache_attention(q, k_all, v_all, idx, True)
+
+
+def _masked_cache_attention(q, k_all, v_all, idx, ragged):
+    """Dense masked attention over a full cache view — the decode tail
+    shared by the dense cache path and the paged gather path. q:
+    [batch, heads, steps, d]; k/v_all: [batch, kv_heads, cache_len, d];
+    idx: [] or [batch] — position p visible to query row r iff
+    p <= idx + r."""
+    batch, heads, steps, head_dim = q.shape
+    kv_heads = k_all.shape[1]
+    cache_len = k_all.shape[2]
+    q_pos = (
+        idx[:, None] + jnp.arange(steps) if ragged
+        else idx + jnp.arange(steps)
+    )  # [batch, steps] or [steps]
+    k_pos = jnp.arange(cache_len)
+    # [steps, cache_len], or [batch, steps, cache_len] when ragged.
+    mask = k_pos[None, :] <= q_pos[..., None]
+    scale = head_dim ** -0.5
+    if kv_heads != heads:
+        # Grouped-query attention prefill (single steps take the
+        # kernel path): query head i reads KV head i // group; the K/V
+        # cache is read once at kv_heads width — the decode step's
+        # HBM traffic shrinks by the group factor.
+        group = heads // kv_heads
+        # Rank-3 batched matmuls ([b*kv_heads] batch cells, group*
+        # steps query rows each): K/V stream once in their storage
+        # dtype with f32 MXU accumulation — an astype(f32) of the
+        # cache here would materialize it at twice the bytes,
+        # forfeiting exactly the traffic GQA removes.
+        qg = q.reshape(batch * kv_heads, group * steps, head_dim)
+        kg = k_all.reshape(batch * kv_heads, cache_len, head_dim)
+        vg = v_all.reshape(batch * kv_heads, cache_len, head_dim)
+        logits = jnp.einsum(
+            "xrd,xkd->xrk", qg, kg,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if ragged:  # [b, steps, cache] -> per-cell rows
+            gmask = jnp.broadcast_to(
+                mask[:, None, None],
+                (batch, kv_heads, group, steps, cache_len),
+            ).reshape(batch * kv_heads, group * steps, cache_len)
+        else:  # [steps, cache] -> same rows for every cell
+            gmask = jnp.tile(mask, (group, 1))[None]
+        logits = jnp.where(gmask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_all.dtype), v_all)
+        o = jnp.einsum(
+            "xrk,xkd->xrd", probs.astype(vg.dtype), vg,
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
+        return o.reshape(batch, heads, steps, head_dim)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+        k_all.astype(jnp.float32),
+    ) * scale
+    logits = jnp.where(
+        mask[:, None] if ragged else mask[None, None], logits, -1e30
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_all.dtype), v_all)
 
 
 class DecoderBlock(nn.Module):
@@ -403,10 +518,11 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False):
+    def __call__(self, x, *, decode: bool = False, block_table=None):
         c = self.cfg
         x = x + CausalAttention(c, self.mesh, name="attn")(
             _make_norm(c, "norm1")(x), decode=decode,
+            block_table=block_table,
         )
         h = _make_norm(c, "norm2")(x)
         if self.use_moe:
@@ -449,12 +565,16 @@ class DecoderLM(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, tokens, *, decode: bool = False):
+    def __call__(self, tokens, *, decode: bool = False, block_table=None):
         """tokens: [batch, seq] int32 -> logits [batch, seq, vocab].
 
         With `decode=True` the blocks run in KV-cache mode (mutable
         `cache` collection): `tokens` is the prefill chunk or the next
-        single step, positions continue from the cache index.
+        single step, positions continue from the cache index. With
+        `paged_decode`, `block_table` ([batch, max_logical_blocks]
+        int32 pool-block ids) must accompany every decode apply — the
+        serving engine owns it host-side, so it is an argument, not a
+        cache variable.
         """
         c = self.cfg
         x = nn.Embed(
@@ -506,7 +626,9 @@ class DecoderLM(nn.Module):
         for i in range(c.num_layers):
             use_moe = c.num_experts > 0 and (i + 1) % c.moe_every == 0
             block = block_cls(c, self.mesh, use_moe, name=f"block{i}")
-            x = block(x) if use_remat else block(x, decode=decode)
+            x = block(x) if use_remat else block(
+                x, decode=decode, block_table=block_table
+            )
         x = _make_norm(c, "norm")(x)
         return nn.Dense(
             c.vocab_size, dtype=jnp.float32, use_bias=c.head_bias,

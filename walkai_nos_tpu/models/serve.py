@@ -1,35 +1,45 @@
 """Continuous batching for LM serving: concurrent generations share one
-running decode batch.
+running decode batch over a PAGED KV block pool.
 
 A fixed pool of `slots` sequences advances together, one token per
 step, through a single jitted program — sequences JOIN at step
-boundaries (prefill into a free slot) and LEAVE when they hit EOS or
-their token budget, without ever stopping the batch. This is the
-serving pattern that keeps a device busy under ragged, asynchronous
-request arrival (one-at-a-time `generate()` calls leave the chip idle
-whenever a sequence ends; batched `generate()` waits for the longest
-sequence).
+boundaries and LEAVE when they hit EOS or their token budget, without
+ever stopping the batch. This is the serving pattern that keeps a
+device busy under ragged, asynchronous request arrival (one-at-a-time
+`generate()` calls leave the chip idle whenever a sequence ends;
+batched `generate()` waits for the longest sequence).
 
 TPU-first mechanics (everything static-shaped, nothing recompiles as
 requests come and go):
 
-- **Ragged KV cache** (`LMConfig.ragged_decode`): the cache index is a
-  [slots] vector — each row sits at its own position; writes are
-  per-row scatters and the causal mask per-row. `step_chunk`'s decode
-  steps run the streamed decode kernel with the per-row index
-  (`ops/decode_attention.py`): each slot's cache streams through VMEM
-  in 128-row blocks, and bucket tail blocks past every slot in a grid
-  block are skipped, not read — freshly admitted short slots don't pay
-  for the pool's longest resident.
-- **Prefill into a slot**: the prompt (padded to a bucket, so prompt
-  lengths share compiled programs) runs through a batch-1 cache; its
-  rows are then written into the pool cache at the slot index with one
-  donated `tree_map` of dynamic_update_slices, and the slot's first
-  token (argmax at the true prompt length) lands in the device-side
-  token vector — admission never synchronizes with the host. Pad rows
-  write garbage K/V beyond the true length — invisible (masked by the
-  per-row index) and overwritten row-by-row as generation proceeds, so
-  bucketing is exact, not approximate.
+- **Paged KV cache** (`LMConfig.paged_decode`, default): each layer's
+  cache is a SHARED pool of 128-row physical blocks plus a host-owned
+  per-slot block table (uploaded per dispatch — a few hundred bytes)
+  mapping logical cache block j of a slot to its pool block. Cache
+  memory and per-step HBM traffic scale with tokens RESIDENT, not
+  `slots x cache_len` — the PagedAttention memory model — so the slot
+  count can grow well past what dense per-slot caches allowed. The
+  streamed decode kernel reads cache blocks THROUGH the table
+  (gather-indexed BlockSpec grid, tail-skip preserved;
+  `ops/decode_attention.paged_decode_attention`). Block 0 is a
+  reserved scratch block: freed or not-yet-admitted slots keep
+  stepping with their table row parked there, so their writes land in
+  garbage no live slot ever reads. Blocks are allocated at admission
+  (enough for prompt + budget) and returned when the request leaves.
+- **Chunked prefill fused into the step program** (stall-free
+  admission, the Sarathi-Serve move): admission no longer runs a
+  blocking batch-1 prefill + admit dispatch pair per request between
+  chunks. Instead `step_chunk` carries a PREFILL LANE — up to
+  `prefill_lanes` newly admitted requests each consume up to
+  `prefill_chunk` prompt tokens per dispatch, written straight
+  through their block tables into the pool, WHILE every live slot
+  keeps decoding in the same dispatch. Arbitrary prompt lengths
+  stream in over as many chunks as they need; the finishing chunk
+  computes the slot's first token and flips it live, so TTFT is
+  bounded by the chunk cadence, not by a queue of serialized
+  prefills. During its prefill a slot's decode-lane table row stays
+  parked on the scratch block, so the two lanes never write the same
+  block.
 - **Chunked, pipelined stepping**: the step program scans
   `chunk_steps` decode steps on-device and carries the token vector in
   device state; the host keeps ONE chunk in flight and fetches chunk
@@ -39,12 +49,21 @@ requests come and go):
   device — freed slots idle for one extra chunk (their output is
   discarded), which costs bounded wasted work, never correctness.
 
-Greedy only (the exactness property below is the point); sampling
-belongs to `models/decode.py`'s one-shot path.
+`paged=False` keeps the original dense per-slot cache with blocking
+bucketed prefill admission (the parity baseline tests pin against).
+In dense mode, prompts longer than `prompt_bucket` select the
+smallest power-of-two bucket that fits (compile pre-warmed at submit),
+so long prompts are served, not rejected.
+
+Greedy only by default (the exactness property below is the point);
+per-request sampling knobs ride along. Sampling belongs to
+`models/decode.py`'s one-shot path.
 
 **Exactness**: every request's output is token-identical to a
 standalone `make_generate_fn` greedy call on the same weights
-(tests/test_serve.py), regardless of what else shares the batch.
+(tests/test_serve.py, tests/test_serve_paged.py), regardless of what
+else shares the batch — and identical between the paged and dense
+cache layouts.
 
 No reference analogue — the reference is a k8s control plane; this is
 the serving-side engine of the TPU compute runtime.
@@ -64,6 +83,7 @@ import numpy as np
 
 from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
 
 
 @dataclass
@@ -84,6 +104,17 @@ class _Request:
     streamed: int = 0  # tokens already handed out via drain_new_tokens
 
 
+@dataclass
+class _Prefill:
+    """A request mid-way through the chunked prefill lane: `consumed`
+    prompt tokens already written through `blocks` into the pool;
+    the slot flips live when the final chunk lands."""
+    req: _Request
+    slot: int
+    blocks: list
+    consumed: int = 0
+
+
 class ContinuousBatcher:
     """Continuous-batching engine over a slot pool.
 
@@ -95,6 +126,15 @@ class ContinuousBatcher:
 
     `submit` only queues; `run` (or repeated `step()`) drives
     admission + decoding until every queued request finishes.
+
+    `paged=True` (default) stores KV in a shared pool of
+    `pool_blocks` 128-row blocks (default: enough to back every slot
+    at `cache_len`, plus the scratch block — set it lower to
+    oversubscribe slots against expected resident tokens, or pair a
+    bigger `slots` with the same pool) and admits via the fused
+    chunked-prefill lane (`prefill_lanes` concurrent admissions, up to
+    `prefill_chunk` prompt tokens per dispatch each). `paged=False`
+    keeps the dense per-slot cache with blocking bucketed prefill.
 
     Sampling is per request (`temperature`/`top_k`/`top_p`/`seed` on
     `submit`; default greedy): the knobs and a per-slot PRNG key live
@@ -114,6 +154,10 @@ class ContinuousBatcher:
         cache_len: int | None = None,
         prompt_bucket: int = 16,
         chunk_steps: int = 8,
+        paged: bool = True,
+        pool_blocks: int | None = None,
+        prefill_chunk: int = 64,
+        prefill_lanes: int = 4,
     ) -> None:
         cache_len = cache_len or cfg.max_seq_len
         if prompt_bucket > cache_len:
@@ -121,17 +165,36 @@ class ContinuousBatcher:
                 f"prompt_bucket {prompt_bucket} exceeds cache_len "
                 f"{cache_len}: prefilled rows would not fit the cache"
             )
-        self.cfg = dataclasses.replace(
-            cfg, ragged_decode=True, cache_len=cache_len
-        )
         self.slots = slots
         self.cache_len = cache_len
         self.prompt_bucket = prompt_bucket
         self.chunk_steps = chunk_steps
+        self.paged = paged
         self.params = params
+        self._nlog = -(-cache_len // PAGE_ROWS)
+        if paged:
+            self.pool_blocks = pool_blocks or slots * self._nlog + 1
+            if self.pool_blocks < 2:
+                raise ValueError(
+                    f"pool_blocks must be >= 2 (block 0 is the "
+                    f"reserved scratch block); got {self.pool_blocks}"
+                )
+            self.prefill_chunk = max(1, min(prefill_chunk, cache_len))
+            self.prefill_lanes = max(1, prefill_lanes)
+            self.cfg = dataclasses.replace(
+                cfg, ragged_decode=True, cache_len=cache_len,
+                paged_decode=True, paged_blocks=self.pool_blocks,
+            )
+        else:
+            self.pool_blocks = 0
+            self.cfg = dataclasses.replace(
+                cfg, ragged_decode=True, cache_len=cache_len
+            )
         self._model = DecoderLM(self.cfg)
         self._requests: dict[int, _Request] = {}
-        self._pending: list[_Request] = []
+        # O(1) admission pops under load (was a list popped from the
+        # front — O(n) per admission).
+        self._pending: deque[_Request] = deque()
         self._slot_req: list[_Request | None] = [None] * slots
         self._slot_new: list[bool] = [False] * slots
         self._next_rid = 0
@@ -147,6 +210,30 @@ class ContinuousBatcher:
         # In-flight chunk: (device tokens handle, slot->req snapshot,
         # per-slot "first token expected" flags).
         self._inflight: tuple | None = None
+        # Serving telemetry: cumulative host seconds spent inside
+        # admission work (dense mode: the blocking prefill + admit
+        # dispatch pair this engine's paged mode exists to remove),
+        # and the latest KV-memory-per-resident-token snapshot.
+        self.admission_stall_s = 0.0
+        self._kv_ratio: float | None = None
+        # Cumulative per-dispatch sums (bytes backing resident tokens,
+        # and resident tokens) — a window's delta ratio is the
+        # load-weighted average the bench reports, robust to WHEN the
+        # stats endpoint is polled (a lone drain-tail or mid-prefill
+        # snapshot is not representative).
+        self._kv_bytes_acc = 0.0
+        self._kv_resident_acc = 0
+
+        # Paged allocator state (host-owned; the table uploads per
+        # dispatch). Block 0 is never allocated: it is the scratch
+        # block idle slots write into.
+        self._table = np.zeros((slots, self._nlog), np.int32)
+        self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
+        self._free_blocks: list[int] = (
+            list(range(self.pool_blocks - 1, 0, -1)) if paged else []
+        )
+        self._prefilling: list[_Prefill] = []
+        self._warm_buckets: set[int] = set()
 
         cache = self._model.init(
             jax.random.PRNGKey(0),
@@ -163,8 +250,118 @@ class ContinuousBatcher:
             jnp.ones(slots, jnp.float32),        # top_p
             jax.random.split(jax.random.PRNGKey(0), slots),
         )
+        if paged:
+            self._build_paged_programs()
+        else:
+            self._build_dense_programs()
 
+    # -- compiled programs ---------------------------------------------
+
+    def _decode_scan(self, params, state, dec_table):
+        """Scan `chunk_steps` decode steps over every slot — the ONE
+        definition of the per-step sampling/key protocol both cache
+        layouts compile (dense passes dec_table=None). Returns the new
+        state and [slots, 1 + chunk_steps] tokens: column 0 is the
+        chunk's INPUT token per slot (how the host learns a newly
+        admitted slot's first token without its own fetch), the rest
+        are the generated tokens."""
         model = self._model
+        cache, tokens, temps, topks, topps, keys = state
+
+        def one(carry, _):
+            cache, tok, keys = carry
+            logits, variables = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None], decode=True, block_table=dec_table,
+                mutable=["cache"],
+            )
+            split = jax.vmap(jax.random.split)(keys)
+            nxt = sample_rows(
+                logits[:, -1].astype(jnp.float32),
+                temps, topks, topps, split[:, 1],
+            ).astype(jnp.int32)
+            return (variables["cache"], nxt, split[:, 0]), nxt
+
+        (cache, last, keys), out = jax.lax.scan(
+            one, (cache, tokens, keys), None, length=self.chunk_steps
+        )
+        emitted = jnp.concatenate(
+            [tokens[:, None], out.transpose(1, 0)], axis=1
+        )
+        return (cache, last, temps, topks, topps, keys), emitted
+
+    def _build_paged_programs(self) -> None:
+        model = self._model
+        decode_scan = self._decode_scan
+
+        @functools.partial(
+            jax.jit, static_argnames=("lane",), donate_argnums=(1,)
+        )
+        def step_chunk(params, state, dec_table, pf, lane: bool):
+            """Advance every slot `chunk_steps` tokens (`_decode_scan`),
+            then run the prefill lane.
+
+            The decode scan runs FIRST: a lane row that finishes its
+            prompt this dispatch must end with cache_index[slot] =
+            true_len (the scan would add chunk_steps to it). During
+            the scan a prefilling slot's `dec_table` row still points
+            at the scratch block, so the two lanes touch disjoint
+            pool blocks.
+            """
+            state, emitted = decode_scan(params, state, dec_table)
+            cache, last, temps, topks, topps, keys = state
+            if lane:
+                # Prefill lane: [P, W] prompt tokens, each row its own
+                # slot/segment. Rows that FINISH their prompt this
+                # dispatch carry their slot id in pf_fslot (idle and
+                # mid-prompt rows carry `slots`, an out-of-bounds
+                # index every scatter drops); the finishing updates
+                # are the old admit program, expressed as dropped
+                # scatters: index leaves <- true_len, first token into
+                # the token vector, knobs + PRNG key into slot state.
+                (pf_tok, pf_start, pf_tbl, pf_fslot, pf_true,
+                 pf_temp, pf_topk, pf_topp, pf_seed) = pf
+                lane_cache = jax.tree.map(
+                    lambda leaf: pf_start if leaf.ndim == 1 else leaf,
+                    cache,
+                )
+                pf_logits, lane_vars = model.apply(
+                    {"params": params, "cache": lane_cache},
+                    pf_tok, decode=True, block_table=pf_tbl,
+                    mutable=["cache"],
+                )
+                cache = jax.tree.map(
+                    lambda old, new: (
+                        old.at[pf_fslot].set(pf_true, mode="drop")
+                        if old.ndim == 1 else new
+                    ),
+                    cache, lane_vars["cache"],
+                )
+                last_pos = jnp.clip(
+                    pf_true - pf_start - 1, 0, pf_tok.shape[1] - 1
+                )
+                fl = jnp.take_along_axis(
+                    pf_logits, last_pos[:, None, None], axis=1
+                )[:, 0]
+                pf_keys = jax.vmap(
+                    lambda s: jax.random.split(jax.random.PRNGKey(s))
+                )(pf_seed)
+                first = sample_rows(
+                    fl.astype(jnp.float32),
+                    pf_temp, pf_topk, pf_topp, pf_keys[:, 1],
+                ).astype(jnp.int32)
+                last = last.at[pf_fslot].set(first, mode="drop")
+                temps = temps.at[pf_fslot].set(pf_temp, mode="drop")
+                topks = topks.at[pf_fslot].set(pf_topk, mode="drop")
+                topps = topps.at[pf_fslot].set(pf_topp, mode="drop")
+                keys = keys.at[pf_fslot].set(pf_keys[:, 0], mode="drop")
+            return (cache, last, temps, topks, topps, keys), emitted
+
+        self._step_fn = step_chunk
+
+    def _build_dense_programs(self) -> None:
+        model = self._model
+        decode_scan = self._decode_scan
 
         @jax.jit
         def prefill(params, prompt):
@@ -182,13 +379,17 @@ class ContinuousBatcher:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def admit(
-            state, small, logits, slot, true_len, temp, topk, topp, seed
+            state, small, logits_row, slot, true_len, temp, topk, topp,
+            seed,
         ):
             """Write prefilled rows, sampling knobs, and the slot's
             first token into the pool state. Index leaves (ndim 1) get
             the TRUE prompt length, not the bucket the prefill ran at —
             rows past true_len are pad garbage the per-row mask hides
-            until decoding overwrites them."""
+            until decoding overwrites them. `logits_row` is the last
+            TRUE prompt position's logits ([vocab] — sliced by the
+            caller so this program's signature is bucket-independent
+            and compiles exactly once)."""
             cache, tokens, temps, topks, topps, keys = state
 
             def put(big, row):
@@ -200,7 +401,7 @@ class ContinuousBatcher:
 
             key, sub = jax.random.split(jax.random.PRNGKey(seed))
             first = sample_rows(
-                logits[true_len - 1][None].astype(jnp.float32),
+                logits_row[None].astype(jnp.float32),
                 temp[None], topk[None], topp[None], sub[None],
             )[0].astype(jnp.int32)
             return (
@@ -214,36 +415,10 @@ class ContinuousBatcher:
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def step_chunk(params, state):
-            """Advance every slot `chunk_steps` tokens (greedy or
-            sampled per the slot's knobs; one key split per token).
-
-            Returns the new state and [slots, 1 + chunk_steps] tokens:
-            column 0 is the chunk's INPUT token per slot (how the host
-            learns a newly admitted slot's first token without its own
-            fetch), the rest are the generated tokens.
-            """
-            cache, tokens, temps, topks, topps, keys = state
-
-            def one(carry, _):
-                cache, tok, keys = carry
-                logits, variables = model.apply(
-                    {"params": params, "cache": cache},
-                    tok[:, None], decode=True, mutable=["cache"],
-                )
-                split = jax.vmap(jax.random.split)(keys)
-                nxt = sample_rows(
-                    logits[:, -1].astype(jnp.float32),
-                    temps, topks, topps, split[:, 1],
-                ).astype(jnp.int32)
-                return (variables["cache"], nxt, split[:, 0]), nxt
-
-            (cache, last, keys), out = jax.lax.scan(
-                one, (cache, tokens, keys), None, length=self.chunk_steps
-            )
-            emitted = jnp.concatenate(
-                [tokens[:, None], out.transpose(1, 0)], axis=1
-            )
-            return (cache, last, temps, topks, topps, keys), emitted
+            """Advance every slot `chunk_steps` tokens
+            (`_decode_scan`; no block table — the dense cache indexes
+            by slot directly)."""
+            return decode_scan(params, state, None)
 
         self._prefill_fn = prefill
         self._admit_fn = admit
@@ -294,17 +469,33 @@ class ContinuousBatcher:
                 f"[{prompt.min()}, {prompt.max()}]"
             )
         prompt = prompt.astype(np.int32)
-        if len(prompt) > self.prompt_bucket:
-            raise ValueError(
-                f"prompt len {len(prompt)} exceeds prompt_bucket "
-                f"{self.prompt_bucket}"
-            )
         total = len(prompt) + max_new_tokens
         if total > self.cache_len:
             raise ValueError(
                 f"prompt + max_new_tokens = {total} exceeds cache_len "
                 f"{self.cache_len}"
             )
+        if self.paged:
+            if self._blocks_needed(len(prompt), max_new_tokens) > (
+                self.pool_blocks - 1
+            ):
+                raise ValueError(
+                    f"request needs "
+                    f"{self._blocks_needed(len(prompt), max_new_tokens)} "
+                    f"cache blocks but the pool holds "
+                    f"{self.pool_blocks - 1} allocatable blocks"
+                )
+        else:
+            # Dense mode: any prompt that fits the cache is served —
+            # over-bucket prompts pick the smallest power-of-two
+            # bucket that fits; pre-warm its prefill compile here
+            # (submit time) so admission never stalls on a trace.
+            bucket = self._bucket_for(len(prompt))
+            if bucket not in self._warm_buckets:
+                self._warm_buckets.add(bucket)
+                self._prefill_fn(
+                    self.params, jnp.zeros((1, bucket), jnp.int32)
+                )
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(
@@ -330,7 +521,7 @@ class ContinuousBatcher:
         PREVIOUS chunk's tokens (the host fetch overlaps the chunk
         just dispatched). True while work remains."""
         self._admit()
-        if any(self._slot_req):
+        if any(r is not None for r in self._slot_req) or self._prefilling:
             handle = self._dispatch()
         else:
             handle = None
@@ -347,6 +538,7 @@ class ContinuousBatcher:
         return bool(
             self._pending
             or any(self._slot_req)
+            or self._prefilling
             or self._inflight is not None
         )
 
@@ -400,6 +592,41 @@ class ContinuousBatcher:
             "occupancy": round(self._busy_slot_steps / total, 4),
         }
 
+    def kv_stats(self) -> dict:
+        """KV-memory and admission telemetry for the serving bench.
+
+        `kv_hbm_bytes_per_resident_token` is the latest per-dispatch
+        snapshot of cache HBM bytes backing each resident token (paged:
+        allocated blocks only — approaches the analytic per-token KV
+        size as blocks fill; dense: the whole `slots x cache_len`
+        allocation, however empty); the `*_dispatch_acc` cumulative
+        sums let a caller difference two snapshots into the
+        dispatch-weighted average over its own window.
+        `admission_stall_s` is cumulative host time inside admission
+        dispatch work."""
+        per_tok = self._kv_bytes_per_token()
+        if self.paged:
+            backing = self.pool_blocks * PAGE_ROWS * per_tok
+        else:
+            backing = self.slots * self.cache_len * per_tok
+        return {
+            "kv_hbm_bytes_per_resident_token": self._kv_ratio,
+            # Cumulative sums: a caller differencing two snapshots gets
+            # the dispatch-weighted average ratio over its window.
+            "kv_bytes_dispatch_acc": self._kv_bytes_acc,
+            "kv_resident_dispatch_acc": self._kv_resident_acc,
+            "kv_bytes_per_token": per_tok,
+            "kv_backing_bytes": backing,
+            "kv_pool_blocks": self.pool_blocks if self.paged else None,
+            "kv_blocks_in_use": (
+                sum(len(b) for b in self._slot_blocks)
+                + sum(len(p.blocks) for p in self._prefilling)
+                if self.paged else None
+            ),
+            "paged": self.paged,
+            "admission_stall_s": round(self.admission_stall_s, 6),
+        }
+
     def run(self) -> dict[int, list[int]]:
         """Drive until every submitted request finishes."""
         out: dict[int, list[int]] = {}
@@ -411,11 +638,156 @@ class ContinuousBatcher:
 
     # -- internals -----------------------------------------------------
 
+    def _kv_bytes_per_token(self) -> int:
+        c = self.cfg
+        head_dim = c.hidden_dim // c.num_heads
+        dtype_bytes = 2 if "bfloat16" in str(c.dtype) else 4
+        return c.num_layers * 2 * c.kv_heads * head_dim * dtype_bytes
+
+    def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        """Physical blocks a request holds: its whole footprint
+        (prompt + budget), floored at one prefill chunk — the lane's
+        final chunk pads to `prefill_chunk`, and pad rows must land in
+        blocks the request owns (they are masked, then overwritten as
+        decoding proceeds — the same trick dense bucketed prefill
+        plays inside one slot's cache)."""
+        cover = max(prompt_len + max_new, self.prefill_chunk)
+        return -(-min(cover, self.cache_len) // PAGE_ROWS)
+
+    def _bucket_for(self, prompt_len: int) -> int:
+        """Dense-mode prefill bucket: `prompt_bucket` when it fits,
+        else the smallest power of two that does (capped at the cache
+        width) — prompt lengths share compiled programs, and long
+        prompts are served instead of rejected."""
+        if prompt_len <= self.prompt_bucket:
+            return self.prompt_bucket
+        bucket = 1 << (prompt_len - 1).bit_length()
+        return min(max(bucket, self.prompt_bucket), self.cache_len)
+
+    def _record_kv_snapshot(self) -> None:
+        live = [r for r in self._slot_req if r is not None]
+        resident = sum(len(r.prompt) + len(r.tokens) for r in live)
+        resident += sum(p.consumed for p in self._prefilling)
+        if resident <= 0:
+            return
+        per_tok = self._kv_bytes_per_token()
+        if self.paged:
+            in_use = sum(
+                len(self._slot_blocks[s])
+                for s in range(self.slots)
+                if self._slot_req[s] is not None
+            ) + sum(len(p.blocks) for p in self._prefilling)
+            bytes_backing = in_use * PAGE_ROWS * per_tok
+        else:
+            bytes_backing = self.slots * self.cache_len * per_tok
+        self._kv_ratio = round(bytes_backing / resident, 1)
+        self._kv_bytes_acc += float(bytes_backing)
+        self._kv_resident_acc += resident
+
     def _dispatch(self):
+        if self.paged:
+            return self._dispatch_paged()
+        self._record_kv_snapshot()
         self._state, emitted = self._step_fn(self.params, self._state)
         snapshot = list(self._slot_req)
         fresh = list(self._slot_new)
         self._slot_new = [False] * self.slots
+        busy = sum(1 for r in snapshot if r is not None)
+        self._busy_slot_steps += busy * self.chunk_steps
+        self._total_slot_steps += self.slots * self.chunk_steps
+        return emitted, snapshot, fresh
+
+    def _dispatch_paged(self):
+        self._record_kv_snapshot()
+        dec_table = jnp.asarray(self._table)
+        finished: list[_Prefill] = []
+        if self._prefilling:
+            W = self.prefill_chunk
+            # Lane batch sized to ACTIVE admissions (rounded up to a
+            # power of two, capped at prefill_lanes, so compile
+            # signatures stay bounded): idle lane rows would pay whole
+            # transformer forwards for scratch-block garbage.
+            P = 1
+            while P < len(self._prefilling):
+                P *= 2
+            P = min(P, self.prefill_lanes)
+            pf_tok = np.zeros((P, W), np.int32)
+            pf_start = np.zeros(P, np.int32)
+            pf_tbl = np.zeros((P, self._nlog), np.int32)
+            # `slots` is out of bounds on purpose: scatters with
+            # mode="drop" ignore idle and mid-prompt rows.
+            pf_fslot = np.full(P, self.slots, np.int32)
+            pf_true = np.ones(P, np.int32)
+            pf_temp = np.zeros(P, np.float32)
+            pf_topk = np.zeros(P, np.int32)
+            pf_topp = np.ones(P, np.float32)
+            pf_seed = np.zeros(P, np.int32)
+            lane_end = W  # highest position any lane row touches
+            for r, entry in enumerate(self._prefilling):
+                req = entry.req
+                true_len = len(req.prompt)
+                remaining = true_len - entry.consumed
+                if remaining > W:
+                    start = entry.consumed
+                    entry.consumed += W
+                else:
+                    # Final chunk: align its END to the prompt's end
+                    # (re-writing up to W-remaining already-written
+                    # rows with identical values) so the last true
+                    # token's logits sit inside this chunk and no pad
+                    # row lands past position max(true_len, W) - 1.
+                    start = max(0, true_len - W)
+                    entry.consumed = true_len
+                    finished.append(entry)
+                    pf_fslot[r] = entry.slot
+                    pf_true[r] = true_len
+                    pf_temp[r] = req.temperature
+                    pf_topk[r] = req.top_k
+                    pf_topp[r] = req.top_p
+                    pf_seed[r] = req.seed
+                seg = req.prompt[start:start + W]
+                pf_tok[r, :len(seg)] = seg
+                pf_start[r] = start
+                pf_tbl[r, :len(entry.blocks)] = entry.blocks
+                lane_end = max(lane_end, start + W)
+            # The lane only ever touches positions < lane_end, so hand
+            # it a table truncated to the covering logical blocks
+            # (rounded up to a power of two, capped at the full width,
+            # to bound compile signatures): the wide-prefill gather in
+            # the model materializes table-width x 128 rows per layer,
+            # which must scale with the prompt prefix being written,
+            # not with cache_len.
+            need = -(-lane_end // PAGE_ROWS)
+            nlog = 1
+            while nlog < need:
+                nlog *= 2
+            nlog = min(nlog, self._nlog)
+            pf = tuple(
+                jnp.asarray(a) for a in (
+                    pf_tok, pf_start, pf_tbl[:, :nlog], pf_fslot,
+                    pf_true, pf_temp, pf_topk, pf_topp, pf_seed,
+                )
+            )
+            self._state, emitted = self._step_fn(
+                self.params, self._state, dec_table, pf, True
+            )
+        else:
+            self._state, emitted = self._step_fn(
+                self.params, self._state, dec_table, (), False
+            )
+        # Snapshot BEFORE flipping finished prefills live: their first
+        # token rides the NEXT chunk's input column.
+        snapshot = list(self._slot_req)
+        fresh = list(self._slot_new)
+        self._slot_new = [False] * self.slots
+        for entry in finished:
+            self._prefilling.remove(entry)
+            s = entry.slot
+            self._slot_req[s] = entry.req
+            self._slot_new[s] = True
+            self._budget[s] = entry.req.max_new_tokens
+            self._slot_blocks[s] = entry.blocks
+            self._table[s, :len(entry.blocks)] = entry.blocks
         busy = sum(1 for r in snapshot if r is not None)
         self._busy_slot_steps += busy * self.chunk_steps
         self._total_slot_steps += self.slots * self.chunk_steps
@@ -440,21 +812,67 @@ class ContinuousBatcher:
                     if self._slot_req[s] is req:
                         self._slot_req[s] = None
                         self._budget[s] = 0
+                        if self.paged:
+                            self._release_slot(s)
                     break
 
+    def _release_slot(self, s: int) -> None:
+        """Return a freed slot's blocks to the pool and park its table
+        row on the scratch block. The chunk already in flight was
+        dispatched with the old table, so it still writes these blocks
+        at the dead sequence's tail positions — harmless: any block
+        handed to a new request is rewritten position-by-position
+        before that position becomes visible (writes precede reads at
+        every step), exactly the pad-row invariant."""
+        self._free_blocks.extend(self._slot_blocks[s])
+        self._slot_blocks[s] = []
+        self._table[s, :] = 0
+
     def _admit(self) -> None:
+        t0 = time.monotonic()
+        if self.paged:
+            self._admit_paged()
+        else:
+            self._admit_dense()
+        self.admission_stall_s += time.monotonic() - t0
+
+    def _admit_paged(self) -> None:
+        """Assign pending requests to free slots + pool blocks and
+        enqueue them on the prefill lane — pure host bookkeeping, no
+        device dispatch (the lane rides the next step program).
+        Head-of-line: a request that does not fit the free pool waits
+        for completions to return blocks rather than being jumped."""
+        busy = {p.slot for p in self._prefilling}
+        for s in range(self.slots):
+            if len(self._prefilling) >= self.prefill_lanes:
+                return
+            if not self._pending:
+                return
+            if self._slot_req[s] is not None or s in busy:
+                continue
+            req = self._pending[0]
+            needed = self._blocks_needed(len(req.prompt), req.max_new_tokens)
+            if len(self._free_blocks) < needed:
+                return
+            self._pending.popleft()
+            blocks = [self._free_blocks.pop() for _ in range(needed)]
+            self._prefilling.append(_Prefill(req, s, blocks))
+            busy.add(s)
+
+    def _admit_dense(self) -> None:
         for s in range(self.slots):
             if self._slot_req[s] is not None or not self._pending:
                 continue
-            req = self._pending.pop(0)
+            req = self._pending.popleft()
             true_len = len(req.prompt)
-            padded = np.zeros(self.prompt_bucket, np.int32)
+            bucket = self._bucket_for(true_len)
+            padded = np.zeros(bucket, np.int32)
             padded[:true_len] = req.prompt
             small, logits = self._prefill_fn(
                 self.params, jnp.asarray(padded[None])
             )
             self._state = self._admit_fn(
-                self._state, small, logits, s, true_len,
+                self._state, small, logits[true_len - 1], s, true_len,
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 jnp.float32(req.top_p), req.seed,
             )

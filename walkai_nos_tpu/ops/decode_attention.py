@@ -54,6 +54,22 @@ row r iff p <= index + r. Rows above the index hold whatever the ring
 buffer holds — typically zeros — and are never read past the block
 boundary, so the kernel is exact for any cache length bucket.
 
+**Paged variant** (`paged_decode_attention`): the serving engine
+(`models/serve.py`) stores K/V in a SHARED pool of 128-row physical
+blocks instead of a dense `[slots, cache_len]` cache; a per-slot block
+table maps logical cache block j to its physical pool block. The
+paged kernel is the streamed kernel with the cache-block BlockSpec
+index map reading THROUGH the table (scalar-prefetched to SMEM): grid
+step (slot, j) streams physical block `table[slot, j]` — a
+gather-indexed grid — and the tail-skip clamp applies to the table
+lookup, so blocks wholly past the slot's index are still never read.
+One grid step covers all kv heads of one slot (the pool block is
+`[kv_heads, 128, head_dim]`-contiguous), so per-block HBM traffic and
+the all-pairs two-dot structure are unchanged; only the address of
+each block is indirect. HBM traffic per step thus scales with tokens
+RESIDENT (blocks the tables actually reference), not with
+slots x max_len.
+
 Inference-only by design: no VJP (decoding never differentiates).
 
 No reference-repo analogue (the reference is a k8s control plane); this
@@ -141,47 +157,49 @@ _VMEM_BLOCK_BUDGET_BYTES = 8 * 1024 * 1024
 _VMEM_SCORE_BUDGET_BYTES = 2 * 1024 * 1024
 
 
-def _gqa_stream_kernel(
-    n_blk, steps, per_cell, idx_ref, nblk_ref,
+def _stream_fold(
+    j, last, lim_fn, n_cells, cell_rows, steps,
     q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 ):
-    """One (cell-block, cache-block) grid step: fold one 128-row K/V
-    block of `n_blk` independent (batch, kv-head) cells into the
-    running softmax statistics, as TWO MXU dots.
+    """The ONE online-softmax fold both streamed kernels run per
+    (cell-block, cache-block) grid step: fold one 128-row K/V block of
+    `n_cells` independent (batch, kv-head) cells into the running
+    softmax statistics, as TWO MXU dots.
 
-    Refs: q/o [n_blk, g*steps, d] (rows ordered (group, step) within a
-    cell), k/v [n_blk, _STREAM_BLOCK_S, d]; m/l [rows, 128] and acc
-    [rows, d] are f32 VMEM scratch carried across the cache-block grid
-    dimension (the grid iterates cache blocks innermost, so each cell
-    block's statistics initialize at block 0 and finalize at its last
-    visible block).
+    Refs: q/o flatten to [n_cells * cell_rows, d] query rows ordered
+    (group, step) within a cell; k/v flatten to
+    [n_cells * _STREAM_BLOCK_S, d]; m/l [rows, 128] and acc [rows, d]
+    are f32 VMEM scratch carried across the cache-block grid dimension
+    (the grid iterates cache blocks innermost, so each cell block's
+    statistics initialize at block 0 and finalize at its last visible
+    block, `last`).
 
     The cells' queries and cache blocks are flattened into single
-    matrices: one [n_blk*g*steps, d] x [d, n_blk*128] score dot and one
-    [rows, n_blk*128] x [n_blk*128, d] PV dot, with a BLOCK-DIAGONAL
-    mask (query rows of cell i see only key columns of cell i, up to
-    the cell's own cache index + the row's step offset). Off-block
-    scores mask to -inf, so after the softmax their probabilities are
-    exactly 0 and the PV dot reduces to the per-cell product — exact,
-    not approximate (pinned against the XLA reference in
+    matrices: one [rows, d] x [d, n_cells*128] score dot and one
+    [rows, n_cells*128] x [n_cells*128, d] PV dot, with a
+    BLOCK-DIAGONAL mask (query rows of cell i see only key columns of
+    cell i, up to the cell's visibility limit + the row's step
+    offset). `lim_fn` supplies that limit — a scalar, or a
+    [1, n_cells*s_blk] per-column row for ragged cells — lazily, so
+    skipped tail steps never compute it. Off-block scores mask to
+    -inf, so after the softmax their probabilities are exactly 0 and
+    the PV dot reduces to the per-cell product — exact, not
+    approximate (pinned against the XLA reference in
     tests/test_decode_stream.py).
 
-    Blocks wholly past every cell's index never reach this body
-    (`pl.when` guard) and never stream (their BlockSpec index clamps to
-    the last visible block, so the pipeline elides the copy).
+    Blocks wholly past every cell's index never reach the fold
+    (`pl.when` guard) and never stream (their BlockSpec index clamps
+    to the last visible block, so the pipeline elides the copy).
 
     K/V/q stay in their storage dtype: the MXU multiplies bf16
     natively with f32 accumulation — an astype(f32) here would spend
     VPU cycles converting the whole cache block and double its vreg
     footprint. The softmax scale is applied to the f32 scores, not
     pre-applied to a bf16 q, which would round the scaled query."""
-    i = pl.program_id(0)
-    j = pl.program_id(1)
-    gs = q_ref.shape[1]  # g * steps rows per cell
+    gs = cell_rows
     d = q_ref.shape[-1]
-    s_blk = k_ref.shape[1]
-    rows = n_blk * gs
-    last = nblk_ref[i] - 1  # last visible cache block for this cell block
+    s_blk = k_ref.shape[-2]
+    rows = n_cells * gs
 
     @pl.when(j == 0)
     def _init():
@@ -193,12 +211,12 @@ def _gqa_stream_kernel(
     def _fold():
         scale = d ** -0.5
         qf = q_ref[...].reshape(rows, d)
-        kf = k_ref[...].reshape(n_blk * s_blk, d)
-        vf = v_ref[...].reshape(n_blk * s_blk, d)
+        kf = k_ref[...].reshape(n_cells * s_blk, d)
+        vf = v_ref[...].reshape(n_cells * s_blk, d)
         sc = jax.lax.dot_general(
             qf, kf, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [rows, n_blk*s_blk] f32
+        ) * scale  # [rows, n_cells*s_blk] f32
         row_ids = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
         col_ids = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
         cell_r = row_ids // gs
@@ -207,18 +225,7 @@ def _gqa_stream_kernel(
         # step offset ((group, step) row order -> offset = row % steps).
         pos = j * s_blk + col_ids - cell_c * s_blk
         off = row_ids % steps if steps > 1 else 0
-        if per_cell:
-            # Ragged decoding: one index per cell. Build the per-column
-            # visibility limit from the prefetched scalars (static
-            # unroll over n_blk; SMEM scalar reads are free next to the
-            # dots).
-            lim = jnp.concatenate([
-                jnp.full((1, s_blk), idx_ref[i * n_blk + c], jnp.int32)
-                for c in range(n_blk)
-            ], axis=1)  # [1, n_blk*s_blk]
-        else:
-            lim = idx_ref[0]
-        visible = (cell_r == cell_c) & (pos <= lim + off)
+        visible = (cell_r == cell_c) & (pos <= lim_fn() + off)
         sc = jnp.where(visible, sc, _NEG_INF)
         m_prev = m_ref[:, :1]  # [rows, 1] (lanes replicated)
         m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
@@ -238,7 +245,38 @@ def _gqa_stream_kernel(
         def _finish():
             o_ref[...] = (
                 acc_new / l_new
-            ).reshape(n_blk, gs, d).astype(o_ref.dtype)
+            ).reshape(o_ref.shape).astype(o_ref.dtype)
+
+
+def _gqa_stream_kernel(
+    n_blk, steps, per_cell, idx_ref, nblk_ref,
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+):
+    """Dense-cache grid step: q/o [n_blk, g*steps, d], k/v
+    [n_blk, _STREAM_BLOCK_S, d] — `_stream_fold` with the visibility
+    limit read per cell from the prefetched index scalars (ragged) or
+    shared by every cell (scalar index)."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    gs = q_ref.shape[1]  # g * steps rows per cell
+    s_blk = k_ref.shape[1]
+
+    def lim():
+        if per_cell:
+            # Ragged decoding: one index per cell. Build the per-column
+            # visibility limit from the prefetched scalars (static
+            # unroll over n_blk; SMEM scalar reads are free next to the
+            # dots).
+            return jnp.concatenate([
+                jnp.full((1, s_blk), idx_ref[i * n_blk + c], jnp.int32)
+                for c in range(n_blk)
+            ], axis=1)  # [1, n_blk*s_blk]
+        return idx_ref[0]
+
+    _stream_fold(
+        j, nblk_ref[i] - 1, lim, n_blk, gs, steps,
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -351,5 +389,166 @@ def decode_attention(
     out = _gqa_pallas(
         q[:, :, None, :] if single else q, k, v, index,
         interpret=interpret,
+    )
+    return out[:, :, 0] if single else out
+
+
+# -- paged (block-pool) decode attention ------------------------------
+
+# Rows per physical cache block — the paged pool's allocation quantum.
+# Identical to the stream block on purpose: one block table entry is
+# one kernel grid step, so the allocator's granularity IS the skip
+# granularity.
+PAGE_ROWS = _STREAM_BLOCK_S
+
+
+def gather_paged_cache(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Materialize a slot-major dense cache view from a block pool.
+
+    pool: [num_blocks, kv_heads, PAGE_ROWS, head_dim]; table:
+    [batch, max_logical_blocks] int physical-block ids. Returns
+    [batch, kv_heads, max_logical_blocks * PAGE_ROWS, head_dim] — the
+    shape the dense reference/prefill paths expect. A COPY (it defeats
+    the paging win); reference and wide-prefill use only.
+    """
+    b, nlog = table.shape
+    _, kvh, rows, d = pool.shape
+    gathered = pool[table]  # [b, nlog, kvh, rows, d]
+    return gathered.transpose(0, 2, 1, 3, 4).reshape(
+        b, kvh, nlog * rows, d
+    )
+
+
+def paged_decode_attention_reference(
+    q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+    table: jax.Array, index: jax.Array,
+) -> jax.Array:
+    """XLA reference for the paged path: gather each slot's blocks into
+    a dense view, then plain masked cache attention. Positions past a
+    slot's index are masked exactly as in the dense reference, so
+    whatever unreferenced pool blocks hold is invisible."""
+    return decode_attention_reference(
+        q,
+        gather_paged_cache(k_pool, table),
+        gather_paged_cache(v_pool, table),
+        index,
+    )
+
+
+def _paged_stream_kernel(
+    kvh, steps, idx_ref, nblk_ref, tbl_ref,
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+):
+    """One (slot, logical-cache-block) grid step of the paged kernel.
+
+    `_stream_fold` with the cell block fixed to one SLOT: its kvh
+    cells share one cache index (a single scalar visibility limit)
+    and one physical block, delivered by the table-indexed BlockSpec.
+    q_ref [1, kvh, g*steps, d], k/v_ref [1, kvh, PAGE_ROWS, d].
+    `tbl_ref` is consumed by the BlockSpec index maps, not the body.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    _stream_fold(
+        j, nblk_ref[i] - 1, lambda: idx_ref[i], kvh, q_ref.shape[2],
+        steps, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_pallas(q, k_pool, v_pool, table, index, interpret=False):
+    """q: [b, h, steps, d]; k/v_pool: [nb, kvh, PAGE_ROWS, d]; table:
+    [b, max_logical_blocks] int32; index: [b] int32."""
+    nb, kvh, s_blk, d = k_pool.shape
+    b, h, steps = q.shape[0], q.shape[1], q.shape[2]
+    g = h // kvh
+    gs = g * steps
+    nlog = table.shape[1]
+    rows = kvh * gs
+    idx_arr = index.astype(jnp.int32)
+    # Visible logical blocks per slot (highest query position is
+    # index + steps - 1), clamped to the table width — freed serving
+    # slots keep stepping with index past their logical capacity
+    # (models/serve.py parks their table rows on the scratch block).
+    nblk_arr = jnp.minimum(
+        (idx_arr + steps - 1) // s_blk + 1, nlog
+    ).astype(jnp.int32)
+    tbl_arr = table.astype(jnp.int32).reshape(-1)  # [b * nlog]
+    qr = q.reshape(b, kvh, g, steps, d).reshape(b, kvh, gs, d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, nlog),
+        in_specs=[
+            pl.BlockSpec(
+                (1, kvh, gs, d), lambda i, j, idx, nb_, tb: (i, 0, 0, 0)
+            ),
+            # The gather-indexed grid: logical block j of slot i
+            # streams PHYSICAL pool block table[i, j]. Tail blocks
+            # clamp the table LOOKUP to the last visible logical
+            # block — consecutive grid steps then fetch the same
+            # physical block and the pipeline elides the copy.
+            pl.BlockSpec(
+                (1, kvh, s_blk, d),
+                lambda i, j, idx, nb_, tb: (
+                    tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0, 0
+                ),
+            ),
+            pl.BlockSpec(
+                (1, kvh, s_blk, d),
+                lambda i, j, idx, nb_, tb: (
+                    tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0, 0
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, kvh, gs, d), lambda i, j, idx, nb_, tb: (i, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 128), jnp.float32),  # running max
+            pltpu.VMEM((rows, 128), jnp.float32),  # running sum
+            pltpu.VMEM((rows, d), jnp.float32),    # running PV acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_stream_kernel, kvh, steps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, gs, d), q.dtype),
+        interpret=interpret,
+    )(idx_arr, nblk_arr, tbl_arr, qr, k_pool, v_pool)
+    return out.reshape(b, kvh, g, steps, d).reshape(b, h, steps, d)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    table: jax.Array,
+    index: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused decode attention over a PAGED KV cache.
+
+    q: [batch, heads, head_dim] or [batch, heads, steps, head_dim];
+    k/v_pool: [num_blocks, kv_heads, PAGE_ROWS, head_dim] — the shared
+    physical block pool; table: [batch, max_logical_blocks] int32
+    physical block ids (logical block j of slot b lives in pool block
+    table[b, j]); index: [batch] int32 per-slot cache index. Every
+    table entry must be a valid pool block id (the serving engine
+    parks idle slots on a reserved scratch block). Uses the streamed
+    Pallas kernel with the table-indexed grid on TPU (or interpret
+    mode via the argument / WALKAI_DECODE_INTERPRET=1); falls back to
+    the gather-based XLA reference otherwise.
+    """
+    if interpret is None:
+        interpret = os.environ.get("WALKAI_DECODE_INTERPRET") == "1"
+        if not interpret and jax.default_backend() != "tpu":
+            return paged_decode_attention_reference(
+                q, k_pool, v_pool, table, index
+            )
+    single = q.ndim == 3
+    out = _paged_pallas(
+        q[:, :, None, :] if single else q, k_pool, v_pool,
+        table, index, interpret=interpret,
     )
     return out[:, :, 0] if single else out
