@@ -4,7 +4,7 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check metrics-lint dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
@@ -48,6 +48,12 @@ bench: native
 # and on cb_ttft_p99 inflating past its band).
 bench-check:
 	python hack/bench_check.py
+
+# Metrics/docs drift gate: every metric in obs/catalog.py documented in
+# docs/observability.md (and vice versa), no literal registrations
+# outside the catalog. Also tier-1 via tests/test_metrics_lint.py.
+metrics-lint:
+	python hack/metrics_lint.py
 
 dryrun:
 	python __graft_entry__.py

@@ -581,6 +581,18 @@ def cb_serving_benchmark() -> dict:
     return measure_cb_serving()
 
 
+def obs_overhead_benchmark() -> dict:
+    """Telemetry overhead gate: the same engine-direct workload with
+    the obs subsystem enabled vs disabled
+    (`bench_lm.measure_obs_overhead`). `obs_overhead_pct` is a
+    headline key gated < 2% by `make bench-check` — instrumentation
+    is production-default, so its cost is a regression surface like
+    any other."""
+    from bench_lm import measure_obs_overhead
+
+    return measure_obs_overhead()
+
+
 def main() -> None:
     result: dict = {}
     err = None
@@ -597,6 +609,10 @@ def main() -> None:
         result.update(cb_serving_benchmark())
     except Exception as e:
         err = (err + "; " if err else "") + f"cb-serving: {e}"
+    try:
+        result.update(obs_overhead_benchmark())
+    except Exception as e:
+        err = (err + "; " if err else "") + f"obs-overhead: {e}"
     try:
         result.update(scheduling_benchmark())
     except Exception as e:
@@ -615,7 +631,7 @@ def main() -> None:
             "decode_gqa_roofline_fraction", "decode_tokens_per_dispatch",
             "cb_vs_serial_speedup", "cb_ttft_p50", "cb_token_p99",
             "cb_serving_capacity_tokens_per_s", "cb_admission_stall_ms",
-            "cb_kv_hbm_bytes_per_resident_token",
+            "cb_kv_hbm_bytes_per_resident_token", "obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )
         if k in result

@@ -31,7 +31,16 @@ Endpoints:
 - GET  /stats  -> cumulative fenced {images, requests, batches, flops,
   monotonic_s} + {device_kind, peak_bf16_flops,
   model_ceiling_images_per_s, fence_rtt_s} for utilization measurement.
-- GET  /healthz for probes.
+- GET  /healthz -> readiness payload: {"ok": true, "engine": {alive,
+  queue_depth, seconds_since_last_dispatch, has_work, slots} | null}
+  (engine block present when continuous batching is enabled).
+- GET  /metrics -> Prometheus text exposition of the obs registry
+  (serving-engine dispatch/TTFT/TPOT/pool telemetry; see
+  docs/observability.md for every exported name).
+- GET  /debug/trace -> Chrome trace-event JSON of recent request
+  lifecycles (load into chrome://tracing or Perfetto).
+- GET/POST /debug/profile -> jax.profiler capture-window status / arm
+  ({"dispatches": N, "logdir": ...}).
 
 Env knobs: WALKAI_MAX_BATCH (default 32), WALKAI_BATCH_WINDOW_MS
 (default 2.0), WALKAI_WARM_BUCKETS (comma list, default "1,8,32"),
@@ -149,6 +158,25 @@ class _Stats:
                 "device_starved_s": starved,
                 "monotonic_s": now,
             }
+
+
+def engine_health(engine, alive: bool) -> dict | None:
+    """The /healthz readiness payload's engine block: liveness of the
+    driver loop plus the two "is it actually moving" signals a probe
+    or an operator wants first — queue depth and staleness of the last
+    dispatch. None when continuous batching is not enabled."""
+    if engine is None:
+        return None
+    age = engine.seconds_since_last_dispatch
+    return {
+        "alive": bool(alive),
+        "queue_depth": engine.queue_depth,
+        "seconds_since_last_dispatch": (
+            None if age is None else round(age, 3)
+        ),
+        "has_work": engine.has_work,
+        "slots": engine.slots,
+    }
 
 
 def _bucket(n: int, max_batch: int) -> int:
@@ -325,6 +353,15 @@ def main() -> None:
     lm_max_new = int(os.environ.get("WALKAI_LM_MAX_NEW", "64"))
     cb_engine = cb_queue = None
     cb_slots = cb_bucket = 0
+    cb_enabled = [False]
+    # Telemetry bundle (walkai_nos_tpu/obs): the registry behind
+    # /metrics, the lifecycle trace behind /debug/trace, and the
+    # jax.profiler hook behind /debug/profile. WALKAI_OBS=0 builds the
+    # no-op bundle (the disabled arm of the bench's obs_overhead_pct
+    # measurement).
+    from walkai_nos_tpu.obs import ServingObs
+
+    obs = ServingObs(enabled=os.environ.get("WALKAI_OBS", "1") == "1")
     if os.environ.get("WALKAI_DEMO_LM") == "1":
         from walkai_nos_tpu.models.decode import make_generate_fn
         from walkai_nos_tpu.models.lm import LM_TINY, LM_SMALL, DecoderLM
@@ -430,20 +467,26 @@ def main() -> None:
                 prefill_chunk=int(
                     os.environ.get("WALKAI_CB_PFCHUNK", "64")
                 ),
+                obs=obs,
             )
             # Compile prefill + chunk step off the request path.
             cb_engine.submit([1], max_new_tokens=min(2, lm_max_new))
             cb_engine.run()
             cb_queue = queue.Queue()
             cb_waiters: dict[int, dict] = {}
-            cb_enabled = [True]
+            cb_enabled[0] = True
 
             def cb_fail_waiter(holder, error=None) -> None:
                 """Failure notification, one definition: tokens=None
                 (the handlers' failure marker), optional error text,
-                end-of-stream sentinel for SSE waiters, then wake."""
+                end-of-stream sentinel for SSE waiters, then wake.
+                Engine-death failures (no error text: the submit-time
+                rejects count themselves) land in the error taxonomy
+                as engine_failure."""
                 if error is not None:
                     holder["error"] = error
+                else:
+                    obs.errors.inc(labels={"reason": "engine_failure"})
                 holder["tokens"] = None
                 if holder.get("queue") is not None:
                     holder["queue"].put(None)
@@ -651,6 +694,26 @@ def main() -> None:
             if self.path == "/generate":
                 self._generate()
                 return
+            if self.path == "/debug/profile":
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    # Malformed JSON and non-object bodies are client
+                    # errors too (JSONDecodeError is a ValueError).
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                    obs.profile.arm(
+                        int(body.get("dispatches", 20)),
+                        body.get("logdir")
+                        or os.environ.get(
+                            "WALKAI_PROFILE_DIR", "/tmp/walkai-profile"
+                        ),
+                    )
+                except (TypeError, ValueError, RuntimeError) as e:
+                    self.send_error(400, str(e))
+                    return
+                self._json(200, obs.profile.status())
+                return
             if self.path != "/infer":
                 self.send_error(404)
                 return
@@ -796,6 +859,9 @@ def main() -> None:
                         self.send_error(503, "batch engine failed; retry")
                         return
                     if time.perf_counter() - t0 > 120.0:
+                        obs.errors.inc(
+                            labels={"reason": "generation_timeout"}
+                        )
                         self.send_error(503, "generation timed out")
                         return
                 if waiter["tokens"] is None:
@@ -805,23 +871,33 @@ def main() -> None:
                     self.send_error(503, "batch engine failed; retry")
                     return
                 dt = time.perf_counter() - t0
-                self._json(200, {
-                    "tokens": waiter["tokens"],
-                    "generate_time_seconds": round(dt, 6),
-                    "ttft_seconds": round(waiter.get("ttft_s", 0.0), 6),
-                    # Engine-side wall (submit -> done, same clock
-                    # origin as ttft_seconds): lets clients separate
-                    # queueing from decode pace.
-                    "engine_wall_seconds": round(
-                        waiter.get("wall_s", 0.0), 6
-                    ),
-                    "tokens_per_second": round(
-                        len(waiter["tokens"]) / dt, 1
-                    ),
-                    "slice": slice_id,
-                    "batched": True,
-                    "cb_slots": cb_slots,
-                })
+                try:
+                    self._json(200, {
+                        "tokens": waiter["tokens"],
+                        "generate_time_seconds": round(dt, 6),
+                        "ttft_seconds": round(
+                            waiter.get("ttft_s", 0.0), 6
+                        ),
+                        # Engine-side wall (submit -> done, same clock
+                        # origin as ttft_seconds): lets clients
+                        # separate queueing from decode pace.
+                        "engine_wall_seconds": round(
+                            waiter.get("wall_s", 0.0), 6
+                        ),
+                        "tokens_per_second": round(
+                            len(waiter["tokens"]) / dt, 1
+                        ),
+                        "slice": slice_id,
+                        "batched": True,
+                        "cb_slots": cb_slots,
+                    })
+                except (BrokenPipeError, ConnectionResetError):
+                    # Client gave up before the response: the work was
+                    # done and discarded — that's a served-for-nothing
+                    # request the error mix must show.
+                    obs.errors.inc(
+                        labels={"reason": "client_disconnect"}
+                    )
                 return
             arr = jnp.asarray([prompt], jnp.int32)
             # Serialized: one generation at a time keeps decode latency
@@ -890,6 +966,9 @@ def main() -> None:
                         self.send_error(503, "batch engine failed; retry")
                         return
                     if time.perf_counter() - t0 > 120.0:
+                        obs.errors.inc(
+                            labels={"reason": "generation_timeout"}
+                        )
                         self.send_error(503, "generation timed out")
                         return
             if item is None and waiter.get("error"):
@@ -942,16 +1021,40 @@ def main() -> None:
                                 })
                                 return
                             if time.perf_counter() - t0 > 120.0:
+                                obs.errors.inc(
+                                    labels={
+                                        "reason": "generation_timeout"
+                                    }
+                                )
                                 event({"error": "generation timed out"})
                                 return
             except (BrokenPipeError, ConnectionResetError):
                 # Client went away mid-stream: the engine finishes the
-                # request on its own; nothing to clean up here.
-                pass
+                # request on its own; nothing to clean up here beyond
+                # recording the disconnect in the error taxonomy.
+                obs.errors.inc(labels={"reason": "client_disconnect"})
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._json(200, {"ok": True})
+                # Readiness, not bare liveness: a probe (or operator)
+                # sees whether the engine loop is alive and moving.
+                self._json(200, {
+                    "ok": True,
+                    "engine": engine_health(cb_engine, cb_enabled[0]),
+                })
+            elif self.path == "/metrics":
+                data = obs.registry.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif self.path == "/debug/trace":
+                self._json(200, obs.trace.chrome_trace())
+            elif self.path == "/debug/profile":
+                self._json(200, obs.profile.status())
             elif self.path == "/stats":
                 payload = {**stats.snapshot(), **device_info}
                 if cb_engine is not None:

@@ -18,6 +18,12 @@ default 25% band) or a spec:
 - value null: baseline not yet recorded (the key postdates the last
   recorded round) — skipped with a note, never a failure, so new
   metrics can be declared before a chip run exists to anchor them.
+- "absent_ok": true — a BUDGET key (e.g. obs_overhead_pct's absolute
+  < 2% ceiling with tolerance 0): when the key is missing from the
+  bench output (the recorded artifact predates the key), skip with a
+  note instead of failing; once a bench run emits it, the band is
+  enforced like any other. This is how an absolute gate ships before
+  the next chip run records a measurement.
 """
 
 from __future__ import annotations
@@ -40,10 +46,12 @@ def check(
     notes: list[str] = []
     published = baseline.get("published") or {}
     for key, spec in sorted(published.items()):
+        absent_ok = False
         if isinstance(spec, dict):
             base = spec.get("value")
             direction = spec.get("direction", "higher")
             tol = spec.get("tolerance", tolerance)
+            absent_ok = bool(spec.get("absent_ok", False))
         else:
             base, direction, tol = spec, "higher", tolerance
         if base is None:
@@ -51,6 +59,12 @@ def check(
             continue
         got = bench.get(key)
         if not isinstance(got, (int, float)):
+            if absent_ok:
+                notes.append(
+                    f"{key}: absent from bench output — skipped "
+                    f"(absent_ok budget key; enforced once emitted)"
+                )
+                continue
             failures.append(
                 f"{key}: missing from bench output "
                 f"(baseline {base}, {direction} is better)"

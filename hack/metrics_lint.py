@@ -1,0 +1,138 @@
+"""Metrics/docs drift gate (`make metrics-lint`, tier-1 via
+tests/test_metrics_lint.py).
+
+Holds three surfaces to one truth:
+
+1. `walkai_nos_tpu/obs/catalog.py` — every metric the repo exports,
+   declared once (name, type, labels, help);
+2. `docs/observability.md` — the human-facing reference: every
+   catalog metric must appear as a table row (| `name` | type | ...)
+   with the SAME type, and every documented row must exist in the
+   catalog — renames and additions fail in BOTH directions;
+3. the code itself — a literal-registration scan over walkai_nos_tpu/
+   and demos/ (`.counter("..."` / `.gauge("..."` / `.histogram("..."`
+   / `counter_add("..."` / `gauge_set("..."`): any literal metric
+   name not in the catalog is an undeclared metric and fails. (The
+   serving engine registers through the catalog itself, so it cannot
+   drift by construction; this catches ad-hoc registrations
+   elsewhere.)
+
+Exit 0 = clean; prints each violation otherwise. Stdlib + the
+dependency-free catalog module only.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_ROOT))
+
+from walkai_nos_tpu.obs.catalog import CATALOG  # noqa: E402
+
+DOC = _ROOT / "docs" / "observability.md"
+
+# A documented metric row: | `name` | type | ...
+_DOC_ROW = re.compile(
+    r"^\|\s*`([A-Za-z_:][A-Za-z0-9_:]*)`\s*\|"
+    r"\s*(counter|gauge|histogram)\s*\|"
+)
+
+# Literal registrations (the registry API and the health.Metrics
+# adapter API). \s* spans newlines: call sites often wrap.
+_CODE_PATTERNS = (
+    re.compile(r'\.counter\(\s*"([^"]+)"'),
+    re.compile(r'\.gauge\(\s*"([^"]+)"'),
+    re.compile(r'\.histogram\(\s*"([^"]+)"'),
+    re.compile(r'\bcounter_add\(\s*"([^"]+)"'),
+    re.compile(r'\bgauge_set\(\s*"([^"]+)"'),
+)
+
+_SCAN_DIRS = ("walkai_nos_tpu", "demos")
+# Test fixtures register throwaway names on purpose; the registry and
+# adapter implementations pass variables, not literals, but skip them
+# anyway so an inline example in a docstring can't trip the scan.
+_SCAN_SKIP = ("obs/metrics.py", "health.py")
+
+
+def documented_metrics(doc_text: str) -> dict[str, str]:
+    """name -> documented type, from the markdown tables."""
+    out: dict[str, str] = {}
+    for line in doc_text.splitlines():
+        m = _DOC_ROW.match(line.strip())
+        if m:
+            out[m.group(1)] = m.group(2)
+    return out
+
+
+def registered_literals(root: Path = _ROOT) -> dict[str, list[str]]:
+    """literal metric name -> files registering it."""
+    out: dict[str, list[str]] = {}
+    for sub in _SCAN_DIRS:
+        for path in sorted((root / sub).rglob("*.py")):
+            rel = str(path.relative_to(root))
+            if any(rel.endswith(skip) for skip in _SCAN_SKIP):
+                continue
+            text = path.read_text()
+            for pattern in _CODE_PATTERNS:
+                for name in pattern.findall(text):
+                    out.setdefault(name, []).append(rel)
+    return out
+
+
+def lint(
+    doc_text: str, code_names: dict[str, list[str]] | None = None
+) -> list[str]:
+    """The testable core: violations as strings (empty = clean)."""
+    errors: list[str] = []
+    documented = documented_metrics(doc_text)
+    catalog = {spec.name: spec for spec in CATALOG}
+
+    for name, spec in sorted(catalog.items()):
+        doc_kind = documented.get(name)
+        if doc_kind is None:
+            errors.append(
+                f"catalog metric not documented in "
+                f"docs/observability.md: {name} ({spec.kind})"
+            )
+        elif doc_kind != spec.kind:
+            errors.append(
+                f"type mismatch for {name}: catalog says {spec.kind}, "
+                f"docs say {doc_kind}"
+            )
+    for name in sorted(set(documented) - set(catalog)):
+        errors.append(
+            f"documented metric not in obs/catalog.py: {name} "
+            f"(remove the row or declare it)"
+        )
+    for name, files in sorted((code_names or {}).items()):
+        if name not in catalog:
+            errors.append(
+                f"literal metric registration not in obs/catalog.py: "
+                f"{name} ({', '.join(sorted(set(files)))})"
+            )
+    return errors
+
+
+def main(argv=None) -> int:
+    doc_text = DOC.read_text() if DOC.is_file() else ""
+    if not doc_text:
+        print(f"missing {DOC}")
+        return 1
+    errors = lint(doc_text, registered_literals())
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"{len(errors)} metrics-lint problem(s)")
+        return 1
+    print(
+        f"metrics-lint OK: {len(CATALOG)} catalog metrics documented, "
+        f"no undeclared registrations"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

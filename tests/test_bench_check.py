@@ -68,6 +68,41 @@ class TestCheckLogic:
             for f in failures
         )
 
+    def test_absent_ok_budget_key(self):
+        """A budget key (absolute ceiling, e.g. obs_overhead_pct < 2%)
+        ships before the recorded artifact emits it: missing-from-bench
+        is a skip note, but once emitted the band is enforced with
+        tolerance 0."""
+        base = {
+            "published": {
+                "obs_overhead_pct": {
+                    "value": 2.0, "direction": "lower",
+                    "tolerance": 0.0, "absent_ok": True,
+                },
+            }
+        }
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert any("absent" in n for n in notes)
+        failures, _ = bench_check.check({"obs_overhead_pct": 1.4}, base)
+        assert failures == []
+        # Negative overhead (noise floor: obs-on measured faster) is
+        # fine — the budget only caps the upside.
+        failures, _ = bench_check.check({"obs_overhead_pct": -0.3}, base)
+        assert failures == []
+        failures, _ = bench_check.check({"obs_overhead_pct": 2.6}, base)
+        assert failures and "obs_overhead_pct" in failures[0]
+
+    def test_repo_baseline_gates_obs_overhead(self):
+        """The committed BASELINE.json actually carries the obs
+        overhead budget the observability PR promises."""
+        with open(_ROOT / "BASELINE.json") as f:
+            spec = json.load(f)["published"]["obs_overhead_pct"]
+        assert spec["value"] == 2.0
+        assert spec["direction"] == "lower"
+        assert spec["tolerance"] == 0.0
+        assert spec["absent_ok"] is True
+
     def test_bare_number_baseline_defaults_higher(self):
         failures, _ = bench_check.check(
             {"x": 70.0}, {"published": {"x": 100.0}}

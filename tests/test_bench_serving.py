@@ -71,6 +71,24 @@ def test_cb_serving_benchmark_runs_end_to_end(monkeypatch):
     assert r["cb_admission_stall_ms"] >= 0
     assert r["cb_kv_hbm_bytes_per_resident_token"] > 0
     assert r["cb_kv_paged"] is True
+    # Observability acceptance: the TTFT p99 read from the server's
+    # /metrics histogram (bucket delta over the window) agrees with
+    # the record-derived p99 within one log-bucket width.
+    from walkai_nos_tpu.obs.catalog import CATALOG
+
+    bounds = next(
+        s.buckets for s in CATALOG if s.name == "cb_ttft_seconds"
+    )
+    got = r["cb_ttft_p99_from_metrics"]
+    assert got is not None
+    expect_idx = next(
+        (i for i, b in enumerate(bounds) if b >= r["cb_ttft_p99"]),
+        len(bounds) - 1,
+    )
+    assert got in bounds
+    assert abs(bounds.index(got) - expect_idx) <= 1, (
+        got, r["cb_ttft_p99"]
+    )
     # And they are headline keys in bench.py's emitted line (they
     # must survive driver-side tail truncation).
     import inspect
@@ -122,6 +140,28 @@ def test_decode_bench_emits_roofline_fields(monkeypatch):
     src = inspect.getsource(bench.main)
     assert "decode_gqa_roofline_fraction" in src
     assert "decode_tokens_per_dispatch" in src
+
+
+def test_obs_overhead_measure_runs_end_to_end(monkeypatch):
+    """The telemetry-overhead A/B (`bench_lm.measure_obs_overhead`,
+    the obs_overhead_pct headline key gated < 2% by bench-check) must
+    execute on the tiny CPU model — the VALUES are machine noise here;
+    the field contract and the enabled/disabled engine paths are what
+    CI pins."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from bench_lm import measure_obs_overhead
+    from walkai_nos_tpu.models.lm import LM_TINY
+
+    r = measure_obs_overhead(
+        slots=2, n_requests=4, prompt_len=4, new_tokens=6,
+        chunk_steps=2, repeats=1, cfg=LM_TINY,
+    )
+    assert set(r) >= {
+        "obs_overhead_pct", "obs_on_tokens_per_s",
+        "obs_off_tokens_per_s", "obs_overhead_repeats",
+    }
+    assert r["obs_on_tokens_per_s"] > 0
+    assert r["obs_off_tokens_per_s"] > 0
 
 
 def test_serving_benchmark_runs_end_to_end(bench_mod):

@@ -92,7 +92,9 @@ class TestHealthServer:
             )
             server.metrics.gauge_set("nos_free_slices", 3)
             body = urllib.request.urlopen(f"{base}/metrics").read().decode()
-            assert 'nos_reconcile_total{controller="partitioner"} 2.0' in body
+            # Integral values render bare (the unified obs registry's
+            # Go-client-style formatting; "2.0" was the old adapter's).
+            assert 'nos_reconcile_total{controller="partitioner"} 2' in body
             assert "nos_free_slices 3" in body
         finally:
             server.stop()

@@ -74,6 +74,12 @@ class TestDemoServer:
         assert s1["fence_rtt_s"] >= 0
         assert s1["flops_per_image"] > 0
 
+    def test_healthz_without_engine(self, server):
+        # Vision-only server: readiness payload present, engine null.
+        h = get_json(f"{server}/healthz")
+        assert h["ok"] is True
+        assert h["engine"] is None
+
 
 class TestGenerateEndpoint:
     @pytest.fixture(scope="class")
@@ -376,3 +382,80 @@ class TestContinuousBatchingEndpoint:
         assert status == 200
         assert out.get("batched") is True
         assert len(out["tokens"]) > 0
+
+    def test_healthz_readiness_payload(self, cb_server):
+        """/healthz is a readiness payload, not a bare liveness bit:
+        engine alive + queue depth + dispatch staleness."""
+        self._post(cb_server, {"prompt": [1, 2, 3]})  # ensure dispatches
+        h = get_json(f"{cb_server}/healthz")
+        assert h["ok"] is True
+        eng = h["engine"]
+        assert eng["alive"] is True
+        assert eng["slots"] == 2
+        assert isinstance(eng["queue_depth"], int)
+        assert eng["seconds_since_last_dispatch"] >= 0
+        assert isinstance(eng["has_work"], bool)
+
+    def test_metrics_prometheus_exposition(self, cb_server):
+        """/metrics serves valid Prometheus text with the serving
+        registry's series after traffic."""
+        import re
+        import urllib.request
+
+        self._post(cb_server, {"prompt": [1, 2, 3]})
+        with urllib.request.urlopen(
+            f"{cb_server}/metrics", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            text = resp.read().decode()
+        assert "# TYPE cb_requests_submitted_total counter" in text
+        assert "# TYPE cb_ttft_seconds histogram" in text
+        assert 'cb_ttft_seconds_bucket{le="+Inf"}' in text
+        sample = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? -?[0-9.eE+-]+$"
+        )
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert sample.match(line), line
+        # The engine-side stats endpoints are views of these series.
+        stats = get_json(f"{cb_server}/stats")
+        assert stats["cb_occupancy"]["total_slot_steps"] > 0
+
+    def test_debug_trace_chrome_export(self, cb_server):
+        _, out = self._post(cb_server, {"prompt": [1, 2, 3, 4]})
+        assert out.get("batched") is True
+        trace = get_json(f"{cb_server}/debug/trace")
+        events = trace["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"queued", "decode"} <= names
+        for e in events:
+            assert e["ph"] in ("X", "i", "M")
+
+    def test_debug_profile_status_and_arm_validation(self, cb_server):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        status = get_json(f"{cb_server}/debug/profile")
+        assert status["active"] is False
+        # Arming with a bad window, malformed JSON, or a non-object
+        # body is a 400, not a server error.
+        for payload in (
+            _json.dumps({"dispatches": 0}).encode(),
+            b"not json at all",
+            b"5",
+        ):
+            req = urllib.request.Request(
+                f"{cb_server}/debug/profile",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raised = None
+            except urllib.error.HTTPError as e:
+                raised = e.code
+            assert raised == 400, payload

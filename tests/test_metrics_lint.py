@@ -1,0 +1,91 @@
+"""`make metrics-lint` (hack/metrics_lint.py): the catalog/docs drift
+gate must pass on the repo's own current files and fail on every
+synthetic drift direction — a broken linter would wave undocumented
+metrics through silently, so the logic itself is tier-1 (mirroring
+tests/test_bench_check.py for the bench gate)."""
+
+import importlib.util
+import pathlib
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+spec = importlib.util.spec_from_file_location(
+    "metrics_lint", _ROOT / "hack" / "metrics_lint.py"
+)
+metrics_lint = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(metrics_lint)
+
+
+def _doc_text() -> str:
+    return (_ROOT / "docs" / "observability.md").read_text()
+
+
+class TestRepoIsClean:
+    def test_lint_passes_on_repo(self):
+        errors = metrics_lint.lint(
+            _doc_text(), metrics_lint.registered_literals()
+        )
+        assert errors == [], errors
+
+    def test_main_exit_zero(self):
+        assert metrics_lint.main([]) == 0
+
+    def test_every_catalog_metric_documented(self):
+        from walkai_nos_tpu.obs.catalog import CATALOG
+
+        documented = metrics_lint.documented_metrics(_doc_text())
+        for spec_ in CATALOG:
+            assert documented.get(spec_.name) == spec_.kind, spec_.name
+
+    def test_makefile_has_target(self):
+        assert "metrics-lint:" in (_ROOT / "Makefile").read_text()
+
+
+class TestDriftDirections:
+    def test_undocumented_catalog_metric_fails(self):
+        # Remove one documented row: the catalog->docs direction.
+        doc = _doc_text().replace("`cb_ttft_seconds`", "`renamed_away`")
+        errors = metrics_lint.lint(doc)
+        assert any(
+            "cb_ttft_seconds" in e and "not documented" in e
+            for e in errors
+        )
+        # ...and the stale row trips the docs->catalog direction.
+        assert any("renamed_away" in e for e in errors)
+
+    def test_documented_but_unregistered_fails(self):
+        doc = _doc_text() + (
+            "\n| `ghost_metric_total` | counter | — | not real |\n"
+        )
+        errors = metrics_lint.lint(doc)
+        assert any(
+            "ghost_metric_total" in e and "not in obs/catalog" in e
+            for e in errors
+        )
+
+    def test_type_mismatch_fails(self):
+        doc = _doc_text().replace(
+            "| `cb_queue_depth` | gauge |",
+            "| `cb_queue_depth` | counter |",
+        )
+        errors = metrics_lint.lint(doc)
+        assert any(
+            "cb_queue_depth" in e and "mismatch" in e for e in errors
+        )
+
+    def test_literal_registration_outside_catalog_fails(self):
+        errors = metrics_lint.lint(
+            _doc_text(),
+            {"rogue_total": ["walkai_nos_tpu/somewhere.py"]},
+        )
+        assert any(
+            "rogue_total" in e and "somewhere.py" in e for e in errors
+        )
+
+    def test_code_scan_finds_known_literals(self):
+        """The scan must actually see the kube/runtime.py and demo
+        client registrations (a regex regression would quietly turn
+        the third lint leg off)."""
+        names = metrics_lint.registered_literals()
+        assert "nos_reconcile_total" in names
+        assert "inference_time_seconds_sum" in names

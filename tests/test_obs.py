@@ -1,0 +1,683 @@
+"""Telemetry subsystem (`walkai_nos_tpu/obs/`): registry semantics,
+histogram bucket boundaries, ring-buffer wraparound, Prometheus
+exposition format, profile-hook gating — and the contract that makes
+the trace trustworthy: per-request ttft/wall reconstructed from
+lifecycle spans equal `drain_done_records()` EXACTLY, and the
+engine's `occupancy()`/`kv_stats()` dicts are views of the same
+registry `/metrics` exports."""
+
+import re
+
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.obs.metrics import (
+    Registry,
+    log_buckets,
+)
+from walkai_nos_tpu.obs.profile import ProfileHook
+from walkai_nos_tpu.obs.serving import ServingObs
+from walkai_nos_tpu.obs.trace import RequestTrace, Ring
+
+
+class TestLogBuckets:
+    def test_geometric_and_covering(self):
+        b = log_buckets(1e-3, 100.0, per_decade=3)
+        assert b[0] == 1e-3
+        assert b[-1] >= 100.0
+        assert list(b) == sorted(b)
+        # Constant ratio ~10^(1/3): every adjacent pair within 10%
+        # of it (bounds snap to 4 significant digits).
+        ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+        for r in ratios:
+            assert abs(r - 10 ** (1 / 3)) / 10 ** (1 / 3) < 0.1
+
+    def test_invalid_args_raise(self):
+        with pytest.raises(ValueError):
+            log_buckets(0, 1.0)
+        with pytest.raises(ValueError):
+            log_buckets(1.0, 0.5)
+        with pytest.raises(ValueError):
+            log_buckets(1.0, 2.0, per_decade=0)
+
+
+class TestHistogram:
+    def _hist(self, bounds=(1.0, 2.0, 4.0, 8.0)):
+        reg = Registry()
+        return reg, reg.histogram("h_seconds", "t", buckets=bounds)
+
+    def test_bucket_boundaries_le_inclusive(self):
+        """Prometheus `le` semantics: a sample exactly ON a bound
+        lands in that bucket, just above goes to the next."""
+        reg, h = self._hist()
+        h.observe(2.0)   # == bound 2 -> bucket le=2
+        h.observe(2.001)  # -> bucket le=4
+        h.observe(0.0)   # below first bound -> bucket le=1
+        text = reg.render()
+        assert 'h_seconds_bucket{le="1"} 1' in text
+        assert 'h_seconds_bucket{le="2"} 2' in text  # cumulative
+        assert 'h_seconds_bucket{le="4"} 3' in text
+        assert 'h_seconds_bucket{le="8"} 3' in text
+        assert 'h_seconds_bucket{le="+Inf"} 3' in text
+
+    def test_overflow_counts_only_inf(self):
+        reg, h = self._hist()
+        h.observe(9.5)
+        assert h.count() == 1
+        assert h.sum() == 9.5
+        text = reg.render()
+        assert 'h_seconds_bucket{le="8"} 0' in text
+        assert 'h_seconds_bucket{le="+Inf"} 1' in text
+        assert "h_seconds_count 1" in text
+
+    def test_quantile_within_one_bucket(self):
+        _, h = self._hist()
+        for v in (0.5, 1.5, 3.0, 7.0):
+            h.observe(v)
+        assert h.quantile(0.0) == 1.0
+        assert h.quantile(0.5) == 2.0   # rank 2 of 4 -> le=2 bucket
+        assert h.quantile(1.0) == 8.0
+        # Every estimate is the upper bound of the sample's bucket:
+        # exact to within one bucket width.
+        assert h.quantile(0.25) == 1.0
+
+    def test_quantile_overflow_clamps_to_last_bound(self):
+        _, h = self._hist()
+        h.observe(100.0)
+        assert h.quantile(0.99) == 8.0
+
+    def test_quantile_empty_and_invalid(self):
+        _, h = self._hist()
+        assert h.quantile(0.5) is None
+        h.observe(1.0)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_unsorted_buckets_rejected(self):
+        reg = Registry()
+        with pytest.raises(ValueError):
+            reg.histogram("bad_seconds", "t", buckets=(2.0, 1.0))
+
+
+class TestRing:
+    def test_wraparound_keeps_newest_in_order(self):
+        r = Ring(4)
+        for i in range(10):
+            r.append(i)
+        assert r.snapshot() == [6, 7, 8, 9]
+        assert r.dropped == 6
+        assert len(r) == 4
+
+    def test_underfill_in_order(self):
+        r = Ring(8)
+        for i in range(3):
+            r.append(i)
+        assert r.snapshot() == [0, 1, 2]
+        assert r.dropped == 0
+        assert len(r) == 3
+
+    def test_exact_capacity_boundary(self):
+        r = Ring(3)
+        for i in range(3):
+            r.append(i)
+        assert r.snapshot() == [0, 1, 2] and r.dropped == 0
+        r.append(3)
+        assert r.snapshot() == [1, 2, 3] and r.dropped == 1
+
+    def test_zero_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            Ring(0)
+
+
+# One Prometheus text-format sample line (after HELP/TYPE comments).
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_+][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" -?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?$"
+)
+
+
+class TestExposition:
+    def test_every_line_is_valid_prometheus_text(self):
+        reg = Registry()
+        reg.counter("a_total", "help a").inc(2, {"x": "1"})
+        reg.gauge("b", "help b").set(1.5)
+        h = reg.histogram("c_seconds", "help c", buckets=(0.1, 1.0))
+        h.observe(0.05, {"op": "q"})
+        h.observe(50.0, {"op": "q"})
+        text = reg.render()
+        assert text.endswith("\n")
+        for line in text.strip().splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                continue
+            assert _SAMPLE.match(line), line
+
+    def test_help_and_type_lines(self):
+        reg = Registry()
+        reg.counter("x_total", "counts xs").inc()
+        text = reg.render()
+        assert "# HELP x_total counts xs" in text
+        assert "# TYPE x_total counter" in text
+
+    def test_histogram_contract(self):
+        """Cumulative buckets, +Inf == _count, _sum present."""
+        reg = Registry()
+        h = reg.histogram("d_seconds", "t", buckets=(1.0, 2.0))
+        for v in (0.5, 0.6, 1.5, 9.0):
+            h.observe(v)
+        text = reg.render()
+        assert 'd_seconds_bucket{le="1"} 2' in text
+        assert 'd_seconds_bucket{le="2"} 3' in text
+        assert 'd_seconds_bucket{le="+Inf"} 4' in text
+        assert "d_seconds_count 4" in text
+        assert "d_seconds_sum 11.6" in text
+
+    def test_label_escaping(self):
+        reg = Registry()
+        reg.counter("e_total", "t").inc(1, {"r": 'bad "q"\nline'})
+        out = reg.render()
+        assert 'r="bad \\"q\\"\\nline"' in out
+
+    def test_unobserved_metrics_omitted(self):
+        reg = Registry()
+        reg.counter("never_total", "t")
+        assert "never_total" not in reg.render()
+
+    def test_nonfinite_values_render_not_crash(self):
+        """One inf/NaN gauge (a ratio whose denominator hit zero)
+        must not take down the whole exposition — the format has
+        spellings for them."""
+        reg = Registry()
+        reg.gauge("ratio", "t").set(float("inf"))
+        reg.gauge("neg", "t").set(float("-inf"))
+        reg.gauge("nan", "t").set(float("nan"))
+        reg.gauge("ok", "t").set(1.0)
+        text = reg.render()
+        assert "ratio +Inf" in text
+        assert "neg -Inf" in text
+        assert "nan NaN" in text
+        assert "ok 1" in text
+
+
+class TestRegistry:
+    def test_get_or_create_and_kind_conflict(self):
+        reg = Registry()
+        c1 = reg.counter("x_total", "first help")
+        c2 = reg.counter("x_total", "different help")
+        assert c1 is c2
+        assert c1.help == "first help"
+        with pytest.raises(ValueError):
+            reg.gauge("x_total", "now a gauge?")
+
+    def test_concurrent_registration_single_winner(self):
+        """Racing threads registering the same name must converge on
+        ONE instrument (creation happens under the lock) — a loser
+        must never silently receive a wrong-kind instance."""
+        import threading
+
+        reg = Registry()
+        out = []
+
+        def register():
+            out.append(reg.counter("race_total", "t"))
+
+        threads = [
+            threading.Thread(target=register) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(m is out[0] for m in out)
+
+    def test_disabled_registry_noops(self):
+        reg = Registry(enabled=False)
+        c = reg.counter("x_total", "t")
+        c.inc(5)
+        g = reg.gauge("g", "t")
+        g.set(2)
+        h = reg.histogram("h_seconds", "t", buckets=(1.0,))
+        h.observe(0.5)
+        assert c.value() == 0.0
+        assert g.value() is None
+        assert h.count() == 0
+
+    def test_counter_rejects_decrease(self):
+        with pytest.raises(ValueError):
+            Registry().counter("x_total", "t").inc(-1)
+
+    def test_gauge_set_min_is_low_watermark(self):
+        g = Registry().gauge("w", "t")
+        g.set_min(5)
+        g.set_min(3)
+        g.set_min(9)
+        assert g.value() == 3
+
+
+class TestProfileHook:
+    def _patched(self, monkeypatch):
+        import jax
+
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda logdir: calls.append(("start", logdir)),
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+        )
+        return calls
+
+    def test_window_covers_exactly_n_dispatches(self, monkeypatch):
+        calls = self._patched(monkeypatch)
+        hook = ProfileHook()
+        hook.arm(3, "/tmp/prof")
+        for _ in range(5):
+            hook.on_dispatch()
+        assert calls == [("start", "/tmp/prof"), ("stop",)]
+        s = hook.status()
+        assert s["completed_windows"] == 1
+        assert s["active"] is False
+        assert s["remaining_dispatches"] == 0
+
+    def test_unarmed_is_noop(self, monkeypatch):
+        calls = self._patched(monkeypatch)
+        hook = ProfileHook()
+        for _ in range(10):
+            hook.on_dispatch()
+        assert calls == []
+
+    def test_start_failure_disarms(self, monkeypatch):
+        import jax
+
+        def boom(logdir):
+            raise RuntimeError("no profiler here")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        hook = ProfileHook()
+        hook.arm(2, "/tmp/prof")
+        hook.on_dispatch()
+        hook.on_dispatch()  # must not retry or raise
+        s = hook.status()
+        assert s["active"] is False
+        assert "no profiler here" in s["last_error"]
+
+    def test_arm_validation(self):
+        hook = ProfileHook()
+        with pytest.raises(ValueError):
+            hook.arm(0, "/tmp/p")
+        with pytest.raises(ValueError):
+            hook.arm(3, "")
+
+    def test_from_env(self):
+        hook = ProfileHook.from_env(
+            {"WALKAI_PROFILE_DIR": "/tmp/x",
+             "WALKAI_PROFILE_DISPATCHES": "7"}
+        )
+        assert hook.status()["remaining_dispatches"] == 7
+        assert ProfileHook.from_env({}).status()[
+            "remaining_dispatches"
+        ] == 0
+
+    def test_disabled_bundle_never_arms_from_env(self, monkeypatch):
+        """WALKAI_OBS=0 + WALKAI_PROFILE_DIR set: the no-op bundle
+        must be a real no-op — no capture window on a
+        telemetry-disabled engine (and no bias in the overhead A/B's
+        disabled arm)."""
+        monkeypatch.setenv("WALKAI_PROFILE_DIR", "/tmp/prof")
+        monkeypatch.setenv("WALKAI_PROFILE_DISPATCHES", "5")
+        obs = ServingObs(enabled=False)
+        assert obs.profile.status()["remaining_dispatches"] == 0
+        assert ServingObs(enabled=True).profile.status()[
+            "remaining_dispatches"
+        ] == 5
+
+
+class TestRequestTraceUnit:
+    def test_span_math_uses_caller_clock(self):
+        tr = RequestTrace()
+        tr.submit(7, 100.0, prompt_len=4, max_new=8)
+        tr.admitted(7, 100.5, slot=1, blocks=2)
+        tr.first_token(7, 101.25)
+        tr.done(7, 103.0, "eos", 5)
+        assert tr.ttft_s(7) == 1.25
+        assert tr.wall_s(7) == 3.0
+        tl = tr.timeline(7)
+        assert tl["reason"] == "eos" and tl["slot"] == 1
+
+    def test_done_retention_bounded(self):
+        tr = RequestTrace(keep_done=2)
+        for rid in range(5):
+            tr.submit(rid, float(rid), 1, 1)
+            tr.done(rid, float(rid) + 1, "budget", 1)
+        assert tr.ttft_s(0) is None  # evicted
+        assert tr.wall_s(4) == 1.0
+
+    def test_disabled_records_nothing(self):
+        tr = RequestTrace(enabled=False)
+        tr.submit(1, 0.0, 1, 1)
+        assert tr.timeline(1) is None
+        assert tr.ring.snapshot() == []
+
+    def test_chrome_trace_structure(self):
+        tr = RequestTrace()
+        tr.submit(3, 10.0, 4, 8)
+        tr.admitted(3, 10.1, slot=0, blocks=1)
+        tr.prefill_chunk(3, 10.15, 4, 4)
+        tr.first_token(3, 10.2)
+        tr.done(3, 10.9, "budget", 8)
+        tr.error(11.0, "oversize_reject")
+        ct = tr.chrome_trace()
+        events = ct["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"queued", "prefill", "decode", "error"} <= names
+        for e in events:
+            assert e["ph"] in ("X", "i", "M")
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], int)
+                assert isinstance(e["dur"], int) and e["dur"] >= 0
+        decode = next(e for e in events if e["name"] == "decode")
+        assert decode["ts"] == 200_000  # 10.2 - 10.0 in us
+        assert decode["dur"] == 700_000
+
+    def test_empty_trace_exports(self):
+        assert RequestTrace().chrome_trace()["traceEvents"] == []
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_run():
+    """One tiny paged engine driven to completion: shared by the
+    span-parity, registry-derivation, and exposition checks (the jit
+    compile is the expensive part)."""
+    import jax
+
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+    cfg = LMConfig(
+        vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+        max_seq_len=64,
+    )
+    params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+    engine = ContinuousBatcher(
+        cfg, params, slots=2, cache_len=64, prompt_bucket=16,
+        chunk_steps=2,
+    )
+    rng = np.random.default_rng(0)
+    rids = []
+    for n, max_new in ((3, 5), (6, 3), (4, 4)):
+        prompt = rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+        rids.append(engine.submit(prompt, max_new_tokens=max_new))
+    while engine.has_work:
+        engine.step()
+    records = engine.drain_done_records()
+    return engine, rids, records
+
+
+class TestEngineObsIntegration:
+    def test_span_timeline_parity_is_exact(self, tiny_engine_run):
+        """ttft_s/wall_s reconstructed from lifecycle spans equal
+        drain_done_records EXACTLY (same clock reads, not a second
+        measurement)."""
+        engine, rids, records = tiny_engine_run
+        assert set(records) == set(rids)
+        for rid, rec in records.items():
+            assert engine.obs.trace.ttft_s(rid) == rec["ttft_s"]
+            assert engine.obs.trace.wall_s(rid) == rec["wall_s"]
+            tl = engine.obs.trace.timeline(rid)
+            assert tl["n_tokens"] == len(rec["tokens"])
+            assert tl["reason"] == "budget"  # no eos_id set
+
+    def test_histograms_agree_with_records_within_one_bucket(
+        self, tiny_engine_run
+    ):
+        engine, _, records = tiny_engine_run
+        obs = engine.obs
+        assert obs.ttft.count() == len(records)
+        assert obs.wall.count() == len(records)
+        max_ttft = max(r["ttft_s"] for r in records.values())
+        bound = next(
+            b for b in obs.ttft.bounds if b >= max_ttft
+        )
+        assert obs.ttft.quantile(1.0) == bound
+
+    def test_occupancy_and_kv_stats_are_registry_views(
+        self, tiny_engine_run
+    ):
+        engine, _, records = tiny_engine_run
+        obs = engine.obs
+        occ = engine.occupancy()
+        assert occ["busy_slot_steps"] == int(obs.busy_steps.value())
+        assert occ["total_slot_steps"] == int(obs.total_steps.value())
+        assert occ["total_slot_steps"] == (
+            int(obs.dispatches.value()) * engine.slots
+            * engine.chunk_steps
+        )
+        kv = engine.kv_stats()
+        assert kv["kv_bytes_dispatch_acc"] == obs.kv_bytes.value()
+        assert kv["kv_resident_dispatch_acc"] == int(
+            obs.kv_resident.value()
+        )
+        assert kv["admission_stall_s"] == round(obs.stall.value(), 6)
+        assert kv["kv_hbm_bytes_per_resident_token"] == (
+            obs.kv_ratio.value()
+        )
+        assert engine.admission_stall_s == obs.stall.value()
+
+    def test_counters_and_gauges_after_drain(self, tiny_engine_run):
+        engine, rids, records = tiny_engine_run
+        obs = engine.obs
+        assert obs.submitted.value() == len(rids)
+        assert obs.completed.value({"reason": "budget"}) == len(rids)
+        total_tokens = sum(len(r["tokens"]) for r in records.values())
+        assert obs.tokens.value() == total_tokens
+        assert obs.queue_depth.value() == 0
+        assert engine.queue_depth == 0
+        assert obs.dispatch_latency.count() == int(
+            obs.dispatches.value()
+        )
+        # Paged pool drained back to fully free; watermark recorded.
+        free = engine.pool_blocks - 1
+        assert obs.pool_blocks.value({"state": "free"}) == free
+        assert obs.pool_blocks.value({"state": "used"}) == 0
+        assert obs.pool_min_free.value() < free
+        assert engine.seconds_since_last_dispatch is not None
+
+    def test_metrics_render_parses(self, tiny_engine_run):
+        engine, _, _ = tiny_engine_run
+        text = engine.obs.render()
+        assert "# TYPE cb_ttft_seconds histogram" in text
+        assert "cb_requests_submitted_total 3" in text
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert _SAMPLE.match(line), line
+
+    def test_error_taxonomy_labels(self, tiny_engine_run):
+        engine, _, _ = tiny_engine_run
+        obs = engine.obs
+        with pytest.raises(ValueError):
+            engine.submit([1] * 70, max_new_tokens=5)  # > cache_len
+        assert obs.errors.value({"reason": "oversize_reject"}) == 1
+        with pytest.raises(ValueError):
+            engine.submit([1, 2], max_new_tokens=5, temperature=-1.0)
+        assert obs.errors.value({"reason": "bad_request"}) == 1
+
+    def test_pool_overflow_label(self):
+        """A request that fits the cache but not the pool is a
+        distinct reject reason."""
+        import jax
+
+        from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+        from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+            max_seq_len=256,
+        )
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        engine = ContinuousBatcher(
+            cfg, params, slots=1, cache_len=256, prompt_bucket=16,
+            chunk_steps=2, pool_blocks=2,
+        )
+        with pytest.raises(ValueError, match="pool"):
+            engine.submit([1] * 4, max_new_tokens=200)  # 2 blocks > 1
+        assert engine.obs.errors.value(
+            {"reason": "pool_overflow"}
+        ) == 1
+
+    def test_disabled_obs_keeps_api_shape(self):
+        """obs=False (the bench's A/B arm): no recording, but the
+        occupancy/kv_stats dict shapes survive."""
+        import jax
+
+        from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+        from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+            max_seq_len=64,
+        )
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        engine = ContinuousBatcher(
+            cfg, params, slots=2, cache_len=64, prompt_bucket=16,
+            chunk_steps=2, obs=False,
+        )
+        rid = engine.submit([1, 2, 3], max_new_tokens=4)
+        out = engine.run()
+        assert len(out[rid]) == 4
+        occ = engine.occupancy()
+        assert set(occ) == {
+            "busy_slot_steps", "total_slot_steps", "occupancy",
+            "obs_disabled",
+        }
+        assert occ["total_slot_steps"] == 0  # disabled records nothing
+        # ...and the zeros are FLAGGED, not presented as measurements.
+        assert occ["obs_disabled"] is True
+        kv = engine.kv_stats()
+        assert kv["obs_disabled"] is True
+        assert kv["kv_hbm_bytes_per_resident_token"] is None
+        assert engine.obs.trace.timeline(rid) is None
+
+
+class TestHealthzPayload:
+    def _demo_module(self):
+        import importlib.util
+        import pathlib
+        import sys
+
+        path = (
+            pathlib.Path(__file__).resolve().parents[1]
+            / "demos" / "tpu-sharing-comparison" / "app" / "main.py"
+        )
+        spec = importlib.util.spec_from_file_location(
+            "walkai_demo_app", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["walkai_demo_app"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_engine_block_fields(self):
+        mod = self._demo_module()
+
+        class Stub:
+            queue_depth = 5
+            seconds_since_last_dispatch = 0.1234
+            has_work = True
+            slots = 8
+
+        payload = mod.engine_health(Stub(), True)
+        assert payload == {
+            "alive": True,
+            "queue_depth": 5,
+            "seconds_since_last_dispatch": 0.123,
+            "has_work": True,
+            "slots": 8,
+        }
+
+    def test_no_engine_and_never_dispatched(self):
+        mod = self._demo_module()
+        assert mod.engine_health(None, False) is None
+
+        class Fresh:
+            queue_depth = 0
+            seconds_since_last_dispatch = None
+            has_work = False
+            slots = 2
+
+        payload = mod.engine_health(Fresh(), True)
+        assert payload["seconds_since_last_dispatch"] is None
+
+
+class TestInstallExporterRegistry:
+    def test_inventory_as_gauges(self):
+        from walkai_nos_tpu.cmd.metricsexporter import (
+            registry_from_metrics,
+        )
+
+        text = registry_from_metrics({
+            "installation_uuid": "u-1",
+            "components": {"tpuagent": True, "scheduler": False},
+            "nodes": [{
+                "name": "n1",
+                "capacity": {
+                    "google.com/tpu": "8",
+                    "memory": "16Gi",
+                    "bogus": "not-a-quantity",
+                },
+            }],
+        }).render()
+        assert 'nos_install_info{installation_uuid="u-1"} 1' in text
+        assert (
+            'nos_install_component_enabled{component="tpuagent"} 1'
+            in text
+        )
+        assert (
+            'nos_install_component_enabled{component="scheduler"} 0'
+            in text
+        )
+        assert (
+            'nos_install_node_capacity{node="n1",'
+            'resource="google.com/tpu"} 8' in text
+        )
+        assert "nos_install_nodes 1" in text
+        assert "bogus" not in text  # unparseable quantity skipped
+
+    def test_health_metrics_is_the_same_registry(self):
+        """The kube binaries' Metrics IS the obs Registry (one
+        implementation, adapter API on top)."""
+        from walkai_nos_tpu.health import Metrics
+
+        m = Metrics()
+        assert isinstance(m, Registry)
+        m.counter_add("nos_reconcile_total", 1,
+                      {"controller": "c", "result": "ok"},
+                      help_text="Reconciliations")
+        out = m.render()
+        assert "# TYPE nos_reconcile_total counter" in out
+        assert (
+            'nos_reconcile_total{controller="c",result="ok"} 1' in out
+        )
+
+
+class TestServingObsBundle:
+    def test_catalog_attrs_built(self):
+        from walkai_nos_tpu.obs.catalog import serving_specs
+
+        obs = ServingObs()
+        for spec in serving_specs():
+            inst = getattr(obs, spec.attr)
+            assert inst.name == spec.name
+            assert inst.kind == spec.kind
+
+    def test_overhead_key_is_headline(self):
+        """The gated key must survive driver-side tail truncation:
+        it has to be in bench.py's headline tuple (the measured A/B
+        itself runs in tests/test_bench_serving.py — compile-heavy)."""
+        import inspect
+
+        import bench
+
+        assert "obs_overhead_pct" in inspect.getsource(bench.main)

@@ -5,6 +5,15 @@ UUID, node inventory, chart values, enabled components — schema per
 `cmd/metricsexporter/metrics/metrics.go:24-42`), POST it as JSON to the
 telemetry endpoint. EVERY error path exits 0 — telemetry must never fail an
 install (the reference swallows all errors the same way).
+
+The same payload is also exposed through the repo's unified metrics
+registry (`walkai_nos_tpu/obs/metrics.py` — the registry the serving
+engine's /metrics and the kube binaries' health servers serve):
+`registry_from_metrics` turns the install inventory into the
+`nos_install_*` gauges declared in `obs/catalog.py`, and `--prom-file`
+writes the Prometheus text exposition to a file (the node-exporter
+textfile-collector pattern), so kube-side and serving-side telemetry
+share one metrics surface instead of two bespoke formats.
 """
 
 from __future__ import annotations
@@ -18,6 +27,8 @@ import urllib.request
 import yaml
 
 from walkai_nos_tpu.cmd import _common
+from walkai_nos_tpu.obs.metrics import Registry
+from walkai_nos_tpu.utils.quantity import parse_quantity
 
 logger = logging.getLogger("metricsexporter")
 
@@ -52,11 +63,57 @@ def build_metrics(raw: dict, kube=None) -> dict:
     return metrics
 
 
+def registry_from_metrics(metrics: dict) -> Registry:
+    """The install payload as `nos_install_*` gauges on the unified
+    registry (names/types declared in `obs/catalog.py`, documented in
+    docs/observability.md, linted by `make metrics-lint`)."""
+    reg = Registry()
+    reg.gauge(
+        "nos_install_info", "Install identity (value is always 1)"
+    ).set(
+        1,
+        {"installation_uuid": metrics.get("installation_uuid", "")},
+    )
+    comp = reg.gauge(
+        "nos_install_component_enabled",
+        "1 if the chart component is enabled, else 0",
+    )
+    for name, enabled in sorted(
+        (metrics.get("components") or {}).items()
+    ):
+        comp.set(1 if enabled else 0, {"component": str(name)})
+    nodes = metrics.get("nodes") or []
+    reg.gauge(
+        "nos_install_nodes", "Nodes in the install inventory"
+    ).set(len(nodes))
+    cap = reg.gauge(
+        "nos_install_node_capacity",
+        "Node capacity by resource, parsed from the Kube quantity",
+    )
+    for node in nodes:
+        for resource, raw in sorted((node.get("capacity") or {}).items()):
+            try:
+                value = parse_quantity(raw)
+            except (TypeError, ValueError):
+                continue  # unparseable quantity: skip the series
+            cap.set(
+                value,
+                {"node": node.get("name", ""), "resource": resource},
+            )
+    return reg
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="metricsexporter")
     parser.add_argument("--metrics-file", required=True)
     parser.add_argument(
         "--endpoint", default="https://telemetry.walkai.io/v1/nos-metrics"
+    )
+    parser.add_argument(
+        "--prom-file",
+        default=None,
+        help="also write the install inventory as Prometheus text "
+        "exposition to this path (textfile-collector pattern)",
     )
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
@@ -76,6 +133,19 @@ def main(argv: list[str] | None = None) -> int:
         pass
     try:
         metrics = build_metrics(raw, kube)
+    except Exception as e:
+        logger.warning("cannot build metrics: %s", e)
+        return 0
+    if args.prom_file:
+        # Exposition failure must not block the POST (and vice versa):
+        # both sinks are best-effort, every path still exits 0.
+        try:
+            with open(args.prom_file, "w") as f:
+                f.write(registry_from_metrics(metrics).render())
+            logger.info("prometheus exposition written: %s", args.prom_file)
+        except Exception as e:
+            logger.warning("cannot write prom file: %s", e)
+    try:
         req = urllib.request.Request(
             args.endpoint,
             data=json.dumps(metrics).encode(),

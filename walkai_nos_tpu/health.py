@@ -7,6 +7,13 @@ metrics at `metrics.bindAddress`). Serves:
 - /healthz  liveness (200 while the process runs)
 - /readyz   readiness (200 once mark_ready(), 503 before/after)
 - /metrics  Prometheus text exposition of registered gauges/counters
+
+`Metrics` is the kube binaries' view of the ONE registry
+implementation the repo has (`walkai_nos_tpu/obs/metrics.py` — the
+serving engine and the install exporter expose the same surface): a
+thin adapter keeping the imperative `counter_add`/`gauge_set` API the
+controller runtime calls, over `obs.metrics.Registry` storage and
+exposition.
 """
 
 from __future__ import annotations
@@ -14,71 +21,25 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-
-def _escape_label(value) -> str:
-    """Prometheus exposition label escaping: one bad value (a quote or
-    newline from an object name or error string) must not corrupt the
-    whole /metrics payload."""
-    return (
-        str(value)
-        .replace("\\", "\\\\")
-        .replace('"', '\\"')
-        .replace("\n", "\\n")
-    )
+from walkai_nos_tpu.obs.metrics import Registry
 
 
-class Metrics:
-    """Minimal Prometheus registry: counters and gauges with labels."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._values: dict[tuple[str, tuple], float] = {}
-        self._help: dict[str, tuple[str, str]] = {}  # name -> (type, help)
-
-    def _register(self, name: str, kind: str, help_text: str) -> None:
-        self._help.setdefault(name, (kind, help_text))
+class Metrics(Registry):
+    """The obs registry with the record-and-register-in-one-call API
+    the kube binaries use (the instrument-object API is better for hot
+    loops; reconcile-rate metrics don't need it)."""
 
     def counter_add(
         self, name: str, value: float = 1.0,
         labels: dict | None = None, help_text: str = "",
     ) -> None:
-        self._register(name, "counter", help_text)
-        key = (name, tuple(sorted((labels or {}).items())))
-        with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + value
+        self.counter(name, help_text).inc(value, labels)
 
     def gauge_set(
         self, name: str, value: float,
         labels: dict | None = None, help_text: str = "",
     ) -> None:
-        self._register(name, "gauge", help_text)
-        key = (name, tuple(sorted((labels or {}).items())))
-        with self._lock:
-            self._values[key] = value
-
-    def render(self) -> str:
-        lines = []
-        with self._lock:
-            by_name: dict[str, list] = {}
-            for (name, labels), value in sorted(self._values.items()):
-                by_name.setdefault(name, []).append((labels, value))
-        for name, series in by_name.items():
-            kind, help_text = self._help.get(name, ("gauge", ""))
-            if help_text:
-                lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} {kind}")
-            for labels, value in series:
-                label_s = (
-                    "{"
-                    + ",".join(
-                        f'{k}="{_escape_label(v)}"' for k, v in labels
-                    )
-                    + "}"
-                    if labels
-                    else ""
-                )
-                lines.append(f"{name}{label_s} {value}")
-        return "\n".join(lines) + "\n"
+        self.gauge(name, help_text).set(value, labels)
 
 
 class HealthServer:

@@ -83,6 +83,7 @@ import numpy as np
 
 from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.obs.serving import ServingObs
 from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
 
 
@@ -143,6 +144,16 @@ class ContinuousBatcher:
     once per emitted token, so a request's output is a deterministic
     function of (weights, prompt, knobs, seed) — independent of batch
     composition, admission timing, or which slot it lands in.
+
+    `obs` is the telemetry bundle (`walkai_nos_tpu/obs`): pass a
+    `ServingObs` to share a registry with a server, `True` (default)
+    for a private bundle, `False` for the no-op bundle (the disabled
+    arm of the bench's `obs_overhead_pct` A/B). Every cumulative stat
+    the engine exports — `occupancy()`, `kv_stats()`, TTFT/TPOT
+    histograms, the request-lifecycle trace — lives in `self.obs`;
+    recording happens host-side at dispatch/sync points only, and the
+    span clock reuses the engine's own timestamp reads so
+    trace-derived ttft/wall equal `drain_done_records()` exactly.
     """
 
     def __init__(
@@ -158,6 +169,7 @@ class ContinuousBatcher:
         pool_blocks: int | None = None,
         prefill_chunk: int = 64,
         prefill_lanes: int = 4,
+        obs: ServingObs | bool = True,
     ) -> None:
         cache_len = cache_len or cfg.max_seq_len
         if prompt_bucket > cache_len:
@@ -202,27 +214,21 @@ class ContinuousBatcher:
         # Bounded: a long-running server may drive the engine without
         # ever draining latency samples; keep only the newest window.
         self._latencies: deque[float] = deque(maxlen=4096)
-        # Slot occupancy: busy vs total slot-steps across dispatched
-        # chunks — the utilization of the pool the serving benchmark
-        # reports (idle slots still burn a row of every compiled step).
-        self._busy_slot_steps = 0
-        self._total_slot_steps = 0
+        # Telemetry (obs/): the registry is the single source of truth
+        # for every cumulative counter the engine exports — occupancy,
+        # admission stall, the KV dispatch-weighted sums, TTFT/TPOT
+        # histograms, the lifecycle trace — all recorded host-side at
+        # sync points, never on the device path. `obs=False` builds
+        # the no-op bundle (the disabled arm the bench's
+        # obs_overhead_pct key measures).
+        if isinstance(obs, ServingObs):
+            self.obs = obs
+        else:
+            self.obs = ServingObs(enabled=bool(obs))
         # In-flight chunk: (device tokens handle, slot->req snapshot,
-        # per-slot "first token expected" flags).
+        # per-slot "first token expected" flags, dispatch timestamp).
         self._inflight: tuple | None = None
-        # Serving telemetry: cumulative host seconds spent inside
-        # admission work (dense mode: the blocking prefill + admit
-        # dispatch pair this engine's paged mode exists to remove),
-        # and the latest KV-memory-per-resident-token snapshot.
-        self.admission_stall_s = 0.0
-        self._kv_ratio: float | None = None
-        # Cumulative per-dispatch sums (bytes backing resident tokens,
-        # and resident tokens) — a window's delta ratio is the
-        # load-weighted average the bench reports, robust to WHEN the
-        # stats endpoint is polled (a lone drain-tail or mid-prefill
-        # snapshot is not representative).
-        self._kv_bytes_acc = 0.0
-        self._kv_resident_acc = 0
+        self._last_dispatch_mono: float | None = None
 
         # Paged allocator state (host-owned; the table uploads per
         # dispatch). Block 0 is never allocated: it is the scratch
@@ -234,6 +240,8 @@ class ContinuousBatcher:
         )
         self._prefilling: list[_Prefill] = []
         self._warm_buckets: set[int] = set()
+        if paged:
+            self._set_pool_gauges()
 
         cache = self._model.init(
             jax.random.PRNGKey(0),
@@ -442,48 +450,63 @@ class ContinuousBatcher:
         temperature 0 (default) is greedy; otherwise temperature
         sampling with optional top-k / nucleus truncation, seeded per
         request (`seed` defaults to the request id, so every request
-        is deterministic AND distinct)."""
+        is deterministic AND distinct).
+
+        Rejections raise ValueError AND land in the labeled
+        `cb_request_errors_total` counter (reason: bad_request |
+        oversize_reject | pool_overflow), so a production engine's
+        reject mix is visible on /metrics, not only in per-request
+        error strings."""
         if not temperature >= 0.0:  # NaN-proof: NaN fails >= too
-            raise ValueError(f"temperature must be >= 0; got {temperature}")
+            raise self._reject(
+                "bad_request",
+                f"temperature must be >= 0; got {temperature}",
+            )
         if not 0 <= top_k <= self.cfg.vocab_size or not 0.0 < top_p <= 1.0:
-            raise ValueError(
+            raise self._reject(
+                "bad_request",
                 f"top_k must be in [0, vocab_size={self.cfg.vocab_size}] "
-                f"and top_p in (0, 1]; got {top_k}, {top_p}"
+                f"and top_p in (0, 1]; got {top_k}, {top_p}",
             )
         if seed is not None and not -(2**31) <= seed < 2**31:
             # The seed crosses into jit as an int32 argument; an
             # out-of-range value must fail HERE (a per-request error),
             # not later inside the engine's step thread.
-            raise ValueError(f"seed must fit int32; got {seed}")
+            raise self._reject(
+                "bad_request", f"seed must fit int32; got {seed}"
+            )
         prompt = np.asarray(prompt).reshape(-1)
         if len(prompt) == 0:
-            raise ValueError("empty prompt")
+            raise self._reject("bad_request", "empty prompt")
         # Validate BEFORE the int32 cast (which would silently wrap
         # wide values, e.g. 2**32+5 -> 5): the embedding gather clamps
         # out-of-vocab ids into garbage tokens, so direct engine users
         # (no demo server in front) must get a per-request error.
         if prompt.min() < 0 or prompt.max() >= self.cfg.vocab_size:
-            raise ValueError(
+            raise self._reject(
+                "bad_request",
                 f"prompt ids must be in [0, vocab_size="
                 f"{self.cfg.vocab_size}); got range "
-                f"[{prompt.min()}, {prompt.max()}]"
+                f"[{prompt.min()}, {prompt.max()}]",
             )
         prompt = prompt.astype(np.int32)
         total = len(prompt) + max_new_tokens
         if total > self.cache_len:
-            raise ValueError(
+            raise self._reject(
+                "oversize_reject",
                 f"prompt + max_new_tokens = {total} exceeds cache_len "
-                f"{self.cache_len}"
+                f"{self.cache_len}",
             )
         if self.paged:
             if self._blocks_needed(len(prompt), max_new_tokens) > (
                 self.pool_blocks - 1
             ):
-                raise ValueError(
+                raise self._reject(
+                    "pool_overflow",
                     f"request needs "
                     f"{self._blocks_needed(len(prompt), max_new_tokens)} "
                     f"cache blocks but the pool holds "
-                    f"{self.pool_blocks - 1} allocatable blocks"
+                    f"{self.pool_blocks - 1} allocatable blocks",
                 )
         else:
             # Dense mode: any prompt that fits the cache is served —
@@ -506,7 +529,21 @@ class ContinuousBatcher:
         )
         self._requests[rid] = req
         self._pending.append(req)
+        self.obs.submitted.inc()
+        self.obs.queue_depth.set(len(self._pending))
+        # The span clock is the request's own stored timestamp, so
+        # trace-derived ttft/wall equal drain_done_records exactly.
+        self.obs.trace.submit(
+            rid, req.submitted_at, len(prompt), max_new_tokens
+        )
         return rid
+
+    def _reject(self, reason: str, message: str) -> ValueError:
+        """Count a submit-time rejection under its taxonomy label and
+        build the ValueError for the caller to raise."""
+        self.obs.errors.inc(labels={"reason": reason})
+        self.obs.trace.error(time.monotonic(), reason)
+        return ValueError(message)
 
     def drain_latencies(self) -> list[float]:
         """Pop submit->completion wall seconds of finished requests
@@ -584,13 +621,44 @@ class ContinuousBatcher:
         return done
 
     def occupancy(self) -> dict:
-        """Cumulative slot-pool occupancy over dispatched chunks."""
-        total = max(1, self._total_slot_steps)
-        return {
-            "busy_slot_steps": self._busy_slot_steps,
-            "total_slot_steps": self._total_slot_steps,
-            "occupancy": round(self._busy_slot_steps / total, 4),
+        """Cumulative slot-pool occupancy over dispatched chunks —
+        read from the metrics registry (the single source of truth;
+        `cb_busy_slot_steps_total` / `cb_slot_steps_total`), shaped
+        exactly as the /stats consumers and `measure_cb_serving`
+        expect."""
+        busy = int(self.obs.busy_steps.value())
+        total = int(self.obs.total_steps.value())
+        out = {
+            "busy_slot_steps": busy,
+            "total_slot_steps": total,
+            "occupancy": round(busy / max(1, total), 4),
         }
+        if not self.obs.enabled:
+            # Telemetry off (obs=False / WALKAI_OBS=0): the counters
+            # no-op, so flag the zeros rather than letting a /stats
+            # consumer read them as a measured idle pool.
+            out["obs_disabled"] = True
+        return out
+
+    @property
+    def admission_stall_s(self) -> float:
+        """Cumulative host seconds inside admission work (registry:
+        `cb_admission_stall_seconds_total`)."""
+        return self.obs.stall.value()
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests submitted but not yet admitted to a slot."""
+        return len(self._pending)
+
+    @property
+    def seconds_since_last_dispatch(self) -> float | None:
+        """Host seconds since the engine last dispatched a step
+        program; None before the first dispatch. The /healthz
+        readiness payload's staleness signal."""
+        if self._last_dispatch_mono is None:
+            return None
+        return time.monotonic() - self._last_dispatch_mono
 
     def kv_stats(self) -> dict:
         """KV-memory and admission telemetry for the serving bench.
@@ -603,18 +671,24 @@ class ContinuousBatcher:
         sums let a caller difference two snapshots into the
         dispatch-weighted average over its own window.
         `admission_stall_s` is cumulative host time inside admission
-        dispatch work."""
+        dispatch work. Every cumulative field is read from the
+        metrics registry (same series /metrics exports) — the dict is
+        a VIEW of the registry, not a second set of counters."""
         per_tok = self._kv_bytes_per_token()
         if self.paged:
             backing = self.pool_blocks * PAGE_ROWS * per_tok
         else:
             backing = self.slots * self.cache_len * per_tok
         return {
-            "kv_hbm_bytes_per_resident_token": self._kv_ratio,
+            # Flag no-op'd cumulative fields when telemetry is off
+            # (obs=False / WALKAI_OBS=0) — zeros here are "not
+            # recorded", not "measured zero".
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "kv_hbm_bytes_per_resident_token": self.obs.kv_ratio.value(),
             # Cumulative sums: a caller differencing two snapshots gets
             # the dispatch-weighted average ratio over its window.
-            "kv_bytes_dispatch_acc": self._kv_bytes_acc,
-            "kv_resident_dispatch_acc": self._kv_resident_acc,
+            "kv_bytes_dispatch_acc": self.obs.kv_bytes.value(),
+            "kv_resident_dispatch_acc": int(self.obs.kv_resident.value()),
             "kv_bytes_per_token": per_tok,
             "kv_backing_bytes": backing,
             "kv_pool_blocks": self.pool_blocks if self.paged else None,
@@ -680,28 +754,46 @@ class ContinuousBatcher:
             bytes_backing = in_use * PAGE_ROWS * per_tok
         else:
             bytes_backing = self.slots * self.cache_len * per_tok
-        self._kv_ratio = round(bytes_backing / resident, 1)
-        self._kv_bytes_acc += float(bytes_backing)
-        self._kv_resident_acc += resident
+        self.obs.kv_ratio.set(round(bytes_backing / resident, 1))
+        self.obs.kv_bytes.inc(float(bytes_backing))
+        self.obs.kv_resident.inc(resident)
+
+    def _mark_dispatch(self, busy: int, t0: float) -> None:
+        """Per-dispatch registry writes, shared by both cache layouts
+        (host-side bookkeeping between async dispatches)."""
+        self._last_dispatch_mono = t0
+        obs = self.obs
+        obs.dispatches.inc()
+        obs.last_dispatch.set(time.time())
+        obs.slots_active.set(busy)
+        obs.busy_steps.inc(busy * self.chunk_steps)
+        obs.total_steps.inc(self.slots * self.chunk_steps)
 
     def _dispatch(self):
         if self.paged:
             return self._dispatch_paged()
         self._record_kv_snapshot()
+        self.obs.profile.on_dispatch()
+        t0 = time.monotonic()
         self._state, emitted = self._step_fn(self.params, self._state)
         snapshot = list(self._slot_req)
         fresh = list(self._slot_new)
         self._slot_new = [False] * self.slots
         busy = sum(1 for r in snapshot if r is not None)
-        self._busy_slot_steps += busy * self.chunk_steps
-        self._total_slot_steps += self.slots * self.chunk_steps
-        return emitted, snapshot, fresh
+        self._mark_dispatch(busy, t0)
+        return emitted, snapshot, fresh, t0
 
     def _dispatch_paged(self):
         self._record_kv_snapshot()
+        self.obs.profile.on_dispatch()
+        t0 = time.monotonic()
         dec_table = jnp.asarray(self._table)
         finished: list[_Prefill] = []
         if self._prefilling:
+            # Lane utilization: rows carrying a real admission vs the
+            # configured lane width, summed over lane dispatches.
+            self.obs.lane_rows.inc(len(self._prefilling))
+            self.obs.lane_capacity.inc(self.prefill_lanes)
             W = self.prefill_chunk
             # Lane batch sized to ACTIVE admissions (rounded up to a
             # power of two, capped at prefill_lanes, so compile
@@ -750,6 +842,9 @@ class ContinuousBatcher:
                 pf_start[r] = start
                 pf_tbl[r, :len(entry.blocks)] = entry.blocks
                 lane_end = max(lane_end, start + W)
+                self.obs.trace.prefill_chunk(
+                    req.rid, t0, entry.consumed, true_len
+                )
             # The lane only ever touches positions < lane_end, so hand
             # it a table truncated to the covering logical blocks
             # (rounded up to a power of two, capped at the full width,
@@ -788,33 +883,62 @@ class ContinuousBatcher:
             self._budget[s] = entry.req.max_new_tokens
             self._slot_blocks[s] = entry.blocks
             self._table[s, :len(entry.blocks)] = entry.blocks
+        self.obs.lane_active.set(len(self._prefilling))
         busy = sum(1 for r in snapshot if r is not None)
-        self._busy_slot_steps += busy * self.chunk_steps
-        self._total_slot_steps += self.slots * self.chunk_steps
-        return emitted, snapshot, fresh
+        self._mark_dispatch(busy, t0)
+        return emitted, snapshot, fresh, t0
 
-    def _process(self, emitted, snapshot, fresh) -> None:
+    def _process(self, emitted, snapshot, fresh, t_dispatch) -> None:
         tokens = np.asarray(emitted)  # [slots, 1 + chunk] — the sync
+        # ONE clock read serves every record in this chunk: the sync
+        # just completed is the moment all of them became host-visible,
+        # and the trace/histograms/done-records must agree exactly.
+        now = time.monotonic()
+        obs = self.obs
+        obs.dispatch_latency.observe(now - t_dispatch)
+        n_emitted = 0
         for s, req in enumerate(snapshot):
             if req is None or req.done:
                 continue
             emit = tokens[s] if fresh[s] else tokens[s, 1:]
             for t in emit:
                 if not req.tokens:
-                    req.first_token_at = time.monotonic()
+                    req.first_token_at = now
+                    obs.ttft.observe(now - req.submitted_at)
+                    obs.trace.first_token(req.rid, now)
                 req.tokens.append(int(t))
+                n_emitted += 1
                 self._budget[s] -= 1
                 if (
                     req.eos_id is not None and int(t) == req.eos_id
                 ) or self._budget[s] <= 0:
                     req.done = True
-                    req.completed_at = time.monotonic()
+                    req.completed_at = now
+                    reason = (
+                        "eos"
+                        if req.eos_id is not None and int(t) == req.eos_id
+                        else "budget"
+                    )
+                    obs.completed.inc(labels={"reason": reason})
+                    obs.wall.observe(now - req.submitted_at)
+                    if len(req.tokens) > 1 and now > req.first_token_at:
+                        # Requests finishing within their first chunk
+                        # have no host-observable decode pace (all
+                        # tokens landed at one sync) — same exclusion
+                        # the bench's token-pace percentile applies.
+                        obs.tpot.observe(
+                            (now - req.first_token_at)
+                            / (len(req.tokens) - 1)
+                        )
+                    obs.trace.done(req.rid, now, reason, len(req.tokens))
                     if self._slot_req[s] is req:
                         self._slot_req[s] = None
                         self._budget[s] = 0
                         if self.paged:
                             self._release_slot(s)
                     break
+        if n_emitted:
+            obs.tokens.inc(n_emitted)
 
     def _release_slot(self, s: int) -> None:
         """Return a freed slot's blocks to the pool and park its table
@@ -827,6 +951,17 @@ class ContinuousBatcher:
         self._free_blocks.extend(self._slot_blocks[s])
         self._slot_blocks[s] = []
         self._table[s, :] = 0
+        self._set_pool_gauges()
+
+    def _set_pool_gauges(self) -> None:
+        """Block-pool watermark gauges (paged mode): free/used split
+        plus the low watermark of free blocks since engine start."""
+        free = len(self._free_blocks)
+        self.obs.pool_blocks.set(free, labels={"state": "free"})
+        self.obs.pool_blocks.set(
+            self.pool_blocks - 1 - free, labels={"state": "used"}
+        )
+        self.obs.pool_min_free.set_min(free)
 
     def _admit(self) -> None:
         t0 = time.monotonic()
@@ -834,7 +969,7 @@ class ContinuousBatcher:
             self._admit_paged()
         else:
             self._admit_dense()
-        self.admission_stall_s += time.monotonic() - t0
+        self.obs.stall.inc(time.monotonic() - t0)
 
     def _admit_paged(self) -> None:
         """Assign pending requests to free slots + pool blocks and
@@ -858,6 +993,12 @@ class ContinuousBatcher:
             blocks = [self._free_blocks.pop() for _ in range(needed)]
             self._prefilling.append(_Prefill(req, s, blocks))
             busy.add(s)
+            self.obs.queue_depth.set(len(self._pending))
+            self.obs.lane_active.set(len(self._prefilling))
+            self._set_pool_gauges()
+            self.obs.trace.admitted(
+                req.rid, time.monotonic(), s, needed
+            )
 
     def _admit_dense(self) -> None:
         for s in range(self.slots):
@@ -879,3 +1020,5 @@ class ContinuousBatcher:
             self._slot_req[s] = req
             self._slot_new[s] = True
             self._budget[s] = req.max_new_tokens
+            self.obs.queue_depth.set(len(self._pending))
+            self.obs.trace.admitted(req.rid, time.monotonic(), s, 0)

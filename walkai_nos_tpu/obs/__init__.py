@@ -1,0 +1,29 @@
+"""Telemetry subsystem: metrics registry, request tracing, profiling.
+
+- `obs.metrics` — counters/gauges/log-bucketed histograms + Prometheus
+  text exposition; the ONE registry implementation every surface
+  (serving engine, kube binaries, install exporter) shares.
+- `obs.trace` — bounded event ring + per-request lifecycle spans with
+  Chrome trace-event export.
+- `obs.profile` — jax.profiler capture window gated on the dispatch
+  loop.
+- `obs.catalog` — declarative list of every exported metric
+  (`hack/metrics_lint.py` holds it and docs/observability.md to each
+  other).
+- `obs.serving` — `ServingObs`, the bundle `models/serve.py` and the
+  demo server consume.
+
+See docs/observability.md for the exported-metric reference and the
+trace/profile how-to.
+"""
+
+from walkai_nos_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    log_buckets,
+)
+from walkai_nos_tpu.obs.profile import ProfileHook  # noqa: F401
+from walkai_nos_tpu.obs.serving import ServingObs  # noqa: F401
+from walkai_nos_tpu.obs.trace import RequestTrace, Ring  # noqa: F401

@@ -1,0 +1,256 @@
+"""The metric catalog: every metric this repo exports, declared once.
+
+Single source of truth three consumers share:
+
+- `obs/serving.py` builds the serving engine's instruments from the
+  `component="serving"` specs (no literal metric names in serve.py —
+  a name that isn't here doesn't exist);
+- `docs/observability.md` documents every row, and
+  `hack/metrics_lint.py` (the `make metrics-lint` / tier-1 gate)
+  asserts catalog and docs agree in BOTH directions, so a metric can
+  be neither added nor renamed silently;
+- the kube-side registrations (`kube/runtime.py` reconcile counters,
+  `cmd/metricsexporter.py` install gauges) are declared here too: the
+  lint scans the tree for literal registrations and rejects any name
+  missing from this catalog.
+
+Dependency-free on purpose (no jax, no yaml): the lint must import it
+anywhere, including doc-only CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from walkai_nos_tpu.obs.metrics import log_buckets
+
+__all__ = ["CATALOG", "MetricSpec", "serving_specs"]
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    kind: str  # counter | gauge | histogram
+    help: str
+    labels: tuple[str, ...] = ()
+    component: str = "serving"  # serving | kube | install | client
+    buckets: tuple[float, ...] | None = None
+    attr: str = ""  # ServingObs attribute name (serving specs only)
+
+
+# Sub-ms floor for decode-pace style latencies (TPOT on a fast chip is
+# ~0.1-0.5 ms/token); the engine's own dispatch sync sits in the ms
+# range; request walls run to the 120 s server timeout.
+_FAST = log_buckets(1e-4, 10.0)
+_MID = log_buckets(1e-4, 100.0)
+_SLOW = log_buckets(1e-3, 100.0)
+
+CATALOG: tuple[MetricSpec, ...] = (
+    # -- serving engine (models/serve.py via obs/serving.py) -----------
+    MetricSpec(
+        "cb_requests_submitted_total", "counter",
+        "Requests accepted by ContinuousBatcher.submit()",
+        attr="submitted",
+    ),
+    MetricSpec(
+        "cb_requests_completed_total", "counter",
+        "Finished requests by termination reason",
+        labels=("reason",),  # eos | budget
+        attr="completed",
+    ),
+    MetricSpec(
+        "cb_request_errors_total", "counter",
+        "Failed or rejected requests by reason",
+        # oversize_reject | pool_overflow | bad_request |
+        # generation_timeout | client_disconnect | engine_failure
+        labels=("reason",),
+        attr="errors",
+    ),
+    MetricSpec(
+        "cb_tokens_emitted_total", "counter",
+        "Generated tokens handed to the host across all requests",
+        attr="tokens",
+    ),
+    MetricSpec(
+        "cb_queue_depth", "gauge",
+        "Requests submitted but not yet admitted to a slot",
+        attr="queue_depth",
+    ),
+    MetricSpec(
+        "cb_slots_active", "gauge",
+        "Slots holding a live decoding request at the last dispatch",
+        attr="slots_active",
+    ),
+    MetricSpec(
+        "cb_prefill_lane_active", "gauge",
+        "Requests mid-prefill on the chunked prefill lane",
+        attr="lane_active",
+    ),
+    MetricSpec(
+        "cb_prefill_lane_rows_total", "counter",
+        "Prefill-lane rows carrying a real admission, summed over "
+        "lane dispatches",
+        attr="lane_rows",
+    ),
+    MetricSpec(
+        "cb_prefill_lane_row_capacity_total", "counter",
+        "Configured prefill-lane rows available, summed over lane "
+        "dispatches (utilization denominator)",
+        attr="lane_capacity",
+    ),
+    MetricSpec(
+        "cb_kv_pool_blocks", "gauge",
+        "Paged KV pool blocks by state (scratch block excluded)",
+        labels=("state",),  # free | used
+        attr="pool_blocks",
+    ),
+    MetricSpec(
+        "cb_kv_pool_blocks_min_free", "gauge",
+        "Low watermark of free pool blocks since engine start",
+        attr="pool_min_free",
+    ),
+    MetricSpec(
+        "cb_admission_stall_seconds_total", "counter",
+        "Cumulative host seconds inside admission work (dense mode: "
+        "blocking prefill+admit dispatches; paged: bookkeeping only)",
+        attr="stall",
+    ),
+    MetricSpec(
+        "cb_dispatches_total", "counter",
+        "Step-program dispatches issued",
+        attr="dispatches",
+    ),
+    MetricSpec(
+        "cb_dispatch_latency_seconds", "histogram",
+        "Dispatch issue to host sync of its chunk (includes one "
+        "chunk of pipelining overlap by design)",
+        buckets=_MID,
+        attr="dispatch_latency",
+    ),
+    MetricSpec(
+        "cb_ttft_seconds", "histogram",
+        "Submit to first token known to the host (its chunk sync)",
+        buckets=_SLOW,
+        attr="ttft",
+    ),
+    MetricSpec(
+        "cb_tpot_seconds", "histogram",
+        "Per-request mean time per output token after the first "
+        "(decode pace)",
+        buckets=_FAST,
+        attr="tpot",
+    ),
+    MetricSpec(
+        "cb_request_wall_seconds", "histogram",
+        "Submit to completion wall time per finished request",
+        buckets=_SLOW,
+        attr="wall",
+    ),
+    MetricSpec(
+        "cb_busy_slot_steps_total", "counter",
+        "Slot-steps dispatched with a live request in the slot",
+        attr="busy_steps",
+    ),
+    MetricSpec(
+        "cb_slot_steps_total", "counter",
+        "Slot-steps dispatched in total (occupancy denominator)",
+        attr="total_steps",
+    ),
+    MetricSpec(
+        "cb_kv_dispatch_bytes_total", "counter",
+        "Sum over dispatches of KV cache bytes backing resident "
+        "tokens (dispatch-weighted-average numerator)",
+        attr="kv_bytes",
+    ),
+    MetricSpec(
+        "cb_kv_dispatch_resident_tokens_total", "counter",
+        "Sum over dispatches of resident tokens "
+        "(dispatch-weighted-average denominator)",
+        attr="kv_resident",
+    ),
+    MetricSpec(
+        "cb_kv_bytes_per_resident_token", "gauge",
+        "Latest per-dispatch snapshot of KV cache HBM bytes backing "
+        "each resident token",
+        attr="kv_ratio",
+    ),
+    MetricSpec(
+        "cb_last_dispatch_unixtime_seconds", "gauge",
+        "Unix time of the most recent engine dispatch (scrape-side "
+        "staleness = now - value)",
+        attr="last_dispatch",
+    ),
+    # -- kube binaries (kube/runtime.py via health.Metrics) ------------
+    MetricSpec(
+        "nos_reconcile_total", "counter",
+        "Reconciliations per controller and outcome",
+        labels=("controller", "result"),
+        component="kube",
+    ),
+    MetricSpec(
+        "nos_reconcile_seconds_sum", "counter",
+        "Cumulative reconcile wall time",
+        labels=("controller",),
+        component="kube",
+    ),
+    # -- demo bench client (demos/tpu-sharing-comparison/client) -------
+    MetricSpec(
+        "inference_time_seconds_sum", "counter",
+        "Cumulative inference seconds per target (summary numerator; "
+        "reference-repo comparison query shape)",
+        labels=("target",),
+        component="client",
+    ),
+    MetricSpec(
+        "inference_time_seconds_count", "counter",
+        "Completed inference requests per target (summary denominator)",
+        labels=("target",),
+        component="client",
+    ),
+    MetricSpec(
+        "inference_errors_total", "counter",
+        "Failed inference requests per target",
+        labels=("target",),
+        component="client",
+    ),
+    # -- install exporter (cmd/metricsexporter.py) ---------------------
+    MetricSpec(
+        "nos_install_info", "gauge",
+        "Install identity (value is always 1)",
+        labels=("installation_uuid",),
+        component="install",
+    ),
+    MetricSpec(
+        "nos_install_component_enabled", "gauge",
+        "1 if the chart component is enabled, else 0",
+        labels=("component",),
+        component="install",
+    ),
+    MetricSpec(
+        "nos_install_node_capacity", "gauge",
+        "Node capacity by resource, parsed from the Kube quantity",
+        labels=("node", "resource"),
+        component="install",
+    ),
+    MetricSpec(
+        "nos_install_nodes", "gauge",
+        "Nodes in the install inventory",
+        component="install",
+    ),
+)
+
+
+def serving_specs() -> tuple[MetricSpec, ...]:
+    return tuple(s for s in CATALOG if s.component == "serving")
+
+
+def _check() -> None:
+    names = [s.name for s in CATALOG]
+    if len(names) != len(set(names)):
+        raise ValueError("duplicate metric names in CATALOG")
+    attrs = [s.attr for s in serving_specs()]
+    if "" in attrs or len(attrs) != len(set(attrs)):
+        raise ValueError("serving specs need unique non-empty attrs")
+
+
+_check()

@@ -1,0 +1,110 @@
+"""Optional `jax.profiler` capture window, gated on the dispatch loop.
+
+A device-level profile (XLA traces, TensorBoard-viewable) of exactly N
+serving dispatches: arm the hook (by env at process start, or live via
+the demo server's `/debug/profile` endpoint), and the engine's next
+dispatch starts `jax.profiler.start_trace(logdir)`; after `n`
+dispatches the trace stops and the capture lands in `logdir`
+(inspect with `tensorboard --logdir` or xprof).
+
+Everything is fail-safe: a missing/broken jax.profiler records the
+error in `status()` and disarms instead of taking the serving loop
+down — profiling is a diagnostic, never a liveness risk. The
+unarmed-path cost is one attribute check per dispatch.
+
+Env knobs (read by `ProfileHook.from_env`, i.e. at engine start):
+- WALKAI_PROFILE_DIR: capture directory; arming requires it.
+- WALKAI_PROFILE_DISPATCHES: window length in dispatches (default 20).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["ProfileHook"]
+
+
+class ProfileHook:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._logdir: str | None = None
+        self._remaining = 0
+        self._active = False
+        self._completed = 0  # capture windows finished
+        self._last_error: str | None = None
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "ProfileHook":
+        hook = cls()
+        logdir = env.get("WALKAI_PROFILE_DIR")
+        if logdir:
+            try:
+                n = int(env.get("WALKAI_PROFILE_DISPATCHES", "20"))
+            except ValueError:
+                n = 20
+            hook.arm(n, logdir)
+        return hook
+
+    def arm(self, dispatches: int, logdir: str) -> None:
+        """Schedule a capture of the next `dispatches` dispatches.
+        Re-arming while a window is active is rejected (the running
+        window finishes first)."""
+        if dispatches <= 0:
+            raise ValueError(
+                f"dispatches must be > 0; got {dispatches}"
+            )
+        if not logdir:
+            raise ValueError("logdir required")
+        with self._lock:
+            if self._active:
+                raise RuntimeError("capture window already active")
+            self._logdir = logdir
+            self._remaining = int(dispatches)
+
+    def on_dispatch(self) -> None:
+        """Engine hook, called once per dispatch. Fast path (unarmed):
+        one lock-free attribute check."""
+        if self._remaining == 0 and not self._active:
+            return
+        with self._lock:
+            if self._remaining > 0 and not self._active:
+                if self._start(self._logdir):
+                    self._active = True
+                else:
+                    self._remaining = 0  # disarm on failure
+                    return
+            if self._active:
+                self._remaining -= 1
+                if self._remaining <= 0:
+                    self._stop()
+                    self._active = False
+                    self._completed += 1
+
+    def _start(self, logdir: str) -> bool:
+        try:
+            import jax
+
+            jax.profiler.start_trace(logdir)
+            return True
+        except Exception as e:  # noqa: BLE001 — diagnostics must not kill serving
+            self._last_error = f"start_trace: {e!r}"
+            return False
+
+    def _stop(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            self._last_error = f"stop_trace: {e!r}"
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "active": self._active,
+                "remaining_dispatches": self._remaining,
+                "logdir": self._logdir,
+                "completed_windows": self._completed,
+                "last_error": self._last_error,
+            }

@@ -1,0 +1,72 @@
+"""ServingObs: the serving engine's telemetry bundle.
+
+One object carrying the three obs legs the continuous batcher and the
+demo server share:
+
+- `registry` + one instrument attribute per `component="serving"`
+  catalog spec (`obs.submitted.inc()`, `obs.ttft.observe(...)`, ...) —
+  built from `obs/catalog.py`, so serve.py contains no literal metric
+  names and `make metrics-lint` can hold the catalog and the docs to
+  each other;
+- `trace`: the request-lifecycle span recorder + event ring
+  (`/debug/trace` serves its Chrome export);
+- `profile`: the jax.profiler capture-window hook (armed by env or
+  `/debug/profile`), ticked once per engine dispatch.
+
+`enabled=False` builds the whole bundle in no-op mode: every write
+short-circuits on one flag check, reads return zeros/None. That arm
+exists to be MEASURED — `bench_lm.measure_obs_overhead` runs the same
+workload with both bundles and reports `obs_overhead_pct`, gated < 2%
+by `make bench-check`.
+"""
+
+from __future__ import annotations
+
+from walkai_nos_tpu.obs.catalog import serving_specs
+from walkai_nos_tpu.obs.metrics import Registry
+from walkai_nos_tpu.obs.profile import ProfileHook
+from walkai_nos_tpu.obs.trace import RequestTrace
+
+__all__ = ["ServingObs"]
+
+
+class ServingObs:
+    def __init__(
+        self,
+        *,
+        enabled: bool = True,
+        registry: Registry | None = None,
+        trace_events: int = 4096,
+        trace_requests: int = 1024,
+        profile: ProfileHook | None = None,
+    ):
+        self.enabled = enabled
+        self.registry = registry or Registry(enabled=enabled)
+        self.trace = RequestTrace(
+            capacity=trace_events,
+            keep_done=trace_requests,
+            enabled=enabled,
+        )
+        if profile is not None:
+            self.profile = profile
+        elif enabled:
+            self.profile = ProfileHook.from_env()
+        else:
+            # The no-op bundle must be a REAL no-op: never let ambient
+            # WALKAI_PROFILE_* env arm a capture on a
+            # telemetry-disabled engine (or bias the overhead A/B's
+            # disabled arm).
+            self.profile = ProfileHook()
+        for spec in serving_specs():
+            if spec.kind == "counter":
+                inst = self.registry.counter(spec.name, spec.help)
+            elif spec.kind == "gauge":
+                inst = self.registry.gauge(spec.name, spec.help)
+            else:
+                inst = self.registry.histogram(
+                    spec.name, spec.help, buckets=spec.buckets
+                )
+            setattr(self, spec.attr, inst)
+
+    def render(self) -> str:
+        return self.registry.render()

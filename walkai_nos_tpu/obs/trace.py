@@ -1,0 +1,344 @@
+"""Request-lifecycle tracing: bounded event ring + per-request spans.
+
+Two complementary views of the serving engine, both host-side and
+bounded (a long-running server must never grow telemetry without
+limit):
+
+- **Event ring** (`Ring`): a fixed-capacity circular buffer of raw
+  engine events (submits, admissions, prefill chunks, dispatches,
+  errors). Wraparound overwrites the oldest entry; `snapshot()`
+  returns survivors oldest-first. This is the "what just happened"
+  flight recorder — cheap enough to leave on in production.
+- **Lifecycle spans** (`RequestTrace`): per-request timelines
+  (submit -> queued -> prefill chunk(s) -> first token -> decode ->
+  done/error) keyed by request id, retained for the last
+  `keep_done` finished requests. The span clock is the CALLER's
+  timestamp, not a second `time.monotonic()` read: the engine passes
+  the exact floats it stores on the request record, so
+  `ttft_s`/`wall_s` reconstructed here equal `drain_done_records()`
+  values EXACTLY (pinned by tests/test_obs.py) — the trace is the
+  same truth, not a parallel approximation.
+
+`chrome_trace()` exports both as Chrome trace-event JSON (the
+`chrome://tracing` / Perfetto format: one process, one track per
+request, duration events for the queued/prefill/decode phases,
+instant events for chunks and ring entries) — load the
+`/debug/trace` payload straight into a trace viewer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+
+__all__ = ["Ring", "RequestTrace"]
+
+# Lifecycle phase names (span event keys, also the Chrome track names).
+SUBMIT = "submit"
+ADMITTED = "admitted"
+PREFILL_CHUNK = "prefill_chunk"
+FIRST_TOKEN = "first_token"
+DONE = "done"
+ERROR = "error"
+
+
+class Ring:
+    """Fixed-capacity circular buffer. Appends are O(1); once full,
+    each append overwrites the oldest entry (`dropped` counts how
+    many were lost). `snapshot()` returns entries oldest-first."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be > 0; got {capacity}")
+        self.capacity = capacity
+        self._buf: list = [None] * capacity
+        self._next = 0  # next write position
+        self._count = 0  # lifetime appends
+        self._lock = threading.Lock()
+
+    def append(self, item) -> None:
+        with self._lock:
+            self._buf[self._next] = item
+            self._next = (self._next + 1) % self.capacity
+            self._count += 1
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self._count - self.capacity)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._count, self.capacity)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            if self._count <= self.capacity:
+                return [x for x in self._buf[: self._count]]
+            # Full: oldest sits at the write cursor.
+            return self._buf[self._next:] + self._buf[: self._next]
+
+
+class RequestTrace:
+    """Per-request lifecycle spans + the raw event ring.
+
+    All record methods take the event time `t` (the engine's
+    `time.monotonic()` read) explicitly — see the module docstring
+    for why. Completed spans are retained newest-last up to
+    `keep_done`; live spans are never evicted (their count is bounded
+    by the engine's slots + queue)."""
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        keep_done: int = 1024,
+        enabled: bool = True,
+    ):
+        self.enabled = enabled
+        self.ring = Ring(capacity)
+        self._keep_done = keep_done
+        self._lock = threading.Lock()
+        self._spans: "OrderedDict[int, dict]" = OrderedDict()
+        self._done_rids: deque[int] = deque()
+
+    # -- recording -----------------------------------------------------
+
+    def event(self, name: str, t: float, rid=None, **args) -> None:
+        """Raw ring event (no span bookkeeping)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "t": t}
+        if rid is not None:
+            ev["rid"] = rid
+        if args:
+            ev["args"] = args
+        self.ring.append(ev)
+
+    def submit(
+        self, rid: int, t: float, prompt_len: int, max_new: int
+    ) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans[rid] = {
+                "rid": rid,
+                SUBMIT: t,
+                "prompt_len": prompt_len,
+                "max_new": max_new,
+                "chunks": [],
+            }
+        self.event(
+            SUBMIT, t, rid=rid, prompt_len=prompt_len, max_new=max_new
+        )
+
+    def admitted(self, rid: int, t: float, slot: int, blocks: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is not None:
+                span[ADMITTED] = t
+                span["slot"] = slot
+                span["blocks"] = blocks
+        self.event(ADMITTED, t, rid=rid, slot=slot, blocks=blocks)
+
+    def prefill_chunk(
+        self, rid: int, t: float, consumed: int, total: int
+    ) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is not None:
+                span["chunks"].append((t, consumed))
+        self.event(
+            PREFILL_CHUNK, t, rid=rid, consumed=consumed, total=total
+        )
+
+    def first_token(self, rid: int, t: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is not None and FIRST_TOKEN not in span:
+                span[FIRST_TOKEN] = t
+
+    def _finish_locked(self, span: dict, t: float, reason: str) -> None:
+        """Close a span and evict beyond the retention bound — the ONE
+        retention rule both terminal paths share. Caller holds the
+        lock."""
+        span[DONE] = t
+        span["reason"] = reason
+        self._done_rids.append(span["rid"])
+        while len(self._done_rids) > self._keep_done:
+            self._spans.pop(self._done_rids.popleft(), None)
+
+    def done(
+        self, rid: int, t: float, reason: str, n_tokens: int
+    ) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is not None:
+                span["n_tokens"] = n_tokens
+                self._finish_locked(span, t, reason)
+        self.event(DONE, t, rid=rid, reason=reason, n_tokens=n_tokens)
+
+    def error(self, t: float, reason: str, rid=None, **args) -> None:
+        """Errors may predate a request id (submit-time rejects)."""
+        if not self.enabled:
+            return
+        if rid is not None:
+            with self._lock:
+                span = self._spans.get(rid)
+                if span is not None:
+                    self._finish_locked(span, t, f"error:{reason}")
+        self.event(ERROR, t, rid=rid, reason=reason, **args)
+
+    # -- reading -------------------------------------------------------
+
+    def timeline(self, rid: int) -> dict | None:
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is None:
+                return None
+            out = dict(span)
+            out["chunks"] = list(span["chunks"])
+            return out
+
+    def ttft_s(self, rid: int) -> float | None:
+        """submit -> first token, from the span clock — equals the
+        engine's `drain_done_records()["ttft_s"]` exactly."""
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is None or FIRST_TOKEN not in span:
+                return None
+            return span[FIRST_TOKEN] - span[SUBMIT]
+
+    def wall_s(self, rid: int) -> float | None:
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is None or DONE not in span:
+                return None
+            return span[DONE] - span[SUBMIT]
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return [
+                {**s, "chunks": list(s["chunks"])}
+                for s in self._spans.values()
+            ]
+
+    # -- export --------------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (`chrome://tracing` / Perfetto).
+
+        One process ("cb-engine"), one track (tid) per request id.
+        Phases become duration events ("ph": "X"): queued
+        (submit -> admitted, or -> first token when admission isn't
+        traced), prefill (admitted -> first token), decode
+        (first token -> done). Prefill chunks and raw ring events are
+        instants ("ph": "i"). Timestamps are microseconds relative to
+        the earliest event, per the format."""
+        spans = self.spans()
+        events = self.ring.snapshot()
+        times = [s[SUBMIT] for s in spans] + [e["t"] for e in events]
+        if not times:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
+        t0 = min(times)
+
+        def us(t: float) -> int:
+            return int(round((t - t0) * 1e6))
+
+        out = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 1,
+                "args": {"name": "cb-engine"},
+            }
+        ]
+        for s in spans:
+            rid = s["rid"]
+            meta = {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": rid,
+                "args": {"name": f"request {rid}"},
+            }
+            out.append(meta)
+            submit = s[SUBMIT]
+            admitted = s.get(ADMITTED)
+            first = s.get(FIRST_TOKEN)
+            done = s.get(DONE)
+            queued_end = admitted or first or done
+            if queued_end is not None:
+                out.append({
+                    "name": "queued",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": rid,
+                    "ts": us(submit),
+                    "dur": max(0, us(queued_end) - us(submit)),
+                    "args": {
+                        "prompt_len": s.get("prompt_len"),
+                        "max_new": s.get("max_new"),
+                    },
+                })
+            if admitted is not None and first is not None:
+                out.append({
+                    "name": "prefill",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": rid,
+                    "ts": us(admitted),
+                    "dur": max(0, us(first) - us(admitted)),
+                    "args": {
+                        "slot": s.get("slot"),
+                        "blocks": s.get("blocks"),
+                        "chunks": len(s["chunks"]),
+                    },
+                })
+            for t, consumed in s["chunks"]:
+                out.append({
+                    "name": "prefill_chunk",
+                    "ph": "i",
+                    "s": "t",
+                    "pid": 1,
+                    "tid": rid,
+                    "ts": us(t),
+                    "args": {"consumed": consumed},
+                })
+            if first is not None and done is not None:
+                out.append({
+                    "name": "decode",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": rid,
+                    "ts": us(first),
+                    "dur": max(0, us(done) - us(first)),
+                    "args": {
+                        "reason": s.get("reason"),
+                        "n_tokens": s.get("n_tokens"),
+                    },
+                })
+        for e in events:
+            if e["name"] in (SUBMIT, ADMITTED, PREFILL_CHUNK, DONE):
+                continue  # already represented as span structure
+            out.append({
+                "name": e["name"],
+                "ph": "i",
+                "s": "g",
+                "pid": 1,
+                "tid": e.get("rid", 0),
+                "ts": us(e["t"]),
+                "args": e.get("args", {}),
+            })
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_ring_events": self.ring.dropped},
+        }
