@@ -575,10 +575,16 @@ def cb_serving_benchmark() -> dict:
     demo server's HTTP /generate — TTFT, per-token pace, tail
     latency, goodput, slot occupancy (`bench_lm.measure_cb_serving`).
     Spawns its own server (chip-exclusive), so it runs as its own
-    phase after decode."""
-    from bench_lm import measure_cb_serving
+    phase after decode. The `prefix_reuse` variant rides along: the
+    same server stack under the templated-prompt workload (N requests
+    over K shared prefixes), emitting `cb_prefix_hit_rate` and
+    `cb_prefill_tokens_saved_frac` — the shared-prefix KV cache's
+    headline keys (BASELINE.json gates both as `absent_ok` specs)."""
+    from bench_lm import measure_cb_prefix_reuse, measure_cb_serving
 
-    return measure_cb_serving()
+    out = measure_cb_serving()
+    out.update(measure_cb_prefix_reuse())
+    return out
 
 
 def obs_overhead_benchmark() -> dict:
@@ -631,7 +637,8 @@ def main() -> None:
             "decode_gqa_roofline_fraction", "decode_tokens_per_dispatch",
             "cb_vs_serial_speedup", "cb_ttft_p50", "cb_token_p99",
             "cb_serving_capacity_tokens_per_s", "cb_admission_stall_ms",
-            "cb_kv_hbm_bytes_per_resident_token", "obs_overhead_pct",
+            "cb_kv_hbm_bytes_per_resident_token", "cb_prefix_hit_rate",
+            "cb_prefill_tokens_saved_frac", "obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )
         if k in result

@@ -541,6 +541,7 @@ def measure_cb_serving(
     from walkai_nos_tpu.utils.httpbench import (
         get_json,
         kill_server,
+        post_json,
         spawn_server,
     )
 
@@ -559,14 +560,7 @@ def measure_cb_serving(
     rng = np.random.default_rng(0)
 
     def post(payload: dict, timeout: float = 150.0) -> dict:
-        req = urllib.request.Request(
-            f"{base}/generate",
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read())
+        return post_json(f"{base}/generate", payload, timeout=timeout)
 
     def payload_of(r) -> dict:
         plen = int(r.integers(4, prompt_bucket // 2 + 1))
@@ -805,6 +799,149 @@ def measure_cb_serving(
         "cb_serving_slots": slots,
         "cb_serving_vocab": vocab,
         "cb_serving_measure_s": round(window_s, 1),
+    }
+
+
+def measure_cb_prefix_reuse(
+    *,
+    n_requests: int = 64,
+    n_templates: int = 4,
+    prefix_tokens: int = 512,
+    suffix_max: int = 24,
+    max_new: int = 32,
+    slots: int = 16,
+    vocab: int = 512,
+    concurrency: int = 8,
+    server_env: dict | None = None,
+    startup_timeout_s: float = 420.0,
+) -> dict:
+    """Templated-prompt serving workload for the shared-prefix KV
+    cache (`models/prefix_cache.py`): `n_requests` requests drawn
+    round-robin from `n_templates` shared `prefix_tokens`-token
+    prefixes, each with a short unique suffix — the ROADMAP's
+    millions-of-users profile (few distinct system prompts, heavy
+    reuse). One request per template runs first (the cold fills), the
+    rest fire through a small thread pool against the demo server's
+    /generate with `WALKAI_CB_PREFIX_CACHE=1`.
+
+    Headline keys (gated `absent_ok` in BASELINE.json until a chip
+    run records them):
+
+    - `cb_prefix_hit_rate`: full-prompt-block cache hit rate over the
+      whole workload, from the server's `/stats` `cb_prefix` deltas
+      (acceptance floor: > 0.5 at 64 requests over 4 templates);
+    - `cb_prefill_tokens_saved_frac`: fraction of admitted prompt
+      tokens the chunked prefill lane never had to compute.
+    """
+    import threading
+
+    from walkai_nos_tpu.utils.httpbench import (
+        get_json,
+        kill_server,
+        post_json,
+        spawn_server,
+    )
+
+    env = {
+        "WALKAI_DEMO_MODEL": "tiny",
+        "WALKAI_LM_MODEL": "small",
+        "WALKAI_DEMO_LM": "1",
+        "WALKAI_DEMO_CB": "1",
+        "WALKAI_CB_PAGED": "1",
+        "WALKAI_CB_PREFIX_CACHE": "1",
+        "WALKAI_LM_VOCAB": str(vocab),
+        "WALKAI_CB_SLOTS": str(slots),
+        # The server sizes cache_len from bucket + max_new; the bucket
+        # must cover the longest templated prompt.
+        "WALKAI_CB_BUCKET": str(prefix_tokens + suffix_max),
+        "WALKAI_LM_MAX_NEW": str(max_new),
+        **(server_env or {}),
+    }
+    proc, base = spawn_server(env, startup_timeout_s=startup_timeout_s)
+    rng = np.random.default_rng(0)
+    templates = [
+        rng.integers(0, vocab, prefix_tokens).tolist()
+        for _ in range(n_templates)
+    ]
+
+    def post(payload: dict, timeout: float = 150.0) -> dict:
+        return post_json(f"{base}/generate", payload, timeout=timeout)
+
+    def payload_of(i: int) -> dict:
+        suffix = rng.integers(
+            0, vocab, int(rng.integers(1, suffix_max + 1))
+        ).tolist()
+        return {
+            "prompt": templates[i % n_templates] + suffix,
+            "max_new_tokens": max_new,
+        }
+
+    n_tokens = [0]
+    errors = [0]
+    lock = threading.Lock()
+    # All payloads drawn up front on ONE thread: np.random.Generator
+    # is not thread-safe, and the workload must be deterministic
+    # run-to-run for a key gated against a BASELINE.json floor.
+    payloads = [payload_of(i) for i in range(n_requests)]
+    try:
+        stats0 = get_json(f"{base}/stats").get("cb_prefix", {})
+        # Cold fills: one request per template, sequential, so every
+        # template's prefix blocks are resident and ready before the
+        # measured fan-out.
+        for p in payloads[:n_templates]:
+            post(p)
+
+        def worker(mine: list[dict]) -> None:
+            for p in mine:
+                try:
+                    out = post(p)
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                    continue
+                with lock:
+                    n_tokens[0] += len(out["tokens"])
+
+        rest = payloads[n_templates:]
+        threads = [
+            threading.Thread(
+                target=worker, args=(rest[w::concurrency],), daemon=True
+            )
+            for w in range(concurrency)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        window_s = time.perf_counter() - t0
+        stats1 = get_json(f"{base}/stats").get("cb_prefix", {})
+    finally:
+        kill_server(proc)
+
+    def delta(key: str) -> float:
+        return (stats1.get(key, 0) or 0) - (stats0.get(key, 0) or 0)
+
+    hits = delta("block_hits")
+    lookups = hits + delta("block_misses")
+    saved = delta("prefill_tokens_saved")
+    prompt_tokens = delta("prompt_tokens")
+    return {
+        "cb_prefix_hit_rate": (
+            round(hits / lookups, 4) if lookups else None
+        ),
+        "cb_prefill_tokens_saved_frac": (
+            round(saved / prompt_tokens, 4) if prompt_tokens else None
+        ),
+        "cb_prefix_requests": n_requests,
+        "cb_prefix_templates": n_templates,
+        "cb_prefix_prefix_tokens": prefix_tokens,
+        "cb_prefix_evictions": int(delta("evictions")),
+        "cb_prefix_request_errors": errors[0],
+        "cb_prefix_reuse_tokens_per_s": (
+            round(n_tokens[0] / window_s, 1) if window_s > 0 else None
+        ),
+        "cb_prefix_cache_enabled": bool(stats1.get("enabled")),
     }
 
 

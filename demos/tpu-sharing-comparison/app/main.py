@@ -377,11 +377,26 @@ def main() -> None:
             else "small",
         )
         lm_cfg = LM_TINY if lm_choice == "tiny" else LM_SMALL
-        if os.environ.get("WALKAI_LM_VOCAB"):
+        if os.environ.get("WALKAI_LM_VOCAB") or os.environ.get(
+            "WALKAI_LM_SEQ"
+        ):
             import dataclasses as _dcv
 
+            # WALKAI_LM_SEQ stretches max_seq_len the same way
+            # WALKAI_LM_VOCAB shrinks the vocab: the prefix-reuse
+            # bench needs >= 129-token prompts (a full shareable
+            # cache block) on the tiny CPU model whose default
+            # context is 128.
             lm_cfg = _dcv.replace(
-                lm_cfg, vocab_size=int(os.environ["WALKAI_LM_VOCAB"])
+                lm_cfg,
+                vocab_size=int(
+                    os.environ.get("WALKAI_LM_VOCAB")
+                    or lm_cfg.vocab_size
+                ),
+                max_seq_len=int(
+                    os.environ.get("WALKAI_LM_SEQ")
+                    or lm_cfg.max_seq_len
+                ),
             )
         lm_params = jax.device_put(
             DecoderLM(lm_cfg).init_params(jax.random.PRNGKey(0))
@@ -467,6 +482,13 @@ def main() -> None:
                 prefill_chunk=int(
                     os.environ.get("WALKAI_CB_PFCHUNK", "64")
                 ),
+                # Shared-prefix KV reuse (models/prefix_cache.py):
+                # templated prompts share refcounted prefix blocks and
+                # skip their prefill. 0 restores the exclusive pool
+                # (the bench's cold-start baseline arm).
+                prefix_cache=os.environ.get(
+                    "WALKAI_CB_PREFIX_CACHE", "1"
+                ) == "1",
                 obs=obs,
             )
             # Compile prefill + chunk step off the request path.
@@ -540,6 +562,9 @@ def main() -> None:
                             waiter["tokens"] = rec["tokens"]
                             waiter["ttft_s"] = rec["ttft_s"]
                             waiter["wall_s"] = rec["wall_s"]
+                            waiter["truncated"] = rec.get(
+                                "truncated", False
+                            )
                             if waiter.get("queue") is not None:
                                 waiter["queue"].put(None)  # end of stream
                             waiter["done"].set()
@@ -890,6 +915,11 @@ def main() -> None:
                         "slice": slice_id,
                         "batched": True,
                         "cb_slots": cb_slots,
+                        # True when the output was cut at a KV-pool
+                        # boundary (engine pool_overflow truncation) —
+                        # fewer tokens than requested is then a
+                        # capacity signal, not a natural completion.
+                        "truncated": waiter.get("truncated", False),
                     })
                 except (BrokenPipeError, ConnectionResetError):
                     # Client gave up before the response: the work was
@@ -1007,6 +1037,9 @@ def main() -> None:
                                 ),
                                 "slice": slice_id,
                                 "batched": True,
+                                "truncated": waiter.get(
+                                    "truncated", False
+                                ),
                             })
                         return
                     event({"tokens": item})
@@ -1060,6 +1093,7 @@ def main() -> None:
                 if cb_engine is not None:
                     payload["cb_occupancy"] = cb_engine.occupancy()
                     payload["cb_kv"] = cb_engine.kv_stats()
+                    payload["cb_prefix"] = cb_engine.prefix_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

@@ -19,11 +19,13 @@ default 25% band) or a spec:
   recorded round) — skipped with a note, never a failure, so new
   metrics can be declared before a chip run exists to anchor them.
 - "absent_ok": true — a BUDGET key (e.g. obs_overhead_pct's absolute
-  < 2% ceiling with tolerance 0): when the key is missing from the
-  bench output (the recorded artifact predates the key), skip with a
-  note instead of failing; once a bench run emits it, the band is
-  enforced like any other. This is how an absolute gate ships before
-  the next chip run records a measurement.
+  < 2% ceiling with tolerance 0, or the prefix cache's
+  cb_prefix_hit_rate / cb_prefill_tokens_saved_frac acceptance
+  floors): when the key is missing from the bench output (the
+  recorded artifact predates the key), skip with a note instead of
+  failing; once a bench run emits it, the band is enforced like any
+  other. This is how an absolute gate ships before the next chip run
+  records a measurement.
 """
 
 from __future__ import annotations

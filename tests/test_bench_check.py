@@ -103,6 +103,38 @@ class TestCheckLogic:
         assert spec["tolerance"] == 0.0
         assert spec["absent_ok"] is True
 
+    def test_repo_baseline_gates_prefix_cache_keys(self):
+        """BASELINE.json carries the shared-prefix cache's two
+        headline keys as absent_ok acceptance floors, and the specs
+        PARSE through the comparator: absent from the bench output is
+        a skip note, a value below the floor fails once emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        keys = ("cb_prefix_hit_rate", "cb_prefill_tokens_saved_frac")
+        for key in keys:
+            spec = published[key]
+            assert spec["direction"] == "higher"
+            assert spec["tolerance"] == 0.0
+            assert spec["absent_ok"] is True
+            assert spec["value"] >= 0.5
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert sum("absent" in n for n in notes) == 2
+        failures, _ = bench_check.check(
+            {"cb_prefix_hit_rate": 0.9,
+             "cb_prefill_tokens_saved_frac": 0.8},
+            base,
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"cb_prefix_hit_rate": 0.2,
+             "cb_prefill_tokens_saved_frac": 0.8},
+            base,
+        )
+        assert len(failures) == 1
+        assert "cb_prefix_hit_rate" in failures[0]
+
     def test_bare_number_baseline_defaults_higher(self):
         failures, _ = bench_check.check(
             {"x": 70.0}, {"published": {"x": 100.0}}

@@ -101,6 +101,40 @@ def test_cb_serving_benchmark_runs_end_to_end(monkeypatch):
     assert "cb_serving_capacity_tokens_per_s" in src
 
 
+def test_cb_prefix_reuse_benchmark_runs_end_to_end(monkeypatch):
+    """The templated-prefix serving workload
+    (`bench_lm.measure_cb_prefix_reuse`) must execute on the tiny CPU
+    model and emit its two headline keys with the deterministic
+    cold/warm split: 2 templates fill cold (1 shareable block each),
+    the remaining 6 requests hit — hit rate exactly 6/8."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from bench_lm import measure_cb_prefix_reuse
+
+    r = measure_cb_prefix_reuse(
+        n_requests=8, n_templates=2, prefix_tokens=160, suffix_max=8,
+        max_new=8, slots=2, vocab=64, concurrency=2,
+        server_env={
+            "WALKAI_LM_MODEL": "tiny",
+            "WALKAI_LM_SEQ": "512",
+            "WALKAI_CALIB_WINDOW_S": "0.2",
+        },
+        startup_timeout_s=300.0,
+    )
+    assert r["cb_prefix_cache_enabled"] is True
+    assert r["cb_prefix_request_errors"] == 0
+    assert r["cb_prefix_hit_rate"] == 0.75
+    assert r["cb_prefill_tokens_saved_frac"] > 0.4
+    assert r["cb_prefix_evictions"] == 0
+    # Both keys are headline keys in bench.py's emitted line.
+    import inspect
+
+    import bench
+
+    src = inspect.getsource(bench.main)
+    assert "cb_prefix_hit_rate" in src
+    assert "cb_prefill_tokens_saved_frac" in src
+
+
 def test_decode_bench_emits_roofline_fields(monkeypatch):
     """The decode phase's new first-class fields — the roofline
     attainment of the measured attention chain and the dispatch

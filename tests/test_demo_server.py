@@ -257,6 +257,19 @@ class TestContinuousBatchingEndpoint:
             )[0].tolist()
             assert out["tokens"] == expect, (i, out["tokens"], expect)
 
+    def test_stats_expose_prefix_cache_section(self, cb_server):
+        """/stats carries the shared-prefix cache view (`cb_prefix`,
+        `ContinuousBatcher.prefix_stats()`) — on by default, with the
+        full key contract `measure_cb_prefix_reuse` differences."""
+        pre = get_json(f"{cb_server}/stats").get("cb_prefix")
+        assert pre is not None and pre["enabled"] is True
+        assert set(pre) >= {
+            "block_hits", "block_misses", "hit_rate", "evictions",
+            "cached_blocks", "parked_blocks", "cached_tokens",
+            "prefill_tokens_saved", "prompt_tokens",
+            "prefill_tokens_saved_frac",
+        }
+
     def test_sampled_generation(self, cb_server):
         _, out = self._post(
             cb_server,

@@ -5,10 +5,15 @@ greedy decode must be TOKEN-IDENTICAL to the dense cache and to
 standalone generation for mixed ragged lengths crossing 128-row block
 boundaries; the streaming feed must agree with the completion records
 (including mid-chunk EOS and budget exhaustion); the block allocator
-must recycle and bound the pool. Deliberately NOT in conftest's
-`_SLOW_FILES` (tests/test_serve.py is) — the fast control-plane loop
-must exercise the serving engine's correctness surface, so the shapes
-here stay tiny.
+must recycle and bound the pool. The shared-prefix KV cache
+(`models/prefix_cache.py`) adds its own surface: cache-hit outputs
+must be identical to cold serving (greedy AND sampled), refcounts
+must pin shared blocks exactly as long as a holder lives, eviction
+must be LRU and must never break a surviving prefix, and prompts
+that diverge inside a block must never share. Deliberately NOT in
+conftest's `_SLOW_FILES` (tests/test_serve.py is) — the fast
+control-plane loop must exercise the serving engine's correctness
+surface, so the shapes here stay tiny.
 """
 
 from collections import deque
@@ -155,3 +160,249 @@ class TestBlockAllocator:
     def test_pending_queue_is_a_deque(self, params):
         engine = ContinuousBatcher(CFG, params, slots=1, cache_len=128)
         assert isinstance(engine._pending, deque)
+
+
+class TestSubmitValidation:
+    def test_nonpositive_max_new_rejected(self, params):
+        """A degenerate budget must fail through the bad_request
+        taxonomy, not admit a request that can never emit a token."""
+        engine = ContinuousBatcher(CFG, params, slots=1, cache_len=128)
+        for bad in (0, -3):
+            with pytest.raises(ValueError, match="max_new_tokens"):
+                engine.submit(_prompt(4), max_new_tokens=bad)
+        assert engine.obs.errors.value(
+            labels={"reason": "bad_request"}
+        ) == 2
+        assert not engine.has_work
+
+    def test_empty_prompt_rejected(self, params):
+        engine = ContinuousBatcher(CFG, params, slots=1, cache_len=128)
+        with pytest.raises(ValueError, match="empty prompt"):
+            engine.submit([], max_new_tokens=4)
+        assert engine.obs.errors.value(
+            labels={"reason": "bad_request"}
+        ) == 1
+        assert not engine.has_work
+
+
+class TestPrefixReuse:
+    def test_shared_prefix_parity_and_park_reuse(self, params):
+        """A cache-hit request (prefix blocks parked by an earlier
+        completion) must emit exactly the tokens cold serving emits —
+        with and without the cache — and the hit must actually skip
+        the shared prefix's prefill."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=384, chunk_steps=3,
+            prefill_chunk=32, prefill_lanes=2,
+        )
+        p = _prompt(300, seed=31)  # 2 full shareable 128-token blocks
+        want = _expected(params, p, 10)
+        r0 = engine.submit(p, max_new_tokens=10)
+        assert engine.run()[r0] == want  # cold fill
+        r1 = engine.submit(p, max_new_tokens=10)
+        assert engine.run()[r1] == want  # served from parked blocks
+        st = engine.prefix_stats()
+        assert st["block_hits"] == 2
+        assert st["prefill_tokens_saved"] == 256
+        assert st["hit_rate"] == 0.5  # 2 hits / (2 + 2 cold misses)
+        # Divergent tail on a shared 256-token prefix.
+        p2 = np.concatenate([p[:256], _prompt(30, seed=77)])
+        r2 = engine.submit(p2, max_new_tokens=8)
+        assert engine.run()[r2] == _expected(params, p2, 8)
+        assert engine.prefix_stats()["block_hits"] == 4
+        # The cache-off engine agrees and never indexes anything.
+        cold = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=384, chunk_steps=3,
+            prefill_chunk=32, prefill_lanes=2, prefix_cache=False,
+        )
+        rc = cold.submit(p, max_new_tokens=10)
+        assert cold.run()[rc] == want
+        st = cold.prefix_stats()
+        assert st["enabled"] is False and st["cached_blocks"] == 0
+
+    def test_sampled_seeds_on_shared_prefix(self, params):
+        """Two sampled requests sharing a cached prefix but carrying
+        different seeds must each match their own cold-start output:
+        sharing K/V must not couple PRNG streams."""
+        p = _prompt(280, seed=90)
+        outs = {}
+        for prefix_cache in (True, False):
+            engine = ContinuousBatcher(
+                CFG, params, slots=2, cache_len=384, chunk_steps=4,
+                prefill_chunk=32, prefix_cache=prefix_cache,
+            )
+            warm = engine.submit(p, max_new_tokens=2)
+            engine.run()
+            rids = {
+                engine.submit(
+                    p, max_new_tokens=8, temperature=0.9, top_k=16,
+                    top_p=0.95, seed=seed,
+                ): seed
+                for seed in (5, 6)
+            }
+            res = engine.run()
+            outs[prefix_cache] = {
+                rids[r]: toks for r, toks in res.items() if r != warm
+            }
+            if prefix_cache:
+                assert engine.prefix_stats()["block_hits"] >= 4
+        assert outs[True] == outs[False]
+        assert outs[True][5] != outs[True][6]  # seeds still diverge
+
+    def test_mid_prefill_sharer_matches_only_ready_blocks(self, params):
+        """A second sharer admitted while the writer is still
+        mid-prefill may reuse exactly the blocks whose writing chunks
+        have already been DISPATCHED (`ready`), must prefill the rest
+        privately (the writer's registered-but-unready nodes dedup the
+        insert), and both outputs stay exact."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=384, chunk_steps=2,
+            prefill_chunk=64, prefill_lanes=2,
+        )
+        p = _prompt(300, seed=201)
+        ra = engine.submit(p, max_new_tokens=6)
+        for _ in range(3):  # 64-token chunks: block 0 ready, block 1 not
+            engine.step()
+        rb = engine.submit(p, max_new_tokens=6)
+        res: dict[int, list[int]] = {}
+        while engine.has_work:
+            engine.step()
+            res.update(engine.drain_done())
+        want = _expected(params, p, 6)
+        assert res[ra] == want
+        assert res[rb] == want
+        st = engine.prefix_stats()
+        assert st["block_hits"] == 1  # only the dispatched block
+        assert st["block_misses"] == 3  # A's 2 cold + B's unready one
+
+    def test_partial_block_divergence_never_shares(self, params):
+        """Prompts agreeing on only PART of a block share nothing: the
+        index is keyed by full-block content, so a 100-token common
+        prefix inside a 128-token block must miss (the trie-corruption
+        guard)."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=256, chunk_steps=3,
+            prefill_chunk=32,
+        )
+        a = _prompt(150, seed=1)
+        b = np.concatenate([a[:100], _prompt(50, seed=2)])
+        ra = engine.submit(a, max_new_tokens=6)
+        assert engine.run()[ra] == _expected(params, a, 6)
+        rb = engine.submit(b, max_new_tokens=6)
+        assert engine.run()[rb] == _expected(params, b, 6)
+        st = engine.prefix_stats()
+        assert st["block_hits"] == 0 and st["block_misses"] == 2
+
+    def test_refcount_lifecycle(self, params):
+        """Admit two sharers of a parked block: refcount 2 while both
+        live, 1 after the first releases (block pinned, NOT freed),
+        0 + parked after the second — then it is evictable."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=384, chunk_steps=2,
+            prefill_chunk=64,
+        )
+        p = _prompt(200, seed=9)  # 1 shareable block
+        engine.submit(p, max_new_tokens=2)
+        engine.run()
+        node = engine._prefix.match(p)[0]
+        assert node.refcount == 0
+        assert engine._prefix.parked_blocks == 1
+        r_short = engine.submit(p, max_new_tokens=2)
+        r_long = engine.submit(p, max_new_tokens=24)
+        records: dict[int, dict] = {}
+        while engine.has_work and r_short not in records:
+            engine.step()
+            records.update(engine.drain_done_records())
+        assert r_long not in records  # still holding the block
+        assert node.refcount == 1
+        assert node.block not in engine._free_blocks
+        while engine.has_work:
+            engine.step()
+            records.update(engine.drain_done_records())
+        assert records[r_long]["tokens"] == _expected(params, p, 24)
+        assert node.refcount == 0
+        assert node.block not in engine._free_blocks  # parked, not freed
+        assert engine._prefix.parked_blocks == 1
+        assert engine._prefix.evict_lru() == node.block  # evictable
+        assert engine._prefix.match(p) == []
+
+    def test_eviction_under_pressure_is_lru(self, params):
+        """With the free list dry, a mid-flight decode grab evicts the
+        LEAST recently used parked prefix — the older cached template
+        goes first, the newer one survives and still hits."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=384, chunk_steps=4,
+            prefill_chunk=32,
+        )  # pool: 3 allocatable blocks
+        p_old = _prompt(130, seed=101)
+        p_new = _prompt(130, seed=102)
+        for p in (p_old, p_new):
+            rid = engine.submit(p, max_new_tokens=2)
+            assert engine.run()[rid] == _expected(params, p, 2)
+        assert engine._prefix.parked_blocks == 2
+        # 250-row footprint: 1 free block for the prompt, the decode
+        # block must come from evicting exactly one parked prefix.
+        big = engine.submit(_prompt(10, seed=103), max_new_tokens=240)
+        assert len(engine.run()[big]) == 240
+        st = engine.prefix_stats()
+        assert st["evictions"] == 1
+        assert engine._prefix.match(p_old) == []  # LRU victim
+        assert len(engine._prefix.match(p_new)) == 1  # survivor
+        follow = np.concatenate([p_new[:128], _prompt(20, seed=104)])
+        rf = engine.submit(follow, max_new_tokens=4)
+        assert engine.run()[rf] == _expected(params, follow, 4)
+        assert engine.prefix_stats()["block_hits"] == 1
+
+
+class TestLazyDecodeAllocation:
+    def test_residency_grows_at_block_boundaries(self, params):
+        """Admission allocates only the prompt's blocks; decode blocks
+        appear as the write head crosses 128-row boundaries, and the
+        pool drains fully on completion — headroom reports actual
+        residency, not worst-case budgets."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=384, chunk_steps=4,
+            prefill_chunk=32,
+        )
+        p = _prompt(4, seed=55)
+        rid = engine.submit(p, max_new_tokens=300)  # 304 rows, 3 blocks
+        seen: set[int] = set()
+        out: dict[int, list[int]] = {}
+        while engine.has_work:
+            engine.step()
+            seen.add(engine.kv_stats()["kv_blocks_in_use"])
+            out.update(engine.drain_done())
+        assert out[rid] == _expected(params, p, 300)
+        assert {1, 2, 3} <= seen  # grew one boundary at a time
+        kv = engine.kv_stats()
+        assert kv["kv_blocks_in_use"] == 0
+        assert kv["kv_blocks_reserved"] == 0
+        assert sorted(engine._free_blocks) == [1, 2, 3]
+
+    def test_dry_pool_truncates_with_pool_overflow(self, params):
+        """The defensive valve: if a mid-flight grab finds the pool
+        truly dry (the reservation invariant broken from outside),
+        the request finishes AT ITS BACKED BOUNDARY — the emitted
+        prefix is still exact, the completion is labeled
+        pool_overflow, and the record carries truncated=True."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=384, chunk_steps=4,
+            prefill_chunk=32,
+        )
+        p = _prompt(4, seed=66)
+        rid = engine.submit(p, max_new_tokens=260)  # 3-block footprint
+        while not any(r is not None for r in engine._slot_req):
+            engine.step()
+        engine._free_blocks.clear()  # simulate external pool theft
+        records: dict[int, dict] = {}
+        while engine.has_work:
+            engine.step()
+            records.update(engine.drain_done_records())
+        rec = records[rid]
+        assert rec["truncated"] is True
+        # One 128-row block backs the 4-token prompt + 124 tokens.
+        assert len(rec["tokens"]) == 124
+        assert rec["tokens"] == _expected(params, p, 260)[:124]
+        assert engine.obs.completed.value(
+            labels={"reason": "pool_overflow"}
+        ) == 1

@@ -40,6 +40,39 @@ requests come and go):
   prefills. During its prefill a slot's decode-lane table row stays
   parked on the scratch block, so the two lanes never write the same
   block.
+- **Shared-prefix KV reuse** (`prefix_cache=True`, paged mode): the
+  pool is refcounted and content-addressed through a host-side radix
+  index over prompt token prefixes at 128-token block granularity
+  (`models/prefix_cache.py`, the RadixAttention / vLLM
+  prefix-caching move). At admission the index is walked: every
+  fully-matched full prompt block maps to the EXISTING physical
+  block (refcount++, zero HBM writes, zero prefill compute) and the
+  prefill lane starts at the first uncached token — a fully-cached
+  prefix collapses prefill to one chunk. Released prompt-prefix
+  blocks PARK in the index (refcount 0, LRU) instead of returning to
+  the free list; allocation evicts parked blocks leaf-first only
+  when the free list is dry. Decode-written blocks stay private — no
+  copy-on-write is ever needed, because shared blocks are by
+  construction full, immutable prompt blocks and the first
+  partially-filled block is always freshly allocated. Sharing is
+  EXACT, not approximate: a node's path spells the entire prefix at
+  absolute positions, and recomputing those rows would produce
+  bit-identical K/V (each row is a deterministic per-position
+  function of the prefix), so a cache-hit request's output is
+  token-identical to serving it cold (tests/test_serve_paged.py).
+- **Lazy decode-block allocation**: admission allocates only the
+  blocks the PROMPT needs (minus cached ones); each decode block is
+  grabbed when the write head is about to cross a 128-row boundary,
+  so pool residency tracks tokens actually written, not worst-case
+  budgets. Admission still reserves the worst case *virtually* (the
+  accounting that kept PR 2's no-starvation guarantee — a request
+  never admits unless free + parked blocks cover every admitted
+  request's remaining worst case), so a mid-flight grab can always
+  be satisfied from the free list or by evicting a parked block; if
+  the pool is ever truly dry (the accounting invariant was broken
+  from outside), the request finishes at the boundary with a
+  `pool_overflow`-labeled truncation record rather than decoding
+  into garbage.
 - **Chunked, pipelined stepping**: the step program scans
   `chunk_steps` decode steps on-device and carries the token vector in
   device state; the host keeps ONE chunk in flight and fetches chunk
@@ -83,6 +116,7 @@ import numpy as np
 
 from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.prefix_cache import PrefixIndex
 from walkai_nos_tpu.obs.serving import ServingObs
 from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
 
@@ -103,17 +137,29 @@ class _Request:
     first_token_at: float = 0.0
     completed_at: float = 0.0
     streamed: int = 0  # tokens already handed out via drain_new_tokens
+    truncated: bool = False  # finished early at a pool boundary
 
 
 @dataclass
 class _Prefill:
     """A request mid-way through the chunked prefill lane: `consumed`
     prompt tokens already written through `blocks` into the pool;
-    the slot flips live when the final chunk lands."""
+    the slot flips live when the final chunk lands. The first
+    `cached` tokens (= `len(nodes) x PAGE_ROWS` shared prefix-index
+    blocks at the front of `blocks`) were never written by this
+    request — its chunks start at `cached` and must never write
+    below it. `pending` holds this request's own inserted index
+    nodes awaiting their writing chunk's dispatch; `resv` is the
+    worst-case decode blocks still unallocated (virtual reservation,
+    see `_admit_paged`)."""
     req: _Request
     slot: int
     blocks: list
     consumed: int = 0
+    cached: int = 0
+    nodes: list = field(default_factory=list)
+    pending: list = field(default_factory=list)
+    resv: int = 0
 
 
 class ContinuousBatcher:
@@ -136,6 +182,15 @@ class ContinuousBatcher:
     chunked-prefill lane (`prefill_lanes` concurrent admissions, up to
     `prefill_chunk` prompt tokens per dispatch each). `paged=False`
     keeps the dense per-slot cache with blocking bucketed prefill.
+
+    `prefix_cache=True` (paged only) turns the pool refcounted and
+    content-addressed: full 128-token prompt blocks are indexed in a
+    host-side radix trie, admissions reuse every fully-matched prefix
+    block with zero prefill compute, released prefix blocks park in
+    the index (LRU) and are evicted only under allocation pressure.
+    `prefix_cache=False` restores PR 2's exclusive pool exactly
+    (match/park/evict never run — the cold-start baseline the bench
+    compares against).
 
     Sampling is per request (`temperature`/`top_k`/`top_p`/`seed` on
     `submit`; default greedy): the knobs and a per-slot PRNG key live
@@ -169,6 +224,7 @@ class ContinuousBatcher:
         pool_blocks: int | None = None,
         prefill_chunk: int = 64,
         prefill_lanes: int = 4,
+        prefix_cache: bool = True,
         obs: ServingObs | bool = True,
     ) -> None:
         cache_len = cache_len or cfg.max_seq_len
@@ -240,6 +296,24 @@ class ContinuousBatcher:
         )
         self._prefilling: list[_Prefill] = []
         self._warm_buckets: set[int] = set()
+        # Shared-prefix index (paged only): refcounted radix trie over
+        # full 128-token prompt blocks. `_slot_nodes[s]` pins the
+        # FIRST len(nodes) entries of `_slot_blocks[s]` (matched +
+        # self-inserted prefix nodes, a contiguous front run);
+        # everything after is private and frees on release.
+        self._prefix: PrefixIndex | None = (
+            PrefixIndex(PAGE_ROWS) if (paged and prefix_cache) else None
+        )
+        self._slot_nodes: list[list] = [[] for _ in range(slots)]
+        # Lazy decode allocation: `_slot_pos` mirrors the device
+        # cache_index of each LIVE slot (true_len at flip-live, +
+        # chunk_steps per dispatch); `_slot_resv` is the slot's
+        # remaining virtual reservation and `_reserved` the aggregate
+        # (admission invariant: free + parked >= _reserved, so a
+        # mid-flight block grab can always be backed).
+        self._slot_pos = np.zeros(slots, np.int64)
+        self._slot_resv = np.zeros(slots, np.int64)
+        self._reserved = 0
         if paged:
             self._set_pool_gauges()
 
@@ -475,6 +549,14 @@ class ContinuousBatcher:
             raise self._reject(
                 "bad_request", f"seed must fit int32; got {seed}"
             )
+        if max_new_tokens <= 0:
+            # A degenerate budget would admit a request that can never
+            # emit a token: the slot would spin until the budget check
+            # underflowed. Reject it up front through the taxonomy.
+            raise self._reject(
+                "bad_request",
+                f"max_new_tokens must be >= 1; got {max_new_tokens}",
+            )
         prompt = np.asarray(prompt).reshape(-1)
         if len(prompt) == 0:
             raise self._reject("bad_request", "empty prompt")
@@ -605,12 +687,16 @@ class ContinuousBatcher:
         """Like `drain_done`, with per-request serving telemetry:
         {"tokens", "ttft_s" (submit -> first token KNOWN to the host,
         i.e. at its chunk sync — the moment a streaming server could
-        first emit it), "wall_s"}."""
+        first emit it), "wall_s", "truncated"}."""
         done = {
             rid: {
                 "tokens": r.tokens,
                 "ttft_s": r.first_token_at - r.submitted_at,
                 "wall_s": r.completed_at - r.submitted_at,
+                # True when the output stopped at a pool-capacity
+                # boundary (pool_overflow completion), not at EOS or
+                # the requested budget.
+                "truncated": r.truncated,
             }
             for rid, r in self._requests.items()
             if r.done
@@ -692,13 +778,60 @@ class ContinuousBatcher:
             "kv_bytes_per_token": per_tok,
             "kv_backing_bytes": backing,
             "kv_pool_blocks": self.pool_blocks if self.paged else None,
+            # Actual residency (lazy allocation: decode blocks are
+            # grabbed at boundary crossings, not reserved physically),
+            # counting each shared prefix block ONCE however many
+            # requests reference it.
             "kv_blocks_in_use": (
-                sum(len(b) for b in self._slot_blocks)
-                + sum(len(p.blocks) for p in self._prefilling)
-                if self.paged else None
+                self._blocks_allocated() if self.paged else None
             ),
+            "kv_blocks_free": (
+                len(self._free_blocks) if self.paged else None
+            ),
+            "kv_blocks_parked": (
+                self._parked_count() if self.paged else None
+            ),
+            # Worst-case decode blocks admitted requests may still
+            # grab (virtual — admission guarantees free + parked
+            # covers it).
+            "kv_blocks_reserved": self._reserved if self.paged else None,
             "paged": self.paged,
             "admission_stall_s": round(self.admission_stall_s, 6),
+        }
+
+    def prefix_stats(self) -> dict:
+        """Shared-prefix cache telemetry — a view of the registry's
+        `cb_prefix_*` series plus the index's current residency, the
+        `/stats` `cb_prefix` section and the bench's
+        `cb_prefix_hit_rate` / `cb_prefill_tokens_saved_frac`
+        source. Hit rate is per LOOKUPABLE full prompt block (blocks
+        a prompt could have shared, matched or not); the saved
+        fraction divides prompt tokens skipped by prompt tokens
+        admitted."""
+        hits = int(self.obs.prefix_hits.value())
+        misses = int(self.obs.prefix_misses.value())
+        lookups = hits + misses
+        saved = int(self.obs.prefix_saved.value())
+        prompt_tokens = int(self.obs.prefix_prompt_tokens.value())
+        idx = self._prefix
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "enabled": idx is not None,
+            "block_hits": hits,
+            "block_misses": misses,
+            "hit_rate": (
+                round(hits / lookups, 4) if lookups else None
+            ),
+            "evictions": int(self.obs.prefix_evictions.value()),
+            "cached_blocks": idx.cached_blocks if idx else 0,
+            "parked_blocks": idx.parked_blocks if idx else 0,
+            "cached_tokens": idx.cached_tokens if idx else 0,
+            "prefill_tokens_saved": saved,
+            "prompt_tokens": prompt_tokens,
+            "prefill_tokens_saved_frac": (
+                round(saved / prompt_tokens, 4) if prompt_tokens
+                else None
+            ),
         }
 
     def run(self) -> dict[int, list[int]]:
@@ -719,14 +852,28 @@ class ContinuousBatcher:
         return c.num_layers * 2 * c.kv_heads * head_dim * dtype_bytes
 
     def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
-        """Physical blocks a request holds: its whole footprint
-        (prompt + budget), floored at one prefill chunk — the lane's
-        final chunk pads to `prefill_chunk`, and pad rows must land in
-        blocks the request owns (they are masked, then overwritten as
-        decoding proceeds — the same trick dense bucketed prefill
-        plays inside one slot's cache)."""
-        cover = max(prompt_len + max_new, self.prefill_chunk)
-        return -(-min(cover, self.cache_len) // PAGE_ROWS)
+        """Worst-case physical blocks a request's footprint (prompt +
+        budget) covers. Lane pad rows past the footprint no longer
+        force extra blocks: positions beyond the owned table entries
+        map to the scratch block (table entry 0), whose garbage no
+        live row ever reads — pad rows inside an owned block stay
+        masked-then-overwritten as before."""
+        return -(-min(prompt_len + max_new, self.cache_len) // PAGE_ROWS)
+
+    def _parked_count(self) -> int:
+        """Blocks held only by the prefix index (refcount 0,
+        evictable on demand) — the ONE definition the admission
+        check, the residency views, and the pool gauges all share."""
+        return self._prefix.parked_blocks if self._prefix is not None else 0
+
+    def _blocks_allocated(self) -> int:
+        """Distinct pool blocks held by live requests (paged mode) —
+        actual residency: shared prefix blocks count once, parked
+        (refcount-0 cached) blocks don't count at all."""
+        return (
+            self.pool_blocks - 1 - len(self._free_blocks)
+            - self._parked_count()
+        )
 
     def _bucket_for(self, prompt_len: int) -> int:
         """Dense-mode prefill bucket: `prompt_bucket` when it fits,
@@ -746,12 +893,11 @@ class ContinuousBatcher:
             return
         per_tok = self._kv_bytes_per_token()
         if self.paged:
-            in_use = sum(
-                len(self._slot_blocks[s])
-                for s in range(self.slots)
-                if self._slot_req[s] is not None
-            ) + sum(len(p.blocks) for p in self._prefilling)
-            bytes_backing = in_use * PAGE_ROWS * per_tok
+            # Distinct blocks allocated (shared prefix blocks count
+            # ONCE): with sharing, bytes-per-resident-token drops
+            # BELOW the analytic per-token KV size — the reuse win
+            # the bench's kv ratio is meant to show.
+            bytes_backing = self._blocks_allocated() * PAGE_ROWS * per_tok
         else:
             bytes_backing = self.slots * self.cache_len * per_tok
         self.obs.kv_ratio.set(round(bytes_backing / resident, 1))
@@ -784,6 +930,10 @@ class ContinuousBatcher:
         return emitted, snapshot, fresh, t0
 
     def _dispatch_paged(self):
+        # Lazy decode allocation: back every live slot's next chunk of
+        # cache writes BEFORE the table snapshot below captures the
+        # rows.
+        self._ensure_decode_blocks()
         self._record_kv_snapshot()
         self.obs.profile.on_dispatch()
         t0 = time.monotonic()
@@ -825,10 +975,15 @@ class ContinuousBatcher:
                 else:
                     # Final chunk: align its END to the prompt's end
                     # (re-writing up to W-remaining already-written
-                    # rows with identical values) so the last true
-                    # token's logits sit inside this chunk and no pad
-                    # row lands past position max(true_len, W) - 1.
-                    start = max(0, true_len - W)
+                    # rows with identical values — identical because
+                    # each row is a deterministic per-position
+                    # function of the prefix) so the last true
+                    # token's logits sit inside this chunk, clamped
+                    # to the CACHED prefix boundary: rows below
+                    # `entry.cached` live in shared index blocks this
+                    # request must never write (another sharer may be
+                    # reading them in this very dispatch).
+                    start = max(entry.cached, true_len - W)
                     entry.consumed = true_len
                     finished.append(entry)
                     pf_fslot[r] = entry.slot
@@ -842,6 +997,16 @@ class ContinuousBatcher:
                 pf_start[r] = start
                 pf_tbl[r, :len(entry.blocks)] = entry.blocks
                 lane_end = max(lane_end, start + W)
+                # Own inserted index nodes become matchable once the
+                # chunk writing their rows is dispatched: any later
+                # reader's chunks dispatch strictly after this one,
+                # and the device executes dispatches in order.
+                while (
+                    entry.pending
+                    and entry.pending[0].depth * PAGE_ROWS
+                    <= entry.consumed
+                ):
+                    self._prefix.mark_ready(entry.pending.pop(0))
                 self.obs.trace.prefill_chunk(
                     req.rid, t0, entry.consumed, true_len
                 )
@@ -882,11 +1047,66 @@ class ContinuousBatcher:
             self._slot_new[s] = True
             self._budget[s] = entry.req.max_new_tokens
             self._slot_blocks[s] = entry.blocks
+            self._slot_nodes[s] = entry.nodes
+            self._slot_resv[s] = entry.resv
+            # Mirror of the device cache_index from here on (decode
+            # writes start at true_len next dispatch).
+            self._slot_pos[s] = len(entry.req.prompt)
             self._table[s, :len(entry.blocks)] = entry.blocks
         self.obs.lane_active.set(len(self._prefilling))
         busy = sum(1 for r in snapshot if r is not None)
         self._mark_dispatch(busy, t0)
         return emitted, snapshot, fresh, t0
+
+    def _ensure_decode_blocks(self) -> None:
+        """Back every live slot's next `chunk_steps` cache writes,
+        allocating decode blocks only as the write head crosses
+        128-row boundaries (lazy: pool residency tracks tokens
+        actually written, and headroom reports actual residency).
+        The admission-time virtual reservation guarantees the grab
+        succeeds — from the free list or by evicting a parked prefix
+        block; if the pool is somehow truly dry, the request is
+        TRUNCATED at its backed boundary (a `pool_overflow`-labeled
+        completion) rather than decoding through scratch garbage."""
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None or req.done:
+                continue
+            if not req.truncated:
+                total = len(req.prompt) + req.max_new_tokens
+                end = min(int(self._slot_pos[s]) + self.chunk_steps, total)
+                need = -(-end // PAGE_ROWS)
+                while len(self._slot_blocks[s]) < need:
+                    block = self._grab_block()
+                    if block is None:
+                        self._truncate_slot(s)
+                        break
+                    self._slot_blocks[s].append(block)
+                    self._table[s, len(self._slot_blocks[s]) - 1] = block
+                    if self._slot_resv[s] > 0:
+                        self._slot_resv[s] -= 1
+                        self._reserved -= 1
+            # The device advances every slot's cache_index by
+            # chunk_steps per dispatch; mirror it for live slots.
+            self._slot_pos[s] += self.chunk_steps
+        self._set_pool_gauges()
+
+    def _truncate_slot(self, s: int) -> None:
+        """Cap a live slot's budget at what its allocated blocks can
+        back. Tokens at positions up to the backed capacity read only
+        backed rows, so everything already emitted (and in flight)
+        stays valid; the request then finishes through the normal
+        budget path with reason `pool_overflow` and a truncation mark
+        on its completion record."""
+        req = self._slot_req[s]
+        cap = len(self._slot_blocks[s]) * PAGE_ROWS - len(req.prompt)
+        new_budget = max(0, cap - len(req.tokens))
+        if new_budget < self._budget[s]:
+            self._budget[s] = new_budget
+            req.truncated = True
+            # The rest of the worst case will never be grabbed.
+            self._reserved -= int(self._slot_resv[s])
+            self._slot_resv[s] = 0
 
     def _process(self, emitted, snapshot, fresh, t_dispatch) -> None:
         tokens = np.asarray(emitted)  # [slots, 1 + chunk] — the sync
@@ -914,11 +1134,19 @@ class ContinuousBatcher:
                 ) or self._budget[s] <= 0:
                     req.done = True
                     req.completed_at = now
-                    reason = (
-                        "eos"
-                        if req.eos_id is not None and int(t) == req.eos_id
-                        else "budget"
-                    )
+                    if req.eos_id is not None and int(t) == req.eos_id:
+                        reason = "eos"
+                    elif req.truncated:
+                        # Budget exhausted because a mid-flight block
+                        # grab found the pool dry: a truncation, not
+                        # a natural completion.
+                        reason = "pool_overflow"
+                    else:
+                        reason = "budget"
+                    # The record flag means "output actually cut at a
+                    # pool boundary" — a capped request that still hit
+                    # EOS first completed naturally.
+                    req.truncated = reason == "pool_overflow"
                     obs.completed.inc(labels={"reason": reason})
                     obs.wall.observe(now - req.submitted_at)
                     if len(req.tokens) > 1 and now > req.first_token_at:
@@ -941,27 +1169,59 @@ class ContinuousBatcher:
             obs.tokens.inc(n_emitted)
 
     def _release_slot(self, s: int) -> None:
-        """Return a freed slot's blocks to the pool and park its table
-        row on the scratch block. The chunk already in flight was
-        dispatched with the old table, so it still writes these blocks
-        at the dead sequence's tail positions — harmless: any block
-        handed to a new request is rewritten position-by-position
-        before that position becomes visible (writes precede reads at
-        every step), exactly the pad-row invariant."""
-        self._free_blocks.extend(self._slot_blocks[s])
+        """Return a freed slot's PRIVATE blocks to the pool, release
+        its pins on shared prefix-index nodes (refcount--; at zero
+        the node PARKS in the index instead of freeing), and park its
+        table row on the scratch block. The chunk already in flight
+        was dispatched with the old table, so it still writes the
+        private blocks at the dead sequence's tail positions —
+        harmless: any block handed to a new request is rewritten
+        position-by-position before that position becomes visible
+        (writes precede reads at every step), exactly the pad-row
+        invariant. Shared blocks are never written past the prompt
+        prefix (decode starts in the first private block), so the
+        in-flight chunk can't touch them."""
+        nodes = self._slot_nodes[s]
+        if nodes:
+            for node in nodes:
+                self._prefix.release(node)
+            self.obs.prefix_cached_tokens.set(self._prefix.cached_tokens)
+        self._free_blocks.extend(self._slot_blocks[s][len(nodes):])
         self._slot_blocks[s] = []
+        self._slot_nodes[s] = []
+        self._reserved -= int(self._slot_resv[s])
+        self._slot_resv[s] = 0
         self._table[s, :] = 0
         self._set_pool_gauges()
 
+    def _grab_block(self) -> int | None:
+        """One physical block: the free list first, then LRU eviction
+        of a parked prefix-index block; None only when the pool is
+        truly dry (no free, nothing evictable)."""
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        if self._prefix is not None:
+            block = self._prefix.evict_lru()
+            if block is not None:
+                self.obs.prefix_evictions.inc()
+                self.obs.prefix_cached_tokens.set(
+                    self._prefix.cached_tokens
+                )
+                return block
+        return None
+
     def _set_pool_gauges(self) -> None:
-        """Block-pool watermark gauges (paged mode): free/used split
-        plus the low watermark of free blocks since engine start."""
+        """Block-pool watermark gauges (paged mode): free/used/parked
+        split plus the low watermark of reclaimable blocks (free +
+        evictable parked) since engine start."""
         free = len(self._free_blocks)
+        parked = self._parked_count()
         self.obs.pool_blocks.set(free, labels={"state": "free"})
+        self.obs.pool_blocks.set(parked, labels={"state": "parked"})
         self.obs.pool_blocks.set(
-            self.pool_blocks - 1 - free, labels={"state": "used"}
+            self.pool_blocks - 1 - free - parked, labels={"state": "used"}
         )
-        self.obs.pool_min_free.set_min(free)
+        self.obs.pool_min_free.set_min(free + parked)
 
     def _admit(self) -> None:
         t0 = time.monotonic()
@@ -975,8 +1235,20 @@ class ContinuousBatcher:
         """Assign pending requests to free slots + pool blocks and
         enqueue them on the prefill lane — pure host bookkeeping, no
         device dispatch (the lane rides the next step program).
-        Head-of-line: a request that does not fit the free pool waits
-        for completions to return blocks rather than being jumped."""
+
+        Prefix reuse: the radix index is walked first; every matched
+        full prompt block is mapped to its existing physical block
+        (refcount++) and the lane starts at the first uncached token.
+        Accounting counts only NEW blocks — a cached-prefix request
+        admits under pressure that would park a cold one — and
+        reserves the worst case VIRTUALLY: only the prompt's own new
+        blocks allocate now (decode blocks are grabbed lazily at
+        boundary crossings), but admission requires free + parked
+        blocks to cover every admitted request's remaining worst
+        case, so those later grabs can always be backed (at worst by
+        evicting parked cache blocks). Head-of-line: a request that
+        does not fit waits for completions/evictions rather than
+        being jumped."""
         busy = {p.slot for p in self._prefilling}
         for s in range(self.slots):
             if len(self._prefilling) >= self.prefill_lanes:
@@ -986,18 +1258,75 @@ class ContinuousBatcher:
             if self._slot_req[s] is not None or s in busy:
                 continue
             req = self._pending[0]
-            needed = self._blocks_needed(len(req.prompt), req.max_new_tokens)
-            if len(self._free_blocks) < needed:
+            true_len = len(req.prompt)
+            total = self._blocks_needed(true_len, req.max_new_tokens)
+            matched = (
+                self._prefix.match(req.prompt)
+                if self._prefix is not None else []
+            )
+            new_need = total - len(matched)
+            # Matched refcount-0 nodes are about to be pinned by THIS
+            # request: exclude them from the evictable supply.
+            matched_parked = sum(1 for n in matched if n.refcount == 0)
+            avail = (
+                len(self._free_blocks) + self._parked_count()
+                - matched_parked - self._reserved
+            )
+            if avail < new_need:
                 return
             self._pending.popleft()
-            blocks = [self._free_blocks.pop() for _ in range(needed)]
-            self._prefilling.append(_Prefill(req, s, blocks))
+            cached = len(matched) * PAGE_ROWS
+            blocks = [n.block for n in matched]
+            if self._prefix is not None:
+                self._prefix.acquire(matched)
+            # Allocate the prompt's uncached blocks now (the lane
+            # writes them over the coming chunks); decode blocks come
+            # lazily from `_ensure_decode_blocks`.
+            new_now = -(-true_len // PAGE_ROWS) - len(matched)
+            for _ in range(new_now):
+                block = self._grab_block()
+                if block is None:
+                    # Unreachable while the reservation invariant
+                    # holds (avail >= new_need was just checked) —
+                    # fail loudly rather than corrupt the pool.
+                    raise RuntimeError(
+                        "paged pool accounting violated: free list "
+                        "and parked index both dry under reservation"
+                    )
+                blocks.append(block)
+            entry = _Prefill(
+                req, s, blocks, consumed=cached, cached=cached,
+                nodes=list(matched), resv=new_need - new_now,
+            )
+            if self._prefix is not None:
+                # Register this prompt's remaining full blocks so
+                # concurrent same-template admissions dedup on one
+                # copy; they become matchable (`ready`) only once
+                # their writing chunk has been dispatched.
+                walkable = self._prefix.matchable_blocks(true_len)
+                inserted = self._prefix.insert(
+                    req.prompt,
+                    matched[-1] if matched else None,
+                    blocks[len(matched):walkable],
+                )
+                entry.nodes += inserted
+                entry.pending = list(inserted)
+                self.obs.prefix_hits.inc(len(matched))
+                self.obs.prefix_misses.inc(walkable - len(matched))
+                self.obs.prefix_saved.inc(cached)
+                self.obs.prefix_prompt_tokens.inc(true_len)
+                self.obs.prefix_cached_tokens.set(
+                    self._prefix.cached_tokens
+                )
+            self._reserved += entry.resv
+            self._prefilling.append(entry)
             busy.add(s)
             self.obs.queue_depth.set(len(self._pending))
             self.obs.lane_active.set(len(self._prefilling))
             self._set_pool_gauges()
             self.obs.trace.admitted(
-                req.rid, time.monotonic(), s, needed
+                req.rid, time.monotonic(), s, len(blocks),
+                cached=cached,
             )
 
     def _admit_dense(self) -> None:

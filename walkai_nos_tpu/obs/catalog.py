@@ -55,7 +55,7 @@ CATALOG: tuple[MetricSpec, ...] = (
     MetricSpec(
         "cb_requests_completed_total", "counter",
         "Finished requests by termination reason",
-        labels=("reason",),  # eos | budget
+        labels=("reason",),  # eos | budget | pool_overflow (truncated)
         attr="completed",
     ),
     MetricSpec(
@@ -101,13 +101,50 @@ CATALOG: tuple[MetricSpec, ...] = (
     MetricSpec(
         "cb_kv_pool_blocks", "gauge",
         "Paged KV pool blocks by state (scratch block excluded)",
-        labels=("state",),  # free | used
+        labels=("state",),  # free | used | parked
         attr="pool_blocks",
     ),
     MetricSpec(
         "cb_kv_pool_blocks_min_free", "gauge",
-        "Low watermark of free pool blocks since engine start",
+        "Low watermark of reclaimable pool blocks (free + evictable "
+        "parked) since engine start",
         attr="pool_min_free",
+    ),
+    MetricSpec(
+        "cb_prefix_blocks_hit_total", "counter",
+        "Full prompt blocks served from the shared prefix cache at "
+        "admission (zero prefill compute, zero HBM writes)",
+        attr="prefix_hits",
+    ),
+    MetricSpec(
+        "cb_prefix_blocks_miss_total", "counter",
+        "Full prompt blocks prefilled fresh despite being lookupable "
+        "(hit-rate denominator together with hits)",
+        attr="prefix_misses",
+    ),
+    MetricSpec(
+        "cb_prefix_evictions_total", "counter",
+        "Parked prefix-cache blocks evicted (LRU, leaf-first) to "
+        "back new allocations",
+        attr="prefix_evictions",
+    ),
+    MetricSpec(
+        "cb_prefix_cached_tokens", "gauge",
+        "Prompt tokens resident in the prefix index (shared + parked "
+        "blocks x 128)",
+        attr="prefix_cached_tokens",
+    ),
+    MetricSpec(
+        "cb_prefix_prefill_tokens_saved_total", "counter",
+        "Prompt tokens the chunked prefill lane skipped thanks to "
+        "prefix-cache hits",
+        attr="prefix_saved",
+    ),
+    MetricSpec(
+        "cb_prefix_prompt_tokens_total", "counter",
+        "Prompt tokens of requests admitted while the prefix cache "
+        "is enabled (saved-fraction denominator)",
+        attr="prefix_prompt_tokens",
     ),
     MetricSpec(
         "cb_admission_stall_seconds_total", "counter",

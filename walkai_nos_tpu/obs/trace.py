@@ -131,7 +131,12 @@ class RequestTrace:
             SUBMIT, t, rid=rid, prompt_len=prompt_len, max_new=max_new
         )
 
-    def admitted(self, rid: int, t: float, slot: int, blocks: int) -> None:
+    def admitted(
+        self, rid: int, t: float, slot: int, blocks: int,
+        cached: int = 0,
+    ) -> None:
+        """`cached` = prompt tokens served from the shared prefix
+        cache at admission (0 when the cache is off or cold)."""
         if not self.enabled:
             return
         with self._lock:
@@ -140,7 +145,11 @@ class RequestTrace:
                 span[ADMITTED] = t
                 span["slot"] = slot
                 span["blocks"] = blocks
-        self.event(ADMITTED, t, rid=rid, slot=slot, blocks=blocks)
+                span["cached"] = cached
+        self.event(
+            ADMITTED, t, rid=rid, slot=slot, blocks=blocks,
+            cached=cached,
+        )
 
     def prefill_chunk(
         self, rid: int, t: float, consumed: int, total: int
@@ -299,6 +308,7 @@ class RequestTrace:
                     "args": {
                         "slot": s.get("slot"),
                         "blocks": s.get("blocks"),
+                        "cached": s.get("cached"),
                         "chunks": len(s["chunks"]),
                     },
                 })

@@ -32,15 +32,22 @@ def get_json(url: str, timeout: float = 10.0) -> dict:
         return json.loads(resp.read())
 
 
-def post_infer(base: str, batch: int, timeout: float = 150.0) -> dict:
+def post_json(url: str, payload: dict, timeout: float = 150.0) -> dict:
+    """POST a JSON payload, return the decoded JSON response — the
+    one definition of the bench client's request path (the serving
+    benches all drive `/generate` through this)."""
     req = urllib.request.Request(
-        f"{base}/infer",
-        data=json.dumps({"batch": batch}).encode(),
+        url,
+        data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",
     )
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read())
+
+
+def post_infer(base: str, batch: int, timeout: float = 150.0) -> dict:
+    return post_json(f"{base}/infer", {"batch": batch}, timeout=timeout)
 
 
 class InferClient:
