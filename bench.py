@@ -579,11 +579,25 @@ def cb_serving_benchmark() -> dict:
     same server stack under the templated-prompt workload (N requests
     over K shared prefixes), emitting `cb_prefix_hit_rate` and
     `cb_prefill_tokens_saved_frac` — the shared-prefix KV cache's
-    headline keys (BASELINE.json gates both as `absent_ok` specs)."""
-    from bench_lm import measure_cb_prefix_reuse, measure_cb_serving
+    headline keys (BASELINE.json gates both as `absent_ok` specs).
+    The speculative variant (`measure_cb_spec_serving`) then reruns
+    the Poisson harness with the engine's draft-and-verify rounds on
+    (`WALKAI_CB_SPEC=1`, self-draft seam), reusing this run's
+    spec-off capacity as its baseline — `cb_spec_capacity_tokens_per_s`
+    is gated within 5% of the spec-off capacity baseline, and
+    `cb_spec_accepted_per_round` reports the amortization per verify
+    dispatch."""
+    from bench_lm import (
+        measure_cb_prefix_reuse,
+        measure_cb_serving,
+        measure_cb_spec_serving,
+    )
 
     out = measure_cb_serving()
     out.update(measure_cb_prefix_reuse())
+    out.update(measure_cb_spec_serving(
+        baseline_capacity=out.get("cb_serving_capacity_tokens_per_s"),
+    ))
     return out
 
 
@@ -638,7 +652,9 @@ def main() -> None:
             "cb_vs_serial_speedup", "cb_ttft_p50", "cb_token_p99",
             "cb_serving_capacity_tokens_per_s", "cb_admission_stall_ms",
             "cb_kv_hbm_bytes_per_resident_token", "cb_prefix_hit_rate",
-            "cb_prefill_tokens_saved_frac", "obs_overhead_pct",
+            "cb_prefill_tokens_saved_frac",
+            "cb_spec_capacity_tokens_per_s",
+            "cb_spec_accepted_per_round", "obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )
         if k in result

@@ -692,7 +692,12 @@ def measure_cb_serving(
         kv1 = get_json(f"{base}/stats").get("cb_kv", {})
         for th in workers:
             th.join(timeout=160.0)
-        occ1 = get_json(f"{base}/stats").get("cb_occupancy", {})
+        stats_end = get_json(f"{base}/stats")
+        occ1 = stats_end.get("cb_occupancy", {})
+        # Speculative-serving telemetry (present when the server runs
+        # with WALKAI_CB_SPEC=1): cumulative over the whole run —
+        # capacity + Poisson phases see the same workload mix.
+        spec_end = stats_end.get("cb_spec", {}) or {}
         # After the joins: every fired request's first token is in the
         # server-side histogram, so the delta population matches the
         # client records exactly.
@@ -799,6 +804,17 @@ def measure_cb_serving(
         "cb_serving_slots": slots,
         "cb_serving_vocab": vocab,
         "cb_serving_measure_s": round(window_s, 1),
+        # Speculative-serving section (spec-enabled servers only).
+        **({
+            "cb_spec_accepted_per_round": spec_end.get(
+                "accepted_per_round"
+            ),
+            "cb_spec_acceptance_rate": spec_end.get("acceptance_rate"),
+            "cb_spec_drafting_disabled": spec_end.get(
+                "drafting_disabled"
+            ),
+            "cb_spec_k_final": spec_end.get("k"),
+        } if spec_end.get("enabled") else {}),
     }
 
 
@@ -942,6 +958,75 @@ def measure_cb_prefix_reuse(
             round(n_tokens[0] / window_s, 1) if window_s > 0 else None
         ),
         "cb_prefix_cache_enabled": bool(stats1.get("enabled")),
+    }
+
+
+def measure_cb_spec_serving(
+    *,
+    spec_k: int = 3,
+    spec_draft: str = "self",
+    baseline_capacity: float | None = None,
+    **serving_kwargs,
+) -> dict:
+    """Batched speculative decoding inside the continuous batcher,
+    measured as SERVING: the same Poisson harness as
+    `measure_cb_serving` (capacity saturation, then open-loop arrivals
+    at a fraction of it) against a server running the engine with
+    `WALKAI_CB_SPEC=1` — every request is served draft-and-verify,
+    outputs token-identical to spec-off by construction.
+
+    Headline keys:
+
+    - `cb_spec_capacity_tokens_per_s`: closed-loop capacity with spec
+      on. BASELINE.json gates it against the spec-OFF capacity
+      baseline with a 5% band: the acceptance-adaptive controller may
+      disable drafting (untrained drafts accept ~nothing), but must
+      never cost more than 5% capacity.
+    - `cb_spec_accepted_per_round`: mean accepted draft tokens per
+      (live slot, round) — the amortization the verify dispatch buys.
+
+    `spec_draft="self"` (default) runs the draft-=-target seam: with
+    greedy capacity traffic acceptance is ~k, exercising the full
+    accept/commit machinery at its upper bound (a deployment measures
+    its own distilled draft here via `spec_draft="tiny"` + loaded
+    weights). `baseline_capacity` skips the spec-off arm when the
+    caller (bench.py) already measured it this run."""
+    spec_env = {
+        "WALKAI_CB_SPEC": "1",
+        "WALKAI_CB_SPEC_K": str(spec_k),
+        "WALKAI_CB_SPEC_DRAFT": spec_draft,
+    }
+    extra_env = dict(serving_kwargs.pop("server_env", {}) or {})
+    on = measure_cb_serving(
+        server_env={**spec_env, **extra_env}, **serving_kwargs
+    )
+    if baseline_capacity is None:
+        baseline_capacity = measure_cb_serving(
+            server_env=extra_env or None, **serving_kwargs
+        )["cb_serving_capacity_tokens_per_s"]
+    cap = on["cb_serving_capacity_tokens_per_s"]
+    return {
+        "cb_spec_capacity_tokens_per_s": cap,
+        "cb_spec_off_capacity_tokens_per_s": baseline_capacity,
+        "cb_spec_capacity_ratio": (
+            round(cap / baseline_capacity, 3) if baseline_capacity
+            else None
+        ),
+        "cb_spec_accepted_per_round": on.get(
+            "cb_spec_accepted_per_round"
+        ),
+        "cb_spec_acceptance_rate": on.get("cb_spec_acceptance_rate"),
+        "cb_spec_drafting_disabled": on.get(
+            "cb_spec_drafting_disabled"
+        ),
+        "cb_spec_k_final": on.get("cb_spec_k_final"),
+        "cb_spec_goodput_tokens_per_s": on.get(
+            "cb_goodput_tokens_per_s"
+        ),
+        "cb_spec_ttft_p99": on.get("cb_ttft_p99"),
+        "cb_spec_serving_k": spec_k,
+        "cb_spec_serving_draft": spec_draft,
+        "cb_spec_request_errors": on.get("cb_request_errors"),
     }
 
 

@@ -459,6 +459,40 @@ def main() -> None:
 
             cb_slots = int(os.environ.get("WALKAI_CB_SLOTS", "4"))
             cb_bucket = int(os.environ.get("WALKAI_CB_BUCKET", "64"))
+            # Batched speculative decoding inside the engine
+            # (WALKAI_CB_SPEC=1): a shared draft proposes
+            # WALKAI_CB_SPEC_K tokens per slot per round, one
+            # multi-step target dispatch verifies them — outputs stay
+            # token-identical to spec-off, /generate is unchanged.
+            # WALKAI_CB_SPEC_DRAFT picks the draft: "tiny" (default,
+            # a draft_config-scaled random init — a deployment loads
+            # a distilled draft here; untrained acceptance is near
+            # zero, so the engine's adaptive controller will disable
+            # drafting) or "self" (draft = target: the full-acceptance
+            # seam the spec bench uses to exercise the machinery).
+            cb_spec_kwargs = {}
+            if os.environ.get("WALKAI_CB_SPEC") == "1":
+                from walkai_nos_tpu.models.lm import draft_config
+
+                if os.environ.get(
+                    "WALKAI_CB_SPEC_DRAFT", "tiny"
+                ) == "self":
+                    cb_draft_cfg, cb_draft_params = lm_cfg, lm_params
+                else:
+                    cb_draft_cfg = draft_config(lm_cfg)
+                    cb_draft_params = jax.device_put(
+                        DecoderLM(cb_draft_cfg).init_params(
+                            jax.random.PRNGKey(2)
+                        )
+                    )
+                cb_spec_kwargs = {
+                    "spec": True,
+                    "spec_k": int(
+                        os.environ.get("WALKAI_CB_SPEC_K", "3")
+                    ),
+                    "draft_cfg": cb_draft_cfg,
+                    "draft_params": cb_draft_params,
+                }
             cb_engine = ContinuousBatcher(
                 lm_cfg,
                 lm_params,
@@ -489,6 +523,7 @@ def main() -> None:
                 prefix_cache=os.environ.get(
                     "WALKAI_CB_PREFIX_CACHE", "1"
                 ) == "1",
+                **cb_spec_kwargs,
                 obs=obs,
             )
             # Compile prefill + chunk step off the request path.
@@ -1094,6 +1129,7 @@ def main() -> None:
                     payload["cb_occupancy"] = cb_engine.occupancy()
                     payload["cb_kv"] = cb_engine.kv_stats()
                     payload["cb_prefix"] = cb_engine.prefix_stats()
+                    payload["cb_spec"] = cb_engine.spec_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

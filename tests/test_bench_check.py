@@ -135,6 +135,46 @@ class TestCheckLogic:
         assert len(failures) == 1
         assert "cb_prefix_hit_rate" in failures[0]
 
+    def test_repo_baseline_gates_spec_serving_keys(self):
+        """BASELINE.json carries the speculative-serving keys and
+        they PARSE through the comparator: the capacity key is an
+        absent_ok 5% band against the r5 spec-OFF capacity (the
+        controller may disable drafting but must never cost more),
+        the accepted-per-round key is null-until-recorded — absent
+        or unanchored is a skip note, a capacity below the band
+        fails once emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        cap = published["cb_spec_capacity_tokens_per_s"]
+        assert cap["direction"] == "higher"
+        assert cap["tolerance"] == 0.05
+        assert cap["absent_ok"] is True
+        # The gate anchors to the r5 spec-off capacity baseline.
+        assert cap["value"] == published[
+            "cb_serving_capacity_tokens_per_s"
+        ]["value"]
+        acc = published["cb_spec_accepted_per_round"]
+        assert acc["value"] is None  # pending the next chip run
+        keys = (
+            "cb_spec_capacity_tokens_per_s",
+            "cb_spec_accepted_per_round",
+        )
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert len(notes) == 2
+        failures, _ = bench_check.check(
+            {"cb_spec_capacity_tokens_per_s": cap["value"] * 0.96},
+            base,
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"cb_spec_capacity_tokens_per_s": cap["value"] * 0.94},
+            base,
+        )
+        assert len(failures) == 1
+        assert "cb_spec_capacity_tokens_per_s" in failures[0]
+
     def test_bare_number_baseline_defaults_higher(self):
         failures, _ = bench_check.check(
             {"x": 70.0}, {"published": {"x": 100.0}}

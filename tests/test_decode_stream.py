@@ -247,6 +247,55 @@ class TestPagedKernelParity:
             np.asarray(out), np.asarray(ref), atol=2e-5
         )
 
+    def test_multi_step_heterogeneous_positions(self):
+        """The speculative-serving verify shape: every slot at its OWN
+        write head with k+1 query steps each — heads at the cache
+        start, mid-block, straddling the 128-row edge, and deep in
+        block 3 must each see exactly rows <= head + step."""
+        q, k, v = _qkv(b=4, kvh=2, s=384, steps=4, seed=6)
+        idx = jnp.asarray([0, 100, 126, 290], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=3)
+        out = da.paged_decode_attention(
+            q, k_pool, v_pool, table, idx, interpret=True
+        )
+        ref = da.decode_attention_reference(q, k, v, idx)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_rejected_speculative_rows_invisible(self):
+        """The serving engine's rollback invariant: rows a rejected
+        verify window wrote past the committed head need no device
+        rewind BECAUSE a later round's queries cannot see them —
+        overwrite every pool row strictly past each slot's last
+        visible position (head + steps - 1) with garbage and the
+        multi-step output must be bit-identical. (Finite garbage, not
+        inf: rows inside a partially visible block are read and
+        score-masked, so the test asserts zero INFLUENCE, which is
+        the serving invariant.)"""
+        q, k, v = _qkv(b=2, kvh=2, s=384, steps=3, seed=7)
+        idx = np.asarray([126, 40], np.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=3)
+        tbl = np.asarray(table)
+        poison_k = np.array(k_pool)
+        poison_v = np.array(v_pool)
+        for r in range(2):
+            first_hidden = int(idx[r]) + 3  # steps = 3
+            for j in range(3):
+                lo = max(0, first_hidden - j * 128)
+                if lo < 128:
+                    poison_k[tbl[r, j], :, lo:] = 1e4
+                    poison_v[tbl[r, j], :, lo:] = -1e4
+        out = da.paged_decode_attention(
+            q, jnp.asarray(poison_k, k.dtype),
+            jnp.asarray(poison_v, v.dtype), table,
+            jnp.asarray(idx), interpret=True,
+        )
+        clean = da.paged_decode_attention(
+            q, k_pool, v_pool, table, jnp.asarray(idx), interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(clean))
+
     def test_bf16_pool_f32_accumulation(self):
         q, k, v = _qkv(b=2, kvh=2, s=256, dtype=jnp.bfloat16, seed=4)
         idx = jnp.asarray([200, 77], jnp.int32)

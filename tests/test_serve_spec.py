@@ -1,0 +1,425 @@
+"""Batched speculative decoding inside the paged continuous batcher
+(`models/serve.py`, `spec=True`).
+
+Tier-1 surface for the draft-and-verify serving path: spec-on output
+must be TOKEN-IDENTICAL to spec-off serving for ANY draft weights
+(greedy and seeded sampling alike — acceptance replays the plain
+decode scan's per-token sampling/key protocol exactly), EOS landing
+inside an accepted window must cut the output exactly where stepwise
+decoding would, verify-window blocks that rejection left unused must
+return to the pool the same sync (pool accounting exact at every
+step), prefix-index blocks must only ever cover prompt rows — never
+speculative or decode writes — and the acceptance-adaptive controller
+must drop k and then disable drafting when the draft earns nothing,
+with generation continuing through the plain path. Deliberately NOT
+in conftest's `_SLOW_FILES`: the fast control-plane loop must
+exercise this correctness surface, so the shapes here stay tiny.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig, draft_config
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+import jax.numpy as jnp
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+    max_seq_len=512,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def tiny_draft():
+    """An untrained draft_config draft: acceptance against the target
+    is near zero (~2% on a 64-token vocab), which is exactly what the
+    any-draft-exactness and controller tests want."""
+    dcfg = draft_config(CFG)
+    return dcfg, DecoderLM(dcfg).init_params(jax.random.PRNGKey(1))
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG.vocab_size, n).astype(np.int32)
+
+
+def _expected(params, prompt, max_new):
+    gen = make_generate_fn(CFG)
+    out = gen(params, jnp.asarray(prompt[None]), max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _spec_engine(params, draft, *, spec_k=3, **kw):
+    dcfg, dparams = draft
+    defaults = dict(
+        slots=2, cache_len=384, prompt_bucket=16, chunk_steps=3,
+        prefill_chunk=32, prefill_lanes=2, spec=True, spec_k=spec_k,
+        draft_cfg=dcfg, draft_params=dparams,
+        # Pin drafting ON: parity must hold however little the draft
+        # earns, so the controller must not rescue a broken round.
+        spec_min_accept=0.0,
+    )
+    defaults.update(kw)
+    return ContinuousBatcher(CFG, params, **defaults)
+
+
+class TestSpecParity:
+    """Spec-on serving vs standalone stepwise generation: identical
+    for a perfect draft (draft = target, acceptance 1.0) and for an
+    untrained draft (acceptance ~0) — acceptance length must never
+    leak into WHAT is emitted, only into how fast."""
+
+    SPECS = [(3, 9), (20, 17), (100, 40), (140, 11)]
+
+    def test_greedy_parity_self_draft_mixed_ragged(self, params):
+        """Prompts of 3/20/100/140 tokens crossing the 128-row block
+        edge mid-prefill (140 > 128, streamed in 32-token lane
+        chunks) and mid-decode (100 + 40 crosses at step 28), on 2
+        slots with draft = target: full acceptance exercises
+        max-length commits (k+1 tokens per slot-round)."""
+        engine = _spec_engine(params, (CFG, params))
+        rids = {
+            engine.submit(_prompt(n, seed=n), max_new_tokens=m): (n, m)
+            for n, m in self.SPECS
+        }
+        res = engine.run()
+        for rid, (n, m) in rids.items():
+            assert res[rid] == _expected(params, _prompt(n, seed=n), m), (
+                n, m,
+            )
+        st = engine.spec_stats()
+        assert st["acceptance_rate"] == 1.0
+        assert st["accepted_per_round"] == 3.0
+        assert st["emitted_per_round"] == 4.0
+
+    def test_greedy_parity_any_draft(self, params, tiny_draft):
+        """Same stream through an UNTRAINED draft: near-every proposal
+        is rejected, every round commits the bonus token alone — and
+        the output must still be bitwise the spec-off stream."""
+        engine = _spec_engine(params, tiny_draft)
+        rids = {
+            engine.submit(_prompt(n, seed=n), max_new_tokens=m): (n, m)
+            for n, m in self.SPECS
+        }
+        res = engine.run()
+        for rid, (n, m) in rids.items():
+            assert res[rid] == _expected(params, _prompt(n, seed=n), m), (
+                n, m,
+            )
+        # The draft really did earn ~nothing (else this test's
+        # rejection coverage is illusory).
+        assert engine.spec_stats()["acceptance_rate"] < 0.5
+
+    @pytest.mark.parametrize("draft_kind", ["self", "tiny"])
+    def test_sampled_parity_spec_on_vs_off(
+        self, params, tiny_draft, draft_kind
+    ):
+        """(prompt, knobs, seed) fully determines sampled output with
+        drafting on: the chosen-token chain must replay the plain
+        scan's split-per-token key protocol, so the surviving PRNG
+        key — not just the committed prefix — matches spec-off."""
+        p = _prompt(11, seed=42)
+        draft = (CFG, params) if draft_kind == "self" else tiny_draft
+        outs = {}
+        for spec in (True, False):
+            if spec:
+                engine = _spec_engine(
+                    params, draft, slots=2, cache_len=256,
+                    chunk_steps=4, prefill_chunk=8,
+                )
+            else:
+                engine = ContinuousBatcher(
+                    CFG, params, slots=2, cache_len=256, chunk_steps=4,
+                    prefill_chunk=8,
+                )
+            rid = engine.submit(
+                p, max_new_tokens=8, temperature=0.9, top_k=16,
+                top_p=0.95, seed=123,
+            )
+            outs[spec] = engine.run()[rid]
+        assert outs[True] == outs[False]
+        assert len(outs[True]) == 8
+
+    def test_eos_inside_accepted_window(self, params):
+        """With draft = target and k = 3, every round commits 4
+        tokens; an EOS at a non-boundary position lands INSIDE an
+        accepted window, and the tokens accepted after it must be
+        dropped exactly as stepwise decoding would never have emitted
+        them."""
+        full = _expected(params, _prompt(6, seed=6), 12)
+        candidates = [
+            (t, i) for i, t in enumerate(full)
+            if 1 <= i < 11 and t not in full[:i]
+        ]
+        # Prefer an EOS position strictly inside a commit window
+        # (i % 4 != 3): tokens after it in the SAME window get
+        # accepted by the verify and must still be discarded.
+        eos, cut = min(candidates, key=lambda c: (c[1] % 4 == 3, c[1]))
+        engine = _spec_engine(
+            params, (CFG, params), slots=1, cache_len=128,
+            chunk_steps=4, prefill_chunk=8,
+        )
+        rid = engine.submit(
+            _prompt(6, seed=6), max_new_tokens=12, eos_id=eos
+        )
+        assert engine.run()[rid] == full[:cut + 1]
+
+
+class TestSpecTableEdge:
+    """A verify window crossing the block table's edge (total ==
+    cache_len == a 128 multiple, so the last rounds start within
+    spec_k of capacity) must not corrupt committed rows: the paged
+    write path DROPS out-of-capacity K/V rows. Clipping them instead
+    rewrites rows 0..k-1 of the slot's last real block before the same
+    dispatch's kernel reads them — the final committed tokens come out
+    of corrupted attention and parity silently breaks."""
+
+    @pytest.mark.parametrize("draft_kind", ["self", "tiny"])
+    def test_parity_at_table_capacity(
+        self, params, tiny_draft, draft_kind
+    ):
+        """Totals of exactly cache_len=256 with prompt lengths across
+        every mod-4 alignment: the self draft's full-acceptance
+        windows (+4/round) and the untrained draft's single-token
+        walks (+1/round) both start rounds at heads 253..255, writing
+        verify rows past capacity."""
+        draft = (CFG, params) if draft_kind == "self" else tiny_draft
+        engine = _spec_engine(
+            params, draft, slots=2, cache_len=256, prefill_chunk=64,
+        )
+        specs = [(200, 56), (201, 55), (230, 26), (131, 125)]
+        rids = {
+            engine.submit(_prompt(n, seed=n), max_new_tokens=m): (n, m)
+            for n, m in specs
+        }
+        res = engine.run()
+        for rid, (n, m) in rids.items():
+            assert res[rid] == _expected(params, _prompt(n, seed=n), m), (
+                n, m,
+            )
+
+
+class TestSpecRollback:
+    """Blocks grabbed to back a verify window whose rows were then
+    rejected must return to the pool at the round's sync — residency
+    tracks COMMITTED tokens exactly, never speculative lookahead."""
+
+    def test_pool_accounting_tracks_committed_tokens_exactly(
+        self, params, tiny_draft
+    ):
+        """A 126-token prompt decodes across the 128-row boundary
+        with an untrained draft: while the head sits at 125..127,
+        every round grabs block 2 for its 4-row verify window and —
+        on rejection — must hand it straight back. After every
+        step(), blocks in use must equal ceil(committed / 128): a
+        leaked speculative block shows up as in_use = 2 one sync
+        early, a lost one as an exhausted pool later."""
+        engine = _spec_engine(
+            params, tiny_draft, slots=1, cache_len=384,
+            prefill_chunk=128, prefill_lanes=1, prefix_cache=False,
+        )
+        rid = engine.submit(_prompt(126, seed=9), max_new_tokens=20)
+        emitted = 0
+        done = {}
+        while engine.has_work:
+            engine.step()
+            emitted += sum(
+                len(v) for v in engine.drain_new_tokens().values()
+            )
+            done.update(engine.drain_done())
+            kv = engine.kv_stats()
+            assert (
+                kv["kv_blocks_in_use"] + kv["kv_blocks_free"]
+                == engine.pool_blocks - 1
+            )
+            if not done:
+                # The first emitted token is sampled from prefill
+                # logits; its K/V row is written by the round that
+                # emits token 2 — so rows resident after a sync are
+                # prompt + emitted - 1 (and just the prompt pre-flip).
+                committed = 126 + max(0, emitted - 1)
+                assert kv["kv_blocks_in_use"] == -(-committed // 128), (
+                    emitted, kv,
+                )
+        assert len(done[rid]) == 20
+        kv = engine.kv_stats()
+        assert kv["kv_blocks_in_use"] == 0
+        assert kv["kv_blocks_free"] == engine.pool_blocks - 1
+        assert kv["kv_blocks_reserved"] == 0
+
+
+class TestSpecPrefixInterplay:
+    """The prefix index must only ever serve blocks fully covered by
+    PROMPT tokens: decode-written blocks — which carry committed AND
+    rejected speculative rows — are private and never matchable."""
+
+    def test_prompt_blocks_share_decode_blocks_never_match(
+        self, params
+    ):
+        engine = _spec_engine(
+            params, (CFG, params), slots=2, cache_len=384,
+            prefill_chunk=64,
+        )
+        pa = _prompt(140, seed=20)
+        ra = engine.submit(pa, max_new_tokens=20)
+        out_a = engine.run()[ra]
+        assert out_a == _expected(params, pa, 20)
+        base = engine.prefix_stats()
+        # A's one full prompt block (rows 0..127) is cached; its
+        # decode block (rows 128..255: prompt tail + committed +
+        # rejected speculative rows) must NOT be.
+        assert base["cached_blocks"] == 1
+
+        # B shares A's first 128 prompt tokens: exactly that block
+        # must hit, and the shared-cache output must equal cold
+        # stepwise generation.
+        pb = np.concatenate([pa[:128], _prompt(10, seed=21)])
+        rb = engine.submit(pb, max_new_tokens=12)
+        out_b = engine.run()[rb]
+        assert out_b == _expected(params, pb, 12)
+        after_b = engine.prefix_stats()
+        assert after_b["block_hits"] == base["block_hits"] + 1
+
+        # C's prompt extends A's full sequence INTO the decode
+        # region: its second full block spells rows A physically
+        # holds in a private decode block, which was never indexed —
+        # so C must match only block 0 and prefill the rest fresh,
+        # still token-identical to cold generation.
+        pc = np.asarray(
+            list(pa) + out_a + list(_prompt(100, seed=22)), np.int32
+        )[:260]
+        rc = engine.submit(pc, max_new_tokens=8)
+        out_c = engine.run()[rc]
+        assert out_c == _expected(params, pc, 8)
+        after_c = engine.prefix_stats()
+        assert after_c["block_hits"] == after_b["block_hits"] + 1
+        assert after_c["block_misses"] > after_b["block_misses"]
+
+
+class TestSpecController:
+    """The acceptance-adaptive controller: EMA of accepted drafts per
+    (live slot, round) under `spec_min_accept` past the warmup first
+    halves k, then disables drafting for the engine's lifetime."""
+
+    def test_disables_drafting_under_zero_acceptance(
+        self, params, tiny_draft
+    ):
+        """An untrained draft accepts ~nothing: k must walk 2 -> 1,
+        drafting must disable, and generation must finish through the
+        plain chunk path — with the output still bitwise correct."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=384, chunk_steps=4,
+            prefill_chunk=32, spec=True, spec_k=2,
+            draft_cfg=tiny_draft[0], draft_params=tiny_draft[1],
+            spec_warmup_rounds=3,
+        )
+        rid = engine.submit(_prompt(6, seed=1), max_new_tokens=60)
+        assert engine.run()[rid] == _expected(
+            params, _prompt(6, seed=1), 60
+        )
+        st = engine.spec_stats()
+        assert st["drafting_disabled"] is True
+        assert st["k"] == 1 and st["k_configured"] == 2
+        assert int(engine.obs.spec_disabled.value()) == 1
+        # Rounds stopped the moment drafting disabled: far fewer
+        # verify dispatches than the 60 tokens would need at 1/round.
+        assert st["verify_dispatches"] < 30
+
+    def test_keeps_drafting_when_acceptance_earns(self, params):
+        """Draft = target at the DEFAULT acceptance threshold: the
+        EMA sits at k, so the controller must leave drafting on well
+        past the warmup."""
+        engine = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=384, chunk_steps=4,
+            prefill_chunk=32, spec=True, spec_k=3, draft_cfg=CFG,
+            draft_params=params, spec_warmup_rounds=4,
+        )
+        rid = engine.submit(_prompt(8, seed=2), max_new_tokens=48)
+        assert engine.run()[rid] == _expected(
+            params, _prompt(8, seed=2), 48
+        )
+        st = engine.spec_stats()
+        assert st["drafting_disabled"] is False
+        assert st["k"] == 3
+        assert st["acceptance_rate"] == 1.0
+
+
+class TestSpecValidation:
+    def test_requires_paged_engine(self, params):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(
+                CFG, params, slots=1, cache_len=128, paged=False,
+                spec=True, draft_cfg=CFG, draft_params=params,
+            )
+
+    def test_requires_draft(self, params):
+        with pytest.raises(ValueError, match="draft"):
+            ContinuousBatcher(
+                CFG, params, slots=1, cache_len=128, spec=True
+            )
+
+    @pytest.mark.parametrize("k", [0, 8])
+    def test_spec_k_bounds(self, params, k):
+        """k + 1 verify positions ride the multi-step decode kernel
+        (MAX_KERNEL_STEPS = 8), so k itself caps at 7."""
+        with pytest.raises(ValueError, match="spec_k"):
+            ContinuousBatcher(
+                CFG, params, slots=1, cache_len=128, spec=True,
+                spec_k=k, draft_cfg=CFG, draft_params=params,
+            )
+
+    def test_vocab_mismatch_rejected(self, params):
+        import dataclasses
+
+        bad = dataclasses.replace(CFG, vocab_size=32)
+        with pytest.raises(ValueError, match="vocab"):
+            ContinuousBatcher(
+                CFG, params, slots=1, cache_len=128, spec=True,
+                draft_cfg=bad, draft_params=params,
+            )
+
+    def test_submit_lookahead_guard(self, params):
+        """The verify window peeks spec_k positions past the budget:
+        a request whose total fits cache_len but whose lookahead
+        crosses max_seq_len must reject at submit, through the
+        oversize taxonomy."""
+        engine = _spec_engine(
+            params, (CFG, params), slots=1, cache_len=512,
+        )
+        with pytest.raises(ValueError, match="lookahead"):
+            engine.submit(_prompt(300, seed=3), max_new_tokens=212)
+        # One token of slack under the lookahead limit admits.
+        rid = engine.submit(_prompt(300, seed=3), max_new_tokens=209)
+        assert isinstance(rid, int)
+
+    def test_lookahead_guard_relaxes_after_disable(
+        self, params, tiny_draft
+    ):
+        """Drafting disables one-way: once the controller flips it
+        off no verify window ever runs again, so the submit guard —
+        gated on the LIVE controller state — must go back to
+        admitting requests right up to cache_len, exactly like
+        spec-off serving."""
+        engine = _spec_engine(
+            params, tiny_draft, slots=1, cache_len=512,
+            spec_min_accept=0.9, spec_warmup_rounds=2,
+        )
+        with pytest.raises(ValueError, match="lookahead"):
+            engine.submit(_prompt(500, seed=4), max_new_tokens=12)
+        rid = engine.submit(_prompt(6, seed=5), max_new_tokens=24)
+        assert engine.run()[rid] == _expected(
+            params, _prompt(6, seed=5), 24
+        )
+        assert engine.spec_stats()["drafting_disabled"] is True
+        rid = engine.submit(_prompt(500, seed=4), max_new_tokens=12)
+        assert engine.run()[rid] == _expected(
+            params, _prompt(500, seed=4), 12
+        )

@@ -14,6 +14,7 @@ workload its slices serve, first-class per the TPU mandate.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 
 import flax.linen as nn
@@ -178,6 +179,49 @@ LM_TINY = LMConfig(
     max_seq_len=128,
 )
 LM_SMALL = LMConfig()
+
+
+def draft_config(
+    cfg: LMConfig,
+    *,
+    num_layers: int = 1,
+    hidden_dim: int | None = None,
+    num_heads: int | None = None,
+) -> LMConfig:
+    """A draft-model config compatible with speculative decoding
+    against `cfg` as the target: same vocabulary (acceptance compares
+    token ids), same context and positional scheme (the draft's cache
+    tracks the target's positions row for row), same norm/MLP family —
+    but a fraction of the stack. Defaults follow the bench's measured
+    operating point (1 layer, ~1/4 width): batch-1 draft steps are
+    op-latency-bound, so the draft earns its keep only when its
+    per-step op count is tiny.
+
+    The serving engine (`models/serve.py`, `spec=True`) gives the
+    draft its own paged KV pool, mirrored block table for block table
+    — the paged fields here stay unset; the engine sets them alongside
+    the target's (`paged_blocks` equal, so one physical block id
+    addresses both pools)."""
+    heads = num_heads or max(1, cfg.num_heads // 4)
+    hidden = hidden_dim or max(32, cfg.hidden_dim // 4)
+    # head_dim must divide evenly, and rope needs it even.
+    quantum = 2 * heads
+    hidden = -(-hidden // quantum) * quantum
+    return dataclasses.replace(
+        cfg,
+        num_layers=num_layers,
+        hidden_dim=hidden,
+        num_heads=heads,
+        num_kv_heads=None,
+        mlp_dim=None,
+        num_experts=0,
+        remat=False,
+        use_ring_attention=False,
+        use_ulysses_attention=False,
+        ragged_decode=False,
+        paged_decode=False,
+        paged_blocks=0,
+    )
 
 
 def apply_rope(
@@ -389,10 +433,15 @@ class CausalAttention(nn.Module):
         single/short-step reads run the table-indexed streamed kernel
         (`ops/decode_attention.paged_decode_attention`), wide prefill
         chunks gather the slot's blocks into a dense view once and
-        reuse the masked-attention tail. Positions past a slot's
-        logical capacity clamp to its last table entry — idle serving
-        slots (table rows parked on scratch block 0) keep stepping
-        harmlessly."""
+        reuse the masked-attention tail. Writes at positions past the
+        table's logical capacity are DROPPED (not clipped): a clipped
+        write would land in the slot's last real block and corrupt
+        committed rows before the same dispatch's kernel reads them —
+        exactly what a speculative verify window crossing the table
+        edge would do. Idle serving slots (table rows parked on
+        scratch block 0) and lookahead rows past capacity step
+        harmlessly either way: their logits are garbage but never
+        committed."""
         c = self.cfg
         batch, heads, steps, head_dim = q.shape
         kv_heads = k.shape[1]
@@ -421,6 +470,10 @@ class CausalAttention(nn.Module):
             k = apply_rope(k, pos, c.rope_theta)
         logical = jnp.clip(pos // PAGE_ROWS, 0, nlog - 1)
         phys = jnp.take_along_axis(block_table, logical, axis=1)
+        # Out-of-capacity rows scatter to an out-of-bounds pool index
+        # so mode="drop" discards them; clipping instead would rewrite
+        # the slot's last real block in-place.
+        phys = jnp.where(pos < nlog * PAGE_ROWS, phys, c.paged_blocks)
         row = pos % PAGE_ROWS
 
         def put(pool, new):  # new: [batch, kv_heads, steps, d]
@@ -429,7 +482,7 @@ class CausalAttention(nn.Module):
             )
             return pool.at[
                 phys.reshape(-1), :, row.reshape(-1), :
-            ].set(rows.astype(pool.dtype))
+            ].set(rows.astype(pool.dtype), mode="drop")
 
         k_pool = put(pool_k.value, k)
         v_pool = put(pool_v.value, v)

@@ -73,6 +73,37 @@ requests come and go):
   from outside), the request finishes at the boundary with a
   `pool_overflow`-labeled truncation record rather than decoding
   into garbage.
+- **Batched speculative decoding fused into the step program**
+  (`spec=True`, paged mode): decode dispatches are HBM-bound — every
+  step re-reads the resident KV blocks for ONE token per slot — and
+  draft-and-verify (Leviathan et al. 2023) amortizes that read over
+  several tokens. A shared small DRAFT model keeps its own paged KV
+  pool with the SAME block ids (every write to target block b is
+  mirrored to draft block b — the prefill lane writes both models, so
+  a freshly admitted slot is draft-warm the moment it flips live, and
+  prefix-cache-matched blocks are warm in both pools because their
+  original writer mirrored them too). One speculative ROUND per
+  dispatch: the draft proposes k tokens per slot (k cheap single-step
+  forwards), ONE target dispatch verifies all slots' k+1 positions
+  through the multi-step paged kernel (per-slot heterogeneous
+  positions), the target's chosen-token chain replays the plain
+  path's per-token sampling-key protocol bit for bit, and the shared
+  acceptance rule (`models/speculative.py:accept_tokens`) commits a
+  VARIABLE number of tokens per slot by moving that slot's write head
+  (`cache_index <- head + accepted + 1`). Rejected speculative rows
+  need no device rewind — positions past the write head are invisible
+  to the masked kernels until overwritten in order — and blocks
+  lazily allocated for a verify window whose rows were all rejected
+  are returned to the pool at the round's sync. Greedy spec-on output
+  is token-identical to spec-off serving, and seeded sampling too
+  (the chosen chain IS the spec-off stream), for ANY draft weights;
+  an acceptance-adaptive controller (EMA of accepted drafts/round)
+  halves k and finally disables drafting when the draft stops earning
+  its verify cost — protecting the batch>=2 regime where standalone
+  speculative decoding loses to plain batching. Spec rounds are
+  synchronous (the next round's positions depend on this round's
+  acceptance), trading the plain path's one-chunk pipelining for up
+  to k+1 tokens per slot per dispatch.
 - **Chunked, pipelined stepping**: the step program scans
   `chunk_steps` decode steps on-device and carries the token vector in
   device state; the host keeps ONE chunk in flight and fetches chunk
@@ -117,8 +148,13 @@ import numpy as np
 from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
 from walkai_nos_tpu.models.prefix_cache import PrefixIndex
+from walkai_nos_tpu.models.speculative import (
+    accept_tokens,
+    cache_positions,
+    rewind_cache,
+)
 from walkai_nos_tpu.obs.serving import ServingObs
-from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
+from walkai_nos_tpu.ops.decode_attention import MAX_KERNEL_STEPS, PAGE_ROWS
 
 
 @dataclass
@@ -200,6 +236,21 @@ class ContinuousBatcher:
     function of (weights, prompt, knobs, seed) — independent of batch
     composition, admission timing, or which slot it lands in.
 
+    `spec=True` (paged only) turns on batched speculative decoding:
+    a draft model (`draft_cfg` + `draft_params`, typically
+    `models/lm.py:draft_config(cfg)`) proposes `spec_k` tokens per
+    live slot per round, one multi-step target dispatch verifies
+    them, and each slot commits 1..spec_k+1 tokens — greedy and
+    seeded-sampled outputs stay token-identical to spec-off serving
+    for ANY draft weights. The acceptance-adaptive controller halves
+    k, then disables drafting, whenever the EMA of accepted drafts
+    per round stays under `spec_min_accept` past
+    `spec_warmup_rounds` (EMA smoothing `spec_ema_alpha`) — set
+    `spec_min_accept=0.0` to pin drafting on. Disabling is for the
+    engine's lifetime: the plain step program does not mirror writes
+    into the draft pool, so a re-enabled draft would hold a stale
+    cache.
+
     `obs` is the telemetry bundle (`walkai_nos_tpu/obs`): pass a
     `ServingObs` to share a registry with a server, `True` (default)
     for a private bundle, `False` for the no-op bundle (the disabled
@@ -225,6 +276,13 @@ class ContinuousBatcher:
         prefill_chunk: int = 64,
         prefill_lanes: int = 4,
         prefix_cache: bool = True,
+        spec: bool = False,
+        spec_k: int = 4,
+        draft_cfg: LMConfig | None = None,
+        draft_params=None,
+        spec_min_accept: float = 0.35,
+        spec_warmup_rounds: int = 16,
+        spec_ema_alpha: float = 0.25,
         obs: ServingObs | bool = True,
     ) -> None:
         cache_len = cache_len or cfg.max_seq_len
@@ -259,6 +317,55 @@ class ContinuousBatcher:
                 cfg, ragged_decode=True, cache_len=cache_len
             )
         self._model = DecoderLM(self.cfg)
+        # Speculative serving (paged only): the draft holds its own
+        # paged pool with the SAME block count, addressed through the
+        # same host tables — one physical block id names a (target,
+        # draft) block pair, so the allocator needs no second set of
+        # books.
+        self._spec = bool(spec)
+        if self._spec:
+            if not paged:
+                raise ValueError(
+                    "spec=True requires the paged engine (per-slot "
+                    "write heads are what make variable-length "
+                    "acceptance per row possible)"
+                )
+            if draft_cfg is None or draft_params is None:
+                raise ValueError(
+                    "spec=True needs draft_cfg and draft_params "
+                    "(models/lm.py:draft_config builds a compatible one)"
+                )
+            if not 1 <= spec_k <= MAX_KERNEL_STEPS - 1:
+                raise ValueError(
+                    f"spec_k must be in [1, {MAX_KERNEL_STEPS - 1}] "
+                    f"(k+1 verify positions ride the multi-step decode "
+                    f"kernel); got {spec_k}"
+                )
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "target and draft must share a vocabulary "
+                    f"({cfg.vocab_size} != {draft_cfg.vocab_size})"
+                )
+            if draft_cfg.max_seq_len < cache_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} is "
+                    f"shorter than cache_len {cache_len}: the draft "
+                    f"cache tracks the target's positions row for row"
+                )
+            self._draft_cfg = dataclasses.replace(
+                draft_cfg, ragged_decode=True, cache_len=cache_len,
+                paged_decode=True, paged_blocks=self.pool_blocks,
+            )
+            self._draft_model = DecoderLM(self._draft_cfg)
+            self.draft_params = draft_params
+        self._spec_k = spec_k
+        self._k_now = spec_k
+        self._spec_on = self._spec  # controller may flip off, once
+        self._spec_min_accept = spec_min_accept
+        self._spec_warmup = max(1, spec_warmup_rounds)
+        self._spec_alpha = spec_ema_alpha
+        self._spec_ema: float | None = None
+        self._spec_rounds_seen = 0
         self._requests: dict[int, _Request] = {}
         # O(1) admission pops under load (was a list popped from the
         # front — O(n) per admission).
@@ -332,6 +439,17 @@ class ContinuousBatcher:
             jnp.ones(slots, jnp.float32),        # top_p
             jax.random.split(jax.random.PRNGKey(0), slots),
         )
+        if self._spec:
+            # Draft-side paged pool + per-slot index mirror; the
+            # sampling knobs and PRNG keys stay in the target state
+            # (one per-slot protocol, two caches).
+            self._d_cache = self._draft_model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((slots, 1), jnp.int32),
+                decode=True,
+            )["cache"]
+            self.obs.spec_k_gauge.set(spec_k)
+            self.obs.spec_disabled.set(0)
         if paged:
             self._build_paged_programs()
         else:
@@ -376,6 +494,57 @@ class ContinuousBatcher:
         model = self._model
         decode_scan = self._decode_scan
 
+        def target_lane(params, state, pf):
+            """Prefill lane over the TARGET model: [P, W] prompt
+            tokens, each row its own slot/segment. Rows that FINISH
+            their prompt this dispatch carry their slot id in
+            pf_fslot (idle and mid-prompt rows carry `slots`, an
+            out-of-bounds index every scatter drops); the finishing
+            updates are the old admit program, expressed as dropped
+            scatters: index leaves <- true_len, first token into the
+            token vector, knobs + PRNG key into slot state. Shared by
+            the plain step program and the speculative round."""
+            cache, last, temps, topks, topps, keys = state
+            (pf_tok, pf_start, pf_tbl, pf_fslot, pf_true,
+             pf_temp, pf_topk, pf_topp, pf_seed) = pf
+            lane_cache = jax.tree.map(
+                lambda leaf: pf_start if leaf.ndim == 1 else leaf,
+                cache,
+            )
+            pf_logits, lane_vars = model.apply(
+                {"params": params, "cache": lane_cache},
+                pf_tok, decode=True, block_table=pf_tbl,
+                mutable=["cache"],
+            )
+            cache = jax.tree.map(
+                lambda old, new: (
+                    old.at[pf_fslot].set(pf_true, mode="drop")
+                    if old.ndim == 1 else new
+                ),
+                cache, lane_vars["cache"],
+            )
+            last_pos = jnp.clip(
+                pf_true - pf_start - 1, 0, pf_tok.shape[1] - 1
+            )
+            fl = jnp.take_along_axis(
+                pf_logits, last_pos[:, None, None], axis=1
+            )[:, 0]
+            pf_keys = jax.vmap(
+                lambda s: jax.random.split(jax.random.PRNGKey(s))
+            )(pf_seed)
+            first = sample_rows(
+                fl.astype(jnp.float32),
+                pf_temp, pf_topk, pf_topp, pf_keys[:, 1],
+            ).astype(jnp.int32)
+            last = last.at[pf_fslot].set(first, mode="drop")
+            temps = temps.at[pf_fslot].set(pf_temp, mode="drop")
+            topks = topks.at[pf_fslot].set(pf_topk, mode="drop")
+            topps = topps.at[pf_fslot].set(pf_topp, mode="drop")
+            keys = keys.at[pf_fslot].set(pf_keys[:, 0], mode="drop")
+            return (cache, last, temps, topks, topps, keys)
+
+        self._target_lane = target_lane
+
         @functools.partial(
             jax.jit, static_argnames=("lane",), donate_argnums=(1,)
         )
@@ -391,55 +560,136 @@ class ContinuousBatcher:
             pool blocks.
             """
             state, emitted = decode_scan(params, state, dec_table)
-            cache, last, temps, topks, topps, keys = state
             if lane:
-                # Prefill lane: [P, W] prompt tokens, each row its own
-                # slot/segment. Rows that FINISH their prompt this
-                # dispatch carry their slot id in pf_fslot (idle and
-                # mid-prompt rows carry `slots`, an out-of-bounds
-                # index every scatter drops); the finishing updates
-                # are the old admit program, expressed as dropped
-                # scatters: index leaves <- true_len, first token into
-                # the token vector, knobs + PRNG key into slot state.
-                (pf_tok, pf_start, pf_tbl, pf_fslot, pf_true,
-                 pf_temp, pf_topk, pf_topp, pf_seed) = pf
-                lane_cache = jax.tree.map(
-                    lambda leaf: pf_start if leaf.ndim == 1 else leaf,
-                    cache,
+                state = target_lane(params, state, pf)
+            return state, emitted
+
+        self._step_fn = step_chunk
+        if self._spec:
+            self._build_spec_program()
+
+    def _build_spec_program(self) -> None:
+        model, draft = self._model, self._draft_model
+        target_lane = self._target_lane
+        slots = self.slots
+
+        @functools.partial(
+            jax.jit, static_argnames=("k", "lane"),
+            donate_argnums=(1, 3),
+        )
+        def spec_round(
+            params, state, d_params, d_cache, dec_table, pf,
+            k: int, lane: bool,
+        ):
+            """One batched draft-and-verify round over every slot.
+
+            Entering with both caches' write heads at idx0 (per-slot):
+            the draft proposes k tokens greedily (k single-step paged
+            forwards through its OWN pool, same block table — plus one
+            extra step writing d_{k-1}'s K/V, needed at full
+            acceptance), then ONE target dispatch verifies all slots'
+            k+1 positions through the multi-step paged kernel. The
+            chosen-token chain replays the plain decode scan's
+            per-token key protocol exactly (token j samples with
+            split_j's subkey, the key carries split_j's fold), so the
+            committed prefix — and the surviving PRNG key — are
+            bitwise the spec-off stream's for greedy and sampled slots
+            alike. Acceptance is the shared exact-match rule
+            (`accept_tokens`); both write heads move to
+            idx0 + accepted + 1. Rows past the head need no rewind:
+            the masked kernels cannot see them until they are
+            overwritten in order.
+
+            Returns (state, d_cache, emitted [slots, k+2], n_emit):
+            emitted column 0 is the round's INPUT token (a freshly
+            flipped slot's first token, like the plain program's
+            input column), columns 1..k+1 the chosen chain of which
+            the first n_emit[s] are committed."""
+            cache, last, temps, topks, topps, keys = state
+            idx0 = cache_positions(cache)  # [slots] write heads
+
+            def draft_step(carry, _):
+                dc, tok = carry
+                logits, vs = draft.apply(
+                    {"params": d_params, "cache": dc},
+                    tok[:, None], decode=True, block_table=dec_table,
+                    mutable=["cache"],
                 )
-                pf_logits, lane_vars = model.apply(
-                    {"params": params, "cache": lane_cache},
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (vs["cache"], nxt), nxt
+
+            (d_cache, _), drafts = jax.lax.scan(
+                draft_step, (d_cache, last), None, length=k
+            )
+            drafts = drafts.transpose(1, 0)  # [slots, k]
+            # The scan fed cur..d_{k-2}; d_{k-1}'s K/V is still
+            # missing and full acceptance rewinds past it — one extra
+            # cheap draft step writes it, logits discarded.
+            _, d_vs = draft.apply(
+                {"params": d_params, "cache": d_cache},
+                drafts[:, k - 1:], decode=True, block_table=dec_table,
+                mutable=["cache"],
+            )
+            d_cache = d_vs["cache"]
+
+            t_in = jnp.concatenate([last[:, None], drafts], axis=1)
+            t_logits, t_vs = model.apply(
+                {"params": params, "cache": cache},
+                t_in, decode=True, block_table=dec_table,
+                mutable=["cache"],
+            )
+            cache = t_vs["cache"]
+
+            def chain_step(ks, logits_j):
+                split = jax.vmap(jax.random.split)(ks)
+                tok = sample_rows(
+                    logits_j.astype(jnp.float32),
+                    temps, topks, topps, split[:, 1],
+                ).astype(jnp.int32)
+                return split[:, 0], (split[:, 0], tok)
+
+            _, (nkeys, chosen) = jax.lax.scan(
+                chain_step, keys, t_logits.transpose(1, 0, 2)
+            )
+            chosen = chosen.transpose(1, 0)       # [slots, k+1]
+            nkeys = nkeys.transpose(1, 0, 2)      # [slots, k+1, 2]
+
+            _, n_emit, last = accept_tokens(drafts, chosen)
+            # The key after n_emit splits — what the plain path would
+            # hold after emitting the same tokens one by one.
+            keys = nkeys[jnp.arange(slots), n_emit - 1]
+            new_index = idx0 + n_emit
+            cache = rewind_cache(cache, new_index)
+            d_cache = rewind_cache(d_cache, new_index)
+
+            state = (cache, last, temps, topks, topps, keys)
+            if lane:
+                state = target_lane(params, state, pf)
+                # Mirror the lane into the draft pool: block b holds
+                # the same prompt rows in both caches, so the slot is
+                # draft-warm (and its blocks prefix-shareable for
+                # later spec admissions) the moment it flips live.
+                (pf_tok, pf_start, pf_tbl, pf_fslot, pf_true) = pf[:5]
+                d_lane = jax.tree.map(
+                    lambda leaf: pf_start if leaf.ndim == 1 else leaf,
+                    d_cache,
+                )
+                _, d_lane_vars = draft.apply(
+                    {"params": d_params, "cache": d_lane},
                     pf_tok, decode=True, block_table=pf_tbl,
                     mutable=["cache"],
                 )
-                cache = jax.tree.map(
+                d_cache = jax.tree.map(
                     lambda old, new: (
                         old.at[pf_fslot].set(pf_true, mode="drop")
                         if old.ndim == 1 else new
                     ),
-                    cache, lane_vars["cache"],
+                    d_cache, d_lane_vars["cache"],
                 )
-                last_pos = jnp.clip(
-                    pf_true - pf_start - 1, 0, pf_tok.shape[1] - 1
-                )
-                fl = jnp.take_along_axis(
-                    pf_logits, last_pos[:, None, None], axis=1
-                )[:, 0]
-                pf_keys = jax.vmap(
-                    lambda s: jax.random.split(jax.random.PRNGKey(s))
-                )(pf_seed)
-                first = sample_rows(
-                    fl.astype(jnp.float32),
-                    pf_temp, pf_topk, pf_topp, pf_keys[:, 1],
-                ).astype(jnp.int32)
-                last = last.at[pf_fslot].set(first, mode="drop")
-                temps = temps.at[pf_fslot].set(pf_temp, mode="drop")
-                topks = topks.at[pf_fslot].set(pf_topk, mode="drop")
-                topps = topps.at[pf_fslot].set(pf_topp, mode="drop")
-                keys = keys.at[pf_fslot].set(pf_keys[:, 0], mode="drop")
-            return (cache, last, temps, topks, topps, keys), emitted
+            emitted = jnp.concatenate([t_in[:, :1], chosen], axis=1)
+            return state, d_cache, emitted, n_emit
 
-        self._step_fn = step_chunk
+        self._spec_fn = spec_round
 
     def _build_dense_programs(self) -> None:
         model = self._model
@@ -579,6 +829,26 @@ class ContinuousBatcher:
                 f"prompt + max_new_tokens = {total} exceeds cache_len "
                 f"{self.cache_len}",
             )
+        if self._spec_on:
+            # The verify round touches up to spec_k positions past the
+            # last committed token (same lookahead guard the
+            # standalone speculative loop applies): those positions
+            # must stay inside both models' positional range even
+            # though the tokens there are never committed. Gated on
+            # the LIVE controller state, not the constructor flag:
+            # once drafting disables (one-way) no verify window ever
+            # runs again, and the engine must stop shrinking the
+            # admissible request space below spec-off's.
+            limit = min(
+                self.cfg.max_seq_len, self._draft_cfg.max_seq_len
+            )
+            if total + self._spec_k > limit:
+                raise self._reject(
+                    "oversize_reject",
+                    f"prompt + max_new_tokens = {total} + spec_k "
+                    f"{self._spec_k} lookahead exceeds max_seq_len "
+                    f"{limit}",
+                )
         if self.paged:
             if self._blocks_needed(len(prompt), max_new_tokens) > (
                 self.pool_blocks - 1
@@ -638,12 +908,31 @@ class ContinuousBatcher:
     def step(self) -> bool:
         """One pipeline turn: admit, dispatch a chunk, process the
         PREVIOUS chunk's tokens (the host fetch overlaps the chunk
-        just dispatched). True while work remains."""
+        just dispatched). True while work remains.
+
+        Speculative rounds (`spec=True`, until the controller
+        disables drafting) are SYNCHRONOUS instead: the next round's
+        write heads and block backing depend on this round's
+        acceptance, so the round is dispatched and processed in the
+        same turn — each sync commits up to spec_k+1 tokens per slot
+        where a plain chunk's sync commits chunk_steps at one token
+        per slot-step."""
         self._admit()
-        if any(r is not None for r in self._slot_req) or self._prefilling:
-            handle = self._dispatch()
-        else:
-            handle = None
+        has_live = bool(
+            any(r is not None for r in self._slot_req)
+            or self._prefilling
+        )
+        if self._spec and self._spec_on and has_live:
+            if self._inflight is not None:
+                # A plain chunk can only be in flight across the
+                # spec-off -> spec-on boundary (never crossed today:
+                # disabling is one-way); drain it defensively before
+                # the synchronous round reads the write heads.
+                self._process(*self._inflight)
+                self._inflight = None
+            self._process_spec(*self._dispatch_spec())
+            return True
+        handle = self._dispatch() if has_live else None
         if self._inflight is not None:
             self._process(*self._inflight)
         self._inflight = handle
@@ -834,6 +1123,48 @@ class ContinuousBatcher:
             ),
         }
 
+    def spec_stats(self) -> dict:
+        """Speculative-serving telemetry — a view of the registry's
+        `cb_spec_*` series plus the controller's live state: the
+        `/stats` `cb_spec` section and the bench's
+        `cb_spec_accepted_per_round` source. `acceptance_rate` is
+        accepted drafts over proposed drafts; `accepted_per_round`
+        and `emitted_per_round` average over (live slot, round)
+        pairs — emitted = accepted + 1 (the bonus token), so 1.0
+        emitted/round means the draft earned nothing."""
+        if not self._spec:
+            return {"enabled": False}
+        proposed = int(self.obs.spec_proposed.value())
+        accepted = int(self.obs.spec_accepted.value())
+        slot_rounds = int(self.obs.spec_rounds.value())
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "enabled": True,
+            "k": self._k_now,
+            "k_configured": self._spec_k,
+            "drafting_disabled": not self._spec_on,
+            "draft_dispatches": int(self.obs.spec_draft.value()),
+            "verify_dispatches": int(self.obs.spec_verify.value()),
+            "slot_rounds": slot_rounds,
+            "proposed_tokens": proposed,
+            "accepted_tokens": accepted,
+            "acceptance_rate": (
+                round(accepted / proposed, 4) if proposed else None
+            ),
+            "accepted_per_round": (
+                round(accepted / slot_rounds, 4) if slot_rounds
+                else None
+            ),
+            "emitted_per_round": (
+                round((accepted + slot_rounds) / slot_rounds, 4)
+                if slot_rounds else None
+            ),
+            "accepted_ema": (
+                round(self._spec_ema, 4)
+                if self._spec_ema is not None else None
+            ),
+        }
+
     def run(self) -> dict[int, list[int]]:
         """Drive until every submitted request finishes."""
         out: dict[int, list[int]] = {}
@@ -904,16 +1235,20 @@ class ContinuousBatcher:
         self.obs.kv_bytes.inc(float(bytes_backing))
         self.obs.kv_resident.inc(resident)
 
-    def _mark_dispatch(self, busy: int, t0: float) -> None:
+    def _mark_dispatch(self, busy: int, t0: float, steps: int) -> None:
         """Per-dispatch registry writes, shared by both cache layouts
-        (host-side bookkeeping between async dispatches)."""
+        (host-side bookkeeping between async dispatches). `steps` is
+        the dispatch's actual per-slot step window — `chunk_steps` for
+        a plain chunk, k+1 for a speculative round — so the absolute
+        slot-step counters report device work, not the configured
+        chunk size."""
         self._last_dispatch_mono = t0
         obs = self.obs
         obs.dispatches.inc()
         obs.last_dispatch.set(time.time())
         obs.slots_active.set(busy)
-        obs.busy_steps.inc(busy * self.chunk_steps)
-        obs.total_steps.inc(self.slots * self.chunk_steps)
+        obs.busy_steps.inc(busy * steps)
+        obs.total_steps.inc(self.slots * steps)
 
     def _dispatch(self):
         if self.paged:
@@ -926,120 +1261,169 @@ class ContinuousBatcher:
         fresh = list(self._slot_new)
         self._slot_new = [False] * self.slots
         busy = sum(1 for r in snapshot if r is not None)
-        self._mark_dispatch(busy, t0)
+        self._mark_dispatch(busy, t0, self.chunk_steps)
         return emitted, snapshot, fresh, t0
 
-    def _dispatch_paged(self):
-        # Lazy decode allocation: back every live slot's next chunk of
-        # cache writes BEFORE the table snapshot below captures the
-        # rows.
-        self._ensure_decode_blocks()
+    def _paged_prologue(self, steps: int, advance: bool):
+        """Shared paged-dispatch prologue: lazily back the cache rows
+        this dispatch will write BEFORE the table snapshot captures
+        them, record KV telemetry, arm the profiler, and assemble the
+        prefill lane. Returns (t0, dec_table, pf, lane, finished)."""
+        self._ensure_decode_blocks(steps, advance=advance)
         self._record_kv_snapshot()
         self.obs.profile.on_dispatch()
         t0 = time.monotonic()
         dec_table = jnp.asarray(self._table)
-        finished: list[_Prefill] = []
         if self._prefilling:
-            # Lane utilization: rows carrying a real admission vs the
-            # configured lane width, summed over lane dispatches.
-            self.obs.lane_rows.inc(len(self._prefilling))
-            self.obs.lane_capacity.inc(self.prefill_lanes)
-            W = self.prefill_chunk
-            # Lane batch sized to ACTIVE admissions (rounded up to a
-            # power of two, capped at prefill_lanes, so compile
-            # signatures stay bounded): idle lane rows would pay whole
-            # transformer forwards for scratch-block garbage.
-            P = 1
-            while P < len(self._prefilling):
-                P *= 2
-            P = min(P, self.prefill_lanes)
-            pf_tok = np.zeros((P, W), np.int32)
-            pf_start = np.zeros(P, np.int32)
-            pf_tbl = np.zeros((P, self._nlog), np.int32)
-            # `slots` is out of bounds on purpose: scatters with
-            # mode="drop" ignore idle and mid-prompt rows.
-            pf_fslot = np.full(P, self.slots, np.int32)
-            pf_true = np.ones(P, np.int32)
-            pf_temp = np.zeros(P, np.float32)
-            pf_topk = np.zeros(P, np.int32)
-            pf_topp = np.ones(P, np.float32)
-            pf_seed = np.zeros(P, np.int32)
-            lane_end = W  # highest position any lane row touches
-            for r, entry in enumerate(self._prefilling):
-                req = entry.req
-                true_len = len(req.prompt)
-                remaining = true_len - entry.consumed
-                if remaining > W:
-                    start = entry.consumed
-                    entry.consumed += W
-                else:
-                    # Final chunk: align its END to the prompt's end
-                    # (re-writing up to W-remaining already-written
-                    # rows with identical values — identical because
-                    # each row is a deterministic per-position
-                    # function of the prefix) so the last true
-                    # token's logits sit inside this chunk, clamped
-                    # to the CACHED prefix boundary: rows below
-                    # `entry.cached` live in shared index blocks this
-                    # request must never write (another sharer may be
-                    # reading them in this very dispatch).
-                    start = max(entry.cached, true_len - W)
-                    entry.consumed = true_len
-                    finished.append(entry)
-                    pf_fslot[r] = entry.slot
-                    pf_true[r] = true_len
-                    pf_temp[r] = req.temperature
-                    pf_topk[r] = req.top_k
-                    pf_topp[r] = req.top_p
-                    pf_seed[r] = req.seed
-                seg = req.prompt[start:start + W]
-                pf_tok[r, :len(seg)] = seg
-                pf_start[r] = start
-                pf_tbl[r, :len(entry.blocks)] = entry.blocks
-                lane_end = max(lane_end, start + W)
-                # Own inserted index nodes become matchable once the
-                # chunk writing their rows is dispatched: any later
-                # reader's chunks dispatch strictly after this one,
-                # and the device executes dispatches in order.
-                while (
-                    entry.pending
-                    and entry.pending[0].depth * PAGE_ROWS
-                    <= entry.consumed
-                ):
-                    self._prefix.mark_ready(entry.pending.pop(0))
-                self.obs.trace.prefill_chunk(
-                    req.rid, t0, entry.consumed, true_len
-                )
-            # The lane only ever touches positions < lane_end, so hand
-            # it a table truncated to the covering logical blocks
-            # (rounded up to a power of two, capped at the full width,
-            # to bound compile signatures): the wide-prefill gather in
-            # the model materializes table-width x 128 rows per layer,
-            # which must scale with the prompt prefix being written,
-            # not with cache_len.
-            need = -(-lane_end // PAGE_ROWS)
-            nlog = 1
-            while nlog < need:
-                nlog *= 2
-            nlog = min(nlog, self._nlog)
-            pf = tuple(
-                jnp.asarray(a) for a in (
-                    pf_tok, pf_start, pf_tbl[:, :nlog], pf_fslot,
-                    pf_true, pf_temp, pf_topk, pf_topp, pf_seed,
-                )
-            )
-            self._state, emitted = self._step_fn(
-                self.params, self._state, dec_table, pf, True
-            )
-        else:
-            self._state, emitted = self._step_fn(
-                self.params, self._state, dec_table, (), False
-            )
-        # Snapshot BEFORE flipping finished prefills live: their first
-        # token rides the NEXT chunk's input column.
+            pf, finished = self._prepare_lane(t0)
+            return t0, dec_table, pf, True, finished
+        return t0, dec_table, (), False, []
+
+    def _paged_epilogue(self, finished, t0: float, steps: int):
+        """Shared paged-dispatch epilogue: snapshot slot state BEFORE
+        flipping finished prefills live (their first token rides the
+        NEXT chunk's input column), then the per-dispatch registry
+        writes. Returns (snapshot, fresh)."""
         snapshot = list(self._slot_req)
         fresh = list(self._slot_new)
         self._slot_new = [False] * self.slots
+        self._flip_finished(finished)
+        busy = sum(1 for r in snapshot if r is not None)
+        self._mark_dispatch(busy, t0, steps)
+        return snapshot, fresh
+
+    def _dispatch_paged(self):
+        t0, dec_table, pf, lane, finished = self._paged_prologue(
+            self.chunk_steps, advance=True
+        )
+        self._state, emitted = self._step_fn(
+            self.params, self._state, dec_table, pf, lane
+        )
+        snapshot, fresh = self._paged_epilogue(
+            finished, t0, self.chunk_steps
+        )
+        return emitted, snapshot, fresh, t0
+
+    def _dispatch_spec(self):
+        """Dispatch one speculative round: back the k+1 verify window
+        for every live slot (the write head `_slot_pos` is EXACT here
+        — rounds are synchronous, so the mirror advanced with the
+        last round's accepted counts), then the fused
+        draft-scan + verify + lane program."""
+        t0, dec_table, pf, lane, finished = self._paged_prologue(
+            self._k_now + 1, advance=False
+        )
+        out = self._spec_fn(
+            self.params, self._state, self.draft_params,
+            self._d_cache, dec_table, pf, k=self._k_now, lane=lane,
+        )
+        self._state, self._d_cache, emitted, n_emit = out
+        snapshot, fresh = self._paged_epilogue(
+            finished, t0, self._k_now + 1
+        )
+        return emitted, n_emit, snapshot, fresh, t0
+
+    def _prepare_lane(self, t0: float):
+        """Host-side prefill-lane assembly for one dispatch: the
+        [P, W] token/table arrays, the finishing-row scatter operands,
+        and the prefix-index ready marks. Returns (pf, finished) —
+        shared by the plain and speculative dispatch paths."""
+        # Lane utilization: rows carrying a real admission vs the
+        # configured lane width, summed over lane dispatches.
+        self.obs.lane_rows.inc(len(self._prefilling))
+        self.obs.lane_capacity.inc(self.prefill_lanes)
+        W = self.prefill_chunk
+        finished: list[_Prefill] = []
+        # Lane batch sized to ACTIVE admissions (rounded up to a
+        # power of two, capped at prefill_lanes, so compile
+        # signatures stay bounded): idle lane rows would pay whole
+        # transformer forwards for scratch-block garbage.
+        P = 1
+        while P < len(self._prefilling):
+            P *= 2
+        P = min(P, self.prefill_lanes)
+        pf_tok = np.zeros((P, W), np.int32)
+        pf_start = np.zeros(P, np.int32)
+        pf_tbl = np.zeros((P, self._nlog), np.int32)
+        # `slots` is out of bounds on purpose: scatters with
+        # mode="drop" ignore idle and mid-prompt rows.
+        pf_fslot = np.full(P, self.slots, np.int32)
+        pf_true = np.ones(P, np.int32)
+        pf_temp = np.zeros(P, np.float32)
+        pf_topk = np.zeros(P, np.int32)
+        pf_topp = np.ones(P, np.float32)
+        pf_seed = np.zeros(P, np.int32)
+        lane_end = W  # highest position any lane row touches
+        for r, entry in enumerate(self._prefilling):
+            req = entry.req
+            true_len = len(req.prompt)
+            remaining = true_len - entry.consumed
+            if remaining > W:
+                start = entry.consumed
+                entry.consumed += W
+            else:
+                # Final chunk: align its END to the prompt's end
+                # (re-writing up to W-remaining already-written
+                # rows with identical values — identical because
+                # each row is a deterministic per-position
+                # function of the prefix) so the last true
+                # token's logits sit inside this chunk, clamped
+                # to the CACHED prefix boundary: rows below
+                # `entry.cached` live in shared index blocks this
+                # request must never write (another sharer may be
+                # reading them in this very dispatch).
+                start = max(entry.cached, true_len - W)
+                entry.consumed = true_len
+                finished.append(entry)
+                pf_fslot[r] = entry.slot
+                pf_true[r] = true_len
+                pf_temp[r] = req.temperature
+                pf_topk[r] = req.top_k
+                pf_topp[r] = req.top_p
+                pf_seed[r] = req.seed
+            seg = req.prompt[start:start + W]
+            pf_tok[r, :len(seg)] = seg
+            pf_start[r] = start
+            pf_tbl[r, :len(entry.blocks)] = entry.blocks
+            lane_end = max(lane_end, start + W)
+            # Own inserted index nodes become matchable once the
+            # chunk writing their rows is dispatched: any later
+            # reader's chunks dispatch strictly after this one,
+            # and the device executes dispatches in order.
+            while (
+                entry.pending
+                and entry.pending[0].depth * PAGE_ROWS
+                <= entry.consumed
+            ):
+                self._prefix.mark_ready(entry.pending.pop(0))
+            self.obs.trace.prefill_chunk(
+                req.rid, t0, entry.consumed, true_len
+            )
+        # The lane only ever touches positions < lane_end, so hand
+        # it a table truncated to the covering logical blocks
+        # (rounded up to a power of two, capped at the full width,
+        # to bound compile signatures): the wide-prefill gather in
+        # the model materializes table-width x 128 rows per layer,
+        # which must scale with the prompt prefix being written,
+        # not with cache_len.
+        need = -(-lane_end // PAGE_ROWS)
+        nlog = 1
+        while nlog < need:
+            nlog *= 2
+        nlog = min(nlog, self._nlog)
+        pf = tuple(
+            jnp.asarray(a) for a in (
+                pf_tok, pf_start, pf_tbl[:, :nlog], pf_fslot,
+                pf_true, pf_temp, pf_topk, pf_topp, pf_seed,
+            )
+        )
+        return pf, finished
+
+    def _flip_finished(self, finished: list[_Prefill]) -> None:
+        """Flip requests whose final prefill chunk just dispatched
+        LIVE: hand the slot its request, budget, blocks, prefix pins,
+        and the write-head mirror (decode writes start at true_len
+        next dispatch)."""
         for entry in finished:
             self._prefilling.remove(entry)
             s = entry.slot
@@ -1049,17 +1433,12 @@ class ContinuousBatcher:
             self._slot_blocks[s] = entry.blocks
             self._slot_nodes[s] = entry.nodes
             self._slot_resv[s] = entry.resv
-            # Mirror of the device cache_index from here on (decode
-            # writes start at true_len next dispatch).
             self._slot_pos[s] = len(entry.req.prompt)
             self._table[s, :len(entry.blocks)] = entry.blocks
         self.obs.lane_active.set(len(self._prefilling))
-        busy = sum(1 for r in snapshot if r is not None)
-        self._mark_dispatch(busy, t0)
-        return emitted, snapshot, fresh, t0
 
-    def _ensure_decode_blocks(self) -> None:
-        """Back every live slot's next `chunk_steps` cache writes,
+    def _ensure_decode_blocks(self, window: int, *, advance: bool) -> None:
+        """Back every live slot's next `window` cache writes,
         allocating decode blocks only as the write head crosses
         128-row boundaries (lazy: pool residency tracks tokens
         actually written, and headroom reports actual residency).
@@ -1067,14 +1446,20 @@ class ContinuousBatcher:
         succeeds — from the free list or by evicting a parked prefix
         block; if the pool is somehow truly dry, the request is
         TRUNCATED at its backed boundary (a `pool_overflow`-labeled
-        completion) rather than decoding through scratch garbage."""
+        completion) rather than decoding through scratch garbage.
+
+        `advance` mirrors the device's unconditional cache_index
+        advance (plain chunks add chunk_steps per dispatch).
+        Speculative rounds pass advance=False: their heads move by
+        the ACCEPTED count, known only at the round's sync, so
+        `_process_spec` advances the mirror instead."""
         for s in range(self.slots):
             req = self._slot_req[s]
             if req is None or req.done:
                 continue
             if not req.truncated:
                 total = len(req.prompt) + req.max_new_tokens
-                end = min(int(self._slot_pos[s]) + self.chunk_steps, total)
+                end = min(int(self._slot_pos[s]) + window, total)
                 need = -(-end // PAGE_ROWS)
                 while len(self._slot_blocks[s]) < need:
                     block = self._grab_block()
@@ -1086,10 +1471,38 @@ class ContinuousBatcher:
                     if self._slot_resv[s] > 0:
                         self._slot_resv[s] -= 1
                         self._reserved -= 1
-            # The device advances every slot's cache_index by
-            # chunk_steps per dispatch; mirror it for live slots.
-            self._slot_pos[s] += self.chunk_steps
+            if advance:
+                self._slot_pos[s] += window
         self._set_pool_gauges()
+
+    def _rollback_spec_blocks(self, s: int) -> None:
+        """Return a live slot's decode blocks that back ONLY
+        uncommitted speculative rows — blocks grabbed for a verify
+        window whose rows were then rejected. The block goes back to
+        the free list (usable by any admission this very turn) and
+        the slot's virtual reservation grows back by one, so the
+        admission invariant (free + parked >= reserved) is untouched
+        on both sides; the next round's `_ensure_decode_blocks`
+        re-grabs a block if the head advances across the boundary
+        after all. Garbage speculative writes in a returned block are
+        harmless: any block handed to a new owner is rewritten
+        position-by-position before those positions become visible
+        (the pad-row invariant). Truncated slots keep their blocks —
+        their budget was already capped to what those blocks back."""
+        req = self._slot_req[s]
+        if req is None or req.done or req.truncated:
+            return
+        keep = max(
+            -(-int(self._slot_pos[s]) // PAGE_ROWS),
+            len(self._slot_nodes[s]),
+            1,
+        )
+        while len(self._slot_blocks[s]) > keep:
+            block = self._slot_blocks[s].pop()
+            self._table[s, len(self._slot_blocks[s])] = 0
+            self._free_blocks.append(block)
+            self._slot_resv[s] += 1
+            self._reserved += 1
 
     def _truncate_slot(self, s: int) -> None:
         """Cap a live slot's budget at what its allocated blocks can
@@ -1108,65 +1521,155 @@ class ContinuousBatcher:
             self._reserved -= int(self._slot_resv[s])
             self._slot_resv[s] = 0
 
+    def _commit_tokens(self, s: int, req: _Request, emit, now) -> int:
+        """Feed one slot's newly host-visible tokens into its request:
+        first-token/TTFT bookkeeping, EOS and budget termination, slot
+        release. The ONE commit rule the plain chunk and the
+        speculative round share — spec-on differs only in WHICH
+        tokens reach here (the accepted prefix), never in what
+        happens to them. Returns the number committed."""
+        obs = self.obs
+        n = 0
+        for t in emit:
+            if not req.tokens:
+                req.first_token_at = now
+                obs.ttft.observe(now - req.submitted_at)
+                obs.trace.first_token(req.rid, now)
+            req.tokens.append(int(t))
+            n += 1
+            self._budget[s] -= 1
+            if (
+                req.eos_id is not None and int(t) == req.eos_id
+            ) or self._budget[s] <= 0:
+                req.done = True
+                req.completed_at = now
+                if req.eos_id is not None and int(t) == req.eos_id:
+                    reason = "eos"
+                elif req.truncated:
+                    # Budget exhausted because a mid-flight block
+                    # grab found the pool dry: a truncation, not
+                    # a natural completion.
+                    reason = "pool_overflow"
+                else:
+                    reason = "budget"
+                # The record flag means "output actually cut at a
+                # pool boundary" — a capped request that still hit
+                # EOS first completed naturally.
+                req.truncated = reason == "pool_overflow"
+                obs.completed.inc(labels={"reason": reason})
+                obs.wall.observe(now - req.submitted_at)
+                if len(req.tokens) > 1 and now > req.first_token_at:
+                    # Requests finishing within their first chunk
+                    # have no host-observable decode pace (all
+                    # tokens landed at one sync) — same exclusion
+                    # the bench's token-pace percentile applies.
+                    obs.tpot.observe(
+                        (now - req.first_token_at)
+                        / (len(req.tokens) - 1)
+                    )
+                obs.trace.done(req.rid, now, reason, len(req.tokens))
+                if self._slot_req[s] is req:
+                    self._slot_req[s] = None
+                    self._budget[s] = 0
+                    if self.paged:
+                        self._release_slot(s)
+                break
+        return n
+
     def _process(self, emitted, snapshot, fresh, t_dispatch) -> None:
         tokens = np.asarray(emitted)  # [slots, 1 + chunk] — the sync
         # ONE clock read serves every record in this chunk: the sync
         # just completed is the moment all of them became host-visible,
         # and the trace/histograms/done-records must agree exactly.
         now = time.monotonic()
-        obs = self.obs
-        obs.dispatch_latency.observe(now - t_dispatch)
+        self.obs.dispatch_latency.observe(now - t_dispatch)
         n_emitted = 0
         for s, req in enumerate(snapshot):
             if req is None or req.done:
                 continue
             emit = tokens[s] if fresh[s] else tokens[s, 1:]
-            for t in emit:
-                if not req.tokens:
-                    req.first_token_at = now
-                    obs.ttft.observe(now - req.submitted_at)
-                    obs.trace.first_token(req.rid, now)
-                req.tokens.append(int(t))
-                n_emitted += 1
-                self._budget[s] -= 1
-                if (
-                    req.eos_id is not None and int(t) == req.eos_id
-                ) or self._budget[s] <= 0:
-                    req.done = True
-                    req.completed_at = now
-                    if req.eos_id is not None and int(t) == req.eos_id:
-                        reason = "eos"
-                    elif req.truncated:
-                        # Budget exhausted because a mid-flight block
-                        # grab found the pool dry: a truncation, not
-                        # a natural completion.
-                        reason = "pool_overflow"
-                    else:
-                        reason = "budget"
-                    # The record flag means "output actually cut at a
-                    # pool boundary" — a capped request that still hit
-                    # EOS first completed naturally.
-                    req.truncated = reason == "pool_overflow"
-                    obs.completed.inc(labels={"reason": reason})
-                    obs.wall.observe(now - req.submitted_at)
-                    if len(req.tokens) > 1 and now > req.first_token_at:
-                        # Requests finishing within their first chunk
-                        # have no host-observable decode pace (all
-                        # tokens landed at one sync) — same exclusion
-                        # the bench's token-pace percentile applies.
-                        obs.tpot.observe(
-                            (now - req.first_token_at)
-                            / (len(req.tokens) - 1)
-                        )
-                    obs.trace.done(req.rid, now, reason, len(req.tokens))
-                    if self._slot_req[s] is req:
-                        self._slot_req[s] = None
-                        self._budget[s] = 0
-                        if self.paged:
-                            self._release_slot(s)
-                    break
+            n_emitted += self._commit_tokens(s, req, emit, now)
+        if n_emitted:
+            self.obs.tokens.inc(n_emitted)
+
+    def _process_spec(
+        self, emitted, n_emit, snapshot, fresh, t_dispatch
+    ) -> None:
+        """Sync one speculative round and commit its acceptances:
+        per live slot, move the write-head mirror by the accepted
+        count, commit `[input?] + chosen[:n_emit]` through the shared
+        commit rule, return verify-window blocks the rejections left
+        unused, and feed the acceptance controller."""
+        tokens = np.asarray(emitted)   # [slots, k + 2] — the sync
+        counts = np.asarray(n_emit)    # [slots] committed per slot
+        now = time.monotonic()
+        obs = self.obs
+        obs.dispatch_latency.observe(now - t_dispatch)
+        k = self._k_now
+        n_emitted = 0
+        live = 0
+        accepted = 0
+        for s, req in enumerate(snapshot):
+            # Idle slots drafted and "accepted" scratch garbage; their
+            # device heads moved, but nothing here reads them again
+            # before a flip-live resets slot state.
+            if req is None or req.done:
+                continue
+            live += 1
+            c = int(counts[s])
+            accepted += c - 1
+            obs.spec_emitted.observe(c)
+            # Committed write head: equals the device's post-rewind
+            # cache_index exactly (spec rounds are synchronous).
+            self._slot_pos[s] += c
+            emit = tokens[s, :1 + c] if fresh[s] else tokens[s, 1:1 + c]
+            n_emitted += self._commit_tokens(s, req, emit, now)
+            self._rollback_spec_blocks(s)
         if n_emitted:
             obs.tokens.inc(n_emitted)
+        obs.spec_verify.inc()
+        obs.spec_draft.inc(k + 1)
+        if live:
+            obs.spec_rounds.inc(live)
+            obs.spec_proposed.inc(k * live)
+            obs.spec_accepted.inc(accepted)
+            obs.trace.spec_round(now, k, live, accepted)
+            self._spec_controller(accepted / live)
+        self._set_pool_gauges()
+
+    def _spec_controller(self, round_accepted: float) -> None:
+        """Acceptance-adaptive drafting: EMA the mean accepted drafts
+        per live slot per round; when it sits under `spec_min_accept`
+        past the warmup, first halve k (each k compiles its own round
+        program; a shorter window wastes less verify work per miss),
+        and at k=1 disable drafting for the engine's lifetime — the
+        protection for workloads where the draft never earns its
+        keep, e.g. the batch>=2 regime that made standalone
+        speculative decoding a net loss. Every k change resets the
+        EMA so the new operating point is judged on its own rounds."""
+        a = self._spec_alpha
+        self._spec_ema = (
+            round_accepted if self._spec_ema is None
+            else a * round_accepted + (1 - a) * self._spec_ema
+        )
+        self._spec_rounds_seen += 1
+        if (
+            self._spec_rounds_seen < self._spec_warmup
+            or self._spec_ema >= self._spec_min_accept
+        ):
+            return
+        if self._k_now > 1:
+            self._k_now = max(1, self._k_now // 2)
+            self._spec_rounds_seen = 0
+            self._spec_ema = None
+            self.obs.spec_k_gauge.set(self._k_now)
+            self.obs.trace.event(
+                "spec_k_drop", time.monotonic(), k=self._k_now
+            )
+        else:
+            self._spec_on = False
+            self.obs.spec_disabled.set(1)
+            self.obs.trace.event("spec_disabled", time.monotonic())
 
     def _release_slot(self, s: int) -> None:
         """Return a freed slot's PRIVATE blocks to the pool, release

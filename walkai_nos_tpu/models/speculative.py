@@ -38,8 +38,14 @@ input is the last emitted token, index n):
   cur, d_0..d_{a-1}), so their indices rewind to n+a+1; stale entries
   beyond are invisible (causal masking) until overwritten in order.
 
-Single-sequence (batch 1): acceptance length is data-dependent PER ROW,
-so batching requires per-row cache indices — out of scope here.
+This standalone loop is single-sequence (batch 1): acceptance length
+is data-dependent per row, and a dense cache has one index. The
+BATCHED variant lives in the serving engine (`models/serve.py`
+`spec=True`), where the paged cache's per-slot indices make
+variable-length acceptance per row natural; both paths share the
+acceptance rule (`accept_tokens`) and the index rewind
+(`rewind_cache`) exported here, so the two implementations cannot
+drift.
 
 No reference analogue — serving-side companion of `models/decode.py`.
 """
@@ -57,18 +63,78 @@ from walkai_nos_tpu.models.decode import cache_bucket
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
 
 
-def _rewind_cache(cache, new_index):
+def rewind_cache(cache, new_index):
     """Set every cache_index / pos_index leaf to `new_index`, leaving
     the K/V buffers in place (stale tail entries are masked until
-    overwritten)."""
+    overwritten). `new_index` is a scalar, or a [batch] vector for
+    ragged caches (the serving engine's per-slot write heads) —
+    broadcast to each leaf's shape either way, so the one rewind
+    serves both the standalone loop and the batched serving path."""
 
     def fix(path, leaf):
         name = path[-1].key if path else ""
         if name in ("cache_index", "pos_index"):
-            return jnp.asarray(new_index, leaf.dtype)
+            return jnp.broadcast_to(
+                jnp.asarray(new_index, leaf.dtype), leaf.shape
+            )
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def cache_positions(cache):
+    """The cache's current write head: the value of the first
+    `cache_index` leaf (scalar, or [batch] when ragged). Every layer's
+    index advances in lockstep, so one leaf speaks for all — the
+    serving engine reads it inside its jitted speculative round to
+    compute the post-acceptance rewind target without trusting a
+    host-side mirror."""
+    found = []
+
+    def visit(path, leaf):
+        name = path[-1].key if path else ""
+        if name == "cache_index":
+            found.append(leaf)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, cache)
+    if not found:
+        raise ValueError("cache pytree has no cache_index leaf")
+    return found[0]
+
+
+def accept_tokens(drafts: jax.Array, chosen: jax.Array):
+    """The ONE acceptance rule both speculative paths share.
+
+    drafts: [rows, k] draft-proposed tokens; chosen: [rows, k + 1] the
+    target's chosen token at each verified position (argmax for
+    greedy, the seeded per-row sample for the serving engine's sampled
+    slots — either way the token the target WOULD have emitted
+    stepwise). Per row: accept the longest prefix with
+    drafts[j] == chosen[j], emit chosen[0..a] (the matched drafts plus
+    the free bonus token). Because `chosen` is exactly the stepwise
+    emission chain, the committed tokens equal spec-off decoding token
+    for token — exact-match acceptance preserves the target
+    distribution by construction (standalone `speculative.py`
+    semantics, batched).
+
+    Returns (accepted [rows], n_emit [rows], last [rows]): matched
+    draft count a in [0, k], tokens to commit a + 1 in [1, k + 1], and
+    the last committed token chosen[row, a] (the next round's input).
+    """
+    rows, k = drafts.shape
+    match = drafts == chosen[:, :k]
+    # argmin over [match, False]: index of the first mismatch — k (the
+    # appended False) when every draft matched.
+    a = jnp.argmin(
+        jnp.concatenate(
+            [match, jnp.zeros((rows, 1), bool)], axis=1
+        ).astype(jnp.int32),
+        axis=1,
+    ).astype(jnp.int32)
+    n_emit = a + 1
+    last = jnp.take_along_axis(chosen, a[:, None], axis=1)[:, 0]
+    return a, n_emit, last
 
 
 def make_speculative_generate_fn(
@@ -192,23 +258,20 @@ def make_speculative_generate_fn(
             )
             preds = jnp.argmax(t_logits, axis=-1)  # [1, k+1] = P_0..P_k
 
-            # 3. Acceptance: longest prefix with d_j == P_j.
-            match = drafts[0] == preds[0, :k]
-            a = jnp.argmin(
-                jnp.concatenate(
-                    [match, jnp.zeros((1,), bool)]
-                ).astype(jnp.int32)
+            # 3. Acceptance: longest prefix with d_j == P_j — the
+            # shared rule (`accept_tokens`, also the serving engine's).
+            a_rows, n_emit_rows, last = accept_tokens(
+                drafts, preds.astype(jnp.int32)
             )
-            n_emit = a + 1  # P_0..P_a
+            a, n_emit = a_rows[0], n_emit_rows[0]  # P_0..P_a
 
             # 4. Emit and rewind both caches to n + a + 1.
             out = jax.lax.dynamic_update_slice(
                 out, preds.astype(jnp.int32), (0, emitted)
             )
             new_index = n + n_emit
-            t_cache = _rewind_cache(t_vs["cache"], new_index)
-            d_cache = _rewind_cache(d_cache, new_index)
-            last = preds[:, a]
+            t_cache = rewind_cache(t_vs["cache"], new_index)
+            d_cache = rewind_cache(d_cache, new_index)
             return (
                 t_cache, d_cache, last, new_index,
                 emitted + n_emit, out, hist.at[a].add(1),

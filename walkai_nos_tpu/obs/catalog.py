@@ -147,6 +147,59 @@ CATALOG: tuple[MetricSpec, ...] = (
         attr="prefix_prompt_tokens",
     ),
     MetricSpec(
+        "cb_spec_draft_dispatches_total", "counter",
+        "Draft-model forwards dispatched by speculative serving "
+        "rounds (k scan steps + 1 lookahead K/V write per round)",
+        attr="spec_draft",
+    ),
+    MetricSpec(
+        "cb_spec_verify_dispatches_total", "counter",
+        "Target multi-step verify dispatches (one per speculative "
+        "round)",
+        attr="spec_verify",
+    ),
+    MetricSpec(
+        "cb_spec_slot_rounds_total", "counter",
+        "(live slot, speculative round) pairs — the per-slot-round "
+        "denominator for acceptance and commit averages",
+        attr="spec_rounds",
+    ),
+    MetricSpec(
+        "cb_spec_proposed_tokens_total", "counter",
+        "Draft tokens proposed to live slots (acceptance-rate "
+        "denominator)",
+        attr="spec_proposed",
+    ),
+    MetricSpec(
+        "cb_spec_accepted_tokens_total", "counter",
+        "Draft tokens the target verify accepted (acceptance-rate "
+        "numerator)",
+        attr="spec_accepted",
+    ),
+    MetricSpec(
+        "cb_spec_commit_tokens_per_round", "histogram",
+        "Tokens the verify committed per live slot per speculative "
+        "round (accepted drafts + the bonus token, 1..k+1) — "
+        "device-side counts, like every cb_spec_* acceptance metric: "
+        "a round that ends its request mid-window (EOS or budget) "
+        "still counts the full verified window; realized emission is "
+        "cb_tokens_total",
+        buckets=(1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0),
+        attr="spec_emitted",
+    ),
+    MetricSpec(
+        "cb_spec_k", "gauge",
+        "Current draft length k chosen by the acceptance-adaptive "
+        "controller",
+        attr="spec_k_gauge",
+    ),
+    MetricSpec(
+        "cb_spec_drafting_disabled", "gauge",
+        "1 once the acceptance-adaptive controller has disabled "
+        "drafting for this engine (0 while drafting)",
+        attr="spec_disabled",
+    ),
+    MetricSpec(
         "cb_admission_stall_seconds_total", "counter",
         "Cumulative host seconds inside admission work (dense mode: "
         "blocking prefill+admit dispatches; paged: bookkeeping only)",

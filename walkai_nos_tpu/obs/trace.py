@@ -40,6 +40,12 @@ PREFILL_CHUNK = "prefill_chunk"
 FIRST_TOKEN = "first_token"
 DONE = "done"
 ERROR = "error"
+# Speculative-serving round phases (engine-level ring events — the
+# draft scan and the target verify run fused in one device dispatch,
+# so the phases are markers at the round's host sync, not separately
+# timed sub-spans).
+SPEC_DRAFT = "spec_draft"
+SPEC_VERIFY = "spec_verify"
 
 
 class Ring:
@@ -162,6 +168,22 @@ class RequestTrace:
                 span["chunks"].append((t, consumed))
         self.event(
             PREFILL_CHUNK, t, rid=rid, consumed=consumed, total=total
+        )
+
+    def spec_round(
+        self, t: float, k: int, live_slots: int, accepted: int
+    ) -> None:
+        """One speculative draft-and-verify round: a draft-phase and a
+        verify-phase marker on the engine track (tid 0 in the Chrome
+        export). `accepted` is the round's total accepted draft
+        tokens across the `live_slots` slots that carried a request —
+        the per-round acceptance story a trace viewer can scrub."""
+        if not self.enabled:
+            return
+        self.event(SPEC_DRAFT, t, k=k, live_slots=live_slots)
+        self.event(
+            SPEC_VERIFY, t, k=k, live_slots=live_slots,
+            accepted=accepted,
         )
 
     def first_token(self, rid: int, t: float) -> None:
