@@ -652,7 +652,9 @@ def main() -> None:
             "cb_vs_serial_speedup", "cb_ttft_p50", "cb_token_p99",
             "cb_serving_capacity_tokens_per_s", "cb_admission_stall_ms",
             "cb_kv_hbm_bytes_per_resident_token", "cb_prefix_hit_rate",
-            "cb_prefill_tokens_saved_frac",
+            "cb_prefill_tokens_saved_frac", "cb_device_step_ms",
+            "cb_host_overhead_frac", "cb_device_roofline_fraction",
+            "cb_slo_ttft_p99", "cb_saturation",
             "cb_spec_capacity_tokens_per_s",
             "cb_spec_accepted_per_round", "obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",

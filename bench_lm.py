@@ -444,6 +444,39 @@ def _pctl(sorted_vals, q):
     return percentile(sorted_vals, q)
 
 
+def scrape_metrics(base: str) -> str:
+    """GET `{base}/metrics` — the ONE Prometheus scrape helper every
+    HTTP bench phase (serving, prefix-reuse, speculative) brackets its
+    measurement window with (was a local closure inside
+    `measure_cb_serving`; the other phases re-invented or skipped
+    it)."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+        return resp.read().decode()
+
+
+def _parse_value(text: str, name: str) -> float | None:
+    """First sample value of an UNLABELED series in a Prometheus text
+    exposition (gauges like cb_device_step_ms, plain counters like
+    cb_prefix_blocks_hit_total). None when the series is absent —
+    e.g. a gauge never set because its input (published HBM
+    bandwidth) doesn't exist on this host."""
+    import re
+
+    m = re.search(
+        rf"^{re.escape(name)} (-?[0-9.eE+-]+|NaN|[+-]Inf)$",
+        text,
+        re.MULTILINE,
+    )
+    if m is None:
+        return None
+    try:
+        return float(m.group(1).replace("Inf", "inf"))
+    except ValueError:
+        return None
+
+
 def _parse_histogram(text: str, name: str) -> dict[float, int]:
     """Cumulative bucket counts {le_bound: count} for one histogram in
     a Prometheus text exposition (the /metrics scrape). +Inf maps to
@@ -536,7 +569,6 @@ def measure_cb_serving(
     pool's memory-per-token snapshot under load).
     """
     import threading
-    import urllib.request
 
     from walkai_nos_tpu.utils.httpbench import (
         get_json,
@@ -633,18 +665,12 @@ def measure_cb_serving(
         occ0 = stats0.get("cb_occupancy", {})
         kv0 = stats0.get("cb_kv", {})
 
-        def scrape_metrics() -> str:
-            with urllib.request.urlopen(
-                f"{base}/metrics", timeout=30
-            ) as resp:
-                return resp.read().decode()
-
         # /metrics scrape bracketing the window: the TTFT histogram's
         # bucket-count DELTA over exactly the Poisson-fired requests
         # (capacity traffic completed before this snapshot), so the
         # histogram-derived p99 is comparable to the record-derived
         # one — within one log-bucket width, the registry's guarantee.
-        metrics0 = scrape_metrics()
+        metrics0 = scrape_metrics(base)
 
         def fire(payload: dict) -> None:
             t0 = time.perf_counter()
@@ -701,7 +727,7 @@ def measure_cb_serving(
         # After the joins: every fired request's first token is in the
         # server-side histogram, so the delta population matches the
         # client records exactly.
-        metrics1 = scrape_metrics()
+        metrics1 = scrape_metrics(base)
     finally:
         kill_server(proc)
 
@@ -782,6 +808,25 @@ def measure_cb_serving(
             _parse_histogram(metrics1, "cb_tpot_seconds"),
             0.99,
         ),
+        # Device-time attribution gauges (obs/attrib.py), scraped at
+        # window end: device-attributed ms per batch step, the host
+        # fraction of step time, and the live roofline fraction (None
+        # off-TPU — no published HBM bandwidth to anchor it). The
+        # first two are gated in BASELINE.json (absent_ok bands).
+        "cb_device_step_ms": _parse_value(
+            metrics1, "cb_device_step_ms"
+        ),
+        "cb_host_overhead_frac": _parse_value(
+            metrics1, "cb_host_overhead_frac"
+        ),
+        "cb_device_roofline_fraction": _parse_value(
+            metrics1, "cb_device_roofline_fraction"
+        ),
+        # Windowed SLO gauges (obs/slo.py) at window end: the p99
+        # TTFT over the engine's sliding window and the composed
+        # saturation signal the router/autoscaler consumes.
+        "cb_slo_ttft_p99": _parse_value(metrics1, "cb_slo_ttft_p99"),
+        "cb_saturation": _parse_value(metrics1, "cb_saturation"),
         "cb_token_p99": round(_pctl(token_paces, 99), 4)
         if token_paces else None,
         "cb_serving_request_p50_s": round(_pctl(walls, 50), 4)
@@ -901,6 +946,12 @@ def measure_cb_prefix_reuse(
     payloads = [payload_of(i) for i in range(n_requests)]
     try:
         stats0 = get_json(f"{base}/stats").get("cb_prefix", {})
+        # /metrics scrape bracketing the workload (shared
+        # `scrape_metrics` helper): the same hit/miss counters the
+        # /stats view reads, straight from the exposition — the
+        # cross-check key below must agree with the /stats-derived
+        # hit rate exactly (both are views of one registry).
+        metrics0 = scrape_metrics(base)
         # Cold fills: one request per template, sequential, so every
         # template's prefix blocks are resident and ready before the
         # measured fan-out.
@@ -932,19 +983,33 @@ def measure_cb_prefix_reuse(
             t.join(timeout=300.0)
         window_s = time.perf_counter() - t0
         stats1 = get_json(f"{base}/stats").get("cb_prefix", {})
+        metrics1 = scrape_metrics(base)
     finally:
         kill_server(proc)
 
     def delta(key: str) -> float:
         return (stats1.get(key, 0) or 0) - (stats0.get(key, 0) or 0)
 
+    def metric_delta(name: str) -> float:
+        return (_parse_value(metrics1, name) or 0.0) - (
+            _parse_value(metrics0, name) or 0.0
+        )
+
     hits = delta("block_hits")
     lookups = hits + delta("block_misses")
+    m_hits = metric_delta("cb_prefix_blocks_hit_total")
+    m_lookups = m_hits + metric_delta("cb_prefix_blocks_miss_total")
     saved = delta("prefill_tokens_saved")
     prompt_tokens = delta("prompt_tokens")
     return {
         "cb_prefix_hit_rate": (
             round(hits / lookups, 4) if lookups else None
+        ),
+        # The SAME hit rate from the /metrics counters (shared scrape
+        # helper): /stats and the exposition are views of one
+        # registry, so any disagreement is a bug, not noise.
+        "cb_prefix_hit_rate_from_metrics": (
+            round(m_hits / m_lookups, 4) if m_lookups else None
         ),
         "cb_prefill_tokens_saved_frac": (
             round(saved / prompt_tokens, 4) if prompt_tokens else None
@@ -1024,6 +1089,12 @@ def measure_cb_spec_serving(
             "cb_goodput_tokens_per_s"
         ),
         "cb_spec_ttft_p99": on.get("cb_ttft_p99"),
+        # Attribution under speculation (same shared /metrics scrape
+        # the serving harness brackets its window with): spec rounds
+        # are synchronous, so this device-step reading has no
+        # pipelining overlap hiding any of it.
+        "cb_spec_device_step_ms": on.get("cb_device_step_ms"),
+        "cb_spec_host_overhead_frac": on.get("cb_host_overhead_frac"),
         "cb_spec_serving_k": spec_k,
         "cb_spec_serving_draft": spec_draft,
         "cb_spec_request_errors": on.get("cb_request_errors"),

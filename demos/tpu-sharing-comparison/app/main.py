@@ -38,9 +38,14 @@ Endpoints:
   (serving-engine dispatch/TTFT/TPOT/pool telemetry; see
   docs/observability.md for every exported name).
 - GET  /debug/trace -> Chrome trace-event JSON of recent request
-  lifecycles (load into chrome://tracing or Perfetto).
+  lifecycles plus per-dispatch device-vs-host attribution phases
+  (load into chrome://tracing or Perfetto).
 - GET/POST /debug/profile -> jax.profiler capture-window status / arm
   ({"dispatches": N, "logdir": ...}).
+- GET  /debug/state -> one fenced engine snapshot: slots, KV block
+  pool, prefix trie, spec controller, attribution, SLO windows.
+- GET  /debug/slo -> the sliding-window SLO view alone (windowed
+  quantiles, objective compliance + burn rate, saturation).
 
 Env knobs: WALKAI_MAX_BATCH (default 32), WALKAI_BATCH_WINDOW_MS
 (default 2.0), WALKAI_WARM_BUCKETS (comma list, default "1,8,32"),
@@ -164,10 +169,15 @@ def engine_health(engine, alive: bool) -> dict | None:
     """The /healthz readiness payload's engine block: liveness of the
     driver loop plus the two "is it actually moving" signals a probe
     or an operator wants first — queue depth and staleness of the last
-    dispatch. None when continuous batching is not enabled."""
+    dispatch — and the two scale signals a kube autoscaler consumes
+    without scraping Prometheus text: `saturation` (the engine's
+    composed [0, 1] pressure signal) and `slo_ok` (windowed SLO
+    compliance; both None before the first dispatch or with telemetry
+    off). None when continuous batching is not enabled."""
     if engine is None:
         return None
     age = engine.seconds_since_last_dispatch
+    saturation = engine.saturation
     return {
         "alive": bool(alive),
         "queue_depth": engine.queue_depth,
@@ -176,6 +186,10 @@ def engine_health(engine, alive: bool) -> dict | None:
         ),
         "has_work": engine.has_work,
         "slots": engine.slots,
+        "saturation": (
+            None if saturation is None else round(saturation, 4)
+        ),
+        "slo_ok": engine.slo_ok,
     }
 
 
@@ -470,6 +484,27 @@ def main() -> None:
             # zero, so the engine's adaptive controller will disable
             # drafting) or "self" (draft = target: the full-acceptance
             # seam the spec bench uses to exercise the machinery).
+            # Sliding-window SLO layer (obs/slo.py): WALKAI_SLO_*
+            # knobs configure the window and the objectives the
+            # engine's windowed compliance/burn gauges (and the
+            # /healthz slo_ok field) are judged against. Unset
+            # objectives leave compliance vacuously ok.
+            cb_slo_kwargs = {}
+            if os.environ.get("WALKAI_SLO_WINDOW_S"):
+                cb_slo_kwargs["slo_window_s"] = float(
+                    os.environ["WALKAI_SLO_WINDOW_S"]
+                )
+            slo_objectives = {}
+            if os.environ.get("WALKAI_SLO_TTFT_P99_S"):
+                slo_objectives["ttft_p99_s"] = float(
+                    os.environ["WALKAI_SLO_TTFT_P99_S"]
+                )
+            if os.environ.get("WALKAI_SLO_TPOT_P99_S"):
+                slo_objectives["tpot_p99_s"] = float(
+                    os.environ["WALKAI_SLO_TPOT_P99_S"]
+                )
+            if slo_objectives:
+                cb_slo_kwargs["slo_objectives"] = slo_objectives
             cb_spec_kwargs = {}
             if os.environ.get("WALKAI_CB_SPEC") == "1":
                 from walkai_nos_tpu.models.lm import draft_config
@@ -524,6 +559,7 @@ def main() -> None:
                     "WALKAI_CB_PREFIX_CACHE", "1"
                 ) == "1",
                 **cb_spec_kwargs,
+                **cb_slo_kwargs,
                 obs=obs,
             )
             # Compile prefill + chunk step off the request path.
@@ -1123,6 +1159,24 @@ def main() -> None:
                 self._json(200, obs.trace.chrome_trace())
             elif self.path == "/debug/profile":
                 self._json(200, obs.profile.status())
+            elif self.path == "/debug/state":
+                # One fenced engine snapshot: slots, block pool,
+                # prefix trie, spec controller, attribution, SLO
+                # windows — the whole engine in a single read
+                # (engine null when continuous batching is off).
+                self._json(200, {
+                    "engine": (
+                        cb_engine.debug_state()
+                        if cb_engine is not None else None
+                    ),
+                })
+            elif self.path == "/debug/slo":
+                self._json(200, {
+                    "engine": (
+                        cb_engine.slo_stats()
+                        if cb_engine is not None else None
+                    ),
+                })
             elif self.path == "/stats":
                 payload = {**stats.snapshot(), **device_info}
                 if cb_engine is not None:
@@ -1130,6 +1184,8 @@ def main() -> None:
                     payload["cb_kv"] = cb_engine.kv_stats()
                     payload["cb_prefix"] = cb_engine.prefix_stats()
                     payload["cb_spec"] = cb_engine.spec_stats()
+                    payload["cb_slo"] = cb_engine.slo_stats()
+                    payload["cb_attrib"] = cb_engine.attrib_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

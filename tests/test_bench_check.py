@@ -175,6 +175,53 @@ class TestCheckLogic:
         assert len(failures) == 1
         assert "cb_spec_capacity_tokens_per_s" in failures[0]
 
+    def test_repo_baseline_gates_attribution_keys(self):
+        """BASELINE.json carries the device-time attribution keys as
+        absent_ok lower-is-better bands and they PARSE through the
+        comparator: absent from the bench output is a skip note; a
+        device step past the band or a host-overhead fraction past
+        the 0.5 budget fails once emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        step = published["cb_device_step_ms"]
+        assert step["direction"] == "lower"
+        assert step["absent_ok"] is True
+        assert step["value"] > 0
+        frac = published["cb_host_overhead_frac"]
+        assert frac["direction"] == "lower"
+        assert frac["tolerance"] == 0.0
+        assert frac["absent_ok"] is True
+        assert frac["value"] == 0.5
+        # The windowed SLO p99 rides the same absent_ok pattern,
+        # anchored like-for-like to the r5 record-derived cb_ttft_p99.
+        slo = published["cb_slo_ttft_p99"]
+        assert slo["direction"] == "lower"
+        assert slo["absent_ok"] is True
+        assert slo["value"] == published["cb_ttft_p99"]["value"]
+        keys = (
+            "cb_device_step_ms", "cb_host_overhead_frac",
+            "cb_slo_ttft_p99",
+        )
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert sum("absent" in n for n in notes) == 3
+        ceiling = step["value"] * (1 + step["tolerance"])
+        failures, _ = bench_check.check(
+            {"cb_device_step_ms": ceiling * 0.9,
+             "cb_host_overhead_frac": 0.31},
+            base,
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"cb_device_step_ms": ceiling * 1.1,
+             "cb_host_overhead_frac": 0.62},
+            base,
+        )
+        assert len(failures) == 2
+        assert any("cb_device_step_ms" in f for f in failures)
+        assert any("cb_host_overhead_frac" in f for f in failures)
+
     def test_bare_number_baseline_defaults_higher(self):
         failures, _ = bench_check.check(
             {"x": 70.0}, {"published": {"x": 100.0}}

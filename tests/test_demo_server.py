@@ -80,6 +80,12 @@ class TestDemoServer:
         assert h["ok"] is True
         assert h["engine"] is None
 
+    def test_debug_state_without_engine(self, server):
+        # The snapshot endpoint exists on every server; engine null
+        # when continuous batching is off (same shape as /healthz).
+        assert get_json(f"{server}/debug/state") == {"engine": None}
+        assert get_json(f"{server}/debug/slo") == {"engine": None}
+
 
 class TestGenerateEndpoint:
     @pytest.fixture(scope="class")
@@ -209,6 +215,10 @@ class TestContinuousBatchingEndpoint:
                 "WALKAI_MAX_BATCH": "8",
                 "WALKAI_WARM_BUCKETS": "1",
                 "WALKAI_CALIB_WINDOW_S": "0.2",
+                # SLO objective knob: a generous TTFT p99 target so
+                # the windowed compliance machinery runs (and stays
+                # green) on CPU CI.
+                "WALKAI_SLO_TTFT_P99_S": "60",
             },
             startup_timeout_s=300.0,
             poll_s=0.25,
@@ -398,7 +408,9 @@ class TestContinuousBatchingEndpoint:
 
     def test_healthz_readiness_payload(self, cb_server):
         """/healthz is a readiness payload, not a bare liveness bit:
-        engine alive + queue depth + dispatch staleness."""
+        engine alive + queue depth + dispatch staleness + the scale
+        signals (saturation, windowed SLO compliance) a kube probe or
+        autoscaler consumes without scraping Prometheus text."""
         self._post(cb_server, {"prompt": [1, 2, 3]})  # ensure dispatches
         h = get_json(f"{cb_server}/healthz")
         assert h["ok"] is True
@@ -408,6 +420,82 @@ class TestContinuousBatchingEndpoint:
         assert isinstance(eng["queue_depth"], int)
         assert eng["seconds_since_last_dispatch"] >= 0
         assert isinstance(eng["has_work"], bool)
+        # The engine has dispatched, so both scale signals are live:
+        # saturation is a [0, 1] float and the configured TTFT
+        # objective (60 s) is comfortably met on an idle CPU server.
+        assert 0.0 <= eng["saturation"] <= 1.0
+        assert eng["slo_ok"] is True
+
+    def test_debug_slo_endpoint_contract(self, cb_server):
+        """/debug/slo serves the sliding-window SLO view: windowed
+        quantiles per histogram, the configured objectives, compliance
+        + burn rate, and the composed saturation signal."""
+        self._post(cb_server, {"prompt": [1, 2, 3]})
+        slo = get_json(f"{cb_server}/debug/slo")["engine"]
+        assert set(slo) >= {
+            "window_s", "objectives", "windows", "slo_ok", "ok",
+            "burn_rate", "saturation",
+        }
+        assert slo["objectives"] == {"ttft_p99_s": 60.0}
+        assert set(slo["windows"]) == {"ttft", "tpot", "dispatch"}
+        ttft = slo["windows"]["ttft"]
+        assert set(ttft) == {"count", "p50", "p99", "span_s"}
+        # Traffic has flowed: the window holds TTFT samples and the
+        # windowed p99 is a real (positive) bucket bound.
+        assert ttft["count"] >= 1
+        assert ttft["p99"] > 0
+        assert slo["ok"] is True
+        sat = slo["saturation"]
+        assert set(sat) == {"value", "components"}
+        assert set(sat["components"]) == {
+            "busy", "queue", "queue_trend", "pool",
+        }
+
+    def test_debug_state_fenced_snapshot(self, cb_server):
+        """/debug/state is ONE snapshot of the whole engine — slots,
+        block pool, prefix trie, spec controller, attribution, SLO
+        windows — and its pool counts must sum exactly like
+        `kv_stats()` (free + parked + in_use == allocatable blocks),
+        agreeing with the /stats cb_kv view on a drained engine."""
+        self._post(cb_server, {"prompt": [1, 2, 3]})
+        state = get_json(f"{cb_server}/debug/state")["engine"]
+        assert set(state) >= {
+            "paged", "queue_depth", "has_work", "slots",
+            "prefilling", "pool", "prefix", "spec", "attrib", "slo",
+        }
+        assert state["paged"] is True
+        assert len(state["slots"]) == 2
+        for row in state["slots"]:
+            assert set(row) == {
+                "slot", "rid", "tokens_emitted", "budget_remaining",
+                "write_head", "blocks",
+            }
+        pool = state["pool"]
+        assert (
+            pool["free"] + pool["parked"] + pool["in_use"]
+            == pool["blocks_total"] - pool["scratch_blocks"]
+        )
+        # Cross-view agreement (engine drained, so no race): the
+        # snapshot's pool counts are the kv_stats() numbers.
+        kv = get_json(f"{cb_server}/stats")["cb_kv"]
+        assert pool["free"] == kv["kv_blocks_free"]
+        assert pool["parked"] == kv["kv_blocks_parked"]
+        assert pool["in_use"] == kv["kv_blocks_in_use"]
+        assert pool["reserved_virtual"] == kv["kv_blocks_reserved"]
+        # Attribution rode along: dispatches were classified and the
+        # device/host split measured.
+        at = state["attrib"]
+        assert at["device_step_ms"] > 0
+        assert 0.0 <= at["host_overhead_frac"] <= 1.0
+        kinds = at["kinds"]
+        assert sum(v["dispatches"] for v in kinds.values()) > 0
+
+    def test_stats_expose_slo_and_attrib_sections(self, cb_server):
+        """/stats carries the new views beside cb_occupancy/cb_kv —
+        the same dicts /debug/slo and /debug/state serve."""
+        stats = get_json(f"{cb_server}/stats")
+        assert "windows" in stats["cb_slo"]
+        assert "kinds" in stats["cb_attrib"]
 
     def test_metrics_prometheus_exposition(self, cb_server):
         """/metrics serves valid Prometheus text with the serving

@@ -11,12 +11,18 @@ import re
 import numpy as np
 import pytest
 
+from walkai_nos_tpu.obs.attrib import (
+    DISPATCH_KINDS,
+    DispatchAttribution,
+    classify_dispatch,
+)
 from walkai_nos_tpu.obs.metrics import (
     Registry,
     log_buckets,
 )
 from walkai_nos_tpu.obs.profile import ProfileHook
 from walkai_nos_tpu.obs.serving import ServingObs
+from walkai_nos_tpu.obs.slo import BucketRing, SloTracker
 from walkai_nos_tpu.obs.trace import RequestTrace, Ring
 
 
@@ -559,6 +565,31 @@ class TestEngineObsIntegration:
         assert kv["obs_disabled"] is True
         assert kv["kv_hbm_bytes_per_resident_token"] is None
         assert engine.obs.trace.timeline(rid) is None
+        # The new attribution/SLO views keep the SAME dict shapes with
+        # telemetry off, flagged obs_disabled (the /stats convention):
+        # zeros read as "not recorded", not "measured zero".
+        slo = engine.slo_stats()
+        assert slo["obs_disabled"] is True
+        assert set(slo["windows"]) == {"ttft", "tpot", "dispatch"}
+        assert slo["windows"]["ttft"] == {
+            "count": 0, "p50": None, "p99": None, "span_s": 0.0,
+        }
+        assert slo["saturation"]["value"] is None
+        at = engine.attrib_stats()
+        assert at["obs_disabled"] is True
+        assert at["device_step_ms"] is None
+        assert all(
+            k["dispatches"] == 0 for k in at["kinds"].values()
+        )
+        assert engine.saturation is None
+        assert engine.slo_ok is None
+        # And the fenced snapshot still assembles (pool counts sum).
+        state = engine.debug_state()
+        pool = state["pool"]
+        assert (
+            pool["free"] + pool["parked"] + pool["in_use"]
+            == pool["blocks_total"] - 1
+        )
 
 
 class TestHealthzPayload:
@@ -587,6 +618,8 @@ class TestHealthzPayload:
             seconds_since_last_dispatch = 0.1234
             has_work = True
             slots = 8
+            saturation = 0.62518
+            slo_ok = True
 
         payload = mod.engine_health(Stub(), True)
         assert payload == {
@@ -595,6 +628,11 @@ class TestHealthzPayload:
             "seconds_since_last_dispatch": 0.123,
             "has_work": True,
             "slots": 8,
+            # Scale signals for kube probes/autoscalers: the composed
+            # saturation and windowed SLO compliance ride /healthz so
+            # consumers need not scrape Prometheus text.
+            "saturation": 0.6252,
+            "slo_ok": True,
         }
 
     def test_no_engine_and_never_dispatched(self):
@@ -606,9 +644,15 @@ class TestHealthzPayload:
             seconds_since_last_dispatch = None
             has_work = False
             slots = 2
+            saturation = None
+            slo_ok = None
 
         payload = mod.engine_health(Fresh(), True)
         assert payload["seconds_since_last_dispatch"] is None
+        # Before the first dispatch (or with telemetry off) the scale
+        # signals are None — "not measured", never a fake healthy 0.
+        assert payload["saturation"] is None
+        assert payload["slo_ok"] is None
 
 
 class TestInstallExporterRegistry:
@@ -681,3 +725,422 @@ class TestServingObsBundle:
         import bench
 
         assert "obs_overhead_pct" in inspect.getsource(bench.main)
+
+    def test_attribution_and_slo_keys_are_headline(self):
+        """The attribution/SLO PR's gated and acceptance keys must
+        survive driver-side tail truncation too."""
+        import inspect
+
+        import bench
+
+        src = inspect.getsource(bench.main)
+        for key in (
+            "cb_device_step_ms", "cb_host_overhead_frac",
+            "cb_device_roofline_fraction", "cb_slo_ttft_p99",
+            "cb_saturation",
+        ):
+            assert key in src, key
+
+
+class TestBucketRing:
+    """Ring-of-buckets windowed views (obs/slo.py) over a cumulative
+    histogram: rotation, expiry of old buckets, partial-window reads,
+    the empty-window sentinel, and the windowed-vs-cumulative p99
+    divergence after a latency regime change — the property the whole
+    layer exists for."""
+
+    def _ring(self, window_s=10.0, buckets=5):
+        reg = Registry()
+        h = reg.histogram(
+            "w_seconds", "t", buckets=(1.0, 2.0, 4.0, 8.0)
+        )
+        return h, BucketRing(h, window_s=window_s, buckets=buckets)
+
+    def test_partial_window_reads_everything_since_start(self):
+        h, ring = self._ring()
+        ring.advance(0.0)
+        h.observe(0.5)
+        h.observe(1.5)
+        # No snapshot is a full window old yet: the read covers the
+        # partial span since start, baseline zero.
+        delta, total, span = ring.window_counts(3.0)
+        assert total == 2
+        assert span == 3.0
+        assert ring.quantile(1.0, 3.0) == 2.0
+
+    def test_empty_window_is_none_not_zero(self):
+        h, ring = self._ring()
+        ring.advance(0.0)
+        assert ring.quantile(0.99, 0.0) is None
+        assert ring.frac_over(1.0, 0.0) is None
+        h.observe(0.5)
+        # ...and once the sample ages out of the window, None again.
+        for t in range(2, 26, 2):
+            ring.advance(float(t))
+        assert ring.quantile(0.99, 24.0) is None
+
+    def test_rotation_and_expiry(self):
+        h, ring = self._ring(window_s=10.0, buckets=5)  # bucket_s = 2
+        ring.advance(0.0)
+        h.observe(0.5)
+        h.observe(0.5)
+        ring.advance(2.0)   # snapshot captures the 2 old samples
+        h.observe(8.0)      # regime change
+        for t in (4.0, 6.0, 8.0, 10.0, 12.0):
+            ring.advance(t)
+        # At t=12 the t=2 snapshot is exactly window-old: it is the
+        # baseline, so the window holds ONLY the post-change sample.
+        delta, total, span = ring.window_counts(12.0)
+        assert total == 1
+        assert span == 10.0
+        assert ring.quantile(0.99, 12.0) == 8.0
+        # Ring stays bounded: snapshots older than the baseline are
+        # expired, so a long run holds ~window_s/bucket_s entries.
+        assert len(ring._snaps) <= 5 + 2
+
+    def test_window_p99_diverges_from_cumulative_after_regime_change(
+        self,
+    ):
+        h, ring = self._ring(window_s=10.0, buckets=5)
+        ring.advance(0.0)
+        for _ in range(1000):
+            h.observe(0.5)  # a thousand fast samples, old regime
+        for t in (2.0, 4.0, 6.0, 8.0, 10.0, 12.0):
+            ring.advance(t)
+        for _ in range(5):
+            h.observe(7.0)  # slow regime begins after the window
+        ring.advance(14.0)
+        # Cumulative p99: rank 995 of 1005 still lands in the fast
+        # bucket — the lifetime histogram cannot see the regression.
+        assert h.quantile(0.99) == 1.0
+        # Windowed p99: only the 5 slow samples are in the window.
+        assert ring.quantile(0.99, 14.0) == 8.0
+
+    def test_idle_window_ages_out_without_advance(self):
+        """Reads are wall-clock probes, rotation happens only on
+        dispatch: an engine idle past the window must read EMPTY at
+        probe time — the baseline is the NEWEST snapshot at or
+        before the cutoff, so a stale burst is never replayed as the
+        'current' window (a probe frozen on a 5-minute-old breach
+        would keep a replica unready forever)."""
+        h, ring = self._ring(window_s=10.0, buckets=5)
+        ring.advance(0.0)
+        h.observe(9.0)
+        h.observe(9.0)
+        ring.advance(2.0)  # snapshot captures the burst
+        # Shortly after: the burst is (correctly) in the window.
+        assert ring.quantile(0.99, 3.0) is not None
+        # Minutes later, with NO dispatches to rotate the ring:
+        delta, total, span = ring.window_counts(300.0)
+        assert total == 0
+        assert ring.quantile(0.99, 300.0) is None
+        assert ring.frac_over(1.0, 300.0) is None
+
+    def test_overflow_clamps_and_frac_over(self):
+        h, ring = self._ring()
+        ring.advance(0.0)
+        h.observe(100.0)  # +Inf overflow
+        h.observe(0.5)
+        assert ring.quantile(1.0, 1.0) == 8.0  # clamp to last bound
+        assert ring.frac_over(1.0, 1.0) == pytest.approx(0.5)
+        assert ring.frac_over(200.0, 1.0) == pytest.approx(0.5)
+
+    def test_invalid_args(self):
+        h, ring = self._ring()
+        with pytest.raises(ValueError):
+            BucketRing(h, window_s=0, buckets=5)
+        with pytest.raises(ValueError):
+            BucketRing(h, window_s=1.0, buckets=0)
+        with pytest.raises(ValueError):
+            ring.quantile(1.5, 0.0)
+
+
+class TestSloTracker:
+    def _tracker(self, **kw):
+        obs = ServingObs()
+        kw.setdefault("slots", 4)
+        kw.setdefault("window_s", 10.0)
+        kw.setdefault("refresh_s", 1.0)
+        return obs, SloTracker(obs, **kw)
+
+    def test_unknown_objective_rejected(self):
+        obs = ServingObs()
+        with pytest.raises(ValueError, match="unknown SLO objective"):
+            SloTracker(obs, slots=2, objectives={"nope_p99": 1.0})
+
+    def test_windowed_gauges_and_compliance(self):
+        obs, slo = self._tracker(
+            objectives={"ttft_p99_s": 1.0}
+        )
+        for v in (0.1, 0.2, 0.3):
+            obs.ttft.observe(v)
+        slo.on_sync(
+            0.0, queue_depth=0, busy_slots=2, headroom_frac=0.75
+        )
+        assert obs.slo_ttft_p99.value() is not None
+        assert slo.ok is True
+        assert slo.stats(0.0)["slo_ok"]["ttft_p99_s"] is True
+        assert obs.slo_ok_gauge.value(
+            {"objective": "ttft_p99_s"}
+        ) == 1.0
+        # Regime change: every new sample breaches the objective.
+        for _ in range(20):
+            obs.ttft.observe(5.0)
+        slo.on_sync(
+            2.0, queue_depth=0, busy_slots=2, headroom_frac=0.75
+        )
+        assert slo.ok is False
+        burn = obs.slo_burn.value({"objective": "ttft_p99_s"})
+        assert burn is not None and burn > 1.0
+        # Windowed quantile view reflects the breach live.
+        assert slo.stats(2.0)["windows"]["ttft"]["p99"] >= 5.0
+
+    def test_refresh_throttled_but_rings_advance(self):
+        obs, slo = self._tracker(refresh_s=5.0)
+        slo.on_sync(0.0, queue_depth=0, busy_slots=0,
+                    headroom_frac=1.0)
+        sat0 = slo.saturation
+        slo.on_sync(1.0, queue_depth=8, busy_slots=4,
+                    headroom_frac=0.0)
+        # Inside the refresh interval: gauges unchanged...
+        assert slo.saturation == sat0
+        slo.on_sync(6.0, queue_depth=8, busy_slots=4,
+                    headroom_frac=0.0)
+        # ...past it: the saturation refresh sees the pressure.
+        assert slo.saturation == 1.0
+
+    def test_saturation_components(self):
+        obs, slo = self._tracker()  # slots=4
+        slo.on_sync(0.0, queue_depth=0, busy_slots=1,
+                    headroom_frac=0.9)
+        comp = slo.stats(0.0)["saturation"]["components"]
+        assert comp["busy"] == 0.25
+        assert comp["queue"] == 0.0
+        assert comp["pool"] == pytest.approx(0.1)
+        assert slo.saturation == 0.25  # max of components
+        # Queue growth over the window drives the trend component.
+        slo.on_sync(2.0, queue_depth=6, busy_slots=4,
+                    headroom_frac=0.5)
+        comp = slo.stats(2.0)["saturation"]["components"]
+        assert comp["busy"] == 1.0
+        assert comp["queue"] == 0.75   # 6 / (2*4)
+        assert comp["queue_trend"] == 1.0  # +6 over 4 slots, clamped
+        assert slo.saturation == 1.0
+
+    def test_dense_engine_has_no_pool_component(self):
+        obs, slo = self._tracker()
+        slo.on_sync(0.0, queue_depth=0, busy_slots=0,
+                    headroom_frac=None)
+        assert slo.stats(0.0)["saturation"]["components"][
+            "pool"
+        ] is None
+        assert slo.saturation == 0.0
+
+    def test_compliance_is_live_not_last_refresh(self):
+        """A request burst can land entirely inside one refresh
+        interval: the stats()/ok_at() compliance must be computed
+        over the CURRENT window, not echo the (possibly empty)
+        last-refresh snapshot — the /healthz probe sees breaches the
+        throttled gauges haven't caught up to yet."""
+        obs, slo = self._tracker(
+            objectives={"ttft_p99_s": 1.0}, refresh_s=1.0
+        )
+        # First sync refreshes on an empty window: unknown.
+        slo.on_sync(0.0, queue_depth=0, busy_slots=0,
+                    headroom_frac=1.0)
+        # Breaching burst, all within the refresh interval.
+        for t in (0.1, 0.2, 0.3):
+            obs.ttft.observe(9.0)
+            slo.on_sync(t, queue_depth=0, busy_slots=1,
+                        headroom_frac=1.0)
+        st = slo.stats(0.3)
+        assert st["slo_ok"]["ttft_p99_s"] is False
+        assert st["burn_rate"]["ttft_p99_s"] > 1.0
+        assert st["ok"] is False
+        assert slo.ok_at(0.3) is False
+        # ...and once the breach burst ages out of the window (no
+        # dispatches needed), the probe clears: no fresh evidence of
+        # breach, compliance unknown-therefore-ok again.
+        assert slo.ok_at(300.0) is True
+        assert slo.stats(300.0)["windows"]["ttft"]["count"] == 0
+        # Before any sync at all, compliance is None (not measured).
+        obs2, slo2 = self._tracker(objectives={"ttft_p99_s": 1.0})
+        assert slo2.ok_at(0.0) is None
+
+    def test_empty_window_compliance_is_unknown(self):
+        obs, slo = self._tracker(objectives={"ttft_p99_s": 1.0})
+        slo.on_sync(0.0, queue_depth=0, busy_slots=0,
+                    headroom_frac=1.0)
+        st = slo.stats(0.0)
+        # No TTFT samples: compliance unknown (None), never a breach
+        # — and overall ok stays True (no evidence against it).
+        assert st["slo_ok"]["ttft_p99_s"] is None
+        assert st["burn_rate"]["ttft_p99_s"] is None
+        assert slo.ok is True
+
+
+class TestClassifyDispatch:
+    def test_all_compositions(self):
+        assert classify_dispatch(3, 0, False) == "decode"
+        assert classify_dispatch(0, 2, False) == "prefill"
+        # The mixed case: prefill lane + live decode in ONE step
+        # program dispatch.
+        assert classify_dispatch(3, 2, False) == "mixed"
+        assert classify_dispatch(3, 0, True) == "spec"
+        # ...and prefill + decode + spec fused in one dispatch.
+        assert classify_dispatch(3, 2, True) == "spec_prefill"
+        assert classify_dispatch(0, 2, True) == "spec_prefill"
+
+    def test_kinds_tuple_is_exhaustive(self):
+        got = {
+            classify_dispatch(b, l, s)
+            for b in (0, 2) for l in (0, 1) for s in (False, True)
+        }
+        assert got <= set(DISPATCH_KINDS)
+
+
+class TestDispatchAttribution:
+    def test_window_gauges_and_cost_model(self):
+        obs = ServingObs()
+        attr = DispatchAttribution(
+            obs, param_bytes=1000, kv_bytes_per_token=10,
+            hbm_bytes_per_s=1e6, window=2,
+        )
+        attr.record(
+            kind="decode", steps=2, host_s=0.001, device_s=0.004,
+            resident_tokens=100,
+        )
+        # bytes/step = 1000 + 100*10 = 2000; ideal = 2*2000/1e6 =
+        # 0.004 s == measured device -> roofline exactly 1.0.
+        assert obs.device_step_ms.value() == 2.0
+        assert obs.host_overhead.value() == 0.2
+        assert obs.device_roofline.value() == 1.0
+        assert obs.hbm_step_bytes.value() == 2000.0
+        assert obs.dispatch_kind.value({"kind": "decode"}) == 1
+        assert obs.device_sync.count() == 1
+        # Trailing window (2): a third record evicts the first, so
+        # the gauges average ONLY the newest two.
+        attr.record(kind="decode", steps=1, host_s=0.0,
+                    device_s=0.010, resident_tokens=100)
+        attr.record(kind="decode", steps=1, host_s=0.0,
+                    device_s=0.010, resident_tokens=100)
+        assert obs.device_step_ms.value() == 10.0
+        st = attr.stats()
+        assert st["window_dispatches"] == 2
+        assert st["kinds"]["decode"]["dispatches"] == 3
+
+    def test_roofline_clamped_and_absent_without_bandwidth(self):
+        obs = ServingObs()
+        attr = DispatchAttribution(
+            obs, param_bytes=1000, kv_bytes_per_token=0,
+            hbm_bytes_per_s=1e9,
+        )
+        # Measured device faster than the analytic floor (timer noise
+        # / overlap): the fraction clamps at 1.0, never reports >1.
+        attr.record(kind="decode", steps=1, host_s=0.0,
+                    device_s=1e-9, resident_tokens=0)
+        assert obs.device_roofline.value() == 1.0
+        obs2 = ServingObs()
+        no_bw = DispatchAttribution(obs2, param_bytes=1000,
+                                    kv_bytes_per_token=10)
+        no_bw.record(kind="decode", steps=1, host_s=0.001,
+                     device_s=0.001, resident_tokens=10)
+        # No published bandwidth: the roofline gauges are simply
+        # never set (absent from /metrics, None in the view).
+        assert obs2.device_roofline.value() is None
+        assert no_bw.stats()["roofline_fraction"] is None
+        assert no_bw.stats()["hbm_bytes_per_step"] is None
+
+    def test_disabled_noops(self):
+        obs = ServingObs(enabled=False)
+        attr = DispatchAttribution(obs, param_bytes=1, window=4)
+        attr.record(kind="decode", steps=1, host_s=1.0, device_s=1.0,
+                    resident_tokens=1)
+        st = attr.stats()
+        assert st["obs_disabled"] is True
+        assert st["window_dispatches"] == 0
+        assert obs.dispatch_kind.value({"kind": "decode"}) == 0
+
+
+class TestEngineAttribution:
+    """The engine's attribution classification at its real dispatch
+    seams — including the mixed (prefill+decode) and fused spec
+    (draft+verify+prefill) compositions."""
+
+    def _build(self, **kw):
+        import jax
+
+        from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+        from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+            max_seq_len=64,
+        )
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        return cfg, params, ContinuousBatcher(
+            cfg, params, slots=2, cache_len=32, prompt_bucket=8,
+            chunk_steps=2, **kw,
+        )
+
+    def test_kind_invariant_and_views(self, tiny_engine_run):
+        engine, _, _ = tiny_engine_run
+        obs = engine.obs
+        kinds_total = sum(
+            obs.dispatch_kind.value({"kind": k})
+            for k in DISPATCH_KINDS
+        )
+        # Every dispatch is classified exactly once, at its sync.
+        assert kinds_total == obs.dispatches.value()
+        assert obs.device_sync.count() == int(obs.dispatches.value())
+        at = engine.attrib_stats()
+        assert at["device_step_ms"] == obs.device_step_ms.value()
+        assert at["device_step_ms"] is not None
+        assert 0.0 <= at["host_overhead_frac"] <= 1.0
+        slo = engine.slo_stats()
+        # The windowed TTFT view saw the finished requests (<= 3: on
+        # a compile-slowed host the earliest sample may age out of
+        # the 30 s window; it must never read MORE than happened).
+        assert 1 <= slo["windows"]["ttft"]["count"] <= 3
+        assert engine.saturation is not None
+
+    def test_mixed_dispatch_classification(self):
+        _, _, engine = self._build()
+        engine.submit([1, 2, 3], max_new_tokens=8)
+        engine.step()  # dispatch 1: lane only -> "prefill"
+        engine.submit([4, 5, 6], max_new_tokens=4)
+        engine.step()  # dispatch 2: live slot + lane -> "mixed"
+        engine.run()
+        obs = engine.obs
+        assert obs.dispatch_kind.value({"kind": "prefill"}) >= 1
+        assert obs.dispatch_kind.value({"kind": "mixed"}) >= 1
+        assert obs.dispatch_kind.value({"kind": "decode"}) >= 1
+        assert obs.dispatch_kind.value({"kind": "spec"}) == 0
+
+    def test_spec_dispatch_classification(self):
+        import jax
+
+        from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+        from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+            max_seq_len=64,
+        )
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        engine = ContinuousBatcher(
+            cfg, params, slots=2, cache_len=32, prompt_bucket=8,
+            chunk_steps=2, spec=True, spec_k=2, draft_cfg=cfg,
+            draft_params=params, spec_min_accept=0.0,
+        )
+        engine.submit([1, 2, 3], max_new_tokens=6)
+        engine.step()  # round 1: lane riding the spec round
+        engine.run()
+        obs = engine.obs
+        # Prefill + decode + speculative draft/verify fused in one
+        # dispatch classifies as spec_prefill; pure rounds as spec.
+        assert obs.dispatch_kind.value({"kind": "spec_prefill"}) >= 1
+        assert obs.dispatch_kind.value({"kind": "spec"}) >= 1
+        assert obs.dispatch_kind.value({"kind": "decode"}) == 0
+        at = engine.attrib_stats()
+        assert at["kinds"]["spec"]["device_s"] > 0

@@ -153,7 +153,9 @@ from walkai_nos_tpu.models.speculative import (
     cache_positions,
     rewind_cache,
 )
+from walkai_nos_tpu.obs.attrib import DispatchAttribution, classify_dispatch
 from walkai_nos_tpu.obs.serving import ServingObs
+from walkai_nos_tpu.obs.slo import SloTracker
 from walkai_nos_tpu.ops.decode_attention import MAX_KERNEL_STEPS, PAGE_ROWS
 
 
@@ -260,6 +262,16 @@ class ContinuousBatcher:
     recording happens host-side at dispatch/sync points only, and the
     span clock reuses the engine's own timestamp reads so
     trace-derived ttft/wall equal `drain_done_records()` exactly.
+    Two layers ride on top of the registry (`obs/attrib.py`,
+    `obs/slo.py`): every dispatch's blocked device sync is timed
+    separately from its host assembly and classified by composition
+    (live `cb_device_step_ms` / `cb_host_overhead_frac` /
+    `cb_device_roofline_fraction`), and sliding-window SLO views
+    (`slo_window_s` seconds; `slo_objectives` maps "ttft_p99_s" /
+    "tpot_p99_s" to threshold seconds) feed windowed quantile, burn-
+    rate, and `cb_saturation` gauges — read them via `slo_stats()` /
+    `attrib_stats()` / `debug_state()` and the `saturation` /
+    `slo_ok` properties.
     """
 
     def __init__(
@@ -284,6 +296,8 @@ class ContinuousBatcher:
         spec_warmup_rounds: int = 16,
         spec_ema_alpha: float = 0.25,
         obs: ServingObs | bool = True,
+        slo_window_s: float = 30.0,
+        slo_objectives: dict | None = None,
     ) -> None:
         cache_len = cache_len or cfg.max_seq_len
         if prompt_bucket > cache_len:
@@ -388,8 +402,38 @@ class ContinuousBatcher:
             self.obs = obs
         else:
             self.obs = ServingObs(enabled=bool(obs))
+        # Device-time attribution (obs/attrib.py): every dispatch's
+        # blocked device sync vs host assembly, classified by
+        # composition and paired with the analytic HBM cost model the
+        # bench uses — the live cb_device_step_ms /
+        # cb_host_overhead_frac / cb_device_roofline_fraction gauges.
+        from walkai_nos_tpu.utils.flops import hbm_bytes_per_s
+        try:
+            bw = hbm_bytes_per_s(jax.devices()[0].device_kind)
+        except Exception:  # noqa: BLE001 — telemetry must not gate serving
+            bw = None
+        param_bytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+        self._attrib = DispatchAttribution(
+            self.obs,
+            param_bytes=param_bytes,
+            kv_bytes_per_token=self._kv_bytes_per_token(),
+            hbm_bytes_per_s=bw,
+        )
+        # Sliding-window SLO / saturation layer (obs/slo.py): windowed
+        # TTFT/TPOT/dispatch quantiles, per-objective compliance +
+        # burn rate, and the composed cb_saturation scale signal.
+        self._slo = SloTracker(
+            self.obs,
+            slots=slots,
+            window_s=slo_window_s,
+            objectives=slo_objectives,
+        )
         # In-flight chunk: (device tokens handle, slot->req snapshot,
-        # per-slot "first token expected" flags, dispatch timestamp).
+        # per-slot "first token expected" flags, dispatch timestamp,
+        # attribution context).
         self._inflight: tuple | None = None
         self._last_dispatch_mono: float | None = None
 
@@ -1165,6 +1209,104 @@ class ContinuousBatcher:
             ),
         }
 
+    def slo_stats(self) -> dict:
+        """Sliding-window SLO view (`obs/slo.py`): windowed
+        TTFT/TPOT/dispatch quantiles, per-objective compliance and
+        burn rate, and the composed saturation signal — the
+        `/debug/slo` payload and the `/stats` `cb_slo` section. With
+        telemetry off the same dict shape returns flagged
+        `obs_disabled: true` (the PR 3 convention), so zeros read as
+        "not recorded"."""
+        return self._slo.stats(time.monotonic())
+
+    def attrib_stats(self) -> dict:
+        """Device-time attribution view (`obs/attrib.py`): per-kind
+        dispatch/device/host totals and the trailing-window
+        device-step / host-overhead / roofline gauges — the
+        `/debug/state` `attrib` block and the `/stats` `cb_attrib`
+        section. Same shape + `obs_disabled` with telemetry off."""
+        return self._attrib.stats()
+
+    @property
+    def saturation(self) -> float | None:
+        """Composed scale signal in [0, 1] from the SLO layer's last
+        refresh (max of busy/queue/queue-trend/pool pressure); None
+        before the first dispatch or with telemetry off. The
+        `/healthz` engine block's autoscaling signal."""
+        return self._slo.saturation
+
+    @property
+    def slo_ok(self) -> bool | None:
+        """Overall SLO compliance computed live over the current
+        window: False iff a configured objective measurably breached
+        its error budget; None before the first dispatch or with
+        telemetry off."""
+        return self._slo.ok_at(time.monotonic())
+
+    def debug_state(self) -> dict:
+        """One fenced JSON snapshot of the whole engine: slots, block
+        pool, prefix trie, spec controller, attribution, and SLO
+        windows in a single read — `/debug/state`. Consistency comes
+        from derivation, not locking: the pool's `in_use` is computed
+        from the same free/parked reads it is reported beside (the
+        same rule `kv_stats()` uses), so the counts always sum to the
+        allocatable pool even while the driver thread runs."""
+        if self.paged:
+            free = len(self._free_blocks)
+            parked = self._parked_count()
+            pool = {
+                "blocks_total": self.pool_blocks,
+                "scratch_blocks": 1,
+                "free": free,
+                "parked": parked,
+                "in_use": self.pool_blocks - 1 - free - parked,
+                "reserved_virtual": int(self._reserved),
+                "min_free_watermark": self.obs.pool_min_free.value(),
+            }
+        else:
+            pool = {"blocks_total": 0, "scratch_blocks": 0,
+                    "free": 0, "parked": 0, "in_use": 0,
+                    "reserved_virtual": 0, "min_free_watermark": None}
+        slot_rows = []
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            slot_rows.append({
+                "slot": s,
+                "rid": req.rid if req is not None else None,
+                "tokens_emitted": (
+                    len(req.tokens) if req is not None else 0
+                ),
+                "budget_remaining": int(self._budget[s]),
+                "write_head": (
+                    int(self._slot_pos[s]) if self.paged else None
+                ),
+                "blocks": (
+                    len(self._slot_blocks[s]) if self.paged else None
+                ),
+            })
+        prefilling = [
+            {
+                "rid": p.req.rid,
+                "slot": p.slot,
+                "consumed": p.consumed,
+                "prompt_len": len(p.req.prompt),
+                "cached": p.cached,
+            }
+            for p in list(self._prefilling)
+        ]
+        return {
+            "paged": self.paged,
+            "queue_depth": len(self._pending),
+            "has_work": self.has_work,
+            "slots": slot_rows,
+            "prefilling": prefilling,
+            "pool": pool,
+            "prefix": self.prefix_stats(),
+            "spec": self.spec_stats(),
+            "attrib": self.attrib_stats(),
+            "slo": self.slo_stats(),
+        }
+
     def run(self) -> dict[int, list[int]]:
         """Drive until every submitted request finishes."""
         out: dict[int, list[int]] = {}
@@ -1216,12 +1358,14 @@ class ContinuousBatcher:
         bucket = 1 << (prompt_len - 1).bit_length()
         return min(max(bucket, self.prompt_bucket), self.cache_len)
 
-    def _record_kv_snapshot(self) -> None:
+    def _record_kv_snapshot(self) -> int:
+        """Per-dispatch KV telemetry; returns the resident-token count
+        (the attribution cost model's cache-read term)."""
         live = [r for r in self._slot_req if r is not None]
         resident = sum(len(r.prompt) + len(r.tokens) for r in live)
         resident += sum(p.consumed for p in self._prefilling)
         if resident <= 0:
-            return
+            return 0
         per_tok = self._kv_bytes_per_token()
         if self.paged:
             # Distinct blocks allocated (shared prefix blocks count
@@ -1234,6 +1378,7 @@ class ContinuousBatcher:
         self.obs.kv_ratio.set(round(bytes_backing / resident, 1))
         self.obs.kv_bytes.inc(float(bytes_backing))
         self.obs.kv_resident.inc(resident)
+        return resident
 
     def _mark_dispatch(self, busy: int, t0: float, steps: int) -> None:
         """Per-dispatch registry writes, shared by both cache layouts
@@ -1253,7 +1398,8 @@ class ContinuousBatcher:
     def _dispatch(self):
         if self.paged:
             return self._dispatch_paged()
-        self._record_kv_snapshot()
+        t_host0 = time.monotonic()
+        resident = self._record_kv_snapshot()
         self.obs.profile.on_dispatch()
         t0 = time.monotonic()
         self._state, emitted = self._step_fn(self.params, self._state)
@@ -1262,22 +1408,28 @@ class ContinuousBatcher:
         self._slot_new = [False] * self.slots
         busy = sum(1 for r in snapshot if r is not None)
         self._mark_dispatch(busy, t0, self.chunk_steps)
-        return emitted, snapshot, fresh, t0
+        ctx = self._attrib_ctx(
+            busy, 0, False, self.chunk_steps, t_host0, resident
+        )
+        return emitted, snapshot, fresh, t0, ctx
 
     def _paged_prologue(self, steps: int, advance: bool):
         """Shared paged-dispatch prologue: lazily back the cache rows
         this dispatch will write BEFORE the table snapshot captures
         them, record KV telemetry, arm the profiler, and assemble the
-        prefill lane. Returns (t0, dec_table, pf, lane, finished)."""
+        prefill lane. Returns (t0, dec_table, pf, lane, finished,
+        resident, lane_rows) — the trailing pair feeds the
+        attribution layer (cost-model tokens + composition class)."""
         self._ensure_decode_blocks(steps, advance=advance)
-        self._record_kv_snapshot()
+        resident = self._record_kv_snapshot()
         self.obs.profile.on_dispatch()
         t0 = time.monotonic()
         dec_table = jnp.asarray(self._table)
         if self._prefilling:
+            lane_rows = len(self._prefilling)
             pf, finished = self._prepare_lane(t0)
-            return t0, dec_table, pf, True, finished
-        return t0, dec_table, (), False, []
+            return t0, dec_table, pf, True, finished, resident, lane_rows
+        return t0, dec_table, (), False, [], resident, 0
 
     def _paged_epilogue(self, finished, t0: float, steps: int):
         """Shared paged-dispatch epilogue: snapshot slot state BEFORE
@@ -1292,8 +1444,26 @@ class ContinuousBatcher:
         self._mark_dispatch(busy, t0, steps)
         return snapshot, fresh
 
+    def _attrib_ctx(
+        self, busy: int, lane_rows: int, spec: bool, steps: int,
+        t_host0: float, resident: int,
+    ) -> dict:
+        """Attribution context riding the in-flight tuple to the
+        sync: composition class, step window, measured host assembly
+        time so far, and the cost model's resident-token count. The
+        sync side (`_finish_sync`) adds the blocked device time."""
+        return {
+            "kind": classify_dispatch(busy, lane_rows, spec),
+            "steps": steps,
+            "busy": busy,
+            "host_s": time.monotonic() - t_host0,
+            "resident": resident,
+        }
+
     def _dispatch_paged(self):
-        t0, dec_table, pf, lane, finished = self._paged_prologue(
+        t_host0 = time.monotonic()
+        (t0, dec_table, pf, lane, finished, resident,
+         lane_rows) = self._paged_prologue(
             self.chunk_steps, advance=True
         )
         self._state, emitted = self._step_fn(
@@ -1302,7 +1472,11 @@ class ContinuousBatcher:
         snapshot, fresh = self._paged_epilogue(
             finished, t0, self.chunk_steps
         )
-        return emitted, snapshot, fresh, t0
+        busy = sum(1 for r in snapshot if r is not None)
+        ctx = self._attrib_ctx(
+            busy, lane_rows, False, self.chunk_steps, t_host0, resident
+        )
+        return emitted, snapshot, fresh, t0, ctx
 
     def _dispatch_spec(self):
         """Dispatch one speculative round: back the k+1 verify window
@@ -1310,7 +1484,9 @@ class ContinuousBatcher:
         — rounds are synchronous, so the mirror advanced with the
         last round's accepted counts), then the fused
         draft-scan + verify + lane program."""
-        t0, dec_table, pf, lane, finished = self._paged_prologue(
+        t_host0 = time.monotonic()
+        (t0, dec_table, pf, lane, finished, resident,
+         lane_rows) = self._paged_prologue(
             self._k_now + 1, advance=False
         )
         out = self._spec_fn(
@@ -1321,7 +1497,11 @@ class ContinuousBatcher:
         snapshot, fresh = self._paged_epilogue(
             finished, t0, self._k_now + 1
         )
-        return emitted, n_emit, snapshot, fresh, t0
+        busy = sum(1 for r in snapshot if r is not None)
+        ctx = self._attrib_ctx(
+            busy, lane_rows, True, self._k_now + 1, t_host0, resident
+        )
+        return emitted, n_emit, snapshot, fresh, t0, ctx
 
     def _prepare_lane(self, t0: float):
         """Host-side prefill-lane assembly for one dispatch: the
@@ -1576,7 +1756,38 @@ class ContinuousBatcher:
                 break
         return n
 
-    def _process(self, emitted, snapshot, fresh, t_dispatch) -> None:
+    def _finish_sync(self, now: float, ctx: dict, device_s: float) -> None:
+        """Post-sync attribution + SLO bookkeeping shared by the plain
+        chunk and the speculative round: feed the dispatch's host/
+        device split (and its composition class) to the attribution
+        layer and the trace, then tick the sliding-window SLO layer
+        with the live pressure signals."""
+        self._attrib.record(
+            kind=ctx["kind"], steps=ctx["steps"],
+            host_s=ctx["host_s"], device_s=device_s,
+            resident_tokens=ctx["resident"],
+        )
+        self.obs.trace.dispatch(
+            now, ctx["kind"], ctx["steps"], ctx["host_s"], device_s
+        )
+        headroom = None
+        if self.paged:
+            headroom = (
+                len(self._free_blocks) + self._parked_count()
+            ) / max(1, self.pool_blocks - 1)
+        self._slo.on_sync(
+            now,
+            queue_depth=len(self._pending),
+            busy_slots=ctx["busy"],
+            headroom_frac=headroom,
+        )
+
+    def _process(self, emitted, snapshot, fresh, t_dispatch, ctx) -> None:
+        # The blocked device sync: the host fetch of the chunk's
+        # tokens. Under one-chunk pipelining this is the residual
+        # device time the host could not overlap — the attribution
+        # layer's device term.
+        t_sync0 = time.monotonic()
         tokens = np.asarray(emitted)  # [slots, 1 + chunk] — the sync
         # ONE clock read serves every record in this chunk: the sync
         # just completed is the moment all of them became host-visible,
@@ -1591,18 +1802,23 @@ class ContinuousBatcher:
             n_emitted += self._commit_tokens(s, req, emit, now)
         if n_emitted:
             self.obs.tokens.inc(n_emitted)
+        self._finish_sync(now, ctx, now - t_sync0)
 
     def _process_spec(
-        self, emitted, n_emit, snapshot, fresh, t_dispatch
+        self, emitted, n_emit, snapshot, fresh, t_dispatch, ctx
     ) -> None:
         """Sync one speculative round and commit its acceptances:
         per live slot, move the write-head mirror by the accepted
         count, commit `[input?] + chosen[:n_emit]` through the shared
         commit rule, return verify-window blocks the rejections left
         unused, and feed the acceptance controller."""
+        # Spec rounds are synchronous, so the blocked fetch here IS
+        # the whole device round (no pipelining hides any of it).
+        t_sync0 = time.monotonic()
         tokens = np.asarray(emitted)   # [slots, k + 2] — the sync
         counts = np.asarray(n_emit)    # [slots] committed per slot
         now = time.monotonic()
+        device_s = now - t_sync0
         obs = self.obs
         obs.dispatch_latency.observe(now - t_dispatch)
         k = self._k_now
@@ -1636,6 +1852,7 @@ class ContinuousBatcher:
             obs.trace.spec_round(now, k, live, accepted)
             self._spec_controller(accepted / live)
         self._set_pool_gauges()
+        self._finish_sync(now, ctx, device_s)
 
     def _spec_controller(self, round_accepted: float) -> None:
         """Acceptance-adaptive drafting: EMA the mean accepted drafts

@@ -7,6 +7,12 @@
   Chrome trace-event export.
 - `obs.profile` — jax.profiler capture window gated on the dispatch
   loop.
+- `obs.attrib` — per-dispatch device-time attribution: host assembly
+  vs blocked device sync, classified by dispatch composition, paired
+  with the analytic HBM cost model for a live roofline fraction.
+- `obs.slo` — sliding-window (ring-of-buckets) SLO views over the
+  cumulative histograms, burn-rate gauges, and the composed
+  `cb_saturation` scale signal.
 - `obs.catalog` — declarative list of every exported metric
   (`hack/metrics_lint.py` holds it and docs/observability.md to each
   other).
@@ -17,6 +23,10 @@ See docs/observability.md for the exported-metric reference and the
 trace/profile how-to.
 """
 
+from walkai_nos_tpu.obs.attrib import (  # noqa: F401
+    DispatchAttribution,
+    classify_dispatch,
+)
 from walkai_nos_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -26,4 +36,5 @@ from walkai_nos_tpu.obs.metrics import (  # noqa: F401
 )
 from walkai_nos_tpu.obs.profile import ProfileHook  # noqa: F401
 from walkai_nos_tpu.obs.serving import ServingObs  # noqa: F401
+from walkai_nos_tpu.obs.slo import BucketRing, SloTracker  # noqa: F401
 from walkai_nos_tpu.obs.trace import RequestTrace, Ring  # noqa: F401

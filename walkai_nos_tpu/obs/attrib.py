@@ -1,0 +1,196 @@
+"""Per-dispatch device-time attribution for the serving engine.
+
+The continuous batcher's step time has three very different owners —
+the device program itself, the host-side dispatch assembly (table
+uploads, lane packing, registry writes), and whatever pipelining hides
+— and ROADMAP item 3 needs them separated LIVE, not only in offline
+bench runs (r5's slope decomposition found 0.23 ms of host dispatch
+inside a 0.74 ms step, but only once per bench round). This module is
+the always-on version of that decomposition, in the spirit of
+continuous profiling in production (Google-Wide Profiling): cheap
+enough to leave enabled, precise enough to act on.
+
+Mechanics, all host-side at the engine's existing sync seams:
+
+- **Classification**: every dispatch is labeled by its composition —
+  plain decode slot-steps, prefill-lane chunks, both fused in one
+  program, or a speculative draft+verify round (`classify_dispatch`;
+  the `kind` label on every attribution series). A TTFT regression
+  that lives only in `mixed` dispatches is a lane-interference story;
+  one that lives in `spec` is a draft-cost story.
+- **Host vs device split**: the engine measures the host time spent
+  assembling each dispatch (prologue through program issue plus
+  epilogue bookkeeping) separately from the BLOCKED device sync (the
+  host fetch of the chunk's tokens). Under the engine's one-chunk
+  pipelining the blocked sync is the residual device time the host
+  could not overlap — exactly the quantity that bounds capacity;
+  speculative rounds are synchronous, so there the sync is the whole
+  device round.
+- **Roofline lineage**: each dispatch's measured device time is paired
+  with the same analytic HBM cost model the bench uses (weights
+  re-read + resident KV per step over published bandwidth), so
+  `cb_device_roofline_fraction` tracks continuously what
+  `decode_gqa_roofline_fraction` records once per bench round. On
+  hosts with no published bandwidth (CPU CI) the fraction is simply
+  never set.
+
+Live gauges are maintained over a short trailing window of dispatches
+(`window` — big enough to smooth one-off syncs, small enough to react
+within seconds): `cb_device_step_ms`, `cb_host_overhead_frac`,
+`cb_device_roofline_fraction`, `cb_device_hbm_bytes_per_step`.
+Cumulative per-kind counters (`cb_dispatch_kind_total`,
+`cb_device_time_seconds_total`, `cb_host_time_seconds_total`) and the
+`cb_device_sync_seconds` histogram carry the full history for
+dashboards. Everything no-ops when the obs bundle is disabled.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+__all__ = ["DISPATCH_KINDS", "DispatchAttribution", "classify_dispatch"]
+
+# Every value the `kind` label can take, in documentation order.
+DISPATCH_KINDS = ("decode", "prefill", "mixed", "spec", "spec_prefill")
+
+
+def classify_dispatch(
+    busy_slots: int, lane_rows: int, spec: bool
+) -> str:
+    """Composition class of one dispatch: what the step program
+    actually carried. `busy_slots` = slots holding a live request,
+    `lane_rows` = prefill-lane rows carrying a real admission, `spec`
+    = the dispatch was a speculative draft+verify round."""
+    if spec:
+        return "spec_prefill" if lane_rows else "spec"
+    if lane_rows and busy_slots:
+        return "mixed"
+    if lane_rows:
+        return "prefill"
+    return "decode"
+
+
+class DispatchAttribution:
+    """Attribution recorder over a `ServingObs` bundle.
+
+    One `record()` per dispatch, at its host sync (the only place both
+    the host and device times are known). The cost model inputs are
+    fixed at construction — weights are served once, KV bytes per
+    token is a config constant — so the per-dispatch work is a handful
+    of registry writes plus O(1) window-sum updates.
+    """
+
+    def __init__(
+        self,
+        obs,
+        *,
+        param_bytes: int = 0,
+        kv_bytes_per_token: int = 0,
+        hbm_bytes_per_s: float | None = None,
+        window: int = 128,
+    ):
+        self.enabled = obs.enabled
+        self._obs = obs
+        self._param_bytes = float(param_bytes)
+        self._kv_per_tok = float(kv_bytes_per_token)
+        self._bw = hbm_bytes_per_s or None
+        if window <= 0:
+            raise ValueError(f"window must be > 0; got {window}")
+        self._window = window
+        # Trailing window of (device_s, host_s, steps, ideal_s|None):
+        # running sums maintained incrementally so a record is O(1).
+        self._recent: deque[tuple] = deque()
+        self._sum_device = 0.0
+        self._sum_host = 0.0
+        self._sum_steps = 0
+        self._sum_ideal = 0.0
+        self._last_bytes_per_step: float | None = None
+
+    def record(
+        self,
+        *,
+        kind: str,
+        steps: int,
+        host_s: float,
+        device_s: float,
+        resident_tokens: int,
+    ) -> None:
+        """One dispatch: `steps` = its per-slot step window (chunk
+        size for a plain chunk, k+1 for a speculative round), `host_s`
+        = measured host assembly + bookkeeping, `device_s` = the
+        blocked device sync, `resident_tokens` = KV-resident tokens
+        at dispatch (the cost model's cache-read term)."""
+        if not self.enabled:
+            return
+        obs = self._obs
+        obs.dispatch_kind.inc(labels={"kind": kind})
+        obs.device_time.inc(max(0.0, device_s), {"kind": kind})
+        obs.host_time.inc(max(0.0, host_s), {"kind": kind})
+        obs.device_sync.observe(device_s)
+        ideal_s = None
+        bytes_per_step = None
+        if self._bw:
+            # Analytic HBM floor of this dispatch: every decode step
+            # re-reads the weights and the resident KV once (the same
+            # model bench_lm's decode ceiling uses).
+            bytes_per_step = (
+                self._param_bytes + resident_tokens * self._kv_per_tok
+            )
+            ideal_s = steps * bytes_per_step / self._bw
+            self._last_bytes_per_step = bytes_per_step
+        self._recent.append((device_s, host_s, steps, ideal_s))
+        self._sum_device += device_s
+        self._sum_host += host_s
+        self._sum_steps += steps
+        self._sum_ideal += ideal_s or 0.0
+        if len(self._recent) > self._window:
+            d, h, st, ideal = self._recent.popleft()
+            self._sum_device -= d
+            self._sum_host -= h
+            self._sum_steps -= st
+            self._sum_ideal -= ideal or 0.0
+        if self._sum_steps > 0:
+            obs.device_step_ms.set(
+                round(1e3 * self._sum_device / self._sum_steps, 4)
+            )
+        total = self._sum_device + self._sum_host
+        if total > 0:
+            obs.host_overhead.set(round(self._sum_host / total, 4))
+        if bytes_per_step is not None:
+            obs.hbm_step_bytes.set(bytes_per_step)
+            if self._sum_ideal > 0 and self._sum_device > 0:
+                obs.device_roofline.set(
+                    round(
+                        min(1.0, self._sum_ideal / self._sum_device), 4
+                    )
+                )
+
+    def stats(self) -> dict:
+        """Attribution view of the registry — the `/stats` `cb_attrib`
+        section and the `/debug/state` `attrib` block. Same dict shape
+        with telemetry off, flagged `obs_disabled` (the PR 3
+        convention), so zeros read as "not recorded"."""
+        obs = self._obs
+        kinds = {
+            kind: {
+                "dispatches": int(
+                    obs.dispatch_kind.value({"kind": kind})
+                ),
+                "device_s": round(
+                    obs.device_time.value({"kind": kind}), 6
+                ),
+                "host_s": round(
+                    obs.host_time.value({"kind": kind}), 6
+                ),
+            }
+            for kind in DISPATCH_KINDS
+        }
+        return {
+            **({} if self.enabled else {"obs_disabled": True}),
+            "device_step_ms": obs.device_step_ms.value(),
+            "host_overhead_frac": obs.host_overhead.value(),
+            "roofline_fraction": obs.device_roofline.value(),
+            "hbm_bytes_per_step": self._last_bytes_per_step,
+            "window_dispatches": len(self._recent),
+            "kinds": kinds,
+        }

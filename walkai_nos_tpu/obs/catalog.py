@@ -270,6 +270,116 @@ CATALOG: tuple[MetricSpec, ...] = (
         "staleness = now - value)",
         attr="last_dispatch",
     ),
+    # -- device-time attribution (obs/attrib.py) -----------------------
+    MetricSpec(
+        "cb_dispatch_kind_total", "counter",
+        "Dispatches by composition class",
+        # decode | prefill | mixed | spec | spec_prefill
+        labels=("kind",),
+        attr="dispatch_kind",
+    ),
+    MetricSpec(
+        "cb_device_time_seconds_total", "counter",
+        "Cumulative blocked-device-sync seconds by dispatch "
+        "composition (the device time the host could not overlap)",
+        labels=("kind",),
+        attr="device_time",
+    ),
+    MetricSpec(
+        "cb_host_time_seconds_total", "counter",
+        "Cumulative host dispatch-assembly seconds by dispatch "
+        "composition (prologue, lane packing, program issue, "
+        "epilogue bookkeeping)",
+        labels=("kind",),
+        attr="host_time",
+    ),
+    MetricSpec(
+        "cb_device_sync_seconds", "histogram",
+        "Blocked host time in one dispatch's device sync (the token "
+        "fetch; pipelined chunks overlap part of the device time, "
+        "speculative rounds are fully synchronous)",
+        buckets=_MID,
+        attr="device_sync",
+    ),
+    MetricSpec(
+        "cb_device_step_ms", "gauge",
+        "Device-attributed milliseconds per batch step over the "
+        "trailing attribution window (device sync seconds / per-slot "
+        "step window, averaged)",
+        attr="device_step_ms",
+    ),
+    MetricSpec(
+        "cb_host_overhead_frac", "gauge",
+        "Host assembly fraction of total step time over the trailing "
+        "attribution window (host / (host + device))",
+        attr="host_overhead",
+    ),
+    MetricSpec(
+        "cb_device_roofline_fraction", "gauge",
+        "Analytic HBM-streaming floor over measured device time, "
+        "trailing window (1.0 = decode runs at the memory roofline; "
+        "unset on hosts with no published bandwidth)",
+        attr="device_roofline",
+    ),
+    MetricSpec(
+        "cb_device_hbm_bytes_per_step", "gauge",
+        "Latest analytic HBM bytes one decode step must stream "
+        "(weights + resident KV — the roofline fraction's numerator "
+        "input)",
+        attr="hbm_step_bytes",
+    ),
+    # -- sliding-window SLO / saturation (obs/slo.py) ------------------
+    MetricSpec(
+        "cb_slo_ttft_p50", "gauge",
+        "TTFT p50 over the trailing SLO window (seconds, one "
+        "log-bucket accuracy)",
+        attr="slo_ttft_p50",
+    ),
+    MetricSpec(
+        "cb_slo_ttft_p99", "gauge",
+        "TTFT p99 over the trailing SLO window (seconds, one "
+        "log-bucket accuracy)",
+        attr="slo_ttft_p99",
+    ),
+    MetricSpec(
+        "cb_slo_tpot_p99", "gauge",
+        "Per-request decode pace p99 over the trailing SLO window "
+        "(seconds per output token)",
+        attr="slo_tpot_p99",
+    ),
+    MetricSpec(
+        "cb_slo_dispatch_p99", "gauge",
+        "Dispatch latency p99 over the trailing SLO window (seconds)",
+        attr="slo_dispatch_p99",
+    ),
+    MetricSpec(
+        "cb_slo_ok", "gauge",
+        "1 when the labeled objective met its error budget over the "
+        "window, 0 on breach (absent until the window has samples)",
+        labels=("objective",),  # ttft_p99_s | tpot_p99_s
+        attr="slo_ok_gauge",
+    ),
+    MetricSpec(
+        "cb_slo_burn_rate", "gauge",
+        "Error-budget burn of the labeled objective: fraction of "
+        "window samples over the threshold divided by the quantile's "
+        "budget (1.0 = burning exactly at budget)",
+        labels=("objective",),
+        attr="slo_burn",
+    ),
+    MetricSpec(
+        "cb_saturation", "gauge",
+        "Composed engine saturation in [0, 1]: max of the normalized "
+        "pressure components (the router/autoscaler scale signal)",
+        attr="saturation",
+    ),
+    MetricSpec(
+        "cb_saturation_component", "gauge",
+        "Normalized pressure component of cb_saturation",
+        # busy | queue | queue_trend | pool
+        labels=("signal",),
+        attr="saturation_component",
+    ),
     # -- kube binaries (kube/runtime.py via health.Metrics) ------------
     MetricSpec(
         "nos_reconcile_total", "counter",

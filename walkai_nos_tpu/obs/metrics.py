@@ -233,6 +233,19 @@ class Histogram(_Metric):
             state = self._series.get(self._key(labels))
             return 0 if state is None else state.total
 
+    def snapshot_counts(
+        self, labels: dict | None = None
+    ) -> tuple[list[int], int]:
+        """(per-bucket counts copy, total incl. the +Inf overflow) —
+        the raw material the sliding-window SLO layer (`obs/slo.py`)
+        snapshots into its ring of buckets: two snapshots differenced
+        give the bucket counts of exactly the samples between them."""
+        with self._lock:
+            state = self._series.get(self._key(labels))
+            if state is None:
+                return [0] * len(self.bounds), 0
+            return list(state.counts), state.total
+
     def sum(self, labels: dict | None = None) -> float:
         with self._lock:
             state = self._series.get(self._key(labels))

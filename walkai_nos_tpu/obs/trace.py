@@ -46,6 +46,12 @@ ERROR = "error"
 # timed sub-spans).
 SPEC_DRAFT = "spec_draft"
 SPEC_VERIFY = "spec_verify"
+# Per-dispatch attribution event: host-assembly vs blocked-device-sync
+# durations, recorded at the dispatch's host sync (obs/attrib.py is
+# the registry side; this is the trace side, rendered as duration
+# events on the engine track so /debug/trace shows device vs host
+# time per dispatch).
+DISPATCH = "dispatch"
 
 
 class Ring:
@@ -184,6 +190,24 @@ class RequestTrace:
         self.event(
             SPEC_VERIFY, t, k=k, live_slots=live_slots,
             accepted=accepted,
+        )
+
+    def dispatch(
+        self, t_sync: float, kind: str, steps: int,
+        host_s: float, device_s: float,
+    ) -> None:
+        """One dispatch's attribution: `t_sync` is its host sync (the
+        engine clock read every record in the chunk shares), `kind`
+        its composition class (obs/attrib.py), `host_s` the measured
+        assembly time and `device_s` the blocked device sync that
+        ended at `t_sync`. The Chrome export renders these as
+        back-to-back duration events on the engine track."""
+        if not self.enabled:
+            return
+        self.event(
+            DISPATCH, t_sync, kind=kind, steps=steps,
+            host_ms=round(host_s * 1e3, 3),
+            device_ms=round(device_s * 1e3, 3),
         )
 
     def first_token(self, rid: int, t: float) -> None:
@@ -357,9 +381,51 @@ class RequestTrace:
                         "n_tokens": s.get("n_tokens"),
                     },
                 })
+        engine_track_named = False
         for e in events:
             if e["name"] in (SUBMIT, ADMITTED, PREFILL_CHUNK, DONE):
                 continue  # already represented as span structure
+            if e["name"] == DISPATCH:
+                # Device-vs-host attribution phases on the engine
+                # track (tid 0): the blocked device sync ended at the
+                # event time, the host assembly directly preceded the
+                # dispatch. Rendered back to back ending at the sync —
+                # under pipelining the host work actually overlapped
+                # the previous chunk's device time, so the layout is
+                # the attribution, not a wall-clock gantt.
+                if not engine_track_named:
+                    engine_track_named = True
+                    out.append({
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": 1,
+                        "tid": 0,
+                        "args": {"name": "engine dispatches"},
+                    })
+                args = e.get("args", {})
+                device_s = args.get("device_ms", 0.0) / 1e3
+                host_s = args.get("host_ms", 0.0) / 1e3
+                kind = args.get("kind", "?")
+                out.append({
+                    "name": f"host:{kind}",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": 0,
+                    "ts": max(0, us(e["t"] - device_s - host_s)),
+                    "dur": max(0, us(e["t"] - device_s))
+                    - max(0, us(e["t"] - device_s - host_s)),
+                    "args": args,
+                })
+                out.append({
+                    "name": f"device:{kind}",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": 0,
+                    "ts": max(0, us(e["t"] - device_s)),
+                    "dur": us(e["t"]) - max(0, us(e["t"] - device_s)),
+                    "args": args,
+                })
+                continue
             out.append({
                 "name": e["name"],
                 "ph": "i",
