@@ -654,6 +654,7 @@ def main() -> None:
             "cb_kv_hbm_bytes_per_resident_token", "cb_prefix_hit_rate",
             "cb_prefill_tokens_saved_frac", "cb_device_step_ms",
             "cb_host_overhead_frac", "cb_device_roofline_fraction",
+            "cb_loop_steps_per_sync",
             "cb_slo_ttft_p99", "cb_saturation",
             "cb_spec_capacity_tokens_per_s",
             "cb_spec_accepted_per_round", "obs_overhead_pct",

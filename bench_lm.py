@@ -822,6 +822,14 @@ def measure_cb_serving(
         "cb_device_roofline_fraction": _parse_value(
             metrics1, "cb_device_roofline_fraction"
         ),
+        # Device-resident loop fold depth (models/serve.py
+        # loop_steps; the demo server enables the loop by default, so
+        # cb_host_overhead_frac above is the WITH-LOOP re-scrape the
+        # BASELINE budget gates): per-slot device steps surfaced per
+        # loop sync, run average.
+        "cb_loop_steps_per_sync": _parse_value(
+            metrics1, "cb_loop_steps_per_sync"
+        ),
         # Windowed SLO gauges (obs/slo.py) at window end: the p99
         # TTFT over the engine's sliding window and the composed
         # saturation signal the router/autoscaler consumes.

@@ -543,6 +543,18 @@ def main() -> None:
                 # 16 is the balanced default; on a local runtime the
                 # chunk sync is ~free and smaller chunks cost little.
                 chunk_steps=int(os.environ.get("WALKAI_CB_CHUNK", "16")),
+                # Device-resident multi-step loop (models/serve.py):
+                # WALKAI_CB_LOOP=0 disables the fold entirely;
+                # WALKAI_CB_LOOP_STEPS sets how many chunks (or spec
+                # rounds) one while_loop dispatch may fold whenever no
+                # admission is pending. loop_steps=1 IS the disabled
+                # path, bit for bit, so the gate just maps to it.
+                loop_steps=(
+                    int(os.environ.get("WALKAI_CB_LOOP_STEPS", "8"))
+                    if os.environ.get("WALKAI_CB_LOOP", "1") == "1"
+                    and os.environ.get("WALKAI_CB_PAGED", "1") == "1"
+                    else 1
+                ),
                 # Paged KV block pool + fused chunked-prefill lane
                 # (models/serve.py): admission rides the step program
                 # instead of blocking prefill+admit dispatch pairs.
@@ -562,9 +574,28 @@ def main() -> None:
                 **cb_slo_kwargs,
                 obs=obs,
             )
-            # Compile prefill + chunk step off the request path.
+            # Compile prefill + chunk step (and, with loop_steps > 1,
+            # the device-resident loop program) off the request path —
+            # a single admission first (the steady-state P=1 lane
+            # width), then bursts of 2, 4, ... up to the usable lane
+            # count so EVERY pow2 lane-width signature compiles NOW:
+            # the first concurrent admissions otherwise stall the
+            # driver for seconds of XLA compile mid-traffic (measured
+            # ~6 s on a CPU dev box — long enough to zero a short
+            # capacity probe's window).
             cb_engine.submit([1], max_new_tokens=min(2, lm_max_new))
             cb_engine.run()
+            widest = min(
+                cb_slots, getattr(cb_engine, "prefill_lanes", 1)
+            )
+            p = 2
+            while p <= widest:
+                for _ in range(p):
+                    cb_engine.submit(
+                        [1], max_new_tokens=min(2, lm_max_new)
+                    )
+                cb_engine.run()
+                p *= 2
             cb_queue = queue.Queue()
             cb_waiters: dict[int, dict] = {}
             cb_enabled[0] = True
@@ -617,7 +648,15 @@ def main() -> None:
                         except queue.Empty:
                             pass
                         if cb_engine.has_work:
-                            cb_engine.step()
+                            # Streaming consumers want per-chunk token
+                            # cadence; the device-resident fold would
+                            # batch their SSE events into loop-horizon
+                            # bursts. Fold only while every waiter is
+                            # a whole-response waiter.
+                            cb_engine.step(allow_loop=not any(
+                                w.get("queue") is not None
+                                for w in cb_waiters.values()
+                            ))
                         # Streaming feed: push newly visible tokens to
                         # SSE waiters as each chunk syncs.
                         for rid, delta in (
@@ -1186,6 +1225,7 @@ def main() -> None:
                     payload["cb_spec"] = cb_engine.spec_stats()
                     payload["cb_slo"] = cb_engine.slo_stats()
                     payload["cb_attrib"] = cb_engine.attrib_stats()
+                    payload["cb_loop"] = cb_engine.loop_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

@@ -20,6 +20,38 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the test session: the suite
+# builds hundreds of tiny-model jit programs, and most are IDENTICAL
+# HLO (every ContinuousBatcher instance traces its own closures, so
+# the in-process jit cache never dedupes them — measured ~7.7 s per
+# cold engine build vs ~1.3 s warm). A per-session temp dir keeps the
+# speedup within one run with zero cross-run staleness risk; the cache
+# key includes the HLO fingerprint + compile options + jaxlib version,
+# so hits are exact. WALKAI_TEST_NO_COMPILE_CACHE=1 disables (e.g. to
+# time true cold compiles).
+if os.environ.get("WALKAI_TEST_NO_COMPILE_CACHE") != "1":
+    import atexit as _atexit
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    _jax_cache_dir = _tempfile.mkdtemp(prefix="walkai-xla-cache-")
+    # Session-scoped on purpose; reap it at interpreter exit (spawned
+    # demo servers are dead by then) so runs don't accumulate cache
+    # dirs under /tmp.
+    _atexit.register(
+        _shutil.rmtree, _jax_cache_dir, ignore_errors=True
+    )
+    # Spawned subprocesses (the demo-server tests) inherit the same
+    # session cache through the env var jax reads natively, so each
+    # server spawn stops recompiling the full serving program set.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _jax_cache_dir)
+    jax.config.update("jax_compilation_cache_dir", _jax_cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except AttributeError:  # older jaxlib: flag absent, default is 0
+        pass
+
 import pytest  # noqa: E402
 
 from walkai_nos_tpu.tpu.tiling import known_tilings  # noqa: E402

@@ -180,7 +180,7 @@ class TestCheckLogic:
         absent_ok lower-is-better bands and they PARSE through the
         comparator: absent from the bench output is a skip note; a
         device step past the band or a host-overhead fraction past
-        the 0.5 budget fails once emitted."""
+        the 0.15 loop-era budget fails once emitted."""
         with open(_ROOT / "BASELINE.json") as f:
             published = json.load(f)["published"]
         step = published["cb_device_step_ms"]
@@ -191,7 +191,10 @@ class TestCheckLogic:
         assert frac["direction"] == "lower"
         assert frac["tolerance"] == 0.0
         assert frac["absent_ok"] is True
-        assert frac["value"] == 0.5
+        # Tightened from 0.5 by the device-resident-loop PR: with
+        # loop_steps chunks folded per host sync, assembly must stay
+        # under 0.15 of step time.
+        assert frac["value"] == 0.15
         # The windowed SLO p99 rides the same absent_ok pattern,
         # anchored like-for-like to the r5 record-derived cb_ttft_p99.
         slo = published["cb_slo_ttft_p99"]
@@ -209,7 +212,7 @@ class TestCheckLogic:
         ceiling = step["value"] * (1 + step["tolerance"])
         failures, _ = bench_check.check(
             {"cb_device_step_ms": ceiling * 0.9,
-             "cb_host_overhead_frac": 0.31},
+             "cb_host_overhead_frac": 0.12},
             base,
         )
         assert failures == []
@@ -221,6 +224,45 @@ class TestCheckLogic:
         assert len(failures) == 2
         assert any("cb_device_step_ms" in f for f in failures)
         assert any("cb_host_overhead_frac" in f for f in failures)
+        # The r5 per-chunk measurement (0.31) must now FAIL the
+        # tightened budget — the loop is the only way back to green.
+        failures, _ = bench_check.check(
+            {"cb_host_overhead_frac": 0.31},
+            {"published": {
+                "cb_host_overhead_frac": published[
+                    "cb_host_overhead_frac"
+                ],
+            }},
+        )
+        assert len(failures) == 1
+
+    def test_repo_baseline_activates_roofline_gate(self):
+        """The device-resident-loop PR activates the long-deferred
+        decode_gqa_roofline_fraction gate: an absent_ok acceptance
+        FLOOR at 0.8 (tolerance 0) instead of the old
+        null-until-recorded placeholder — absent from the bench
+        output is still a skip note, but a chip run landing under
+        the floor fails."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        spec = published["decode_gqa_roofline_fraction"]
+        assert spec["direction"] == "higher"
+        assert spec["tolerance"] == 0.0
+        assert spec["absent_ok"] is True
+        assert spec["value"] == 0.8
+        base = {"published": {"decode_gqa_roofline_fraction": spec}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert any("absent" in n for n in notes)
+        failures, _ = bench_check.check(
+            {"decode_gqa_roofline_fraction": 0.85}, base
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"decode_gqa_roofline_fraction": 0.46}, base
+        )
+        assert len(failures) == 1
+        assert "decode_gqa_roofline_fraction" in failures[0]
 
     def test_bare_number_baseline_defaults_higher(self):
         failures, _ = bench_check.check(

@@ -406,3 +406,168 @@ class TestKernelThroughModel:
         monkeypatch.setenv("WALKAI_DECODE_INTERPRET", "1")
         out = make_generate_fn(cfg)(params, _prompt(), max_new_tokens=6)
         assert jnp.array_equal(ref, out), (ref, out)
+
+
+class TestFusedQkvKernel:
+    """Fused QKV projection + rotary + paged attention
+    (`ops/decode_attention.fused_qkv_paged_attention`): interpret-mode
+    CI pins the fusion against the unfused composition
+    (`fused_qkv_paged_reference` — projection, split, rope, pool
+    scatter, gather-reference attention), across storage dtypes,
+    kv-head counts, and rope on/off — the dtype-parity seam for a
+    kernel whose TPU lowering CI cannot run."""
+
+    def _case(self, kvh, dtype, rope, *, steps=4, b=3, hd=16, seed=0):
+        rng = np.random.default_rng(seed)
+        h = 4
+        dm = h * hd
+        nlog, nb = 3, 12
+        x = jnp.asarray(rng.standard_normal((b, steps, dm)), dtype)
+        w = jnp.asarray(
+            rng.standard_normal((dm, dm + 2 * kvh * hd)) * 0.1, dtype
+        )
+        bias = jnp.asarray(
+            rng.standard_normal(dm + 2 * kvh * hd) * 0.1, dtype
+        )
+        kp = jnp.asarray(
+            rng.standard_normal((nb, kvh, da.PAGE_ROWS, hd)), dtype
+        )
+        vp = jnp.asarray(
+            rng.standard_normal((nb, kvh, da.PAGE_ROWS, hd)), dtype
+        )
+        # Shuffled table (physical != logical) + ragged indices, some
+        # mid-block, some crossing a block edge inside the window.
+        table = jnp.asarray(
+            rng.permutation(np.arange(1, nb))[:b * nlog].reshape(
+                b, nlog
+            ),
+            jnp.int32,
+        )
+        index = jnp.asarray([0, 126, 200][:b], jnp.int32)
+        theta = 10000.0 if rope else None
+        return (x, w, bias, kp, vp, table, index), theta
+
+    @pytest.mark.parametrize("kvh", [1, 2, 4])
+    @pytest.mark.parametrize("rope", [False, True])
+    def test_fp32_parity(self, kvh, rope):
+        args, theta = self._case(kvh, jnp.float32, rope)
+        out = da.fused_qkv_paged_attention(
+            *args, num_heads=4, rope_theta=theta, interpret=True
+        )
+        ref = da.fused_qkv_paged_reference(
+            *args, num_heads=4, rope_theta=theta
+        )
+        for name, a, b in zip(("o", "k_new", "v_new"), out, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5,
+                err_msg=name,
+            )
+
+    @pytest.mark.parametrize("kvh", [1, 2, 4])
+    @pytest.mark.parametrize("rope", [False, True])
+    def test_bf16_parity(self, kvh, rope):
+        """bf16 storage: kernel folds accumulate f32 and the rope math
+        runs f32 either way, so the paths agree within bf16 rounding."""
+        args, theta = self._case(kvh, jnp.bfloat16, rope)
+        out = da.fused_qkv_paged_attention(
+            *args, num_heads=4, rope_theta=theta, interpret=True
+        )
+        ref = da.fused_qkv_paged_reference(
+            *args, num_heads=4, rope_theta=theta
+        )
+        for name, a, b in zip(("o", "k_new", "v_new"), out, ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=3e-2, rtol=3e-2, err_msg=name,
+            )
+
+    def test_bias_free_and_single_step(self):
+        """use_bias=False models pass b_qkv=None; steps=1 is the
+        serving decode step."""
+        (x, w, _, kp, vp, table, index), _ = self._case(
+            2, jnp.float32, True, steps=1
+        )
+        out = da.fused_qkv_paged_attention(
+            x, w, None, kp, vp, table, index,
+            num_heads=4, rope_theta=10000.0, interpret=True,
+        )
+        ref = da.fused_qkv_paged_reference(
+            x, w, None, kp, vp, table, index,
+            num_heads=4, rope_theta=10000.0,
+        )
+        for a, b in zip(out, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+            )
+
+    def test_fresh_rows_visible_to_fold(self):
+        """The kernel must attend to the rows it just projected
+        WITHOUT a prior pool update (in-VMEM injection): poison the
+        pool at the write positions — the output must match the
+        reference, which scatters before attending, not the poison."""
+        (x, w, bias, kp, vp, table, index), _ = self._case(
+            2, jnp.float32, False
+        )
+        steps = x.shape[1]
+        poison = kp
+        for s in range(x.shape[0]):
+            base = int(index[s])
+            for t in range(steps):
+                blk = int(table[s, (base + t) // da.PAGE_ROWS])
+                row = (base + t) % da.PAGE_ROWS
+                poison = poison.at[blk, :, row, :].set(1e4)
+        out_o, _, _ = da.fused_qkv_paged_attention(
+            x, w, bias, poison, vp, table, index,
+            num_heads=4, rope_theta=None, interpret=True,
+        )
+        ref_o, _, _ = da.fused_qkv_paged_reference(
+            x, w, bias, poison, vp, table, index,
+            num_heads=4, rope_theta=None,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_o), np.asarray(ref_o), atol=2e-5, rtol=2e-5
+        )
+
+    def test_model_routing_parity(self, monkeypatch):
+        """`LMConfig.fused_qkv` routing through DecoderLM (the
+        WALKAI_FUSED_QKV interpret seam): fused and unfused paged
+        decode must agree on logits AND the whole cache tree — pools,
+        write heads — for a rope+GQA llama-family config."""
+        monkeypatch.setenv("WALKAI_FUSED_QKV", "1")
+        monkeypatch.setenv("WALKAI_DECODE_INTERPRET", "1")
+        cfg = dataclasses.replace(
+            CFG, num_heads=4, num_kv_heads=2, rope=True,
+            norm="rmsnorm", mlp="swiglu", use_bias=False,
+            ragged_decode=True, cache_len=256, max_seq_len=512,
+            paged_decode=True, paged_blocks=9,
+        )
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+        table = jnp.asarray(
+            np.arange(1, 9).reshape(2, 4), jnp.int32
+        )
+        tok = jnp.asarray([[3, 5], [7, 9]], jnp.int32)
+        outs = {}
+        for fused in (True, False):
+            model = DecoderLM(
+                dataclasses.replace(cfg, fused_qkv=fused)
+            )
+            cache = model.init(
+                jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+                decode=True,
+            )["cache"]
+            logits, vs = model.apply(
+                {"params": params, "cache": cache}, tok, decode=True,
+                block_table=table, mutable=["cache"],
+            )
+            outs[fused] = (logits, vs["cache"])
+        np.testing.assert_allclose(
+            np.asarray(outs[True][0]), np.asarray(outs[False][0]),
+            atol=2e-4, rtol=2e-4,
+        )
+        flat_f = jax.tree_util.tree_leaves(outs[True][1])
+        flat_u = jax.tree_util.tree_leaves(outs[False][1])
+        for a, b in zip(flat_f, flat_u):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=2e-4, rtol=2e-4,
+            )

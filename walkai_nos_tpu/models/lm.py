@@ -15,6 +15,7 @@ workload its slices serve, first-class per the TPU mandate.
 from __future__ import annotations
 
 import dataclasses
+import os
 from dataclasses import dataclass
 
 import flax.linen as nn
@@ -27,8 +28,10 @@ from walkai_nos_tpu.ops.decode_attention import (
     MAX_KERNEL_STEPS,
     PAGE_ROWS,
     decode_attention,
+    fused_qkv_paged_attention,
     gather_paged_cache,
     paged_decode_attention,
+    scatter_paged_rows,
 )
 from walkai_nos_tpu.ops.ring_attention import ring_attention
 from walkai_nos_tpu.ops.ulysses import ulysses_attention
@@ -135,6 +138,16 @@ class LMConfig:
     # scratch block for idle slots).
     paged_decode: bool = False
     paged_blocks: int = 0
+    # Fused QKV projection + rotary + streamed paged attention
+    # (ops/decode_attention.fused_qkv_paged_attention): short-step
+    # paged decode folds the per-layer projection and rope into the
+    # attention kernel, so the layer reads its projection weight and
+    # cache blocks from HBM once instead of bouncing q/k/v
+    # activations out between projection and attention. TPU only
+    # (plus the WALKAI_FUSED_QKV=1 interpret-mode CI seam) — other
+    # backends keep the unfused composition, which stays bit-for-bit
+    # today's path.
+    fused_qkv: bool = True
 
     def __post_init__(self):
         if self.num_kv_heads is not None and (
@@ -268,6 +281,18 @@ def _make_norm(cfg: LMConfig, name: str):
     )
 
 
+def _fused_qkv_backend_ok() -> bool:
+    """Host-side routing gate for the fused QKV/rotary decode kernel:
+    real TPU, or the explicit interpret-mode CI opt-in. Deliberately
+    NOT keyed on WALKAI_DECODE_INTERPRET — tests force that env to
+    exercise the attention kernels alone, and flipping the serving
+    engine's whole decode path under them would change what they
+    measure."""
+    if os.environ.get("WALKAI_FUSED_QKV") == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 class CausalAttention(nn.Module):
     cfg: LMConfig
     mesh: Mesh | None = None
@@ -279,6 +304,26 @@ class CausalAttention(nn.Module):
         head_dim = d // c.num_heads
         kv_heads = c.kv_heads
         kv_dim = kv_heads * head_dim
+        if (
+            decode and c.paged_decode and c.fused_qkv
+            and x.shape[1] <= MAX_KERNEL_STEPS
+            and not self.is_initializing()
+            and _fused_qkv_backend_ok()
+        ):
+            # Fused QKV + rotary + paged attention: the projection
+            # runs inside the streamed kernel, so q/k/v never bounce
+            # through HBM between projection and attention. Init and
+            # non-TPU backends take the unfused path below (which
+            # also creates the `qkv` Dense params the fused path
+            # reads).
+            o = self._fused_paged_decode(x, block_table)
+            o = o.transpose(0, 2, 1, 3).reshape(
+                x.shape[0], x.shape[1], d
+            )
+            return nn.Dense(
+                d, dtype=c.compute_dtype, use_bias=c.use_bias,
+                name="out_proj",
+            )(o)
         # Fused projection: [q | k | v] channel blocks. With GQA the
         # K/V blocks are kv_heads wide; at kv_heads == num_heads this
         # is the same 3d-channel kernel (and layout) as always.
@@ -463,29 +508,18 @@ class CausalAttention(nn.Module):
                 "paged_decode requires block_table= at apply time"
             )
         idx = index.value  # [batch]
-        nlog = block_table.shape[1]
         pos = idx[:, None] + jnp.arange(steps)  # [batch, steps]
         if c.rope:
             q = apply_rope(q, pos, c.rope_theta)
             k = apply_rope(k, pos, c.rope_theta)
-        logical = jnp.clip(pos // PAGE_ROWS, 0, nlog - 1)
-        phys = jnp.take_along_axis(block_table, logical, axis=1)
         # Out-of-capacity rows scatter to an out-of-bounds pool index
-        # so mode="drop" discards them; clipping instead would rewrite
-        # the slot's last real block in-place.
-        phys = jnp.where(pos < nlog * PAGE_ROWS, phys, c.paged_blocks)
-        row = pos % PAGE_ROWS
-
-        def put(pool, new):  # new: [batch, kv_heads, steps, d]
-            rows = new.transpose(0, 2, 1, 3).reshape(
-                batch * steps, kv_heads, head_dim
-            )
-            return pool.at[
-                phys.reshape(-1), :, row.reshape(-1), :
-            ].set(rows.astype(pool.dtype), mode="drop")
-
-        k_pool = put(pool_k.value, k)
-        v_pool = put(pool_v.value, v)
+        # and DROP (never clip — a clipped write would rewrite the
+        # slot's last real block in-place); the one write rule lives
+        # in ops/decode_attention.scatter_paged_rows, shared with the
+        # fused QKV path.
+        k_pool, v_pool = scatter_paged_rows(
+            pool_k.value, pool_v.value, k, v, block_table, idx
+        )
         pool_k.value, pool_v.value = k_pool, v_pool
         index.value = idx + steps
         if steps <= MAX_KERNEL_STEPS:
@@ -503,6 +537,56 @@ class CausalAttention(nn.Module):
         k_all = gather_paged_cache(k_pool, block_table)
         v_all = gather_paged_cache(v_pool, block_table)
         return _masked_cache_attention(q, k_all, v_all, idx, True)
+
+    def _fused_paged_decode(self, x, block_table):
+        """Short-step paged decode through the fused QKV/rotary/
+        attention kernel (`ops/decode_attention.
+        fused_qkv_paged_attention`): reads the `qkv` Dense's params
+        directly (same pytree path, so checkpoints and the
+        tensor-parallel sharding rules are untouched), hands the
+        kernel the normed hidden states, and scatters the returned
+        fresh K/V rows into the pool — the cache write the unfused
+        path performs pre-attention happens post-attention here, with
+        the kernel seeing the rows via in-VMEM injection instead.
+        Cache-tree structure (pool leaves + cache_index) is identical
+        to `_paged_decode_attention`'s."""
+        c = self.cfg
+        head_dim = c.hidden_dim // c.num_heads
+        kv_heads = c.kv_heads
+        batch, steps = x.shape[0], x.shape[1]
+        pool_shape = (c.paged_blocks, kv_heads, PAGE_ROWS, head_dim)
+        pool_k = self.variable(
+            "cache", "cached_key", jnp.zeros, pool_shape, c.compute_dtype
+        )
+        pool_v = self.variable(
+            "cache", "cached_value", jnp.zeros, pool_shape, c.compute_dtype
+        )
+        index = self.variable(
+            "cache", "cache_index",
+            lambda: jnp.zeros((batch,), jnp.int32),
+        )
+        if block_table is None:
+            raise ValueError(
+                "paged_decode requires block_table= at apply time"
+            )
+        qkv_params = self.get_variable("params", "qkv")
+        kernel = qkv_params["kernel"].astype(c.compute_dtype)
+        bias = (
+            qkv_params["bias"].astype(c.compute_dtype)
+            if c.use_bias else None
+        )
+        idx = index.value
+        o, k_new, v_new = fused_qkv_paged_attention(
+            x.astype(c.compute_dtype), kernel, bias,
+            pool_k.value, pool_v.value, block_table, idx,
+            num_heads=c.num_heads,
+            rope_theta=c.rope_theta if c.rope else None,
+        )
+        pool_k.value, pool_v.value = scatter_paged_rows(
+            pool_k.value, pool_v.value, k_new, v_new, block_table, idx
+        )
+        index.value = idx + steps
+        return o
 
 
 def _masked_cache_attention(q, k_all, v_all, idx, ragged):

@@ -145,6 +145,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from walkai_nos_tpu.models.block_pool import BlockPool
 from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
 from walkai_nos_tpu.models.prefix_cache import PrefixIndex
@@ -221,6 +222,18 @@ class ContinuousBatcher:
     `prefill_chunk` prompt tokens per dispatch each). `paged=False`
     keeps the dense per-slot cache with blocking bucketed prefill.
 
+    `loop_steps` (paged only; default 1) sets how many decode chunks
+    — or speculative rounds — ONE device-resident `lax.while_loop`
+    dispatch may fold whenever no admission work is pending: the
+    loop body runs entirely on-device over a donated carry and exits
+    on the first host-relevant condition (a slot hitting EOS or its
+    budget, a write head about to cross into an unbacked block, the
+    horizon), surfacing only committed tokens and per-slot counts at
+    the sync. Host dispatch cost then amortizes over the fold; the
+    output is token-identical to `loop_steps=1` (which IS today's
+    per-chunk pipelined path, bit for bit) — the loop changes when
+    the host learns about tokens, never which.
+
     `prefix_cache=True` (paged only) turns the pool refcounted and
     content-addressed: full 128-token prompt blocks are indexed in a
     host-side radix trie, admissions reuse every fully-matched prefix
@@ -283,6 +296,7 @@ class ContinuousBatcher:
         cache_len: int | None = None,
         prompt_bucket: int = 16,
         chunk_steps: int = 8,
+        loop_steps: int = 1,
         paged: bool = True,
         pool_blocks: int | None = None,
         prefill_chunk: int = 64,
@@ -309,6 +323,23 @@ class ContinuousBatcher:
         self.cache_len = cache_len
         self.prompt_bucket = prompt_bucket
         self.chunk_steps = chunk_steps
+        # Device-resident multi-step serving loop (ROADMAP item 3):
+        # loop_steps > 1 folds up to that many decode chunks (or
+        # speculative rounds) into ONE donated-carry lax.while_loop
+        # dispatch whenever no admission work is pending, surfacing
+        # only committed tokens and per-slot counts at the sync.
+        # loop_steps=1 is today's per-chunk dispatch path, bit for bit.
+        if loop_steps < 1:
+            raise ValueError(
+                f"loop_steps must be >= 1; got {loop_steps}"
+            )
+        if loop_steps > 1 and not paged:
+            raise ValueError(
+                "loop_steps > 1 requires the paged engine (the "
+                "device-resident loop pre-backs per-slot block tables "
+                "to its horizon; the dense cache has no table)"
+            )
+        self.loop_steps = loop_steps
         self.paged = paged
         self.params = params
         self._nlog = -(-cache_len // PAGE_ROWS)
@@ -438,35 +469,28 @@ class ContinuousBatcher:
         self._last_dispatch_mono: float | None = None
 
         # Paged allocator state (host-owned; the table uploads per
-        # dispatch). Block 0 is never allocated: it is the scratch
-        # block idle slots write into.
-        self._table = np.zeros((slots, self._nlog), np.int32)
-        self._slot_blocks: list[list[int]] = [[] for _ in range(slots)]
-        self._free_blocks: list[int] = (
-            list(range(self.pool_blocks - 1, 0, -1)) if paged else []
+        # dispatch), extracted to `models/block_pool.py`: free list,
+        # per-slot block lists/table rows, lazy decode backing, the
+        # virtual worst-case reservation, and the refcount/park/evict
+        # glue around the shared-prefix radix index. Block 0 is never
+        # allocated: it is the scratch block idle slots write into.
+        self.pool = BlockPool(
+            slots=slots,
+            cache_len=cache_len,
+            pool_blocks=self.pool_blocks if paged else 0,
+            prefix=(
+                PrefixIndex(PAGE_ROWS) if (paged and prefix_cache)
+                else None
+            ),
+            obs=self.obs,
         )
         self._prefilling: list[_Prefill] = []
         self._warm_buckets: set[int] = set()
-        # Shared-prefix index (paged only): refcounted radix trie over
-        # full 128-token prompt blocks. `_slot_nodes[s]` pins the
-        # FIRST len(nodes) entries of `_slot_blocks[s]` (matched +
-        # self-inserted prefix nodes, a contiguous front run);
-        # everything after is private and frees on release.
-        self._prefix: PrefixIndex | None = (
-            PrefixIndex(PAGE_ROWS) if (paged and prefix_cache) else None
-        )
-        self._slot_nodes: list[list] = [[] for _ in range(slots)]
-        # Lazy decode allocation: `_slot_pos` mirrors the device
-        # cache_index of each LIVE slot (true_len at flip-live, +
-        # chunk_steps per dispatch); `_slot_resv` is the slot's
-        # remaining virtual reservation and `_reserved` the aggregate
-        # (admission invariant: free + parked >= _reserved, so a
-        # mid-flight block grab can always be backed).
-        self._slot_pos = np.zeros(slots, np.int64)
-        self._slot_resv = np.zeros(slots, np.int64)
-        self._reserved = 0
+        # Trailing run averages behind the cb_loop_steps_per_sync gauge.
+        self._loop_sync_n = 0
+        self._loop_steps_acc = 0
         if paged:
-            self._set_pool_gauges()
+            self.pool.set_gauges()
 
         cache = self._model.init(
             jax.random.PRNGKey(0),
@@ -609,23 +633,93 @@ class ContinuousBatcher:
             return state, emitted
 
         self._step_fn = step_chunk
+        if self.loop_steps > 1:
+            self._build_loop_program()
         if self._spec:
             self._build_spec_program()
+
+    def _build_loop_program(self) -> None:
+        """Device-resident multi-step decode loop (`loop_steps` > 1,
+        plain path): ONE donated-carry `jax.lax.while_loop` program
+        folds up to `loop_steps` decode chunks — each a full
+        `_decode_scan`, so the per-step sampling/key protocol is the
+        per-chunk path's by construction — and exits on the first
+        HOST-RELEVANT condition:
+
+        - a live slot emitted its EOS token (the host must release
+          the slot and record completion timing),
+        - a live slot generated its remaining token budget (`owed`),
+        - a live slot's write head would cross into an UNBACKED block
+          next chunk (lazy decode-block backing is host-side; the
+          prologue pre-backs to the loop horizon, so this fires only
+          when the pool ran dry mid-backing),
+        - the `loop_steps` horizon (bounds how long a pending
+          admission waits for the next sync).
+
+        Carry: (device state, emit buffer [slots, 1 + loop_steps *
+        chunk_steps] whose column 0 is the loop's input token — a
+        freshly flipped slot's first token, exactly the per-chunk
+        program's input column — and columns 1 + t*chunk_steps ..
+        carry chunk t's tokens, chunk counter t, exit code). The
+        first chunk always runs (a truncated slot with owed=0 must
+        still surface the tokens the host will cap); every check is
+        conservative — a spurious exit costs one extra sync, never
+        correctness, because the host replays the surfaced tokens
+        through the same `_commit_tokens` rule either way. The loop
+        changes WHEN the host learns about tokens, never WHICH."""
+        decode_scan = self._decode_scan
+        cs = self.chunk_steps
+        L = self.loop_steps
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def loop_chunks(params, state, dec_table, live, eos, owed, backed):
+            buf0 = jnp.zeros((self.slots, 1 + L * cs), jnp.int32)
+            buf0 = buf0.at[:, 0].set(state[1])
+
+            def body(carry):
+                state, buf, t, code = carry
+                state, emitted = decode_scan(params, state, dec_table)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, emitted[:, 1:], (0, 1 + t * cs)
+                )
+                t = t + 1
+                # EOS anywhere in the chunk (column 0 covers a fresh
+                # slot whose FIRST token is EOS; a non-fresh live
+                # slot's input is a committed non-EOS token) or the
+                # budget generated: both need the host.
+                done = live & (
+                    jnp.any(emitted == eos[:, None], axis=1)
+                    | (t * cs >= owed)
+                )
+                idx = cache_positions(state[0])
+                unbacked = live & (idx + cs > backed)
+                code = jnp.where(
+                    jnp.any(done), 1,
+                    jnp.where(jnp.any(unbacked), 2, 0),
+                ).astype(jnp.int32)
+                return state, buf, t, code
+
+            def cond(carry):
+                _, _, t, code = carry
+                return (t < L) & ((t == 0) | (code == 0))
+
+            return jax.lax.while_loop(
+                cond, body, (state, buf0, jnp.int32(0), jnp.int32(0))
+            )
+
+        self._loop_fn = loop_chunks
 
     def _build_spec_program(self) -> None:
         model, draft = self._model, self._draft_model
         target_lane = self._target_lane
         slots = self.slots
 
-        @functools.partial(
-            jax.jit, static_argnames=("k", "lane"),
-            donate_argnums=(1, 3),
-        )
-        def spec_round(
-            params, state, d_params, d_cache, dec_table, pf,
-            k: int, lane: bool,
-        ):
-            """One batched draft-and-verify round over every slot.
+        def spec_core(params, state, d_params, d_cache, dec_table, k):
+            """One batched draft-and-verify round over every slot —
+            the jit-free core BOTH spec programs trace (the
+            synchronous per-round dispatch below and the
+            device-resident loop body, which folds several of these
+            between host syncs).
 
             Entering with both caches' write heads at idx0 (per-slot):
             the draft proposes k tokens greedily (k single-step paged
@@ -707,6 +801,25 @@ class ContinuousBatcher:
             d_cache = rewind_cache(d_cache, new_index)
 
             state = (cache, last, temps, topks, topps, keys)
+            emitted = jnp.concatenate([t_in[:, :1], chosen], axis=1)
+            return state, d_cache, emitted, n_emit
+
+        self._spec_core = spec_core
+
+        @functools.partial(
+            jax.jit, static_argnames=("k", "lane"),
+            donate_argnums=(1, 3),
+        )
+        def spec_round(
+            params, state, d_params, d_cache, dec_table, pf,
+            k: int, lane: bool,
+        ):
+            """The synchronous per-round spec dispatch: `spec_core`
+            plus, when admissions ride along, the prefill lane and
+            its draft-pool mirror."""
+            state, d_cache, emitted, n_emit = spec_core(
+                params, state, d_params, d_cache, dec_table, k
+            )
             if lane:
                 state = target_lane(params, state, pf)
                 # Mirror the lane into the draft pool: block b holds
@@ -730,10 +843,84 @@ class ContinuousBatcher:
                     ),
                     d_cache, d_lane_vars["cache"],
                 )
-            emitted = jnp.concatenate([t_in[:, :1], chosen], axis=1)
             return state, d_cache, emitted, n_emit
 
         self._spec_fn = spec_round
+        if self.loop_steps > 1:
+            self._build_spec_loop_program()
+
+    def _build_spec_loop_program(self) -> None:
+        """Device-resident multi-step loop, speculative body: fold up
+        to `loop_steps` draft-and-verify rounds (`_spec_core` — the
+        while_loop spec shape `models/speculative.py`'s standalone
+        loop already proves) into one donated-carry program. Each
+        round commits a VARIABLE 1..k+1 tokens per slot, so the carry
+        threads per-slot write offsets into the emit buffer plus a
+        per-round count matrix rc[t, s] — the host replays rc through
+        the acceptance controller and the cb_spec_* counters round by
+        round, exactly as if each round had synced. Exit conditions
+        mirror the plain loop (EOS inside a committed window, budget,
+        a head whose NEXT k+1-row verify window would cross into an
+        unbacked block, horizon)."""
+        spec_core = self._spec_core
+        L = self.loop_steps
+        slots = self.slots
+
+        @functools.partial(
+            jax.jit, static_argnames=("k",), donate_argnums=(1, 3)
+        )
+        def loop_spec(
+            params, state, d_params, d_cache, dec_table,
+            live, eos, owed, backed, k: int,
+        ):
+            width = 1 + L * (k + 1)
+            buf0 = jnp.zeros((slots, width), jnp.int32)
+            buf0 = buf0.at[:, 0].set(state[1])
+            rows = jnp.arange(slots)[:, None]
+            win = jnp.arange(k + 1)[None]
+
+            def body(carry):
+                state, d_cache, buf, off, rc, t, code = carry
+                state, d_cache, emitted, n_emit = spec_core(
+                    params, state, d_params, d_cache, dec_table, k
+                )
+                chosen = emitted[:, 1:]  # [slots, k+1] chosen chain
+                valid = win < n_emit[:, None]
+                # Rejected tail positions scatter out of bounds and
+                # drop — the buffer holds only committed tokens.
+                cols = jnp.where(valid, 1 + off[:, None] + win, width)
+                buf = buf.at[rows, cols].set(chosen, mode="drop")
+                rc = rc.at[t].set(n_emit)
+                off = off + n_emit
+                t = t + 1
+                done = live & (
+                    jnp.any((chosen == eos[:, None]) & valid, axis=1)
+                    | (emitted[:, 0] == eos)
+                    | (off >= owed)
+                )
+                idx = cache_positions(state[0])
+                unbacked = live & (idx + k + 1 > backed)
+                code = jnp.where(
+                    jnp.any(done), 1,
+                    jnp.where(jnp.any(unbacked), 2, 0),
+                ).astype(jnp.int32)
+                return state, d_cache, buf, off, rc, t, code
+
+            def cond(carry):
+                t, code = carry[5], carry[6]
+                return (t < L) & ((t == 0) | (code == 0))
+
+            carry0 = (
+                state, d_cache, buf0, jnp.zeros(slots, jnp.int32),
+                jnp.zeros((L, slots), jnp.int32),
+                jnp.int32(0), jnp.int32(0),
+            )
+            state, d_cache, buf, _, rc, t, code = jax.lax.while_loop(
+                cond, body, carry0
+            )
+            return state, d_cache, buf, rc, t, code
+
+        self._spec_loop_fn = loop_spec
 
     def _build_dense_programs(self) -> None:
         model = self._model
@@ -949,10 +1136,18 @@ class ContinuousBatcher:
         self._latencies.clear()
         return out
 
-    def step(self) -> bool:
+    def step(self, *, allow_loop: bool = True) -> bool:
         """One pipeline turn: admit, dispatch a chunk, process the
         PREVIOUS chunk's tokens (the host fetch overlaps the chunk
         just dispatched). True while work remains.
+
+        `allow_loop=False` forces this turn onto the per-chunk path
+        even when `loop_steps > 1` and the fold is otherwise
+        eligible: tokens become host-visible per CHUNK sync instead
+        of per loop sync. A serving front-end passes this while a
+        STREAMING consumer is attached — folding would batch an SSE
+        stream's tokens into loop-horizon bursts — and restores the
+        fold the moment only whole-response waiters remain.
 
         Speculative rounds (`spec=True`, until the controller
         disables drafting) are SYNCHRONOUS instead: the next round's
@@ -960,12 +1155,33 @@ class ContinuousBatcher:
         acceptance, so the round is dispatched and processed in the
         same turn — each sync commits up to spec_k+1 tokens per slot
         where a plain chunk's sync commits chunk_steps at one token
-        per slot-step."""
+        per slot-step.
+
+        With `loop_steps > 1` and NO admission work pending (empty
+        queue, empty prefill lane), the turn instead folds up to
+        loop_steps chunks (or spec rounds) into one device-resident
+        while_loop dispatch (`_step_loop`, synchronous like spec):
+        the host round-trip amortizes over the whole fold. Any
+        pending admission routes the turn through the per-chunk path
+        — the "admission pending" loop-exit condition, applied at
+        dispatch granularity."""
         self._admit()
-        has_live = bool(
-            any(r is not None for r in self._slot_req)
-            or self._prefilling
-        )
+        live_any = any(r is not None for r in self._slot_req)
+        has_live = bool(live_any or self._prefilling)
+        if (
+            allow_loop and self.loop_steps > 1 and live_any
+            and not self._prefilling and not self._pending
+        ):
+            if self._inflight is not None:
+                # Drain the pipelined chunk before the synchronous
+                # loop reads budgets and write heads.
+                self._process(*self._inflight)
+                self._inflight = None
+            if any(r is not None for r in self._slot_req):
+                self._step_loop()
+            # Draining the in-flight chunk may have finished every
+            # live slot; the next turn admits whatever is queued.
+            return self.has_work
         if self._spec and self._spec_on and has_live:
             if self._inflight is not None:
                 # A plain chunk can only be in flight across the
@@ -1209,6 +1425,28 @@ class ContinuousBatcher:
             ),
         }
 
+    def loop_stats(self) -> dict:
+        """Device-resident-loop telemetry — a view of the registry's
+        `cb_loop_*` series plus the configured fold depth: the
+        `/debug/state` `loop` block and the bench's
+        `cb_loop_steps_per_sync` source. `steps_per_sync` is per-slot
+        device steps surfaced per loop sync, averaged over the run
+        (loop_steps * chunk_steps when every fold runs to its
+        horizon; lower when exit conditions fire early)."""
+        exits = {
+            r: int(self.obs.loop_exits.value({"reason": r}))
+            for r in ("slot_done", "unbacked", "horizon")
+        }
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "loop_steps": self.loop_steps,
+            "enabled": self.loop_steps > 1,
+            "dispatches": int(self.obs.loop_dispatches.value()),
+            "chunks_folded": int(self.obs.loop_chunks.value()),
+            "steps_per_sync": self.obs.loop_steps_per_sync.value(),
+            "exits": exits,
+        }
+
     def slo_stats(self) -> dict:
         """Sliding-window SLO view (`obs/slo.py`): windowed
         TTFT/TPOT/dispatch quantiles, per-objective compliance and
@@ -1303,6 +1541,7 @@ class ContinuousBatcher:
             "pool": pool,
             "prefix": self.prefix_stats(),
             "spec": self.spec_stats(),
+            "loop": self.loop_stats(),
             "attrib": self.attrib_stats(),
             "slo": self.slo_stats(),
         }
@@ -1324,29 +1563,49 @@ class ContinuousBatcher:
         dtype_bytes = 2 if "bfloat16" in str(c.dtype) else 4
         return c.num_layers * 2 * c.kv_heads * head_dim * dtype_bytes
 
+    # Pool bookkeeping lives in `models/block_pool.py`; these views
+    # keep the engine's historical attribute surface (tests and debug
+    # tooling read them) pointing at the live pool objects.
+    @property
+    def _table(self):
+        return self.pool.table
+
+    @property
+    def _free_blocks(self):
+        return self.pool.free_blocks
+
+    @property
+    def _slot_blocks(self):
+        return self.pool.slot_blocks
+
+    @property
+    def _slot_nodes(self):
+        return self.pool.slot_nodes
+
+    @property
+    def _slot_pos(self):
+        return self.pool.slot_pos
+
+    @property
+    def _slot_resv(self):
+        return self.pool.slot_resv
+
+    @property
+    def _reserved(self):
+        return self.pool.reserved
+
+    @property
+    def _prefix(self):
+        return self.pool.prefix
+
     def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
-        """Worst-case physical blocks a request's footprint (prompt +
-        budget) covers. Lane pad rows past the footprint no longer
-        force extra blocks: positions beyond the owned table entries
-        map to the scratch block (table entry 0), whose garbage no
-        live row ever reads — pad rows inside an owned block stay
-        masked-then-overwritten as before."""
-        return -(-min(prompt_len + max_new, self.cache_len) // PAGE_ROWS)
+        return self.pool.blocks_needed(prompt_len, max_new)
 
     def _parked_count(self) -> int:
-        """Blocks held only by the prefix index (refcount 0,
-        evictable on demand) — the ONE definition the admission
-        check, the residency views, and the pool gauges all share."""
-        return self._prefix.parked_blocks if self._prefix is not None else 0
+        return self.pool.parked_count()
 
     def _blocks_allocated(self) -> int:
-        """Distinct pool blocks held by live requests (paged mode) —
-        actual residency: shared prefix blocks count once, parked
-        (refcount-0 cached) blocks don't count at all."""
-        return (
-            self.pool_blocks - 1 - len(self._free_blocks)
-            - self._parked_count()
-        )
+        return self.pool.blocks_allocated()
 
     def _bucket_for(self, prompt_len: int) -> int:
         """Dense-mode prefill bucket: `prompt_bucket` when it fits,
@@ -1610,11 +1869,10 @@ class ContinuousBatcher:
             self._slot_req[s] = entry.req
             self._slot_new[s] = True
             self._budget[s] = entry.req.max_new_tokens
-            self._slot_blocks[s] = entry.blocks
-            self._slot_nodes[s] = entry.nodes
-            self._slot_resv[s] = entry.resv
-            self._slot_pos[s] = len(entry.req.prompt)
-            self._table[s, :len(entry.blocks)] = entry.blocks
+            self.pool.bind_slot(
+                s, entry.blocks, entry.nodes, entry.resv,
+                len(entry.req.prompt),
+            )
         self.obs.lane_active.set(len(self._prefilling))
 
     def _ensure_decode_blocks(self, window: int, *, advance: bool) -> None:
@@ -1630,30 +1888,23 @@ class ContinuousBatcher:
 
         `advance` mirrors the device's unconditional cache_index
         advance (plain chunks add chunk_steps per dispatch).
-        Speculative rounds pass advance=False: their heads move by
-        the ACCEPTED count, known only at the round's sync, so
-        `_process_spec` advances the mirror instead."""
+        Speculative rounds and device-resident loops pass
+        advance=False: their heads move by the ACCEPTED / actually
+        folded count, known only at the sync, so `_process_spec` /
+        `_step_loop` advance the mirror instead."""
+        pool = self.pool
         for s in range(self.slots):
             req = self._slot_req[s]
             if req is None or req.done:
                 continue
             if not req.truncated:
                 total = len(req.prompt) + req.max_new_tokens
-                end = min(int(self._slot_pos[s]) + window, total)
-                need = -(-end // PAGE_ROWS)
-                while len(self._slot_blocks[s]) < need:
-                    block = self._grab_block()
-                    if block is None:
-                        self._truncate_slot(s)
-                        break
-                    self._slot_blocks[s].append(block)
-                    self._table[s, len(self._slot_blocks[s]) - 1] = block
-                    if self._slot_resv[s] > 0:
-                        self._slot_resv[s] -= 1
-                        self._reserved -= 1
+                end = min(int(pool.slot_pos[s]) + window, total)
+                if not pool.back_slot(s, end):
+                    self._truncate_slot(s)
             if advance:
-                self._slot_pos[s] += window
-        self._set_pool_gauges()
+                pool.slot_pos[s] += window
+        pool.set_gauges()
 
     def _rollback_spec_blocks(self, s: int) -> None:
         """Return a live slot's decode blocks that back ONLY
@@ -1672,17 +1923,13 @@ class ContinuousBatcher:
         req = self._slot_req[s]
         if req is None or req.done or req.truncated:
             return
+        pool = self.pool
         keep = max(
-            -(-int(self._slot_pos[s]) // PAGE_ROWS),
-            len(self._slot_nodes[s]),
+            -(-int(pool.slot_pos[s]) // PAGE_ROWS),
+            len(pool.slot_nodes[s]),
             1,
         )
-        while len(self._slot_blocks[s]) > keep:
-            block = self._slot_blocks[s].pop()
-            self._table[s, len(self._slot_blocks[s])] = 0
-            self._free_blocks.append(block)
-            self._slot_resv[s] += 1
-            self._reserved += 1
+        pool.rollback_unused(s, keep)
 
     def _truncate_slot(self, s: int) -> None:
         """Cap a live slot's budget at what its allocated blocks can
@@ -1692,14 +1939,15 @@ class ContinuousBatcher:
         budget path with reason `pool_overflow` and a truncation mark
         on its completion record."""
         req = self._slot_req[s]
-        cap = len(self._slot_blocks[s]) * PAGE_ROWS - len(req.prompt)
+        pool = self.pool
+        cap = pool.backed_rows(s) - len(req.prompt)
         new_budget = max(0, cap - len(req.tokens))
         if new_budget < self._budget[s]:
             self._budget[s] = new_budget
             req.truncated = True
             # The rest of the worst case will never be grabbed.
-            self._reserved -= int(self._slot_resv[s])
-            self._slot_resv[s] = 0
+            pool.reserved -= int(pool.slot_resv[s])
+            pool.slot_resv[s] = 0
 
     def _commit_tokens(self, s: int, req: _Request, emit, now) -> int:
         """Feed one slot's newly host-visible tokens into its request:
@@ -1854,6 +2102,160 @@ class ContinuousBatcher:
         self._set_pool_gauges()
         self._finish_sync(now, ctx, device_s)
 
+    def _step_loop(self) -> None:
+        """One device-resident loop turn (dispatch AND sync — the
+        fold is synchronous by design: the next turn's admissions,
+        backing, and spec-k all depend on this one's committed
+        counts, and the whole point is ONE host round-trip per
+        `loop_steps` chunks instead of one per chunk).
+
+        Prologue: pre-back every live slot's blocks up to the loop
+        horizon (`loop_steps * chunk_steps` decode rows, or
+        `loop_steps * (k+1)` verify rows) so the loop body never
+        needs the host; upload the per-slot exit inputs (live mask,
+        EOS ids, remaining token budgets, backed-row bounds) beside
+        the table. Sync: replay the surfaced emit buffer and counts
+        through the SAME `_commit_tokens` / controller / registry
+        path the per-chunk dispatches use — streaming records,
+        prefix-trie state, obs counters, and SLO windows see the
+        identical token stream, just delivered at loop-sync
+        granularity."""
+        t_host0 = time.monotonic()
+        pool = self.pool
+        spec = self._spec and self._spec_on
+        k = self._k_now
+        kstep = (k + 1) if spec else self.chunk_steps
+        window = self.loop_steps * kstep
+        # Pre-backing horizon: each live slot needs at most
+        # ceil(min(pos + window, prompt + budget) / 128) blocks; the
+        # budget exit fires before any write past `total`, so backing
+        # is capped there (advance=False — the head mirror advances
+        # by the ACTUAL folded steps at the sync below).
+        self._ensure_decode_blocks(window, advance=False)
+        resident = self._record_kv_snapshot()
+        self.obs.profile.on_dispatch()
+        live_mask = np.array(
+            [r is not None and not r.done for r in self._slot_req],
+            bool,
+        )
+        eos = np.array(
+            [
+                r.eos_id
+                if (r is not None and r.eos_id is not None) else -1
+                for r in self._slot_req
+            ],
+            np.int32,
+        )
+        # Tokens the device may still generate per slot: the live
+        # budget, minus the input-column token a freshly flipped slot
+        # surfaces at position 0 of the emit buffer.
+        owed = np.array(
+            [
+                max(int(self._budget[s]) - int(self._slot_new[s]), 0)
+                if self._slot_req[s] is not None else 0
+                for s in range(self.slots)
+            ],
+            np.int32,
+        )
+        backed = np.array(
+            [pool.backed_rows(s) for s in range(self.slots)], np.int32
+        )
+        snapshot = list(self._slot_req)
+        fresh = list(self._slot_new)
+        self._slot_new = [False] * self.slots
+        busy = int(live_mask.sum())
+        t0 = time.monotonic()
+        dec_table = jnp.asarray(pool.table)
+        args = (
+            jnp.asarray(live_mask), jnp.asarray(eos),
+            jnp.asarray(owed), jnp.asarray(backed),
+        )
+        counts = None
+        if spec:
+            out = self._spec_loop_fn(
+                self.params, self._state, self.draft_params,
+                self._d_cache, dec_table, *args, k=k,
+            )
+            self._state, self._d_cache, buf, rc, t_dev, code = out
+        else:
+            out = self._loop_fn(
+                self.params, self._state, dec_table, *args
+            )
+            self._state, buf, t_dev, code = out
+        ctx = self._attrib_ctx(busy, 0, spec, 0, t_host0, resident)
+        # -- the sync: the ONLY blocked device fetch of the fold -----
+        t_sync0 = time.monotonic()
+        tokens = np.asarray(buf)
+        t_run = int(t_dev)
+        exit_code = int(code)
+        if spec:
+            counts = np.asarray(rc)
+        now = time.monotonic()
+        device_s = now - t_sync0
+        steps = t_run * kstep
+        ctx["steps"] = steps
+        obs = self.obs
+        obs.dispatch_latency.observe(now - t0)
+        n_emitted = 0
+        if spec:
+            for s, req in enumerate(snapshot):
+                if req is None or req.done:
+                    continue
+                total = int(counts[:t_run, s].sum())
+                pool.slot_pos[s] += total
+                emit = (
+                    tokens[s, :1 + total] if fresh[s]
+                    else tokens[s, 1:1 + total]
+                )
+                n_emitted += self._commit_tokens(s, req, emit, now)
+                self._rollback_spec_blocks(s)
+            obs.spec_verify.inc(t_run)
+            obs.spec_draft.inc(t_run * (k + 1))
+            if busy:
+                # Replay the per-round counts through the acceptance
+                # controller and the cb_spec_* counters exactly as if
+                # each folded round had synced on its own.
+                for r in range(t_run):
+                    accepted_r = 0
+                    for s in range(self.slots):
+                        if not live_mask[s]:
+                            continue
+                        c = int(counts[r, s])
+                        obs.spec_emitted.observe(c)
+                        accepted_r += c - 1
+                    obs.spec_rounds.inc(busy)
+                    obs.spec_proposed.inc(k * busy)
+                    obs.spec_accepted.inc(accepted_r)
+                    obs.trace.spec_round(now, k, busy, accepted_r)
+                    self._spec_controller(accepted_r / busy)
+            pool.set_gauges()
+        else:
+            adv = t_run * self.chunk_steps
+            for s, req in enumerate(snapshot):
+                if req is None or req.done:
+                    continue
+                pool.slot_pos[s] += adv
+                emit = (
+                    tokens[s, :1 + adv] if fresh[s]
+                    else tokens[s, 1:1 + adv]
+                )
+                n_emitted += self._commit_tokens(s, req, emit, now)
+        if n_emitted:
+            obs.tokens.inc(n_emitted)
+        self._mark_dispatch(busy, t0, steps)
+        reason = {1: "slot_done", 2: "unbacked"}.get(
+            exit_code, "horizon"
+        )
+        obs.loop_dispatches.inc()
+        obs.loop_chunks.inc(t_run)
+        obs.loop_exits.inc(labels={"reason": reason})
+        self._loop_sync_n += 1
+        self._loop_steps_acc += steps
+        obs.loop_steps_per_sync.set(
+            round(self._loop_steps_acc / self._loop_sync_n, 2)
+        )
+        self._finish_sync(now, ctx, device_s)
+
     def _spec_controller(self, round_accepted: float) -> None:
         """Acceptance-adaptive drafting: EMA the mean accepted drafts
         per live slot per round; when it sits under `spec_min_accept`
@@ -1901,47 +2303,13 @@ class ContinuousBatcher:
         invariant. Shared blocks are never written past the prompt
         prefix (decode starts in the first private block), so the
         in-flight chunk can't touch them."""
-        nodes = self._slot_nodes[s]
-        if nodes:
-            for node in nodes:
-                self._prefix.release(node)
-            self.obs.prefix_cached_tokens.set(self._prefix.cached_tokens)
-        self._free_blocks.extend(self._slot_blocks[s][len(nodes):])
-        self._slot_blocks[s] = []
-        self._slot_nodes[s] = []
-        self._reserved -= int(self._slot_resv[s])
-        self._slot_resv[s] = 0
-        self._table[s, :] = 0
-        self._set_pool_gauges()
+        self.pool.release_slot(s)
 
     def _grab_block(self) -> int | None:
-        """One physical block: the free list first, then LRU eviction
-        of a parked prefix-index block; None only when the pool is
-        truly dry (no free, nothing evictable)."""
-        if self._free_blocks:
-            return self._free_blocks.pop()
-        if self._prefix is not None:
-            block = self._prefix.evict_lru()
-            if block is not None:
-                self.obs.prefix_evictions.inc()
-                self.obs.prefix_cached_tokens.set(
-                    self._prefix.cached_tokens
-                )
-                return block
-        return None
+        return self.pool.grab_block()
 
     def _set_pool_gauges(self) -> None:
-        """Block-pool watermark gauges (paged mode): free/used/parked
-        split plus the low watermark of reclaimable blocks (free +
-        evictable parked) since engine start."""
-        free = len(self._free_blocks)
-        parked = self._parked_count()
-        self.obs.pool_blocks.set(free, labels={"state": "free"})
-        self.obs.pool_blocks.set(parked, labels={"state": "parked"})
-        self.obs.pool_blocks.set(
-            self.pool_blocks - 1 - free - parked, labels={"state": "used"}
-        )
-        self.obs.pool_min_free.set_min(free + parked)
+        self.pool.set_gauges()
 
     def _admit(self) -> None:
         t0 = time.monotonic()
@@ -1988,11 +2356,9 @@ class ContinuousBatcher:
             # Matched refcount-0 nodes are about to be pinned by THIS
             # request: exclude them from the evictable supply.
             matched_parked = sum(1 for n in matched if n.refcount == 0)
-            avail = (
-                len(self._free_blocks) + self._parked_count()
-                - matched_parked - self._reserved
-            )
-            if avail < new_need:
+            if self.pool.available(
+                excluding_parked=matched_parked
+            ) < new_need:
                 return
             self._pending.popleft()
             cached = len(matched) * PAGE_ROWS
@@ -2038,7 +2404,7 @@ class ContinuousBatcher:
                 self.obs.prefix_cached_tokens.set(
                     self._prefix.cached_tokens
                 )
-            self._reserved += entry.resv
+            self.pool.reserved += entry.resv
             self._prefilling.append(entry)
             busy.add(s)
             self.obs.queue_depth.set(len(self._pending))

@@ -200,6 +200,34 @@ CATALOG: tuple[MetricSpec, ...] = (
         attr="spec_disabled",
     ),
     MetricSpec(
+        "cb_loop_dispatches_total", "counter",
+        "Device-resident multi-step loop dispatches (one host sync "
+        "folding up to loop_steps decode chunks or spec rounds)",
+        attr="loop_dispatches",
+    ),
+    MetricSpec(
+        "cb_loop_chunks_total", "counter",
+        "Decode chunks / speculative rounds folded into "
+        "device-resident loop dispatches (fold-depth numerator; "
+        "denominator cb_loop_dispatches_total)",
+        attr="loop_chunks",
+    ),
+    MetricSpec(
+        "cb_loop_exits_total", "counter",
+        "Device-resident loop exits by first-hit condition",
+        # slot_done (EOS or budget) | unbacked (write head would
+        # cross into an unbacked block) | horizon (loop_steps)
+        labels=("reason",),
+        attr="loop_exits",
+    ),
+    MetricSpec(
+        "cb_loop_steps_per_sync", "gauge",
+        "Per-slot device steps surfaced per device-resident loop "
+        "sync, averaged over the run (the host-dispatch amortization "
+        "factor the loop buys)",
+        attr="loop_steps_per_sync",
+    ),
+    MetricSpec(
         "cb_admission_stall_seconds_total", "counter",
         "Cumulative host seconds inside admission work (dense mode: "
         "blocking prefill+admit dispatches; paged: bookkeeping only)",

@@ -518,6 +518,42 @@ def _paged_pallas(q, k_pool, v_pool, table, index, interpret=False):
     return out.reshape(b, kvh, g, steps, d).reshape(b, h, steps, d)
 
 
+def scatter_paged_rows(
+    k_pool: jax.Array, v_pool: jax.Array,
+    k: jax.Array, v: jax.Array,
+    table: jax.Array, index: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Write new K/V rows through a block table into the paged pools.
+
+    k/v: [batch, kv_heads, steps, head_dim] rows for positions
+    index..index+steps-1 of each slot (already rotated if the model
+    uses RoPE — cached keys are stored rotated); k/v_pool:
+    [num_blocks, kv_heads, PAGE_ROWS, head_dim]; table:
+    [batch, max_logical_blocks]. Rows at positions past the table's
+    logical capacity are DROPPED, not clipped: a clipped write would
+    land in the slot's last real block and corrupt committed rows
+    before the same dispatch's kernel reads them (the table-edge
+    invariant `models/lm.py` established for speculative verify
+    windows). The ONE paged write rule the model's unfused decode
+    path and the fused QKV kernel's caller share."""
+    nb, kvh, page, hd = k_pool.shape
+    bsz, _, steps, _ = k.shape
+    nlog = table.shape[1]
+    pos = index[:, None] + jnp.arange(steps)  # [batch, steps]
+    logical = jnp.clip(pos // page, 0, nlog - 1)
+    phys = jnp.take_along_axis(table, logical, axis=1)
+    phys = jnp.where(pos < nlog * page, phys, nb)
+    row = pos % page
+
+    def put(pool, new):
+        rows = new.transpose(0, 2, 1, 3).reshape(bsz * steps, kvh, hd)
+        return pool.at[
+            phys.reshape(-1), :, row.reshape(-1), :
+        ].set(rows.astype(pool.dtype), mode="drop")
+
+    return put(k_pool, k), put(v_pool, v)
+
+
 def paged_decode_attention(
     q: jax.Array,
     k_pool: jax.Array,
@@ -552,3 +588,309 @@ def paged_decode_attention(
         table, index, interpret=interpret,
     )
     return out[:, :, 0] if single else out
+
+
+# -- fused QKV projection + rotary + paged attention -------------------
+#
+# The decode step's remaining HBM bounce: the per-layer QKV projection
+# writes its activations back to HBM, attention reads them again — and
+# between the two, q/k/v round-trip at full width while the weights
+# and cache were each only needed once. The fused kernel folds the
+# projection, the rotary embedding, and the streamed paged attention
+# into ONE Pallas program: x enters VMEM once, the projection weight
+# streams once (its BlockSpec index is constant, so the Mosaic
+# pipeline elides the re-fetch across grid steps), q never touches
+# HBM at all, and the freshly projected K/V rows are both injected
+# into the attention fold IN VMEM (so the kernel sees the new tokens
+# without a prior pool update) and emitted as outputs for the caller
+# to scatter into the pool — the one write the cache semantics
+# require. Per layer per step the HBM traffic is then: weights once,
+# resident cache blocks once, x/o/k_new/v_new rows once — no
+# intermediate activation round-trip.
+
+
+def _rope_tables(
+    index: jax.Array, steps: int, head_dim: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """Full-width cos/sin tables [batch, steps, head_dim] (f32,
+    HF half-split layout — the same angle math as
+    `models/lm.py:apply_rope`) for positions index + 0..steps-1.
+    Computed OUTSIDE the kernel: the tables are tiny and keeping
+    transcendentals off the kernel's VPU keeps the Mosaic lowering
+    simple."""
+    pos = (
+        index.astype(jnp.float32)[:, None]
+        + jnp.arange(steps, dtype=jnp.float32)[None]
+    )
+    inv_freq = 1.0 / (
+        theta ** (
+            jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+        )
+    )
+    angles = pos[..., None] * inv_freq  # [batch, steps, head_dim/2]
+    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)
+    sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)
+    return cos, sin
+
+
+def _rotate(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Apply precomputed rotary tables (HF half-split): pairs
+    dimension i with i + head_dim/2, f32 math, result in x's dtype."""
+    h = x.shape[-1] // 2
+    rotated = jnp.concatenate([-x[..., h:], x[..., :h]], axis=-1)
+    return (
+        x.astype(jnp.float32) * cos + rotated.astype(jnp.float32) * sin
+    ).astype(x.dtype)
+
+
+def fused_qkv_paged_reference(
+    x: jax.Array, w_qkv: jax.Array, b_qkv: jax.Array | None,
+    k_pool: jax.Array, v_pool: jax.Array,
+    table: jax.Array, index: jax.Array,
+    *, num_heads: int, rope_theta: float | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """XLA reference for the fused path: the exact unfused composition
+    (projection -> split/transpose -> rotary -> pool scatter ->
+    gather-reference paged attention), so interpret-mode CI can pin
+    the fusion against it. Returns (o, k_new, v_new) like the fused
+    kernel — o computed against pools that already contain the new
+    rows."""
+    nb, kvh, page, hd = k_pool.shape
+    bsz, steps, _ = x.shape
+    d = num_heads * hd
+    qkv = jnp.dot(x, w_qkv)
+    if b_qkv is not None:
+        qkv = qkv + b_qkv
+    q = qkv[..., :d].reshape(
+        bsz, steps, num_heads, hd
+    ).transpose(0, 2, 1, 3)
+    k = qkv[..., d:d + kvh * hd].reshape(
+        bsz, steps, kvh, hd
+    ).transpose(0, 2, 1, 3)
+    v = qkv[..., d + kvh * hd:].reshape(
+        bsz, steps, kvh, hd
+    ).transpose(0, 2, 1, 3)
+    if rope_theta is not None:
+        cos, sin = _rope_tables(index, steps, hd, rope_theta)
+        q = _rotate(q, cos[:, None], sin[:, None])
+        k = _rotate(k, cos[:, None], sin[:, None])
+    kp, vp = scatter_paged_rows(k_pool, v_pool, k, v, table, index)
+    o = paged_decode_attention_reference(q, kp, vp, table, index)
+    return o, k, v
+
+
+def _fused_stream_kernel(
+    kvh, g, steps, rope, idx_ref, nblk_ref, tbl_ref,
+    x_ref, w_ref, b_ref, cos_ref, sin_ref, k_ref, v_ref,
+    o_ref, ko_ref, vo_ref,
+    q_scr, kn_scr, vn_scr, m_ref, l_ref, acc_ref,
+):
+    """One (slot, logical-cache-block) grid step of the fused kernel.
+
+    At j == 0 the slot's QKV projection runs on-chip (one MXU dot
+    over the streamed-once weight), rotary applies from the
+    prefetched cos/sin tables, q parks in VMEM scratch for the whole
+    stream, and the fresh K/V rows land in scratch + the k_new/v_new
+    outputs. Every grid step then streams one pool block, INJECTS the
+    fresh rows into the VMEM tile wherever this slot's write
+    positions fall inside the block (the pool itself is only updated
+    by the caller, after the kernel), and runs the shared
+    `_stream_fold`. `tbl_ref` is consumed by the BlockSpec index
+    maps, not the body."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    hd = k_ref.shape[-1]
+    s_blk = k_ref.shape[2]
+    h = kvh * g
+    gs = g * steps
+    d = h * hd
+
+    @pl.when(j == 0)
+    def _project():
+        xv = x_ref[0]  # [steps, d_model]
+        qkv = jax.lax.dot_general(
+            xv, w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        qkv = (qkv + b_ref[0]).astype(xv.dtype)
+        q = qkv[:, :d].reshape(steps, h, hd)
+        kx = qkv[:, d:d + kvh * hd].reshape(steps, kvh, hd)
+        vx = qkv[:, d + kvh * hd:].reshape(steps, kvh, hd)
+        if rope:
+            cos = cos_ref[0][:, None]  # [steps, 1, head_dim]
+            sin = sin_ref[0][:, None]
+            q = _rotate(q, cos, sin)
+            kx = _rotate(kx, cos, sin)
+        # (kv-head, group, step) row order — the layout the shared
+        # fold's block-diagonal mask assumes.
+        q_scr[...] = q.transpose(1, 0, 2).reshape(h * steps, hd)
+        kn = kx.transpose(1, 0, 2)  # [kvh, steps, head_dim]
+        vn = vx.transpose(1, 0, 2)
+        kn_scr[...] = kn.astype(kn_scr.dtype)
+        vn_scr[...] = vn.astype(vn_scr.dtype)
+        ko_ref[...] = kn[None].astype(ko_ref.dtype)
+        vo_ref[...] = vn[None].astype(vo_ref.dtype)
+
+    # Inject this slot's fresh rows into the streamed tile: write
+    # position idx + t falls in this block iff its in-block row
+    # idx + t - j*128 lands in [0, 128) — no row matches otherwise,
+    # so the unrolled select is a no-op for blocks the write window
+    # doesn't touch. Shared blocks streamed by OTHER slots are never
+    # injected (their write positions map elsewhere), preserving the
+    # immutability of shared prefix blocks.
+    kf = k_ref[0]  # [kvh, s_blk, head_dim]
+    vf = v_ref[0]
+    knv = kn_scr[...]
+    vnv = vn_scr[...]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (1, s_blk, 1), 1)
+    for t in range(steps):
+        hit = row_ids == idx_ref[i] + t - j * s_blk
+        kf = jnp.where(hit, knv[:, t][:, None, :], kf)
+        vf = jnp.where(hit, vnv[:, t][:, None, :], vf)
+    _stream_fold(
+        j, nblk_ref[i] - 1, lambda: idx_ref[i], kvh, gs, steps,
+        q_scr, kf[None], vf[None], o_ref, m_ref, l_ref, acc_ref,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_heads", "rope", "interpret")
+)
+def _fused_qkv_pallas(
+    x, w, b2, cos, sin, k_pool, v_pool, table, index,
+    num_heads, rope, interpret=False,
+):
+    """x: [b, steps, d_model]; w: [d_model, d_model + 2*kv_dim]; b2:
+    [1, dout] f32 (zeros when the model is bias-free); cos/sin:
+    [b, steps, head_dim] f32; pools/table/index as the paged kernel."""
+    nb, kvh, s_blk, hd = k_pool.shape
+    bsz, steps, dm = x.shape
+    dout = w.shape[1]
+    g = num_heads // kvh
+    gs = g * steps
+    nlog = table.shape[1]
+    rows = kvh * gs
+    idx_arr = index.astype(jnp.int32)
+    nblk_arr = jnp.minimum(
+        (idx_arr + steps - 1) // s_blk + 1, nlog
+    ).astype(jnp.int32)
+    tbl_arr = table.astype(jnp.int32).reshape(-1)
+    pool_spec = pl.BlockSpec(
+        (1, kvh, s_blk, hd),
+        lambda i, j, idx, nb_, tb: (
+            tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0, 0
+        ),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(bsz, nlog),
+        in_specs=[
+            pl.BlockSpec(
+                (1, steps, dm), lambda i, j, idx, nb_, tb: (i, 0, 0)
+            ),
+            # Constant index: the weight streams to VMEM once and the
+            # pipeline elides every later fetch (revisiting).
+            pl.BlockSpec(
+                (dm, dout), lambda i, j, idx, nb_, tb: (0, 0)
+            ),
+            pl.BlockSpec(
+                (1, dout), lambda i, j, idx, nb_, tb: (0, 0)
+            ),
+            pl.BlockSpec(
+                (1, steps, hd), lambda i, j, idx, nb_, tb: (i, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, steps, hd), lambda i, j, idx, nb_, tb: (i, 0, 0)
+            ),
+            pool_spec,
+            pool_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, kvh, gs, hd), lambda i, j, idx, nb_, tb: (i, 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, kvh, steps, hd),
+                lambda i, j, idx, nb_, tb: (i, 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, kvh, steps, hd),
+                lambda i, j, idx, nb_, tb: (i, 0, 0, 0),
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, hd), x.dtype),            # q rows
+            pltpu.VMEM((kvh, steps, hd), k_pool.dtype),  # fresh K
+            pltpu.VMEM((kvh, steps, hd), k_pool.dtype),  # fresh V
+            pltpu.VMEM((rows, 128), jnp.float32),        # running max
+            pltpu.VMEM((rows, 128), jnp.float32),        # running sum
+            pltpu.VMEM((rows, hd), jnp.float32),         # running acc
+        ],
+    )
+    o, kn, vn = pl.pallas_call(
+        functools.partial(_fused_stream_kernel, kvh, g, steps, rope),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bsz, kvh, gs, hd), x.dtype),
+            jax.ShapeDtypeStruct((bsz, kvh, steps, hd), k_pool.dtype),
+            jax.ShapeDtypeStruct((bsz, kvh, steps, hd), k_pool.dtype),
+        ],
+        interpret=interpret,
+    )(idx_arr, nblk_arr, tbl_arr, x, w, b2, cos, sin, k_pool, v_pool)
+    o = o.reshape(bsz, kvh, g, steps, hd).reshape(
+        bsz, num_heads, steps, hd
+    )
+    return o, kn, vn
+
+
+def fused_qkv_paged_attention(
+    x: jax.Array,
+    w_qkv: jax.Array,
+    b_qkv: jax.Array | None,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    table: jax.Array,
+    index: jax.Array,
+    *,
+    num_heads: int,
+    rope_theta: float | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused QKV projection + rotary + streamed paged decode attention.
+
+    x: [batch, steps, d_model] normed hidden states (steps <=
+    MAX_KERNEL_STEPS); w_qkv: [d_model, d_model + 2*kv_dim] the fused
+    projection weight ([q | k | v] channel blocks, kv_dim = kv_heads *
+    head_dim inferred from the pool); b_qkv: [dout] or None; pools/
+    table/index as `paged_decode_attention`. Returns (o [batch,
+    num_heads, steps, head_dim], k_new, v_new [batch, kv_heads, steps,
+    head_dim]): o already attends to the fresh rows (injected in
+    VMEM), and the CALLER must scatter k_new/v_new into the pool
+    (`scatter_paged_rows`) — the one HBM write the cache requires.
+    Uses the fused Pallas kernel on TPU (or interpret mode via the
+    argument / WALKAI_DECODE_INTERPRET=1); falls back to the
+    gather-reference composition otherwise, same pattern as
+    `paged_decode_attention`."""
+    if interpret is None:
+        interpret = os.environ.get("WALKAI_DECODE_INTERPRET") == "1"
+        if not interpret and jax.default_backend() != "tpu":
+            return fused_qkv_paged_reference(
+                x, w_qkv, b_qkv, k_pool, v_pool, table, index,
+                num_heads=num_heads, rope_theta=rope_theta,
+            )
+    nb, kvh, s_blk, hd = k_pool.shape
+    bsz, steps, _ = x.shape
+    dout = w_qkv.shape[1]
+    if rope_theta is not None:
+        cos, sin = _rope_tables(index, steps, hd, rope_theta)
+    else:
+        cos = jnp.ones((bsz, steps, hd), jnp.float32)
+        sin = jnp.zeros((bsz, steps, hd), jnp.float32)
+    b2 = (
+        b_qkv if b_qkv is not None else jnp.zeros((dout,), x.dtype)
+    ).reshape(1, dout).astype(jnp.float32)
+    return _fused_qkv_pallas(
+        x, w_qkv, b2, cos, sin, k_pool, v_pool, table, index,
+        num_heads=num_heads, rope=rope_theta is not None,
+        interpret=interpret,
+    )
