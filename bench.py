@@ -601,6 +601,38 @@ def cb_serving_benchmark() -> dict:
     return out
 
 
+def router_benchmark() -> dict:
+    """Fleet router + slice autoscaler through the traffic-replay
+    harness (`walkai_nos_tpu/sim/trafficbench.py`): a deterministic
+    diurnal + flash-crowd trace over a Zipf template distribution is
+    replayed through a 2-replica prefix-affinity fleet (one spare
+    slice held by the autoscaler's provider), and again through a
+    round-robin fleet for the hit-rate comparison. Headline keys:
+    `router_ttft_p99_under_surge` (p99 TTFT of requests arriving
+    inside the flash-crowd window — lower-better, absent_ok band in
+    BASELINE.json), `router_prefix_hit_rate` (fleet-level
+    prefix-cache hit rate, gated >= 0.5 like the single-engine key it
+    aggregates; `router_rr_prefix_hit_rate` rides along as the
+    baseline arm), and `router_scale_events_total` (reconciler
+    actions during the replay)."""
+    from walkai_nos_tpu.router.autoscale import ScalePolicy
+    from walkai_nos_tpu.sim.trafficbench import run_traffic_benchmark
+
+    r = run_traffic_benchmark(
+        n_replicas=2,
+        spare_replicas=1,
+        requests=96,
+        templates=8,
+        ticks=48,
+        slots=4,
+        scale_policy=ScalePolicy(
+            up_saturation=0.6, breach_ticks=3,
+            idle_ticks=12, cooldown_ticks=16,
+        ),
+    )
+    return r.bench_keys()
+
+
 def obs_overhead_benchmark() -> dict:
     """Telemetry overhead gate: the same engine-direct workload with
     the obs subsystem enabled vs disabled
@@ -634,6 +666,10 @@ def main() -> None:
     except Exception as e:
         err = (err + "; " if err else "") + f"obs-overhead: {e}"
     try:
+        result.update(router_benchmark())
+    except Exception as e:
+        err = (err + "; " if err else "") + f"router: {e}"
+    try:
         result.update(scheduling_benchmark())
     except Exception as e:
         err = (err + "; " if err else "") + f"scheduling: {e}"
@@ -658,6 +694,8 @@ def main() -> None:
             "cb_slo_ttft_p99", "cb_saturation",
             "cb_spec_capacity_tokens_per_s",
             "cb_spec_accepted_per_round", "obs_overhead_pct",
+            "router_ttft_p99_under_surge", "router_prefix_hit_rate",
+            "router_scale_events_total",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )
         if k in result

@@ -32,8 +32,9 @@ Endpoints:
   monotonic_s} + {device_kind, peak_bf16_flops,
   model_ceiling_images_per_s, fence_rtt_s} for utilization measurement.
 - GET  /healthz -> readiness payload: {"ok": true, "engine": {alive,
-  queue_depth, seconds_since_last_dispatch, has_work, slots} | null}
-  (engine block present when continuous batching is enabled).
+  queue_depth, seconds_since_last_dispatch, has_work, draining,
+  slots} | null} (engine block present when continuous batching is
+  enabled).
 - GET  /metrics -> Prometheus text exposition of the obs registry
   (serving-engine dispatch/TTFT/TPOT/pool telemetry; see
   docs/observability.md for every exported name).
@@ -185,6 +186,11 @@ def engine_health(engine, alive: bool) -> dict | None:
             None if age is None else round(age, 3)
         ),
         "has_work": engine.has_work,
+        # Drain lifecycle: True once drain() was called; together with
+        # has_work=False it means "fully drained" — what the fleet
+        # router's scale-down reconciler polls before returning the
+        # slice.
+        "draining": getattr(engine, "draining", False),
         "slots": engine.slots,
         "saturation": (
             None if saturation is None else round(saturation, 4)
@@ -575,27 +581,14 @@ def main() -> None:
                 obs=obs,
             )
             # Compile prefill + chunk step (and, with loop_steps > 1,
-            # the device-resident loop program) off the request path —
-            # a single admission first (the steady-state P=1 lane
-            # width), then bursts of 2, 4, ... up to the usable lane
-            # count so EVERY pow2 lane-width signature compiles NOW:
-            # the first concurrent admissions otherwise stall the
-            # driver for seconds of XLA compile mid-traffic (measured
-            # ~6 s on a CPU dev box — long enough to zero a short
-            # capacity probe's window).
-            cb_engine.submit([1], max_new_tokens=min(2, lm_max_new))
-            cb_engine.run()
-            widest = min(
-                cb_slots, getattr(cb_engine, "prefill_lanes", 1)
-            )
-            p = 2
-            while p <= widest:
-                for _ in range(p):
-                    cb_engine.submit(
-                        [1], max_new_tokens=min(2, lm_max_new)
-                    )
-                cb_engine.run()
-                p *= 2
+            # the device-resident loop program) off the request path:
+            # the engine's own pow2 admission-burst discipline, so
+            # every lane-width signature compiles NOW instead of
+            # stalling the driver for seconds of XLA compile on the
+            # first concurrent admissions mid-traffic (measured ~6 s
+            # on a CPU dev box — long enough to zero a short capacity
+            # probe's window).
+            cb_engine.warm(max_new_tokens=min(2, lm_max_new))
             cb_queue = queue.Queue()
             cb_waiters: dict[int, dict] = {}
             cb_enabled[0] = True

@@ -20,33 +20,45 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compilation cache for the test session: the suite
+# Persistent XLA compilation cache for the test suite: the suite
 # builds hundreds of tiny-model jit programs, and most are IDENTICAL
 # HLO (every ContinuousBatcher instance traces its own closures, so
 # the in-process jit cache never dedupes them — measured ~7.7 s per
-# cold engine build vs ~1.3 s warm). A per-session temp dir keeps the
-# speedup within one run with zero cross-run staleness risk; the cache
-# key includes the HLO fingerprint + compile options + jaxlib version,
-# so hits are exact. WALKAI_TEST_NO_COMPILE_CACHE=1 disables (e.g. to
-# time true cold compiles).
+# cold engine build vs ~1.3 s warm). The cache dir is a FIXED
+# REPO-LOCAL path (gitignored `.xla_cache/`; was a per-session temp
+# dir): entries are content-addressed — the key includes the HLO
+# fingerprint, compile options, and the jaxlib version — so
+# cross-run reuse is exact-by-construction, and a warm dir takes the
+# tier-1 lane's XLA time out of its 870 s budget instead of
+# re-paying it every run (a fully cold run no longer fits the
+# budget). Repo-local rather than /tmp because the checkout persists
+# exactly as long as the test surface it caches for, and a
+# world-shared /tmp path created by one user leaves every other
+# user's cache WRITES failing EACCES — silently degrading them back
+# to cold compiles. Staleness cannot occur (a changed program is a
+# different key; a changed jaxlib misses); a stray corrupt entry is
+# self-healing (delete the dir). WALKAI_TEST_NO_COMPILE_CACHE=1
+# disables (e.g. to time true cold compiles).
 if os.environ.get("WALKAI_TEST_NO_COMPILE_CACHE") != "1":
-    import atexit as _atexit
-    import shutil as _shutil
-    import tempfile as _tempfile
-
-    _jax_cache_dir = _tempfile.mkdtemp(prefix="walkai-xla-cache-")
-    # Session-scoped on purpose; reap it at interpreter exit (spawned
-    # demo servers are dead by then) so runs don't accumulate cache
-    # dirs under /tmp.
-    _atexit.register(
-        _shutil.rmtree, _jax_cache_dir, ignore_errors=True
+    _jax_cache_dir = os.environ.get(
+        "WALKAI_TEST_COMPILE_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), "..", ".xla_cache"),
     )
+    os.makedirs(_jax_cache_dir, exist_ok=True)
     # Spawned subprocesses (the demo-server tests) inherit the same
-    # session cache through the env var jax reads natively, so each
-    # server spawn stops recompiling the full serving program set.
+    # cache through the env var jax reads natively, so each server
+    # spawn stops recompiling the full serving program set.
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _jax_cache_dir)
     jax.config.update("jax_compilation_cache_dir", _jax_cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        # Bound the persistent dir (LRU eviction): min_compile_time 0
+        # caches every program, and a jaxlib upgrade or config change
+        # orphans all prior keys — without a cap the dir grows without
+        # bound across runs (a full suite writes ~100 MB).
+        jax.config.update("jax_compilation_cache_max_size", 2 * 1024**3)
+    except AttributeError:  # older jaxlib: no cap flag, accept growth
+        pass
     try:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except AttributeError:  # older jaxlib: flag absent, default is 0

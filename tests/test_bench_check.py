@@ -236,6 +236,51 @@ class TestCheckLogic:
         )
         assert len(failures) == 1
 
+    def test_repo_baseline_gates_router_keys(self):
+        """BASELINE.json carries the fleet router's two headline keys
+        as absent_ok specs and they PARSE through the comparator:
+        `router_ttft_p99_under_surge` is a lower-is-better band (the
+        surge-window serving quality the autoscaler defends),
+        `router_prefix_hit_rate` a >= 0.5 acceptance floor (fleet
+        sharing must not degrade below the single-engine floor).
+        Absent from the bench output is a skip note; a value past its
+        band fails once emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        surge = published["router_ttft_p99_under_surge"]
+        assert surge["direction"] == "lower"
+        assert surge["absent_ok"] is True
+        assert surge["value"] > 0
+        rate = published["router_prefix_hit_rate"]
+        assert rate["direction"] == "higher"
+        assert rate["tolerance"] == 0.0
+        assert rate["absent_ok"] is True
+        assert rate["value"] >= 0.5
+        keys = (
+            "router_ttft_p99_under_surge", "router_prefix_hit_rate",
+        )
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert sum("absent" in n for n in notes) == 2
+        ceiling = surge["value"] * (1 + surge["tolerance"])
+        failures, _ = bench_check.check(
+            {"router_ttft_p99_under_surge": ceiling * 0.9,
+             "router_prefix_hit_rate": 0.8},
+            base,
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"router_ttft_p99_under_surge": ceiling * 1.1,
+             "router_prefix_hit_rate": 0.2},
+            base,
+        )
+        assert len(failures) == 2
+        assert any(
+            "router_ttft_p99_under_surge" in f for f in failures
+        )
+        assert any("router_prefix_hit_rate" in f for f in failures)
+
     def test_repo_baseline_activates_roofline_gate(self):
         """The device-resident-loop PR activates the long-deferred
         decode_gqa_roofline_fraction gate: an absent_ok acceptance

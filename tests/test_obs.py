@@ -260,6 +260,21 @@ class TestRegistry:
         g.set_min(9)
         assert g.value() == 3
 
+    def test_gauge_remove_drops_one_series(self):
+        """remove() drops a label set from exposition entirely — the
+        retired-fleet-member shape, where the last value would export
+        a dead member as live and 0 would read as 'observed idle'."""
+        reg = Registry()
+        g = reg.gauge("members", "t")
+        g.set(0.7, labels={"replica": "a"})
+        g.set(0.2, labels={"replica": "b"})
+        g.remove(labels={"replica": "a"})
+        assert g.value(labels={"replica": "a"}) is None
+        assert g.value(labels={"replica": "b"}) == 0.2
+        assert 'members{replica="a"}' not in reg.render()
+        assert 'members{replica="b"} 0.2' in reg.render()
+        g.remove(labels={"replica": "a"})  # absent: no-op
+
 
 class TestProfileHook:
     def _patched(self, monkeypatch):
@@ -627,6 +642,9 @@ class TestHealthzPayload:
             "queue_depth": 5,
             "seconds_since_last_dispatch": 0.123,
             "has_work": True,
+            # Drain lifecycle bit (Stub predates drain(): getattr
+            # default False keeps old engines readable).
+            "draining": False,
             "slots": 8,
             # Scale signals for kube probes/autoscalers: the composed
             # saturation and windowed SLO compliance ride /healthz so

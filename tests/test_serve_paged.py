@@ -220,10 +220,14 @@ class TestPrefixReuse:
         st = cold.prefix_stats()
         assert st["enabled"] is False and st["cached_blocks"] == 0
 
+    @pytest.mark.slow
     def test_sampled_seeds_on_shared_prefix(self, params):
         """Two sampled requests sharing a cached prefix but carrying
         different seeds must each match their own cold-start output:
-        sharing K/V must not couple PRNG streams."""
+        sharing K/V must not couple PRNG streams. Slow lane (~13 s,
+        three cold-start reference runs): greedy shared-prefix parity
+        incl. park-reuse stays tier-1 in
+        test_shared_prefix_parity_and_park_reuse."""
         p = _prompt(280, seed=90)
         outs = {}
         for prefix_cache in (True, False):

@@ -182,7 +182,15 @@ class TestSpecTableEdge:
     dispatch's kernel reads them — the final committed tokens come out
     of corrupted attention and parity silently breaks."""
 
-    @pytest.mark.parametrize("draft_kind", ["self", "tiny"])
+    # Tier-1 keeps only the "tiny" arm: the untrained draft's
+    # single-token walks are the arm that actually failed pre-fix
+    # (CHANGES PR 5); the self-draft arm re-proves the same drop rule
+    # from the full-acceptance side at ~12 s — slow-lane coverage,
+    # not a distinct regression pin.
+    @pytest.mark.parametrize(
+        "draft_kind",
+        [pytest.param("self", marks=pytest.mark.slow), "tiny"],
+    )
     def test_parity_at_table_capacity(
         self, params, tiny_draft, draft_kind
     ):
@@ -333,10 +341,13 @@ class TestSpecController:
         # verify dispatches than the 60 tokens would need at 1/round.
         assert st["verify_dispatches"] < 30
 
+    @pytest.mark.slow
     def test_keeps_drafting_when_acceptance_earns(self, params):
         """Draft = target at the DEFAULT acceptance threshold: the
         EMA sits at k, so the controller must leave drafting on well
-        past the warmup."""
+        past the warmup. Slow lane (~14 s): the regression-critical
+        controller direction — disable under zero acceptance — stays
+        tier-1 in test_disables_drafting_under_zero_acceptance."""
         engine = ContinuousBatcher(
             CFG, params, slots=1, cache_len=384, chunk_steps=4,
             prefill_chunk=32, spec=True, spec_k=3, draft_cfg=CFG,
@@ -400,6 +411,7 @@ class TestSpecValidation:
         rid = engine.submit(_prompt(300, seed=3), max_new_tokens=209)
         assert isinstance(rid, int)
 
+    @pytest.mark.slow
     def test_lookahead_guard_relaxes_after_disable(
         self, params, tiny_draft
     ):
@@ -407,7 +419,11 @@ class TestSpecValidation:
         off no verify window ever runs again, so the submit guard —
         gated on the LIVE controller state — must go back to
         admitting requests right up to cache_len, exactly like
-        spec-off serving."""
+        spec-off serving. Slow lane (~29 s, the file's heaviest: it
+        must first DRIVE the controller to disable, then serve to
+        cache_len): the guard's reject side stays tier-1 in
+        test_submit_lookahead_guard, and the disable walk itself in
+        test_disables_drafting_under_zero_acceptance."""
         engine = _spec_engine(
             params, tiny_draft, slots=1, cache_len=512,
             spec_min_accept=0.9, spec_warmup_rounds=2,

@@ -1,0 +1,454 @@
+"""serverouter: the fleet-router front-end binary (ROADMAP item 4).
+
+One process that owns a fleet of serving replicas behind a single
+HTTP surface — the piece that finally puts inference traffic through
+the partitioner-adjacent serving stack as a FLEET instead of a single
+engine:
+
+- **HTTP replica mode** (`--replica URL`, repeatable): each URL is a
+  demo-server pod (`demos/tpu-sharing-comparison/app/main.py`) on its
+  own TPU slice; the router fronts them over their existing
+  `/generate` + `/healthz` + `/stats` endpoints
+  (`router/replica.HttpReplica`) — the real-deployment shape.
+- **In-process mode** (`--inproc N`): N tiny `ContinuousBatcher`
+  replicas in this process — CI, demos, and single-host smoke runs;
+  `--spares K` keeps K warmed standbys in a `RespawningSliceProvider`
+  so the autoscaling reconciler can admit them under load (released
+  standbys are rebuilt during the idle window that triggered the
+  scale-down, so capacity never ratchets away).
+
+Endpoints (the router's own, on `--port`):
+
+- `POST /generate`  {"prompt": [...], "max_new_tokens"?, "eos_id"?,
+  "temperature"?, "top_k"?, "top_p"?, "seed"?} -> the routed
+  replica's tokens + timing + which replica served it. Routing is
+  prefix-affinity with a power-of-two-choices load fallback
+  (`router/core.py`, docs/serving-router.md).
+- `GET /healthz` -> {"ok": bool, "fleet": ...} — the driver thread's
+  latest `router.stats()` snapshot: replica membership/drain
+  lifecycle, per-replica scale signals, fleet prefix hit rate,
+  scale-event tallies.
+- `GET /metrics` -> Prometheus exposition of the ROUTER registry
+  (the `router_*` series; each replica keeps serving its own `cb_*`
+  on its own port).
+
+A single driver thread owns the fleet (the same one-owner discipline
+as the demo server's cb_driver): it drains submissions, steps every
+replica, ticks the autoscaling reconciler, and fulfils waiters — so
+the router needs no locking around engine state, and reconcile ticks
+keep flowing while idle (that's when scale-DOWN happens).
+
+Env knobs (in-process mode): WALKAI_ROUTER_LM_MODEL (tiny|small,
+default tiny), WALKAI_ROUTER_SLOTS (default 4), WALKAI_ROUTER_VOCAB /
+WALKAI_ROUTER_SEQ (test seams, like the demo server's WALKAI_LM_*).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from walkai_nos_tpu.obs.router import RouterObs
+from walkai_nos_tpu.router.autoscale import ScalePolicy
+from walkai_nos_tpu.router.core import FleetRouter
+from walkai_nos_tpu.router.replica import HttpReplica
+
+logger = logging.getLogger("serverouter")
+
+
+class RespawningSliceProvider:
+    """The long-running binary's provider: up to `spares` warmed
+    standby replicas, REBUILT after release. A drained engine is
+    one-way (it can never serve again), so the static CI provider
+    would ratchet a diurnal fleet down to min_replicas for good —
+    every idle-period scale-down permanently eating one slice of
+    capacity. Instead, `release()` builds and warms a fresh standby
+    from the factory right away: release fires when the fleet is
+    IDLE by definition (that's what triggered the drain), so the
+    standby's XLA warm-up lands in the idle window, not in the surge
+    that later acquires it."""
+
+    def __init__(self, factory, spares: int):
+        self._factory = factory
+        self._cap = spares
+        self._seq = 0
+        self._pool = [self._build() for _ in range(spares)]
+
+    def _build(self):
+        replica = self._factory(f"spare{self._seq}")
+        self._seq += 1
+        replica.warm()
+        return replica
+
+    def acquire(self):
+        return self._pool.pop(0) if self._pool else None
+
+    def release(self, replica) -> None:
+        # The retired replica is dropped, not retained: a drained
+        # engine can never serve again, and holding it would leak one
+        # full KV-cache pool per diurnal scale-down cycle in a
+        # long-running process.
+        if len(self._pool) < self._cap:
+            self._pool.append(self._build())
+
+
+def build_inproc_replicas(n: int, *, slots: int | None = None):
+    """N in-process engine replicas sharing one tiny weight set (the
+    CI / smoke shape; a production fleet uses HTTP replicas on real
+    slices). Imports jax lazily so `--help` and the HTTP-mode path
+    never pay for it."""
+    import jax
+
+    from walkai_nos_tpu.models.lm import LM_SMALL, LM_TINY, DecoderLM
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+    from walkai_nos_tpu.router.replica import EngineReplica
+
+    cfg = (
+        LM_SMALL
+        if os.environ.get("WALKAI_ROUTER_LM_MODEL") == "small"
+        else LM_TINY
+    )
+    if os.environ.get("WALKAI_ROUTER_VOCAB") or os.environ.get(
+        "WALKAI_ROUTER_SEQ"
+    ):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg,
+            vocab_size=int(
+                os.environ.get("WALKAI_ROUTER_VOCAB")
+                or cfg.vocab_size
+            ),
+            max_seq_len=int(
+                os.environ.get("WALKAI_ROUTER_SEQ") or cfg.max_seq_len
+            ),
+        )
+    slots = slots or int(os.environ.get("WALKAI_ROUTER_SLOTS", "4"))
+    params = jax.device_put(
+        DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+    )
+
+    def factory(name: str):
+        return EngineReplica(
+            ContinuousBatcher(cfg, params, slots=slots),
+            name=name,
+        )
+
+    return cfg, factory
+
+
+class RouterDriver:
+    """The one thread that owns the fleet: submissions in, finished
+    records out, one `router.step()` per turn (replica advance +
+    reconciler tick) — idle turns still tick, on a short timeout, so
+    scale-down proceeds when traffic stops."""
+
+    def __init__(self, router: FleetRouter, *, idle_tick_s: float = 0.05):
+        self.router = router
+        self.alive = True
+        self._idle_tick_s = idle_tick_s
+        self._queue: queue.Queue = queue.Queue()
+        self._waiters: dict[int, dict] = {}
+        self._stop = threading.Event()
+        # Fleet-stats snapshot, refreshed by the driver thread each
+        # turn and swapped in whole: HTTP handler threads read THIS,
+        # never router.stats() directly — the router is single-
+        # driver-threaded (a concurrent stats() would race the
+        # reconciler's retire() over the handle list and, in HTTP
+        # mode, run synchronous health probes on the handler thread).
+        self._fleet_stats = router.stats()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="router-driver"
+        )
+        self._thread.start()
+
+    def fleet_stats(self) -> dict:
+        """The driver's latest whole-snapshot of `router.stats()` —
+        at most one idle tick stale, safe from any thread."""
+        return self._fleet_stats
+
+    def submit(self, prompt, max_new_tokens, knobs: dict) -> dict:
+        holder = {"done": threading.Event()}
+        self._queue.put((prompt, max_new_tokens, knobs, holder))
+        return holder
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _fail(
+        self, holder: dict, error: str, *, client: bool = False
+    ) -> None:
+        """`client=True` marks a request the CALLER got wrong (bad
+        knobs, oversize prompt — a 400); everything else (fleet
+        empty, driver death, replica failure) is a server-side 503 a
+        client should retry."""
+        holder["error"] = error
+        holder["client_error"] = client
+        holder["tokens"] = None
+        holder["done"].set()
+
+    def _loop(self) -> None:
+        router = self.router
+        try:
+            while not self._stop.is_set():
+                # Spin only while some replica needs step() to make
+                # progress (in-process engines). HTTP replicas' work
+                # advances remotely — their records arrive via worker
+                # threads and are collected on the timeout tick, so a
+                # pure-HTTP fleet must NOT busy-loop for the length of
+                # every remote generation.
+                stepping = any(
+                    getattr(r, "steps_locally", True) and r.has_work
+                    for r in router.replicas
+                )
+                try:
+                    item = self._queue.get(
+                        block=not stepping,
+                        timeout=self._idle_tick_s,
+                    )
+                    while True:
+                        prompt, max_new, knobs, holder = item
+                        try:
+                            rid = router.submit(
+                                prompt, max_new_tokens=max_new,
+                                **knobs,
+                            )
+                        except ValueError as bad:
+                            # Replica-side validation: the CALLER's
+                            # error — fail that request with a 400.
+                            self._fail(holder, str(bad), client=True)
+                        except RuntimeError as unplaced:
+                            # Fleet-side condition (no active
+                            # replica mid-scale-in): retryable 503.
+                            self._fail(holder, str(unplaced))
+                        else:
+                            self._waiters[rid] = holder
+                        item = self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                router.step()
+                for rid, rec in router.drain_done_records().items():
+                    waiter = self._waiters.pop(rid, None)
+                    if waiter is None:
+                        continue
+                    waiter.update(rec)
+                    waiter["done"].set()
+                self._fleet_stats = router.stats()
+        except Exception as e:  # noqa: BLE001 — fleet-driver death
+            self.alive = False
+            logger.exception("router driver failed: %r", e)
+            for holder in self._waiters.values():
+                self._fail(holder, "router driver failed")
+            self._waiters.clear()
+            while True:
+                try:
+                    *_, holder = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._fail(holder, "router driver failed")
+
+
+def make_handler(driver: RouterDriver, obs: RouterObs):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):  # noqa: N802 (http.server API)
+            if self.path != "/generate":
+                self.send_error(404)
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                prompt = body.get("prompt")
+                if not isinstance(prompt, list) or not prompt:
+                    raise ValueError("prompt must be a non-empty list")
+                max_new = int(body.get("max_new_tokens", 16))
+                knobs = {}
+                for key, cast in (
+                    ("eos_id", int), ("temperature", float),
+                    ("top_k", int), ("top_p", float), ("seed", int),
+                ):
+                    if body.get(key) is not None:
+                        knobs[key] = cast(body[key])
+            except (TypeError, ValueError) as e:
+                self.send_error(400, str(e))
+                return
+            t0 = time.perf_counter()
+            holder = driver.submit(prompt, max_new, knobs)
+            while not holder["done"].wait(timeout=1.0):
+                if not driver.alive:
+                    self.send_error(503, "router driver failed; retry")
+                    return
+                if time.perf_counter() - t0 > 120.0:
+                    self.send_error(503, "generation timed out")
+                    return
+            if holder.get("tokens") is None:
+                # Only CALLER mistakes are 400s; capacity and
+                # failure conditions are retryable 503s (a remote
+                # replica's error record has no client_error mark).
+                self.send_error(
+                    400 if holder.get("client_error") else 503,
+                    holder.get("error") or "generation failed",
+                )
+                return
+            self._json(200, {
+                "tokens": holder["tokens"],
+                "ttft_seconds": round(holder.get("ttft_s") or 0.0, 6),
+                "engine_wall_seconds": round(
+                    holder.get("wall_s") or 0.0, 6
+                ),
+                "replica": holder.get("replica"),
+                "truncated": holder.get("truncated", False),
+            })
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path == "/healthz":
+                self._json(200, {
+                    "ok": driver.alive,
+                    "fleet": driver.fleet_stats(),
+                })
+            elif self.path == "/metrics":
+                data = obs.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self.send_error(404)
+
+        def _json(self, code: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    return Handler
+
+
+class RouterServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 128
+
+
+def build(args) -> tuple[RouterDriver, RouterObs]:
+    """Fleet + driver from parsed args — the testable seam `main`
+    and the tier-1 wiring test share."""
+    obs = RouterObs(
+        enabled=os.environ.get("WALKAI_OBS", "1") == "1"
+    )
+    if args.replica:
+        replicas = [HttpReplica(url) for url in args.replica]
+        router = FleetRouter(replicas, obs=obs)
+    else:
+        policy = ScalePolicy(
+            min_replicas=(
+                1 if args.min_replicas is None else args.min_replicas
+            ),
+            max_replicas=(
+                8 if args.max_replicas is None else args.max_replicas
+            ),
+        )
+        _, factory = build_inproc_replicas(args.inproc)
+        replicas = [factory(f"r{i}") for i in range(args.inproc)]
+        # Warm every engine before traffic: a cold engine pays its
+        # XLA compiles on the first concurrent admissions,
+        # mid-traffic. The provider warms its own standbys the same
+        # way (and respawns them on release, so idle-period
+        # scale-downs don't permanently eat fleet capacity).
+        for replica in replicas:
+            replica.warm()
+        provider = (
+            RespawningSliceProvider(factory, args.spares)
+            if args.spares > 0 else None
+        )
+        router = FleetRouter(
+            replicas, provider=provider, scale_policy=policy, obs=obs,
+        )
+    return RouterDriver(router), obs
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        description="fleet router front-end over serving replicas"
+    )
+    parser.add_argument(
+        "--port", type=int,
+        default=int(os.environ.get("PORT", "8090")),
+    )
+    parser.add_argument(
+        "--replica", action="append", default=[],
+        help="HTTP replica base URL (repeatable); omit for --inproc",
+    )
+    parser.add_argument(
+        "--inproc", type=int, default=2,
+        help="in-process replica count when no --replica is given",
+    )
+    parser.add_argument(
+        "--spares", type=int, default=0,
+        help="extra in-process replicas held by the autoscaler "
+             "(in-process mode only)",
+    )
+    parser.add_argument(
+        "--min-replicas", type=int, default=None,
+        help="autoscaler floor, default 1 (in-process mode only)",
+    )
+    parser.add_argument(
+        "--max-replicas", type=int, default=None,
+        help="autoscaler ceiling, default 8 (in-process mode only)",
+    )
+    args = parser.parse_args(argv)
+    if args.replica and (
+        args.spares > 0
+        or args.min_replicas is not None
+        or args.max_replicas is not None
+    ):
+        # HTTP mode has no slice provider (remote pods own their
+        # lifecycle): silently ignoring an autoscaling flag would
+        # read as autoscaling-enabled.
+        parser.error(
+            "--spares/--min-replicas/--max-replicas require "
+            "in-process mode (no --replica)"
+        )
+    return args
+
+
+def main(argv=None) -> None:
+    from walkai_nos_tpu.cmd import _common
+
+    _common.setup_logging(os.environ.get("LOG_LEVEL", "info"))
+    args = parse_args(argv)
+    driver, obs = build(args)
+    server = RouterServer(
+        ("0.0.0.0", args.port), make_handler(driver, obs)
+    )
+    logger.info(
+        "serverouter on :%d fronting %d replica(s)",
+        args.port, len(driver.router.replicas),
+    )
+    threading.Thread(
+        target=server.serve_forever, daemon=True, name="router-http"
+    ).start()
+    _common.wait_for_shutdown().wait()
+    server.shutdown()
+    driver.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -412,6 +412,12 @@ class ContinuousBatcher:
         self._spec_ema: float | None = None
         self._spec_rounds_seen = 0
         self._requests: dict[int, _Request] = {}
+        # Graceful-drain seam (the router's scale-down primitive):
+        # once drain() flips this, submit() rejects with the
+        # `draining` taxonomy reason while everything already accepted
+        # — queued, prefilling, or resident — runs to completion;
+        # `has_work` going False afterwards means fully drained.
+        self._draining = False
         # O(1) admission pops under load (was a list popped from the
         # front — O(n) per admission).
         self._pending: deque[_Request] = deque()
@@ -1009,9 +1015,19 @@ class ContinuousBatcher:
 
         Rejections raise ValueError AND land in the labeled
         `cb_request_errors_total` counter (reason: bad_request |
-        oversize_reject | pool_overflow), so a production engine's
-        reject mix is visible on /metrics, not only in per-request
-        error strings."""
+        oversize_reject | pool_overflow | draining), so a production
+        engine's reject mix is visible on /metrics, not only in
+        per-request error strings."""
+        if self._draining:
+            # Drain-mode gate FIRST: a draining engine must reject
+            # every new request for the same reason regardless of its
+            # shape — the router (or any front-end) reads this as
+            # "stop routing here", not as a client error.
+            raise self._reject(
+                "draining",
+                "engine is draining: new requests are not accepted "
+                "(resident work runs to completion)",
+            )
         if not temperature >= 0.0:  # NaN-proof: NaN fails >= too
             raise self._reject(
                 "bad_request",
@@ -1209,6 +1225,46 @@ class ContinuousBatcher:
             or self._prefilling
             or self._inflight is not None
         )
+
+    def warm(self, max_new_tokens: int = 2) -> None:
+        """Compile the serving programs OFF the request path: one
+        admission burst per pow2 lane width (1, 2, 4, ... up to
+        min(slots, prefill_lanes)), each run to completion, so every
+        lane-width signature compiles before traffic — the first
+        CONCURRENT admissions otherwise stall the driver for seconds
+        of XLA compile mid-traffic (measured ~6 s on a CPU dev box).
+        THE one warm-up discipline; the demo server and the fleet
+        router's replica adapters both call it. Warm-up prompts are
+        single tokens (no full 128-row block), so prefix-cache
+        tallies stay untouched."""
+        width = 1
+        widest = min(self.slots, self.prefill_lanes)
+        while width <= widest:
+            for _ in range(width):
+                self.submit([1], max_new_tokens=max_new_tokens)
+            self.run()
+            width *= 2
+
+    def drain(self) -> None:
+        """Enter drain mode: reject every further `submit()` with the
+        `draining` error-taxonomy reason while everything already
+        accepted — queued, prefilling, and resident slots — runs to
+        completion through the normal step path. Idempotent and
+        one-way for the engine's lifetime (a drained engine is about
+        to be retired; re-opening would race its owner's teardown).
+        `has_work` going False after a drain() means fully drained —
+        the signal `/healthz` surfaces and the fleet router's
+        scale-down reconciler polls before returning the slice."""
+        if self._draining:
+            return
+        self._draining = True
+        self.obs.trace.event("drain", time.monotonic())
+
+    @property
+    def draining(self) -> bool:
+        """True once drain() has been called (the `/healthz` engine
+        block's drain-lifecycle bit)."""
+        return self._draining
 
     def drain_done(self) -> dict[int, list[int]]:
         """Pop and return every finished request's tokens (for callers

@@ -18,6 +18,9 @@
   other).
 - `obs.serving` — `ServingObs`, the bundle `models/serve.py` and the
   demo server consume.
+- `obs.router` — `RouterObs`, the fleet router's bundle
+  (`walkai_nos_tpu/router`, `cmd/serverouter.py`): the `router_*`
+  series built from the same catalog.
 
 See docs/observability.md for the exported-metric reference and the
 trace/profile how-to.
@@ -35,6 +38,7 @@ from walkai_nos_tpu.obs.metrics import (  # noqa: F401
     log_buckets,
 )
 from walkai_nos_tpu.obs.profile import ProfileHook  # noqa: F401
+from walkai_nos_tpu.obs.router import RouterObs  # noqa: F401
 from walkai_nos_tpu.obs.serving import ServingObs  # noqa: F401
 from walkai_nos_tpu.obs.slo import BucketRing, SloTracker  # noqa: F401
 from walkai_nos_tpu.obs.trace import RequestTrace, Ring  # noqa: F401

@@ -24,7 +24,7 @@ from dataclasses import dataclass
 
 from walkai_nos_tpu.obs.metrics import log_buckets
 
-__all__ = ["CATALOG", "MetricSpec", "serving_specs"]
+__all__ = ["CATALOG", "MetricSpec", "router_specs", "serving_specs"]
 
 
 @dataclass(frozen=True)
@@ -33,9 +33,9 @@ class MetricSpec:
     kind: str  # counter | gauge | histogram
     help: str
     labels: tuple[str, ...] = ()
-    component: str = "serving"  # serving | kube | install | client
+    component: str = "serving"  # serving | router | kube | install | client
     buckets: tuple[float, ...] | None = None
-    attr: str = ""  # ServingObs attribute name (serving specs only)
+    attr: str = ""  # bundle attribute name (serving/router specs only)
 
 
 # Sub-ms floor for decode-pace style latencies (TPOT on a fast chip is
@@ -61,7 +61,7 @@ CATALOG: tuple[MetricSpec, ...] = (
     MetricSpec(
         "cb_request_errors_total", "counter",
         "Failed or rejected requests by reason",
-        # oversize_reject | pool_overflow | bad_request |
+        # oversize_reject | pool_overflow | bad_request | draining |
         # generation_timeout | client_disconnect | engine_failure
         labels=("reason",),
         attr="errors",
@@ -408,6 +408,70 @@ CATALOG: tuple[MetricSpec, ...] = (
         labels=("signal",),
         attr="saturation_component",
     ),
+    # -- fleet router (walkai_nos_tpu/router via obs/router.py) --------
+    MetricSpec(
+        "router_requests_total", "counter",
+        "Requests accepted and routed by the fleet router",
+        component="router",
+        attr="submitted",
+    ),
+    MetricSpec(
+        "router_routed_total", "counter",
+        "Routing decisions by policy arm",
+        # affinity (prefix-affinity map hit) | p2c (power-of-two-
+        # choices fallback) | round_robin (baseline policy)
+        labels=("policy",),
+        component="router",
+        attr="routed",
+    ),
+    MetricSpec(
+        "router_requests_failed_total", "counter",
+        "Requests the router could not place, by reason",
+        # no_replica (fleet empty or all draining) | bad_request
+        # (replica-side submit validation rejected it)
+        labels=("reason",),
+        component="router",
+        attr="failed",
+    ),
+    MetricSpec(
+        "router_replicas", "gauge",
+        "Fleet replicas by lifecycle state",
+        labels=("state",),  # active | draining
+        component="router",
+        attr="replicas_gauge",
+    ),
+    MetricSpec(
+        "router_replica_saturation", "gauge",
+        "Last observed composed saturation per replica (the engine's "
+        "cb_saturation, read through the replica interface)",
+        labels=("replica",),
+        component="router",
+        attr="replica_saturation",
+    ),
+    MetricSpec(
+        "router_queue_depth", "gauge",
+        "Requests submitted but not yet admitted, summed over the "
+        "fleet's replicas",
+        component="router",
+        attr="queue_depth",
+    ),
+    MetricSpec(
+        "router_prefix_hit_rate", "gauge",
+        "Fleet-level shared-prefix block hit rate: prefix-cache hits "
+        "over lookupable blocks summed across every replica that ever "
+        "served (retired replicas' tallies included)",
+        component="router",
+        attr="prefix_hit_rate",
+    ),
+    MetricSpec(
+        "router_scale_events_total", "counter",
+        "Autoscaling reconciler actions by direction",
+        # up (slice acquired, replica joined) | down (drain initiated)
+        # | denied (scale-up wanted, provider had no capacity)
+        labels=("direction",),
+        component="router",
+        attr="scale_events",
+    ),
     # -- kube binaries (kube/runtime.py via health.Metrics) ------------
     MetricSpec(
         "nos_reconcile_total", "counter",
@@ -472,13 +536,23 @@ def serving_specs() -> tuple[MetricSpec, ...]:
     return tuple(s for s in CATALOG if s.component == "serving")
 
 
+def router_specs() -> tuple[MetricSpec, ...]:
+    return tuple(s for s in CATALOG if s.component == "router")
+
+
 def _check() -> None:
     names = [s.name for s in CATALOG]
     if len(names) != len(set(names)):
         raise ValueError("duplicate metric names in CATALOG")
-    attrs = [s.attr for s in serving_specs()]
-    if "" in attrs or len(attrs) != len(set(attrs)):
-        raise ValueError("serving specs need unique non-empty attrs")
+    for component, specs in (
+        ("serving", serving_specs()),
+        ("router", router_specs()),
+    ):
+        attrs = [s.attr for s in specs]
+        if "" in attrs or len(attrs) != len(set(attrs)):
+            raise ValueError(
+                f"{component} specs need unique non-empty attrs"
+            )
 
 
 _check()

@@ -177,6 +177,15 @@ class Gauge(_Metric):
             v = self._series.get(self._key(labels))
             return None if v is None else float(v)
 
+    def remove(self, labels: dict | None = None) -> None:
+        """Drop one label set's series entirely, so exposition stops
+        exporting it. For gauges whose label values name transient
+        members (a fleet replica that was retired): keeping the last
+        value exports a dead member as live forever, and 0 would read
+        as 'observed idle', not 'gone'."""
+        with self._lock:
+            self._series.pop(self._key(labels), None)
+
 
 class _HistState:
     __slots__ = ("counts", "total", "sum")

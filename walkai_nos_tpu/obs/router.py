@@ -1,0 +1,44 @@
+"""RouterObs: the fleet router's telemetry bundle.
+
+The router-level sibling of `obs/serving.ServingObs`: one registry
+plus one instrument attribute per `component="router"` catalog spec
+(`obs.submitted.inc()`, `obs.scale_events.inc(labels=...)`, ...),
+built through the same instruments-from-catalog path
+(`bind_catalog_instruments`), so the router contains no literal
+metric names and `make metrics-lint` holds `obs/catalog.py` and
+`docs/observability.md` to each other for the `router_*` series
+exactly as it does for `cb_*`.
+
+The router runs in its own process (cmd/serverouter.py) in a real
+deployment — its registry is separate from any replica's by design;
+`cmd/serverouter.py` serves it on the router's own `/metrics`. In CI
+the in-process fleet shares the process with its engines but still
+keeps the registries apart: fleet-level series aggregate across
+replicas, per-engine series stay per-engine.
+
+`enabled=False` builds the bundle in no-op mode, same contract as the
+serving bundle (reads return zeros/None; views flag `obs_disabled`).
+"""
+
+from __future__ import annotations
+
+from walkai_nos_tpu.obs.catalog import router_specs
+from walkai_nos_tpu.obs.metrics import Registry
+from walkai_nos_tpu.obs.serving import bind_catalog_instruments
+
+__all__ = ["RouterObs"]
+
+
+class RouterObs:
+    def __init__(
+        self,
+        *,
+        enabled: bool = True,
+        registry: Registry | None = None,
+    ):
+        self.enabled = enabled
+        self.registry = registry or Registry(enabled=enabled)
+        bind_catalog_instruments(self, router_specs(), self.registry)
+
+    def render(self) -> str:
+        return self.registry.render()

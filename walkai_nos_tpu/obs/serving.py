@@ -27,7 +27,26 @@ from walkai_nos_tpu.obs.metrics import Registry
 from walkai_nos_tpu.obs.profile import ProfileHook
 from walkai_nos_tpu.obs.trace import RequestTrace
 
-__all__ = ["ServingObs"]
+__all__ = ["ServingObs", "bind_catalog_instruments"]
+
+
+def bind_catalog_instruments(target, specs, registry: Registry) -> None:
+    """Build one registry instrument per catalog spec and set it as an
+    attribute on `target` (spec.attr). The ONE instruments-from-catalog
+    path every obs bundle uses (`ServingObs`, `obs/router.RouterObs`):
+    bundles contain no literal metric names, so a name that isn't in
+    `obs/catalog.py` doesn't exist and `make metrics-lint` can hold the
+    catalog and the docs to each other."""
+    for spec in specs:
+        if spec.kind == "counter":
+            inst = registry.counter(spec.name, spec.help)
+        elif spec.kind == "gauge":
+            inst = registry.gauge(spec.name, spec.help)
+        else:
+            inst = registry.histogram(
+                spec.name, spec.help, buckets=spec.buckets
+            )
+        setattr(target, spec.attr, inst)
 
 
 class ServingObs:
@@ -57,16 +76,7 @@ class ServingObs:
             # telemetry-disabled engine (or bias the overhead A/B's
             # disabled arm).
             self.profile = ProfileHook()
-        for spec in serving_specs():
-            if spec.kind == "counter":
-                inst = self.registry.counter(spec.name, spec.help)
-            elif spec.kind == "gauge":
-                inst = self.registry.gauge(spec.name, spec.help)
-            else:
-                inst = self.registry.histogram(
-                    spec.name, spec.help, buckets=spec.buckets
-                )
-            setattr(self, spec.attr, inst)
+        bind_catalog_instruments(self, serving_specs(), self.registry)
 
     def render(self) -> str:
         return self.registry.render()

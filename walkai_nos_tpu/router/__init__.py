@@ -1,0 +1,34 @@
+"""Fleet router + slice autoscaler: multi-engine serving over the
+partitioner control plane (ROADMAP item 4).
+
+- `router.core` — `FleetRouter`: prefix-affinity routing (first
+  128-token block hashed to the replica whose radix trie holds it)
+  with a power-of-two-choices load fallback, behind a single-engine-
+  shaped `submit()`/`step()`/`drain_done_records()` surface.
+- `router.replica` — `EngineReplica` (in-process `ContinuousBatcher`,
+  CI and single host) and `HttpReplica` (remote demo-server pod) —
+  one interface, two deployment shapes.
+- `router.autoscale` — the reconciler (hysteresis + cooldown over
+  `cb_saturation`/`slo_ok`/queue depth; drain-then-release
+  scale-down) and its slice providers (`StaticSliceProvider`,
+  `PartitionerSliceProvider` through
+  `partitioning/partitioner.py`).
+
+Front-end binary: `cmd/serverouter.py`. Traffic-replay harness:
+`sim/trafficbench.py`. Metrics: the `router_*` series in
+`obs/catalog.py` (docs/serving-router.md has the routing policy and
+the scale state machine).
+"""
+
+from walkai_nos_tpu.router.autoscale import (  # noqa: F401
+    PartitionerSliceProvider,
+    Reconciler,
+    ScalePolicy,
+    StaticSliceProvider,
+    replica_load,
+)
+from walkai_nos_tpu.router.core import FleetRouter, prefix_key  # noqa: F401
+from walkai_nos_tpu.router.replica import (  # noqa: F401
+    EngineReplica,
+    HttpReplica,
+)

@@ -1,0 +1,334 @@
+"""Slice autoscaling for the fleet router: reconciler + providers.
+
+The reconciler closes ROADMAP item 4's loop: the serving engines
+already export the exact scale signals an autoscaler needs
+(`cb_saturation`, windowed `slo_ok`, queue depth — PR 7's SLO layer),
+and the partitioner control plane already knows how to carve a TPU
+slice on demand (`partitioning/partitioner.py`); this module is the
+piece in between. Each `tick()`:
+
+1. **completes drains** — a draining replica whose `has_work` went
+   False is retired from the fleet and its slice returned to the
+   provider (records were already collected by the router's step
+   loop, so retirement drops zero requests);
+2. **reads fleet pressure** — a tick is *pressured* when any active
+   replica's windowed SLO is measurably breached (`slo_ok is False`,
+   i.e. p99 TTFT over its objective) or the mean load (saturation,
+   with a queue-based fallback before the first dispatch) crosses
+   `up_saturation`; it is *idle* when every load sits under
+   `down_saturation` with empty queues;
+3. **applies hysteresis + cooldown** — pressure must hold for
+   `breach_ticks` CONSECUTIVE ticks before a scale-up, idleness for
+   `idle_ticks` before a scale-down, and any scale event opens a
+   `cooldown_ticks` window during which no further event fires — so
+   a flapping load (breach, recover, breach again inside the window)
+   produces exactly one scale-up and one scale-down instead of
+   thrashing partitioner plans.
+
+Scale-up asks the provider for a slice-backed replica and admits it
+to the fleet (power-of-two-choices routing favors it immediately —
+it is the least-loaded candidate). Scale-down picks the
+least-loaded active replica and calls its `drain()` (the engine
+seam: new submits reject, resident slots finish); the router stops
+routing to it the same tick, and step 1 retires it once empty.
+
+Providers:
+
+- **`StaticSliceProvider`** — hands out pre-built replicas from a
+  fixed pool (CI, the traffic-replay harness, single-host demos).
+- **`PartitionerSliceProvider`** — the control-plane path: each
+  acquire adds one slice profile to a labeled node's desired
+  partitioning and writes it through `Partitioner.apply_partitioning`
+  (spec-tpu-* annotations + a fresh plan id — the identical write the
+  k8s pod controller performs, which the node's tpuagent actuates
+  and its device plugin advertises), then builds the serving replica
+  for that slice via the injected `engine_factory`. Release removes
+  the slice from the plan and re-applies. Capacity is the node's ICI
+  mesh chip count (from its topology label) divided by the profile's
+  chips.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PartitionerSliceProvider",
+    "Reconciler",
+    "ScalePolicy",
+    "StaticSliceProvider",
+    "replica_load",
+]
+
+
+def replica_load(replica) -> float:
+    """Normalized [0, 1] load of one replica: the engine's composed
+    saturation when it has refreshed, else a queue-pressure fallback
+    (the same queue/(2*slots) normalization the saturation signal
+    itself uses) so a replica that never dispatched reads as idle,
+    not unknown. A replica whose health probe FAILED (HttpReplica
+    `unreachable`) reads as maximum load: its empty signals would
+    otherwise score a dead pod 0.0 — the fleet's most attractive
+    routing target."""
+    if getattr(replica, "unreachable", False):
+        return 1.0
+    sat = replica.saturation
+    if sat is not None:
+        return float(sat)
+    slots = max(1, getattr(replica, "slots", 1))
+    return min(1.0, replica.queue_depth / (2.0 * slots))
+
+
+@dataclass
+class ScalePolicy:
+    """Thresholds + hysteresis for the reconciler, in reconcile ticks
+    (one tick per router step): deliberately unitless so the same
+    policy drives a real-time serving loop and a deterministic
+    scripted test."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    up_saturation: float = 0.85   # mean active load triggering pressure
+    down_saturation: float = 0.30  # max active load counting as idle
+    breach_ticks: int = 3          # consecutive pressured ticks -> up
+    idle_ticks: int = 8            # consecutive idle ticks -> down
+    cooldown_ticks: int = 20       # no further event inside this window
+
+
+class Reconciler:
+    """The scale state machine. `tick(fleet)` is called once per
+    router step with the fleet facade (`FleetRouter` or any object
+    exposing `active_handles()` / `draining_handles()` /
+    `add_replica()` / `retire()`); all state lives here, so a
+    scripted saturation trace through fake replicas exercises the
+    hysteresis exactly as production load does."""
+
+    def __init__(self, provider, policy: ScalePolicy | None = None,
+                 obs=None):
+        self._provider = provider
+        self.policy = policy or ScalePolicy()
+        self._obs = obs
+        self._tick = 0
+        self._over = 0
+        self._under = 0
+        self._cooldown_until = 0
+
+    # -- signals -------------------------------------------------------
+
+    def _pressured(self, active) -> bool:
+        if not active:
+            return True  # traffic with zero active replicas IS pressure
+        if any(h.replica.slo_ok is False for h in active):
+            return True
+        loads = [replica_load(h.replica) for h in active]
+        return sum(loads) / len(loads) >= self.policy.up_saturation
+
+    def _idle(self, active) -> bool:
+        if not active:
+            return False
+        return all(
+            replica_load(h.replica) <= self.policy.down_saturation
+            and h.replica.queue_depth == 0
+            for h in active
+        )
+
+    def _event(self, direction: str) -> None:
+        self._cooldown_until = self._tick + self.policy.cooldown_ticks
+        self._over = 0
+        self._under = 0
+        if self._obs is not None:
+            self._obs.scale_events.inc(
+                labels={"direction": direction}
+            )
+
+    # -- the loop ------------------------------------------------------
+
+    def tick(self, fleet) -> None:
+        self._tick += 1
+        # 1. Complete drains: retirement drops nothing — the router's
+        # step loop already collected every record, and has_work False
+        # means queue, lanes, slots, and in-flight chunks are all
+        # empty.
+        for handle in list(fleet.draining_handles()):
+            if not handle.replica.has_work:
+                fleet.retire(handle)
+                self._provider.release(handle.replica)
+                logger.info(
+                    "router: replica %s drained and released",
+                    handle.name,
+                )
+        active = fleet.active_handles()
+        # 2. Consecutive-tick hysteresis counters.
+        pressured = self._pressured(active)
+        self._over = self._over + 1 if pressured else 0
+        self._under = self._under + 1 if self._idle(active) else 0
+        if self._tick < self._cooldown_until:
+            return
+        # 3a. Scale up.
+        if (
+            self._over >= self.policy.breach_ticks
+            and len(active) < self.policy.max_replicas
+        ):
+            replica = self._provider.acquire()
+            if replica is None:
+                # No capacity: note it, re-accumulate a full breach
+                # window before asking again (a dry provider must not
+                # be hammered every tick).
+                self._over = 0
+                if self._obs is not None:
+                    self._obs.scale_events.inc(
+                        labels={"direction": "denied"}
+                    )
+                return
+            fleet.add_replica(replica)
+            self._event("up")
+            logger.info(
+                "router: scale-up admitted replica %s", replica.name
+            )
+            return
+        # 3b. Scale down: drain the least-loaded active replica.
+        if (
+            self._under >= self.policy.idle_ticks
+            and len(active) > self.policy.min_replicas
+        ):
+            victim = min(
+                active, key=lambda h: replica_load(h.replica)
+            )
+            fleet.start_drain(victim)
+            self._event("down")
+            logger.info(
+                "router: scale-down draining replica %s", victim.name
+            )
+
+
+class StaticSliceProvider:
+    """Pre-built replicas handed out in order — the CI / harness
+    provider. Released replicas are NOT recycled (a drained engine's
+    drain is one-way); they land in `released` for assertions."""
+
+    def __init__(self, replicas=()):
+        self._pool = list(replicas)
+        self.released: list = []
+
+    def acquire(self):
+        return self._pool.pop(0) if self._pool else None
+
+    def release(self, replica) -> None:
+        self.released.append(replica)
+
+
+class PartitionerSliceProvider:
+    """Slices through the partitioner control plane.
+
+    `acquire()` finds a node with free mesh capacity, adds one
+    `profile` slice to its desired partitioning, writes the plan with
+    `Partitioner.apply_partitioning` (spec annotations + plan id on
+    the Node object — the write the tpuagent actuates), and returns
+    `engine_factory(slice_name)`. `release()` reverses the geometry
+    delta and re-applies. The provider owns exactly ONE spec entry —
+    its (mesh_index, profile) pair — and every write MERGES that
+    entry into the node's current spec annotations before applying:
+    `apply_partitioning` rewrites the whole spec-annotation set, so a
+    plan built from the provider's own count alone would wipe
+    pod-controller-managed slices (and other meshes' geometry) off
+    any node the two writers share.
+    """
+
+    def __init__(
+        self,
+        kube,
+        node_names,
+        *,
+        engine_factory,
+        profile: str = "1x1",
+        mesh_index: int = 0,
+    ):
+        from walkai_nos_tpu.partitioning.partitioner import Partitioner
+        from walkai_nos_tpu.tpu.tiling.profile import Profile
+
+        self._kube = kube
+        self._partitioner = Partitioner(kube)
+        self._nodes = list(node_names)
+        self._factory = engine_factory
+        self.profile = profile
+        self._mesh_index = mesh_index
+        self._chips = Profile.parse(profile).chips
+        self._count: dict[str, int] = {n: 0 for n in self._nodes}
+        self._node_of: dict[int, str] = {}  # id(replica) -> node
+        self._seq = 0
+        self.plan_ids: list[str] = []
+
+    def _capacity(self, node_name: str) -> int:
+        from walkai_nos_tpu.api import constants
+        from walkai_nos_tpu.kube import objects
+        from walkai_nos_tpu.tpu import topology
+
+        node = self._kube.get("Node", node_name)
+        label = objects.labels(node).get(
+            constants.LABEL_TPU_TOPOLOGY, "2x4"
+        )
+        return topology.shape_chip_count(
+            topology.parse_shape(label)
+        ) // self._chips
+
+    def _apply(self, node_name: str) -> str:
+        from walkai_nos_tpu.kube import objects
+        from walkai_nos_tpu.partitioning.state import (
+            MeshPartitioning,
+            NodePartitioning,
+        )
+        from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+
+        node = self._kube.get("Node", node_name)
+        # Merge-then-write: the node's current spec annotations are the
+        # base plan; only this provider's (mesh, profile) entry is
+        # replaced by its tracked count (or dropped at zero). Everything
+        # another writer put there rides through the rewrite untouched.
+        _, spec = parse_node_annotations(objects.annotations(node))
+        per_mesh: dict[int, dict[str, int]] = {}
+        for ann in spec:
+            per_mesh.setdefault(ann.mesh_index, {})[ann.profile] = (
+                ann.quantity
+            )
+        mesh = per_mesh.setdefault(self._mesh_index, {})
+        if self._count[node_name]:
+            mesh[self.profile] = self._count[node_name]
+        else:
+            mesh.pop(self.profile, None)
+        plan_id = self._partitioner.apply_partitioning(
+            node,
+            NodePartitioning(
+                name=node_name,
+                meshes=tuple(
+                    MeshPartitioning.of(idx, geometry)
+                    for idx, geometry in sorted(per_mesh.items())
+                ),
+            ),
+        )
+        self.plan_ids.append(plan_id)
+        return plan_id
+
+    def acquire(self):
+        for node_name in self._nodes:
+            if self._count[node_name] >= self._capacity(node_name):
+                continue
+            self._count[node_name] += 1
+            self._apply(node_name)
+            slice_name = (
+                f"{node_name}/{self.profile}-{self._seq}"
+            )
+            self._seq += 1
+            replica = self._factory(slice_name)
+            self._node_of[id(replica)] = node_name
+            return replica
+        return None
+
+    def release(self, replica) -> None:
+        node_name = self._node_of.pop(id(replica), None)
+        if node_name is None:
+            return
+        self._count[node_name] = max(0, self._count[node_name] - 1)
+        self._apply(node_name)

@@ -1,0 +1,396 @@
+"""FleetRouter: prefix-affinity request routing over N engine replicas.
+
+The front-end that finally makes the repo's two halves serve traffic
+TOGETHER (ROADMAP item 4): a fleet of `ContinuousBatcher` replicas —
+one per TPU slice the partitioner carved — behind one `submit()`/
+`step()`/`drain_done_records()` surface shaped exactly like a single
+engine's, so every existing driver loop (the demo server's, the
+bench's, the traffic harness's) can front a fleet unchanged.
+
+Routing is CACHE-AWARE, LOAD-BOUNDED — the radix-affinity insight of
+SGLang-style routers, grounded in this repo's own prefix cache:
+
+- **Prefix-affinity**: the routing key is a hash of the prompt's
+  FIRST 128-token block (`PAGE_ROWS` — the radix trie's own block
+  granularity: the smallest unit `models/prefix_cache.py` can share).
+  Same-template traffic therefore carries the same key, and the
+  affinity map steers it to the replica whose trie already holds the
+  template's blocks: the fleet-level win is that each template's
+  prefix is prefilled ONCE PER FLEET instead of once per replica,
+  which is what `router_prefix_hit_rate` (the fleet-aggregated
+  `cb_prefix_hit_rate`) measures. Prompts shorter than one block have
+  nothing shareable and skip straight to load balancing.
+- **Power-of-two-choices fallback**: affinity never overloads a hot
+  replica — when the affinity target's load (engine saturation, with
+  a queue fallback) is at or past `affinity_overload`, the router
+  samples two candidates, takes the less loaded (Mitzenmacher's d=2
+  bound: near-best-of-all balance at O(1) probes), and migrates the
+  template there ONLY if that destination is at least
+  `affinity_imbalance` less loaded than the target (a uniformly
+  saturated fleet gains nothing from moving and would pay a cold
+  prefill per migration; a sampled pair hotter than the target must
+  never inherit the stream). On migration the template's affinity
+  RE-POINTS, so the overflow replica warms the template's blocks
+  once and inherits the stream. Unknown keys route through the same
+  two-choice sample.
+- **Draining replicas receive nothing**: the candidate set is the
+  non-draining fleet, checked per request — the scale-down
+  invariant the reconciler's drain lifecycle relies on.
+
+`policy="round_robin"` disables the affinity map (pure rotation) —
+the baseline arm the traffic harness compares the hit rate against.
+
+The router is single-driver-threaded like the engine itself: one
+thread calls `submit()`/`step()`; `step()` advances every replica one
+turn, ticks the autoscaling reconciler (`router/autoscale.py`), and
+collects finished records fleet-wide (records survive replica
+retirement — they are pulled every step, BEFORE a drained replica is
+released). Scale signals and fleet telemetry flow through
+`obs/router.RouterObs` (`router_*` catalog series).
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+
+import numpy as np
+
+from walkai_nos_tpu.obs.router import RouterObs
+from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
+from walkai_nos_tpu.router.autoscale import Reconciler, replica_load
+
+__all__ = ["FleetRouter", "prefix_key"]
+
+
+def prefix_key(prompt) -> int | None:
+    """Routing key: CRC-32 of the prompt's first full 128-token block
+    (PAGE_ROWS — the prefix trie's share granularity), None when the
+    prompt has no full block to share. Stable across processes (no
+    PYTHONHASHSEED dependence), so a router restart re-derives the
+    same template keys."""
+    prompt = np.asarray(prompt).reshape(-1)
+    if len(prompt) < PAGE_ROWS:
+        return None
+    return zlib.crc32(
+        prompt[:PAGE_ROWS].astype(np.int64).tobytes()
+    )
+
+
+class _Handle:
+    """One fleet member: the replica plus the router's bookkeeping
+    (request count, the final prefix tallies captured at retirement)."""
+
+    def __init__(self, replica, name: str):
+        self.replica = replica
+        self.name = name
+        self.routed = 0
+
+    def prefix_tallies(self) -> tuple[int, int]:
+        stats = self.replica.prefix_stats() or {}
+        return (
+            int(stats.get("block_hits") or 0),
+            int(stats.get("block_hits") or 0)
+            + int(stats.get("block_misses") or 0),
+        )
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        replicas=(),
+        *,
+        provider=None,
+        scale_policy=None,
+        policy: str = "affinity",
+        affinity_overload: float = 0.9,
+        affinity_imbalance: float = 0.25,
+        seed: int = 0,
+        obs: RouterObs | bool = True,
+    ):
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"policy must be 'affinity' or 'round_robin'; "
+                f"got {policy!r}"
+            )
+        self.policy = policy
+        self.affinity_overload = affinity_overload
+        self.affinity_imbalance = affinity_imbalance
+        if isinstance(obs, RouterObs):
+            self.obs = obs
+        else:
+            self.obs = RouterObs(enabled=bool(obs))
+        self._rng = random.Random(seed)
+        self._handles: list[_Handle] = []
+        self._seq = 0
+        for replica in replicas:
+            self.add_replica(replica)
+        # template key -> handle (affinity map); entries for retired
+        # handles are dropped lazily at lookup.
+        self._affinity: dict[int, _Handle] = {}
+        self._rr_next = 0
+        self._next_rid = 0
+        # router rid -> (handle, local rid); completed records land in
+        # _done keyed by router rid.
+        self._routes: dict[int, tuple[_Handle, int]] = {}
+        self._local: dict[tuple[int, int], int] = {}
+        self._done: dict[int, dict] = {}
+        # Prefix tallies of replicas already retired, so the fleet hit
+        # rate never loses history when a slice is returned.
+        self._retired_hits = 0
+        self._retired_lookups = 0
+        self._reconciler = (
+            Reconciler(provider, scale_policy, obs=self.obs)
+            if provider is not None else None
+        )
+        self._set_replica_gauges()
+
+    # -- fleet membership ----------------------------------------------
+
+    def add_replica(self, replica) -> None:
+        name = getattr(replica, "name", None) or f"r{self._seq}"
+        self._seq += 1
+        self._handles.append(_Handle(replica, name))
+        self._set_replica_gauges()
+
+    def start_drain(self, handle: _Handle) -> None:
+        """Stop routing to `handle` and ask its replica to drain
+        (resident work finishes; the reconciler retires it once
+        `has_work` goes False)."""
+        handle.replica.drain()
+        self._set_replica_gauges()
+
+    def retire(self, handle: _Handle) -> None:
+        """Remove a fully drained handle from the fleet, folding its
+        prefix tallies into the retired accumulators first so the
+        fleet-level hit rate keeps its history."""
+        self._collect(handle)  # final records, before the handle goes
+        hits, lookups = handle.prefix_tallies()
+        self._retired_hits += hits
+        self._retired_lookups += lookups
+        self._handles.remove(handle)
+        self._affinity = {
+            k: h for k, h in self._affinity.items() if h is not handle
+        }
+        # Drop the retired replica's per-replica series: its last
+        # saturation would otherwise export as a live member forever.
+        self.obs.replica_saturation.remove(
+            labels={"replica": handle.name}
+        )
+        self._set_replica_gauges()
+
+    def active_handles(self) -> list[_Handle]:
+        return [
+            h for h in self._handles if not h.replica.draining
+        ]
+
+    def draining_handles(self) -> list[_Handle]:
+        return [h for h in self._handles if h.replica.draining]
+
+    @property
+    def replicas(self) -> list:
+        return [h.replica for h in self._handles]
+
+    # -- routing -------------------------------------------------------
+
+    def _pick(self, key: int | None) -> tuple[_Handle, str]:
+        candidates = self.active_handles()
+        if not candidates:
+            self.obs.failed.inc(labels={"reason": "no_replica"})
+            raise RuntimeError(
+                "fleet has no active replica to route to"
+            )
+        if self.policy == "round_robin":
+            handle = candidates[self._rr_next % len(candidates)]
+            self._rr_next += 1
+            return handle, "round_robin"
+        if key is not None:
+            handle = self._affinity.get(key)
+            if handle is not None and handle in candidates:
+                load = replica_load(handle.replica)
+                # Affinity yields only when the target is HOT *and*
+                # the sampled alternative is meaningfully less loaded
+                # THAN THE TARGET: a uniformly saturated fleet (every
+                # engine's busy component pinned at 1.0 under full
+                # load) gains nothing from moving and would pay a
+                # cold prefill per migration. The gap is checked
+                # against the actual migration destination, not the
+                # fleet minimum — a lucky global minimum must not
+                # green-light re-pointing to whatever two replicas
+                # the sample happened to draw (possibly hotter than
+                # the target itself).
+                if load < self.affinity_overload:
+                    return handle, "affinity"
+                alt = self._two_choices(candidates)
+                if (
+                    load - replica_load(alt.replica)
+                    >= self.affinity_imbalance
+                ):
+                    self._affinity[key] = alt
+                    return alt, "p2c"
+                return handle, "affinity"
+        # Unknown key (or no affinity yet): two-choice placement; the
+        # key (if any) points here so the template's stream follows
+        # the blocks it is about to warm.
+        handle = self._two_choices(candidates)
+        if key is not None:
+            self._affinity[key] = handle
+        return handle, "p2c"
+
+    def _two_choices(self, candidates: list[_Handle]) -> _Handle:
+        """Power-of-two-choices: two distinct candidates when the
+        fleet has them, least loaded wins (Mitzenmacher's d=2 bound:
+        near-best-of-all balance at O(1) probes)."""
+        if len(candidates) == 1:
+            return candidates[0]
+        a, b = self._rng.sample(candidates, 2)
+        return min((a, b), key=lambda h: replica_load(h.replica))
+
+    def submit(self, prompt, **kwargs) -> int:
+        """Route one request; returns a ROUTER request id (replica
+        rids are namespaced per replica and never leak). Replica-side
+        validation errors (bad knobs, oversize) propagate to the
+        caller after landing in `router_requests_failed_total` —
+        client errors stay client errors whatever replica they hit."""
+        handle, arm = self._pick(prefix_key(prompt))
+        try:
+            local = handle.replica.submit(prompt, **kwargs)
+        except ValueError:
+            self.obs.failed.inc(labels={"reason": "bad_request"})
+            raise
+        rid = self._next_rid
+        self._next_rid += 1
+        self._routes[rid] = (handle, local)
+        self._local[(id(handle), local)] = rid
+        handle.routed += 1
+        self.obs.submitted.inc()
+        self.obs.routed.inc(labels={"policy": arm})
+        return rid
+
+    # -- the drive loop ------------------------------------------------
+
+    def _collect(self, handle: _Handle) -> None:
+        for local, record in handle.replica.drain_done_records().items():
+            rid = self._local.pop((id(handle), local), None)
+            if rid is None:
+                continue  # a request submitted around the router
+            self._routes.pop(rid, None)
+            record = dict(record)
+            record["replica"] = handle.name
+            self._done[rid] = record
+
+    def step(self) -> bool:
+        """One fleet turn: advance every replica (draining ones
+        included — their resident work is what a drain waits for),
+        collect finished records, tick the reconciler, refresh the
+        fleet gauges. True while any replica still has work."""
+        for handle in list(self._handles):
+            handle.replica.step()
+            self._collect(handle)
+        if self._reconciler is not None:
+            self._reconciler.tick(self)
+        self._refresh_gauges()
+        return self.has_work
+
+    def run(self) -> dict[int, list[int]]:
+        """Drive until every routed request finishes."""
+        out: dict[int, list[int]] = {}
+        while self.has_work:
+            self.step()
+            out.update(self.drain_done())
+        out.update(self.drain_done())
+        return out
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._routes) or any(
+            h.replica.has_work for h in self._handles
+        )
+
+    def drain_done_records(self) -> dict[int, dict]:
+        done, self._done = self._done, {}
+        return done
+
+    def drain_done(self) -> dict[int, list[int]]:
+        return {
+            rid: rec["tokens"]
+            for rid, rec in self.drain_done_records().items()
+        }
+
+    # -- telemetry -----------------------------------------------------
+
+    def _set_replica_gauges(self) -> None:
+        active = [h for h in self._handles if not h.replica.draining]
+        self.obs.replicas_gauge.set(
+            len(active), labels={"state": "active"}
+        )
+        self.obs.replicas_gauge.set(
+            len(self._handles) - len(active),
+            labels={"state": "draining"},
+        )
+
+    def _refresh_gauges(self) -> None:
+        self._set_replica_gauges()
+        self.obs.queue_depth.set(
+            sum(h.replica.queue_depth for h in self._handles)
+        )
+        for handle in self._handles:
+            sat = handle.replica.saturation
+            if sat is not None:
+                self.obs.replica_saturation.set(
+                    sat, labels={"replica": handle.name}
+                )
+        rate = self.prefix_hit_rate
+        if rate is not None:
+            self.obs.prefix_hit_rate.set(round(rate, 4))
+
+    @property
+    def prefix_hit_rate(self) -> float | None:
+        """Fleet-level prefix-cache block hit rate: hits over
+        lookupable blocks summed across live AND retired replicas —
+        the metric prefix-affinity routing exists to raise."""
+        hits, lookups = self._retired_hits, self._retired_lookups
+        for handle in self._handles:
+            h, lk = handle.prefix_tallies()
+            hits += h
+            lookups += lk
+        return hits / lookups if lookups else None
+
+    def scale_events(self) -> dict[str, int]:
+        return {
+            d: int(self.obs.scale_events.value(
+                labels={"direction": d}
+            ))
+            for d in ("up", "down", "denied")
+        }
+
+    def stats(self) -> dict:
+        """One fleet snapshot: membership, per-replica signals and
+        routed counts, affinity-map size, fleet prefix hit rate, and
+        the scale-event tallies — the serverouter `/healthz` fleet
+        block and the traffic harness's read surface."""
+        rate = self.prefix_hit_rate
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "policy": self.policy,
+            "replicas": [
+                {
+                    "name": h.name,
+                    "draining": h.replica.draining,
+                    "saturation": h.replica.saturation,
+                    "slo_ok": h.replica.slo_ok,
+                    "queue_depth": h.replica.queue_depth,
+                    "has_work": h.replica.has_work,
+                    "routed": h.routed,
+                }
+                for h in self._handles
+            ],
+            "active": len(self.active_handles()),
+            "draining": len(self.draining_handles()),
+            "affinity_keys": len(self._affinity),
+            "prefix_hit_rate": (
+                round(rate, 4) if rate is not None else None
+            ),
+            "scale_events": self.scale_events(),
+            "in_flight": len(self._routes),
+        }

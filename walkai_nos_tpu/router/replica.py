@@ -1,0 +1,319 @@
+"""Replica adapters: one interface over in-process and HTTP engines.
+
+The fleet router (`router/core.py`) owns N serving replicas — one per
+TPU slice — and needs exactly six things from each: submit a request,
+advance it (in-process only), collect finished records, read its
+scale signals (saturation / SLO compliance / queue depth), start a
+graceful drain, and read its prefix-cache tallies so the fleet-level
+`router_prefix_hit_rate` can be computed. Everything else (paging,
+speculation, SLO windows) stays inside the engine.
+
+Two adapters implement that surface:
+
+- **`EngineReplica`** wraps a `models/serve.ContinuousBatcher`
+  in-process — the CI / single-host shape, and what the traffic-replay
+  harness (`sim/trafficbench.py`) drives. `step()` advances the
+  engine one pipeline turn; drain maps to the engine's own
+  `drain()` seam (new submits reject with the `draining` taxonomy
+  reason, resident slots finish).
+- **`HttpReplica`** fronts a remote demo-server pod
+  (`demos/tpu-sharing-comparison/app/main.py`) over its existing
+  endpoints: `POST /generate` per request (a small worker pool keeps
+  submits non-blocking), `GET /healthz` for the engine block's
+  `saturation` / `slo_ok` / `queue_depth` / `has_work` /
+  `draining` scale signals (cached for `refresh_s` so hot routing
+  paths don't serialize on probes), `GET /stats` for the
+  `cb_prefix` tallies. `drain()` is router-side (stop routing here,
+  wait for in-flight work) — the remote process keeps its own
+  lifecycle.
+
+Both expose the same attribute surface, so the router, the
+autoscaling reconciler, and the traffic harness never branch on the
+deployment shape.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+__all__ = ["EngineReplica", "HttpReplica"]
+
+
+class EngineReplica:
+    """In-process replica over a `ContinuousBatcher`."""
+
+    # In-process work only advances when step() is called, so a driver
+    # loop must spin while this replica has work. HttpReplica's work
+    # advances remotely — its driver can sleep between collection
+    # ticks instead of burning a core.
+    steps_locally = True
+
+    def __init__(self, engine, *, name: str = "engine"):
+        self.name = name
+        self.engine = engine
+
+    def warm(self) -> None:
+        """Compile the engine's serving programs before traffic (the
+        engine's own pow2 admission-burst discipline — a cold engine
+        pays ~seconds of XLA compile on its FIRST concurrent
+        admissions, mid-traffic)."""
+        self.engine.warm()
+
+    # -- request path --------------------------------------------------
+
+    def submit(self, prompt, **kwargs) -> int:
+        return self.engine.submit(prompt, **kwargs)
+
+    def step(self) -> None:
+        if self.engine.has_work:
+            self.engine.step()
+
+    def drain_done_records(self) -> dict[int, dict]:
+        return self.engine.drain_done_records()
+
+    # -- scale signals -------------------------------------------------
+
+    @property
+    def saturation(self):
+        return self.engine.saturation
+
+    @property
+    def slo_ok(self):
+        return self.engine.slo_ok
+
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth
+
+    @property
+    def has_work(self) -> bool:
+        return self.engine.has_work
+
+    @property
+    def slots(self) -> int:
+        return self.engine.slots
+
+    # -- drain lifecycle -----------------------------------------------
+
+    def drain(self) -> None:
+        self.engine.drain()
+
+    @property
+    def draining(self) -> bool:
+        return self.engine.draining
+
+    # -- fleet telemetry -----------------------------------------------
+
+    def prefix_stats(self) -> dict:
+        return self.engine.prefix_stats()
+
+
+class HttpReplica:
+    """Remote replica over the demo server's HTTP surface.
+
+    `submit()` enqueues; a small worker pool POSTs `/generate` and
+    parks each response as a finished record, so the router's submit
+    path never blocks on a remote generation. Records carry the same
+    keys the engine's `drain_done_records()` produces ("tokens",
+    "ttft_s", "wall_s", "truncated") plus "error" on failure, so the
+    router's completion path is adapter-agnostic.
+    """
+
+    # The remote server drives its own engine; a driver fronting only
+    # HTTP replicas sleeps between ticks (see EngineReplica).
+    steps_locally = False
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        name: str | None = None,
+        workers: int = 8,
+        timeout_s: float = 120.0,
+        refresh_s: float = 1.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.name = name or self.base_url
+        self._timeout_s = timeout_s
+        self._refresh_s = refresh_s
+        self._next_rid = 0
+        self._lock = threading.Lock()
+        self._queue: queue.Queue = queue.Queue()
+        self._inflight = 0
+        self._done: dict[int, dict] = {}
+        self._draining = False
+        self._health: dict | None = None
+        self._health_at: float | None = None
+        self._unreachable = False
+        self._prefix: dict = {}
+        self._prefix_at: float | None = None
+        for i in range(max(1, workers)):
+            threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"router-replica-{self.name}-{i}",
+            ).start()
+
+    # -- request path --------------------------------------------------
+
+    def submit(self, prompt, **kwargs) -> int:
+        body = {"prompt": [int(t) for t in prompt]}
+        for key in (
+            "max_new_tokens", "eos_id", "temperature", "top_k",
+            "top_p", "seed",
+        ):
+            if kwargs.get(key) is not None:
+                body[key] = kwargs[key]
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._inflight += 1
+        self._queue.put((rid, body))
+        return rid
+
+    def _worker(self) -> None:
+        while True:
+            rid, body = self._queue.get()
+            t0 = time.monotonic()
+            try:
+                req = urllib.request.Request(
+                    f"{self.base_url}/generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self._timeout_s
+                ) as resp:
+                    out = json.loads(resp.read())
+                record = {
+                    "tokens": out.get("tokens", []),
+                    "ttft_s": out.get(
+                        "ttft_seconds",
+                        out.get("generate_time_seconds", 0.0),
+                    ),
+                    "wall_s": out.get(
+                        "engine_wall_seconds",
+                        time.monotonic() - t0,
+                    ),
+                    "truncated": out.get("truncated", False),
+                }
+            except Exception as e:  # noqa: BLE001 — per-request failure
+                record = {
+                    "tokens": None,
+                    "ttft_s": None,
+                    "wall_s": time.monotonic() - t0,
+                    "truncated": False,
+                    "error": str(e),
+                }
+            with self._lock:
+                self._done[rid] = record
+                self._inflight -= 1
+
+    def warm(self) -> None:
+        """No-op: the remote server warms its own engine at startup."""
+
+    def step(self) -> None:
+        """No-op: the remote server drives its own engine."""
+
+    def drain_done_records(self) -> dict[int, dict]:
+        with self._lock:
+            done, self._done = self._done, {}
+        return done
+
+    # -- scale signals (cached /healthz engine block) ------------------
+
+    def _engine_block(self) -> dict:
+        now = time.monotonic()
+        if (
+            self._health_at is None
+            or now - self._health_at >= self._refresh_s
+        ):
+            try:
+                # Short probe timeout: this runs on the ROUTER's
+                # driver thread (load reads inside routing picks) — a
+                # blackholed pod must not stall the whole fleet's
+                # request path for long per refresh interval.
+                with urllib.request.urlopen(
+                    f"{self.base_url}/healthz", timeout=2.0
+                ) as resp:
+                    payload = json.loads(resp.read())
+                self._health = payload.get("engine") or {}
+                self._unreachable = False
+            except Exception:  # noqa: BLE001 — probe failed
+                self._health = None
+                self._unreachable = True
+            self._health_at = now
+        return self._health or {}
+
+    @property
+    def unreachable(self) -> bool:
+        """True while the last health probe FAILED (distinct from
+        'not yet probed'). `autoscale.replica_load` reads this as
+        maximum load, so routing prefers any replica that answers —
+        an empty engine block would otherwise score a DEAD pod as
+        load 0.0, the fleet's most attractive target."""
+        self._engine_block()  # refresh if the cache expired
+        return self._unreachable
+
+    @property
+    def saturation(self):
+        return self._engine_block().get("saturation")
+
+    @property
+    def slo_ok(self):
+        return self._engine_block().get("slo_ok")
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._engine_block().get("queue_depth") or 0)
+
+    @property
+    def has_work(self) -> bool:
+        with self._lock:
+            if self._inflight > 0:
+                return True
+        return bool(self._engine_block().get("has_work"))
+
+    @property
+    def slots(self) -> int:
+        return int(self._engine_block().get("slots") or 1)
+
+    # -- drain lifecycle -----------------------------------------------
+
+    def drain(self) -> None:
+        """Router-side drain: stop routing here; `has_work` (local
+        in-flight requests OR the remote engine block) reports when
+        the replica can be retired. The remote process's own drain is
+        its operator's call — the router only stops feeding it."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- fleet telemetry -----------------------------------------------
+
+    def prefix_stats(self) -> dict:
+        """Cached for `refresh_s`, like the /healthz probe: the
+        router reads prefix tallies every step (the fleet hit-rate
+        gauge), and an uncached synchronous GET per step per replica
+        would let one slow replica stall the whole driver loop."""
+        now = time.monotonic()
+        if (
+            self._prefix_at is not None
+            and now - self._prefix_at < self._refresh_s
+        ):
+            return self._prefix
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}/stats", timeout=5.0
+            ) as resp:
+                payload = json.loads(resp.read())
+            self._prefix = payload.get("cb_prefix") or {}
+        except Exception:  # noqa: BLE001 — telemetry must not gate routing
+            pass  # keep the last good tallies
+        self._prefix_at = now
+        return self._prefix

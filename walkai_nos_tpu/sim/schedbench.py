@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from walkai_nos_tpu.kube import objects
 from walkai_nos_tpu.sim.harness import SimCluster
 from walkai_nos_tpu.tpu.annotations import parse_node_annotations
+from walkai_nos_tpu.utils.stats import percentile
 
 
 @dataclass
@@ -59,10 +60,13 @@ def _workload(n_nodes: int) -> list[tuple[str, str]]:
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
-    return sorted_vals[idx]
+    """The SHARED nearest-rank percentile (`utils/stats.percentile`),
+    with this module's legacy call shape (fractional q, 0.0 on empty
+    — the result fields are unconditionally rounded floats). Was a
+    third private floor-rank implementation; `sim/trafficbench.py`
+    uses the shared helper directly."""
+    p = percentile(sorted_vals, q * 100)
+    return 0.0 if p is None else p
 
 
 def _drive_pods(

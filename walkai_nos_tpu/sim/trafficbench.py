@@ -1,0 +1,328 @@
+"""Traffic-replay benchmark: diurnal load + flash crowds + Zipf
+templates through the fleet router.
+
+`sim/schedbench.py` replays pod-to-slice scheduling through the REAL
+control plane; this module does the same for serving traffic through
+the REAL router + engines (`router/core.py` over in-process
+`ContinuousBatcher` replicas — tiny configs, CPU-friendly): a
+deterministic trace of requests whose arrival rate follows a diurnal
+curve with a flash-crowd surge window, and whose prompts draw from a
+Zipf-distributed pool of templates (each template a shared
+full-128-token-block prefix plus a per-request suffix — the
+million-user serving shape where a handful of system prompts
+dominate).
+
+Headline keys (gated absent_ok in BASELINE.json, emitted by
+`bench.py`'s router phase):
+
+- `router_ttft_p99_under_surge` — p99 TTFT of requests that arrived
+  inside the flash-crowd window (nearest-rank, `utils/stats`): the
+  serving quality the router + autoscaler must defend exactly when
+  load spikes;
+- `router_prefix_hit_rate` — the fleet-level prefix-cache hit rate
+  prefix-affinity routing exists to raise (compare
+  `router_rr_prefix_hit_rate`, the same trace under round-robin:
+  affinity should beat it because each template's blocks are warmed
+  on ONE replica instead of sprayed across all);
+- `router_scale_events_total` — reconciler actions during the
+  replay (up + down) when autoscaling is enabled.
+
+The trace is tick-based, not wall-clock-based: arrivals land at
+router-step boundaries by largest-remainder apportionment of a
+deterministic rate curve, so two runs over the same seed submit the
+same requests in the same order — the property the affinity-vs-
+round-robin comparison and the CI fleet test both need. TTFT values
+are still real host seconds (the engines' own record clocks).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from walkai_nos_tpu.utils.stats import percentile
+
+__all__ = [
+    "TrafficBenchResult",
+    "make_trace",
+    "run_traffic_benchmark",
+]
+
+
+@dataclass
+class TrafficBenchResult:
+    requests: int
+    completed: int
+    errored: int
+    ttft_p99_surge_s: float | None
+    ttft_p99_steady_s: float | None
+    prefix_hit_rate: float | None
+    rr_prefix_hit_rate: float | None
+    scale_up_events: int
+    scale_down_events: int
+    replicas_final: int
+    per_request_tokens: dict = field(default_factory=dict)
+
+    def bench_keys(self) -> dict:
+        """The headline-key view `bench.py` merges into its one JSON
+        line (names match BASELINE.json's published specs)."""
+        out = {
+            "router_requests": self.requests,
+            "router_completed": self.completed,
+            "router_errored": self.errored,
+            "router_scale_events_total": (
+                self.scale_up_events + self.scale_down_events
+            ),
+            "router_scale_up_events": self.scale_up_events,
+            "router_scale_down_events": self.scale_down_events,
+            "router_replicas_final": self.replicas_final,
+        }
+        if self.ttft_p99_surge_s is not None:
+            out["router_ttft_p99_under_surge"] = round(
+                self.ttft_p99_surge_s, 4
+            )
+        if self.ttft_p99_steady_s is not None:
+            out["router_ttft_p99_steady"] = round(
+                self.ttft_p99_steady_s, 4
+            )
+        if self.prefix_hit_rate is not None:
+            out["router_prefix_hit_rate"] = round(
+                self.prefix_hit_rate, 4
+            )
+        if self.rr_prefix_hit_rate is not None:
+            out["router_rr_prefix_hit_rate"] = round(
+                self.rr_prefix_hit_rate, 4
+            )
+        return out
+
+
+def make_trace(
+    *,
+    requests: int,
+    templates: int,
+    ticks: int,
+    zipf_a: float = 1.1,
+    surge_start_frac: float = 0.5,
+    surge_len_frac: float = 0.25,
+    surge_mult: float = 4.0,
+    suffix_tokens: int = 8,
+    max_new: int = 6,
+    vocab: int = 64,
+    prefix_tokens: int = 128,
+    seed: int = 0,
+) -> tuple[list[list[dict]], set[int]]:
+    """(arrivals per tick, surge tick set). Each arrival is
+    {"prompt": np.ndarray, "template": t, "max_new": n}; prompts are
+    a Zipf-chosen shared `prefix_tokens` template prefix + a random
+    suffix, deterministically derived from `seed`."""
+    rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(0, vocab, prefix_tokens).astype(np.int32)
+        for _ in range(templates)
+    ]
+    weights = 1.0 / np.arange(1, templates + 1) ** zipf_a
+    weights /= weights.sum()
+    # Diurnal rate curve with a flash-crowd window on top.
+    s0 = int(ticks * surge_start_frac)
+    s1 = min(ticks, s0 + max(1, int(ticks * surge_len_frac)))
+    surge_ticks = set(range(s0, s1))
+    rate = np.sin(np.pi * (np.arange(ticks) + 0.5) / ticks) ** 2 + 0.2
+    for t in surge_ticks:
+        rate[t] *= surge_mult
+    # Largest-remainder apportionment of exactly `requests` arrivals.
+    share = rate / rate.sum() * requests
+    counts = np.floor(share).astype(int)
+    remainder = requests - int(counts.sum())
+    for t in np.argsort(share - counts)[::-1][:remainder]:
+        counts[t] += 1
+    trace: list[list[dict]] = []
+    for t in range(ticks):
+        arrivals = []
+        for _ in range(int(counts[t])):
+            template = int(rng.choice(templates, p=weights))
+            suffix = rng.integers(0, vocab, suffix_tokens).astype(
+                np.int32
+            )
+            arrivals.append({
+                "prompt": np.concatenate(
+                    [prefixes[template], suffix]
+                ),
+                "template": template,
+                "max_new": max_new,
+            })
+        trace.append(arrivals)
+    return trace, surge_ticks
+
+
+def default_engine_factory(cfg=None, params=None, *, slots=4,
+                           cache_len=256, chunk_steps=4,
+                           park_blocks=8, prefill_lanes=1):
+    """(cfg, params, factory): tiny-config in-process engines sharing
+    ONE weight set — routing must never change tokens, so every
+    replica serves the same model. `park_blocks` of pool headroom
+    beyond the per-slot worst case let released template prefixes
+    PARK in the radix index instead of being evicted between reuses
+    — without it a tiny pool's eviction pressure (and its pinned
+    `pool` saturation component) would measure the allocator, not
+    the routing policy. `prefill_lanes=1` serializes admissions so a
+    same-template request admitted right behind its writer finds the
+    writer's blocks READY (the trie marks a block matchable only
+    once its writing chunk has dispatched): with concurrent lanes
+    the hit/miss split would partly measure admission-window
+    collisions — timing noise — instead of the routing policy, and
+    it halves the per-engine XLA compile surface too."""
+    import jax
+
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
+
+    if cfg is None:
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+            max_seq_len=512,
+        )
+    if params is None:
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+    pool_blocks = (
+        slots * -(-cache_len // PAGE_ROWS) + 1 + park_blocks
+    )
+
+    def factory(name: str):
+        from walkai_nos_tpu.models.serve import ContinuousBatcher
+        from walkai_nos_tpu.router.replica import EngineReplica
+
+        return EngineReplica(
+            ContinuousBatcher(
+                cfg, params, slots=slots, cache_len=cache_len,
+                chunk_steps=chunk_steps, pool_blocks=pool_blocks,
+                prefill_lanes=prefill_lanes,
+            ),
+            name=name,
+        )
+
+    return cfg, params, factory
+
+
+def _warm(replica) -> None:
+    """Compile an in-process engine's programs OFF the replay path
+    (`EngineReplica.warm()` — the demo server's warmup discipline).
+    Without this the trace's first arrivals measure XLA compile, not
+    serving — a ~60 s TTFT outlier on a CPU dev box. HTTP replicas
+    warm on their own server's startup (`warm()` is a no-op)."""
+    replica.warm()
+
+
+def _replay(router, trace, surge_ticks) -> tuple[dict, dict, int]:
+    """Drive the trace through a router: returns (records by rid,
+    submit tick by rid, errored count)."""
+    records: dict[int, dict] = {}
+    submit_tick: dict[int, int] = {}
+    errored = 0
+    for tick, arrivals in enumerate(trace):
+        for arrival in arrivals:
+            try:
+                rid = router.submit(
+                    arrival["prompt"],
+                    max_new_tokens=arrival["max_new"],
+                )
+            except (ValueError, RuntimeError):
+                errored += 1
+                continue
+            submit_tick[rid] = tick
+        router.step()
+        records.update(router.drain_done_records())
+    while router.has_work:
+        router.step()
+        records.update(router.drain_done_records())
+    records.update(router.drain_done_records())
+    return records, submit_tick, errored
+
+
+def run_traffic_benchmark(
+    *,
+    n_replicas: int = 2,
+    spare_replicas: int = 0,
+    requests: int = 64,
+    templates: int = 6,
+    ticks: int = 32,
+    zipf_a: float = 1.1,
+    slots: int = 4,
+    max_new: int = 6,
+    seed: int = 0,
+    compare_round_robin: bool = True,
+    scale_policy=None,
+    cfg=None,
+    params=None,
+) -> TrafficBenchResult:
+    """Replay one deterministic trace through a prefix-affinity fleet
+    (optionally autoscaling over `spare_replicas` provider-held
+    spares) and, for the hit-rate comparison, through a fresh
+    round-robin fleet on the SAME trace and weights."""
+    from walkai_nos_tpu.router.autoscale import StaticSliceProvider
+    from walkai_nos_tpu.router.core import FleetRouter
+
+    cfg, params, factory = default_engine_factory(
+        cfg, params, slots=slots
+    )
+    trace, surge_ticks = make_trace(
+        requests=requests, templates=templates, ticks=ticks,
+        zipf_a=zipf_a, max_new=max_new, vocab=cfg.vocab_size,
+        seed=seed,
+    )
+
+    replicas = [factory(f"r{i}") for i in range(n_replicas)]
+    spares = [factory(f"spare{i}") for i in range(spare_replicas)]
+    for replica in replicas + spares:
+        _warm(replica)
+    provider = (
+        StaticSliceProvider(spares) if spare_replicas > 0 else None
+    )
+    router = FleetRouter(
+        replicas, provider=provider, scale_policy=scale_policy,
+        policy="affinity", seed=seed,
+    )
+    records, submit_tick, errored = _replay(
+        router, trace, surge_ticks
+    )
+
+    surge_ttft = sorted(
+        r["ttft_s"] for rid, r in records.items()
+        if submit_tick.get(rid) in surge_ticks
+        and r.get("ttft_s") is not None
+    )
+    steady_ttft = sorted(
+        r["ttft_s"] for rid, r in records.items()
+        if submit_tick.get(rid) not in surge_ticks
+        and r.get("ttft_s") is not None
+    )
+    events = router.scale_events()
+
+    rr_rate = None
+    if compare_round_robin:
+        rr_replicas = [
+            factory(f"rr{i}") for i in range(n_replicas)
+        ]
+        for replica in rr_replicas:
+            _warm(replica)
+        rr_router = FleetRouter(
+            rr_replicas, policy="round_robin", seed=seed,
+        )
+        _replay(rr_router, trace, surge_ticks)
+        rr_rate = rr_router.prefix_hit_rate
+
+    return TrafficBenchResult(
+        requests=sum(len(a) for a in trace),
+        completed=len(records),
+        errored=errored,
+        ttft_p99_surge_s=percentile(surge_ttft, 99),
+        ttft_p99_steady_s=percentile(steady_ttft, 99),
+        prefix_hit_rate=router.prefix_hit_rate,
+        rr_prefix_hit_rate=rr_rate,
+        scale_up_events=events["up"],
+        scale_down_events=events["down"],
+        replicas_final=len(router.replicas),
+        per_request_tokens={
+            rid: rec["tokens"] for rid, rec in records.items()
+        },
+    )
