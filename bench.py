@@ -589,8 +589,10 @@ def cb_serving_benchmark() -> dict:
     dispatch."""
     from bench_lm import (
         measure_cb_prefix_reuse,
+        measure_cb_quant_serving,
         measure_cb_serving,
         measure_cb_spec_serving,
+        measure_quant_quality,
     )
 
     out = measure_cb_serving()
@@ -598,6 +600,14 @@ def cb_serving_benchmark() -> dict:
     out.update(measure_cb_spec_serving(
         baseline_capacity=out.get("cb_serving_capacity_tokens_per_s"),
     ))
+    # Quantized arm (int8 paged KV + int8 weights): the same Poisson
+    # harness reusing this run's bf16 capacity as its anchor, plus
+    # the engine-direct perplexity-delta gate — capacity may only go
+    # UP when bytes/step go down, and quality may not move.
+    out.update(measure_cb_quant_serving(
+        baseline_capacity=out.get("cb_serving_capacity_tokens_per_s"),
+    ))
+    out.update(measure_quant_quality())
     return out
 
 
@@ -693,7 +703,9 @@ def main() -> None:
             "cb_loop_steps_per_sync",
             "cb_slo_ttft_p99", "cb_saturation",
             "cb_spec_capacity_tokens_per_s",
-            "cb_spec_accepted_per_round", "obs_overhead_pct",
+            "cb_spec_accepted_per_round",
+            "cb_quant_capacity_tokens_per_s", "lm_quality_delta_ppl",
+            "obs_overhead_pct",
             "router_ttft_p99_under_surge", "router_prefix_hit_rate",
             "router_scale_events_total",
             "noisy_neighbor_no_degradation", "spec_speedup",

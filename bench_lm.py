@@ -822,6 +822,12 @@ def measure_cb_serving(
         "cb_device_roofline_fraction": _parse_value(
             metrics1, "cb_device_roofline_fraction"
         ),
+        # Analytic HBM bytes one decode step streams (weights +
+        # resident KV, from ACTUAL storage dtypes): the ceiling the
+        # quantized-serving arm moves.
+        "cb_device_hbm_bytes_per_step": _parse_value(
+            metrics1, "cb_device_hbm_bytes_per_step"
+        ),
         # Device-resident loop fold depth (models/serve.py
         # loop_steps; the demo server enables the loop by default, so
         # cb_host_overhead_frac above is the WITH-LOOP re-scrape the
@@ -1109,6 +1115,216 @@ def measure_cb_spec_serving(
     }
 
 
+def _bigram_corpus_batch(vocab: int, seed: int = 0):
+    """Bigram-structured corpus sampler: every token has a dominant
+    successor (80%) and an alternative (20%) under fixed permutation
+    tables, so briefly-trained models become peaked like any deployed
+    pair. The ONE corpus recipe both quality-sensitive bench arms
+    train and evaluate on (`measure_speculative`'s draft acceptance,
+    `measure_quant_quality`'s perplexity delta) — their gates anchor
+    to the same distribution by construction."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    succ1 = rng.permutation(vocab)
+    succ2 = rng.permutation(vocab)
+
+    def corpus_batch(batch: int, seq: int, step_seed: int):
+        r = np.random.default_rng(step_seed)
+        toks = np.empty((batch, seq), np.int32)
+        toks[:, 0] = r.integers(0, vocab, batch)
+        for t in range(1, seq):
+            pick2 = r.random(batch) < 0.2
+            toks[:, t] = np.where(
+                pick2, succ2[toks[:, t - 1]], succ1[toks[:, t - 1]]
+            )
+        return jnp.asarray(toks)
+
+    return corpus_batch
+
+
+def _train_bigram_lm(cfg, corpus_batch, steps: int, seed: int):
+    """Briefly train a DecoderLM on the bigram corpus (adamw 3e-3,
+    batch 16 x seq 128); returns (params, final loss) — shared by the
+    speculative and quantization quality benches."""
+    import jax
+    import optax
+
+    from walkai_nos_tpu.models.lm import DecoderLM, lm_loss
+
+    model = DecoderLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    tx = optax.adamw(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(model.apply({"params": p}, batch), batch)
+        )(params)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    loss = None
+    for i in range(steps):
+        params, opt, loss = step(params, opt, corpus_batch(16, 128, i))
+    return params, (float(loss) if loss is not None else None)
+
+
+def measure_cb_quant_serving(
+    *,
+    kv_dtype: str = "int8",
+    w_dtype: str = "int8",
+    baseline_capacity: float | None = None,
+    **serving_kwargs,
+) -> dict:
+    """Quantized serving (int8 paged KV + int8 weights), measured as
+    SERVING: the same Poisson harness as `measure_cb_serving` against
+    a server running the engine with WALKAI_CB_KV_DTYPE /
+    WALKAI_LM_W_DTYPE set — decode is memory-bound, so storing fewer
+    HBM bytes IS capacity, and this arm measures the claim end to end.
+
+    Headline key `cb_quant_capacity_tokens_per_s`: closed-loop
+    capacity with quantization on. BASELINE.json gates it as an
+    absent_ok floor at the spec-off bf16 capacity anchor (direction
+    higher, tolerance 0): quantization must never COST capacity —
+    on-chip it should raise the ceiling roughly by the bytes-per-step
+    ratio the attribution gauges report
+    (`cb_quant_hbm_bytes_per_step` rides along from the same
+    /metrics scrape, next to the bf16 arm's reading for the
+    before/after). Quality is gated separately
+    (`measure_quant_quality` -> lm_quality_delta_ppl).
+    `baseline_capacity` skips the quant-off arm when the caller
+    (bench.py) already measured it this run."""
+    quant_env = {
+        "WALKAI_CB_KV_DTYPE": kv_dtype,
+        "WALKAI_LM_W_DTYPE": w_dtype,
+    }
+    extra_env = dict(serving_kwargs.pop("server_env", {}) or {})
+    on = measure_cb_serving(
+        server_env={**quant_env, **extra_env}, **serving_kwargs
+    )
+    if baseline_capacity is None:
+        baseline_capacity = measure_cb_serving(
+            server_env=extra_env or None, **serving_kwargs
+        )["cb_serving_capacity_tokens_per_s"]
+    cap = on["cb_serving_capacity_tokens_per_s"]
+    return {
+        "cb_quant_capacity_tokens_per_s": cap,
+        "cb_quant_off_capacity_tokens_per_s": baseline_capacity,
+        "cb_quant_capacity_ratio": (
+            round(cap / baseline_capacity, 3) if baseline_capacity
+            else None
+        ),
+        "cb_quant_kv_dtype": kv_dtype,
+        "cb_quant_w_dtype": w_dtype,
+        "cb_quant_ttft_p99": on.get("cb_ttft_p99"),
+        "cb_quant_goodput_tokens_per_s": on.get(
+            "cb_goodput_tokens_per_s"
+        ),
+        # The ceiling move itself: analytic HBM bytes per decode step
+        # under quantization (weights + resident KV at their actual
+        # storage dtypes) and the step/roofline gauges beside it —
+        # None off-TPU (no published bandwidth anchors the model).
+        "cb_quant_hbm_bytes_per_step": on.get(
+            "cb_device_hbm_bytes_per_step"
+        ),
+        "cb_quant_device_step_ms": on.get("cb_device_step_ms"),
+        "cb_quant_roofline_fraction": on.get(
+            "cb_device_roofline_fraction"
+        ),
+        "cb_quant_kv_hbm_bytes_per_resident_token": on.get(
+            "cb_kv_hbm_bytes_per_resident_token"
+        ),
+        "cb_quant_request_errors": on.get("cb_request_errors"),
+    }
+
+
+def measure_quant_quality(
+    *, train_steps: int | None = None, eval_rows: int = 16,
+    seq: int = 128, vocab: int = 2048,
+) -> dict:
+    """Perplexity cost of int8 quantization on the bench prompt set.
+
+    Quantization quality measured on random weights would measure
+    nothing (near-uniform logits barely move under rounding), so this
+    briefly trains a small GQA target on the bigram-structured corpus
+    (the `measure_speculative` recipe — peaked after a few hundred
+    steps, like any deployed model), then teacher-forces a held-out
+    eval set through the SERVING decode path — paged cache, one wide
+    decode chunk per sequence, so K/V rows quantize at emit and
+    dequantize at read exactly as serving stores them — with
+    quantization off vs `kv_dtype=int8` + `w_dtype=int8` on the same
+    weights. Headline key `lm_quality_delta_ppl` = ppl(int8) -
+    ppl(fp), gated in BASELINE.json as an absent_ok upper bound
+    (<= 0.05): the quantized engine may move the roofline, not the
+    model."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from walkai_nos_tpu.models.lm import (
+        DecoderLM, LMConfig, quantize_lm_params,
+    )
+    from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
+
+    steps = train_steps or int(
+        __import__("os").environ.get("WALKAI_BENCH_QUANT_STEPS", "150")
+    )
+    cfg = LMConfig(
+        vocab_size=vocab, hidden_dim=256, num_layers=4, num_heads=8,
+        num_kv_heads=2, max_seq_len=1024, dtype="bfloat16",
+    )
+    corpus_batch = _bigram_corpus_batch(vocab, seed=7)
+    params, _ = _train_bigram_lm(cfg, corpus_batch, steps, 0)
+    eval_toks = corpus_batch(eval_rows, seq, 10_000)
+    nlog = -(-seq // PAGE_ROWS)
+
+    def decode_nll(kv_dtype: str, w_dtype: str) -> float:
+        """Teacher-forced mean NLL through the paged decode path:
+        one wide decode apply writes every K/V row through the block
+        table (quantized at emit when configured) and attends back
+        over the stored — possibly int8 — cache."""
+        dcfg = dataclasses.replace(
+            cfg, kv_dtype=kv_dtype, w_dtype=w_dtype,
+            ragged_decode=True, paged_decode=True,
+            cache_len=nlog * PAGE_ROWS,
+            paged_blocks=eval_rows * nlog + 1,
+        )
+        dmodel = DecoderLM(dcfg)
+        dparams = quantize_lm_params(params, dcfg)
+        table = jnp.asarray(
+            np.arange(1, eval_rows * nlog + 1).reshape(eval_rows, nlog),
+            jnp.int32,
+        )
+        cache = dmodel.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((eval_rows, 1), jnp.int32), decode=True,
+        )["cache"]
+        logits, _ = dmodel.apply(
+            {"params": dparams, "cache": cache}, eval_toks,
+            decode=True, block_table=table, mutable=["cache"],
+        )
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), eval_toks[:, 1:]
+        )
+        return float(nll.mean())
+
+    nll_fp = decode_nll("model", "model")
+    nll_q = decode_nll("int8", "int8")
+    ppl_fp = float(np.exp(nll_fp))
+    ppl_q = float(np.exp(nll_q))
+    return {
+        "lm_quality_delta_ppl": round(ppl_q - ppl_fp, 4),
+        "lm_quality_ppl_fp": round(ppl_fp, 4),
+        "lm_quality_ppl_int8": round(ppl_q, 4),
+        "lm_quality_eval_tokens": int(eval_rows * seq),
+        "lm_quality_train_steps": steps,
+    }
+
+
 def measure_obs_overhead(
     *, slots: int = 16, n_requests: int = 48, prompt_len: int = 24,
     new_tokens: int = 64, chunk_steps: int = 16, repeats: int = 3,
@@ -1221,12 +1437,8 @@ def measure_speculative(
     tests/test_speculative.py; on TPU near-argmax ties under ~4e-2 MXU
     rounding can flip — rare for trained, peaked models).
     """
-    import jax
-    import jax.numpy as jnp
-    import optax
-
     from walkai_nos_tpu.models.decode import make_generate_fn
-    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig, lm_loss
+    from walkai_nos_tpu.models.lm import LMConfig
     from walkai_nos_tpu.models.speculative import (
         make_speculative_generate_fn,
     )
@@ -1245,47 +1457,15 @@ def measure_speculative(
         max_seq_len=1024, dtype="bfloat16",
     )
 
-    # Bigram-structured corpus: every token has a dominant successor
-    # (80%) and an alternative (20%). Both models learn the chain in a
-    # few hundred steps; greedy decode then follows it, and acceptance
-    # measures how well the small draft tracks the big target — the
-    # same quantity it measures for a distilled production pair.
-    rng = np.random.default_rng(0)
-    succ1 = rng.permutation(vocab)
-    succ2 = rng.permutation(vocab)
-
-    def corpus_batch(batch: int, seq: int, step_seed: int):
-        r = np.random.default_rng(step_seed)
-        toks = np.empty((batch, seq), np.int32)
-        toks[:, 0] = r.integers(0, vocab, batch)
-        for t in range(1, seq):
-            pick2 = r.random(batch) < 0.2
-            toks[:, t] = np.where(
-                pick2, succ2[toks[:, t - 1]], succ1[toks[:, t - 1]]
-            )
-        return jnp.asarray(toks)
-
-    def train(cfg: LMConfig, seed: int):
-        model = DecoderLM(cfg)
-        params = model.init_params(jax.random.PRNGKey(seed))
-        tx = optax.adamw(3e-3)
-        opt = tx.init(params)
-
-        @jax.jit
-        def step(params, opt, batch):
-            loss, grads = jax.value_and_grad(
-                lambda p: lm_loss(model.apply({"params": p}, batch), batch)
-            )(params)
-            updates, opt = tx.update(grads, opt, params)
-            return optax.apply_updates(params, updates), opt, loss
-
-        loss = None
-        for i in range(steps):
-            params, opt, loss = step(params, opt, corpus_batch(16, 128, i))
-        return params, float(loss)
-
-    t_params, t_loss = train(cfg_t, 0)
-    d_params, d_loss = train(cfg_d, 1)
+    # Bigram-structured corpus (`_bigram_corpus_batch`, the recipe
+    # shared with measure_quant_quality): both models learn the chain
+    # in a few hundred steps; greedy decode then follows it, and
+    # acceptance measures how well the small draft tracks the big
+    # target — the same quantity it measures for a distilled
+    # production pair.
+    corpus_batch = _bigram_corpus_batch(vocab)
+    t_params, t_loss = _train_bigram_lm(cfg_t, corpus_batch, steps, 0)
+    d_params, d_loss = _train_bigram_lm(cfg_d, corpus_batch, steps, 1)
 
     prompt = corpus_batch(1, prompt_len, 999)
 

@@ -534,8 +534,32 @@ def main() -> None:
                     "draft_cfg": cb_draft_cfg,
                     "draft_params": cb_draft_params,
                 }
-            cb_engine = ContinuousBatcher(
+            # Quantized serving (WALKAI_CB_KV_DTYPE /
+            # WALKAI_LM_W_DTYPE ∈ model|int8|int8-sim): int8 paged KV
+            # blocks with per-row scale pools, and/or int8 projection/
+            # MLP weights dequantized on-chip — the engine quantizes
+            # its own copy of the params, so the one-shot /generate
+            # path keeps serving the full-precision tree. Applied to
+            # the CB engine's config only (the dense one-shot cache
+            # has no scale store); an unknown value fails HERE, at
+            # LMConfig construction, with a bad_request-style
+            # ValueError naming the knob — never as a jit crash
+            # mid-traffic.
+            import dataclasses as _dcq
+
+            cb_cfg = _dcq.replace(
                 lm_cfg,
+                kv_dtype=os.environ.get("WALKAI_CB_KV_DTYPE", "model"),
+                w_dtype=os.environ.get("WALKAI_LM_W_DTYPE", "model"),
+            )
+            if cb_spec_kwargs:
+                cb_spec_kwargs["draft_cfg"] = _dcq.replace(
+                    cb_spec_kwargs["draft_cfg"],
+                    kv_dtype=cb_cfg.kv_dtype,
+                    w_dtype=cb_cfg.w_dtype,
+                )
+            cb_engine = ContinuousBatcher(
+                cb_cfg,
                 lm_params,
                 slots=cb_slots,
                 cache_len=cache_bucket(
@@ -1219,6 +1243,7 @@ def main() -> None:
                     payload["cb_slo"] = cb_engine.slo_stats()
                     payload["cb_attrib"] = cb_engine.attrib_stats()
                     payload["cb_loop"] = cb_engine.loop_stats()
+                    payload["cb_quant"] = cb_engine.quant_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

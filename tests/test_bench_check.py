@@ -175,6 +175,60 @@ class TestCheckLogic:
         assert len(failures) == 1
         assert "cb_spec_capacity_tokens_per_s" in failures[0]
 
+    def test_repo_baseline_gates_quant_keys(self):
+        """BASELINE.json carries the quantized-serving keys and they
+        PARSE through the comparator: the capacity key is an
+        absent_ok floor at the r5 quant-off capacity anchor
+        (tolerance 0 — halving bytes/step must never cost capacity),
+        the perplexity delta an absent_ok <= 0.05 upper bound.
+        Absent from the bench output is a skip note; a capacity
+        under the anchor or a delta past the budget fails once
+        emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        cap = published["cb_quant_capacity_tokens_per_s"]
+        assert cap["direction"] == "higher"
+        assert cap["tolerance"] == 0.0
+        assert cap["absent_ok"] is True
+        # Anchored to the r5 quant-off capacity baseline.
+        assert cap["value"] == published[
+            "cb_serving_capacity_tokens_per_s"
+        ]["value"]
+        ppl = published["lm_quality_delta_ppl"]
+        assert ppl["direction"] == "lower"
+        assert ppl["tolerance"] == 0.0
+        assert ppl["absent_ok"] is True
+        assert ppl["value"] == 0.05
+        keys = (
+            "cb_quant_capacity_tokens_per_s", "lm_quality_delta_ppl",
+        )
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert sum("absent" in n for n in notes) == 2
+        failures, _ = bench_check.check(
+            {"cb_quant_capacity_tokens_per_s": cap["value"] * 1.8,
+             "lm_quality_delta_ppl": 0.01},
+            base,
+        )
+        assert failures == []
+        # A slightly NEGATIVE delta (quantization noise measured
+        # faster-than-fp) passes — the budget caps only the upside.
+        failures, _ = bench_check.check(
+            {"lm_quality_delta_ppl": -0.02}, base
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"cb_quant_capacity_tokens_per_s": cap["value"] * 0.9,
+             "lm_quality_delta_ppl": 0.2},
+            base,
+        )
+        assert len(failures) == 2
+        assert any(
+            "cb_quant_capacity_tokens_per_s" in f for f in failures
+        )
+        assert any("lm_quality_delta_ppl" in f for f in failures)
+
     def test_repo_baseline_gates_attribution_keys(self):
         """BASELINE.json carries the device-time attribution keys as
         absent_ok lower-is-better bands and they PARSE through the

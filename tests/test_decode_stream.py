@@ -315,6 +315,229 @@ class TestPagedKernelParity:
         )
 
 
+def _quantize_pools(k_pool, v_pool, quant="int8"):
+    """Quantize fp pools per row (the emit rule, applied offline):
+    pools keep their [nb, kvh, 128, d] shape, scales parallel them at
+    [nb, kvh, 128]."""
+    kq, ks = da.quantize_kv_rows(k_pool, quant)
+    vq, vs = da.quantize_kv_rows(v_pool, quant)
+    return (
+        kq if quant == "int8" else jnp.asarray(kq, k_pool.dtype),
+        vq if quant == "int8" else jnp.asarray(vq, v_pool.dtype),
+        ks, vs,
+    )
+
+
+class TestQuantizedKernelParity:
+    """Dtype matrix for the quantized paged kernels: int8 pools with
+    per-row scale tiles must match the dequantized-gather reference
+    through the table-indexed grid (shuffled physical order, ragged
+    indices, block-edge crossings), and the "sim" arm must be
+    bit-identical to the unquantized kernel — the lossless-plumbing
+    property the serving parity suite builds on."""
+
+    @pytest.mark.parametrize("kvh", [1, 2, 4])
+    def test_int8_pool_matches_dequant_reference(self, kvh):
+        q, k, v = _qkv(b=3, kvh=kvh, s=384)
+        idx = jnp.asarray([0, 129, 383], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=3)
+        kq, vq, ks, vs = _quantize_pools(k_pool, v_pool)
+        out = da.paged_decode_attention(
+            q, kq, vq, table, idx, k_scales=ks, v_scales=vs,
+            interpret=True,
+        )
+        ref = da.paged_decode_attention_reference(
+            q, kq, vq, table, idx, k_scales=ks, v_scales=vs
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.parametrize("steps", [3, 7])
+    def test_int8_multi_step_crosses_block_edge(self, steps):
+        """The speculative verify shape over a quantized pool: per-
+        slot heads mid-block and straddling the 128-row edge."""
+        q, k, v = _qkv(b=2, kvh=2, s=256, steps=steps, seed=2)
+        idx = jnp.asarray([126, 40], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=2)
+        kq, vq, ks, vs = _quantize_pools(k_pool, v_pool)
+        out = da.paged_decode_attention(
+            q, kq, vq, table, idx, k_scales=ks, v_scales=vs,
+            interpret=True,
+        )
+        ref = da.paged_decode_attention_reference(
+            q, kq, vq, table, idx, k_scales=ks, v_scales=vs
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_int8_bf16_queries(self):
+        """bf16 q over an int8 pool — the serving dtype pairing: the
+        int8->bf16 tile convert is lossless, folds accumulate f32."""
+        q, k, v = _qkv(b=2, kvh=2, s=256, dtype=jnp.bfloat16, seed=4)
+        idx = jnp.asarray([200, 77], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=2)
+        kq, vq, ks, vs = _quantize_pools(k_pool, v_pool)
+        out = da.paged_decode_attention(
+            q, kq, vq, table, idx, k_scales=ks, v_scales=vs,
+            interpret=True,
+        )
+        ref = da.paged_decode_attention_reference(
+            q, kq, vq, table, idx, k_scales=ks, v_scales=vs
+        )
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2,
+        )
+
+    def test_sim_mode_bit_identical_to_unquantized(self):
+        """quant="sim" stores the same values with unit scales: the
+        kernel's scale plumbing runs, the output must not move a
+        bit vs the unquantized kernel."""
+        q, k, v = _qkv(b=2, kvh=2, s=384, seed=5)
+        idx = jnp.asarray([100, 290], jnp.int32)
+        k_pool, v_pool, table = _paged_pool(k, v, nlog=3)
+        ksim, vsim, ks, vs = _quantize_pools(k_pool, v_pool, "sim")
+        out = da.paged_decode_attention(
+            q, ksim, vsim, table, idx, k_scales=ks, v_scales=vs,
+            interpret=True,
+        )
+        plain = da.paged_decode_attention(
+            q, k_pool, v_pool, table, idx, interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(plain)
+        )
+
+    def test_scatter_quantizes_at_emit(self):
+        """`scatter_paged_rows` with quant: fresh rows round-trip
+        within int8 resolution, their scales land at the same
+        (block, row) indices, and rows past the table's logical
+        capacity DROP — data and scales alike."""
+        rng = np.random.default_rng(0)
+        nb, kvh, hd, steps = 6, 2, 16, 3
+        kp = jnp.zeros((nb, kvh, da.PAGE_ROWS, hd), jnp.int8)
+        vp = jnp.zeros((nb, kvh, da.PAGE_ROWS, hd), jnp.int8)
+        ksp = jnp.zeros((nb, kvh, da.PAGE_ROWS), jnp.float32)
+        vsp = jnp.zeros((nb, kvh, da.PAGE_ROWS), jnp.float32)
+        table = jnp.asarray([[3, 1], [4, 2]], jnp.int32)
+        k = jnp.asarray(rng.standard_normal((2, kvh, steps, hd)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((2, kvh, steps, hd)), jnp.float32)
+        # Slot 0 crosses the block edge (127 -> 129); slot 1's window
+        # runs off the table's logical capacity (254, 255, 256-drop).
+        idx = jnp.asarray([127, 254], jnp.int32)
+        kp2, vp2, ksp2, vsp2 = da.scatter_paged_rows(
+            kp, vp, k, v, table, idx,
+            k_scale_pool=ksp, v_scale_pool=vsp, quant="int8",
+        )
+        kq = np.asarray(kp2, np.float64)
+        ks = np.asarray(ksp2, np.float64)
+        # Slot 0: row 127 of block 3, rows 0-1 of block 1.
+        for t, (blk, row) in enumerate([(3, 127), (1, 0), (1, 1)]):
+            deq = kq[blk, :, row, :] * ks[blk, :, row, None]
+            want = np.asarray(k)[0, :, t, :]
+            tol = np.abs(want).max(axis=-1, keepdims=True) / 127 + 1e-6
+            assert (np.abs(deq - want) <= tol).all(), (t, blk, row)
+            assert (ks[blk, :, row] > 0).all()
+        # Slot 1: rows 254, 255 land in block 2; position 256 DROPS.
+        assert (ks[2, :, 126:128] > 0).all()
+        written = np.zeros_like(ks, bool)
+        written[3, :, 127] = written[1, :, 0:2] = True
+        written[2, :, 126:128] = True
+        assert (ks[~written] == 0).all(), "dropped row leaked a scale"
+        assert (np.asarray(vsp2)[~written] == 0).all()
+
+    def test_fused_int8_weight_and_pool(self):
+        """The fused kernel's full quantized configuration: int8
+        weight + per-channel scale row dequantized before the MXU,
+        int8 pools + scale tiles dequantized in the fold, rope on,
+        vs the dequant-composition reference."""
+        rng = np.random.default_rng(3)
+        kvh, h, hd, steps, b = 2, 4, 16, 4, 3
+        dm = h * hd
+        dout = dm + 2 * kvh * hd
+        x = jnp.asarray(rng.standard_normal((b, steps, dm)), jnp.float32)
+        w = rng.standard_normal((dm, dout)) * 0.1
+        w_scale = jnp.asarray(
+            np.maximum(np.abs(w).max(axis=0) / 127, 1e-12), jnp.float32
+        )
+        wq = jnp.asarray(
+            np.clip(np.round(w / np.asarray(w_scale)), -127, 127),
+            jnp.int8,
+        )
+        bias = jnp.asarray(rng.standard_normal(dout) * 0.1, jnp.float32)
+        kp = jnp.asarray(
+            rng.standard_normal((12, kvh, da.PAGE_ROWS, hd)), jnp.float32
+        )
+        vp = jnp.asarray(
+            rng.standard_normal((12, kvh, da.PAGE_ROWS, hd)), jnp.float32
+        )
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        table = jnp.asarray(
+            rng.permutation(np.arange(1, 12))[:9].reshape(3, 3),
+            jnp.int32,
+        )
+        index = jnp.asarray([0, 126, 200], jnp.int32)
+        out = da.fused_qkv_paged_attention(
+            x, wq, bias, kq, vq, table, index,
+            num_heads=h, rope_theta=10000.0, w_scale=w_scale,
+            k_scales=ks, v_scales=vs, interpret=True,
+        )
+        ref = da.fused_qkv_paged_reference(
+            x, wq, bias, kq, vq, table, index,
+            num_heads=h, rope_theta=10000.0, w_scale=w_scale,
+            k_scales=ks, v_scales=vs,
+        )
+        for name, a, bb in zip(("o", "k_new", "v_new"), out, ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(bb), atol=1e-4, rtol=1e-4,
+                err_msg=name,
+            )
+
+    def test_fused_fresh_rows_stay_full_precision(self):
+        """Injected fresh rows must bypass the pool's scales entirely
+        (their scale column pins to 1.0 in-kernel): poisoning the
+        scale pools at every write position must not move the
+        output."""
+        rng = np.random.default_rng(6)
+        kvh, h, hd, steps, b = 2, 4, 16, 4, 2
+        dm = h * hd
+        dout = dm + 2 * kvh * hd
+        x = jnp.asarray(rng.standard_normal((b, steps, dm)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((dm, dout)) * 0.1, jnp.float32)
+        kp = jnp.asarray(
+            rng.standard_normal((9, kvh, da.PAGE_ROWS, hd)), jnp.float32
+        )
+        vp = jnp.asarray(
+            rng.standard_normal((9, kvh, da.PAGE_ROWS, hd)), jnp.float32
+        )
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        table = jnp.asarray(
+            np.arange(1, 9).reshape(2, 4), jnp.int32
+        )
+        index = jnp.asarray([126, 40], jnp.int32)
+        poison_ks, poison_vs = np.asarray(ks).copy(), np.asarray(vs).copy()
+        for s in range(b):
+            for t in range(steps):
+                pos = int(index[s]) + t
+                blk = int(table[s, pos // da.PAGE_ROWS])
+                poison_ks[blk, :, pos % da.PAGE_ROWS] = 1e6
+                poison_vs[blk, :, pos % da.PAGE_ROWS] = 1e6
+        clean = da.fused_qkv_paged_attention(
+            x, w, None, kq, vq, table, index, num_heads=h,
+            k_scales=ks, v_scales=vs, interpret=True,
+        )
+        poisoned = da.fused_qkv_paged_attention(
+            x, w, None, kq, vq, table, index, num_heads=h,
+            k_scales=jnp.asarray(poison_ks),
+            v_scales=jnp.asarray(poison_vs), interpret=True,
+        )
+        for a, bb in zip(clean, poisoned):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+
+
 class TestAmortizedDispatch:
     """`tokens_per_dispatch` changes WHEN the host syncs, never the
     tokens: every chunk size must be bit-identical to the single-step

@@ -497,6 +497,24 @@ class TestContinuousBatchingEndpoint:
         assert "windows" in stats["cb_slo"]
         assert "kinds" in stats["cb_attrib"]
 
+    def test_stats_expose_quant_section(self, cb_server):
+        """/stats carries the quantization view (`cb_quant`,
+        `ContinuousBatcher.quant_stats()`), and /debug/state its
+        `quant` block — this fixture runs the default full-precision
+        dtypes, so the knobs read back 'model' and the feature reads
+        disabled (the WALKAI_CB_KV_DTYPE / WALKAI_LM_W_DTYPE env
+        knobs flip them; engine-level behavior is pinned in
+        tests/test_serve_quant.py)."""
+        quant = get_json(f"{cb_server}/stats").get("cb_quant")
+        assert quant is not None
+        assert quant["enabled"] is False
+        assert quant["kv_dtype"] == "model"
+        assert quant["w_dtype"] == "model"
+        assert quant["kv_bytes_per_token"] > 0
+        assert quant["param_bytes"] > 0
+        state = get_json(f"{cb_server}/debug/state")["engine"]
+        assert state["quant"]["kv_dtype"] == "model"
+
     def test_metrics_prometheus_exposition(self, cb_server):
         """/metrics serves valid Prometheus text with the serving
         registry's series after traffic."""

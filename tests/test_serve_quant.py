@@ -1,0 +1,390 @@
+"""Quantized serving (int8 paged KV + int8 weights, `LMConfig.
+kv_dtype` / `w_dtype`).
+
+Tier-1 surface for the quantization PR, in three layers:
+
+1. **fp32-sim exact parity**: `kv_dtype="int8-sim"` + `w_dtype=
+   "int8-sim"` runs the COMPLETE quantized machinery — parallel
+   scale pools written at emit and read at every fold, QuantDense
+   kernels with scale rows, the scale-carrying cache pytree through
+   the spec round and the device-resident loop carry — with identity
+   quantization and unit scales, so serving output must be
+   TOKEN-IDENTICAL to quant-off serving (and to standalone
+   generation) across greedy/sampled x spec on/off x prefix on/off x
+   loop 1/8. Real int8 can never be token-exact (rounding is the
+   point); the sim arm is how CI proves the data flow — scale
+   indexing, emit/fold seams, sharing, rollback — adds exactly
+   nothing.
+2. **Pool accounting**: scale-pool residency mirrors data residency —
+   scales are nonzero exactly for committed rows of a slot's backed
+   blocks (== ceil(committed/128) blocks) and nowhere else off the
+   scratch block.
+3. **The roofline move**: the dtype-aware attribution cost model
+   (`obs/attrib.py`) must report >= 40% lower HBM bytes per decode
+   step for int8 KV+weights than for the bf16 configuration at
+   identical residency — the PR's acceptance criterion, pinned
+   through the same `cb_device_hbm_bytes_per_step` gauge the live
+   engine maintains.
+
+Deliberately NOT in conftest's `_SLOW_FILES`; shapes stay tiny.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import (
+    DecoderLM,
+    LMConfig,
+    QuantDense,
+    quantize_lm_params,
+)
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+from walkai_nos_tpu.obs.attrib import (
+    DispatchAttribution,
+    kv_hbm_bytes_per_token,
+    params_hbm_bytes,
+)
+from walkai_nos_tpu.obs.serving import ServingObs
+from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+    max_seq_len=512,
+)
+SIM = dataclasses.replace(CFG, kv_dtype="int8-sim", w_dtype="int8-sim")
+INT8 = dataclasses.replace(CFG, kv_dtype="int8", w_dtype="int8")
+
+# Mixed ragged workload crossing 128-row block boundaries mid-prefill
+# (140 > 128) and mid-decode (120 + 12 crosses at step 8).
+GREEDY_SPECS = [(3, 9), (20, 12), (120, 12), (140, 8)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG.vocab_size, n).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def expected_greedy(params):
+    """Standalone-generation expectation per (prompt_len, max_new) —
+    the ONE greedy truth every engine variant (quant on/off, spec,
+    prefix, loop) must reproduce token for token."""
+    gen = make_generate_fn(CFG)
+    out = {}
+    for n, m in GREEDY_SPECS:
+        toks = gen(
+            params, jnp.asarray(_prompt(n, seed=n)[None]),
+            max_new_tokens=m,
+        )
+        out[(n, m)] = [int(t) for t in np.asarray(toks)[0]]
+    return out
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("cache_len", 384)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("chunk_steps", 3)
+    kw.setdefault("prefill_chunk", 32)
+    kw.setdefault("prefill_lanes", 2)
+    if kw.pop("self_draft", False):
+        kw.update(
+            spec=True, spec_k=2, spec_min_accept=0.0,
+            draft_cfg=cfg, draft_params=params,
+        )
+    return ContinuousBatcher(cfg, params, **kw)
+
+
+def _serve_greedy(cfg, params, **kw):
+    engine = _engine(cfg, params, **kw)
+    rids = {
+        engine.submit(_prompt(n, seed=n), max_new_tokens=m): (n, m)
+        for n, m in GREEDY_SPECS
+    }
+    res = engine.run()
+    return {rids[r]: toks for r, toks in res.items()}
+
+
+SAMPLED = dict(max_new_tokens=10, temperature=0.9, top_k=16,
+               top_p=0.9, seed=123)
+
+
+def _serve_sampled(cfg, params, **kw):
+    engine = _engine(cfg, params, **kw)
+    rid_a = engine.submit(_prompt(11, seed=42), **SAMPLED)
+    rid_b = engine.submit(
+        _prompt(130, seed=7), max_new_tokens=8, temperature=0.7,
+        seed=99,
+    )
+    res = engine.run()
+    return res[rid_a], res[rid_b]
+
+
+class TestFp32SimExactParity:
+    """quant-on (sim) serving == quant-off serving, token for token,
+    across the engine's whole feature matrix."""
+
+    def test_greedy_mixed_ragged(self, params, expected_greedy):
+        got = _serve_greedy(SIM, params)
+        assert got == expected_greedy
+
+    def test_sampled_identical_to_quant_off(self, params):
+        want = _serve_sampled(CFG, params)
+        got = _serve_sampled(SIM, params)
+        assert got == want
+
+    def test_spec_self_draft_greedy(self, params, expected_greedy):
+        """Speculative rounds over sim-quantized target AND draft
+        pools (the draft mirrors the same scale-pool machinery):
+        still the standalone greedy stream."""
+        got = _serve_greedy(SIM, params, self_draft=True)
+        assert got == expected_greedy
+
+    def test_prefix_shared_greedy(self, params):
+        """Two requests sharing a 140-token prefix: the second maps
+        the first's sim-quantized blocks — scales ride the shared
+        physical block ids — and both must equal standalone
+        generation."""
+        shared = _prompt(140, seed=140)
+        tail = _prompt(6, seed=9)
+        p2 = np.concatenate([shared[:128], tail])
+        gen = make_generate_fn(CFG)
+        engine = _engine(SIM, params)
+        engine.submit(shared, max_new_tokens=8)
+        engine.run()
+        r2 = engine.submit(p2, max_new_tokens=8)
+        res2 = engine.run()
+        hits = engine.prefix_stats()["block_hits"]
+        assert hits >= 1, "second prompt should reuse shared blocks"
+        want = gen(
+            params, jnp.asarray(p2[None]), max_new_tokens=8
+        )
+        assert res2[r2] == [int(t) for t in np.asarray(want)[0]]
+
+    @pytest.mark.parametrize("sampled", [False, True])
+    def test_loop8(self, params, expected_greedy, sampled):
+        """The device-resident loop folds chunks with the scale
+        pools riding the donated carry: loop 8 sim == quant-off."""
+        if sampled:
+            want = _serve_sampled(CFG, params)
+            got = _serve_sampled(SIM, params, loop_steps=8)
+            assert got == want
+        else:
+            got = _serve_greedy(SIM, params, loop_steps=8)
+            assert got == expected_greedy
+
+    def test_spec_loop_combined(self, params, expected_greedy):
+        """The deepest corner: speculative rounds folded by the
+        device-resident loop, both caches quantized-sim."""
+        got = _serve_greedy(
+            SIM, params, self_draft=True, loop_steps=4
+        )
+        assert got == expected_greedy
+
+
+class TestInt8Serving:
+    """Real int8 serving: not token-exact by design, but it must run
+    the full engine feature set and keep its books straight."""
+
+    def test_serves_full_budgets(self, params):
+        got = _serve_greedy(INT8, params)
+        assert {k: len(v) for k, v in got.items()} == {
+            (n, m): m for n, m in GREEDY_SPECS
+        }
+
+    def test_scale_pool_residency_tracks_committed_rows(self, params):
+        """Scale-pool accounting: after prefill of a 130-token
+        prompt, the slot holds ceil(130/128) == 2 blocks; block 0 of
+        the slot has all 128 scale rows nonzero, block 1 exactly
+        rows 0..1, and no other non-scratch block carries a scale.
+        Residency == ceil(committed/128), row for row."""
+        engine = _engine(INT8, params, slots=1, prefill_lanes=1)
+        engine.submit(_prompt(130, seed=130), max_new_tokens=64)
+        # Drive until the slot flips live (prefill chunks dispatched)
+        # but before any decode chunk advances the write head.
+        for _ in range(32):
+            engine.step()
+            if engine._slot_req[0] is not None:
+                break
+        assert engine._slot_req[0] is not None
+        pos = int(engine._slot_pos[0])
+        blocks = list(engine._slot_blocks[0])
+        assert len(blocks) == -(-pos // PAGE_ROWS)
+        # One representative layer's K scale pool from device state.
+        cache = engine._state[0]
+
+        def find_scale(tree):
+            for name, sub in tree.items():
+                if name == "cached_key_scale":
+                    return sub
+                if hasattr(sub, "keys"):
+                    found = find_scale(sub)
+                    if found is not None:
+                        return found
+            return None
+
+        scales = np.asarray(find_scale(cache))
+        assert scales is not None
+        written = scales > 0
+        for i, blk in enumerate(blocks):
+            rows_in_block = min(max(pos - i * PAGE_ROWS, 0), PAGE_ROWS)
+            assert written[blk, :, :rows_in_block].all(), (i, blk)
+            assert not written[blk, :, rows_in_block:].any(), (i, blk)
+        others = [
+            b for b in range(engine.pool_blocks)
+            if b != 0 and b not in blocks
+        ]
+        assert not written[others].any(), "scales leaked off-slot"
+        engine.run()
+
+    def test_views_and_disabled_shapes(self, params):
+        engine = _engine(INT8, params, obs=False)
+        qs = engine.quant_stats()
+        assert qs["obs_disabled"] is True
+        assert qs["enabled"] is True
+        assert qs["kv_dtype"] == "int8"
+        assert qs["w_dtype"] == "int8"
+        assert engine.debug_state()["quant"]["kv_storage_dtype"] == "int8"
+        on = _engine(INT8, params)
+        qs_on = on.quant_stats()
+        assert "obs_disabled" not in qs_on
+        assert qs_on["kv_cache_bytes"].get("int8", 0) > 0
+        assert qs_on["kv_cache_bytes"].get("scale-f32", 0) > 0
+        assert qs_on["weight_quant_seconds"] > 0
+        assert qs_on["kv_bytes_per_token"] == kv_hbm_bytes_per_token(
+            on.cfg
+        )
+
+
+class TestRooflineMove:
+    """The acceptance criterion: int8 KV + int8 weights cut the
+    analytic HBM bytes per decode step by >= 40% vs the bf16
+    configuration at identical residency — measured through the same
+    dtype-aware cost model and `cb_device_hbm_bytes_per_step` gauge
+    the live engine maintains."""
+
+    # A serving-shaped config: head_dim 64 (the scale row's 4 bytes
+    # amortize over real rows), GQA, modest vocab.
+    ROOF_CFG = LMConfig(
+        vocab_size=512, hidden_dim=128, num_layers=2, num_heads=2,
+        num_kv_heads=2, max_seq_len=512, dtype="bfloat16",
+    )
+
+    def _bytes_per_step(self, cfg, resident=4096):
+        base = DecoderLM(
+            dataclasses.replace(cfg, kv_dtype="model", w_dtype="model")
+        ).init_params(jax.random.PRNGKey(0))
+        served_cfg = dataclasses.replace(
+            cfg, ragged_decode=True, paged_decode=True, paged_blocks=64,
+        )
+        tree = quantize_lm_params(base, served_cfg)
+        obs = ServingObs(enabled=True)
+        attrib = DispatchAttribution(
+            obs,
+            param_bytes=params_hbm_bytes(tree),
+            kv_bytes_per_token=kv_hbm_bytes_per_token(served_cfg),
+            hbm_bytes_per_s=1e12,
+        )
+        attrib.record(
+            kind="decode", steps=1, host_s=0.0, device_s=1e-3,
+            resident_tokens=resident,
+        )
+        return obs.hbm_step_bytes.value()
+
+    def test_int8_cuts_hbm_bytes_per_step_40pct(self):
+        bf16 = self._bytes_per_step(self.ROOF_CFG)
+        int8 = self._bytes_per_step(
+            dataclasses.replace(
+                self.ROOF_CFG, kv_dtype="int8", w_dtype="int8"
+            )
+        )
+        assert int8 <= 0.6 * bf16, (int8, bf16)
+
+    def test_kv_bytes_per_token_dtype_aware(self):
+        c = self.ROOF_CFG
+        hd = c.hidden_dim // c.num_heads
+        assert kv_hbm_bytes_per_token(c) == (
+            c.num_layers * 2 * c.kv_heads * hd * 2
+        )
+        q = dataclasses.replace(c, kv_dtype="int8")
+        assert kv_hbm_bytes_per_token(q) == (
+            c.num_layers * 2 * c.kv_heads * (hd + 4)
+        )
+        f32 = dataclasses.replace(c, dtype="float32")
+        assert kv_hbm_bytes_per_token(f32) == (
+            c.num_layers * 2 * c.kv_heads * hd * 4
+        )
+
+
+class TestQuantDenseAndParams:
+    """Module- and tree-level properties the parity suite rests on."""
+
+    def test_quant_dense_sim_bit_exact_vs_dense(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((3, 32)),
+            jnp.bfloat16,
+        )
+        dense = nn.Dense(16, dtype=jnp.bfloat16, name="d")
+        dp = dense.init(jax.random.PRNGKey(1), x)
+        want = dense.apply(dp, x)
+        qp = {
+            "params": {
+                **dp["params"],
+                "scale": jnp.ones((16,), jnp.float32),
+            }
+        }
+        got = QuantDense(
+            16, dtype=jnp.bfloat16, use_bias=True, sim=True, name="d"
+        ).apply(qp, x)
+        np.testing.assert_array_equal(
+            np.asarray(got, np.float32), np.asarray(want, np.float32)
+        )
+
+    def test_quantize_lm_params_targets_and_idempotence(self, params):
+        q = quantize_lm_params(params, INT8)
+        qkv = q["block0"]["attn"]["qkv"]
+        assert qkv["kernel"].dtype == jnp.int8
+        assert qkv["scale"].shape == (qkv["kernel"].shape[-1],)
+        # Embedding / head / norms untouched.
+        assert (
+            q["embed"]["embedding"].dtype
+            == params["embed"]["embedding"].dtype
+        )
+        assert q["head"]["kernel"].dtype == params["head"]["kernel"].dtype
+        # Idempotent: re-quantizing is a no-op (numpy compare — no
+        # per-leaf jit dispatches).
+        q2 = quantize_lm_params(q, INT8)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(q), jax.tree_util.tree_leaves(q2)
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        # Dequantized kernel tracks the original within int8 steps.
+        want = np.asarray(params["block0"]["attn"]["qkv"]["kernel"])
+        deq = np.asarray(qkv["kernel"], np.float64) * np.asarray(
+            qkv["scale"]
+        )
+        tol = np.abs(want).max(axis=0) / 127 + 1e-9
+        assert (np.abs(deq - want) <= tol[None, :]).all()
+
+    def test_unknown_dtype_rejected_at_construction(self):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            LMConfig(kv_dtype="fp4")
+        with pytest.raises(ValueError, match="w_dtype"):
+            LMConfig(w_dtype="int4")
+
+    def test_kv_quant_requires_paged_engine(self, params):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(
+                INT8, params, slots=2, cache_len=256, paged=False
+            )

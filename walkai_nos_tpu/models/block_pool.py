@@ -27,6 +27,14 @@ Semantics (unchanged from the in-engine version):
   list; `grab_block` evicts parked blocks only when the free list is
   dry. With `prefix=None` the pool is PR 2's exclusive allocator
   exactly (match/park/evict never run).
+- **Dtype-polymorphic by construction**: the pool books BLOCKS, never
+  bytes, so `LMConfig.kv_dtype="int8"` changes nothing here — a
+  physical block id simultaneously names the int8 K/V tiles AND their
+  parallel per-row f32 scale tiles (and, under speculation, the draft
+  model's mirror of both), so allocation, refcounting, parking, and
+  the prefix index's content addressing are one set of books for
+  every storage dtype. Byte accounting lives where the dtypes are
+  known: `kv_stats()` / `obs/attrib.kv_hbm_bytes_per_token`.
 
 The pool records its own gauges (`cb_kv_pool_blocks{state}`,
 `cb_kv_pool_blocks_min_free`, `cb_prefix_evictions_total`,

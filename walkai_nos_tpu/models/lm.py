@@ -28,6 +28,7 @@ from walkai_nos_tpu.ops.decode_attention import (
     MAX_KERNEL_STEPS,
     PAGE_ROWS,
     decode_attention,
+    dequantize_gathered,
     fused_qkv_paged_attention,
     gather_paged_cache,
     paged_decode_attention,
@@ -148,8 +149,42 @@ class LMConfig:
     # backends keep the unfused composition, which stays bit-for-bit
     # today's path.
     fused_qkv: bool = True
+    # Storage dtypes for the decode roofline's two HBM streams
+    # (decode is memory-bound: every step re-reads the weights and
+    # the resident KV once, so every byte not stored is throughput):
+    # - kv_dtype: "model" (the pool stores compute_dtype — today's
+    #   path, bit for bit) | "int8" (paged pools store int8 rows with
+    #   per-row f32 scales in parallel scale pools; quantized at emit
+    #   inside scatter_paged_rows, dequantized at the HBM->VMEM tile
+    #   load) | "int8-sim" (the fp32-sim parity seam: the full scale
+    #   plumbing runs with identity quantization and unit scales, so
+    #   serving output is token-identical to "model" — the arm the
+    #   exact-parity suite pins). Requires paged_decode: the dense
+    #   cache has no block-parallel scale store.
+    # - w_dtype: "model" (params as initialized/loaded) | "int8"
+    #   (the MLP and Q/K/V/O projection kernels store int8 with
+    #   per-output-channel f32 scales — `quantize_lm_params` — and
+    #   dequantize on-chip after the dot) | "int8-sim" (identity
+    #   kernels + unit scales through the same code path).
+    #   Embedding, LM head, and norms stay full precision (the
+    #   AWQ-era convention: their quantization costs quality out of
+    #   proportion to their traffic share).
+    kv_dtype: str = "model"
+    w_dtype: str = "model"
 
     def __post_init__(self):
+        for knob, value in (
+            ("kv_dtype", self.kv_dtype), ("w_dtype", self.w_dtype)
+        ):
+            if value not in ("model", "int8", "int8-sim"):
+                # bad_request-shaped: a clean constructor ValueError
+                # naming the knob and the accepted values, never a
+                # jit-time crash (the demo server's WALKAI_CB_KV_DTYPE
+                # / WALKAI_LM_W_DTYPE env knobs land here).
+                raise ValueError(
+                    f"unknown {knob} {value!r}: expected one of "
+                    f"'model', 'int8', 'int8-sim'"
+                )
         if self.num_kv_heads is not None and (
             self.num_kv_heads < 1
             or self.num_heads % self.num_kv_heads != 0
@@ -185,6 +220,38 @@ class LMConfig:
     @property
     def mlp_width(self) -> int:
         return self.mlp_dim or self.mlp_ratio * self.hidden_dim
+
+    @property
+    def kv_quant(self) -> str | None:
+        """The paged pool's quantization mode for
+        `ops/decode_attention`: None (unquantized), "int8", or "sim"
+        (the fp32-sim parity arm)."""
+        if self.kv_dtype == "int8":
+            return "int8"
+        if self.kv_dtype == "int8-sim":
+            return "sim"
+        return None
+
+    @property
+    def kv_storage_dtype(self):
+        """The paged K/V pools' storage dtype: int8 for kv_dtype=
+        "int8", otherwise the compute dtype (including "int8-sim" —
+        the sim arm stores full-precision values so the round-trip
+        is bit-exact)."""
+        return (
+            jnp.dtype(jnp.int8) if self.kv_dtype == "int8"
+            else self.compute_dtype
+        )
+
+    @property
+    def w_quant(self) -> str | None:
+        """The projection/MLP kernels' quantization mode: None,
+        "int8", or "sim"."""
+        if self.w_dtype == "int8":
+            return "int8"
+        if self.w_dtype == "int8-sim":
+            return "sim"
+        return None
 
 
 LM_TINY = LMConfig(
@@ -281,6 +348,135 @@ def _make_norm(cfg: LMConfig, name: str):
     )
 
 
+class QuantDense(nn.Module):
+    """Dense layer over an int8 per-output-channel quantized kernel.
+
+    Param scope matches `nn.Dense` plus a `scale` leaf ([features]
+    f32), so a quantized tree keeps the full-precision tree's paths
+    (block0/attn/qkv/{kernel,scale,bias}) — checkpoints transform
+    through `quantize_lm_params`, nothing else moves. The kernel is
+    stored int8 in HBM and dequantized AFTER the dot: a per-output-
+    channel scale commutes with the contraction (x @ (W_q * s) ==
+    (x @ W_q) * s), so the full-precision weight never materializes —
+    on TPU the int8->compute convert fuses into the matmul operand
+    read and the HBM stream is the int8 bytes.
+
+    `sim=True` is the fp32-sim parity arm: the kernel keeps its
+    original storage (f32 param_dtype, like nn.Dense) and the scale
+    row is all-ones, so the op sequence (dot in compute dtype, f32
+    scale multiply by exactly 1.0, cast back) is bit-identical to
+    nn.Dense — the serving parity suite runs the quantized CODE PATH
+    with lossless arithmetic."""
+
+    features: int
+    dtype: object
+    use_bias: bool = True
+    sim: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        store = jnp.float32 if self.sim else jnp.int8
+        kernel = self.param(
+            "kernel", nn.initializers.zeros,
+            (x.shape[-1], self.features), store,
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        dims = (((x.ndim - 1,), (0,)), ((), ()))
+        x = x.astype(self.dtype)
+        if self.sim:
+            # Mirror nn.Dense exactly (same dot, no preferred
+            # element type), then the identity dequant.
+            y = jax.lax.dot_general(x, kernel.astype(self.dtype), dims)
+            y = (y.astype(jnp.float32) * scale).astype(self.dtype)
+        else:
+            # int8 -> compute dtype is lossless (|q| <= 127); keep
+            # the f32 accumulator through the dequant multiply so
+            # the scale applies before any rounding to compute dtype.
+            y = jax.lax.dot_general(
+                x, kernel.astype(self.dtype), dims,
+                preferred_element_type=jnp.float32,
+            )
+            y = (y * scale).astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,),
+                jnp.float32,
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def _dense(cfg: LMConfig, features: int, name: str):
+    """The decode-path projection/MLP Dense factory: `nn.Dense` at
+    w_dtype="model", `QuantDense` otherwise — one switch point, so
+    every quantizable matmul (qkv, out_proj, gate, fc1, fc2) flips
+    together and none can be missed."""
+    if cfg.w_quant:
+        return QuantDense(
+            features, dtype=cfg.compute_dtype, use_bias=cfg.use_bias,
+            sim=cfg.w_quant == "sim", name=name,
+        )
+    return nn.Dense(
+        features, dtype=cfg.compute_dtype, use_bias=cfg.use_bias,
+        name=name,
+    )
+
+
+# The Dense scopes `quantize_lm_params` transforms — exactly the ones
+# `_dense` builds. Embedding, head, and norms stay full precision.
+_QUANT_DENSE_NAMES = ("qkv", "out_proj", "gate", "fc1", "fc2")
+
+
+def quantize_lm_params(params, cfg: LMConfig):
+    """Transform a full-precision param tree for `cfg.w_dtype`.
+
+    "int8": each targeted Dense kernel quantizes symmetrically per
+    OUTPUT channel (scale = column amax / 127, f32), stored int8 with
+    the f32 `scale` row beside it; biases and everything untargeted
+    pass through. "int8-sim": kernels unchanged, unit scales — the
+    lossless arm. "model": the tree passes through untouched.
+    Idempotent: a scope already carrying a `scale` leaf is left
+    alone, so the serving engine can quantize unconditionally at
+    build time whether the caller handed it a raw or pre-quantized
+    checkpoint."""
+    if not cfg.w_quant:
+        return params
+    sim = cfg.w_quant == "sim"
+
+    def transform(scope):
+        if "scale" in scope:
+            return scope  # already quantized
+        kernel = scope["kernel"]
+        if sim:
+            return {
+                **scope,
+                "scale": jnp.ones((kernel.shape[-1],), jnp.float32),
+            }
+        k32 = jnp.asarray(kernel, jnp.float32)
+        amax = jnp.max(jnp.abs(k32), axis=0)
+        scale = jnp.maximum(amax / 127.0, 1e-12)
+        q = jnp.clip(jnp.round(k32 / scale), -127, 127).astype(jnp.int8)
+        return {**scope, "kernel": q, "scale": scale}
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if (
+                name in _QUANT_DENSE_NAMES
+                and hasattr(sub, "keys") and "kernel" in sub
+            ):
+                out[name] = transform(dict(sub))
+            elif hasattr(sub, "keys"):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return walk(params)
+
+
 def _fused_qkv_backend_ok() -> bool:
     """Host-side routing gate for the fused QKV/rotary decode kernel:
     real TPU, or the explicit interpret-mode CI opt-in. Deliberately
@@ -320,17 +516,11 @@ class CausalAttention(nn.Module):
             o = o.transpose(0, 2, 1, 3).reshape(
                 x.shape[0], x.shape[1], d
             )
-            return nn.Dense(
-                d, dtype=c.compute_dtype, use_bias=c.use_bias,
-                name="out_proj",
-            )(o)
+            return _dense(c, d, "out_proj")(o)
         # Fused projection: [q | k | v] channel blocks. With GQA the
         # K/V blocks are kv_heads wide; at kv_heads == num_heads this
         # is the same 3d-channel kernel (and layout) as always.
-        qkv = nn.Dense(
-            d + 2 * kv_dim, dtype=c.compute_dtype, use_bias=c.use_bias,
-            name="qkv",
-        )(x)
+        qkv = _dense(c, d + 2 * kv_dim, "qkv")(x)
         b, s = x.shape[0], x.shape[1]
         q = qkv[..., :d].reshape(
             b, s, c.num_heads, head_dim
@@ -360,9 +550,7 @@ class CausalAttention(nn.Module):
                 v = jnp.repeat(v, c.num_heads // kv_heads, axis=1)
             o = self._sequence_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
-        return nn.Dense(
-            d, dtype=c.compute_dtype, use_bias=c.use_bias, name="out_proj"
-        )(o)
+        return _dense(c, d, "out_proj")(o)
 
     def _sequence_attention(self, q, k, v):
         c = self.cfg
@@ -386,6 +574,12 @@ class CausalAttention(nn.Module):
         c = self.cfg
         if c.paged_decode:
             return self._paged_decode_attention(q, k, v, block_table)
+        if c.kv_quant:
+            raise ValueError(
+                "kv_dtype != 'model' requires paged_decode (the "
+                "per-row scale store is block-parallel; the dense "
+                "cache has no block pool to parallel)"
+            )
         cache_len = c.cache_len or c.max_seq_len
         batch, heads, steps, head_dim = q.shape
         kv_heads = k.shape[1]
@@ -490,13 +684,31 @@ class CausalAttention(nn.Module):
         c = self.cfg
         batch, heads, steps, head_dim = q.shape
         kv_heads = k.shape[1]
+        quant = c.kv_quant
         pool_shape = (c.paged_blocks, kv_heads, PAGE_ROWS, head_dim)
         pool_k = self.variable(
-            "cache", "cached_key", jnp.zeros, pool_shape, c.compute_dtype
+            "cache", "cached_key", jnp.zeros, pool_shape,
+            c.kv_storage_dtype,
         )
         pool_v = self.variable(
-            "cache", "cached_value", jnp.zeros, pool_shape, c.compute_dtype
+            "cache", "cached_value", jnp.zeros, pool_shape,
+            c.kv_storage_dtype,
         )
+        if quant:
+            # Parallel per-row scale pools, indexed by the same
+            # physical block ids (shared prefix blocks carry their
+            # scales with them). Zero-initialized: an unwritten row
+            # dequantizes to exactly zero — the same poison story as
+            # the zero-initialized data pools.
+            scale_shape = (c.paged_blocks, kv_heads, PAGE_ROWS)
+            scale_k = self.variable(
+                "cache", "cached_key_scale", jnp.zeros, scale_shape,
+                jnp.float32,
+            )
+            scale_v = self.variable(
+                "cache", "cached_value_scale", jnp.zeros, scale_shape,
+                jnp.float32,
+            )
         index = self.variable(
             "cache", "cache_index",
             lambda: jnp.zeros((batch,), jnp.int32),
@@ -516,10 +728,22 @@ class CausalAttention(nn.Module):
         # and DROP (never clip — a clipped write would rewrite the
         # slot's last real block in-place); the one write rule lives
         # in ops/decode_attention.scatter_paged_rows, shared with the
-        # fused QKV path.
-        k_pool, v_pool = scatter_paged_rows(
-            pool_k.value, pool_v.value, k, v, block_table, idx
-        )
+        # fused QKV path. Quantized pools quantize fresh rows HERE —
+        # at emit — so the unfused path, the fused kernel's caller,
+        # and the device-resident loop's in-body scatters all share
+        # one quantization seam.
+        ks = vs = None
+        if quant:
+            k_pool, v_pool, ks, vs = scatter_paged_rows(
+                pool_k.value, pool_v.value, k, v, block_table, idx,
+                k_scale_pool=scale_k.value, v_scale_pool=scale_v.value,
+                quant=quant,
+            )
+            scale_k.value, scale_v.value = ks, vs
+        else:
+            k_pool, v_pool = scatter_paged_rows(
+                pool_k.value, pool_v.value, k, v, block_table, idx
+            )
         pool_k.value, pool_v.value = k_pool, v_pool
         index.value = idx + steps
         if steps <= MAX_KERNEL_STEPS:
@@ -529,13 +753,22 @@ class CausalAttention(nn.Module):
             # mode: the gather alternative would copy the cache.
             if steps == 1:
                 return paged_decode_attention(
-                    q[:, :, 0], k_pool, v_pool, block_table, idx
+                    q[:, :, 0], k_pool, v_pool, block_table, idx,
+                    k_scales=ks, v_scales=vs,
                 )[:, :, None, :]
             return paged_decode_attention(
-                q, k_pool, v_pool, block_table, idx
+                q, k_pool, v_pool, block_table, idx,
+                k_scales=ks, v_scales=vs,
             )
-        k_all = gather_paged_cache(k_pool, block_table)
-        v_all = gather_paged_cache(v_pool, block_table)
+        if quant:
+            # Wide prefill chunks dequantize the gathered view once
+            # (the gather already defeats paging; the dequant rides
+            # the same copy).
+            k_all = dequantize_gathered(k_pool, ks, block_table, q.dtype)
+            v_all = dequantize_gathered(v_pool, vs, block_table, q.dtype)
+        else:
+            k_all = gather_paged_cache(k_pool, block_table)
+            v_all = gather_paged_cache(v_pool, block_table)
         return _masked_cache_attention(q, k_all, v_all, idx, True)
 
     def _fused_paged_decode(self, x, block_table):
@@ -553,14 +786,27 @@ class CausalAttention(nn.Module):
         c = self.cfg
         head_dim = c.hidden_dim // c.num_heads
         kv_heads = c.kv_heads
+        quant = c.kv_quant
         batch, steps = x.shape[0], x.shape[1]
         pool_shape = (c.paged_blocks, kv_heads, PAGE_ROWS, head_dim)
         pool_k = self.variable(
-            "cache", "cached_key", jnp.zeros, pool_shape, c.compute_dtype
+            "cache", "cached_key", jnp.zeros, pool_shape,
+            c.kv_storage_dtype,
         )
         pool_v = self.variable(
-            "cache", "cached_value", jnp.zeros, pool_shape, c.compute_dtype
+            "cache", "cached_value", jnp.zeros, pool_shape,
+            c.kv_storage_dtype,
         )
+        if quant:
+            scale_shape = (c.paged_blocks, kv_heads, PAGE_ROWS)
+            scale_k = self.variable(
+                "cache", "cached_key_scale", jnp.zeros, scale_shape,
+                jnp.float32,
+            )
+            scale_v = self.variable(
+                "cache", "cached_value_scale", jnp.zeros, scale_shape,
+                jnp.float32,
+            )
         index = self.variable(
             "cache", "cache_index",
             lambda: jnp.zeros((batch,), jnp.int32),
@@ -570,7 +816,15 @@ class CausalAttention(nn.Module):
                 "paged_decode requires block_table= at apply time"
             )
         qkv_params = self.get_variable("params", "qkv")
-        kernel = qkv_params["kernel"].astype(c.compute_dtype)
+        w_scale = None
+        if c.w_quant:
+            # QuantDense scope: int8 (or sim) kernel + per-channel
+            # scale row, streamed as-is — the kernel dequantizes in
+            # VMEM after the dot.
+            kernel = qkv_params["kernel"]
+            w_scale = qkv_params["scale"].astype(jnp.float32)
+        else:
+            kernel = qkv_params["kernel"].astype(c.compute_dtype)
         bias = (
             qkv_params["bias"].astype(c.compute_dtype)
             if c.use_bias else None
@@ -581,10 +835,27 @@ class CausalAttention(nn.Module):
             pool_k.value, pool_v.value, block_table, idx,
             num_heads=c.num_heads,
             rope_theta=c.rope_theta if c.rope else None,
+            w_scale=w_scale,
+            k_scales=scale_k.value if quant else None,
+            v_scales=scale_v.value if quant else None,
         )
-        pool_k.value, pool_v.value = scatter_paged_rows(
-            pool_k.value, pool_v.value, k_new, v_new, block_table, idx
-        )
+        if quant:
+            # The kernel attended to the fresh rows at full precision
+            # (in-VMEM injection); they quantize HERE, at the one
+            # emit seam.
+            kp, vp, ks, vs = scatter_paged_rows(
+                pool_k.value, pool_v.value, k_new, v_new,
+                block_table, idx,
+                k_scale_pool=scale_k.value, v_scale_pool=scale_v.value,
+                quant=quant,
+            )
+            pool_k.value, pool_v.value = kp, vp
+            scale_k.value, scale_v.value = ks, vs
+        else:
+            pool_k.value, pool_v.value = scatter_paged_rows(
+                pool_k.value, pool_v.value, k_new, v_new,
+                block_table, idx,
+            )
         index.value = idx + steps
         return o
 
@@ -676,25 +947,13 @@ class DecoderBlock(nn.Module):
                 name="moe",
             )(h)
         if c.mlp == "swiglu":
-            gate = nn.Dense(
-                c.mlp_width, dtype=c.compute_dtype, use_bias=c.use_bias,
-                name="gate",
-            )(h)
-            up = nn.Dense(
-                c.mlp_width, dtype=c.compute_dtype, use_bias=c.use_bias,
-                name="fc1",
-            )(h)
+            gate = _dense(c, c.mlp_width, "gate")(h)
+            up = _dense(c, c.mlp_width, "fc1")(h)
             h = nn.silu(gate) * up
         else:
-            h = nn.Dense(
-                c.mlp_width, dtype=c.compute_dtype, use_bias=c.use_bias,
-                name="fc1",
-            )(h)
+            h = _dense(c, c.mlp_width, "fc1")(h)
             h = nn.gelu(h)
-        return x + nn.Dense(
-            c.hidden_dim, dtype=c.compute_dtype, use_bias=c.use_bias,
-            name="fc2",
-        )(h)
+        return x + _dense(c, c.hidden_dim, "fc2")(h)
 
 
 class DecoderLM(nn.Module):

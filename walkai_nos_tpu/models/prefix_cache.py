@@ -35,6 +35,14 @@ This module is the host-side index only — pure bookkeeping, no jax:
   refcount(child)` by construction and a refcount-0 node's whole
   subtree is reclaimable — `parked_blocks` counts exactly the blocks
   eviction can hand back.
+- **Storage-dtype independent.** Content addressing hashes prompt
+  TOKEN bytes, never K/V bytes, so a quantized pool
+  (`LMConfig.kv_dtype="int8"`) changes nothing here: the physical
+  block id a node names simultaneously addresses the int8 K/V tiles
+  and their parallel per-row scale tiles, so a shared block carries
+  its scales with it and a cache hit reproduces the writer's
+  quantized rows exactly (bit-for-bit the same stored bytes — the
+  same exactness argument as full precision, one level down).
 
 The engine owns physical allocation; this index never touches the
 free list. Lifecycle of a pool block: free -> private (allocated to

@@ -104,6 +104,19 @@ requests come and go):
   synchronous (the next round's positions depend on this round's
   acceptance), trading the plain path's one-chunk pipelining for up
   to k+1 tokens per slot per dispatch.
+- **Quantized storage** (`LMConfig.kv_dtype` / `w_dtype`, int8):
+  decode re-reads the weights and the resident KV every step, so the
+  engine can store BOTH at int8 — paged pools as int8 rows with
+  per-row f32 scale tiles in parallel pools under the same block ids
+  (quantized at emit in `scatter_paged_rows`, dequantized in the
+  kernels' shared fold; shared prefix blocks carry their scales), and
+  the projection/MLP kernels per-output-channel int8 dequantized
+  on-chip (`quantize_lm_params`, applied by the engine to its own
+  copy at build). HBM bytes per step — and with them the analytic
+  roofline the attribution gauges track — drop by roughly the
+  storage ratio. The `int8-sim` arm runs the identical machinery
+  losslessly, so quant-on serving is token-identical to quant-off in
+  sim mode across every engine feature (tests/test_serve_quant.py).
 - **Chunked, pipelined stepping**: the step program scans
   `chunk_steps` decode steps on-device and carries the token vector in
   device state; the host keeps ONE chunk in flight and fetches chunk
@@ -147,14 +160,23 @@ import numpy as np
 
 from walkai_nos_tpu.models.block_pool import BlockPool
 from walkai_nos_tpu.models.decode import sample_rows
-from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.lm import (
+    DecoderLM,
+    LMConfig,
+    quantize_lm_params,
+)
 from walkai_nos_tpu.models.prefix_cache import PrefixIndex
 from walkai_nos_tpu.models.speculative import (
     accept_tokens,
     cache_positions,
     rewind_cache,
 )
-from walkai_nos_tpu.obs.attrib import DispatchAttribution, classify_dispatch
+from walkai_nos_tpu.obs.attrib import (
+    DispatchAttribution,
+    classify_dispatch,
+    kv_hbm_bytes_per_token,
+    params_hbm_bytes,
+)
 from walkai_nos_tpu.obs.serving import ServingObs
 from walkai_nos_tpu.obs.slo import SloTracker
 from walkai_nos_tpu.ops.decode_attention import MAX_KERNEL_STEPS, PAGE_ROWS
@@ -339,6 +361,12 @@ class ContinuousBatcher:
                 "device-resident loop pre-backs per-slot block tables "
                 "to its horizon; the dense cache has no table)"
             )
+        if cfg.kv_dtype != "model" and not paged:
+            raise ValueError(
+                f"kv_dtype={cfg.kv_dtype!r} requires the paged engine "
+                f"(the per-row scale pools parallel the block pool; "
+                f"the dense cache has none)"
+            )
         self.loop_steps = loop_steps
         self.paged = paged
         self.params = params
@@ -439,23 +467,41 @@ class ContinuousBatcher:
             self.obs = obs
         else:
             self.obs = ServingObs(enabled=bool(obs))
+        # Weight quantization (`cfg.w_dtype`): the param tree
+        # transforms ONCE at build — int8 kernels + per-channel f32
+        # scales for the projection/MLP matmuls, dequantized on-chip —
+        # and the host seconds land in cb_quant_dequant_seconds_total.
+        # Idempotent, so pre-quantized checkpoints pass through; the
+        # caller's tree is never mutated (a demo server can keep its
+        # full-precision copy for the one-shot path).
+        t_quant = time.monotonic()
+        self.params = quantize_lm_params(self.params, self.cfg)
+        if self._spec:
+            self.draft_params = quantize_lm_params(
+                self.draft_params, self._draft_cfg
+            )
+        if self.cfg.w_quant:
+            jax.block_until_ready(self.params)
+        self.obs.quant_seconds.inc(time.monotonic() - t_quant)
+        self._record_kv_backing_bytes()
         # Device-time attribution (obs/attrib.py): every dispatch's
         # blocked device sync vs host assembly, classified by
         # composition and paired with the analytic HBM cost model the
         # bench uses — the live cb_device_step_ms /
         # cb_host_overhead_frac / cb_device_roofline_fraction gauges.
+        # Both cost-model inputs are DTYPE-AWARE: param bytes from the
+        # (possibly int8) tree's actual leaf storage, KV bytes from
+        # the pool's storage dtype + scale rows — quantization moves
+        # these gauges, live.
         from walkai_nos_tpu.utils.flops import hbm_bytes_per_s
         try:
             bw = hbm_bytes_per_s(jax.devices()[0].device_kind)
         except Exception:  # noqa: BLE001 — telemetry must not gate serving
             bw = None
-        param_bytes = sum(
-            int(getattr(leaf, "nbytes", 0))
-            for leaf in jax.tree_util.tree_leaves(params)
-        )
+        self._param_bytes = params_hbm_bytes(self.params)
         self._attrib = DispatchAttribution(
             self.obs,
-            param_bytes=param_bytes,
+            param_bytes=self._param_bytes,
             kv_bytes_per_token=self._kv_bytes_per_token(),
             hbm_bytes_per_s=bw,
         )
@@ -1598,6 +1644,7 @@ class ContinuousBatcher:
             "prefix": self.prefix_stats(),
             "spec": self.spec_stats(),
             "loop": self.loop_stats(),
+            "quant": self.quant_stats(),
             "attrib": self.attrib_stats(),
             "slo": self.slo_stats(),
         }
@@ -1614,10 +1661,65 @@ class ContinuousBatcher:
     # -- internals -----------------------------------------------------
 
     def _kv_bytes_per_token(self) -> int:
+        """Physical KV bytes per resident token — the shared
+        dtype-aware cost model (`obs/attrib.py`): storage-dtype item
+        size plus the f32 scale row a quantized pool carries."""
+        return kv_hbm_bytes_per_token(self.cfg)
+
+    def _record_kv_backing_bytes(self) -> None:
+        """One-shot `cb_kv_cache_bytes_total{dtype}` accounting: the
+        paged pools' allocated backing bytes by storage dtype, the
+        draft model's mirrored pools included, with quantized pools
+        split into their data bytes and their parallel f32 scale
+        tiles — the /metrics view of what the quantization knob did
+        to resident cache memory."""
+        if not self.paged:
+            return
+        tokens = self.pool_blocks * PAGE_ROWS
+
+        def record(cfg: LMConfig) -> None:
+            head_dim = cfg.hidden_dim // cfg.num_heads
+            per_head = cfg.num_layers * 2 * cfg.kv_heads
+            data = tokens * per_head * (
+                head_dim * cfg.kv_storage_dtype.itemsize
+            )
+            self.obs.kv_cache_bytes.inc(
+                data, {"dtype": str(cfg.kv_storage_dtype)}
+            )
+            if cfg.kv_quant:
+                self.obs.kv_cache_bytes.inc(
+                    tokens * per_head * 4, {"dtype": "scale-f32"}
+                )
+
+        record(self.cfg)
+        if self._spec:
+            record(self._draft_cfg)
+
+    def quant_stats(self) -> dict:
+        """Quantization telemetry — the `/stats` `cb_quant` section
+        and the `/debug/state` `quant` block: the configured dtypes,
+        the physical per-token KV cost and param bytes the roofline
+        model runs on, and the registry's quant counters. Same shape
+        + `obs_disabled` with telemetry off (the PR 3 convention)."""
         c = self.cfg
-        head_dim = c.hidden_dim // c.num_heads
-        dtype_bytes = 2 if "bfloat16" in str(c.dtype) else 4
-        return c.num_layers * 2 * c.kv_heads * head_dim * dtype_bytes
+        kv_cache_bytes = {}
+        for label in (str(c.kv_storage_dtype), "scale-f32"):
+            value = self.obs.kv_cache_bytes.value({"dtype": label})
+            if value:
+                kv_cache_bytes[label] = int(value)
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "enabled": bool(c.kv_quant or c.w_quant),
+            "kv_dtype": c.kv_dtype,
+            "w_dtype": c.w_dtype,
+            "kv_storage_dtype": str(c.kv_storage_dtype),
+            "kv_bytes_per_token": self._kv_bytes_per_token(),
+            "param_bytes": self._param_bytes,
+            "kv_cache_bytes": kv_cache_bytes,
+            "weight_quant_seconds": round(
+                self.obs.quant_seconds.value(), 6
+            ),
+        }
 
     # Pool bookkeeping lives in `models/block_pool.py`; these views
     # keep the engine's historical attribute surface (tests and debug

@@ -29,6 +29,8 @@ trace/profile how-to.
 from walkai_nos_tpu.obs.attrib import (  # noqa: F401
     DispatchAttribution,
     classify_dispatch,
+    kv_hbm_bytes_per_token,
+    params_hbm_bytes,
 )
 from walkai_nos_tpu.obs.metrics import (  # noqa: F401
     Counter,

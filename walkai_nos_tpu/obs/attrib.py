@@ -32,7 +32,13 @@ Mechanics, all host-side at the engine's existing sync seams:
   `cb_device_roofline_fraction` tracks continuously what
   `decode_gqa_roofline_fraction` records once per bench round. On
   hosts with no published bandwidth (CPU CI) the fraction is simply
-  never set.
+  never set. The model is DTYPE-AWARE (`params_hbm_bytes` /
+  `kv_hbm_bytes_per_token`): param bytes come from the tree's actual
+  leaf storage and KV bytes from the pool's storage dtype plus its
+  scale rows, so when int8 quantization halves the traffic the
+  `cb_device_hbm_bytes_per_step` / `cb_device_roofline_fraction`
+  gauges show the ceiling itself moving rather than flattering the
+  old one.
 
 Live gauges are maintained over a short trailing window of dispatches
 (`window` — big enough to smooth one-off syncs, small enough to react
@@ -48,7 +54,44 @@ from __future__ import annotations
 
 from collections import deque
 
-__all__ = ["DISPATCH_KINDS", "DispatchAttribution", "classify_dispatch"]
+__all__ = [
+    "DISPATCH_KINDS",
+    "DispatchAttribution",
+    "classify_dispatch",
+    "kv_hbm_bytes_per_token",
+    "params_hbm_bytes",
+]
+
+
+def params_hbm_bytes(params) -> int:
+    """HBM bytes one decode step streams for the weights: the param
+    tree's ACTUAL storage bytes (leaf nbytes), not an element count
+    times an assumed width — an int8-quantized tree (its f32 scale
+    rows included) reports its true, smaller footprint, so the
+    roofline gauges move when quantization moves the ceiling."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+
+
+def kv_hbm_bytes_per_token(cfg) -> int:
+    """Physical KV-cache HBM bytes backing one resident token, from
+    the ACTUAL storage dtype (`LMConfig.kv_storage_dtype`), not a
+    hardcoded 2 B/elem: per layer, K and V each store `head_dim`
+    elements per kv head at the pool's item size, plus — for
+    quantized pools (the fp32-sim arm included; its scale pools are
+    physically resident too) — one f32 scale per row per head. The
+    ONE per-token cost the analytic roofline model, `kv_stats()`,
+    and `cb_kv_hbm_bytes_per_resident_token` all derive from."""
+    head_dim = cfg.hidden_dim // cfg.num_heads
+    item = cfg.kv_storage_dtype.itemsize
+    scale_bytes = 4 if cfg.kv_quant else 0
+    return cfg.num_layers * 2 * cfg.kv_heads * (
+        head_dim * item + scale_bytes
+    )
 
 # Every value the `kind` label can take, in documentation order.
 DISPATCH_KINDS = ("decode", "prefill", "mixed", "spec", "spec_prefill")

@@ -293,6 +293,23 @@ CATALOG: tuple[MetricSpec, ...] = (
         attr="kv_ratio",
     ),
     MetricSpec(
+        "cb_kv_cache_bytes_total", "counter",
+        "KV pool backing bytes allocated at engine build, by storage "
+        "dtype (quantized pools split into int8 data and their "
+        "parallel f32 scale tiles; a second engine on the registry "
+        "adds its own)",
+        labels=("dtype",),  # int8 | bfloat16 | float32 | scale-f32
+        attr="kv_cache_bytes",
+    ),
+    MetricSpec(
+        "cb_quant_dequant_seconds_total", "counter",
+        "Host seconds in quantization work (the one-time weight-tree "
+        "quantization at engine build; device-side dequant is fused "
+        "into the kernels and is attributed to "
+        "cb_device_time_seconds_total, not here)",
+        attr="quant_seconds",
+    ),
+    MetricSpec(
         "cb_last_dispatch_unixtime_seconds", "gauge",
         "Unix time of the most recent engine dispatch (scrape-side "
         "staleness = now - value)",

@@ -54,6 +54,18 @@ row r iff p <= index + r. Rows above the index hold whatever the ring
 buffer holds — typically zeros — and are never read past the block
 boundary, so the kernel is exact for any cache length bucket.
 
+**Quantized pools** (kv int8): the paged pools are dtype-polymorphic —
+int8 K/V rows with per-row f32 scales in PARALLEL scale pools indexed
+by the same physical block ids. Fresh rows quantize once at emit
+(`scatter_paged_rows`) and dequantize where the tile meets VMEM: the
+shared `_stream_fold` takes per-column scale rows, converts the int8
+tile losslessly to the compute dtype, and factors the per-row scale
+out of the two dots (score columns for K, probability columns for V).
+Every HBM byte the pool doesn't store is decode throughput — the
+roofline's numerator shrinks by ~the storage ratio. `quant="sim"` is
+the lossless parity arm: identity values, unit scales, the same
+plumbing.
+
 **Paged variant** (`paged_decode_attention`): the serving engine
 (`models/serve.py`) stores K/V in a SHARED pool of 128-row physical
 blocks instead of a dense `[slots, cache_len]` cache; a per-slot block
@@ -160,6 +172,7 @@ _VMEM_SCORE_BUDGET_BYTES = 2 * 1024 * 1024
 def _stream_fold(
     j, last, lim_fn, n_cells, cell_rows, steps,
     q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    ks=None, vs=None,
 ):
     """The ONE online-softmax fold both streamed kernels run per
     (cell-block, cache-block) grid step: fold one 128-row K/V block of
@@ -195,7 +208,18 @@ def _stream_fold(
     natively with f32 accumulation — an astype(f32) here would spend
     VPU cycles converting the whole cache block and double its vreg
     footprint. The softmax scale is applied to the f32 scores, not
-    pre-applied to a bf16 q, which would round the scaled query."""
+    pre-applied to a bf16 q, which would round the scaled query.
+
+    `ks` / `vs` are the int8-pool dequantization seam: per-COLUMN
+    f32 scale rows ([1, n_cells*s_blk], one scale per cache row in
+    the streamed tile). When present, the int8 tiles convert to q's
+    dtype (lossless — |int8| <= 127 is exact in bf16) for the MXU
+    dots and the per-row scale factors out of the linear algebra:
+    K scales multiply the f32 SCORE columns (s_c * (q·k_c) ==
+    q·(s_c*k_c)) and V scales fold into the probability columns
+    before the PV dot (Σ_c p_c*s_c*v_c) — O(rows x cols) + O(cols)
+    work instead of re-widening the whole [cols, d] tile. None =
+    the unquantized path, untouched bit for bit."""
     gs = cell_rows
     d = q_ref.shape[-1]
     s_blk = k_ref.shape[-2]
@@ -213,10 +237,15 @@ def _stream_fold(
         qf = q_ref[...].reshape(rows, d)
         kf = k_ref[...].reshape(n_cells * s_blk, d)
         vf = v_ref[...].reshape(n_cells * s_blk, d)
+        if ks is not None:
+            kf = kf.astype(qf.dtype)
+            vf = vf.astype(qf.dtype)
         sc = jax.lax.dot_general(
             qf, kf, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [rows, n_cells*s_blk] f32
+        if ks is not None:
+            sc = sc * ks  # per-key-row dequant on the f32 scores
         row_ids = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0)
         col_ids = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
         cell_r = row_ids // gs
@@ -232,8 +261,9 @@ def _stream_fold(
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(sc - m_new)
         l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pf = (p * vs) if vs is not None else p
         pv = jax.lax.dot_general(
-            p.astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
+            pf.astype(vf.dtype), vf, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         acc_new = acc_ref[...] * alpha + pv
@@ -419,46 +449,149 @@ def gather_paged_cache(pool: jax.Array, table: jax.Array) -> jax.Array:
     )
 
 
-def paged_decode_attention_reference(
-    q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
-    table: jax.Array, index: jax.Array,
+# -- int8 KV quantization ----------------------------------------------
+#
+# The paged pools are dtype-polymorphic: with `LMConfig.kv_dtype=
+# "int8"` each physical 128-row block stores int8 K/V plus a PARALLEL
+# per-row fp32 scale tile ([kv_heads, PAGE_ROWS] per block) in a scale
+# pool indexed by the SAME physical block id — shared prefix blocks
+# carry their scales with them, refcounts and the radix index are
+# untouched. Quantization is symmetric per (position, kv-head) row
+# over head_dim: one scale per cache row, grouped into block-parallel
+# tiles. Per-ROW rather than one scalar per block because rows land in
+# a block INCREMENTALLY (one decode step at a time): a whole-block
+# scale fixed by the early rows would clip later ones, and re-scaling
+# already-written int8 rows would need a read-modify-write of the
+# block. Rows quantize ONCE at emit (`scatter_paged_rows`, the one
+# paged write rule all three writers share) and dequantize where the
+# tile meets VMEM (`_stream_fold`'s per-column scale application; the
+# gather references off-TPU), so every consumer sees one quantization
+# semantics.
+#
+# `quant="sim"` is the fp32-sim seam: the pool keeps the model dtype,
+# quantize is the identity and every scale is exactly 1.0 — the full
+# scale plumbing (parallel pools, scale gathers, per-column
+# application) runs while the arithmetic stays bit-identical to the
+# unquantized path. That is what lets the serving parity suite prove
+# quant-on serving == quant-off token for token on CPU
+# (tests/test_serve_quant.py) independent of int8 rounding.
+
+KV_QUANT_MODES = ("int8", "sim")
+_INT8_MAX = 127.0
+# Per-row scale floor: an all-zero row (zero-initialized pool regions,
+# pad rows) quantizes to zeros under this scale instead of dividing by
+# zero; dequantized it stays exactly zero.
+_SCALE_TINY = 1e-12
+
+
+def quantize_kv_rows(
+    rows: jax.Array, quant: str
+) -> tuple[jax.Array, jax.Array]:
+    """rows [..., head_dim] -> (stored [..., head_dim], scales [...]).
+
+    "int8": symmetric per-row quantization, scale = amax/127 in f32
+    (floored at `_SCALE_TINY`), values rounded and clipped to int8.
+    "sim": the identity with unit scales — the lossless arm that runs
+    the same plumbing. `stored` is cast to the pool dtype by the
+    scatter."""
+    if quant == "int8":
+        r32 = rows.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(r32), axis=-1)
+        scale = jnp.maximum(amax / _INT8_MAX, _SCALE_TINY)
+        q = jnp.clip(
+            jnp.round(r32 / scale[..., None]), -_INT8_MAX, _INT8_MAX
+        ).astype(jnp.int8)
+        return q, scale
+    if quant == "sim":
+        return rows, jnp.ones(rows.shape[:-1], jnp.float32)
+    raise ValueError(f"unknown kv quant mode {quant!r}")
+
+
+def gather_paged_scales(
+    scale_pool: jax.Array, table: jax.Array
 ) -> jax.Array:
-    """XLA reference for the paged path: gather each slot's blocks into
-    a dense view, then plain masked cache attention. Positions past a
-    slot's index are masked exactly as in the dense reference, so
-    whatever unreferenced pool blocks hold is invisible."""
-    return decode_attention_reference(
-        q,
-        gather_paged_cache(k_pool, table),
-        gather_paged_cache(v_pool, table),
-        index,
+    """Scale-side `gather_paged_cache`: [num_blocks, kv_heads,
+    PAGE_ROWS] scale pool -> dense [batch, kv_heads, nlog * PAGE_ROWS]
+    view through the block table."""
+    b, nlog = table.shape
+    _, kvh, rows = scale_pool.shape
+    return scale_pool[table].transpose(0, 2, 1, 3).reshape(
+        b, kvh, nlog * rows
     )
 
 
+def dequantize_gathered(
+    pool: jax.Array, scale_pool: jax.Array, table: jax.Array, dtype
+) -> jax.Array:
+    """Dense DEQUANTIZED cache view: gather blocks and their scales
+    through the table, multiply in f32, cast to `dtype`. With "sim"
+    scales (all exactly 1.0) the f32 round-trip is bit-exact for
+    bf16/f32 storage — the parity suite's lossless arm."""
+    view = gather_paged_cache(pool, table).astype(jnp.float32)
+    scales = gather_paged_scales(scale_pool, table)
+    return (view * scales[..., None]).astype(dtype)
+
+
+def paged_decode_attention_reference(
+    q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+    table: jax.Array, index: jax.Array,
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
+) -> jax.Array:
+    """XLA reference for the paged path: gather each slot's blocks into
+    a dense view (dequantized through the parallel scale pools when
+    given), then plain masked cache attention. Positions past a
+    slot's index are masked exactly as in the dense reference, so
+    whatever unreferenced pool blocks hold is invisible."""
+    if k_scales is not None:
+        k_view = dequantize_gathered(k_pool, k_scales, table, q.dtype)
+        v_view = dequantize_gathered(v_pool, v_scales, table, q.dtype)
+    else:
+        k_view = gather_paged_cache(k_pool, table)
+        v_view = gather_paged_cache(v_pool, table)
+    return decode_attention_reference(q, k_view, v_view, index)
+
+
 def _paged_stream_kernel(
-    kvh, steps, idx_ref, nblk_ref, tbl_ref,
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    kvh, steps, quant, idx_ref, nblk_ref, tbl_ref, *refs,
 ):
     """One (slot, logical-cache-block) grid step of the paged kernel.
 
     `_stream_fold` with the cell block fixed to one SLOT: its kvh
     cells share one cache index (a single scalar visibility limit)
     and one physical block, delivered by the table-indexed BlockSpec.
-    q_ref [1, kvh, g*steps, d], k/v_ref [1, kvh, PAGE_ROWS, d].
-    `tbl_ref` is consumed by the BlockSpec index maps, not the body.
-    """
+    q_ref [1, kvh, g*steps, d], k/v_ref [1, kvh, PAGE_ROWS, d]; with
+    `quant`, ks/vs_ref [1, kvh, PAGE_ROWS] scale tiles streamed by
+    the same table index map flatten to the fold's per-column scale
+    rows. `tbl_ref` is consumed by the BlockSpec index maps, not the
+    body."""
+    if quant:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+        ks = ks_ref[0].reshape(1, -1)  # [1, kvh * PAGE_ROWS] f32
+        vs = vs_ref[0].reshape(1, -1)
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks = vs = None
     i = pl.program_id(0)
     j = pl.program_id(1)
     _stream_fold(
         j, nblk_ref[i] - 1, lambda: idx_ref[i], kvh, q_ref.shape[2],
         steps, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+        ks=ks, vs=vs,
     )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _paged_pallas(q, k_pool, v_pool, table, index, interpret=False):
+def _paged_pallas(
+    q, k_pool, v_pool, k_scales, v_scales, table, index,
+    interpret=False,
+):
     """q: [b, h, steps, d]; k/v_pool: [nb, kvh, PAGE_ROWS, d]; table:
-    [b, max_logical_blocks] int32; index: [b] int32."""
+    [b, max_logical_blocks] int32; index: [b] int32; k/v_scales:
+    [nb, kvh, PAGE_ROWS] f32 parallel scale pools, or None for an
+    unquantized pool (the structure is static under jit, so each arm
+    compiles its own program)."""
     nb, kvh, s_blk, d = k_pool.shape
     b, h, steps = q.shape[0], q.shape[1], q.shape[2]
     g = h // kvh
@@ -475,31 +608,40 @@ def _paged_pallas(q, k_pool, v_pool, table, index, interpret=False):
     ).astype(jnp.int32)
     tbl_arr = table.astype(jnp.int32).reshape(-1)  # [b * nlog]
     qr = q.reshape(b, kvh, g, steps, d).reshape(b, kvh, gs, d)
+    quant = k_scales is not None
+    # The gather-indexed grid: logical block j of slot i streams
+    # PHYSICAL pool block table[i, j]. Tail blocks clamp the table
+    # LOOKUP to the last visible logical block — consecutive grid
+    # steps then fetch the same physical block and the pipeline
+    # elides the copy. Scale tiles (quantized pools) ride the same
+    # index map, so a block and its scales always arrive together.
+    pool_spec = pl.BlockSpec(
+        (1, kvh, s_blk, d),
+        lambda i, j, idx, nb_, tb: (
+            tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0, 0
+        ),
+    )
+    scale_spec = pl.BlockSpec(
+        (1, kvh, s_blk),
+        lambda i, j, idx, nb_, tb: (
+            tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0
+        ),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, kvh, gs, d), lambda i, j, idx, nb_, tb: (i, 0, 0, 0)
+        ),
+        pool_spec,
+        pool_spec,
+    ]
+    inputs = [qr, k_pool, v_pool]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        inputs += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, nlog),
-        in_specs=[
-            pl.BlockSpec(
-                (1, kvh, gs, d), lambda i, j, idx, nb_, tb: (i, 0, 0, 0)
-            ),
-            # The gather-indexed grid: logical block j of slot i
-            # streams PHYSICAL pool block table[i, j]. Tail blocks
-            # clamp the table LOOKUP to the last visible logical
-            # block — consecutive grid steps then fetch the same
-            # physical block and the pipeline elides the copy.
-            pl.BlockSpec(
-                (1, kvh, s_blk, d),
-                lambda i, j, idx, nb_, tb: (
-                    tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0, 0
-                ),
-            ),
-            pl.BlockSpec(
-                (1, kvh, s_blk, d),
-                lambda i, j, idx, nb_, tb: (
-                    tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0, 0
-                ),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, kvh, gs, d), lambda i, j, idx, nb_, tb: (i, 0, 0, 0)
         ),
@@ -510,11 +652,11 @@ def _paged_pallas(q, k_pool, v_pool, table, index, interpret=False):
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_stream_kernel, kvh, steps),
+        functools.partial(_paged_stream_kernel, kvh, steps, quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, gs, d), q.dtype),
         interpret=interpret,
-    )(idx_arr, nblk_arr, tbl_arr, qr, k_pool, v_pool)
+    )(idx_arr, nblk_arr, tbl_arr, *inputs)
     return out.reshape(b, kvh, g, steps, d).reshape(b, h, steps, d)
 
 
@@ -522,7 +664,11 @@ def scatter_paged_rows(
     k_pool: jax.Array, v_pool: jax.Array,
     k: jax.Array, v: jax.Array,
     table: jax.Array, index: jax.Array,
-) -> tuple[jax.Array, jax.Array]:
+    *,
+    k_scale_pool: jax.Array | None = None,
+    v_scale_pool: jax.Array | None = None,
+    quant: str | None = None,
+) -> tuple[jax.Array, ...]:
     """Write new K/V rows through a block table into the paged pools.
 
     k/v: [batch, kv_heads, steps, head_dim] rows for positions
@@ -535,7 +681,17 @@ def scatter_paged_rows(
     before the same dispatch's kernel reads them (the table-edge
     invariant `models/lm.py` established for speculative verify
     windows). The ONE paged write rule the model's unfused decode
-    path and the fused QKV kernel's caller share."""
+    path and the fused QKV kernel's caller share.
+
+    With a quantized pool (`quant` + the parallel `*_scale_pool`s)
+    fresh rows QUANTIZE HERE — emit is the single seam every paged
+    writer passes through (the unfused decode path, the fused
+    kernel's caller scatter, and the device-resident loop's in-body
+    scatters), so one quantization rule covers them all — and the
+    per-row scales scatter through the same (block, row) indices,
+    drop-past-capacity included: scale residency tracks data
+    residency exactly. Returns (k_pool, v_pool) unquantized, or
+    (k_pool, v_pool, k_scale_pool, v_scale_pool)."""
     nb, kvh, page, hd = k_pool.shape
     bsz, _, steps, _ = k.shape
     nlog = table.shape[1]
@@ -545,13 +701,31 @@ def scatter_paged_rows(
     phys = jnp.where(pos < nlog * page, phys, nb)
     row = pos % page
 
+    if quant is not None:
+        k, k_scales = quantize_kv_rows(k, quant)
+        v, v_scales = quantize_kv_rows(v, quant)
+
     def put(pool, new):
         rows = new.transpose(0, 2, 1, 3).reshape(bsz * steps, kvh, hd)
         return pool.at[
             phys.reshape(-1), :, row.reshape(-1), :
         ].set(rows.astype(pool.dtype), mode="drop")
 
-    return put(k_pool, k), put(v_pool, v)
+    k_pool, v_pool = put(k_pool, k), put(v_pool, v)
+    if quant is None:
+        return k_pool, v_pool
+
+    def put_scale(pool, new):  # new [batch, kv_heads, steps]
+        rows_s = new.transpose(0, 2, 1).reshape(bsz * steps, kvh)
+        return pool.at[phys.reshape(-1), :, row.reshape(-1)].set(
+            rows_s.astype(pool.dtype), mode="drop"
+        )
+
+    return (
+        k_pool, v_pool,
+        put_scale(k_scale_pool, k_scales),
+        put_scale(v_scale_pool, v_scales),
+    )
 
 
 def paged_decode_attention(
@@ -561,6 +735,8 @@ def paged_decode_attention(
     table: jax.Array,
     index: jax.Array,
     *,
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused decode attention over a PAGED KV cache.
@@ -571,21 +747,26 @@ def paged_decode_attention(
     physical block ids (logical block j of slot b lives in pool block
     table[b, j]); index: [batch] int32 per-slot cache index. Every
     table entry must be a valid pool block id (the serving engine
-    parks idle slots on a reserved scratch block). Uses the streamed
-    Pallas kernel with the table-indexed grid on TPU (or interpret
-    mode via the argument / WALKAI_DECODE_INTERPRET=1); falls back to
-    the gather-based XLA reference otherwise.
+    parks idle slots on a reserved scratch block). With a quantized
+    pool, `k_scales`/`v_scales` are the parallel [num_blocks,
+    kv_heads, PAGE_ROWS] f32 scale pools; the kernel streams each
+    block's scale tile beside it and dequantizes inside the shared
+    fold. Uses the streamed Pallas kernel with the table-indexed grid
+    on TPU (or interpret mode via the argument /
+    WALKAI_DECODE_INTERPRET=1); falls back to the gather-based XLA
+    reference otherwise.
     """
     if interpret is None:
         interpret = os.environ.get("WALKAI_DECODE_INTERPRET") == "1"
         if not interpret and jax.default_backend() != "tpu":
             return paged_decode_attention_reference(
-                q, k_pool, v_pool, table, index
+                q, k_pool, v_pool, table, index,
+                k_scales=k_scales, v_scales=v_scales,
             )
     single = q.ndim == 3
     out = _paged_pallas(
         q[:, :, None, :] if single else q, k_pool, v_pool,
-        table, index, interpret=interpret,
+        k_scales, v_scales, table, index, interpret=interpret,
     )
     return out[:, :, 0] if single else out
 
@@ -648,19 +829,37 @@ def fused_qkv_paged_reference(
     k_pool: jax.Array, v_pool: jax.Array,
     table: jax.Array, index: jax.Array,
     *, num_heads: int, rope_theta: float | None = None,
+    w_scale: jax.Array | None = None,
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """XLA reference for the fused path: the exact unfused composition
     (projection -> split/transpose -> rotary -> pool scatter ->
     gather-reference paged attention), so interpret-mode CI can pin
     the fusion against it. Returns (o, k_new, v_new) like the fused
     kernel — o computed against pools that already contain the new
-    rows."""
+    rows. `w_scale` is the int8-weight per-output-channel f32 scale
+    row (the projection dequantizes after the dot, exactly the
+    QuantDense rule); `k/v_scales` mark quantized KV pools — the
+    reference then attends over the DEQUANTIZED gathered view with
+    the fresh rows injected at FULL precision, mirroring the kernel's
+    in-VMEM injection (fresh rows only quantize at the caller's
+    scatter, one dispatch later)."""
     nb, kvh, page, hd = k_pool.shape
     bsz, steps, _ = x.shape
     d = num_heads * hd
-    qkv = jnp.dot(x, w_qkv)
-    if b_qkv is not None:
-        qkv = qkv + b_qkv
+    if w_scale is not None:
+        qkv = jnp.dot(
+            x, w_qkv.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        ) * w_scale
+        if b_qkv is not None:
+            qkv = qkv + b_qkv
+        qkv = qkv.astype(x.dtype)
+    else:
+        qkv = jnp.dot(x, w_qkv)
+        if b_qkv is not None:
+            qkv = qkv + b_qkv
     q = qkv[..., :d].reshape(
         bsz, steps, num_heads, hd
     ).transpose(0, 2, 1, 3)
@@ -674,29 +873,57 @@ def fused_qkv_paged_reference(
         cos, sin = _rope_tables(index, steps, hd, rope_theta)
         q = _rotate(q, cos[:, None], sin[:, None])
         k = _rotate(k, cos[:, None], sin[:, None])
+    if k_scales is not None:
+        # Quantized pools: dequantize the resident view, then place
+        # the fresh rows IN FULL PRECISION at their write positions
+        # (out-of-capacity positions drop, like the scatter rule).
+        k_view = dequantize_gathered(k_pool, k_scales, table, x.dtype)
+        v_view = dequantize_gathered(v_pool, v_scales, table, x.dtype)
+        pos = index[:, None] + jnp.arange(steps)  # [batch, steps]
+        bidx = jnp.arange(bsz)[:, None]
+        k_view = k_view.at[bidx, :, pos, :].set(
+            k.transpose(0, 2, 1, 3).astype(x.dtype), mode="drop"
+        )
+        v_view = v_view.at[bidx, :, pos, :].set(
+            v.transpose(0, 2, 1, 3).astype(x.dtype), mode="drop"
+        )
+        o = decode_attention_reference(q, k_view, v_view, index)
+        return o, k, v
     kp, vp = scatter_paged_rows(k_pool, v_pool, k, v, table, index)
     o = paged_decode_attention_reference(q, kp, vp, table, index)
     return o, k, v
 
 
 def _fused_stream_kernel(
-    kvh, g, steps, rope, idx_ref, nblk_ref, tbl_ref,
-    x_ref, w_ref, b_ref, cos_ref, sin_ref, k_ref, v_ref,
-    o_ref, ko_ref, vo_ref,
-    q_scr, kn_scr, vn_scr, m_ref, l_ref, acc_ref,
+    kvh, g, steps, rope, quant, idx_ref, nblk_ref, tbl_ref, *refs,
 ):
     """One (slot, logical-cache-block) grid step of the fused kernel.
 
     At j == 0 the slot's QKV projection runs on-chip (one MXU dot
-    over the streamed-once weight), rotary applies from the
-    prefetched cos/sin tables, q parks in VMEM scratch for the whole
-    stream, and the fresh K/V rows land in scratch + the k_new/v_new
-    outputs. Every grid step then streams one pool block, INJECTS the
-    fresh rows into the VMEM tile wherever this slot's write
-    positions fall inside the block (the pool itself is only updated
-    by the caller, after the kernel), and runs the shared
-    `_stream_fold`. `tbl_ref` is consumed by the BlockSpec index
-    maps, not the body."""
+    over the streamed-once weight, dequantized in VMEM via the
+    per-output-channel scale row when the weight is int8), rotary
+    applies from the prefetched cos/sin tables, q parks in VMEM
+    scratch for the whole stream, and the fresh K/V rows land in
+    scratch + the k_new/v_new outputs. Every grid step then streams
+    one pool block, INJECTS the fresh rows into the VMEM tile
+    wherever this slot's write positions fall inside the block (the
+    pool itself is only updated by the caller, after the kernel), and
+    runs the shared `_stream_fold`. With a quantized pool the scale
+    tiles stream beside the data blocks and feed the fold's
+    per-column dequant; injected fresh rows stay FULL PRECISION
+    within the dispatch — their scale columns overwrite to exactly
+    1.0 — and only quantize at the caller's scatter. `tbl_ref` is
+    consumed by the BlockSpec index maps, not the body."""
+    if quant:
+        (x_ref, w_ref, ws_ref, b_ref, cos_ref, sin_ref,
+         k_ref, v_ref, ks_ref, vs_ref,
+         o_ref, ko_ref, vo_ref,
+         q_scr, kn_scr, vn_scr, m_ref, l_ref, acc_ref) = refs
+    else:
+        (x_ref, w_ref, ws_ref, b_ref, cos_ref, sin_ref,
+         k_ref, v_ref,
+         o_ref, ko_ref, vo_ref,
+         q_scr, kn_scr, vn_scr, m_ref, l_ref, acc_ref) = refs
     i = pl.program_id(0)
     j = pl.program_id(1)
     hd = k_ref.shape[-1]
@@ -708,11 +935,15 @@ def _fused_stream_kernel(
     @pl.when(j == 0)
     def _project():
         xv = x_ref[0]  # [steps, d_model]
+        # ws is all-ones for an fp weight, so the f32 multiply is an
+        # exact identity there and the one projection rule serves
+        # both dtypes (int8 weights convert losslessly to xv.dtype
+        # for the MXU; the HBM read was the int8 bytes).
         qkv = jax.lax.dot_general(
-            xv, w_ref[...], (((1,), (0,)), ((), ())),
+            xv, w_ref[...].astype(xv.dtype), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        qkv = (qkv + b_ref[0]).astype(xv.dtype)
+        qkv = (qkv * ws_ref[0] + b_ref[0]).astype(xv.dtype)
         q = qkv[:, :d].reshape(steps, h, hd)
         kx = qkv[:, d:d + kvh * hd].reshape(steps, kvh, hd)
         vx = qkv[:, d + kvh * hd:].reshape(steps, kvh, hd)
@@ -740,6 +971,16 @@ def _fused_stream_kernel(
     # immutability of shared prefix blocks.
     kf = k_ref[0]  # [kvh, s_blk, head_dim]
     vf = v_ref[0]
+    if quant:
+        # The injected rows are full precision (q_scr.dtype), so the
+        # tile converts up-front and the scale columns at injected
+        # positions pin to exactly 1.0 — the fold then dequantizes
+        # resident rows and passes fresh rows through untouched.
+        kf = kf.astype(q_scr.dtype)
+        vf = vf.astype(q_scr.dtype)
+        ks_cols = ks_ref[0]  # [kvh, s_blk] f32
+        vs_cols = vs_ref[0]
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (1, s_blk), 1)
     knv = kn_scr[...]
     vnv = vn_scr[...]
     row_ids = jax.lax.broadcasted_iota(jnp.int32, (1, s_blk, 1), 1)
@@ -747,9 +988,15 @@ def _fused_stream_kernel(
         hit = row_ids == idx_ref[i] + t - j * s_blk
         kf = jnp.where(hit, knv[:, t][:, None, :], kf)
         vf = jnp.where(hit, vnv[:, t][:, None, :], vf)
+        if quant:
+            hit_s = col_ids == idx_ref[i] + t - j * s_blk
+            ks_cols = jnp.where(hit_s, 1.0, ks_cols)
+            vs_cols = jnp.where(hit_s, 1.0, vs_cols)
     _stream_fold(
         j, nblk_ref[i] - 1, lambda: idx_ref[i], kvh, gs, steps,
         q_scr, kf[None], vf[None], o_ref, m_ref, l_ref, acc_ref,
+        ks=ks_cols.reshape(1, -1) if quant else None,
+        vs=vs_cols.reshape(1, -1) if quant else None,
     )
 
 
@@ -757,12 +1004,15 @@ def _fused_stream_kernel(
     jax.jit, static_argnames=("num_heads", "rope", "interpret")
 )
 def _fused_qkv_pallas(
-    x, w, b2, cos, sin, k_pool, v_pool, table, index,
-    num_heads, rope, interpret=False,
+    x, w, ws, b2, cos, sin, k_pool, v_pool, k_scales, v_scales,
+    table, index, num_heads, rope, interpret=False,
 ):
-    """x: [b, steps, d_model]; w: [d_model, d_model + 2*kv_dim]; b2:
-    [1, dout] f32 (zeros when the model is bias-free); cos/sin:
-    [b, steps, head_dim] f32; pools/table/index as the paged kernel."""
+    """x: [b, steps, d_model]; w: [d_model, d_model + 2*kv_dim]; ws:
+    [1, dout] f32 per-output-channel weight scales (all-ones for fp
+    weights); b2: [1, dout] f32 (zeros when the model is bias-free);
+    cos/sin: [b, steps, head_dim] f32; pools/table/index as the paged
+    kernel; k/v_scales: parallel [nb, kvh, PAGE_ROWS] f32 scale pools
+    or None."""
     nb, kvh, s_blk, hd = k_pool.shape
     bsz, steps, dm = x.shape
     dout = w.shape[1]
@@ -770,6 +1020,12 @@ def _fused_qkv_pallas(
     gs = g * steps
     nlog = table.shape[1]
     rows = kvh * gs
+    quant = k_scales is not None
+    # Fresh K/V rows stay full precision through the dispatch (they
+    # only quantize at the caller's scatter), so with a quantized
+    # pool the scratch and k_new/v_new outputs carry x's dtype, not
+    # the pool's.
+    fresh_dtype = x.dtype if quant else k_pool.dtype
     idx_arr = index.astype(jnp.int32)
     nblk_arr = jnp.minimum(
         (idx_arr + steps - 1) // s_blk + 1, nlog
@@ -781,30 +1037,44 @@ def _fused_qkv_pallas(
             tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0, 0
         ),
     )
+    scale_spec = pl.BlockSpec(
+        (1, kvh, s_blk),
+        lambda i, j, idx, nb_, tb: (
+            tb[i * nlog + jnp.minimum(j, nb_[i] - 1)], 0, 0
+        ),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, steps, dm), lambda i, j, idx, nb_, tb: (i, 0, 0)
+        ),
+        # Constant index: the weight streams to VMEM once and the
+        # pipeline elides every later fetch (revisiting).
+        pl.BlockSpec(
+            (dm, dout), lambda i, j, idx, nb_, tb: (0, 0)
+        ),
+        pl.BlockSpec(
+            (1, dout), lambda i, j, idx, nb_, tb: (0, 0)
+        ),
+        pl.BlockSpec(
+            (1, dout), lambda i, j, idx, nb_, tb: (0, 0)
+        ),
+        pl.BlockSpec(
+            (1, steps, hd), lambda i, j, idx, nb_, tb: (i, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, steps, hd), lambda i, j, idx, nb_, tb: (i, 0, 0)
+        ),
+        pool_spec,
+        pool_spec,
+    ]
+    inputs = [x, w, ws, b2, cos, sin, k_pool, v_pool]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        inputs += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(bsz, nlog),
-        in_specs=[
-            pl.BlockSpec(
-                (1, steps, dm), lambda i, j, idx, nb_, tb: (i, 0, 0)
-            ),
-            # Constant index: the weight streams to VMEM once and the
-            # pipeline elides every later fetch (revisiting).
-            pl.BlockSpec(
-                (dm, dout), lambda i, j, idx, nb_, tb: (0, 0)
-            ),
-            pl.BlockSpec(
-                (1, dout), lambda i, j, idx, nb_, tb: (0, 0)
-            ),
-            pl.BlockSpec(
-                (1, steps, hd), lambda i, j, idx, nb_, tb: (i, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, steps, hd), lambda i, j, idx, nb_, tb: (i, 0, 0)
-            ),
-            pool_spec,
-            pool_spec,
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (1, kvh, gs, hd), lambda i, j, idx, nb_, tb: (i, 0, 0, 0)
@@ -820,23 +1090,25 @@ def _fused_qkv_pallas(
         ],
         scratch_shapes=[
             pltpu.VMEM((rows, hd), x.dtype),            # q rows
-            pltpu.VMEM((kvh, steps, hd), k_pool.dtype),  # fresh K
-            pltpu.VMEM((kvh, steps, hd), k_pool.dtype),  # fresh V
+            pltpu.VMEM((kvh, steps, hd), fresh_dtype),   # fresh K
+            pltpu.VMEM((kvh, steps, hd), fresh_dtype),   # fresh V
             pltpu.VMEM((rows, 128), jnp.float32),        # running max
             pltpu.VMEM((rows, 128), jnp.float32),        # running sum
             pltpu.VMEM((rows, hd), jnp.float32),         # running acc
         ],
     )
     o, kn, vn = pl.pallas_call(
-        functools.partial(_fused_stream_kernel, kvh, g, steps, rope),
+        functools.partial(
+            _fused_stream_kernel, kvh, g, steps, rope, quant
+        ),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bsz, kvh, gs, hd), x.dtype),
-            jax.ShapeDtypeStruct((bsz, kvh, steps, hd), k_pool.dtype),
-            jax.ShapeDtypeStruct((bsz, kvh, steps, hd), k_pool.dtype),
+            jax.ShapeDtypeStruct((bsz, kvh, steps, hd), fresh_dtype),
+            jax.ShapeDtypeStruct((bsz, kvh, steps, hd), fresh_dtype),
         ],
         interpret=interpret,
-    )(idx_arr, nblk_arr, tbl_arr, x, w, b2, cos, sin, k_pool, v_pool)
+    )(idx_arr, nblk_arr, tbl_arr, *inputs)
     o = o.reshape(bsz, kvh, g, steps, hd).reshape(
         bsz, num_heads, steps, hd
     )
@@ -854,6 +1126,9 @@ def fused_qkv_paged_attention(
     *,
     num_heads: int,
     rope_theta: float | None = None,
+    w_scale: jax.Array | None = None,
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fused QKV projection + rotary + streamed paged decode attention.
@@ -862,21 +1137,28 @@ def fused_qkv_paged_attention(
     MAX_KERNEL_STEPS); w_qkv: [d_model, d_model + 2*kv_dim] the fused
     projection weight ([q | k | v] channel blocks, kv_dim = kv_heads *
     head_dim inferred from the pool); b_qkv: [dout] or None; pools/
-    table/index as `paged_decode_attention`. Returns (o [batch,
-    num_heads, steps, head_dim], k_new, v_new [batch, kv_heads, steps,
-    head_dim]): o already attends to the fresh rows (injected in
-    VMEM), and the CALLER must scatter k_new/v_new into the pool
-    (`scatter_paged_rows`) — the one HBM write the cache requires.
-    Uses the fused Pallas kernel on TPU (or interpret mode via the
-    argument / WALKAI_DECODE_INTERPRET=1); falls back to the
-    gather-reference composition otherwise, same pattern as
-    `paged_decode_attention`."""
+    table/index as `paged_decode_attention`. `w_scale` ([dout] f32)
+    marks an int8 weight: the kernel streams the int8 bytes + the
+    scale row and dequantizes in VMEM before the MXU dot — the HBM
+    read halves while the math stays full precision. `k/v_scales`
+    mark quantized KV pools (parallel scale pools, dequantized inside
+    the shared fold); the freshly projected K/V rows stay FULL
+    precision within the dispatch and quantize only at the caller's
+    scatter. Returns (o [batch, num_heads, steps, head_dim], k_new,
+    v_new [batch, kv_heads, steps, head_dim]): o already attends to
+    the fresh rows (injected in VMEM), and the CALLER must scatter
+    k_new/v_new into the pool (`scatter_paged_rows`) — the one HBM
+    write the cache requires. Uses the fused Pallas kernel on TPU (or
+    interpret mode via the argument / WALKAI_DECODE_INTERPRET=1);
+    falls back to the gather-reference composition otherwise, same
+    pattern as `paged_decode_attention`."""
     if interpret is None:
         interpret = os.environ.get("WALKAI_DECODE_INTERPRET") == "1"
         if not interpret and jax.default_backend() != "tpu":
             return fused_qkv_paged_reference(
                 x, w_qkv, b_qkv, k_pool, v_pool, table, index,
                 num_heads=num_heads, rope_theta=rope_theta,
+                w_scale=w_scale, k_scales=k_scales, v_scales=v_scales,
             )
     nb, kvh, s_blk, hd = k_pool.shape
     bsz, steps, _ = x.shape
@@ -889,8 +1171,13 @@ def fused_qkv_paged_attention(
     b2 = (
         b_qkv if b_qkv is not None else jnp.zeros((dout,), x.dtype)
     ).reshape(1, dout).astype(jnp.float32)
+    ws = (
+        w_scale if w_scale is not None
+        else jnp.ones((dout,), jnp.float32)
+    ).reshape(1, dout).astype(jnp.float32)
     return _fused_qkv_pallas(
-        x, w_qkv, b2, cos, sin, k_pool, v_pool, table, index,
+        x, w_qkv, ws, b2, cos, sin, k_pool, v_pool,
+        k_scales, v_scales, table, index,
         num_heads=num_heads, rope=rope_theta is not None,
         interpret=interpret,
     )
