@@ -592,6 +592,7 @@ def cb_serving_benchmark() -> dict:
         measure_cb_quant_serving,
         measure_cb_serving,
         measure_cb_spec_serving,
+        measure_cb_tp_serving,
         measure_quant_quality,
     )
 
@@ -608,6 +609,16 @@ def cb_serving_benchmark() -> dict:
         baseline_capacity=out.get("cb_serving_capacity_tokens_per_s"),
     ))
     out.update(measure_quant_quality())
+    # Tensor-parallel arm (WALKAI_CB_TP): the decode step sharded
+    # over the ICI mesh's `model` axis, same harness, this run's
+    # tp=1 capacity as the scaling denominator —
+    # `tp_scaling_efficiency` = cap(tp=N) / (N * cap(tp=1)), floored
+    # at 0.7 in BASELINE.json (absent_ok until a chip run records
+    # it; the CPU arm emulates the mesh and proves serving, not
+    # speedup).
+    out.update(measure_cb_tp_serving(
+        baseline_capacity=out.get("cb_serving_capacity_tokens_per_s"),
+    ))
     return out
 
 
@@ -705,6 +716,7 @@ def main() -> None:
             "cb_spec_capacity_tokens_per_s",
             "cb_spec_accepted_per_round",
             "cb_quant_capacity_tokens_per_s", "lm_quality_delta_ppl",
+            "cb_tp_capacity_tokens_per_s", "tp_scaling_efficiency",
             "obs_overhead_pct",
             "router_ttft_p99_under_surge", "router_prefix_hit_rate",
             "router_scale_events_total",

@@ -65,6 +65,21 @@ import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+# Tensor-parallel EMULATION seam (WALKAI_TP_EMULATE=N): force N
+# virtual CPU devices before jax initializes its backend, so a
+# WALKAI_CB_TP>1 engine runs its real sharded programs on a laptop /
+# CI box with no TPU — the same trick tests/conftest.py plays for the
+# tier-1 tp parity suite. Must run at import time, ahead of any jax
+# import below.
+if os.environ.get("WALKAI_TP_EMULATE"):
+    _emu = int(os.environ["WALKAI_TP_EMULATE"])
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_emu}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
 
 @dataclass
 class _Request:
@@ -547,10 +562,18 @@ def main() -> None:
             # mid-traffic.
             import dataclasses as _dcq
 
+            # Tensor-parallel serving (WALKAI_CB_TP=N): shard the CB
+            # engine's decode step over N chips on the serving mesh's
+            # model axis (models/serve.py). A degree the model's head/
+            # MLP dims don't divide fails HERE, at LMConfig
+            # construction, with the bad_request-style ValueError —
+            # never as a jit crash mid-traffic. The one-shot path
+            # stays single-device.
             cb_cfg = _dcq.replace(
                 lm_cfg,
                 kv_dtype=os.environ.get("WALKAI_CB_KV_DTYPE", "model"),
                 w_dtype=os.environ.get("WALKAI_LM_W_DTYPE", "model"),
+                tp_devices=int(os.environ.get("WALKAI_CB_TP", "1")),
             )
             if cb_spec_kwargs:
                 cb_spec_kwargs["draft_cfg"] = _dcq.replace(
@@ -1244,6 +1267,7 @@ def main() -> None:
                     payload["cb_attrib"] = cb_engine.attrib_stats()
                     payload["cb_loop"] = cb_engine.loop_stats()
                     payload["cb_quant"] = cb_engine.quant_stats()
+                    payload["cb_tp"] = cb_engine.tp_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

@@ -229,6 +229,53 @@ class TestCheckLogic:
         )
         assert any("lm_quality_delta_ppl" in f for f in failures)
 
+    def test_repo_baseline_gates_tp_serving_keys(self):
+        """BASELINE.json carries the tensor-parallel serving keys and
+        they PARSE through the comparator: the capacity key is an
+        absent_ok floor at the r5 single-chip capacity anchor
+        (tolerance 0 — adding chips must never cost capacity), the
+        scaling-efficiency key an absent_ok >= 0.7 floor. Absent from
+        the bench output is a skip note; a capacity under the anchor
+        or an efficiency under the floor fails once emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        cap = published["cb_tp_capacity_tokens_per_s"]
+        assert cap["direction"] == "higher"
+        assert cap["tolerance"] == 0.0
+        assert cap["absent_ok"] is True
+        # Anchored to the r5 single-chip capacity baseline.
+        assert cap["value"] == published[
+            "cb_serving_capacity_tokens_per_s"
+        ]["value"]
+        eff = published["tp_scaling_efficiency"]
+        assert eff["direction"] == "higher"
+        assert eff["tolerance"] == 0.0
+        assert eff["absent_ok"] is True
+        assert eff["value"] == 0.7
+        keys = (
+            "cb_tp_capacity_tokens_per_s", "tp_scaling_efficiency",
+        )
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert sum("absent" in n for n in notes) == 2
+        failures, _ = bench_check.check(
+            {"cb_tp_capacity_tokens_per_s": cap["value"] * 3.1,
+             "tp_scaling_efficiency": 0.82},
+            base,
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"cb_tp_capacity_tokens_per_s": cap["value"] * 0.9,
+             "tp_scaling_efficiency": 0.5},
+            base,
+        )
+        assert len(failures) == 2
+        assert any(
+            "cb_tp_capacity_tokens_per_s" in f for f in failures
+        )
+        assert any("tp_scaling_efficiency" in f for f in failures)
+
     def test_repo_baseline_gates_attribution_keys(self):
         """BASELINE.json carries the device-time attribution keys as
         absent_ok lower-is-better bands and they PARSE through the

@@ -515,6 +515,21 @@ class TestContinuousBatchingEndpoint:
         state = get_json(f"{cb_server}/debug/state")["engine"]
         assert state["quant"]["kv_dtype"] == "model"
 
+    def test_stats_expose_tp_section(self, cb_server):
+        """/stats carries the tensor-parallel view (`cb_tp`,
+        `ContinuousBatcher.tp_stats()`), and /debug/state its `tp`
+        block — this fixture runs single-device (WALKAI_CB_TP unset),
+        so the degree reads 1 and the feature disabled; sharded
+        engine behavior is pinned in tests/test_serve_tp.py."""
+        tp = get_json(f"{cb_server}/stats").get("cb_tp")
+        assert tp is not None
+        assert tp["enabled"] is False
+        assert tp["tp_devices"] == 1
+        assert tp["kv_layout"] is None
+        assert tp["param_shard_bytes"] == tp["param_bytes"]
+        state = get_json(f"{cb_server}/debug/state")["engine"]
+        assert state["tp"]["tp_devices"] == 1
+
     def test_metrics_prometheus_exposition(self, cb_server):
         """/metrics serves valid Prometheus text with the serving
         registry's series after traffic."""

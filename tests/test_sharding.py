@@ -1,0 +1,176 @@
+"""Unit tests for the tensor-parallel sharding rules
+(`walkai_nos_tpu/parallel/sharding.py`): the Megatron column/row
+kernel split, the QuantDense `scale` leaves riding their kernel's
+output-dim sharding (the int8 tree from `quantize_lm_params` used to
+fall through to the replicated catch-all), the decode-cache specs
+(paged pools kv-head-split, indexes replicated), and the per-shard
+byte accounting the TP-aware roofline cost model runs on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from walkai_nos_tpu.models.lm import (
+    DecoderLM,
+    LMConfig,
+    quantize_lm_params,
+)
+from walkai_nos_tpu.parallel import sharding as shardlib
+from walkai_nos_tpu.parallel.mesh import (
+    AXIS_FSDP,
+    AXIS_MODEL,
+    serving_mesh,
+)
+
+QCFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=1, num_heads=4,
+    num_kv_heads=2, max_seq_len=32, dtype="float32",
+    mlp="swiglu", mlp_dim=64, use_bias=True, w_dtype="int8",
+)
+
+
+def _quantized_params():
+    raw = DecoderLM(QCFG).init_params(jax.random.PRNGKey(0))
+    return quantize_lm_params(raw, QCFG)
+
+
+class TestQuantDenseScaleRules:
+    def test_column_parallel_scales_follow_model_axis(self):
+        # qkv / gate / fc1 kernels are column-split (output features
+        # on `model`); their per-output-channel scale rows must split
+        # the same way or a sharded QuantDense dequantizes with a
+        # misplaced scale.
+        for path in (
+            "block0/attn/qkv/scale",
+            "block0/gate/scale",
+            "block0/fc1/scale",
+        ):
+            assert shardlib.param_partition_spec(path) == P(AXIS_MODEL)
+
+    def test_row_parallel_scales_follow_fsdp_axis(self):
+        # out_proj / fc2 kernels are row-split P(model, fsdp): their
+        # OUTPUT dim shards over fsdp, so the scale row does too.
+        for path in ("block0/attn/out_proj/scale", "block0/fc2/scale"):
+            assert shardlib.param_partition_spec(path) == P(AXIS_FSDP)
+
+    def test_norm_scales_stay_replicated(self):
+        # RMSNorm/LayerNorm params are also named `scale`; only the
+        # quantized Dense scopes' scale rows shard.
+        for path in ("block0/norm1/scale", "norm/scale"):
+            assert shardlib.param_partition_spec(path) == P()
+
+    def test_quantized_tree_specs_cover_every_scale_leaf(self):
+        """End to end: quantize a real LM tree, ask for fitted specs
+        on a tp=2 mesh, and check every QuantDense scope got a
+        sharded scale spec matching its kernel's output split."""
+        params = _quantized_params()
+        mesh = serving_mesh(2)
+        specs = shardlib.param_specs(params, mesh)
+        attn = specs["block0"]["attn"]
+        assert attn["qkv"]["kernel"] == P(AXIS_FSDP, AXIS_MODEL)
+        assert attn["qkv"]["scale"] == P(AXIS_MODEL)
+        assert attn["qkv"]["bias"] == P(AXIS_MODEL)
+        assert attn["out_proj"]["kernel"] == P(AXIS_MODEL, AXIS_FSDP)
+        # fsdp has size 1 on the serving mesh, so the row-parallel
+        # scale fits trivially and keeps its rule spec.
+        assert attn["out_proj"]["scale"] == P(AXIS_FSDP)
+        assert specs["block0"]["gate"]["scale"] == P(AXIS_MODEL)
+        assert specs["block0"]["fc1"]["scale"] == P(AXIS_MODEL)
+        assert specs["block0"]["fc2"]["scale"] == P(AXIS_FSDP)
+        # Norm scales replicate even in a quantized tree.
+        assert specs["block0"]["norm1"]["scale"] == P()
+
+    def test_sharded_quant_dense_matmul_matches_unsharded(self):
+        """Placement proof: the int8 tree device_puts onto the mesh
+        under the fitted specs, the scale row lands sharded beside
+        its kernel columns, and the sharded apply reproduces the
+        single-device output."""
+        params = _quantized_params()
+        mesh = serving_mesh(2)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 8)), jnp.int32
+        )
+        model = DecoderLM(QCFG)
+
+        # One jitted program (eager apply on sharded leaves would
+        # compile a distributed mini-program per op).
+        @jax.jit
+        def fwd(p):
+            return model.apply({"params": p}, tokens)
+
+        want = np.asarray(fwd(params))
+        sharded = shardlib.shard_params(params, mesh)
+        qkv = sharded["block0"]["attn"]["qkv"]
+        assert qkv["scale"].sharding.shard_shape(
+            qkv["scale"].shape
+        )[0] == qkv["scale"].shape[0] // 2
+        got = np.asarray(fwd(sharded))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_non_dividing_scale_replicates(self):
+        # _fit_spec drops sharded axes the leaf's dim doesn't divide:
+        # a 6-wide scale on a 4-way model axis replicates instead of
+        # erroring.
+        mesh = serving_mesh(4)
+        spec = shardlib._fit_spec(P(AXIS_MODEL), (6,), mesh)
+        assert spec == P()
+
+
+class TestCacheSpecs:
+    def test_pool_leaves_split_kv_heads_indexes_replicate(self):
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=4,
+            num_kv_heads=2, max_seq_len=256, dtype="float32",
+            ragged_decode=True, paged_decode=True, paged_blocks=5,
+            cache_len=256, kv_dtype="int8-sim",
+        )
+        cache = DecoderLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+        specs = shardlib.cache_specs(cache, serving_mesh(2))
+        attn = specs["block0"]["attn"]
+        assert attn["cached_key"] == P(None, AXIS_MODEL)
+        assert attn["cached_value"] == P(None, AXIS_MODEL)
+        assert attn["cached_key_scale"] == P(None, AXIS_MODEL)
+        assert attn["cached_value_scale"] == P(None, AXIS_MODEL)
+        assert attn["cache_index"] == P()
+
+    def test_shard_cache_places_pool_slices(self):
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=4,
+            num_kv_heads=2, max_seq_len=256, dtype="float32",
+            ragged_decode=True, paged_decode=True, paged_blocks=5,
+            cache_len=256,
+        )
+        cache = DecoderLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+        mesh = serving_mesh(2)
+        placed = shardlib.shard_cache(cache, mesh)
+        pool = placed["block0"]["attn"]["cached_key"]
+        # Each shard physically backs one kv head's slice of every
+        # block: same block ids, half the bytes per chip.
+        assert pool.sharding.shard_shape(pool.shape) == (
+            pool.shape[0], 1, pool.shape[2], pool.shape[3]
+        )
+
+
+class TestParamsShardBytes:
+    def test_sharded_tree_reports_per_device_bytes(self):
+        params = _quantized_params()
+        full = shardlib.params_shard_bytes(params)
+        mesh = serving_mesh(2)
+        sharded = shardlib.shard_params(params, mesh)
+        per_shard = shardlib.params_shard_bytes(sharded)
+        # Projection/MLP kernels split 2-way; embeddings/norms/head
+        # bias replicate, so the per-shard sum sits strictly between
+        # half and all of the full tree.
+        assert full / 2 < per_shard < full
+        # The sharded leaves' global nbytes are unchanged — only the
+        # per-device accounting moves.
+        assert shardlib.params_shard_bytes(
+            jax.tree_util.tree_map(np.asarray, params)
+        ) == full

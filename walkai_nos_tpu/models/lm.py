@@ -36,6 +36,7 @@ from walkai_nos_tpu.ops.decode_attention import (
 )
 from walkai_nos_tpu.ops.ring_attention import ring_attention
 from walkai_nos_tpu.ops.ulysses import ulysses_attention
+from walkai_nos_tpu.parallel.mesh import AXIS_MODEL
 
 
 @dataclass(frozen=True)
@@ -171,6 +172,23 @@ class LMConfig:
     #   proportion to their traffic share).
     kv_dtype: str = "model"
     w_dtype: str = "model"
+    # Serving tensor parallelism (models/serve.py): shard the decode
+    # step over `tp_devices` chips on the serving mesh's `model` axis
+    # (parallel/mesh.serving_mesh). Megatron layout: QKV and gate/fc1
+    # column-parallel, out_proj/fc2 row-parallel — one psum per
+    # attention block and one per MLP, inserted by GSPMD from the
+    # NamedShardings (parallel/sharding.param_specs) — and the paged
+    # K/V pools held per-shard as kv-head slices under the SAME
+    # physical block ids, so the host-side batcher, block tables, and
+    # prefix trie stay byte-identical on every shard. GQA forces a
+    # design split at tp > kv_heads: below it the kv heads simply
+    # split (kv-split); above it each kv head is REPLICATED across the
+    # tp/kv_heads shards whose query heads read it — the serving
+    # engine realizes that by expanding the cache (and the qkv
+    # projection's K/V column blocks) to tp effective kv heads
+    # (`expand_kv_heads`), so one uniform head split serves both
+    # regimes. 1 = today's single-chip engine, bit for bit.
+    tp_devices: int = 1
 
     def __post_init__(self):
         for knob, value in (
@@ -197,6 +215,34 @@ class LMConfig:
             raise ValueError(f"unknown norm {self.norm!r}")
         if self.mlp not in ("gelu", "swiglu"):
             raise ValueError(f"unknown mlp {self.mlp!r}")
+        if self.tp_devices < 1:
+            raise ValueError(
+                f"tp_devices must be >= 1; got {self.tp_devices}"
+            )
+        if self.tp_devices > 1:
+            # bad_request-shaped constructor errors, never a jit-time
+            # crash: the demo server's WALKAI_CB_TP knob lands here.
+            tp = self.tp_devices
+            if self.num_heads % tp != 0:
+                raise ValueError(
+                    f"tp_devices={tp} must divide num_heads="
+                    f"{self.num_heads}: attention heads shard over the "
+                    f"model axis"
+                )
+            mlp_width = self.mlp_dim or self.mlp_ratio * self.hidden_dim
+            if mlp_width % tp != 0:
+                raise ValueError(
+                    f"tp_devices={tp} must divide the MLP width "
+                    f"{mlp_width}: gate/fc1 split their output "
+                    f"channels over the model axis"
+                )
+            kvh = self.num_kv_heads or self.num_heads
+            if kvh % tp != 0 and tp % kvh != 0:
+                raise ValueError(
+                    f"tp_devices={tp} must divide num_kv_heads={kvh} "
+                    f"(kv-split) or be a multiple of it "
+                    f"(head-replicated K/V); got neither"
+                )
         if self.paged_decode:
             if not self.ragged_decode:
                 raise ValueError(
@@ -242,6 +288,22 @@ class LMConfig:
             jnp.dtype(jnp.int8) if self.kv_dtype == "int8"
             else self.compute_dtype
         )
+
+    @property
+    def tp_kv_layout(self) -> str | None:
+        """The GQA tensor-parallel K/V design decision, decided by the
+        head counts: None at tp=1; "kv-split" when tp <= kv_heads
+        (each shard holds kv_heads/tp whole head slices of every
+        pool block); "head-replicated" when tp > kv_heads (each kv
+        head is replicated across the tp/kv_heads shards whose query
+        heads read it — the serving engine expands the cache and the
+        qkv K/V columns to tp effective heads so the split stays
+        uniform)."""
+        if self.tp_devices <= 1:
+            return None
+        if self.tp_devices <= self.kv_heads:
+            return "kv-split"
+        return "head-replicated"
 
     @property
     def w_quant(self) -> str | None:
@@ -301,6 +363,10 @@ def draft_config(
         ragged_decode=False,
         paged_decode=False,
         paged_blocks=0,
+        # The draft serves REPLICATED on a tensor-parallel engine (its
+        # step is ~1/64 the target's FLOPs; a second sharding design
+        # would buy noise) — see ContinuousBatcher.
+        tp_devices=1,
     )
 
 
@@ -475,6 +541,277 @@ def quantize_lm_params(params, cfg: LMConfig):
         return out
 
     return walk(params)
+
+
+def expand_kv_heads(params, cfg: LMConfig, new_kv_heads: int):
+    """Expand every block's fused qkv projection from `cfg.kv_heads`
+    to `new_kv_heads` K/V heads by REPEATING each head's column block
+    (kernel, bias, and QuantDense `scale` row alike) — the
+    head-replicated half of the GQA tensor-parallel design decision:
+    at tp > kv_heads a kv head cannot split, so it is duplicated
+    across the tp/kv_heads shards whose query heads read it, and
+    duplicating the PROJECTION columns (plus sizing the paged pools
+    to `num_kv_heads=new_kv_heads`) makes the replication fall out of
+    the ordinary uniform head split — every downstream path (scatter,
+    kernels, grouping) is unchanged. Mathematically exact: a repeated
+    kv head holds bit-identical K/V, and query head i's group mapping
+    (i // (num_heads // kv_heads)) lands on a copy of exactly the
+    head it read before. Works on raw and int8-quantized trees (the
+    per-output-channel scale row repeats with its columns)."""
+    kvh = cfg.kv_heads
+    if new_kv_heads == kvh:
+        return params
+    if new_kv_heads % kvh != 0:
+        raise ValueError(
+            f"new_kv_heads={new_kv_heads} must be a multiple of "
+            f"kv_heads={kvh}"
+        )
+    rep = new_kv_heads // kvh
+    d = cfg.hidden_dim
+    hd = d // cfg.num_heads
+
+    def expand_cols(row):
+        """Repeat the K and V head-column blocks of one [..., d +
+        2*kvh*hd] leaf (kernel rows, bias, scale) along its last
+        axis."""
+        q = row[..., :d]
+        k = row[..., d:d + kvh * hd]
+        v = row[..., d + kvh * hd:]
+
+        def rep_heads(x):
+            heads = x.reshape(x.shape[:-1] + (kvh, hd))
+            return jnp.repeat(heads, rep, axis=-2).reshape(
+                x.shape[:-1] + (new_kv_heads * hd,)
+            )
+
+        return jnp.concatenate([q, rep_heads(k), rep_heads(v)], axis=-1)
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if name == "qkv" and hasattr(sub, "keys") and "kernel" in sub:
+                out[name] = {
+                    leaf: (
+                        expand_cols(val)
+                        if leaf in ("kernel", "bias", "scale") else val
+                    )
+                    for leaf, val in sub.items()
+                }
+            elif hasattr(sub, "keys"):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return walk(params)
+
+
+def _mesh_tp(mesh: Mesh | None) -> int:
+    """The serving mesh's tensor-parallel degree (its `model` axis
+    size); 1 for no mesh or a mesh without the axis."""
+    if mesh is None:
+        return 1
+    try:
+        return int(dict(mesh.shape).get(AXIS_MODEL, 1))
+    except Exception:  # noqa: BLE001 — a foreign mesh means no TP
+        return 1
+
+
+def _paged_scatter_and_attend(
+    q, k, v, k_pool, v_pool, ks_pool, vs_pool, table, idx, quant,
+):
+    """The pure per-shard paged decode segment: write the fresh K/V
+    rows through the table (`scatter_paged_rows`, the one paged write
+    rule; quantized pools quantize at this emit), then read — the
+    table-indexed streamed kernel for short steps, the gather/dequant
+    + masked-attention tail for wide prefill chunks. Single-device
+    serving calls it directly; tensor-parallel serving calls it INSIDE
+    `shard_map` with per-shard kv-head slices of q/k/v and the pools
+    (`_tp_paged_scatter_and_attend`), so the kernels run on local
+    shapes — shard-aware without forking them. Returns
+    (o, k_pool, v_pool, k_scales, v_scales)."""
+    steps = q.shape[2]
+    ks = vs = None
+    if quant:
+        k_pool, v_pool, ks, vs = scatter_paged_rows(
+            k_pool, v_pool, k, v, table, idx,
+            k_scale_pool=ks_pool, v_scale_pool=vs_pool, quant=quant,
+        )
+    else:
+        k_pool, v_pool = scatter_paged_rows(
+            k_pool, v_pool, k, v, table, idx
+        )
+    if steps <= MAX_KERNEL_STEPS:
+        if steps == 1:
+            o = paged_decode_attention(
+                q[:, :, 0], k_pool, v_pool, table, idx,
+                k_scales=ks, v_scales=vs,
+            )[:, :, None, :]
+        else:
+            o = paged_decode_attention(
+                q, k_pool, v_pool, table, idx, k_scales=ks, v_scales=vs
+            )
+    else:
+        # Wide prefill chunks gather the slot's blocks into a dense
+        # view once (the gather already defeats paging; the dequant
+        # rides the same copy).
+        if quant:
+            k_all = dequantize_gathered(k_pool, ks, table, q.dtype)
+            v_all = dequantize_gathered(v_pool, vs, table, q.dtype)
+        else:
+            k_all = gather_paged_cache(k_pool, table)
+            v_all = gather_paged_cache(v_pool, table)
+        o = _masked_cache_attention(q, k_all, v_all, idx, True)
+    return o, k_pool, v_pool, ks, vs
+
+
+def _tp_paged_scatter_and_attend(
+    mesh, quant, q, k, v, k_pool, v_pool, ks_pool, vs_pool, table, idx,
+):
+    """Tensor-parallel wrapper for the paged decode segment: one
+    `shard_map` over the serving mesh's `model` axis. q and the fresh
+    K/V rows enter head-sharded (the column-parallel qkv projection
+    already produced them that way under GSPMD), the pools enter as
+    per-shard kv-head slices, and the block table + per-slot index
+    replicate — every shard sees the SAME physical block ids, so the
+    host-side allocator needs no sharding awareness at all. Inside,
+    each shard runs the unmodified single-device segment on local
+    shapes (on TPU that is the real Pallas kernel per shard; off-TPU
+    the references), writes its own head slice of every fresh row,
+    and returns its output-head slice — no collective in here; the
+    block's one psum happens at the row-parallel out_proj outside."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    heads = P(None, AXIS_MODEL)
+    pool = P(None, AXIS_MODEL)
+    rep = P()
+    if quant:
+        def local(q, k, v, kp, vp, ksp, vsp, table, idx):
+            return _paged_scatter_and_attend(
+                q, k, v, kp, vp, ksp, vsp, table, idx, quant
+            )
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                heads, heads, heads, pool, pool, pool, pool, rep, rep
+            ),
+            out_specs=(heads, pool, pool, pool, pool),
+            check_rep=False,
+        )(q, k, v, k_pool, v_pool, ks_pool, vs_pool, table, idx)
+
+    def local(q, k, v, kp, vp, table, idx):
+        o, kp, vp, _, _ = _paged_scatter_and_attend(
+            q, k, v, kp, vp, None, None, table, idx, None
+        )
+        return o, kp, vp
+
+    o, k_pool, v_pool = shard_map(
+        local, mesh=mesh,
+        in_specs=(heads, heads, heads, pool, pool, rep, rep),
+        out_specs=(heads, pool, pool),
+        check_rep=False,
+    )(q, k, v, k_pool, v_pool, table, idx)
+    return o, k_pool, v_pool, None, None
+
+
+def _tp_fused_paged(
+    mesh, tp, num_heads, kv_heads, rope_theta, quant,
+    x, kernel, bias, w_scale, k_pool, v_pool, ks_pool, vs_pool,
+    table, idx,
+):
+    """Tensor-parallel wrapper for the fused QKV/rotary/attention
+    kernel: `shard_map` over the `model` axis with PER-SHARD WEIGHT
+    SLICES. The fused projection weight is [q | k | v]-concatenated,
+    so a uniform column split would cross the section boundaries —
+    the wrapper slices the three sections apart (kernel, bias, and
+    int8 scale row alike), shards each on its output dim (whole
+    heads per shard: num_heads and kv_heads both divide tp by
+    construction), and re-concatenates LOCALLY, so every shard
+    streams exactly its own heads' projection columns once. Each
+    shard then runs the unmodified fused kernel on local shapes —
+    projecting its heads, injecting its fresh K/V rows in VMEM, and
+    scattering its head slice of every fresh row into its pool shard
+    (the caller-side scatter the fused contract requires, moved
+    inside the shard). x and the table/index replicate; o returns
+    head-sharded into the row-parallel out_proj's psum."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    hd = k_pool.shape[-1]
+    d = num_heads * hd
+    kvd = kv_heads * hd
+
+    def sections(row):
+        return row[..., :d], row[..., d:d + kvd], row[..., d + kvd:]
+
+    col = P(None, AXIS_MODEL)
+    vec = P(AXIS_MODEL)
+    pool = P(None, AXIS_MODEL)
+    rep = P()
+    args = [x, *sections(kernel)]
+    in_specs = [rep, col, col, col]
+    has_bias = bias is not None
+    has_scale = w_scale is not None
+    if has_bias:
+        args += list(sections(bias))
+        in_specs += [vec, vec, vec]
+    if has_scale:
+        args += list(sections(w_scale))
+        in_specs += [vec, vec, vec]
+    args += [k_pool, v_pool]
+    in_specs += [pool, pool]
+    if quant:
+        args += [ks_pool, vs_pool]
+        in_specs += [pool, pool]
+    args += [table, idx]
+    in_specs += [rep, rep]
+    heads_out = P(None, AXIS_MODEL)
+    out_specs = (
+        (heads_out, pool, pool, pool, pool) if quant
+        else (heads_out, pool, pool)
+    )
+
+    def local(*a):
+        it = iter(a)
+        xv = next(it)
+        w = jnp.concatenate([next(it), next(it), next(it)], axis=-1)
+        b = (
+            jnp.concatenate([next(it), next(it), next(it)], axis=-1)
+            if has_bias else None
+        )
+        ws = (
+            jnp.concatenate([next(it), next(it), next(it)], axis=-1)
+            if has_scale else None
+        )
+        kp, vp = next(it), next(it)
+        ksp, vsp = (next(it), next(it)) if quant else (None, None)
+        tbl, ix = next(it), next(it)
+        o, k_new, v_new = fused_qkv_paged_attention(
+            xv, w, b, kp, vp, tbl, ix,
+            num_heads=num_heads // tp, rope_theta=rope_theta,
+            w_scale=ws,
+            k_scales=ksp, v_scales=vsp,
+        )
+        if quant:
+            kp, vp, ksp, vsp = scatter_paged_rows(
+                kp, vp, k_new, v_new, tbl, ix,
+                k_scale_pool=ksp, v_scale_pool=vsp, quant=quant,
+            )
+            return o, kp, vp, ksp, vsp
+        kp, vp = scatter_paged_rows(kp, vp, k_new, v_new, tbl, ix)
+        return o, kp, vp
+
+    out = shard_map(
+        local, mesh=mesh,
+        in_specs=tuple(in_specs), out_specs=out_specs,
+        check_rep=False,
+    )(*args)
+    if quant:
+        return out
+    o, k_pool, v_pool = out
+    return o, k_pool, v_pool, None, None
 
 
 def _fused_qkv_backend_ok() -> bool:
@@ -728,48 +1065,35 @@ class CausalAttention(nn.Module):
         # and DROP (never clip — a clipped write would rewrite the
         # slot's last real block in-place); the one write rule lives
         # in ops/decode_attention.scatter_paged_rows, shared with the
-        # fused QKV path. Quantized pools quantize fresh rows HERE —
-        # at emit — so the unfused path, the fused kernel's caller,
+        # fused QKV path. Quantized pools quantize fresh rows at that
+        # emit seam, so the unfused path, the fused kernel's caller,
         # and the device-resident loop's in-body scatters all share
-        # one quantization seam.
-        ks = vs = None
-        if quant:
-            k_pool, v_pool, ks, vs = scatter_paged_rows(
-                pool_k.value, pool_v.value, k, v, block_table, idx,
-                k_scale_pool=scale_k.value, v_scale_pool=scale_v.value,
-                quant=quant,
+        # one quantization rule. The scatter + read segment is
+        # `_paged_scatter_and_attend`; under tensor parallelism
+        # (serving mesh with model-axis degree > 1) the SAME segment
+        # runs inside shard_map on per-shard head slices — the
+        # kernels become shard-aware without forking.
+        tp = _mesh_tp(self.mesh)
+        if tp > 1:
+            o, kp, vp, ks, vs = _tp_paged_scatter_and_attend(
+                self.mesh, quant, q, k, v,
+                pool_k.value, pool_v.value,
+                scale_k.value if quant else None,
+                scale_v.value if quant else None,
+                block_table, idx,
             )
+        else:
+            o, kp, vp, ks, vs = _paged_scatter_and_attend(
+                q, k, v, pool_k.value, pool_v.value,
+                scale_k.value if quant else None,
+                scale_v.value if quant else None,
+                block_table, idx, quant,
+            )
+        pool_k.value, pool_v.value = kp, vp
+        if quant:
             scale_k.value, scale_v.value = ks, vs
-        else:
-            k_pool, v_pool = scatter_paged_rows(
-                pool_k.value, pool_v.value, k, v, block_table, idx
-            )
-        pool_k.value, pool_v.value = k_pool, v_pool
         index.value = idx + steps
-        if steps <= MAX_KERNEL_STEPS:
-            # The table-indexed streamed kernel reads each referenced
-            # block exactly once (on CPU it falls back to the gather
-            # reference internally). MHA takes this path too in paged
-            # mode: the gather alternative would copy the cache.
-            if steps == 1:
-                return paged_decode_attention(
-                    q[:, :, 0], k_pool, v_pool, block_table, idx,
-                    k_scales=ks, v_scales=vs,
-                )[:, :, None, :]
-            return paged_decode_attention(
-                q, k_pool, v_pool, block_table, idx,
-                k_scales=ks, v_scales=vs,
-            )
-        if quant:
-            # Wide prefill chunks dequantize the gathered view once
-            # (the gather already defeats paging; the dequant rides
-            # the same copy).
-            k_all = dequantize_gathered(k_pool, ks, block_table, q.dtype)
-            v_all = dequantize_gathered(v_pool, vs, block_table, q.dtype)
-        else:
-            k_all = gather_paged_cache(k_pool, block_table)
-            v_all = gather_paged_cache(v_pool, block_table)
-        return _masked_cache_attention(q, k_all, v_all, idx, True)
+        return o
 
     def _fused_paged_decode(self, x, block_table):
         """Short-step paged decode through the fused QKV/rotary/
@@ -830,6 +1154,27 @@ class CausalAttention(nn.Module):
             if c.use_bias else None
         )
         idx = index.value
+        tp = _mesh_tp(self.mesh)
+        if tp > 1:
+            # Per-shard weight slices through shard_map: each shard
+            # streams its own heads' projection columns, injects its
+            # fresh K/V rows, and scatters its head slice into its
+            # pool shard (the caller-side scatter, moved inside the
+            # shard so fresh rows never leave it).
+            o, kp, vp, ks, vs = _tp_fused_paged(
+                self.mesh, tp, c.num_heads, kv_heads,
+                c.rope_theta if c.rope else None, quant,
+                x.astype(c.compute_dtype), kernel, bias, w_scale,
+                pool_k.value, pool_v.value,
+                scale_k.value if quant else None,
+                scale_v.value if quant else None,
+                block_table, idx,
+            )
+            pool_k.value, pool_v.value = kp, vp
+            if quant:
+                scale_k.value, scale_v.value = ks, vs
+            index.value = idx + steps
+            return o
         o, k_new, v_new = fused_qkv_paged_attention(
             x.astype(c.compute_dtype), kernel, bias,
             pool_k.value, pool_v.value, block_table, idx,

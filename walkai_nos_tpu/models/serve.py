@@ -158,13 +158,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import NamedSharding, PartitionSpec
+
 from walkai_nos_tpu.models.block_pool import BlockPool
 from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import (
     DecoderLM,
     LMConfig,
+    expand_kv_heads,
     quantize_lm_params,
 )
+from walkai_nos_tpu.parallel import sharding as shardlib
+from walkai_nos_tpu.parallel.mesh import serving_mesh
 from walkai_nos_tpu.models.prefix_cache import PrefixIndex
 from walkai_nos_tpu.models.speculative import (
     accept_tokens,
@@ -176,6 +181,7 @@ from walkai_nos_tpu.obs.attrib import (
     classify_dispatch,
     kv_hbm_bytes_per_token,
     params_hbm_bytes,
+    tp_ici_bytes_per_token,
 )
 from walkai_nos_tpu.obs.serving import ServingObs
 from walkai_nos_tpu.obs.slo import SloTracker
@@ -389,7 +395,44 @@ class ContinuousBatcher:
             self.cfg = dataclasses.replace(
                 cfg, ragged_decode=True, cache_len=cache_len
             )
-        self._model = DecoderLM(self.cfg)
+        # Tensor-parallel serving (`cfg.tp_devices` > 1): the decode
+        # step shards over a `model`-axis mesh — Megatron
+        # column/row-parallel weights via the NamedSharding rules
+        # (GSPMD inserts one psum per attention block and one per
+        # MLP), per-shard kv-head slices of the paged pools under the
+        # SAME physical block ids, and shard_map'd hot kernels
+        # (models/lm.py). Everything host-side — the batcher, the
+        # BlockPool, the prefix trie, block tables, admission — stays
+        # byte-identical to the single-chip engine: the only things
+        # that shard are device arrays.
+        self.tp = self.cfg.tp_devices
+        self._tp_kv_layout = self.cfg.tp_kv_layout
+        self._mesh = None
+        self._repl = None
+        if self.tp > 1:
+            if not paged:
+                raise ValueError(
+                    "tp_devices > 1 requires the paged engine (the "
+                    "per-shard KV layout is a kv-head split of the "
+                    "block pools; the dense cache has no pool to "
+                    "split)"
+                )
+            if self.cfg.kv_heads < self.tp:
+                # Head-replicated K/V (the GQA design decision at
+                # tp > kv_heads): expand the qkv projection's K/V
+                # column blocks and the cache's kv-head count to tp
+                # effective heads — each original head replicated
+                # across the shards whose query heads read it — so
+                # one uniform head split serves both regimes.
+                self.params = expand_kv_heads(
+                    self.params, self.cfg, self.tp
+                )
+                self.cfg = dataclasses.replace(
+                    self.cfg, num_kv_heads=self.tp
+                )
+            self._mesh = serving_mesh(self.tp)
+            self._repl = NamedSharding(self._mesh, PartitionSpec())
+        self._model = DecoderLM(self.cfg, self._mesh)
         # Speculative serving (paged only): the draft holds its own
         # paged pool with the SAME block count, addressed through the
         # same host tables — one physical block id names a (target,
@@ -428,6 +471,11 @@ class ContinuousBatcher:
             self._draft_cfg = dataclasses.replace(
                 draft_cfg, ragged_decode=True, cache_len=cache_len,
                 paged_decode=True, paged_blocks=self.pool_blocks,
+                # The draft serves REPLICATED on a TP engine (its
+                # step is a small fraction of the target's FLOPs;
+                # every shard runs it redundantly rather than paying
+                # a second sharding design + its collectives).
+                tp_devices=1,
             )
             self._draft_model = DecoderLM(self._draft_cfg)
             self.draft_params = draft_params
@@ -483,6 +531,18 @@ class ContinuousBatcher:
         if self.cfg.w_quant:
             jax.block_until_ready(self.params)
         self.obs.quant_seconds.inc(time.monotonic() - t_quant)
+        if self._mesh is not None:
+            # Megatron placement: column-parallel qkv/gate/fc1 (and
+            # their biases + QuantDense scale rows), row-parallel
+            # out_proj/fc2 — the NamedSharding rules in
+            # parallel/sharding.py; GSPMD lowers the one-psum-per-
+            # block collective schedule from these. The draft tree
+            # replicates (it serves unsharded on every chip).
+            self.params = shardlib.shard_params(self.params, self._mesh)
+            if self._spec:
+                self.draft_params = jax.device_put(
+                    self.draft_params, self._repl
+                )
         self._record_kv_backing_bytes()
         # Device-time attribution (obs/attrib.py): every dispatch's
         # blocked device sync vs host assembly, classified by
@@ -499,12 +559,26 @@ class ContinuousBatcher:
         except Exception:  # noqa: BLE001 — telemetry must not gate serving
             bw = None
         self._param_bytes = params_hbm_bytes(self.params)
+        # TP-aware cost model: the roofline's per-chip HBM terms are
+        # the PER-SHARD weight and KV bytes (each chip streams only
+        # its slices), plus the analytic ICI bytes the two per-layer
+        # psums move — otherwise cb_device_roofline_fraction would
+        # flatter a tp>1 engine by the shard count.
+        self._param_shard_bytes = (
+            shardlib.params_shard_bytes(self.params)
+            if self._mesh is not None else self._param_bytes
+        )
+        self._kv_shard_bytes_per_token = (
+            self._kv_bytes_per_token() // self.tp
+        )
         self._attrib = DispatchAttribution(
             self.obs,
-            param_bytes=self._param_bytes,
-            kv_bytes_per_token=self._kv_bytes_per_token(),
+            param_bytes=self._param_shard_bytes,
+            kv_bytes_per_token=self._kv_shard_bytes_per_token,
             hbm_bytes_per_s=bw,
+            ici_bytes_per_token=tp_ici_bytes_per_token(self.cfg),
         )
+        self.obs.tp_devices_gauge.set(self.tp)
         # Sliding-window SLO / saturation layer (obs/slo.py): windowed
         # TTFT/TPOT/dispatch quantiles, per-objective compliance +
         # burn rate, and the composed cb_saturation scale signal.
@@ -549,6 +623,15 @@ class ContinuousBatcher:
             jnp.zeros((slots, 1), jnp.int32),
             decode=True,
         )["cache"]
+        if self._mesh is not None:
+            # Per-shard KV: the paged pools (and their scale pools)
+            # split their kv-head dimension over the model axis —
+            # each chip physically backs only its head slices of
+            # every block, so the pool a single chip must hold
+            # shrinks by the shard count while the block ids (and
+            # the host books over them) stay global. Index vectors
+            # and slot state replicate.
+            cache = shardlib.shard_cache(cache, self._mesh)
         # Device state: (cache, next-input token per slot, per-slot
         # sampling knobs, per-slot PRNG key).
         self._state = (
@@ -559,6 +642,11 @@ class ContinuousBatcher:
             jnp.ones(slots, jnp.float32),        # top_p
             jax.random.split(jax.random.PRNGKey(0), slots),
         )
+        if self._mesh is not None:
+            self._state = (cache,) + tuple(
+                jax.device_put(leaf, self._repl)
+                for leaf in self._state[1:]
+            )
         if self._spec:
             # Draft-side paged pool + per-slot index mirror; the
             # sampling knobs and PRNG keys stay in the target state
@@ -568,6 +656,10 @@ class ContinuousBatcher:
                 jnp.zeros((slots, 1), jnp.int32),
                 decode=True,
             )["cache"]
+            if self._mesh is not None:
+                self._d_cache = jax.device_put(
+                    self._d_cache, self._repl
+                )
             self.obs.spec_k_gauge.set(spec_k)
             self.obs.spec_disabled.set(0)
         if paged:
@@ -1428,6 +1520,12 @@ class ContinuousBatcher:
             "kv_resident_dispatch_acc": int(self.obs.kv_resident.value()),
             "kv_bytes_per_token": per_tok,
             "kv_backing_bytes": backing,
+            # Bytes ONE shard physically backs (== kv_backing_bytes
+            # at tp=1): the per-chip HBM budget a tensor-parallel
+            # pool must fit — a model whose total KV footprint
+            # exceeds one chip's budget serves as long as
+            # backing/tp fits.
+            "kv_shard_backing_bytes": backing // max(1, self.tp),
             "kv_pool_blocks": self.pool_blocks if self.paged else None,
             # Actual residency (lazy allocation: decode blocks are
             # grabbed at boundary crossings, not reserved physically),
@@ -1645,6 +1743,7 @@ class ContinuousBatcher:
             "spec": self.spec_stats(),
             "loop": self.loop_stats(),
             "quant": self.quant_stats(),
+            "tp": self.tp_stats(),
             "attrib": self.attrib_stats(),
             "slo": self.slo_stats(),
         }
@@ -1659,6 +1758,18 @@ class ContinuousBatcher:
         return out
 
     # -- internals -----------------------------------------------------
+
+    def _dev(self, a):
+        """Host array -> device array for a dispatch input. On a
+        tensor-parallel engine every jit input must live on the
+        serving mesh (mixing mesh-resident state with default-device
+        arrays is a compile-time device mismatch), so host-built
+        arrays — the block table, the prefill-lane operands, the loop
+        exit inputs — upload REPLICATED across the shards; at tp=1
+        this is today's `jnp.asarray`, bit for bit."""
+        if self._mesh is None:
+            return jnp.asarray(a)
+        return jax.device_put(np.asarray(a), self._repl)
 
     def _kv_bytes_per_token(self) -> int:
         """Physical KV bytes per resident token — the shared
@@ -1719,6 +1830,31 @@ class ContinuousBatcher:
             "weight_quant_seconds": round(
                 self.obs.quant_seconds.value(), 6
             ),
+        }
+
+    def tp_stats(self) -> dict:
+        """Tensor-parallel serving telemetry — the `/stats` `cb_tp`
+        section and the `/debug/state` `tp` block: the mesh degree,
+        the GQA K/V design decision in force, the per-shard byte
+        terms the roofline cost model runs on, and the registry's
+        ICI gauge. Same shape + `obs_disabled` with telemetry off
+        (the PR 3 convention); at tp=1 `enabled` is False and the
+        shard terms equal the global ones."""
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "enabled": self.tp > 1,
+            "tp_devices": self.tp,
+            # kv-split: each shard holds kv_heads/tp head slices of
+            # every pool block; head-replicated: tp > kv_heads, each
+            # kv head duplicated across the shards whose query heads
+            # read it (cache expanded to tp effective heads).
+            "kv_layout": self._tp_kv_layout,
+            "kv_heads_served": self.cfg.kv_heads,
+            "param_bytes": self._param_bytes,
+            "param_shard_bytes": self._param_shard_bytes,
+            "kv_shard_bytes_per_token": self._kv_shard_bytes_per_token,
+            "ici_bytes_per_token": tp_ici_bytes_per_token(self.cfg),
+            "ici_bytes_per_step": self.obs.ici_step_bytes.value(),
         }
 
     # Pool bookkeeping lives in `models/block_pool.py`; these views
@@ -1841,7 +1977,7 @@ class ContinuousBatcher:
         resident = self._record_kv_snapshot()
         self.obs.profile.on_dispatch()
         t0 = time.monotonic()
-        dec_table = jnp.asarray(self._table)
+        dec_table = self._dev(self._table)
         if self._prefilling:
             lane_rows = len(self._prefilling)
             pf, finished = self._prepare_lane(t0)
@@ -2009,7 +2145,7 @@ class ContinuousBatcher:
             nlog *= 2
         nlog = min(nlog, self._nlog)
         pf = tuple(
-            jnp.asarray(a) for a in (
+            self._dev(a) for a in (
                 pf_tok, pf_start, pf_tbl[:, :nlog], pf_fslot,
                 pf_true, pf_temp, pf_topk, pf_topp, pf_seed,
             )
@@ -2172,6 +2308,7 @@ class ContinuousBatcher:
             kind=ctx["kind"], steps=ctx["steps"],
             host_s=ctx["host_s"], device_s=device_s,
             resident_tokens=ctx["resident"],
+            busy_slots=ctx["busy"],
         )
         self.obs.trace.dispatch(
             now, ctx["kind"], ctx["steps"], ctx["host_s"], device_s
@@ -2323,10 +2460,10 @@ class ContinuousBatcher:
         self._slot_new = [False] * self.slots
         busy = int(live_mask.sum())
         t0 = time.monotonic()
-        dec_table = jnp.asarray(pool.table)
+        dec_table = self._dev(pool.table)
         args = (
-            jnp.asarray(live_mask), jnp.asarray(eos),
-            jnp.asarray(owed), jnp.asarray(backed),
+            self._dev(live_mask), self._dev(eos),
+            self._dev(owed), self._dev(backed),
         )
         counts = None
         if spec:

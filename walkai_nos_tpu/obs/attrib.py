@@ -60,6 +60,7 @@ __all__ = [
     "classify_dispatch",
     "kv_hbm_bytes_per_token",
     "params_hbm_bytes",
+    "tp_ici_bytes_per_token",
 ]
 
 
@@ -92,6 +93,23 @@ def kv_hbm_bytes_per_token(cfg) -> int:
     return cfg.num_layers * 2 * cfg.kv_heads * (
         head_dim * item + scale_bytes
     )
+
+def tp_ici_bytes_per_token(cfg) -> int:
+    """Analytic ICI bytes one slot-token moves through the
+    tensor-parallel collectives: the Megatron layout pays exactly two
+    psums per layer (the row-parallel out_proj and fc2 reduce their
+    partial activations onto the residual), and a ring all-reduce of
+    an N-byte activation moves 2*(tp-1)/tp * N bytes through each
+    chip. 0 at tp <= 1 — the gauge this feeds reads zero on a
+    single-chip engine by construction, and the roofline cost model
+    adds nothing."""
+    tp = getattr(cfg, "tp_devices", 1)
+    if tp <= 1:
+        return 0
+    act_bytes = cfg.hidden_dim * cfg.compute_dtype.itemsize
+    per_psum = 2 * (tp - 1) * act_bytes // tp
+    return cfg.num_layers * 2 * per_psum
+
 
 # Every value the `kind` label can take, in documentation order.
 DISPATCH_KINDS = ("decode", "prefill", "mixed", "spec", "spec_prefill")
@@ -130,12 +148,21 @@ class DispatchAttribution:
         param_bytes: int = 0,
         kv_bytes_per_token: int = 0,
         hbm_bytes_per_s: float | None = None,
+        ici_bytes_per_token: float = 0.0,
         window: int = 128,
     ):
         self.enabled = obs.enabled
         self._obs = obs
+        # TP-aware inputs: on a tensor-parallel engine the caller
+        # passes PER-SHARD weight and KV bytes (each chip streams
+        # only its slices — the division by the shard count is the
+        # CALLER's contract) plus the per-token ICI bytes of the two
+        # per-layer psums, so the analytic floor stays the floor of
+        # what ONE chip actually does and the roofline fraction
+        # stays honest at tp > 1.
         self._param_bytes = float(param_bytes)
         self._kv_per_tok = float(kv_bytes_per_token)
+        self._ici_per_tok = float(ici_bytes_per_token)
         self._bw = hbm_bytes_per_s or None
         if window <= 0:
             raise ValueError(f"window must be > 0; got {window}")
@@ -157,12 +184,16 @@ class DispatchAttribution:
         host_s: float,
         device_s: float,
         resident_tokens: int,
+        busy_slots: int = 0,
     ) -> None:
         """One dispatch: `steps` = its per-slot step window (chunk
         size for a plain chunk, k+1 for a speculative round), `host_s`
         = measured host assembly + bookkeeping, `device_s` = the
         blocked device sync, `resident_tokens` = KV-resident tokens
-        at dispatch (the cost model's cache-read term)."""
+        at dispatch (the cost model's cache-read term), `busy_slots`
+        = slots carrying a live request (the ICI term's token count —
+        each live slot moves one activation through the psums per
+        step)."""
         if not self.enabled:
             return
         obs = self._obs
@@ -170,12 +201,20 @@ class DispatchAttribution:
         obs.device_time.inc(max(0.0, device_s), {"kind": kind})
         obs.host_time.inc(max(0.0, host_s), {"kind": kind})
         obs.device_sync.observe(device_s)
+        if self._ici_per_tok:
+            # Analytic ICI bytes one batch step moves through the TP
+            # psums (0 series at tp=1: the gauge is only written on
+            # TP engines).
+            obs.ici_step_bytes.set(
+                float(busy_slots) * self._ici_per_tok
+            )
         ideal_s = None
         bytes_per_step = None
         if self._bw:
             # Analytic HBM floor of this dispatch: every decode step
-            # re-reads the weights and the resident KV once (the same
-            # model bench_lm's decode ceiling uses).
+            # re-reads the (per-shard) weights and resident KV once
+            # (the same model bench_lm's decode ceiling uses, divided
+            # by the shard count at tp > 1).
             bytes_per_step = (
                 self._param_bytes + resident_tokens * self._kv_per_tok
             )

@@ -315,6 +315,20 @@ CATALOG: tuple[MetricSpec, ...] = (
         "staleness = now - value)",
         attr="last_dispatch",
     ),
+    # -- tensor-parallel serving (models/serve.py, cfg.tp_devices) -----
+    MetricSpec(
+        "cb_tp_devices", "gauge",
+        "Tensor-parallel shard count of the serving mesh (1 = "
+        "single-chip engine; set once at engine build)",
+        attr="tp_devices_gauge",
+    ),
+    MetricSpec(
+        "cb_ici_bytes_per_step", "gauge",
+        "Analytic ICI bytes one batch step moves through the "
+        "tensor-parallel psums (2 per layer, ring all-reduce cost "
+        "per live slot; only written on tp > 1 engines)",
+        attr="ici_step_bytes",
+    ),
     # -- device-time attribution (obs/attrib.py) -----------------------
     MetricSpec(
         "cb_dispatch_kind_total", "counter",

@@ -117,6 +117,33 @@ def build_mesh(
     return Mesh(arr, ALL_AXES)
 
 
+def serving_mesh(
+    tp_devices: int,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """The serving engine's tensor-parallel mesh: `tp_devices` chips on
+    the `model` axis, every other axis size 1 (`models/serve.py`,
+    `LMConfig.tp_devices`). Uses the FIRST `tp_devices` visible devices
+    — adjacent device ids are adjacent chips on the ICI mesh (JAX's
+    default TPU device order), so the per-layer TP psums ride
+    nearest-neighbor links, exactly the `build_mesh` placement rule.
+    On a CPU host with `--xla_force_host_platform_device_count=N`
+    (the `WALKAI_TP_EMULATE` seam) the same mesh builds over virtual
+    devices, which is how the tp parity suite pins tp=2/4 without a
+    TPU."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if tp_devices < 1:
+        raise ValueError(f"tp_devices must be >= 1; got {tp_devices}")
+    if len(devs) < tp_devices:
+        raise ValueError(
+            f"tp_devices={tp_devices} exceeds the {len(devs)} visible "
+            f"devices (on CPU, set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count / the demo "
+            f"server's WALKAI_TP_EMULATE knob before jax initializes)"
+        )
+    return build_mesh(devs[:tp_devices], axes=MeshAxes(model=tp_devices))
+
+
 def slice_mesh(
     shape: str | topology.Shape,
     devices: Sequence[jax.Device] | None = None,

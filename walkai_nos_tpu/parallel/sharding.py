@@ -42,6 +42,16 @@ _PARAM_RULES: list[tuple[str, P]] = [
     (r"(class_head|box_head|head)/.*kernel", P(AXIS_FSDP, AXIS_MODEL)),
     # Biases of column-parallel layers follow their kernel's output split.
     (r"(qkv|query|key|value|fc1|gate|up|class_head|box_head|head)/.*bias", P(AXIS_MODEL)),
+    # QuantDense `scale` leaves (models/lm.py, w_dtype=int8): one f32
+    # scale per OUTPUT channel, so the row must follow its kernel's
+    # output-dim sharding — column-parallel scales split over `model`
+    # like their bias, row-parallel scales over the kernel's `fsdp`
+    # output split. Without these rows the int8 tree from
+    # `quantize_lm_params` fell through to the replicated catch-all
+    # and a sharded QuantDense dequantized with a shape-mismatched
+    # scale.
+    (r"(qkv|query|key|value|fc1|gate|up|class_head|box_head|head)/scale", P(AXIS_MODEL)),
+    (r"(out_proj|proj|fc2|down)/scale", P(AXIS_FSDP)),
     # Everything else (layernorms, row-parallel biases, cls/det tokens,
     # position embeddings) is replicated.
     (r".*", P()),
@@ -97,11 +107,88 @@ def param_specs(params, mesh: Mesh | None = None) -> object:
 
 
 def shard_params(params, mesh: Mesh):
-    """Place a params pytree onto `mesh` per the rules (device_put)."""
+    """Place a params pytree onto `mesh` per the rules (one batched
+    device_put — a per-leaf loop pays a dispatch per leaf)."""
     specs = param_specs(params, mesh)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
     )
+    return jax.device_put(params, shardings)
+
+
+# Decode-cache leaf names whose kv-head dimension shards over the
+# serving mesh's `model` axis (models/lm.py paged pools: data pools are
+# [blocks, kv_heads, PAGE_ROWS, head_dim], scale pools [blocks,
+# kv_heads, PAGE_ROWS] — dim 1 is the kv-head dim in both). Index
+# vectors and everything else replicate — the host-side block tables
+# stay byte-identical on every shard.
+_CACHE_KV_LEAVES = (
+    "cached_key", "cached_value",
+    "cached_key_scale", "cached_value_scale",
+)
+
+
+def cache_specs(cache, mesh: Mesh | None = None) -> object:
+    """Pytree of `PartitionSpec`s for a decode-cache collection: paged
+    K/V pools (and their parallel scale pools) shard their kv-head
+    dimension over the `model` axis — each shard holds its heads'
+    block slices under the SAME physical block ids — while cache/pos
+    index vectors replicate. With `mesh`, specs are fitted to leaf
+    shapes (a kv-head count the axis doesn't divide replicates; the
+    serving engine's head-replicated expansion makes that unreachable
+    at tp > 1)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+    specs = []
+    for path, leaf in flat:
+        name = ""
+        if path:
+            last = path[-1]
+            name = getattr(last, "key", getattr(last, "name", str(last)))
+        spec = (
+            P(None, AXIS_MODEL) if name in _CACHE_KV_LEAVES else P()
+        )
+        if mesh is not None:
+            spec = _fit_spec(spec, tuple(getattr(leaf, "shape", ())), mesh)
+        specs.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_cache(cache, mesh: Mesh):
+    """Place a decode-cache pytree onto `mesh` per `cache_specs`."""
+    specs = cache_specs(cache, mesh)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(cache, shardings)
+
+
+def params_shard_bytes(params) -> int:
+    """Per-DEVICE HBM bytes of a (possibly sharded) param tree: the
+    sum of each leaf's shard size on one device — what a decode step
+    actually streams per chip, the TP-aware replacement for
+    `obs/attrib.params_hbm_bytes` in the roofline cost model. Falls
+    back to the leaf's full bytes for unsharded/abstract leaves."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        nbytes = int(getattr(leaf, "nbytes", 0))
+        sharding = getattr(leaf, "sharding", None)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if sharding is not None and shape and nbytes:
+            try:
+                shard_shape = sharding.shard_shape(shape)
+                elems = 1
+                for dim in shape:
+                    elems *= dim
+                shard_elems = 1
+                for dim in shard_shape:
+                    shard_elems *= dim
+                nbytes = nbytes * shard_elems // max(1, elems)
+            except Exception:  # noqa: BLE001 — telemetry must not gate serving
+                pass
+        total += nbytes
+    return total
 
 
 def batch_sharding(mesh: Mesh, *, seq_axis: int | None = None) -> NamedSharding:
