@@ -635,9 +635,16 @@ def router_benchmark() -> dict:
     prefix-cache hit rate, gated >= 0.5 like the single-engine key it
     aggregates; `router_rr_prefix_hit_rate` rides along as the
     baseline arm), and `router_scale_events_total` (reconciler
-    actions during the replay)."""
+    actions during the replay). A second, smaller A/B replay emits
+    `router_obs_overhead_pct` — the fleet observability plane
+    (router registry + request spans + per-step anomaly scoring) on
+    vs off on the same trace, engine telemetry on in both arms —
+    gated at the same absolute < 2% budget as `obs_overhead_pct`."""
     from walkai_nos_tpu.router.autoscale import ScalePolicy
-    from walkai_nos_tpu.sim.trafficbench import run_traffic_benchmark
+    from walkai_nos_tpu.sim.trafficbench import (
+        measure_router_obs_overhead,
+        run_traffic_benchmark,
+    )
 
     r = run_traffic_benchmark(
         n_replicas=2,
@@ -651,7 +658,9 @@ def router_benchmark() -> dict:
             idle_ticks=12, cooldown_ticks=16,
         ),
     )
-    return r.bench_keys()
+    out = r.bench_keys()
+    out.update(measure_router_obs_overhead())
+    return out
 
 
 def obs_overhead_benchmark() -> dict:
@@ -719,7 +728,7 @@ def main() -> None:
             "cb_tp_capacity_tokens_per_s", "tp_scaling_efficiency",
             "obs_overhead_pct",
             "router_ttft_p99_under_surge", "router_prefix_hit_rate",
-            "router_scale_events_total",
+            "router_scale_events_total", "router_obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )
         if k in result

@@ -31,10 +31,15 @@ Endpoints:
 - GET  /stats  -> cumulative fenced {images, requests, batches, flops,
   monotonic_s} + {device_kind, peak_bf16_flops,
   model_ceiling_images_per_s, fence_rtt_s} for utilization measurement.
-- GET  /healthz -> readiness payload: {"ok": true, "engine": {alive,
-  queue_depth, seconds_since_last_dispatch, has_work, draining,
+- GET  /healthz -> readiness payload: {"ok": true, "monotonic_s":
+  this process's clock read (the fleet router's trace clock-offset
+  estimate), "engine": {alive, queue_depth,
+  seconds_since_last_dispatch, has_work, draining,
   slots} | null} (engine block present when continuous batching is
-  enabled).
+  enabled). POST /generate accepts an `X-Walkai-Trace` header (the
+  fleet router's cross-process trace id), stores it on the engine
+  submit, and echoes it on the response (header + "trace_id" field)
+  so clients can correlate a slow call with /debug/trace.
 - GET  /metrics -> Prometheus text exposition of the obs registry
   (serving-engine dispatch/TTFT/TPOT/pool telemetry; see
   docs/observability.md for every exported name).
@@ -62,6 +67,7 @@ import os
 import queue
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -212,6 +218,23 @@ def engine_health(engine, alive: bool) -> dict | None:
         ),
         "slo_ok": engine.slo_ok,
     }
+
+
+def request_trace_id(*candidates) -> str:
+    """First well-formed candidate (header value, body field), else a
+    freshly minted local id — every /generate response carries SOME
+    id, so a client can correlate a slow call with /debug/trace
+    without guessing. Validation is `obs/trace.valid_trace_id`, the
+    ONE charset contract shared with the router: a drifted copy
+    would make one side reject and re-mint the other side's ids,
+    silently breaking cross-process correlation."""
+    from walkai_nos_tpu.obs.trace import valid_trace_id
+
+    for candidate in candidates:
+        adopted = valid_trace_id(candidate)
+        if adopted is not None:
+            return adopted
+    return "d" + uuid.uuid4().hex[:15]
 
 
 def _bucket(n: int, max_batch: int) -> int:
@@ -919,6 +942,15 @@ def main() -> None:
 
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n) or b"{}")
+            # The request's cross-process trace id: the router's
+            # X-Walkai-Trace header (or a body field), else minted
+            # here. Echoed on every success response (header + JSON)
+            # and stored on the engine submit so the engine's
+            # lifecycle spans carry it into /debug/trace.
+            trace_id = request_trace_id(
+                self.headers.get("X-Walkai-Trace"),
+                body.get("trace_id"),
+            )
             prompt = body.get("prompt")
             speculative = bool(body.get("speculative"))
             if speculative and lm_spec_generate is None:
@@ -1017,8 +1049,11 @@ def main() -> None:
                 # and a bad value fails only this request (400).
                 if req_eos is not None:
                     knobs["eos_id"] = req_eos
+                knobs["trace_id"] = trace_id
                 if req_stream:
-                    self._generate_stream(prompt, knobs, req_max_new)
+                    self._generate_stream(
+                        prompt, knobs, req_max_new, trace_id
+                    )
                     return
                 waiter = {"done": threading.Event()}
                 t0 = time.perf_counter()
@@ -1048,6 +1083,7 @@ def main() -> None:
                 dt = time.perf_counter() - t0
                 try:
                     self._json(200, {
+                        "trace_id": trace_id,
                         "tokens": waiter["tokens"],
                         "generate_time_seconds": round(dt, 6),
                         "ttft_seconds": round(
@@ -1070,7 +1106,7 @@ def main() -> None:
                         # fewer tokens than requested is then a
                         # capacity signal, not a natural completion.
                         "truncated": waiter.get("truncated", False),
-                    })
+                    }, headers={"X-Walkai-Trace": trace_id})
                 except (BrokenPipeError, ConnectionResetError):
                     # Client gave up before the response: the work was
                     # done and discarded — that's a served-for-nothing
@@ -1112,14 +1148,15 @@ def main() -> None:
                 tokens = np.asarray(out)[0].tolist()  # fenced by fetch
                 dt = time.perf_counter() - t0
             self._json(200, {
+                "trace_id": trace_id,
                 "tokens": tokens,
                 "generate_time_seconds": round(dt, 6),
                 "tokens_per_second": round(lm_max_new / dt, 1),
                 "slice": slice_id,
                 **extra,
-            })
+            }, headers={"X-Walkai-Trace": trace_id})
 
-        def _generate_stream(self, prompt, knobs, req_max_new):
+        def _generate_stream(self, prompt, knobs, req_max_new, trace_id):
             """Server-sent events: tokens stream as each engine chunk
             syncs (up to chunk_steps per event), then a final event
             with the request telemetry. The connection closes at end
@@ -1161,6 +1198,7 @@ def main() -> None:
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
+            self.send_header("X-Walkai-Trace", trace_id)
             self.end_headers()
             self.close_connection = True
 
@@ -1178,6 +1216,7 @@ def main() -> None:
                         else:
                             event({
                                 "done": True,
+                                "trace_id": trace_id,
                                 "n_tokens": len(waiter["tokens"]),
                                 "ttft_seconds": round(
                                     waiter.get("ttft_s", 0.0), 6
@@ -1221,8 +1260,14 @@ def main() -> None:
             if self.path == "/healthz":
                 # Readiness, not bare liveness: a probe (or operator)
                 # sees whether the engine loop is alive and moving.
+                # `monotonic_s` is this process's clock read at
+                # response build: the fleet router's probe estimates
+                # this replica's clock offset from it (NTP-style, at
+                # the probe's RTT midpoint) to align /debug/trace
+                # timelines across processes.
                 self._json(200, {
                     "ok": True,
+                    "monotonic_s": time.monotonic(),
                     "engine": engine_health(cb_engine, cb_enabled[0]),
                 })
             elif self.path == "/metrics":
@@ -1272,11 +1317,13 @@ def main() -> None:
             else:
                 self.send_error(404)
 
-        def _json(self, code, payload):
+        def _json(self, code, payload, headers=None):
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(data)
 

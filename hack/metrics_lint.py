@@ -6,9 +6,10 @@ Holds three surfaces to one truth:
 1. `walkai_nos_tpu/obs/catalog.py` — every metric the repo exports,
    declared once (name, type, labels, help);
 2. `docs/observability.md` — the human-facing reference: every
-   catalog metric must appear as a table row (| `name` | type | ...)
-   with the SAME type, and every documented row must exist in the
-   catalog — renames and additions fail in BOTH directions;
+   catalog metric must appear as a table row (| `name` | type |
+   labels | ...) with the SAME type and the SAME label set, and
+   every documented row must exist in the catalog — renames,
+   additions, and label drift fail in BOTH directions;
 3. the code itself — a literal-registration scan over walkai_nos_tpu/
    and demos/ (`.counter("..."` / `.gauge("..."` / `.histogram("..."`
    / `counter_add("..."` / `gauge_set("..."`): any literal metric
@@ -17,8 +18,23 @@ Holds three surfaces to one truth:
    drift by construction; this catches ad-hoc registrations
    elsewhere.)
 
+Plus the FLEET-PLANE rules the serverouter's federated /metrics
+relies on, in both directions:
+
+- `router_*` names and `component="router"` imply each other — the
+  router catalog half cannot grow a mis-filed spec;
+- the `replica` label belongs to router-component specs ONLY: the
+  federation layer (`obs/federation.py`) injects it onto every
+  re-exported engine series, so an engine metric declaring its own
+  would collide;
+- every federated prefix in `obs.federation.FEDERATED_PREFIXES` must
+  name at least one serving-component catalog family, must not
+  collide with the router's own namespace, and must appear on the
+  docs' "Federated prefixes:" line — and every prefix documented
+  there must exist in code.
+
 Exit 0 = clean; prints each violation otherwise. Stdlib + the
-dependency-free catalog module only.
+dependency-free catalog/federation modules only.
 """
 
 from __future__ import annotations
@@ -31,13 +47,22 @@ _ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(_ROOT))
 
 from walkai_nos_tpu.obs.catalog import CATALOG  # noqa: E402
+from walkai_nos_tpu.obs.federation import (  # noqa: E402
+    FEDERATED_PREFIXES,
+)
 
 DOC = _ROOT / "docs" / "observability.md"
 
-# A documented metric row: | `name` | type | ...
+# A documented metric row: | `name` | type | labels | ...
 _DOC_ROW = re.compile(
     r"^\|\s*`([A-Za-z_:][A-Za-z0-9_:]*)`\s*\|"
     r"\s*(counter|gauge|histogram)\s*\|"
+    r"\s*([^|]*)\|"
+)
+_LABEL_TOKEN = re.compile(r"`([A-Za-z_][A-Za-z0-9_]*)`")
+# The docs' federation contract line: "Federated prefixes: `cb_*`".
+_FED_LINE = re.compile(
+    r"federated prefix(?:es)?:\s*(.+)", re.IGNORECASE
 )
 
 # Literal registrations (the registry API and the health.Metrics
@@ -57,13 +82,31 @@ _SCAN_DIRS = ("walkai_nos_tpu", "demos")
 _SCAN_SKIP = ("obs/metrics.py", "health.py")
 
 
-def documented_metrics(doc_text: str) -> dict[str, str]:
-    """name -> documented type, from the markdown tables."""
-    out: dict[str, str] = {}
+def documented_metrics(doc_text: str) -> dict[str, tuple]:
+    """name -> (documented type, documented label tuple), from the
+    markdown tables (labels are the backticked tokens in the third
+    cell; an em-dash cell documents a label-free metric)."""
+    out: dict[str, tuple] = {}
     for line in doc_text.splitlines():
         m = _DOC_ROW.match(line.strip())
         if m:
-            out[m.group(1)] = m.group(2)
+            out[m.group(1)] = (
+                m.group(2),
+                tuple(_LABEL_TOKEN.findall(m.group(3))),
+            )
+    return out
+
+
+def documented_federated_prefixes(doc_text: str) -> set[str]:
+    """Prefixes the docs declare as federated (the "Federated
+    prefixes: `cb_*`" contract line in the Fleet plane section)."""
+    out: set[str] = set()
+    for line in doc_text.splitlines():
+        m = _FED_LINE.search(line)
+        if m:
+            out.update(
+                re.findall(r"`([a-z0-9_]+)\*`", m.group(1))
+            )
     return out
 
 
@@ -91,16 +134,24 @@ def lint(
     catalog = {spec.name: spec for spec in CATALOG}
 
     for name, spec in sorted(catalog.items()):
-        doc_kind = documented.get(name)
-        if doc_kind is None:
+        row = documented.get(name)
+        if row is None:
             errors.append(
                 f"catalog metric not documented in "
                 f"docs/observability.md: {name} ({spec.kind})"
             )
-        elif doc_kind != spec.kind:
+            continue
+        doc_kind, doc_labels = row
+        if doc_kind != spec.kind:
             errors.append(
                 f"type mismatch for {name}: catalog says {spec.kind}, "
                 f"docs say {doc_kind}"
+            )
+        if set(doc_labels) != set(spec.labels):
+            errors.append(
+                f"label mismatch for {name}: catalog says "
+                f"{sorted(spec.labels) or '—'}, docs say "
+                f"{sorted(doc_labels) or '—'}"
             )
     for name in sorted(set(documented) - set(catalog)):
         errors.append(
@@ -113,6 +164,51 @@ def lint(
                 f"literal metric registration not in obs/catalog.py: "
                 f"{name} ({', '.join(sorted(set(files)))})"
             )
+    # Fleet-plane rules (both directions): the router catalog half
+    # and the federation's `replica`-label contract.
+    for name, spec in sorted(catalog.items()):
+        if name.startswith("router_") != (spec.component == "router"):
+            errors.append(
+                f"router namespace rule: {name} has "
+                f"component={spec.component!r} — router_* names and "
+                f"component='router' must imply each other"
+            )
+        if "replica" in spec.labels and spec.component != "router":
+            errors.append(
+                f"replica-label rule: {name} "
+                f"(component={spec.component!r}) declares a "
+                f"'replica' label — federation injects that label "
+                f"onto re-exported series, so only router-component "
+                f"metrics may carry it"
+            )
+    doc_prefixes = documented_federated_prefixes(doc_text)
+    for prefix in sorted(FEDERATED_PREFIXES):
+        if prefix.startswith("router_") or "router_".startswith(
+            prefix
+        ):
+            errors.append(
+                f"federated prefix {prefix}* collides with the "
+                f"router's own namespace"
+            )
+        if not any(
+            spec.name.startswith(prefix)
+            and spec.component == "serving"
+            for spec in CATALOG
+        ):
+            errors.append(
+                f"federated prefix {prefix}* matches no "
+                f"serving-component catalog metric"
+            )
+        if prefix not in doc_prefixes:
+            errors.append(
+                f"federated prefix {prefix}* not documented on the "
+                f"docs' 'Federated prefixes:' line"
+            )
+    for prefix in sorted(doc_prefixes - set(FEDERATED_PREFIXES)):
+        errors.append(
+            f"docs declare federated prefix {prefix}* but "
+            f"obs/federation.py FEDERATED_PREFIXES does not"
+        )
     return errors
 
 

@@ -103,6 +103,40 @@ class TestCheckLogic:
         assert spec["tolerance"] == 0.0
         assert spec["absent_ok"] is True
 
+    def test_repo_baseline_gates_router_obs_overhead(self):
+        """The fleet observability plane is held to the SAME absolute
+        < 2% budget as the engine's obs bundle
+        (`router_obs_overhead_pct`, trafficbench A/B): absent from
+        the bench output is a skip note; once emitted, above-budget
+        fails and the noise floor (negative overhead) passes."""
+        with open(_ROOT / "BASELINE.json") as f:
+            baseline = json.load(f)
+        spec = baseline["published"]["router_obs_overhead_pct"]
+        assert spec["value"] == 2.0
+        assert spec["direction"] == "lower"
+        assert spec["tolerance"] == 0.0
+        assert spec["absent_ok"] is True
+        failures, notes = bench_check.check({}, baseline)
+        assert not any(
+            "router_obs_overhead_pct" in f for f in failures
+        )
+        assert any(
+            "router_obs_overhead_pct" in n and "absent" in n
+            for n in notes
+        )
+        failures, _ = bench_check.check(
+            {"router_obs_overhead_pct": 1.1}, baseline
+        )
+        assert not any(
+            "router_obs_overhead_pct" in f for f in failures
+        )
+        failures, _ = bench_check.check(
+            {"router_obs_overhead_pct": 2.7}, baseline
+        )
+        assert any(
+            "router_obs_overhead_pct" in f for f in failures
+        )
+
     def test_repo_baseline_gates_prefix_cache_keys(self):
         """BASELINE.json carries the shared-prefix cache's two
         headline keys as absent_ok acceptance floors, and the specs

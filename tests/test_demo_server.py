@@ -406,6 +406,45 @@ class TestContinuousBatchingEndpoint:
         assert out.get("batched") is True
         assert len(out["tokens"]) > 0
 
+    def test_trace_id_echo_and_healthz_clock(self, cb_server):
+        """/generate returns the request's cross-process trace id
+        (response header + JSON field): a well-formed client
+        X-Walkai-Trace is adopted verbatim, anything else gets a
+        server-minted id — so a slow call is always correlatable
+        with /debug/trace without guessing. /healthz carries the
+        process's monotonic clock read (the fleet router's
+        clock-offset estimate for trace alignment)."""
+        import json
+        import urllib.request
+
+        def post_traced(header):
+            headers = {"Content-Type": "application/json"}
+            if header is not None:
+                headers["X-Walkai-Trace"] = header
+            req = urllib.request.Request(
+                f"{cb_server}/generate",
+                data=json.dumps({"prompt": [1, 2, 3]}).encode(),
+                headers=headers,
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.headers.get("X-Walkai-Trace"), json.loads(
+                    resp.read()
+                )
+
+        echoed, out = post_traced("w1234ab-00000007")
+        assert out["trace_id"] == "w1234ab-00000007"
+        assert echoed == "w1234ab-00000007"
+        # No header: the server mints one and still returns it.
+        echoed, out = post_traced(None)
+        assert out["trace_id"] and echoed == out["trace_id"]
+        # Malformed header (bad charset): ignored, minted instead.
+        echoed, out = post_traced("bad id!")
+        assert out["trace_id"] != "bad id!"
+        assert echoed == out["trace_id"]
+        h = get_json(f"{cb_server}/healthz")
+        assert isinstance(h["monotonic_s"], float)
+
     def test_healthz_readiness_payload(self, cb_server):
         """/healthz is a readiness payload, not a bare liveness bit:
         engine alive + queue depth + dispatch staleness + the scale

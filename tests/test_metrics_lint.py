@@ -35,7 +35,17 @@ class TestRepoIsClean:
 
         documented = metrics_lint.documented_metrics(_doc_text())
         for spec_ in CATALOG:
-            assert documented.get(spec_.name) == spec_.kind, spec_.name
+            kind, labels = documented.get(spec_.name, (None, ()))
+            assert kind == spec_.kind, spec_.name
+            assert set(labels) == set(spec_.labels), spec_.name
+
+    def test_federated_prefixes_documented(self):
+        from walkai_nos_tpu.obs.federation import FEDERATED_PREFIXES
+
+        documented = metrics_lint.documented_federated_prefixes(
+            _doc_text()
+        )
+        assert documented == set(FEDERATED_PREFIXES)
 
     def test_makefile_has_target(self):
         assert "metrics-lint:" in (_ROOT / "Makefile").read_text()
@@ -81,6 +91,43 @@ class TestDriftDirections:
         assert any(
             "rogue_total" in e and "somewhere.py" in e for e in errors
         )
+
+    def test_label_mismatch_fails(self):
+        """The third table cell (labels) is linted in both directions
+        too: a label dropped from the docs — or invented there —
+        fails."""
+        doc = _doc_text().replace(
+            "| `router_replica_saturation` | gauge | `replica` |",
+            "| `router_replica_saturation` | gauge | — |",
+        )
+        errors = metrics_lint.lint(doc)
+        assert any(
+            "router_replica_saturation" in e and "label" in e
+            for e in errors
+        )
+        doc = _doc_text().replace(
+            "| `cb_queue_depth` | gauge | — |",
+            "| `cb_queue_depth` | gauge | `invented` |",
+        )
+        errors = metrics_lint.lint(doc)
+        assert any(
+            "cb_queue_depth" in e and "label" in e for e in errors
+        )
+
+    def test_undocumented_federated_prefix_fails(self):
+        """The docs' 'Federated prefixes:' line is held to
+        obs/federation.py in both directions."""
+        doc = _doc_text().replace("Federated prefixes: `cb_*`", "")
+        errors = metrics_lint.lint(doc)
+        assert any(
+            "cb_*" in e and "not documented" in e for e in errors
+        )
+        doc = _doc_text().replace(
+            "Federated prefixes: `cb_*`",
+            "Federated prefixes: `cb_*` `ghost_*`",
+        )
+        errors = metrics_lint.lint(doc)
+        assert any("ghost_*" in e for e in errors)
 
     def test_code_scan_finds_known_literals(self):
         """The scan must actually see the kube/runtime.py and demo

@@ -1162,3 +1162,432 @@ class TestEngineAttribution:
         assert obs.dispatch_kind.value({"kind": "decode"}) == 0
         at = engine.attrib_stats()
         assert at["kinds"]["spec"]["device_s"] > 0
+
+
+# -- fleet observability plane (obs/federation.py, obs/anomaly.py) ----
+
+
+from walkai_nos_tpu.obs.anomaly import (  # noqa: E402
+    AnomalyDetector,
+    FlightRecorder,
+)
+from walkai_nos_tpu.obs.federation import (  # noqa: E402
+    FEDERATED_PREFIXES,
+    federate,
+    first_value,
+    merge_fleet_trace,
+    parse_exposition,
+)
+from walkai_nos_tpu.obs.trace import RouterTrace  # noqa: E402
+
+
+def _exposition(**values) -> str:
+    """A small real exposition rendered by the real registry (the
+    only format the federator consumes)."""
+    registry = Registry()
+    counter = registry.counter(
+        "cb_requests_submitted_total", "requests"
+    )
+    counter.inc(values.get("submitted", 1))
+    gauge = registry.gauge("cb_saturation", "pressure")
+    gauge.set(values.get("saturation", 0.5))
+    errors = registry.counter("cb_request_errors_total", "errors")
+    errors.inc(labels={"reason": "bad_request"})
+    hist = registry.histogram(
+        "cb_ttft_seconds", "ttft", buckets=(0.1, 1.0)
+    )
+    hist.observe(values.get("ttft", 0.05))
+    # Non-federated families must NOT ride through.
+    other = registry.counter("router_requests_total", "own series")
+    other.inc()
+    return registry.render()
+
+
+class TestExpositionRoundTrip:
+    def test_parse_render_reparse(self):
+        """render -> parse -> federate -> parse again: every federated
+        family survives with its kind, labels, and values intact plus
+        the injected replica label (the satellite's round-trip pin)."""
+        text = _exposition(submitted=3, ttft=0.05)
+        families = parse_exposition(text)
+        assert families["cb_requests_submitted_total"]["kind"] == (
+            "counter"
+        )
+        assert families["cb_ttft_seconds"]["kind"] == "histogram"
+        # Histogram sub-series attach to their family.
+        names = {
+            s[0] for s in families["cb_ttft_seconds"]["samples"]
+        }
+        assert names == {
+            "cb_ttft_seconds_bucket", "cb_ttft_seconds_sum",
+            "cb_ttft_seconds_count",
+        }
+        fed = federate({"r0": text, "r1": text})
+        refed = parse_exposition(fed)
+        assert set(refed) == {
+            "cb_requests_submitted_total", "cb_saturation",
+            "cb_request_errors_total", "cb_ttft_seconds",
+        }  # router_* filtered out
+        for name, family in refed.items():
+            for _, labels, _ in family["samples"]:
+                assert labels["replica"] in ("r0", "r1"), name
+        sub = [
+            (labels["replica"], value)
+            for sample, labels, value in refed[
+                "cb_requests_submitted_total"
+            ]["samples"]
+        ]
+        assert sorted(sub) == [("r0", 3.0), ("r1", 3.0)]
+        # One TYPE line per family, not one per source replica.
+        assert fed.count("# TYPE cb_ttft_seconds histogram") == 1
+
+    def test_replica_label_never_trusted(self):
+        """A source that self-labels `replica` is overwritten: the
+        router's handle name is the identity."""
+        registry = Registry()
+        gauge = registry.gauge("cb_saturation", "pressure")
+        gauge.set(0.9, labels={"replica": "spoofed"})
+        fed = federate({"real": registry.render()})
+        assert 'replica="real"' in fed
+        assert "spoofed" not in fed
+
+    def test_label_values_escape_roundtrip(self):
+        registry = Registry()
+        counter = registry.counter("cb_request_errors_total", "errs")
+        counter.inc(labels={"reason": 'a"b\\c\nd'})
+        families = parse_exposition(registry.render())
+        (_, labels, value), = families[
+            "cb_request_errors_total"
+        ]["samples"]
+        assert labels["reason"] == 'a"b\\c\nd'
+        assert value == 1.0
+
+    def test_first_value_and_prefixes(self):
+        text = _exposition(saturation=0.25)
+        assert first_value(text, "cb_saturation") == 0.25
+        assert first_value(text, "cb_nonexistent") is None
+        assert FEDERATED_PREFIXES == ("cb_",)
+        assert federate({}) == ""
+
+    def test_negative_exponent_values_survive(self):
+        """repr of |v| < 1e-4 renders with a negative exponent
+        (5e-05): a fast replica's sub-100µs dispatch p99 must ride
+        the federation, not silently vanish at the parse (regression:
+        the sample-value regex once lacked '-' after the exponent)."""
+        registry = Registry()
+        gauge = registry.gauge("cb_slo_dispatch_p99", "fast")
+        gauge.set(5e-05)
+        neg = registry.gauge("cb_saturation", "signed")
+        neg.set(-1.5e-07)
+        families = parse_exposition(registry.render())
+        assert families["cb_slo_dispatch_p99"]["samples"] == [
+            ("cb_slo_dispatch_p99", {}, 5e-05),
+        ]
+        assert families["cb_saturation"]["samples"] == [
+            ("cb_saturation", {}, -1.5e-07),
+        ]
+        fed = federate({"fast": registry.render()})
+        assert 'cb_slo_dispatch_p99{replica="fast"} 5e-05' in fed
+
+
+class TestAnomalyDetector:
+    def test_straggler_flips_after_sustained_deviation(self):
+        """A replica pinned at ~6x the peer median dispatch p99 flags
+        after a few EWMA ticks — never after one (one noisy window
+        must not flag anything) — and the healthy peers stay clean."""
+        detector = AnomalyDetector()
+        signals = {
+            "good0": {"dispatch_p99_s": 0.01},
+            "good1": {"dispatch_p99_s": 0.011},
+            "bad": {"dispatch_p99_s": 0.1},
+        }
+        first = detector.update(signals)
+        assert first["bad"]["flagged"] is False  # one tick never flags
+        flipped_at = None
+        for tick in range(2, 8):
+            verdicts = detector.update(signals)
+            if verdicts["bad"]["flagged"]:
+                flipped_at = tick
+                break
+        assert flipped_at is not None
+        assert verdicts["good0"]["flagged"] is False
+        assert verdicts["good1"]["flagged"] is False
+        assert verdicts["bad"]["score"] > verdicts["good0"]["score"]
+
+    def test_hysteresis_clears_below_clear_threshold(self):
+        detector = AnomalyDetector(alpha=1.0)  # no smoothing: direct
+        bad = {"dispatch_p99_s": 1.0}
+        good = {"dispatch_p99_s": 0.01}
+        for _ in range(3):
+            verdicts = detector.update({
+                "a": good, "b": dict(bad),
+            })
+        assert verdicts["b"]["flagged"] is True
+        # Recovered but still above `clear`: the flag HOLDS.
+        verdicts = detector.update({
+            "a": good, "b": {"dispatch_p99_s": 0.025},
+        })
+        assert verdicts["b"]["flagged"] is True
+        # Fully recovered: score decays under clear -> unflag.
+        for _ in range(4):
+            verdicts = detector.update({"a": good, "b": dict(good)})
+        assert verdicts["b"]["flagged"] is False
+
+    def test_lower_is_worse_signal(self):
+        """roofline_fraction inverts: the replica running FURTHER
+        from its roofline is the suspect."""
+        detector = AnomalyDetector(alpha=1.0)
+        for _ in range(3):
+            verdicts = detector.update({
+                "healthy": {"roofline_fraction": 0.9},
+                "degraded": {"roofline_fraction": 0.2},
+                "fine": {"roofline_fraction": 0.85},
+            })
+        assert verdicts["degraded"]["flagged"] is True
+        assert verdicts["healthy"]["flagged"] is False
+
+    def test_single_replica_never_flags(self):
+        detector = AnomalyDetector(alpha=1.0)
+        for _ in range(5):
+            verdicts = detector.update({
+                "only": {"dispatch_p99_s": 99.0},
+            })
+        assert verdicts["only"] == {
+            "score": 0.0, "flagged": False, "signals": {},
+        }
+
+    def test_forget_and_absent_none_signals(self):
+        detector = AnomalyDetector(alpha=1.0)
+        for _ in range(3):
+            detector.update({
+                "a": {"dispatch_p99_s": 0.01},
+                "b": {"dispatch_p99_s": 1.0},
+            })
+        assert detector.flagged("b") is True
+        # A replica reporting None (obs off / not scraped yet)
+        # contributes nothing and scores nothing.
+        verdicts = detector.update({
+            "a": {"dispatch_p99_s": 0.01},
+            "b": {"dispatch_p99_s": 1.0},
+            "c": {"dispatch_p99_s": None},
+        })
+        assert verdicts["c"]["score"] == 0.0
+        detector.forget("b")
+        assert detector.flagged("b") is False
+        assert detector.score("b") == 0.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AnomalyDetector(alpha=0.0)
+        with pytest.raises(ValueError):
+            AnomalyDetector(threshold=-1.0)
+        with pytest.raises(ValueError):
+            AnomalyDetector(threshold=2.0, clear=2.0)
+
+
+class TestFlightRecorder:
+    def test_dump_prune_and_bundles(self, tmp_path):
+        recorder = FlightRecorder(
+            str(tmp_path), keep=2, min_interval_s=0.0
+        )
+        paths = [
+            recorder.dump(f"anomaly", {"n": n})
+            for n in range(4)
+        ]
+        assert all(p is not None for p in paths)
+        bundles = recorder.bundles()
+        assert len(bundles) == 2  # oldest pruned
+        assert [b["n"] for b in bundles] == [2, 3]
+        assert all(b["trigger"] == "anomaly" for b in bundles)
+        assert all(b["_file"].endswith(".json") for b in bundles)
+
+    def test_throttle(self, tmp_path):
+        recorder = FlightRecorder(
+            str(tmp_path), keep=8, min_interval_s=100.0
+        )
+        assert recorder.dump("slo_breach", {}, now=0.0) is not None
+        assert recorder.dump("slo_breach", {}, now=50.0) is None
+        assert recorder.dump("slo_breach", {}, now=200.0) is not None
+
+    def test_seq_continues_across_instances(self, tmp_path):
+        first = FlightRecorder(
+            str(tmp_path), keep=8, min_interval_s=0.0
+        )
+        first.dump("anomaly", {"gen": 1})
+        second = FlightRecorder(
+            str(tmp_path), keep=8, min_interval_s=0.0
+        )
+        second.dump("anomaly", {"gen": 2})
+        assert [b["gen"] for b in second.bundles()] == [1, 2]
+
+    def test_unserializable_payload_is_stringified(self, tmp_path):
+        recorder = FlightRecorder(
+            str(tmp_path), keep=2, min_interval_s=0.0
+        )
+        assert recorder.dump(
+            "anomaly", {"obj": object()}
+        ) is not None
+        assert len(recorder.bundles()) == 1
+
+
+class TestFleetTraceMerge:
+    def _engine_trace(self, origin: float, trace_id: str) -> dict:
+        tr = RequestTrace()
+        tr.submit(0, origin + 0.10, 4, 8, trace_id=trace_id)
+        tr.admitted(0, origin + 0.20, slot=0, blocks=1)
+        tr.first_token(0, origin + 0.35)
+        tr.done(0, origin + 0.90, "budget", 8)
+        return tr.chrome_trace()
+
+    def test_skewed_clocks_align_and_order_monotonic(self):
+        """Two replicas whose monotonic clocks sit 100 s apart in
+        OPPOSITE directions merge into one router-frame timeline in
+        true event order — and span args survive the merge exactly."""
+        router_trace = RouterTrace()
+        router_trace.submit(
+            0, trace_id="t-a", t_submit=1000.0, t_routed=1000.01,
+            replica="ra", policy="p2c", t_enqueue=999.99,
+        )
+        router_trace.submit(
+            1, trace_id="t-b", t_submit=1000.05, t_routed=1000.06,
+            replica="rb", policy="affinity",
+        )
+        router_trace.collected(0, 1001.0)
+        router_trace.collected(1, 1001.1)
+        # Replica A's clock runs 100 s AHEAD of the router's, B's
+        # 100 s behind; both served "their" request starting ~1000.01
+        # in router time.
+        trace_a = self._engine_trace(1100.01 - 0.10, "t-a")
+        trace_b = self._engine_trace(900.06 - 0.10, "t-b")
+        merged = merge_fleet_trace(router_trace.chrome_trace(), [
+            {"name": "ra", "trace": trace_a, "offset_s": 100.0},
+            {"name": "rb", "trace": trace_b, "offset_s": -100.0},
+        ])
+        processes = merged["otherData"]["processes"]
+        assert set(processes.values()) == {
+            "router", "replica ra", "replica rb",
+        }
+        events = [
+            e for e in merged["traceEvents"] if e.get("ph") != "M"
+        ]
+        assert [e["ts"] for e in events] == sorted(
+            e["ts"] for e in events
+        )
+        # Request A: the router's route span precedes replica A's
+        # queued span, which precedes its decode — in ROUTER time.
+        def of(name, trace_id):
+            return next(
+                e for e in events
+                if e["name"] == name
+                and e.get("args", {}).get("trace_id") == trace_id
+            )
+
+        route_a = of("route", "t-a")
+        queued_a = of("queued", "t-a")
+        decode_a = of("decode", "t-a")
+        assert route_a["ts"] <= queued_a["ts"] <= decode_a["ts"]
+        # The 100 s skew is GONE: replica A's submit landed ~10 ms
+        # after the router's pick in router time, not 100 s away.
+        assert queued_a["ts"] - route_a["ts"] < 1_000_000
+        # Exact span floats ride through args untouched.
+        assert decode_a["args"]["ttft_s"] == pytest.approx(
+            0.25, abs=1e-12
+        )
+        # Same for the opposite-skew replica.
+        route_b = of("route", "t-b")
+        queued_b = of("queued", "t-b")
+        assert queued_b["ts"] - route_b["ts"] < 1_000_000
+
+    def test_sources_without_origin_are_skipped(self):
+        router_trace = RouterTrace()
+        router_trace.submit(
+            0, trace_id="t", t_submit=1.0, t_routed=1.01,
+            replica="r", policy="p2c",
+        )
+        legacy = {"traceEvents": [{"name": "x", "ph": "i", "ts": 5}]}
+        merged = merge_fleet_trace(router_trace.chrome_trace(), [
+            {"name": "legacy", "trace": legacy, "offset_s": 0.0},
+            {"name": "empty", "trace": RequestTrace().chrome_trace(),
+             "offset_s": 0.0},
+            {"name": "dead", "trace": None, "offset_s": 0.0},
+        ])
+        assert merged["otherData"]["skipped"] == ["replica legacy"]
+        assert set(
+            merged["otherData"]["processes"].values()
+        ) == {"router"}
+
+    def test_empty_everything(self):
+        merged = merge_fleet_trace(RouterTrace().chrome_trace(), [])
+        assert merged["traceEvents"] == []
+        assert merged["otherData"]["clock_origin_monotonic_s"] is None
+
+
+class TestRouterTrace:
+    def test_spans_and_ring_export(self):
+        tr = RouterTrace()
+        tr.submit(
+            7, trace_id="id7", t_submit=10.0, t_routed=10.02,
+            replica="r0", policy="affinity", t_enqueue=9.99,
+            affinity_key=0xDEADBEEF,
+        )
+        tr.event("scale_up", 10.5, replica="spare0",
+                 reason="saturation")
+        tr.collected(7, 11.0)
+        ct = tr.chrome_trace()
+        events = ct["traceEvents"]
+        names = [e["name"] for e in events if e.get("ph") == "X"]
+        assert names == ["queue_wait", "route", "replica_roundtrip"]
+        route = next(e for e in events if e["name"] == "route")
+        assert route["args"]["trace_id"] == "id7"
+        assert route["args"]["replica"] == "r0"
+        assert route["args"]["affinity_key"] == "deadbeef"
+        roundtrip = next(
+            e for e in events if e["name"] == "replica_roundtrip"
+        )
+        assert roundtrip["dur"] == 980_000  # 10.02 -> 11.0
+        scale = next(e for e in events if e["name"] == "scale_up")
+        assert scale["ph"] == "i" and scale["tid"] == 0
+        assert ct["otherData"]["clock_origin_monotonic_s"] == 9.99
+
+    def test_retention_and_disabled(self):
+        tr = RouterTrace(keep_done=1)
+        for rid in range(3):
+            tr.submit(
+                rid, trace_id=f"t{rid}", t_submit=float(rid),
+                t_routed=float(rid) + 0.1, replica="r", policy="p2c",
+            )
+            tr.collected(rid, float(rid) + 0.5)
+        assert len(tr.spans()) == 1
+        off = RouterTrace(enabled=False)
+        off.submit(
+            0, trace_id="x", t_submit=0.0, t_routed=0.1,
+            replica="r", policy="p2c",
+        )
+        off.event("scale_up", 0.0)
+        assert off.spans() == []
+        assert off.chrome_trace()["traceEvents"] == []
+
+
+class TestRequestTraceFleetContract:
+    def test_trace_id_rides_spans_and_chrome_args(self):
+        tr = RequestTrace()
+        tr.submit(3, 10.0, 4, 8, trace_id="abc-123")
+        tr.admitted(3, 10.1, slot=0, blocks=1)
+        tr.first_token(3, 10.2)
+        tr.done(3, 10.9, "budget", 8)
+        assert tr.timeline(3)["trace_id"] == "abc-123"
+        ct = tr.chrome_trace()
+        decode = next(
+            e for e in ct["traceEvents"] if e["name"] == "decode"
+        )
+        assert decode["args"]["trace_id"] == "abc-123"
+        # EXACT floats, not microsecond-rounded: the PR 3 convention
+        # survives the fleet merge through args.
+        assert decode["args"]["ttft_s"] == tr.ttft_s(3)
+        assert decode["args"]["wall_s"] == tr.wall_s(3)
+        assert ct["otherData"]["clock_origin_monotonic_s"] == 10.0
+
+    def test_empty_trace_carries_null_origin(self):
+        ct = RequestTrace().chrome_trace()
+        assert ct["traceEvents"] == []
+        assert ct["otherData"]["clock_origin_monotonic_s"] is None

@@ -797,3 +797,451 @@ class TestServerouterEndpoints:
 
         assert EngineReplica.steps_locally is True
         assert HttpReplica.steps_locally is False
+
+
+# -- fleet observability plane (tracing / federation / anomaly) --------
+
+
+from walkai_nos_tpu.obs.anomaly import FlightRecorder  # noqa: E402
+from walkai_nos_tpu.obs.federation import (  # noqa: E402
+    parse_exposition,
+)
+
+
+class FleetFake(FakeReplica):
+    """FakeReplica plus scripted fleet-plane surfaces: windowed
+    straggler signals and a tiny real exposition (rendered shape, so
+    the federator parses exactly what an engine would serve)."""
+
+    def __init__(self, name, sat=0.0, dispatch_p99=0.01):
+        super().__init__(name, sat)
+        self.dispatch_p99 = dispatch_p99
+
+    def obs_signals(self):
+        return {
+            "dispatch_p99_s": self.dispatch_p99,
+            "device_step_ms": None,
+            "roofline_fraction": None,
+        }
+
+    def metrics_text(self):
+        return (
+            "# TYPE cb_requests_submitted_total counter\n"
+            f"cb_requests_submitted_total {self.submits}\n"
+            "# TYPE cb_slo_dispatch_p99 gauge\n"
+            f"cb_slo_dispatch_p99 {self.dispatch_p99}\n"
+        )
+
+
+class TestScrapeErrorAccounting:
+    def test_dead_pod_errors_counted_not_swallowed(self):
+        """Every failed HttpReplica scrape lands in a labeled counter
+        and in `router.stats()` per handle (`last_error`,
+        `last_ok_age_s`) — a flapping pod used to read only as
+        'unreachable right now' with no history."""
+        from walkai_nos_tpu.router.replica import HttpReplica
+
+        # Port 9 (discard) refuses instantly — a dead pod.
+        dead = HttpReplica("http://127.0.0.1:9", workers=1,
+                           refresh_s=0.0)
+        router = FleetRouter([dead], seed=0, fleet_refresh_s=0.0)
+        router.step()
+        stats = dead.scrape_error_stats()
+        # One step touches all three endpoints (healthz via the load
+        # read, stats via the prefix tallies, metrics via the
+        # straggler signals).
+        assert all(
+            stats["counts"][kind] >= 1
+            for kind in ("healthz", "stats", "metrics")
+        )
+        assert stats["last_error"]
+        assert stats["last_ok_age_s"] is None  # never succeeded
+        for kind in ("healthz", "stats", "metrics"):
+            assert router.obs.scrape_errors.value(labels={
+                "replica": dead.name, "kind": kind,
+            }) >= 1
+        per_replica = router.stats()["replicas"][0]
+        assert per_replica["scrape"]["counts"]["healthz"] >= 1
+        assert per_replica["scrape"]["last_error"]
+
+    def test_engine_replica_has_no_scrape_block(self, fleet):
+        _, make = fleet
+        router = FleetRouter([make("noscrape")], seed=0)
+        assert router.stats()["replicas"][0]["scrape"] is None
+
+
+class TestStragglerDetection:
+    def _router(self, tmp_path, **kwargs):
+        good0 = FleetFake("good0", dispatch_p99=0.01)
+        good1 = FleetFake("good1", dispatch_p99=0.011)
+        bad = FleetFake("bad", dispatch_p99=0.1)
+        recorder = FlightRecorder(
+            str(tmp_path), keep=4, min_interval_s=0.0
+        )
+        router = FleetRouter(
+            [good0, good1, bad], seed=0, fleet_refresh_s=0.0,
+            flight=recorder, **kwargs,
+        )
+        return router, (good0, good1, bad), recorder
+
+    def test_straggler_flips_gauge_loses_share_dumps_flight(
+        self, tmp_path
+    ):
+        """The acceptance scenario on scripted fakes: a replica with
+        ~10x the fleet's dispatch p99 flips
+        `router_replica_anomaly{replica="bad"}` after a few refresh
+        ticks, measurably loses routing share vs the healthy
+        replicas (the score becomes a load penalty), and produces a
+        flight bundle readable from the recorder."""
+        router, (good0, good1, bad), recorder = self._router(tmp_path)
+        for _ in range(6):
+            router.step()
+            if router.anomaly_flagged_names():
+                break
+        assert router.anomaly_flagged_names() == ["bad"]
+        assert router.obs.replica_anomaly.value(
+            labels={"replica": "bad"}
+        ) == 1.0
+        assert router.obs.replica_anomaly.value(
+            labels={"replica": "good0"}
+        ) == 0.0
+        assert router.obs.replica_anomaly_score.value(
+            labels={"replica": "bad"}
+        ) >= 3.0
+        # Routing share: short prompts carry no affinity key, so
+        # every pick is a two-choice sample over penalized loads —
+        # the flagged straggler loses every pairing it is drawn
+        # into.
+        before = bad.submits
+        for seed in range(30):
+            router.submit([1 + seed % 8], max_new_tokens=2)
+        assert bad.submits == before  # sheds ALL p2c share
+        assert good0.submits + good1.submits >= 30
+        # The flip dumped exactly one bundle, with the evidence an
+        # operator needs after the fact.
+        bundles = recorder.bundles()
+        assert len(bundles) == 1
+        bundle = bundles[0]
+        assert bundle["trigger"] == "anomaly"
+        assert bundle["replica"] == "bad"
+        assert bundle["window_signals"]["bad"]["dispatch_p99_s"] == (
+            0.1
+        )
+        assert bundle["anomaly"]["bad"]["flagged"] is True
+        assert any(
+            r["name"] == "bad"
+            for r in bundle["fleet"]["replicas"]
+        )
+        assert isinstance(bundle["trace_ring"], list)
+        assert int(router.obs.flight_dumps.value(
+            labels={"trigger": "anomaly"}
+        )) == 1
+        # Per-replica stats carry the verdict.
+        per = {
+            r["name"]: r for r in router.stats()["replicas"]
+        }
+        assert per["bad"]["anomaly"]["flagged"] is True
+        assert per["good0"]["anomaly"]["flagged"] is False
+
+    def test_recovery_clears_flag_and_restores_share(self, tmp_path):
+        router, (good0, good1, bad), _ = self._router(tmp_path)
+        for _ in range(6):
+            router.step()
+            if router.anomaly_flagged_names():
+                break
+        bad.dispatch_p99 = 0.01  # replica recovers
+        for _ in range(12):
+            router.step()
+            if not router.anomaly_flagged_names():
+                break
+        assert router.anomaly_flagged_names() == []
+        before = bad.submits
+        for seed in range(30):
+            router.submit([1 + seed % 8], max_new_tokens=2)
+        assert bad.submits > before  # share restored
+
+    def test_reconciler_drains_flagged_victim_first(self, tmp_path):
+        """The drain hint: an idle scale-down rotates the flagged
+        straggler out (not the least-loaded healthy replica), and
+        the decision lands on the trace ring with reason
+        'anomaly'."""
+        from walkai_nos_tpu.router.autoscale import (
+            ScalePolicy,
+            StaticSliceProvider,
+        )
+
+        good0 = FleetFake("good0", dispatch_p99=0.01)
+        good1 = FleetFake("good1", dispatch_p99=0.011)
+        bad = FleetFake("bad", dispatch_p99=0.1)
+        provider = StaticSliceProvider([])
+        router = FleetRouter(
+            [good0, good1, bad], seed=0, fleet_refresh_s=0.0,
+            provider=provider,
+            flight=FlightRecorder(
+                str(tmp_path), min_interval_s=0.0
+            ),
+            scale_policy=ScalePolicy(
+                min_replicas=1, max_replicas=3,
+                idle_ticks=8, cooldown_ticks=2,
+            ),
+        )
+        for _ in range(20):
+            router.step()
+            if bad.draining:
+                break
+        assert bad.draining is True
+        assert not good0.draining and not good1.draining
+        events = {
+            e["name"]: e for e in router.trace.ring.snapshot()
+        }
+        drain = events["drain_start"]
+        assert drain["args"]["replica"] == "bad"
+        assert drain["args"]["reason"] == "anomaly"
+        assert "loads" in drain["args"]["signals"]
+        # Drain completes -> retire + release land on the ring too,
+        # and every per-replica series of the retired member drops.
+        for _ in range(5):
+            router.step()
+        assert bad not in [h.replica for h in router._handles]
+        names = {e["name"] for e in router.trace.ring.snapshot()}
+        assert {"release", "retire"} <= names
+        assert router.obs.replica_anomaly.value(
+            labels={"replica": "bad"}
+        ) is None
+        assert router.obs.replica_anomaly_score.value(
+            labels={"replica": "bad"}
+        ) is None
+
+
+class TestReconcilerTraceEvents:
+    def test_scale_up_event_carries_reason_and_signals(self):
+        from walkai_nos_tpu.router.autoscale import (
+            ScalePolicy,
+            StaticSliceProvider,
+        )
+
+        base = FleetFake("base", sat=0.95)
+        spare = FleetFake("spare")
+        router = FleetRouter(
+            [base], seed=0, fleet_refresh_s=0.0,
+            provider=StaticSliceProvider([spare]),
+            scale_policy=ScalePolicy(
+                min_replicas=1, max_replicas=2, breach_ticks=2,
+                cooldown_ticks=2,
+            ),
+        )
+        for _ in range(4):
+            router.step()
+        events = [
+            e for e in router.trace.ring.snapshot()
+            if e["name"] == "scale_up"
+        ]
+        assert len(events) == 1
+        args = events[0]["args"]
+        assert args["replica"] == "spare"
+        assert args["reason"] == "saturation"
+        assert args["signals"]["loads"]["base"] == 0.95
+
+
+class TestMetricsFederation:
+    def test_replica_series_federated_and_dropped_on_retire(self):
+        a = FleetFake("a")
+        b = FleetFake("b")
+        router = FleetRouter([a, b], seed=0, fleet_refresh_s=0.0)
+        for seed in range(4):
+            router.submit(_template(seed), max_new_tokens=2)
+        router.step()
+        text = router.federated_metrics()
+        # Router's own series AND both replicas' engine series under
+        # distinct replica labels, in one exposition.
+        assert "router_requests_total 4" in text
+        assert 'cb_requests_submitted_total{replica="a"}' in text
+        assert 'cb_requests_submitted_total{replica="b"}' in text
+        families = parse_exposition(text)
+        assert families["cb_requests_submitted_total"]["kind"] == (
+            "counter"
+        )
+        values = {
+            labels["replica"]: value
+            for _, labels, value in families[
+                "cb_requests_submitted_total"
+            ]["samples"]
+        }
+        assert values == {"a": float(a.submits), "b": float(b.submits)}
+        # Retire one: its federated series AND per-replica gauges
+        # drop from the very next render.
+        victim = next(
+            h for h in router.active_handles() if h.replica is a
+        )
+        router.start_drain(victim)
+        router.step()
+        router.retire(victim)
+        text = router.federated_metrics()
+        assert 'replica="a"' not in text
+        assert 'cb_requests_submitted_total{replica="b"}' in text
+        assert router.obs.replica_saturation.value(
+            labels={"replica": "a"}
+        ) is None
+
+    def test_obs_disabled_plane_is_off(self):
+        """obs=False disables the WHOLE fleet plane (the off arm of
+        router_obs_overhead_pct): no-op registry, disabled trace, no
+        detector, no flight recorder — and routing still works."""
+        a, b = FleetFake("a"), FleetFake("b")
+        router = FleetRouter([a, b], seed=0, obs=False)
+        router.submit(_template(0), max_new_tokens=2)
+        router.step()
+        assert router.federated_metrics() == "\n"
+        assert router.trace.enabled is False
+        assert router.flight is None
+        assert router._anomaly is None
+        stats = router.stats()
+        assert stats["obs_disabled"] is True
+        assert stats["replicas"][0]["anomaly"] is None
+
+
+class TestFleetTraceEndToEnd:
+    """The acceptance e2e: requests through a ≥2-replica fleet yield
+    ONE merged /debug/trace whose router spans and engine lifecycle
+    spans share the request's trace id, with span-derived TTFT equal
+    to `drain_done_records()` TTFT EXACTLY (the PR 3 convention,
+    surviving the merge); the federated /metrics carries both
+    replicas' cb_* series and drops them on retire."""
+
+    def test_merged_trace_and_exact_ttft(self, fleet):
+        _, make = fleet
+        r0, r1 = make("tr0"), make("tr1")
+        for replica in (r0, r1):
+            replica.warm()
+        router = FleetRouter([r0, r1], seed=0)
+        prompts = [_template(200 + t) for t in range(3)]
+        rids = [
+            router.submit(p, max_new_tokens=4) for p in prompts
+        ]
+        records = {}
+        while router.has_work:
+            router.step()
+            records.update(router.drain_done_records())
+        records.update(router.drain_done_records())
+        assert sorted(records) == sorted(rids)
+        merged = router.fleet_trace()
+        assert set(
+            merged["otherData"]["processes"].values()
+        ) == {"router", "replica tr0", "replica tr1"}
+        events = [
+            e for e in merged["traceEvents"] if e.get("ph") != "M"
+        ]
+        # One merged timeline: strictly ordered timestamps.
+        assert [e["ts"] for e in events] == sorted(
+            e["ts"] for e in events
+        )
+        for rid, rec in records.items():
+            trace_id = rec["trace_id"]
+            assert trace_id  # router-minted, on the record
+            route = next(
+                e for e in events if e["name"] == "route"
+                and e["args"]["trace_id"] == trace_id
+            )
+            decode = next(
+                e for e in events if e["name"] == "decode"
+                and e["args"].get("trace_id") == trace_id
+            )
+            queued = next(
+                e for e in events if e["name"] == "queued"
+                and e["args"].get("trace_id") == trace_id
+            )
+            # Router route -> engine queued -> engine decode, in
+            # order on the merged clock; the engine process the
+            # spans landed in is the replica that served it.
+            assert route["ts"] <= queued["ts"] <= decode["ts"]
+            served = merged["otherData"]["processes"][
+                str(decode["pid"])
+            ]
+            assert served == f"replica {rec['replica']}"
+            # Span-derived TTFT == record-derived TTFT, EXACTLY.
+            assert decode["args"]["ttft_s"] == rec["ttft_s"]
+            assert decode["args"]["wall_s"] == rec["wall_s"]
+
+    def test_serverouter_merged_endpoints(self, fleet):
+        """The same plane over the real binary surface: POST
+        /generate returns the trace id (header + field), GET
+        /debug/trace serves the merged timeline containing that id
+        in both the router's and the engine's spans, GET /metrics
+        federates both replicas' engine series, GET /debug/flight
+        answers."""
+        from walkai_nos_tpu.cmd.serverouter import (
+            RouterDriver,
+            RouterServer,
+            make_handler,
+        )
+        from walkai_nos_tpu.obs.router import RouterObs
+
+        _, make = fleet
+        replicas = [make("sr0"), make("sr1")]
+        for replica in replicas:
+            replica.warm()
+        obs = RouterObs()
+        router = FleetRouter(replicas, obs=obs, seed=0)
+        driver = RouterDriver(router, idle_tick_s=0.01)
+        httpd = RouterServer(
+            ("127.0.0.1", 0), make_handler(driver, obs)
+        )
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            body = json.dumps({
+                "prompt": [int(t) for t in _template(300)],
+                "max_new_tokens": 3,
+            }).encode()
+            req = urllib.request.Request(
+                f"{base}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+                header_id = resp.headers.get("X-Walkai-Trace")
+            trace_id = out["trace_id"]
+            assert trace_id and header_id == trace_id
+            assert out["tokens"]
+            with urllib.request.urlopen(
+                f"{base}/debug/trace", timeout=30
+            ) as resp:
+                merged = json.loads(resp.read())
+            names_with_id = {
+                e["name"]
+                for e in merged["traceEvents"]
+                if e.get("args", {}).get("trace_id") == trace_id
+            }
+            # Router spans (route + queue_wait from the driver's
+            # enqueue) AND engine lifecycle spans under ONE id.
+            assert {
+                "queue_wait", "route", "replica_roundtrip",
+                "queued", "decode",
+            } <= names_with_id
+            assert len(
+                merged["otherData"]["processes"]
+            ) == 3  # router + both replicas
+            with urllib.request.urlopen(
+                f"{base}/metrics", timeout=30
+            ) as resp:
+                text = resp.read().decode()
+            assert "router_requests_total 1" in text
+            # Both replicas' engine series under distinct labels
+            # (warm() traffic guarantees both have series).
+            assert 'cb_requests_submitted_total{replica="sr0"}' in (
+                text
+            )
+            assert 'cb_requests_submitted_total{replica="sr1"}' in (
+                text
+            )
+            with urllib.request.urlopen(
+                f"{base}/debug/flight", timeout=30
+            ) as resp:
+                flight = json.loads(resp.read())
+            assert flight["dir"]
+            assert isinstance(flight["bundles"], list)
+        finally:
+            httpd.shutdown()
+            driver.stop()

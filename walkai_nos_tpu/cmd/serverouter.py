@@ -21,16 +21,24 @@ Endpoints (the router's own, on `--port`):
 
 - `POST /generate`  {"prompt": [...], "max_new_tokens"?, "eos_id"?,
   "temperature"?, "top_k"?, "top_p"?, "seed"?} -> the routed
-  replica's tokens + timing + which replica served it. Routing is
-  prefix-affinity with a power-of-two-choices load fallback
-  (`router/core.py`, docs/serving-router.md).
+  replica's tokens + timing + which replica served it + the
+  request's cross-process `trace_id` (also the `X-Walkai-Trace`
+  response header; a well-formed client-supplied header is adopted).
+  Routing is prefix-affinity with a power-of-two-choices load
+  fallback (`router/core.py`, docs/serving-router.md).
 - `GET /healthz` -> {"ok": bool, "fleet": ...} — the driver thread's
   latest `router.stats()` snapshot: replica membership/drain
-  lifecycle, per-replica scale signals, fleet prefix hit rate,
-  scale-event tallies.
+  lifecycle, per-replica scale signals + anomaly verdicts + scrape
+  health, fleet prefix hit rate, scale-event tallies.
 - `GET /metrics` -> Prometheus exposition of the ROUTER registry
-  (the `router_*` series; each replica keeps serving its own `cb_*`
-  on its own port).
+  (the `router_*` series) PLUS every replica's engine series
+  federated under a `replica` label (`obs/federation.py`) — one
+  scrape for the whole fleet's `cb_*` telemetry.
+- `GET /debug/trace` -> the merged fleet timeline: router
+  route/queue/round-trip spans + every replica's Chrome trace
+  export, clock-aligned into one Perfetto-loadable JSON.
+- `GET /debug/flight` -> the flight recorder's bounded on-disk ring
+  of anomaly/SLO-breach bundles (`obs/anomaly.py`).
 
 A single driver thread owns the fleet (the same one-owner discipline
 as the demo server's cb_driver): it drains submissions, steps every
@@ -55,6 +63,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from walkai_nos_tpu.obs.router import RouterObs
+from walkai_nos_tpu.obs.trace import valid_trace_id
 from walkai_nos_tpu.router.autoscale import ScalePolicy
 from walkai_nos_tpu.router.core import FleetRouter
 from walkai_nos_tpu.router.replica import HttpReplica
@@ -173,8 +182,19 @@ class RouterDriver:
         at most one idle tick stale, safe from any thread."""
         return self._fleet_stats
 
-    def submit(self, prompt, max_new_tokens, knobs: dict) -> dict:
-        holder = {"done": threading.Event()}
+    def submit(
+        self, prompt, max_new_tokens, knobs: dict,
+        trace_id: str | None = None,
+    ) -> dict:
+        holder = {
+            "done": threading.Event(),
+            # The enqueue time becomes the router trace's queue-wait
+            # span (enqueue -> the driver's submit pick-up); the
+            # trace id (client-supplied or router-minted) comes back
+            # on the completion record.
+            "enqueued_at": time.monotonic(),
+            "trace_id_in": trace_id,
+        }
         self._queue.put((prompt, max_new_tokens, knobs, holder))
         return holder
 
@@ -218,6 +238,8 @@ class RouterDriver:
                         try:
                             rid = router.submit(
                                 prompt, max_new_tokens=max_new,
+                                trace_id=holder.get("trace_id_in"),
+                                enqueued_at=holder.get("enqueued_at"),
                                 **knobs,
                             )
                         except ValueError as bad:
@@ -263,6 +285,14 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
             if self.path != "/generate":
                 self.send_error(404)
                 return
+            # Client-supplied trace id (X-Walkai-Trace): adopted when
+            # well-formed so a caller can correlate its own logs with
+            # /debug/trace; anything else is ignored and the router
+            # mints one (`obs/trace.valid_trace_id` — the one charset
+            # contract the demo server shares).
+            trace_in = valid_trace_id(
+                self.headers.get("X-Walkai-Trace")
+            )
             n = int(self.headers.get("Content-Length", 0))
             try:
                 body = json.loads(self.rfile.read(n) or b"{}")
@@ -283,7 +313,9 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
                 self.send_error(400, str(e))
                 return
             t0 = time.perf_counter()
-            holder = driver.submit(prompt, max_new, knobs)
+            holder = driver.submit(
+                prompt, max_new, knobs, trace_id=trace_in
+            )
             while not holder["done"].wait(timeout=1.0):
                 if not driver.alive:
                     self.send_error(503, "router driver failed; retry")
@@ -300,6 +332,7 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
                     holder.get("error") or "generation failed",
                 )
                 return
+            trace_id = holder.get("trace_id")
             self._json(200, {
                 "tokens": holder["tokens"],
                 "ttft_seconds": round(holder.get("ttft_s") or 0.0, 6),
@@ -308,7 +341,13 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
                 ),
                 "replica": holder.get("replica"),
                 "truncated": holder.get("truncated", False),
-            })
+                # The request's cross-process trace id: look it up in
+                # /debug/trace to see this call's route -> queue ->
+                # prefill -> first-token path across processes.
+                "trace_id": trace_id,
+            }, headers=(
+                {"X-Walkai-Trace": trace_id} if trace_id else None
+            ))
 
         def do_GET(self):  # noqa: N802 (http.server API)
             if self.path == "/healthz":
@@ -317,7 +356,14 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
                     "fleet": driver.fleet_stats(),
                 })
             elif self.path == "/metrics":
-                data = obs.render().encode()
+                # Router registry + every replica's engine series
+                # federated under a `replica` label. Safe from a
+                # handler thread: the render reads lock-guarded
+                # registries and the adapters' cached scrapes only
+                # (an HTTP replica past its cache window pays one
+                # scrape here — a Prometheus pull, not a routing
+                # path; caveats in docs/observability.md).
+                data = driver.router.federated_metrics().encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"
@@ -325,14 +371,29 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            elif self.path == "/debug/trace":
+                # The merged fleet timeline (router spans + every
+                # replica's Chrome export, clock-aligned) — load it
+                # straight into Perfetto.
+                self._json(200, driver.router.fleet_trace())
+            elif self.path == "/debug/flight":
+                flight = driver.router.flight
+                self._json(200, {
+                    "dir": flight.dir if flight else None,
+                    "bundles": flight.bundles() if flight else [],
+                })
             else:
                 self.send_error(404)
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(
+            self, code: int, payload: dict, headers: dict | None = None
+        ) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(data)
 

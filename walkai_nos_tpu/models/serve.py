@@ -205,6 +205,9 @@ class _Request:
     completed_at: float = 0.0
     streamed: int = 0  # tokens already handed out via drain_new_tokens
     truncated: bool = False  # finished early at a pool boundary
+    # Cross-process correlation id (the fleet router's
+    # X-Walkai-Trace); rides the trace span and the completion record.
+    trace_id: str | None = None
 
 
 @dataclass
@@ -1143,8 +1146,16 @@ class ContinuousBatcher:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int | None = None,
+        trace_id: str | None = None,
     ) -> int:
         """Queue a generation; returns a request id.
+
+        `trace_id` is an opaque cross-process correlation id (the
+        fleet router mints one per request and propagates it here via
+        the `X-Walkai-Trace` header / in-process submit field); it
+        rides the request's trace span and its completion record so
+        the fleet `/debug/trace` can merge the engine's lifecycle
+        with the router's route/queue spans under one id.
 
         temperature 0 (default) is greedy; otherwise temperature
         sampling with optional top-k / nucleus truncation, seeded per
@@ -1263,6 +1274,9 @@ class ContinuousBatcher:
             temperature=temperature, top_k=top_k, top_p=top_p,
             seed=rid if seed is None else seed,
             submitted_at=time.monotonic(),
+            trace_id=(
+                None if trace_id is None else str(trace_id)[:64]
+            ),
         )
         self._requests[rid] = req
         self._pending.append(req)
@@ -1271,7 +1285,8 @@ class ContinuousBatcher:
         # The span clock is the request's own stored timestamp, so
         # trace-derived ttft/wall equal drain_done_records exactly.
         self.obs.trace.submit(
-            rid, req.submitted_at, len(prompt), max_new_tokens
+            rid, req.submitted_at, len(prompt), max_new_tokens,
+            trace_id=req.trace_id,
         )
         return rid
 
@@ -1430,7 +1445,7 @@ class ContinuousBatcher:
         """Like `drain_done`, with per-request serving telemetry:
         {"tokens", "ttft_s" (submit -> first token KNOWN to the host,
         i.e. at its chunk sync — the moment a streaming server could
-        first emit it), "wall_s", "truncated"}."""
+        first emit it), "wall_s", "truncated", "trace_id"}."""
         done = {
             rid: {
                 "tokens": r.tokens,
@@ -1440,6 +1455,10 @@ class ContinuousBatcher:
                 # boundary (pool_overflow completion), not at EOS or
                 # the requested budget.
                 "truncated": r.truncated,
+                # The submit's cross-process correlation id (None for
+                # direct engine users) — lets a client match its
+                # record to the fleet /debug/trace timeline.
+                "trace_id": r.trace_id,
             }
             for rid, r in self._requests.items()
             if r.done

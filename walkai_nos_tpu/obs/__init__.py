@@ -26,11 +26,21 @@ See docs/observability.md for the exported-metric reference and the
 trace/profile how-to.
 """
 
+from walkai_nos_tpu.obs.anomaly import (  # noqa: F401
+    AnomalyDetector,
+    FlightRecorder,
+)
 from walkai_nos_tpu.obs.attrib import (  # noqa: F401
     DispatchAttribution,
     classify_dispatch,
     kv_hbm_bytes_per_token,
     params_hbm_bytes,
+)
+from walkai_nos_tpu.obs.federation import (  # noqa: F401
+    FEDERATED_PREFIXES,
+    federate,
+    merge_fleet_trace,
+    parse_exposition,
 )
 from walkai_nos_tpu.obs.metrics import (  # noqa: F401
     Counter,
@@ -43,4 +53,8 @@ from walkai_nos_tpu.obs.profile import ProfileHook  # noqa: F401
 from walkai_nos_tpu.obs.router import RouterObs  # noqa: F401
 from walkai_nos_tpu.obs.serving import ServingObs  # noqa: F401
 from walkai_nos_tpu.obs.slo import BucketRing, SloTracker  # noqa: F401
-from walkai_nos_tpu.obs.trace import RequestTrace, Ring  # noqa: F401
+from walkai_nos_tpu.obs.trace import (  # noqa: F401
+    RequestTrace,
+    Ring,
+    RouterTrace,
+)

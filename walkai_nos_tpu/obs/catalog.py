@@ -503,6 +503,58 @@ CATALOG: tuple[MetricSpec, ...] = (
         component="router",
         attr="scale_events",
     ),
+    # -- fleet observability plane (obs/anomaly.py, obs/federation.py) -
+    MetricSpec(
+        "router_fleet_capacity_slots", "gauge",
+        "Decode slots summed over active (non-draining) replicas — "
+        "the fleet's aggregate admission capacity",
+        component="router",
+        attr="fleet_capacity",
+    ),
+    MetricSpec(
+        "router_roofline_fraction_spread", "gauge",
+        "Max minus min of per-replica cb_device_roofline_fraction "
+        "across active replicas (absent until two replicas report; a "
+        "wide spread singles out one degraded replica or TP shard "
+        "where the fleet mean dilutes it)",
+        component="router",
+        attr="roofline_spread",
+    ),
+    MetricSpec(
+        "router_replica_anomaly", "gauge",
+        "1 while the replica is flagged as a fleet straggler by the "
+        "EWMA z-score detector (obs/anomaly.py), else 0; dropped at "
+        "retirement like every per-replica series",
+        labels=("replica",),
+        component="router",
+        attr="replica_anomaly",
+    ),
+    MetricSpec(
+        "router_replica_anomaly_score", "gauge",
+        "EWMA z-score of the replica's windowed dispatch p99 / "
+        "device step ms / roofline fraction against the peer median "
+        "(higher = worse; the routing load penalty's input)",
+        labels=("replica",),
+        component="router",
+        attr="replica_anomaly_score",
+    ),
+    MetricSpec(
+        "router_replica_scrape_errors_total", "counter",
+        "Failed HTTP replica telemetry scrapes by endpoint kind — a "
+        "flapping pod shows up here instead of silently reading as "
+        "unreachable",
+        labels=("replica", "kind"),  # healthz | stats | metrics
+        component="router",
+        attr="scrape_errors",
+    ),
+    MetricSpec(
+        "router_flight_dumps_total", "counter",
+        "Flight-recorder bundles written to the on-disk ring, by "
+        "trigger",
+        labels=("trigger",),  # anomaly | slo_breach
+        component="router",
+        attr="flight_dumps",
+    ),
     # -- kube binaries (kube/runtime.py via health.Metrics) ------------
     MetricSpec(
         "nos_reconcile_total", "counter",

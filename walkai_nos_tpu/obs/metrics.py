@@ -127,6 +127,15 @@ class _Metric:
         with self._lock:
             return [dict(k) for k in self._series]
 
+    def remove(self, labels: dict | None = None) -> None:
+        """Drop one label set's series entirely, so exposition stops
+        exporting it. For series whose label values name transient
+        members (a fleet replica that was retired): keeping the last
+        value exports a dead member as live forever, and 0 would read
+        as 'observed idle', not 'gone'."""
+        with self._lock:
+            self._series.pop(self._key(labels), None)
+
 
 class Counter(_Metric):
     """Monotonically increasing sum. Name should end in `_total` (or
@@ -176,15 +185,6 @@ class Gauge(_Metric):
         with self._lock:
             v = self._series.get(self._key(labels))
             return None if v is None else float(v)
-
-    def remove(self, labels: dict | None = None) -> None:
-        """Drop one label set's series entirely, so exposition stops
-        exporting it. For gauges whose label values name transient
-        members (a fleet replica that was retired): keeping the last
-        value exports a dead member as live forever, and 0 would read
-        as 'observed idle', not 'gone'."""
-        with self._lock:
-            self._series.pop(self._key(labels), None)
 
 
 class _HistState:

@@ -28,10 +28,27 @@ instant events for chunks and ring entries) — load the
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import OrderedDict, deque
 
-__all__ = ["Ring", "RequestTrace"]
+__all__ = ["Ring", "RequestTrace", "RouterTrace", "valid_trace_id"]
+
+# The cross-process trace-id charset contract, in ONE place: the
+# router (adopting a client's X-Walkai-Trace) and the demo server
+# (adopting the router's) must agree EXACTLY, or an id minted on one
+# side gets rejected and re-minted on the other and the correlation
+# silently breaks. An id is a label in traces, headers, and JSON —
+# it must never carry arbitrary bytes.
+_TRACE_ID = re.compile(r"[A-Za-z0-9._:-]{1,64}")
+
+
+def valid_trace_id(value) -> str | None:
+    """`value` when it is a well-formed trace id, else None (caller
+    mints its own)."""
+    if isinstance(value, str) and _TRACE_ID.fullmatch(value):
+        return value
+    return None
 
 # Lifecycle phase names (span event keys, also the Chrome track names).
 SUBMIT = "submit"
@@ -127,18 +144,27 @@ class RequestTrace:
         self.ring.append(ev)
 
     def submit(
-        self, rid: int, t: float, prompt_len: int, max_new: int
+        self, rid: int, t: float, prompt_len: int, max_new: int,
+        trace_id: str | None = None,
     ) -> None:
+        """`trace_id` is the cross-process correlation id minted by
+        whatever front-end routed the request here (the fleet
+        router's `X-Walkai-Trace`); the span carries it so the
+        engine's lifecycle events and the router's route/queue spans
+        merge under one id in the fleet `/debug/trace`."""
         if not self.enabled:
             return
         with self._lock:
-            self._spans[rid] = {
+            span = {
                 "rid": rid,
                 SUBMIT: t,
                 "prompt_len": prompt_len,
                 "max_new": max_new,
                 "chunks": [],
             }
+            if trace_id is not None:
+                span["trace_id"] = trace_id
+            self._spans[rid] = span
         self.event(
             SUBMIT, t, rid=rid, prompt_len=prompt_len, max_new=max_new
         )
@@ -296,12 +322,23 @@ class RequestTrace:
         traced), prefill (admitted -> first token), decode
         (first token -> done). Prefill chunks and raw ring events are
         instants ("ph": "i"). Timestamps are microseconds relative to
-        the earliest event, per the format."""
+        the earliest event, per the format; that origin is exported
+        as `otherData.clock_origin_monotonic_s` so the fleet merger
+        (`obs/federation.merge_fleet_trace`) can re-base this
+        process's events onto the router's clock. Span args carry the
+        trace id (when the submit had one) plus the EXACT span-clock
+        `ttft_s`/`wall_s` floats, so the merged timeline never
+        degrades the PR 3 record-equality guarantee to microsecond
+        rounding."""
         spans = self.spans()
         events = self.ring.snapshot()
         times = [s[SUBMIT] for s in spans] + [e["t"] for e in events]
         if not times:
-            return {"traceEvents": [], "displayTimeUnit": "ms"}
+            return {
+                "traceEvents": [],
+                "displayTimeUnit": "ms",
+                "otherData": {"clock_origin_monotonic_s": None},
+            }
         t0 = min(times)
 
         def us(t: float) -> int:
@@ -329,6 +366,10 @@ class RequestTrace:
             admitted = s.get(ADMITTED)
             first = s.get(FIRST_TOKEN)
             done = s.get(DONE)
+            trace_id = s.get("trace_id")
+            id_args = (
+                {} if trace_id is None else {"trace_id": trace_id}
+            )
             queued_end = admitted or first or done
             if queued_end is not None:
                 out.append({
@@ -341,6 +382,7 @@ class RequestTrace:
                     "args": {
                         "prompt_len": s.get("prompt_len"),
                         "max_new": s.get("max_new"),
+                        **id_args,
                     },
                 })
             if admitted is not None and first is not None:
@@ -356,6 +398,7 @@ class RequestTrace:
                         "blocks": s.get("blocks"),
                         "cached": s.get("cached"),
                         "chunks": len(s["chunks"]),
+                        **id_args,
                     },
                 })
             for t, consumed in s["chunks"]:
@@ -379,6 +422,12 @@ class RequestTrace:
                     "args": {
                         "reason": s.get("reason"),
                         "n_tokens": s.get("n_tokens"),
+                        # Exact span-clock floats (== the request
+                        # record's, PR 3), rounding-proof through the
+                        # fleet merge.
+                        "ttft_s": first - submit,
+                        "wall_s": done - submit,
+                        **id_args,
                     },
                 })
         engine_track_named = False
@@ -438,5 +487,207 @@ class RequestTrace:
         return {
             "traceEvents": out,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_ring_events": self.ring.dropped},
+            "otherData": {
+                "dropped_ring_events": self.ring.dropped,
+                "clock_origin_monotonic_s": t0,
+            },
+        }
+
+
+class RouterTrace:
+    """The fleet router's side of a request's cross-process timeline:
+    per-request route/queue/round-trip spans plus a bounded event ring
+    the reconciler's scale events and the anomaly detector's flips
+    land on — so `/debug/trace` shows autoscaler actions on the same
+    timeline as the traffic that caused them.
+
+    Mirrors `RequestTrace`'s conventions exactly: the caller passes
+    every timestamp (the router's own `time.monotonic()` reads, so
+    span math equals the router's bookkeeping), completed spans are
+    retained newest-last up to `keep_done`, and `chrome_trace()`
+    exports with the clock origin the fleet merger needs."""
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        keep_done: int = 1024,
+        enabled: bool = True,
+    ):
+        self.enabled = enabled
+        self.ring = Ring(capacity)
+        self._keep_done = keep_done
+        self._lock = threading.Lock()
+        self._spans: "OrderedDict[int, dict]" = OrderedDict()
+        self._done_rids: deque[int] = deque()
+
+    def event(self, name: str, t: float, rid=None, **args) -> None:
+        """Raw ring event (scale_up / drain_start / release /
+        anomaly_flagged / flight_dump ... — the fleet-plane flight
+        recorder's recent-history feed)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "t": t}
+        if rid is not None:
+            ev["rid"] = rid
+        if args:
+            ev["args"] = args
+        self.ring.append(ev)
+
+    def submit(
+        self,
+        rid: int,
+        *,
+        trace_id: str,
+        t_submit: float,
+        t_routed: float,
+        replica: str,
+        policy: str,
+        t_enqueue: float | None = None,
+        affinity_key: int | None = None,
+    ) -> None:
+        """One routed request: `t_enqueue` (when the front-end queued
+        it, None for direct submits) -> `t_submit` (the router picked
+        it up) -> `t_routed` (the replica accepted it)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans[rid] = {
+                "rid": rid,
+                "trace_id": trace_id,
+                "enqueue": t_enqueue,
+                "submit": t_submit,
+                "routed": t_routed,
+                "replica": replica,
+                "policy": policy,
+                "affinity_key": affinity_key,
+            }
+        self.event(
+            "route", t_routed, rid=rid, trace_id=trace_id,
+            replica=replica, policy=policy,
+        )
+
+    def collected(self, rid: int, t: float) -> None:
+        """The replica's finished record reached the router — closes
+        the round-trip span."""
+        if not self.enabled:
+            return
+        with self._lock:
+            span = self._spans.get(rid)
+            if span is None or "collected" in span:
+                return
+            span["collected"] = t
+            self._done_rids.append(rid)
+            while len(self._done_rids) > self._keep_done:
+                self._spans.pop(self._done_rids.popleft(), None)
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return [dict(s) for s in self._spans.values()]
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON of the router process: one track
+        per router rid (queue wait -> route decision -> replica
+        round-trip duration events, each carrying the trace id and
+        chosen replica in args), plus ring events as instants on a
+        tid-0 "fleet events" track. Same clock-origin contract as
+        `RequestTrace.chrome_trace`."""
+        spans = self.spans()
+        events = self.ring.snapshot()
+        times = [s["submit"] for s in spans] + [
+            e["t"] for e in events
+        ]
+        times += [
+            s["enqueue"] for s in spans if s.get("enqueue") is not None
+        ]
+        if not times:
+            return {
+                "traceEvents": [],
+                "displayTimeUnit": "ms",
+                "otherData": {"clock_origin_monotonic_s": None},
+            }
+        t0 = min(times)
+
+        def us(t: float) -> int:
+            return int(round((t - t0) * 1e6))
+
+        out: list[dict] = [{
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": "router"},
+        }]
+        for s in spans:
+            rid = s["rid"]
+            args = {
+                "trace_id": s["trace_id"],
+                "replica": s["replica"],
+                "policy": s["policy"],
+            }
+            if s.get("affinity_key") is not None:
+                args["affinity_key"] = f"{s['affinity_key']:08x}"
+            out.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": rid,
+                "args": {"name": f"request {rid}"},
+            })
+            enqueue = s.get("enqueue")
+            if enqueue is not None:
+                out.append({
+                    "name": "queue_wait",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": rid,
+                    "ts": us(enqueue),
+                    "dur": max(0, us(s["submit"]) - us(enqueue)),
+                    "args": args,
+                })
+            out.append({
+                "name": "route",
+                "ph": "X",
+                "pid": 1,
+                "tid": rid,
+                "ts": us(s["submit"]),
+                "dur": max(0, us(s["routed"]) - us(s["submit"])),
+                "args": args,
+            })
+            collected = s.get("collected")
+            if collected is not None:
+                out.append({
+                    "name": "replica_roundtrip",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": rid,
+                    "ts": us(s["routed"]),
+                    "dur": max(0, us(collected) - us(s["routed"])),
+                    "args": args,
+                })
+        if events:
+            out.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": 0,
+                "args": {"name": "fleet events"},
+            })
+        for e in events:
+            if e["name"] == "route":
+                continue  # already represented as span structure
+            out.append({
+                "name": e["name"],
+                "ph": "i",
+                "s": "g",
+                "pid": 1,
+                "tid": 0,
+                "ts": us(e["t"]),
+                "args": e.get("args", {}),
+            })
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "dropped_ring_events": self.ring.dropped,
+                "clock_origin_monotonic_s": t0,
+            },
         }

@@ -51,6 +51,7 @@ Providers:
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 
 logger = logging.getLogger(__name__)
@@ -107,14 +108,39 @@ class Reconciler:
     hysteresis exactly as production load does."""
 
     def __init__(self, provider, policy: ScalePolicy | None = None,
-                 obs=None):
+                 obs=None, trace=None):
         self._provider = provider
         self.policy = policy or ScalePolicy()
         self._obs = obs
+        # Router trace ring (obs/trace.RouterTrace): every scale
+        # action lands there as a structured event with its reason
+        # and a signal snapshot, so the fleet /debug/trace shows
+        # autoscaler decisions on the same timeline as the traffic
+        # that caused them (scale counters alone say WHAT happened,
+        # never WHY or WHEN relative to the surge).
+        self._trace = trace
         self._tick = 0
         self._over = 0
         self._under = 0
         self._cooldown_until = 0
+
+    def _trace_event(self, name: str, **args) -> None:
+        if self._trace is not None:
+            self._trace.event(name, time.monotonic(), **args)
+
+    @staticmethod
+    def _signal_snapshot(active) -> dict:
+        """The evidence a scale decision was made on, JSON-shaped for
+        the trace event: per-replica load and SLO bit plus total
+        queue depth at the decision tick."""
+        return {
+            "loads": {
+                h.name: round(replica_load(h.replica), 4)
+                for h in active
+            },
+            "slo_ok": {h.name: h.replica.slo_ok for h in active},
+            "queue": sum(h.replica.queue_depth for h in active),
+        }
 
     # -- signals -------------------------------------------------------
 
@@ -156,6 +182,13 @@ class Reconciler:
             if not handle.replica.has_work:
                 fleet.retire(handle)
                 self._provider.release(handle.replica)
+                self._trace_event(
+                    "release", replica=handle.name,
+                    reason="drained",
+                    signals=self._signal_snapshot(
+                        fleet.active_handles()
+                    ),
+                )
                 logger.info(
                     "router: replica %s drained and released",
                     handle.name,
@@ -182,23 +215,57 @@ class Reconciler:
                     self._obs.scale_events.inc(
                         labels={"direction": "denied"}
                     )
+                self._trace_event(
+                    "scale_denied",
+                    reason="provider_dry",
+                    signals=self._signal_snapshot(active),
+                )
                 return
             fleet.add_replica(replica)
             self._event("up")
+            self._trace_event(
+                "scale_up", replica=replica.name,
+                reason=(
+                    "slo_breach"
+                    if any(h.replica.slo_ok is False for h in active)
+                    else "saturation"
+                ),
+                signals=self._signal_snapshot(active),
+            )
             logger.info(
                 "router: scale-up admitted replica %s", replica.name
             )
             return
-        # 3b. Scale down: drain the least-loaded active replica.
+        # 3b. Scale down: drain a flagged straggler if the fleet's
+        # anomaly detector singled one out (the drain hint — an idle
+        # window is exactly when rotating a sick replica out is
+        # free), else the least-loaded active replica.
         if (
             self._under >= self.policy.idle_ticks
             and len(active) > self.policy.min_replicas
         ):
+            flagged_names = set()
+            flagged_of = getattr(
+                fleet, "anomaly_flagged_names", None
+            )
+            if flagged_of is not None:
+                flagged_names = set(flagged_of())
+            pool = [
+                h for h in active if h.name in flagged_names
+            ] or active
             victim = min(
-                active, key=lambda h: replica_load(h.replica)
+                pool, key=lambda h: replica_load(h.replica)
             )
             fleet.start_drain(victim)
             self._event("down")
+            self._trace_event(
+                "drain_start", replica=victim.name,
+                reason=(
+                    "anomaly" if victim.name in flagged_names
+                    else "idle"
+                ),
+                signals=self._signal_snapshot(active),
+            )
             logger.info(
                 "router: scale-down draining replica %s", victim.name
             )

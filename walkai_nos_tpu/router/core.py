@@ -47,16 +47,30 @@ collects finished records fleet-wide (records survive replica
 retirement — they are pulled every step, BEFORE a drained replica is
 released). Scale signals and fleet telemetry flow through
 `obs/router.RouterObs` (`router_*` catalog series).
+
+The router also carries the FLEET OBSERVABILITY PLANE on the same
+driver loop: it mints a trace id per request and records its own
+route/queue/round-trip spans (`obs/trace.RouterTrace`; merged with
+replica traces by `fleet_trace()`), re-exports every replica's
+engine series under a `replica` label (`federated_metrics()`),
+scores each replica's windowed signals against the fleet for
+straggler detection (`obs/anomaly.py`; the score feeds routing as a
+load penalty and the reconciler as a drain hint), and dumps a
+flight-recorder bundle on anomaly flips and SLO-breach edges.
 """
 
 from __future__ import annotations
 
 import random
+import time
 import zlib
 
 import numpy as np
 
+from walkai_nos_tpu.obs.anomaly import AnomalyDetector, FlightRecorder
+from walkai_nos_tpu.obs.federation import federate, merge_fleet_trace
 from walkai_nos_tpu.obs.router import RouterObs
+from walkai_nos_tpu.obs.trace import RouterTrace
 from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
 from walkai_nos_tpu.router.autoscale import Reconciler, replica_load
 
@@ -79,12 +93,18 @@ def prefix_key(prompt) -> int | None:
 
 class _Handle:
     """One fleet member: the replica plus the router's bookkeeping
-    (request count, the final prefix tallies captured at retirement)."""
+    (request count, the final prefix tallies captured at retirement,
+    the fleet plane's per-replica state: last anomaly verdict, scrape
+    error counts already reflected into the counter, and the
+    SLO-breach edge detector the flight recorder triggers on)."""
 
     def __init__(self, replica, name: str):
         self.replica = replica
         self.name = name
         self.routed = 0
+        self.anomaly: dict = {"score": 0.0, "flagged": False}
+        self.scrape_seen: dict[str, int] = {}
+        self.slo_was_false = False
 
     def prefix_tallies(self) -> tuple[int, int]:
         stats = self.replica.prefix_stats() or {}
@@ -107,6 +127,12 @@ class FleetRouter:
         affinity_imbalance: float = 0.25,
         seed: int = 0,
         obs: RouterObs | bool = True,
+        trace: RouterTrace | None = None,
+        anomaly: AnomalyDetector | bool | None = None,
+        anomaly_penalty: float = 0.5,
+        fleet_refresh_s: float = 1.0,
+        flight: FlightRecorder | None = None,
+        flight_dir: str | None = None,
     ):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(
@@ -120,7 +146,35 @@ class FleetRouter:
             self.obs = obs
         else:
             self.obs = RouterObs(enabled=bool(obs))
+        # The fleet observability plane: router-side request spans
+        # (merged with replica traces by fleet_trace()), the straggler
+        # detector, and the flight recorder. All keyed off the obs
+        # enable flag so the obs=False arm of the bench's
+        # router_obs_overhead_pct A/B disables the WHOLE plane.
+        self.trace = trace if trace is not None else RouterTrace(
+            enabled=self.obs.enabled
+        )
+        if isinstance(anomaly, AnomalyDetector):
+            self._anomaly = anomaly
+        elif anomaly is False or not self.obs.enabled:
+            self._anomaly = None
+        else:
+            self._anomaly = AnomalyDetector()
+        self.anomaly_penalty = anomaly_penalty
+        self.fleet_refresh_s = fleet_refresh_s
+        if flight is not None:
+            self.flight = flight
+        elif self.obs.enabled:
+            self.flight = FlightRecorder(flight_dir)
+        else:
+            self.flight = None
+        self._penalty: dict[str, float] = {}
+        self._fleet_refresh_at = 0.0
         self._rng = random.Random(seed)
+        # Trace-id prefix: stable per router instance, drawn from the
+        # seeded rng so replays are deterministic while two routers'
+        # ids stay distinguishable.
+        self._trace_prefix = f"w{self._rng.randrange(16 ** 6):06x}"
         self._handles: list[_Handle] = []
         self._seq = 0
         for replica in replicas:
@@ -130,9 +184,9 @@ class FleetRouter:
         self._affinity: dict[int, _Handle] = {}
         self._rr_next = 0
         self._next_rid = 0
-        # router rid -> (handle, local rid); completed records land in
-        # _done keyed by router rid.
-        self._routes: dict[int, tuple[_Handle, int]] = {}
+        # router rid -> (handle, local rid, trace id); completed
+        # records land in _done keyed by router rid.
+        self._routes: dict[int, tuple[_Handle, int, str]] = {}
         self._local: dict[tuple[int, int], int] = {}
         self._done: dict[int, dict] = {}
         # Prefix tallies of replicas already retired, so the fleet hit
@@ -140,7 +194,10 @@ class FleetRouter:
         self._retired_hits = 0
         self._retired_lookups = 0
         self._reconciler = (
-            Reconciler(provider, scale_policy, obs=self.obs)
+            Reconciler(
+                provider, scale_policy, obs=self.obs,
+                trace=self.trace,
+            )
             if provider is not None else None
         )
         self._set_replica_gauges()
@@ -172,10 +229,23 @@ class FleetRouter:
         self._affinity = {
             k: h for k, h in self._affinity.items() if h is not handle
         }
-        # Drop the retired replica's per-replica series: its last
-        # saturation would otherwise export as a live member forever.
-        self.obs.replica_saturation.remove(
-            labels={"replica": handle.name}
+        # Drop EVERY per-replica series of the retired member (and
+        # its federated cb_* series vanish with the handle): the last
+        # values would otherwise export a dead member as live forever.
+        for instrument in (
+            self.obs.replica_saturation,
+            self.obs.replica_anomaly,
+            self.obs.replica_anomaly_score,
+            self.obs.scrape_errors,
+        ):
+            for labels in instrument.labelsets():
+                if labels.get("replica") == handle.name:
+                    instrument.remove(labels)
+        if self._anomaly is not None:
+            self._anomaly.forget(handle.name)
+        self._penalty.pop(handle.name, None)
+        self.trace.event(
+            "retire", time.monotonic(), replica=handle.name
         )
         self._set_replica_gauges()
 
@@ -193,6 +263,17 @@ class FleetRouter:
 
     # -- routing -------------------------------------------------------
 
+    def _load(self, handle: _Handle) -> float:
+        """Routing load: the replica's normalized load plus the
+        anomaly penalty — a flagged straggler reads as proportionally
+        hotter, so p2c and the affinity overload check both steer
+        traffic away from it before its queue ever shows the damage
+        (`router_replica_anomaly_score` scaled into
+        [0, anomaly_penalty])."""
+        return replica_load(handle.replica) + self._penalty.get(
+            handle.name, 0.0
+        )
+
     def _pick(self, key: int | None) -> tuple[_Handle, str]:
         candidates = self.active_handles()
         if not candidates:
@@ -207,7 +288,7 @@ class FleetRouter:
         if key is not None:
             handle = self._affinity.get(key)
             if handle is not None and handle in candidates:
-                load = replica_load(handle.replica)
+                load = self._load(handle)
                 # Affinity yields only when the target is HOT *and*
                 # the sampled alternative is meaningfully less loaded
                 # THAN THE TARGET: a uniformly saturated fleet (every
@@ -223,7 +304,7 @@ class FleetRouter:
                     return handle, "affinity"
                 alt = self._two_choices(candidates)
                 if (
-                    load - replica_load(alt.replica)
+                    load - self._load(alt)
                     >= self.affinity_imbalance
                 ):
                     self._affinity[key] = alt
@@ -244,27 +325,55 @@ class FleetRouter:
         if len(candidates) == 1:
             return candidates[0]
         a, b = self._rng.sample(candidates, 2)
-        return min((a, b), key=lambda h: replica_load(h.replica))
+        return min((a, b), key=self._load)
 
-    def submit(self, prompt, **kwargs) -> int:
+    def submit(
+        self,
+        prompt,
+        *,
+        trace_id: str | None = None,
+        enqueued_at: float | None = None,
+        **kwargs,
+    ) -> int:
         """Route one request; returns a ROUTER request id (replica
         rids are namespaced per replica and never leak). Replica-side
         validation errors (bad knobs, oversize) propagate to the
         caller after landing in `router_requests_failed_total` —
-        client errors stay client errors whatever replica they hit."""
-        handle, arm = self._pick(prefix_key(prompt))
+        client errors stay client errors whatever replica they hit.
+
+        The router mints a `trace_id` per request (or adopts the
+        caller's) and propagates it to the replica — the
+        `X-Walkai-Trace` header over HTTP, a submit field in process
+        — so the replica's engine spans and the router's
+        route/queue/round-trip spans merge under one id in the fleet
+        `/debug/trace`. `enqueued_at` is the front-end's enqueue time
+        (serverouter's driver queue), rendered as the queue-wait
+        span."""
+        t_submit = time.monotonic()
+        key = prefix_key(prompt)
+        handle, arm = self._pick(key)
+        rid = self._next_rid
+        if trace_id is None:
+            trace_id = f"{self._trace_prefix}-{rid:08x}"
         try:
-            local = handle.replica.submit(prompt, **kwargs)
+            local = handle.replica.submit(
+                prompt, trace_id=trace_id, **kwargs
+            )
         except ValueError:
             self.obs.failed.inc(labels={"reason": "bad_request"})
             raise
-        rid = self._next_rid
+        t_routed = time.monotonic()
         self._next_rid += 1
-        self._routes[rid] = (handle, local)
+        self._routes[rid] = (handle, local, trace_id)
         self._local[(id(handle), local)] = rid
         handle.routed += 1
         self.obs.submitted.inc()
         self.obs.routed.inc(labels={"policy": arm})
+        self.trace.submit(
+            rid, trace_id=trace_id, t_submit=t_submit,
+            t_routed=t_routed, replica=handle.name, policy=arm,
+            t_enqueue=enqueued_at, affinity_key=key,
+        )
         return rid
 
     # -- the drive loop ------------------------------------------------
@@ -274,9 +383,16 @@ class FleetRouter:
             rid = self._local.pop((id(handle), local), None)
             if rid is None:
                 continue  # a request submitted around the router
-            self._routes.pop(rid, None)
+            route = self._routes.pop(rid, None)
             record = dict(record)
             record["replica"] = handle.name
+            # The router's minted id is authoritative (a replica that
+            # echoes one echoes this same value; one that doesn't —
+            # a bare fake, an old pod — still yields a correlatable
+            # record).
+            if route is not None:
+                record["trace_id"] = route[2]
+            self.trace.collected(rid, time.monotonic())
             self._done[rid] = record
 
     def step(self) -> bool:
@@ -343,6 +459,237 @@ class FleetRouter:
         rate = self.prefix_hit_rate
         if rate is not None:
             self.obs.prefix_hit_rate.set(round(rate, 4))
+        # The fleet plane's heavier pass (per-replica signal reads,
+        # anomaly scoring, scrape-error deltas, SLO-breach edges) is
+        # throttled like the engine's SLO gauge refresh — its inputs
+        # are windowed quantities that move on ~second scales, and
+        # computing them per step would tax the driver loop for no
+        # added signal.
+        if not self.obs.enabled and self._anomaly is None:
+            return
+        now = time.monotonic()
+        if now >= self._fleet_refresh_at:
+            self._fleet_refresh_at = now + self.fleet_refresh_s
+            self._refresh_fleet(now)
+
+    def _refresh_fleet(self, now: float) -> None:
+        handles = list(self._handles)
+        # The anomaly/signal half of the plane reads ACTIVE replicas
+        # only: a draining member serves no traffic, so its skewed
+        # tail windows must neither flag it (a flight bundle per
+        # scale-down) nor contaminate the leave-one-out peer median
+        # the healthy replicas are judged against. Scrape-error
+        # accounting below still covers every handle — a flapping
+        # pod's history matters through its drain.
+        active = [h for h in handles if not h.replica.draining]
+        self.obs.fleet_capacity.set(sum(
+            int(getattr(h.replica, "slots", 0) or 0) for h in active
+        ))
+        signals: dict[str, dict] = {}
+        for handle in active:
+            read = getattr(handle.replica, "obs_signals", None)
+            sig = None
+            if read is not None:
+                try:
+                    sig = read()
+                except Exception:  # noqa: BLE001 — telemetry read
+                    sig = None
+            signals[handle.name] = sig or {}
+        rooflines = [
+            signals[h.name].get("roofline_fraction")
+            for h in active
+            if signals[h.name].get("roofline_fraction") is not None
+        ]
+        if len(rooflines) >= 2:
+            self.obs.roofline_spread.set(
+                round(max(rooflines) - min(rooflines), 4)
+            )
+        else:
+            # Under two reporters the spread is undefined: drop the
+            # series rather than exporting the last two-replica value
+            # as a live "degraded shard" signal forever.
+            self.obs.roofline_spread.remove()
+        # Scrape-error deltas -> the labeled counter (the adapter
+        # counts locally; the router reflects growth since its last
+        # look, so counter semantics survive the polling shape).
+        for handle in handles:
+            read = getattr(
+                handle.replica, "scrape_error_stats", None
+            )
+            if read is None:
+                continue
+            counts = (read() or {}).get("counts") or {}
+            for kind, count in counts.items():
+                seen = handle.scrape_seen.get(kind, 0)
+                if count > seen:
+                    self.obs.scrape_errors.inc(
+                        count - seen,
+                        labels={"replica": handle.name, "kind": kind},
+                    )
+                    handle.scrape_seen[kind] = count
+        # Straggler scoring + flight-recorder triggers.
+        if self._anomaly is not None:
+            verdicts = self._anomaly.update(signals)
+            for handle in handles:
+                verdict = verdicts.get(handle.name) or {
+                    "score": 0.0, "flagged": False,
+                }
+                was_flagged = handle.anomaly.get("flagged", False)
+                handle.anomaly = verdict
+                self.obs.replica_anomaly.set(
+                    1.0 if verdict["flagged"] else 0.0,
+                    labels={"replica": handle.name},
+                )
+                self.obs.replica_anomaly_score.set(
+                    verdict["score"],
+                    labels={"replica": handle.name},
+                )
+                # The load penalty is gated on the FLAG, then scaled
+                # by the score: routing for a healthy fleet is
+                # byte-identical to the pre-plane router (sub-flag
+                # scores are expected timing spread, and a continuous
+                # penalty would let CPU noise push an affinity target
+                # over the overload check and migrate templates for
+                # nothing), while a flagged straggler sheds share in
+                # proportion to how sick it looks.
+                self._penalty[handle.name] = (
+                    self.anomaly_penalty
+                    * min(
+                        1.0,
+                        max(0.0, verdict["score"])
+                        / self._anomaly.threshold,
+                    )
+                ) if verdict["flagged"] else 0.0
+                if verdict["flagged"] and not was_flagged:
+                    self.trace.event(
+                        "anomaly_flagged", now,
+                        replica=handle.name,
+                        score=verdict["score"],
+                        signals=verdict.get("signals", {}),
+                    )
+                    self._flight_dump(
+                        "anomaly", handle, now, signals,
+                        extra={"anomaly": verdicts},
+                    )
+                elif was_flagged and not verdict["flagged"]:
+                    self.trace.event(
+                        "anomaly_cleared", now,
+                        replica=handle.name,
+                        score=verdict["score"],
+                    )
+        # Windowed SLO breach edges: dump once per False transition,
+        # not once per breached tick (active members only — a
+        # draining replica's tail breach is the drain, not news).
+        for handle in active:
+            ok = handle.replica.slo_ok
+            if ok is False and not handle.slo_was_false:
+                handle.slo_was_false = True
+                self._flight_dump("slo_breach", handle, now, signals)
+            elif ok is not False:
+                handle.slo_was_false = False
+
+    def _flight_dump(
+        self,
+        trigger: str,
+        handle: _Handle,
+        now: float,
+        signals: dict,
+        extra: dict | None = None,
+    ) -> None:
+        """One flight-recorder bundle: the suspect replica's
+        debug_state, the fleet snapshot, every replica's windowed
+        signals, and the recent router trace ring — captured AT the
+        flip, because the state is gone by the time a human looks."""
+        if self.flight is None:
+            return
+        debug_state = None
+        read = getattr(handle.replica, "debug_state", None)
+        if read is not None:
+            try:
+                debug_state = read()
+            except Exception as e:  # noqa: BLE001 — best-effort capture
+                debug_state = {"error": str(e)}
+        payload = {
+            "replica": handle.name,
+            "at_unix_s": time.time(),
+            "fleet": self.stats(),
+            "window_signals": signals,
+            "debug_state": debug_state,
+            "trace_ring": self.trace.ring.snapshot()[-256:],
+            **(extra or {}),
+        }
+        path = self.flight.dump(trigger, payload, now=now)
+        if path is not None:
+            self.obs.flight_dumps.inc(labels={"trigger": trigger})
+            self.trace.event(
+                "flight_dump", now, trigger=trigger,
+                replica=handle.name, path=path,
+            )
+
+    def anomaly_flagged_names(self) -> list[str]:
+        """Currently flagged replicas — the reconciler's drain-victim
+        hint (a straggler is the first candidate to rotate out when
+        the fleet scales down)."""
+        return [
+            h.name for h in self._handles
+            if h.anomaly.get("flagged")
+        ]
+
+    def federated_metrics(self) -> str:
+        """The serverouter `/metrics` body: the router's own
+        `router_*` registry followed by every current replica's
+        engine series re-exported under a `replica` label
+        (`obs/federation.federate`). Retired replicas stop being
+        sources, so their series drop from the very next render —
+        the same dead-pods-never-export discipline as the
+        per-replica gauges. Reads only registries (lock-guarded) and
+        the adapters' cached scrapes, so a handler thread may call
+        it beside the driver; an HTTP replica past its cache window
+        pays one scrape here (federation caveats:
+        docs/observability.md)."""
+        own = self.obs.render()
+        if not self.obs.enabled:
+            return own
+        sources: dict[str, str] = {}
+        for handle in list(self._handles):
+            read = getattr(handle.replica, "metrics_text", None)
+            if read is None:
+                continue
+            try:
+                text = read()
+            except Exception:  # noqa: BLE001 — telemetry read
+                continue
+            if text:
+                sources[handle.name] = text
+        return own + federate(sources)
+
+    def fleet_trace(self) -> dict:
+        """The serverouter `/debug/trace` body: the router's spans
+        merged with every current replica's Chrome export into one
+        clock-aligned timeline (`obs/federation.merge_fleet_trace`;
+        per-replica offsets come from each adapter's
+        `clock_offset_s()` — the /healthz RTT-midpoint estimate for
+        HTTP pods, exactly 0 in process)."""
+        replicas = []
+        for handle in list(self._handles):
+            read = getattr(handle.replica, "chrome_trace", None)
+            if read is None:
+                continue
+            try:
+                trace = read()
+            except Exception:  # noqa: BLE001 — debug read
+                trace = None
+            if not trace:
+                continue
+            offset = getattr(
+                handle.replica, "clock_offset_s", None
+            )
+            replicas.append({
+                "name": handle.name,
+                "trace": trace,
+                "offset_s": offset() if offset is not None else 0.0,
+            })
+        return merge_fleet_trace(self.trace.chrome_trace(), replicas)
 
     @property
     def prefix_hit_rate(self) -> float | None:
@@ -370,6 +717,11 @@ class FleetRouter:
         the scale-event tallies — the serverouter `/healthz` fleet
         block and the traffic harness's read surface."""
         rate = self.prefix_hit_rate
+
+        def scrape(h: _Handle):
+            read = getattr(h.replica, "scrape_error_stats", None)
+            return read() if read is not None else None
+
         return {
             **({} if self.obs.enabled else {"obs_disabled": True}),
             "policy": self.policy,
@@ -382,6 +734,13 @@ class FleetRouter:
                     "queue_depth": h.replica.queue_depth,
                     "has_work": h.replica.has_work,
                     "routed": h.routed,
+                    # Fleet plane: straggler verdict + (HTTP) scrape
+                    # health — None for adapters without scrapes.
+                    "anomaly": (
+                        dict(h.anomaly)
+                        if self._anomaly is not None else None
+                    ),
+                    "scrape": scrape(h),
                 }
                 for h in self._handles
             ],
@@ -393,4 +752,8 @@ class FleetRouter:
             ),
             "scale_events": self.scale_events(),
             "in_flight": len(self._routes),
+            "anomaly_flagged": self.anomaly_flagged_names(),
+            "flight_dir": (
+                self.flight.dir if self.flight is not None else None
+            ),
         }

@@ -1,12 +1,16 @@
 """Replica adapters: one interface over in-process and HTTP engines.
 
 The fleet router (`router/core.py`) owns N serving replicas — one per
-TPU slice — and needs exactly six things from each: submit a request,
-advance it (in-process only), collect finished records, read its
-scale signals (saturation / SLO compliance / queue depth), start a
-graceful drain, and read its prefix-cache tallies so the fleet-level
-`router_prefix_hit_rate` can be computed. Everything else (paging,
-speculation, SLO windows) stays inside the engine.
+TPU slice — and needs the same surface from each: submit a request
+(carrying the router-minted trace id), advance it (in-process only),
+collect finished records, read its scale signals (saturation / SLO
+compliance / queue depth), start a graceful drain, and read its
+fleet-plane telemetry — prefix tallies for `router_prefix_hit_rate`,
+the rendered `cb_*` exposition the serverouter federates under a
+`replica` label, the windowed straggler signals `obs/anomaly.py`
+scores, and the Chrome trace export (plus a clock offset) the fleet
+`/debug/trace` merges. Everything else (paging, speculation, SLO
+windows) stays inside the engine.
 
 Two adapters implement that surface:
 
@@ -15,17 +19,25 @@ Two adapters implement that surface:
   harness (`sim/trafficbench.py`) drives. `step()` advances the
   engine one pipeline turn; drain maps to the engine's own
   `drain()` seam (new submits reject with the `draining` taxonomy
-  reason, resident slots finish).
+  reason, resident slots finish). Its clock IS the router's clock, so
+  `clock_offset_s()` is 0.0 by construction.
 - **`HttpReplica`** fronts a remote demo-server pod
   (`demos/tpu-sharing-comparison/app/main.py`) over its existing
   endpoints: `POST /generate` per request (a small worker pool keeps
-  submits non-blocking), `GET /healthz` for the engine block's
-  `saturation` / `slo_ok` / `queue_depth` / `has_work` /
-  `draining` scale signals (cached for `refresh_s` so hot routing
-  paths don't serialize on probes), `GET /stats` for the
-  `cb_prefix` tallies. `drain()` is router-side (stop routing here,
-  wait for in-flight work) — the remote process keeps its own
-  lifecycle.
+  submits non-blocking; the trace id rides the `X-Walkai-Trace`
+  header), `GET /healthz` for the engine block's scale signals
+  (cached for `refresh_s` so hot routing paths don't serialize on
+  probes — and doubling as the NTP-style clock-offset estimate: the
+  payload's `monotonic_s` minus the probe's RTT midpoint), `GET
+  /stats` for the `cb_prefix` tallies, `GET /metrics` for the
+  federated exposition + straggler signals, `GET /debug/trace` /
+  `/debug/state` on demand. Scrape FAILURES are counted per endpoint
+  kind (`scrape_error_stats()` → the router's
+  `router_replica_scrape_errors_total{replica,kind}`) instead of
+  being swallowed — a flapping pod used to read only as
+  "unreachable" with no history. `drain()` is router-side (stop
+  routing here, wait for in-flight work) — the remote process keeps
+  its own lifecycle.
 
 Both expose the same attribute surface, so the router, the
 autoscaling reconciler, and the traffic harness never branch on the
@@ -39,6 +51,8 @@ import queue
 import threading
 import time
 import urllib.request
+
+from walkai_nos_tpu.obs.federation import first_value
 
 __all__ = ["EngineReplica", "HttpReplica"]
 
@@ -111,6 +125,36 @@ class EngineReplica:
     def prefix_stats(self) -> dict:
         return self.engine.prefix_stats()
 
+    def metrics_text(self) -> str:
+        """The engine's own Prometheus exposition — the source the
+        serverouter's federated `/metrics` re-labels per replica."""
+        return self.engine.obs.render()
+
+    def obs_signals(self) -> dict:
+        """The straggler signals `obs/anomaly.py` scores against the
+        fleet: windowed dispatch p99 (SLO window), device-attributed
+        step ms, and the live roofline fraction (None off-TPU)."""
+        slo = self.engine.slo_stats()
+        attrib = self.engine.attrib_stats()
+        dispatch = (slo.get("windows") or {}).get("dispatch") or {}
+        return {
+            "dispatch_p99_s": dispatch.get("p99"),
+            "device_step_ms": attrib.get("device_step_ms"),
+            "roofline_fraction": attrib.get("roofline_fraction"),
+        }
+
+    def chrome_trace(self) -> dict:
+        """The engine's Chrome trace export (carries its clock origin
+        for the fleet merge)."""
+        return self.engine.obs.trace.chrome_trace()
+
+    def clock_offset_s(self) -> float:
+        """In-process: same monotonic clock as the router."""
+        return 0.0
+
+    def debug_state(self) -> dict:
+        return self.engine.debug_state()
+
 
 class HttpReplica:
     """Remote replica over the demo server's HTTP surface.
@@ -119,8 +163,8 @@ class HttpReplica:
     parks each response as a finished record, so the router's submit
     path never blocks on a remote generation. Records carry the same
     keys the engine's `drain_done_records()` produces ("tokens",
-    "ttft_s", "wall_s", "truncated") plus "error" on failure, so the
-    router's completion path is adapter-agnostic.
+    "ttft_s", "wall_s", "truncated", "trace_id") plus "error" on
+    failure, so the router's completion path is adapter-agnostic.
     """
 
     # The remote server drives its own engine; a driver fronting only
@@ -151,15 +195,50 @@ class HttpReplica:
         self._unreachable = False
         self._prefix: dict = {}
         self._prefix_at: float | None = None
+        self._metrics_text = ""
+        self._metrics_at: float | None = None
+        self._clock_offset_s: float | None = None
+        # Scrape-failure accounting (satellite of the fleet plane): a
+        # flapping pod must show up as a counted, dated error stream,
+        # not just as "unreachable right now".
+        self._scrape_errors = {"healthz": 0, "stats": 0, "metrics": 0}
+        self.last_error: str | None = None
+        self._last_ok_at: float | None = None
         for i in range(max(1, workers)):
             threading.Thread(
                 target=self._worker, daemon=True,
                 name=f"router-replica-{self.name}-{i}",
             ).start()
 
+    def _scrape_failed(self, kind: str, error: Exception) -> None:
+        with self._lock:
+            self._scrape_errors[kind] += 1
+            self.last_error = f"{kind}: {error}"
+
+    def _scrape_ok(self) -> None:
+        with self._lock:
+            self._last_ok_at = time.monotonic()
+
+    def scrape_error_stats(self) -> dict:
+        """Per-handle scrape health for `router.stats()` and the
+        `router_replica_scrape_errors_total{replica,kind}` counter:
+        cumulative failure counts by endpoint kind, the last error
+        string, and how long ago ANY scrape last succeeded."""
+        with self._lock:
+            last_ok = self._last_ok_at
+            return {
+                "counts": dict(self._scrape_errors),
+                "last_error": self.last_error,
+                "last_ok_age_s": (
+                    None if last_ok is None
+                    else round(time.monotonic() - last_ok, 3)
+                ),
+            }
+
     # -- request path --------------------------------------------------
 
     def submit(self, prompt, **kwargs) -> int:
+        trace_id = kwargs.pop("trace_id", None)
         body = {"prompt": [int(t) for t in prompt]}
         for key in (
             "max_new_tokens", "eos_id", "temperature", "top_k",
@@ -171,18 +250,25 @@ class HttpReplica:
             rid = self._next_rid
             self._next_rid += 1
             self._inflight += 1
-        self._queue.put((rid, body))
+        self._queue.put((rid, body, trace_id))
         return rid
 
     def _worker(self) -> None:
         while True:
-            rid, body = self._queue.get()
+            rid, body, trace_id = self._queue.get()
             t0 = time.monotonic()
             try:
+                headers = {"Content-Type": "application/json"}
+                if trace_id is not None:
+                    # The cross-process propagation contract: the demo
+                    # server stores the id on the engine submit and
+                    # echoes it on the response, so this request's
+                    # engine spans and the router's spans share it.
+                    headers["X-Walkai-Trace"] = str(trace_id)
                 req = urllib.request.Request(
                     f"{self.base_url}/generate",
                     data=json.dumps(body).encode(),
-                    headers={"Content-Type": "application/json"},
+                    headers=headers,
                 )
                 with urllib.request.urlopen(
                     req, timeout=self._timeout_s
@@ -199,6 +285,7 @@ class HttpReplica:
                         time.monotonic() - t0,
                     ),
                     "truncated": out.get("truncated", False),
+                    "trace_id": out.get("trace_id", trace_id),
                 }
             except Exception as e:  # noqa: BLE001 — per-request failure
                 record = {
@@ -206,6 +293,7 @@ class HttpReplica:
                     "ttft_s": None,
                     "wall_s": time.monotonic() - t0,
                     "truncated": False,
+                    "trace_id": trace_id,
                     "error": str(e),
                 }
             with self._lock:
@@ -236,15 +324,27 @@ class HttpReplica:
                 # driver thread (load reads inside routing picks) — a
                 # blackholed pod must not stall the whole fleet's
                 # request path for long per refresh interval.
+                t_send = time.monotonic()
                 with urllib.request.urlopen(
                     f"{self.base_url}/healthz", timeout=2.0
                 ) as resp:
                     payload = json.loads(resp.read())
+                t_recv = time.monotonic()
                 self._health = payload.get("engine") or {}
                 self._unreachable = False
-            except Exception:  # noqa: BLE001 — probe failed
+                self._scrape_ok()
+                # NTP-style clock offset (replica monotonic minus the
+                # router's), estimated at the probe's RTT midpoint —
+                # the alignment the fleet /debug/trace merge uses.
+                remote = payload.get("monotonic_s")
+                if isinstance(remote, (int, float)):
+                    self._clock_offset_s = (
+                        float(remote) - (t_send + t_recv) / 2.0
+                    )
+            except Exception as e:  # noqa: BLE001 — probe failed
                 self._health = None
                 self._unreachable = True
+                self._scrape_failed("healthz", e)
             self._health_at = now
         return self._health or {}
 
@@ -313,7 +413,80 @@ class HttpReplica:
             ) as resp:
                 payload = json.loads(resp.read())
             self._prefix = payload.get("cb_prefix") or {}
-        except Exception:  # noqa: BLE001 — telemetry must not gate routing
-            pass  # keep the last good tallies
+            self._scrape_ok()
+        except Exception as e:  # noqa: BLE001 — telemetry must not gate routing
+            self._scrape_failed("stats", e)
+            # keep the last good tallies
         self._prefix_at = now
         return self._prefix
+
+    def metrics_text(self) -> str:
+        """The pod's `/metrics` text, cached for `refresh_s` (the
+        federation source AND the straggler-signal source). Failures
+        keep the last good payload — a blip must not blank the whole
+        fleet exposition — and count under kind="metrics"."""
+        now = time.monotonic()
+        if (
+            self._metrics_at is not None
+            and now - self._metrics_at < self._refresh_s
+        ):
+            return self._metrics_text
+        try:
+            # Same short-timeout discipline as the /healthz probe:
+            # this runs on the ROUTER's driver thread (the straggler
+            # signals are read inside the fleet refresh) — a
+            # blackholed pod must not stall the whole fleet's request
+            # path for long per refresh interval.
+            with urllib.request.urlopen(
+                f"{self.base_url}/metrics", timeout=2.0
+            ) as resp:
+                self._metrics_text = resp.read().decode()
+            self._scrape_ok()
+        except Exception as e:  # noqa: BLE001 — telemetry must not gate routing
+            self._scrape_failed("metrics", e)
+        self._metrics_at = now
+        return self._metrics_text
+
+    def obs_signals(self) -> dict:
+        """Straggler signals parsed from the cached `/metrics` text
+        (the same scrape the federation serves — no extra request)."""
+        text = self.metrics_text()
+        return {
+            "dispatch_p99_s": first_value(text, "cb_slo_dispatch_p99"),
+            "device_step_ms": first_value(text, "cb_device_step_ms"),
+            "roofline_fraction": first_value(
+                text, "cb_device_roofline_fraction"
+            ),
+        }
+
+    def chrome_trace(self) -> dict | None:
+        """The pod's `/debug/trace` export, fetched on demand (only
+        the fleet `/debug/trace` endpoint asks). None on failure —
+        the merge lists the replica under `skipped` instead of
+        failing the whole timeline."""
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}/debug/trace", timeout=5.0
+            ) as resp:
+                return json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — debug read, best-effort
+            return None
+
+    def clock_offset_s(self) -> float:
+        """Replica monotonic clock minus the router's, from the last
+        successful health probe (0.0 until one lands)."""
+        self._engine_block()
+        return self._clock_offset_s or 0.0
+
+    def debug_state(self) -> dict | None:
+        """One `/debug/state` snapshot for the flight recorder;
+        best-effort with a short timeout (a dump must never hang the
+        driver on a sick pod — the sick pod is exactly when dumps
+        fire)."""
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}/debug/state", timeout=2.0
+            ) as resp:
+                return json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — debug read, best-effort
+            return None

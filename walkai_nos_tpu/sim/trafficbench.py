@@ -25,7 +25,12 @@ Headline keys (gated absent_ok in BASELINE.json, emitted by
   affinity should beat it because each template's blocks are warmed
   on ONE replica instead of sprayed across all);
 - `router_scale_events_total` — reconciler actions during the
-  replay (up + down) when autoscaling is enabled.
+  replay (up + down) when autoscaling is enabled;
+- `router_obs_overhead_pct` — the fleet observability plane's cost
+  (`measure_router_obs_overhead`: the same trace replayed with the
+  router-side plane on vs off, engine telemetry on in both arms),
+  gated at the same absolute < 2% budget as the engine's
+  `obs_overhead_pct`.
 
 The trace is tick-based, not wall-clock-based: arrivals land at
 router-step boundaries by largest-remainder apportionment of a
@@ -37,6 +42,7 @@ are still real host seconds (the engines' own record clocks).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -46,6 +52,7 @@ from walkai_nos_tpu.utils.stats import percentile
 __all__ = [
     "TrafficBenchResult",
     "make_trace",
+    "measure_router_obs_overhead",
     "run_traffic_benchmark",
 ]
 
@@ -278,9 +285,17 @@ def run_traffic_benchmark(
     provider = (
         StaticSliceProvider(spares) if spare_replicas > 0 else None
     )
+    # Straggler detection OFF for the policy comparison: this replay
+    # measures the ROUTING POLICY (affinity vs round-robin hit rate
+    # on one deterministic trace), and tiny CPU replicas' timing
+    # spread is load imbalance, not hardware degradation — a
+    # noise-driven flag would migrate templates mid-comparison and
+    # measure the detector instead. The fleet plane's own cost is
+    # measured separately by `measure_router_obs_overhead` (full
+    # plane on vs off).
     router = FleetRouter(
         replicas, provider=provider, scale_policy=scale_policy,
-        policy="affinity", seed=seed,
+        policy="affinity", seed=seed, anomaly=False,
     )
     records, submit_tick, errored = _replay(
         router, trace, surge_ticks
@@ -307,6 +322,7 @@ def run_traffic_benchmark(
             _warm(replica)
         rr_router = FleetRouter(
             rr_replicas, policy="round_robin", seed=seed,
+            anomaly=False,
         )
         _replay(rr_router, trace, surge_ticks)
         rr_rate = rr_router.prefix_hit_rate
@@ -326,3 +342,73 @@ def run_traffic_benchmark(
             rid: rec["tokens"] for rid, rec in records.items()
         },
     )
+
+
+def measure_router_obs_overhead(
+    *,
+    n_replicas: int = 2,
+    requests: int = 48,
+    templates: int = 4,
+    ticks: int = 24,
+    slots: int = 4,
+    max_new: int = 6,
+    repeats: int = 3,
+    seed: int = 0,
+    fleet_refresh_s: float = 1.0,
+    cfg=None,
+    params=None,
+) -> dict:
+    """A/B of the FLEET observability plane's cost: the same
+    deterministic trace replayed through fresh fleets with the plane
+    fully ON (router registry + request spans + throttled
+    anomaly/signal refresh + scrape/federation bookkeeping) vs fully
+    OFF (`FleetRouter(obs=False)` — no-op registry, disabled trace,
+    no detector), arms interleaved per repeat, median wall seconds
+    each. Engine-side telemetry stays ON in BOTH arms — the engine's
+    own budget is `obs_overhead_pct`; this key isolates the
+    router-layer addition and is gated at the same absolute < 2%
+    budget in BASELINE.json. The ON arm runs the PRODUCTION refresh
+    throttle (`fleet_refresh_s`, default 1 s — the budget gates the
+    configuration that ships, not an artificial per-step worst
+    case)."""
+    cfg, params, factory = default_engine_factory(
+        cfg, params, slots=slots
+    )
+    trace, _ = make_trace(
+        requests=requests, templates=templates, ticks=ticks,
+        max_new=max_new, vocab=cfg.vocab_size, seed=seed,
+    )
+    from walkai_nos_tpu.router.core import FleetRouter
+
+    seq = [0]
+
+    def one_replay(enabled: bool) -> float:
+        arm = "on" if enabled else "off"
+        replicas = [
+            factory(f"obs-{arm}{seq[0]}-{i}")
+            for i in range(n_replicas)
+        ]
+        seq[0] += 1
+        for replica in replicas:
+            _warm(replica)
+        router = FleetRouter(
+            replicas, policy="affinity", seed=seed,
+            obs=enabled, fleet_refresh_s=fleet_refresh_s,
+        )
+        t0 = time.perf_counter()
+        _replay(router, trace, set())
+        return time.perf_counter() - t0
+
+    walls: dict[bool, list[float]] = {True: [], False: []}
+    for _ in range(max(1, repeats)):
+        for enabled in (True, False):
+            walls[enabled].append(one_replay(enabled))
+    on = sorted(walls[True])[len(walls[True]) // 2]
+    off = sorted(walls[False])[len(walls[False]) // 2]
+    return {
+        "router_obs_overhead_pct": round(
+            100.0 * (on - off) / max(off, 1e-9), 2
+        ),
+        "router_obs_on_wall_s": round(on, 4),
+        "router_obs_off_wall_s": round(off, 4),
+    }
