@@ -4,7 +4,7 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check metrics-lint dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check metrics-lint replay-check dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
@@ -54,6 +54,13 @@ bench-check:
 # outside the catalog. Also tier-1 via tests/test_metrics_lint.py.
 metrics-lint:
 	python hack/metrics_lint.py
+
+# Capture/replay determinism gate: record a small deterministic
+# traffic run through a capture-armed engine, replay it through
+# cmd/replay.py (same config + a loop_steps override), exit nonzero
+# on any token divergence. Also tier-1 via tests/test_capture_replay.py.
+replay-check:
+	python hack/replay_check.py
 
 dryrun:
 	python __graft_entry__.py

@@ -669,10 +669,16 @@ def obs_overhead_benchmark() -> dict:
     (`bench_lm.measure_obs_overhead`). `obs_overhead_pct` is a
     headline key gated < 2% by `make bench-check` — instrumentation
     is production-default, so its cost is a regression surface like
-    any other."""
-    from bench_lm import measure_obs_overhead
+    any other. The capture-plane A/B (`measure_capture_overhead`)
+    rides along: the black-box request recorder armed vs unarmed,
+    telemetry on in both arms, `capture_overhead_pct` gated at the
+    same < 2% absolute budget — a recorder too expensive to leave
+    armed would never capture the incident it exists for."""
+    from bench_lm import measure_capture_overhead, measure_obs_overhead
 
-    return measure_obs_overhead()
+    out = measure_obs_overhead()
+    out.update(measure_capture_overhead())
+    return out
 
 
 def main() -> None:
@@ -726,7 +732,8 @@ def main() -> None:
             "cb_spec_accepted_per_round",
             "cb_quant_capacity_tokens_per_s", "lm_quality_delta_ppl",
             "cb_tp_capacity_tokens_per_s", "tp_scaling_efficiency",
-            "obs_overhead_pct",
+            "obs_overhead_pct", "capture_overhead_pct",
+            "cb_capture_bytes_per_request",
             "router_ttft_p99_under_surge", "router_prefix_hit_rate",
             "router_scale_events_total", "router_obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",

@@ -568,6 +568,8 @@ def measure_cb_serving(
     removes) and `cb_kv_hbm_bytes_per_resident_token` (the paged
     pool's memory-per-token snapshot under load).
     """
+    import shutil
+    import tempfile
     import threading
 
     from walkai_nos_tpu.utils.httpbench import (
@@ -577,6 +579,12 @@ def measure_cb_serving(
         spawn_server,
     )
 
+    # Capture armed for the WHOLE serving run: the bench tracks what
+    # the black-box recorder costs at production request rates —
+    # `cb_capture_bytes_per_request` is the headline disk-cost key,
+    # and the interleaved A/B (`measure_capture_overhead`) gates the
+    # capacity cost.
+    capture_dir = tempfile.mkdtemp(prefix="walkai-bench-capture-")
     env = {
         "WALKAI_DEMO_MODEL": "tiny",      # fast ViT beside the real LM
         "WALKAI_LM_MODEL": "small",
@@ -586,6 +594,7 @@ def measure_cb_serving(
         "WALKAI_CB_SLOTS": str(slots),
         "WALKAI_CB_BUCKET": str(prompt_bucket),
         "WALKAI_LM_MAX_NEW": str(lm_max_new),
+        "WALKAI_CAPTURE_DIR": capture_dir,
         **(server_env or {}),
     }
     proc, base = spawn_server(env, startup_timeout_s=startup_timeout_s)
@@ -728,8 +737,12 @@ def measure_cb_serving(
         # server-side histogram, so the delta population matches the
         # client records exactly.
         metrics1 = scrape_metrics(base)
+        capture_end = (
+            get_json(f"{base}/debug/capture").get("engine") or {}
+        )
     finally:
         kill_server(proc)
+        shutil.rmtree(capture_dir, ignore_errors=True)
 
     walls = sorted(r["wall_s"] for r in records)
     ttfts = sorted(r["ttft_s"] for r in records)
@@ -863,6 +876,27 @@ def measure_cb_serving(
         "cb_serving_slots": slots,
         "cb_serving_vocab": vocab,
         "cb_serving_measure_s": round(window_s, 1),
+        # Capture-plane disk cost at production request rates: bytes
+        # the black-box recorder wrote per completed request over the
+        # WHOLE run (capacity + Poisson phases — the recorder never
+        # pauses in production either). Headline key, tracked across
+        # rounds beside the <2% capture_overhead_pct capacity gate.
+        "cb_capture_bytes_per_request": (
+            round(
+                capture_end["bytes"]
+                / max(1, capture_end["records"].get("done", 0)),
+                1,
+            )
+            if capture_end.get("enabled") else None
+        ),
+        "cb_capture_records": (
+            capture_end.get("records", {}).get("done")
+            if capture_end.get("enabled") else None
+        ),
+        "cb_capture_dropped": (
+            sum((capture_end.get("dropped") or {}).values())
+            if capture_end.get("enabled") else None
+        ),
         # Speculative-serving section (spec-enabled servers only).
         **({
             "cb_spec_accepted_per_round": spec_end.get(
@@ -1506,6 +1540,96 @@ def measure_obs_overhead(
         "obs_on_tokens_per_s": round(on_tok, 1),
         "obs_off_tokens_per_s": round(off_tok, 1),
         "obs_overhead_repeats": repeats,
+    }
+
+
+def measure_capture_overhead(
+    *, slots: int = 16, n_requests: int = 48, prompt_len: int = 24,
+    new_tokens: int = 64, chunk_steps: int = 16, repeats: int = 3,
+    cfg=None,
+) -> dict:
+    """Capture-plane overhead A/B: the black-box request recorder
+    (`obs/capture.py`) claims its per-request cost is two buffered
+    ndjson writes off the device path; this MEASURES that claim the
+    same way `measure_obs_overhead` measures the metrics registry's.
+    The same engine-direct workload runs with capture armed (rotating
+    on-disk log in a temp dir) and unarmed, interleaved `repeats`
+    times so machine drift cancels, medians compared — telemetry ON
+    in both arms, so the delta isolates the recorder itself.
+
+    `capture_overhead_pct` is gated absent_ok at the same < 2%
+    absolute budget as `obs_overhead_pct` by `make bench-check`: a
+    recorder too expensive to leave armed would never capture the
+    incident it exists for.
+
+    ONE engine per arm, built once and reused (the jit-closure
+    compile argument from `measure_obs_overhead` applies unchanged);
+    the capture engine keeps appending across cycles — rotation
+    bounds the disk, which is exactly the production shape.
+    """
+    import shutil
+    import tempfile
+
+    from walkai_nos_tpu.models.decode import cache_bucket
+    from walkai_nos_tpu.models.lm import LMConfig
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+    from walkai_nos_tpu.obs.capture import CaptureLog
+
+    if cfg is None:
+        cfg = LMConfig(
+            vocab_size=32000, hidden_dim=512, num_layers=8,
+            num_heads=8, max_seq_len=1024, dtype="bfloat16",
+        )
+    params, _ = _served_params(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
+        for _ in range(n_requests)
+    ]
+    cache_len = cache_bucket(prompt_len + new_tokens, cfg.max_seq_len)
+    capture_dir = tempfile.mkdtemp(prefix="walkai-capture-ab-")
+
+    def build(armed: bool) -> ContinuousBatcher:
+        return ContinuousBatcher(
+            cfg, params, slots=slots, cache_len=cache_len,
+            prompt_bucket=prompt_len, chunk_steps=chunk_steps,
+            capture=CaptureLog(capture_dir) if armed else None,
+        )
+
+    def timed_cycle(engine: ContinuousBatcher) -> float:
+        # The clock starts BEFORE the submit loop: the submit-seam
+        # capture write runs on the production request path too, so
+        # excluding it would undercount half the recorder's
+        # per-request work (the done-side write lands inside run()).
+        t0 = time.perf_counter()
+        for p in prompts:
+            engine.submit(p, max_new_tokens=new_tokens)
+        results = engine.run()
+        dt = time.perf_counter() - t0
+        engine.drain_latencies()
+        return sum(len(v) for v in results.values()) / dt
+
+    try:
+        eng_off, eng_on = build(False), build(True)
+        timed_cycle(eng_off)  # compile off the clock
+        timed_cycle(eng_on)
+        on: list[float] = []
+        off: list[float] = []
+        for _ in range(repeats):
+            off.append(timed_cycle(eng_off))
+            on.append(timed_cycle(eng_on))
+    finally:
+        shutil.rmtree(capture_dir, ignore_errors=True)
+
+    def med(xs: list[float]) -> float:
+        return sorted(xs)[len(xs) // 2]
+
+    on_tok, off_tok = med(on), med(off)
+    return {
+        "capture_overhead_pct": round(100.0 * (1 - on_tok / off_tok), 2),
+        "capture_on_tokens_per_s": round(on_tok, 1),
+        "capture_off_tokens_per_s": round(off_tok, 1),
+        "capture_overhead_repeats": repeats,
     }
 
 

@@ -52,6 +52,11 @@ Endpoints:
   pool, prefix trie, spec controller, attribution, SLO windows.
 - GET  /debug/slo -> the sliding-window SLO view alone (windowed
   quantiles, objective compliance + burn rate, saturation).
+- GET/POST /debug/capture, GET /debug/capture/download -> the
+  deterministic capture plane's status / rotate / download
+  (WALKAI_CAPTURE_DIR arms it; every /generate completion then
+  carries the engine's config-fingerprint id, and the downloaded
+  ndjson replays token-identically via cmd/replay.py).
 
 Env knobs: WALKAI_MAX_BATCH (default 32), WALKAI_BATCH_WINDOW_MS
 (default 2.0), WALKAI_WARM_BUCKETS (comma list, default "1,8,32"),
@@ -604,9 +609,21 @@ def main() -> None:
                     kv_dtype=cb_cfg.kv_dtype,
                     w_dtype=cb_cfg.w_dtype,
                 )
+            # Deterministic capture plane (obs/capture.py):
+            # WALKAI_CAPTURE_DIR arms a bounded rotating on-disk
+            # recorder of every accepted request + completion digest
+            # behind the engine's config fingerprint —
+            # `cmd/replay.py` re-executes it token-identically
+            # offline. Served at /debug/capture (status / rotate /
+            # download); WALKAI_CAPTURE_MAX_BYTES /
+            # WALKAI_CAPTURE_MAX_FILES bound the ring.
+            from walkai_nos_tpu.obs.capture import CaptureLog
+
+            cb_capture = CaptureLog.from_env()
             cb_engine = ContinuousBatcher(
                 cb_cfg,
                 lm_params,
+                capture=cb_capture,
                 slots=cb_slots,
                 cache_len=cache_bucket(
                     cb_bucket + lm_max_new, lm_cfg.max_seq_len
@@ -892,6 +909,32 @@ def main() -> None:
             if self.path == "/generate":
                 self._generate()
                 return
+            if self.path == "/debug/capture":
+                # Capture-plane actions: {"action": "rotate"} closes
+                # the current capture file and opens a fresh one (to
+                # freeze an incident's tail before downloading it).
+                cap = (
+                    cb_engine.capture if cb_engine is not None
+                    else None
+                )
+                if cap is None:
+                    self.send_error(
+                        404, "no capture armed (set WALKAI_CAPTURE_DIR)"
+                    )
+                    return
+                from walkai_nos_tpu.obs.capture import (
+                    rotate_action_from_body,
+                )
+
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    rotate_action_from_body(self.rfile.read(n))
+                except (TypeError, ValueError) as e:
+                    self.send_error(400, str(e))
+                    return
+                cap.rotate()
+                self._json(200, {"engine": cb_engine.capture_stats()})
+                return
             if self.path == "/debug/profile":
                 n = int(self.headers.get("Content-Length", 0))
                 try:
@@ -1084,6 +1127,11 @@ def main() -> None:
                 try:
                     self._json(200, {
                         "trace_id": trace_id,
+                        # The engine's config-fingerprint id (None
+                        # while no capture is armed): match this
+                        # completion to the capture that can replay
+                        # it (`/debug/capture`, cmd/replay.py).
+                        "fingerprint": cb_engine.fingerprint_id,
                         "tokens": waiter["tokens"],
                         "generate_time_seconds": round(dt, 6),
                         "ttft_seconds": round(
@@ -1217,6 +1265,7 @@ def main() -> None:
                             event({
                                 "done": True,
                                 "trace_id": trace_id,
+                                "fingerprint": cb_engine.fingerprint_id,
                                 "n_tokens": len(waiter["tokens"]),
                                 "ttft_seconds": round(
                                     waiter.get("ttft_s", 0.0), 6
@@ -1301,6 +1350,37 @@ def main() -> None:
                         if cb_engine is not None else None
                     ),
                 })
+            elif self.path == "/debug/capture":
+                # Capture-plane status: armed/dir/file ring, record
+                # and byte tallies, drops, and the config-fingerprint
+                # id every completion record carries (engine null
+                # when continuous batching is off).
+                self._json(200, {
+                    "engine": (
+                        cb_engine.capture_stats()
+                        if cb_engine is not None else None
+                    ),
+                })
+            elif self.path == "/debug/capture/download":
+                cap = (
+                    cb_engine.capture if cb_engine is not None
+                    else None
+                )
+                if cap is None:
+                    self.send_error(
+                        404, "no capture armed (set WALKAI_CAPTURE_DIR)"
+                    )
+                    return
+                # Every retained file concatenated, oldest first:
+                # each carries its own fingerprint header, so the
+                # download parses as ONE capture — save it and hand
+                # it to `python -m walkai_nos_tpu.cmd.replay`.
+                data = cap.read_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             elif self.path == "/stats":
                 payload = {**stats.snapshot(), **device_info}
                 if cb_engine is not None:

@@ -1,0 +1,95 @@
+"""Capture/replay determinism gate (`make replay-check`, tier-1 via
+tests/test_capture_replay.py).
+
+Records a small deterministic traffic run through a capture-armed
+`ContinuousBatcher` (mixed greedy and seeded-sampled ragged requests,
+a block-boundary-crossing prompt included), then replays the capture
+through `cmd/replay.py` — the same CLI an operator replays an
+incident with — and exits nonzero on ANY divergence. A second replay
+runs under a `loop_steps` override, so the gate also holds the
+device-resident fold to the "replay changes WHEN the host learns
+about tokens, never WHICH" contract.
+
+CPU-pinned and hardware-free: the determinism invariant is exact on
+every backend, so the cheapest backend gates it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def record_traffic(capture_dir: str):
+    """One deterministic mixed traffic run through a capture-armed
+    tiny engine; returns the engine's completed {rid: tokens} so a
+    caller (the tier-1 test) can cross-check the capture contents."""
+    import numpy as np
+
+    import jax
+
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+    cfg = LMConfig(
+        vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+        max_seq_len=320, dtype="float32",
+    )
+    params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+    engine = ContinuousBatcher(
+        cfg, params, slots=2, cache_len=256, prompt_bucket=16,
+        chunk_steps=2, capture=capture_dir,
+    )
+    rng = np.random.default_rng(0)
+    # Mixed greedy/sampled, ragged lengths, one prompt crossing the
+    # 128-row block boundary, budgets that EOS-terminate sometimes.
+    for plen, temperature in (
+        (3, 0.0), (140, 0.0), (5, 1.0), (9, 1.0), (130, 1.0), (4, 0.0),
+    ):
+        engine.submit(
+            rng.integers(0, cfg.vocab_size, plen).tolist(),
+            max_new_tokens=int(rng.integers(3, 9)),
+            eos_id=3,
+            temperature=temperature,
+        )
+    return engine.run()
+
+
+def main(argv=None) -> int:
+    from walkai_nos_tpu.cmd.replay import main as replay_main
+
+    with tempfile.TemporaryDirectory(
+        prefix="walkai-replay-check-"
+    ) as capture_dir:
+        results = record_traffic(capture_dir)
+        print(
+            f"recorded {len(results)} request(s) to {capture_dir}; "
+            f"replaying..."
+        )
+        rc = replay_main([capture_dir, "--init-seed", "0"])
+        if rc != 0:
+            print("replay-check FAILED: same-config replay diverged")
+            return rc
+        rc = replay_main(
+            [capture_dir, "--init-seed", "0",
+             "--override", "loop_steps=4"]
+        )
+        if rc != 0:
+            print(
+                "replay-check FAILED: loop_steps=4 replay diverged "
+                "(the device-resident fold changed WHICH tokens, not "
+                "just when the host learns them)"
+            )
+            return rc
+    print("replay-check ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -137,6 +137,47 @@ class TestCheckLogic:
             "router_obs_overhead_pct" in f for f in failures
         )
 
+    def test_repo_baseline_gates_capture_keys(self):
+        """The capture plane is held to the SAME absolute < 2%
+        budget as the obs bundle (`capture_overhead_pct`,
+        engine-direct interleaved A/B with capture armed vs unarmed),
+        and `cb_capture_bytes_per_request` (disk cost at production
+        request rates) is declared null-until-recorded so the next
+        chip round anchors it. Specs must PARSE through the
+        comparator: absent is a skip note, above-budget fails once
+        emitted, the null key never fails."""
+        with open(_ROOT / "BASELINE.json") as f:
+            baseline = json.load(f)
+        spec = baseline["published"]["capture_overhead_pct"]
+        assert spec["value"] == 2.0
+        assert spec["direction"] == "lower"
+        assert spec["tolerance"] == 0.0
+        assert spec["absent_ok"] is True
+        bytes_spec = baseline["published"]["cb_capture_bytes_per_request"]
+        assert bytes_spec["value"] is None
+        assert bytes_spec["direction"] == "lower"
+        failures, notes = bench_check.check({}, baseline)
+        assert not any("capture_overhead_pct" in f for f in failures)
+        assert any(
+            "capture_overhead_pct" in n and "absent" in n
+            for n in notes
+        )
+        assert any(
+            "cb_capture_bytes_per_request" in n
+            and "no recorded baseline" in n
+            for n in notes
+        )
+        failures, _ = bench_check.check(
+            {"capture_overhead_pct": 1.1,
+             "cb_capture_bytes_per_request": 4096.0},
+            baseline,
+        )
+        assert not any("capture" in f for f in failures)
+        failures, _ = bench_check.check(
+            {"capture_overhead_pct": 2.7}, baseline
+        )
+        assert any("capture_overhead_pct" in f for f in failures)
+
     def test_repo_baseline_gates_prefix_cache_keys(self):
         """BASELINE.json carries the shared-prefix cache's two
         headline keys as absent_ok acceptance floors, and the specs
@@ -490,3 +531,10 @@ class TestRepoArtifacts:
 
     def test_makefile_has_bench_check_target(self):
         assert "bench-check:" in (_ROOT / "Makefile").read_text()
+
+    def test_makefile_has_replay_check_target(self):
+        # The capture/replay determinism gate (hack/replay_check.py)
+        # — pinned fast in tests/test_capture_replay.py.
+        text = (_ROOT / "Makefile").read_text()
+        assert "replay-check:" in text
+        assert "hack/replay_check.py" in text

@@ -1,0 +1,711 @@
+"""Deterministic capture & replay plane (obs/capture.py, sim/replay.py,
+cmd/replay.py, hack/replay_check.py).
+
+The engine's defining invariant — output is a pure function of
+(weights, prompt, knobs, seed) — made operational: a capture recorded
+through a live engine replays token-identically offline across
+spec/prefix/loop/tp axes; an injected config divergence is localized
+to the correct first (request, token) with a readable flight bundle;
+rotation bounds the on-disk ring; malformed files degrade to skipped
+records, never crashes.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+from walkai_nos_tpu.obs.capture import (
+    CaptureLog,
+    fingerprint_id,
+    token_digest,
+    tree_crc32,
+)
+from walkai_nos_tpu.sim.replay import (
+    build_config,
+    load_capture,
+    replay_capture,
+    triage_divergence,
+)
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+    max_seq_len=320, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+
+def _mixed_traffic(engine):
+    """Deterministic mixed greedy/sampled ragged submissions, one
+    prompt crossing the 128-row block boundary, EOS-terminating
+    budgets — the workload shape every replay axis must reproduce."""
+    rng = np.random.default_rng(0)
+    rids = []
+    for plen, temperature in (
+        (3, 0.0), (140, 0.0), (5, 1.0), (9, 1.0), (130, 1.0), (4, 0.0),
+    ):
+        rids.append(engine.submit(
+            rng.integers(0, CFG.vocab_size, plen).tolist(),
+            max_new_tokens=int(rng.integers(3, 9)),
+            eos_id=3,
+            temperature=temperature,
+        ))
+    return rids
+
+
+@pytest.fixture(scope="module")
+def capture_run(params, tmp_path_factory):
+    """ONE captured run shared by the whole replay matrix (each
+    replay builds its own engine; the capture itself need only be
+    recorded once)."""
+    d = str(tmp_path_factory.mktemp("capture"))
+    engine = ContinuousBatcher(
+        CFG, params, slots=2, cache_len=256, prompt_bucket=16,
+        chunk_steps=2, capture=d,
+    )
+    _mixed_traffic(engine)
+    records: dict[int, dict] = {}
+    while engine.has_work:
+        engine.step()
+        records.update(engine.drain_done_records())
+    records.update(engine.drain_done_records())
+    return {
+        "dir": d,
+        "records": records,
+        "fingerprint": engine.config_fingerprint(),
+    }
+
+
+class TestCaptureLog:
+    def test_rotation_bounds_the_ring(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=400, max_files=2)
+        log.attach({"id": "t" * 12})
+        for i in range(60):
+            log.record_submit(rid=i, prompt=[1, 2, 3], arrival_s=0.0)
+        stats = log.stats()
+        assert len(stats["files"]) <= 2
+        assert stats["dropped"]["rotated"] > 0
+        # What survived still parses as one capture (headers agree).
+        cap = load_capture(str(tmp_path))
+        assert cap.fingerprint["id"] == "t" * 12
+        assert len(cap.records) + cap.skipped <= 60
+        assert len(cap.records) >= 1
+
+    def test_every_file_carries_a_header(self, tmp_path):
+        log = CaptureLog(str(tmp_path), max_bytes=300, max_files=8)
+        log.attach({"id": "h" * 12})
+        for i in range(30):
+            log.record_submit(rid=i, prompt=[7], arrival_s=float(i))
+        for path in log.files():
+            with open(path) as f:
+                first = json.loads(f.readline())
+            assert first["kind"] == "header"
+            assert first["fingerprint"]["id"] == "h" * 12
+
+    def test_rotate_endpoint_semantics(self, tmp_path):
+        log = CaptureLog(str(tmp_path))
+        log.attach({"id": "r" * 12})
+        log.record_submit(rid=0, prompt=[1], arrival_s=0.0)
+        n0 = len(log.stats()["files"])
+        log.rotate()
+        assert len(log.stats()["files"]) == n0 + 1
+
+    def test_malformed_lines_skipped_not_fatal(self, tmp_path):
+        log = CaptureLog(str(tmp_path))
+        log.attach({"id": "m" * 12})
+        log.record_submit(rid=0, prompt=[1], max_new_tokens=2,
+                          arrival_s=0.0)
+        log.record_done(rid=0, tokens=[5, 6], digest=token_digest([5, 6]))
+        path = log.files()[0]
+        with open(path, "a") as f:
+            f.write("{not json\n")
+            f.write('{"kind": "mystery"}\n')
+            f.write('{"kind": "done", "rid": 99, "tokens": [1]}\n')
+        cap = load_capture(str(tmp_path))
+        # 2 malformed/unknown lines + 1 orphan done (no submit).
+        assert cap.skipped == 3
+        assert len(cap.records) == 1
+        assert cap.records[0].tokens == [5, 6]
+
+    def test_missing_capture_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_capture(str(tmp_path / "nope"))
+
+    def test_headerless_file_rejected(self, tmp_path):
+        p = tmp_path / "capture-000001.jsonl"
+        p.write_text('{"kind": "submit", "rid": 0}\n')
+        with pytest.raises(ValueError, match="header"):
+            load_capture(str(tmp_path))
+
+    def test_unwritable_dir_never_raises(self, tmp_path):
+        """The recorder must never take serving down: a capture dir
+        that cannot be created (path occupied by a FILE) degrades to
+        counted write_error drops, not an exception on the engine's
+        driver thread."""
+        blocker = tmp_path / "occupied"
+        blocker.write_text("not a directory")
+        log = CaptureLog(str(blocker))
+        log.attach({"id": "x" * 12})  # open fails silently
+        log.record_submit(rid=0, prompt=[1], arrival_s=0.0)
+        log.record_done(rid=0, tokens=[2], digest=token_digest([2]))
+        stats = log.stats()
+        assert stats["dropped"]["write_error"] == 2
+        assert stats["records"] == {"submit": 0, "done": 0}
+
+    def test_multi_run_capture_is_split_not_merged(self, tmp_path):
+        """A capture dir spanning a server restart holds two runs
+        whose request ids both start at 0: they must never merge (a
+        run-1 done pairing a run-2 submit would produce false
+        verdicts). Default selection is the LATEST run; --run style
+        selection reaches earlier ones."""
+        first = CaptureLog(str(tmp_path))
+        first.attach({"id": "f" * 12})
+        first.record_submit(rid=0, prompt=[1, 1], max_new_tokens=2,
+                            arrival_s=0.0)
+        first.record_done(rid=0, tokens=[5, 6],
+                          digest=token_digest([5, 6]))
+        second = CaptureLog(str(tmp_path))  # the restarted server
+        second.attach({"id": "f" * 12})
+        second.record_submit(rid=0, prompt=[2, 2], max_new_tokens=2,
+                             arrival_s=0.0)
+        second.record_done(rid=0, tokens=[7, 8],
+                           digest=token_digest([7, 8]))
+        latest = load_capture(str(tmp_path))
+        assert latest.runs == 2 and latest.run == 1
+        assert len(latest.records) == 1
+        assert latest.records[0].tokens == [7, 8]
+        earlier = load_capture(str(tmp_path), run=0)
+        assert earlier.records[0].tokens == [5, 6]
+        with pytest.raises(ValueError, match="out of range"):
+            load_capture(str(tmp_path), run=5)
+
+    def test_failed_header_write_closes_fd_and_removes_stray(
+        self, tmp_path, monkeypatch
+    ):
+        """ENOSPC-shaped failure: the exclusive create succeeds
+        (metadata) but the header write raises. The fd must close and
+        the stray empty file must go — otherwise every record leaks
+        one fd + one file until the SERVER hits EMFILE."""
+        import builtins
+
+        log = CaptureLog(str(tmp_path))
+        log.attach({"id": "e" * 12})
+        closed = []
+
+        class _BadFile:
+            def write(self, s):
+                raise OSError("no space left on device")
+
+            def flush(self):
+                pass
+
+            def close(self):
+                closed.append(True)
+
+        real_open = builtins.open
+
+        def fake_open(path, mode="r", *a, **k):
+            if mode == "x":
+                real_open(path, "x").close()  # metadata succeeds
+                return _BadFile()
+            return real_open(path, mode, *a, **k)
+
+        monkeypatch.setattr(builtins, "open", fake_open)
+        n_files_before = len(log.stats()["files"])
+        for _ in range(3):
+            log.rotate()
+            log.record_submit(rid=0, prompt=[1], arrival_s=0.0)
+        monkeypatch.undo()
+        assert len(closed) >= 3  # every failed open's fd closed
+        stats = log.stats()
+        assert stats["dropped"]["write_error"] == 3
+        # No unbounded stray-file growth while the disk is sick.
+        assert len(stats["files"]) <= n_files_before
+
+    def test_prune_spares_foreign_ring_and_counts_expired(
+        self, tmp_path
+    ):
+        """The ring bound applies to files THIS instance wrote: a
+        shared dir's foreign files (a possibly-live overlapping
+        writer, or dead runs) are never pruned inside 2x the ring —
+        and when dead runs DO expire, their records are counted as
+        drops (parsed from the file) instead of silently vanishing."""
+        header = '{"kind": "header", "fingerprint": {"id": "%s"}}\n'
+        submit = '{"kind": "submit", "rid": %d, "prompt": [1]}\n'
+        # Two foreign files (an overlapping writer's ring).
+        for i in (1, 2):
+            (tmp_path / f"capture-{i:06d}.jsonl").write_text(
+                header % ("o" * 12) + submit % 0 + submit % 1
+            )
+        log = CaptureLog(str(tmp_path), max_bytes=200, max_files=2)
+        log.attach({"id": "n" * 12})
+        for i in range(10):  # several rotations of our own ring
+            log.record_submit(rid=i, prompt=[2, 3], arrival_s=0.0)
+        stats = log.stats()
+        # Own ring bounded; both foreign files survive (global count
+        # own 2 + foreign 2 == 2 * max_files, never above it).
+        assert (tmp_path / "capture-000001.jsonl").exists()
+        assert (tmp_path / "capture-000002.jsonl").exists()
+        own_dropped = stats["dropped"]["rotated"]
+        assert own_dropped > 0  # our rotations did prune our files
+        # Three more dead-run files push the dir past 2x the ring:
+        # oldest foreign files expire, their records counted.
+        for i in (3, 4, 5):
+            (tmp_path / f"capture-1{i:05d}.jsonl").write_text(
+                header % ("d" * 12) + submit % 0 + submit % 1
+            )
+        log.rotate()
+        stats = log.stats()
+        assert len(stats["files"]) <= 2 * log.max_files
+        assert stats["dropped"]["rotated"] >= own_dropped + 2
+
+    def test_from_env_is_the_one_arming_rule(self, tmp_path):
+        env = {
+            "WALKAI_CAPTURE_DIR": str(tmp_path),
+            "WALKAI_CAPTURE_MAX_BYTES": "1234",
+            "WALKAI_CAPTURE_MAX_FILES": "7",
+        }
+        log = CaptureLog.from_env(env)
+        assert log.dir == str(tmp_path)
+        assert log.max_bytes == 1234
+        assert log.max_files == 7
+        assert CaptureLog.from_env({}) is None
+
+    def test_concurrent_process_never_truncates_a_live_file(
+        self, tmp_path
+    ):
+        """Two processes sharing one capture dir (rolling-restart
+        overlap): exclusive create must bump past an existing file
+        instead of truncating it."""
+        victim = tmp_path / "capture-000001.jsonl"
+        victim.write_text('{"kind": "header", "fingerprint": '
+                          '{"id": "aaaaaaaaaaaa"}}\n')
+        log = CaptureLog(str(tmp_path))  # next seq would be 2
+        (tmp_path / "capture-000002.jsonl").write_text("other live\n")
+        log.attach({"id": "b" * 12})
+        log.record_submit(rid=0, prompt=[1], arrival_s=0.0)
+        assert (tmp_path / "capture-000002.jsonl").read_text() == (
+            "other live\n"
+        )
+        assert victim.read_text().startswith('{"kind": "header"')
+        assert log.stats()["files"][-1] == "capture-000003.jsonl"
+
+    def test_router_capture_rejects_wrong_type(self, tmp_path):
+        from walkai_nos_tpu.router.core import FleetRouter
+
+        with pytest.raises(ValueError, match="capture must be"):
+            FleetRouter([], capture=12345)
+
+    def test_token_digest_discriminates(self):
+        assert token_digest([1, 2, 3]) == token_digest([1, 2, 3])
+        assert token_digest([1, 2, 3]) != token_digest([1, 2, 4])
+        assert token_digest([]) != token_digest([0])
+
+
+class TestFingerprint:
+    def test_stable_across_rebuilds_and_sensitive_to_weights(
+        self, params, capture_run
+    ):
+        fp = capture_run["fingerprint"]
+        rebuilt = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=256, prompt_bucket=16,
+            chunk_steps=2,
+        ).config_fingerprint()
+        assert rebuilt["id"] == fp["id"]
+        params2 = DecoderLM(CFG).init_params(jax.random.PRNGKey(1))
+        other = ContinuousBatcher(
+            CFG, params2, slots=2, cache_len=256, prompt_bucket=16,
+            chunk_steps=2,
+        ).config_fingerprint()
+        assert other["id"] != fp["id"]
+        assert other["weights_crc32"] != fp["weights_crc32"]
+        assert other["cfg"] == fp["cfg"]
+
+    def test_id_ignores_only_the_id_field(self):
+        fp = {"cfg": {"a": 1}, "engine": {"b": 2}}
+        assert fingerprint_id(fp) == fingerprint_id({**fp, "id": "x"})
+        assert fingerprint_id(fp) != fingerprint_id(
+            {"cfg": {"a": 2}, "engine": {"b": 2}}
+        )
+
+    def test_tree_crc32_order_independent(self):
+        a = {"x": np.ones(3, np.float32), "y": np.zeros(2, np.float32)}
+        b = {"y": np.zeros(2, np.float32), "x": np.ones(3, np.float32)}
+        assert tree_crc32(a) == tree_crc32(b)
+
+    def test_done_records_carry_fingerprint(self, capture_run):
+        fp_id = capture_run["fingerprint"]["id"]
+        for rec in capture_run["records"].values():
+            assert rec["fingerprint"] == fp_id
+
+    def test_uncaptured_engine_skips_the_weights_gather(self, params):
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=64, prompt_bucket=8,
+            chunk_steps=2,
+        )
+        assert engine.fingerprint_id is None
+        engine.submit([1, 2], max_new_tokens=2)
+        engine.run()
+        recs = engine.drain_done_records()
+        assert all(r["fingerprint"] is None for r in recs.values())
+        assert engine.capture_stats() == {
+            "enabled": False, "fingerprint": None,
+        }
+
+
+class TestRoundTrip:
+    """A capture recorded through a live engine replays
+    token-identically — same config AND across every determinism-
+    preserving axis (the acceptance criterion's matrix)."""
+
+    def test_capture_matches_live_run(self, capture_run):
+        cap = load_capture(capture_run["dir"])
+        assert cap.fingerprint["id"] == capture_run["fingerprint"]["id"]
+        live = {
+            rid: rec["tokens"]
+            for rid, rec in capture_run["records"].items()
+        }
+        assert {r.rid: r.tokens for r in cap.records} == live
+        for r in cap.records:
+            assert r.digest == token_digest(r.tokens)
+            assert r.seed == r.rid  # effective seed recorded
+
+    @pytest.mark.parametrize(
+        "overrides",
+        [
+            None,
+            {"loop_steps": 8},
+            {"prefix_cache": False},
+            {"spec": True, "spec_min_accept": 0.0},
+            {"kv_dtype": "int8-sim"},
+            {"tp_devices": 2},
+        ],
+        ids=["same", "loop8", "prefix-off", "spec-untrained-draft",
+             "int8-sim", "tp2"],
+    )
+    def test_replay_token_identical(
+        self, capture_run, params, overrides
+    ):
+        cap = load_capture(capture_run["dir"])
+        report = replay_capture(cap, params, overrides=overrides)
+        assert report.ok, report.summary()
+        assert report.n_verified == len(cap.records)
+        for rec in cap.records:
+            assert report.outcomes[rec.rid].tokens == rec.tokens
+
+    def test_original_timing_replay(self, capture_run, params):
+        cap = load_capture(capture_run["dir"])
+        report = replay_capture(
+            cap, params, timing="original", speed=1000.0
+        )
+        assert report.ok, report.summary()
+        assert report.n_verified == len(cap.records)
+
+    def test_truncated_record_verifies_by_prefix(
+        self, capture_run, params, tmp_path
+    ):
+        """A pool-truncated completion's LENGTH is live pool
+        pressure, not the serving function: replay (different
+        pressure) may run past the captured cut. Either stream being
+        a prefix of the other verifies; only a value divergence
+        inside the common prefix is real."""
+        src = load_capture(capture_run["dir"])
+        lines = []
+        chopped = False
+        for path in src.files:
+            for line in open(path):
+                obj = json.loads(line)
+                if (
+                    not chopped and obj.get("kind") == "done"
+                    and len(obj.get("tokens") or []) > 1
+                ):
+                    # Simulate a truncation the live run would have
+                    # recorded: drop the tail, flag it.
+                    obj["tokens"] = obj["tokens"][:-1]
+                    obj["n_tokens"] = len(obj["tokens"])
+                    obj["truncated"] = True
+                    obj["reason"] = "pool_overflow"
+                    chopped = True
+                lines.append(json.dumps(obj))
+        assert chopped
+        edited = tmp_path / "capture-000001.jsonl"
+        edited.write_text("\n".join(lines) + "\n")
+        cap = load_capture(str(edited))
+        report = replay_capture(cap, params)
+        assert report.ok, report.summary()
+
+    def test_unknown_override_rejected(self, capture_run):
+        cap = load_capture(capture_run["dir"])
+        with pytest.raises(ValueError, match="unknown override"):
+            build_config(cap.fingerprint, {"not_a_knob": 1})
+
+
+class TestDivergenceTriage:
+    """An intentionally divergent replay (different weights) is
+    localized to the correct first (request, token) and dumped as a
+    readable flight bundle."""
+
+    @pytest.fixture(scope="class")
+    def divergent(self, capture_run, tmp_path_factory):
+        params2 = DecoderLM(CFG).init_params(jax.random.PRNGKey(1))
+        cap = load_capture(capture_run["dir"])
+        report = replay_capture(cap, params2)
+        flight_dir = str(tmp_path_factory.mktemp("flight"))
+        verdict = triage_divergence(
+            cap, report, params2, flight_dir=flight_dir
+        )
+        return cap, report, verdict
+
+    def test_divergence_detected_in_arrival_order(self, divergent):
+        cap, report, _ = divergent
+        assert not report.ok
+        arrival = [r.rid for r in cap.records]
+        assert report.divergent == [
+            rid for rid in arrival if rid in report.divergent
+        ]
+        assert report.divergent[0] == arrival[0]
+
+    def test_first_divergent_token_is_exact(self, divergent):
+        cap, report, verdict = divergent
+        rid = report.divergent[0]
+        rec = next(r for r in cap.records if r.rid == rid)
+        out = report.outcomes[rid]
+        idx = verdict["token_index"]
+        assert idx == out.first_divergent_token
+        # The index is the FIRST mismatch: everything before agrees.
+        assert rec.tokens[:idx] == out.tokens[:idx]
+        assert (
+            idx >= min(len(rec.tokens), len(out.tokens))
+            or rec.tokens[idx] != out.tokens[idx]
+        )
+        assert verdict["expected_token"] == rec.tokens[idx]
+        assert verdict["got_token"] == out.tokens[idx]
+
+    def test_classified_config_dependent(self, divergent):
+        _, _, verdict = divergent
+        # Different weights = a different function: solo re-run
+        # cannot reproduce the capture either.
+        assert verdict["classification"] == "config_dependent"
+
+    def test_flight_bundle_is_readable(self, divergent, capture_run):
+        _, _, verdict = divergent
+        path = verdict["bundle_path"]
+        assert path is not None and os.path.isfile(path)
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "replay_divergence"
+        assert (
+            bundle["capture_fingerprint"]["id"]
+            == capture_run["fingerprint"]["id"]
+        )
+        # Both configs' fingerprints: the replay side's differs by
+        # exactly the injected axis (the weights digest).
+        assert (
+            bundle["replay_fingerprint"]["weights_crc32"]
+            != bundle["capture_fingerprint"]["weights_crc32"]
+        )
+        assert bundle["record"]["rid"] == verdict["rid"]
+        assert bundle["record"]["captured_tokens"]
+        assert bundle["verdict"]["classification"] == "config_dependent"
+        assert "debug_state" in bundle
+
+    def test_triage_none_on_clean_replay(self, capture_run, params):
+        cap = load_capture(capture_run["dir"])
+        report = replay_capture(cap, params)
+        assert triage_divergence(cap, report, params) is None
+
+
+class TestBatchDependentClassification:
+    def test_solo_match_classifies_batch_dependent(
+        self, capture_run, params, tmp_path
+    ):
+        """Force the 'violated engine invariant' arm without
+        violating it: hand triage a report whose divergence is
+        fabricated (the solo re-run under the TRUE config reproduces
+        the capture, so triage must say batch_dependent)."""
+        cap = load_capture(capture_run["dir"])
+        report = replay_capture(cap, params)
+        assert report.ok
+        victim = cap.records[0]
+        out = report.outcomes[victim.rid]
+        out.match = False
+        out.tokens = list(out.tokens)
+        out.tokens[-1] = (out.tokens[-1] + 1) % CFG.vocab_size
+        out.first_divergent_token = len(out.tokens) - 1
+        report.divergent = [victim.rid]
+        verdict = triage_divergence(
+            cap, report, params, flight_dir=str(tmp_path)
+        )
+        assert verdict["classification"] == "batch_dependent"
+
+
+class TestRouterCapture:
+    def test_fleet_capture_records_routed_replica(
+        self, params, tmp_path
+    ):
+        from walkai_nos_tpu.router.core import FleetRouter
+        from walkai_nos_tpu.router.replica import EngineReplica
+
+        engine = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=64, prompt_bucket=8,
+            chunk_steps=2,
+        )
+        router = FleetRouter(
+            [EngineReplica(engine, name="r0")],
+            capture=str(tmp_path),
+        )
+        rid = router.submit([1, 2, 3], max_new_tokens=3)
+        results = router.run()
+        assert router.capture_stats()["enabled"] is True
+        cap = load_capture(str(tmp_path))
+        assert cap.fingerprint.get("router", {}).get("replicas") == [
+            "r0"
+        ]
+        rec = next(r for r in cap.records if r.rid == rid)
+        assert rec.replica == "r0"
+        assert rec.tokens == results[rid]
+        assert rec.digest == token_digest(results[rid])
+
+
+class TestRouterCaptureFailure:
+    def test_failed_replica_request_not_recorded_as_clean(
+        self, tmp_path
+    ):
+        """A replica failure (tokens None + error) must not read as
+        a successful zero-token completion in the fleet capture:
+        tokens/digest stay null and the error rides the record."""
+        from walkai_nos_tpu.router.core import FleetRouter
+
+        class _FailingReplica:
+            name = "f0"
+            draining = False
+            saturation = None
+            slo_ok = None
+            queue_depth = 0
+            slots = 1
+
+            def __init__(self):
+                self._pending = {}
+                self._rid = 0
+
+            def submit(self, prompt, **kwargs):
+                rid = self._rid
+                self._rid += 1
+                self._pending[rid] = True
+                return rid
+
+            def step(self):
+                return False
+
+            @property
+            def has_work(self):
+                return bool(self._pending)
+
+            def drain_done_records(self):
+                done = {
+                    rid: {
+                        "tokens": None,
+                        "error": "replica died mid-generate",
+                        "ttft_s": None,
+                        "wall_s": 0.01,
+                        "truncated": False,
+                        "trace_id": None,
+                    }
+                    for rid in self._pending
+                }
+                self._pending.clear()
+                return done
+
+            def drain(self):
+                self.draining = True
+
+            def prefix_stats(self):
+                return {}
+
+        router = FleetRouter(
+            [_FailingReplica()], capture=str(tmp_path)
+        )
+        rid = router.submit([1, 2, 3], max_new_tokens=2)
+        while router.has_work:
+            router.step()
+        router.drain_done_records()
+        cap = load_capture(str(tmp_path))
+        rec = next(r for r in cap.records if r.rid == rid)
+        assert rec.tokens is None
+        assert rec.digest is None
+        assert rec.error == "replica died mid-generate"
+
+
+class TestReplayCheckGate:
+    def test_replay_check_is_green(self):
+        """The `make replay-check` flow, in-process: record a
+        deterministic run, replay it through cmd/replay.py (same
+        config + loop override), expect rc 0."""
+        import importlib.util
+        import pathlib
+
+        spec = importlib.util.spec_from_file_location(
+            "replay_check",
+            pathlib.Path(__file__).parent.parent
+            / "hack" / "replay_check.py",
+        )
+        replay_check = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(replay_check)
+        assert replay_check.main([]) == 0
+
+    def test_cli_exit_codes(self, capture_run, tmp_path):
+        from walkai_nos_tpu.cmd.replay import main as replay_main
+
+        assert replay_main(
+            [capture_run["dir"], "--init-seed", "0", "--json"]
+        ) == 0
+        # Different weights: nonzero + a bundle in --flight-dir.
+        flight = tmp_path / "flt"
+        assert replay_main(
+            [capture_run["dir"], "--init-seed", "7",
+             "--flight-dir", str(flight)]
+        ) == 1
+        assert any(
+            n.startswith("flight-") for n in os.listdir(flight)
+        )
+
+    def test_cli_digest_warning_survives_engine_knob_override(
+        self, capture_run, tmp_path, capsys
+    ):
+        """An engine-knob override (loop_steps) cannot change the
+        weight tree, so it must NOT suppress the weights-digest
+        mismatch note — the note is what stops a wrong --init-seed
+        from being blamed on the overridden axis."""
+        from walkai_nos_tpu.cmd.replay import main as replay_main
+
+        rc = replay_main(
+            [capture_run["dir"], "--init-seed", "7",
+             "--override", "loop_steps=1",
+             "--flight-dir", str(tmp_path)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "weights digest mismatch" in out
+
+    def test_cli_override_parsing(self):
+        from walkai_nos_tpu.cmd.replay import parse_override
+
+        assert parse_override("loop_steps=8") == ("loop_steps", 8)
+        assert parse_override("prefix_cache=false") == (
+            "prefix_cache", False,
+        )
+        assert parse_override("kv_dtype=int8-sim") == (
+            "kv_dtype", "int8-sim",
+        )
+        assert parse_override("spec_min_accept=0.5") == (
+            "spec_min_accept", 0.5,
+        )

@@ -203,7 +203,12 @@ class TestContinuousBatchingEndpoint:
     batch and still return exactly the standalone greedy tokens."""
 
     @pytest.fixture(scope="class")
-    def cb_server(self):
+    def cb_server(self, tmp_path_factory):
+        # Capture armed for the WHOLE class: the recorder claims to
+        # be transparent, and every exactness test here doubles as
+        # proof it is; the /debug/capture contract tests then ride
+        # the same (expensive) server spawn.
+        capture_dir = str(tmp_path_factory.mktemp("capture"))
         proc, base = spawn_server(
             {
                 "JAX_PLATFORMS": "cpu",
@@ -219,6 +224,7 @@ class TestContinuousBatchingEndpoint:
                 # the windowed compliance machinery runs (and stays
                 # green) on CPU CI.
                 "WALKAI_SLO_TTFT_P99_S": "60",
+                "WALKAI_CAPTURE_DIR": capture_dir,
             },
             startup_timeout_s=300.0,
             poll_s=0.25,
@@ -632,3 +638,89 @@ class TestContinuousBatchingEndpoint:
             except urllib.error.HTTPError as e:
                 raised = e.code
             assert raised == 400, payload
+
+    def test_debug_capture_contract_and_replay(
+        self, cb_server, tmp_path
+    ):
+        """The /debug/capture surface end-to-end, pinning the
+        acceptance criterion: status carries the armed ring + the
+        engine's config-fingerprint id, every /generate completion
+        carries the SAME id, rotate opens a fresh file, and the
+        DOWNLOADED capture replays token-identically (zero divergent
+        requests) through cmd/replay.py — the server inits LM_TINY
+        from PRNGKey(0), which is exactly `--init-seed 0`."""
+        import json as _json
+        import urllib.request
+
+        # Traffic of our own first (greedy + seeded-sampled), so the
+        # capture verifiably contains these completions.
+        _, greedy = self._post(cb_server, {"prompt": [2, 4, 6]})
+        _, sampled = self._post(
+            cb_server,
+            {"prompt": [3, 5], "temperature": 0.7, "seed": 9},
+        )
+        status = get_json(f"{cb_server}/debug/capture")["engine"]
+        assert status["enabled"] is True
+        fp_id = status["fingerprint"]
+        assert fp_id and len(fp_id) == 12
+        assert greedy["fingerprint"] == fp_id
+        assert sampled["fingerprint"] == fp_id
+        assert status["records"]["submit"] >= 2
+        assert status["records"]["done"] >= 2
+        assert status["bytes"] > 0
+        # Rotate: a fresh file opens (each self-contained).
+        req = urllib.request.Request(
+            f"{cb_server}/debug/capture",
+            data=_json.dumps({"action": "rotate"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            rotated = _json.loads(resp.read())["engine"]
+        assert len(rotated["files"]) == len(status["files"]) + 1
+        # Download -> replay: the full incident workflow.
+        with urllib.request.urlopen(
+            f"{cb_server}/debug/capture/download", timeout=30
+        ) as resp:
+            blob = resp.read().decode()
+        saved = tmp_path / "capture-dl.jsonl"
+        saved.write_text(blob)
+        from walkai_nos_tpu.cmd.replay import main as replay_main
+
+        assert replay_main(
+            [str(saved), "--init-seed", "0", "--json"]
+        ) == 0
+
+    def test_debug_capture_bad_action_rejected(self, cb_server):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{cb_server}/debug/capture",
+            data=_json.dumps({"action": "destroy"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raised = None
+        except urllib.error.HTTPError as e:
+            raised = e.code
+        assert raised == 400
+
+    def test_debug_capture_absent_without_engine(self, server):
+        # Vision-only server: status engine-null like every debug
+        # endpoint; download is a 404 (nothing armed).
+        import urllib.error
+        import urllib.request
+
+        assert get_json(f"{server}/debug/capture") == {"engine": None}
+        try:
+            urllib.request.urlopen(
+                f"{server}/debug/capture/download", timeout=30
+            )
+            raised = None
+        except urllib.error.HTTPError as e:
+            raised = e.code
+        assert raised == 404

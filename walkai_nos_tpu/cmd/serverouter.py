@@ -39,6 +39,10 @@ Endpoints (the router's own, on `--port`):
   export, clock-aligned into one Perfetto-loadable JSON.
 - `GET /debug/flight` -> the flight recorder's bounded on-disk ring
   of anomaly/SLO-breach bundles (`obs/anomaly.py`).
+- `GET/POST /debug/capture`, `GET /debug/capture/download` -> the
+  fleet capture plane's status / rotate / download
+  (`WALKAI_CAPTURE_DIR` arms it; `obs/capture.py`, done records name
+  the routed replica).
 
 A single driver thread owns the fleet (the same one-owner discipline
 as the demo server's cb_driver): it drains submissions, steps every
@@ -282,6 +286,28 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
         protocol_version = "HTTP/1.1"
 
         def do_POST(self):  # noqa: N802 (http.server API)
+            if self.path == "/debug/capture":
+                cap = driver.router.capture
+                if cap is None:
+                    self.send_error(
+                        404, "no capture armed (set WALKAI_CAPTURE_DIR)"
+                    )
+                    return
+                from walkai_nos_tpu.obs.capture import (
+                    rotate_action_from_body,
+                )
+
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    rotate_action_from_body(self.rfile.read(n))
+                except (TypeError, ValueError) as e:
+                    self.send_error(400, str(e))
+                    return
+                cap.rotate()
+                self._json(
+                    200, {"fleet": driver.router.capture_stats()}
+                )
+                return
             if self.path != "/generate":
                 self.send_error(404)
                 return
@@ -382,6 +408,31 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
                     "dir": flight.dir if flight else None,
                     "bundles": flight.bundles() if flight else [],
                 })
+            elif self.path == "/debug/capture":
+                # Fleet capture status (enabled false when
+                # WALKAI_CAPTURE_DIR never armed it) — wrapped in
+                # "fleet" the way the demo server wraps its payload
+                # in "engine" (and /healthz wraps the router stats),
+                # so the two binaries' envelopes differ predictably,
+                # not silently.
+                self._json(
+                    200, {"fleet": driver.router.capture_stats()}
+                )
+            elif self.path == "/debug/capture/download":
+                cap = driver.router.capture
+                if cap is None:
+                    self.send_error(
+                        404, "no capture armed (set WALKAI_CAPTURE_DIR)"
+                    )
+                    return
+                data = cap.read_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/x-ndjson"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             else:
                 self.send_error(404)
 
@@ -414,9 +465,19 @@ def build(args) -> tuple[RouterDriver, RouterObs]:
     obs = RouterObs(
         enabled=os.environ.get("WALKAI_OBS", "1") == "1"
     )
+    # Fleet-level capture plane: WALKAI_CAPTURE_DIR (+ the shared
+    # MAX_BYTES/MAX_FILES bounds — `CaptureLog.from_env`, the ONE
+    # env-arming rule the demo server uses too) arms a bounded
+    # rotating recorder of routed traffic (prompt/knobs/arrival +
+    # completion digests, done records naming the routed replica) —
+    # the incident timeline per-replica engine captures are replayed
+    # against. Served at /debug/capture like the demo server's.
+    from walkai_nos_tpu.obs.capture import CaptureLog
+
+    capture = CaptureLog.from_env()
     if args.replica:
         replicas = [HttpReplica(url) for url in args.replica]
-        router = FleetRouter(replicas, obs=obs)
+        router = FleetRouter(replicas, obs=obs, capture=capture)
     else:
         policy = ScalePolicy(
             min_replicas=(
@@ -441,6 +502,7 @@ def build(args) -> tuple[RouterDriver, RouterObs]:
         )
         router = FleetRouter(
             replicas, provider=provider, scale_policy=policy, obs=obs,
+            capture=capture,
         )
     return RouterDriver(router), obs
 

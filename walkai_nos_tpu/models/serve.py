@@ -183,6 +183,12 @@ from walkai_nos_tpu.obs.attrib import (
     params_hbm_bytes,
     tp_ici_bytes_per_token,
 )
+from walkai_nos_tpu.obs.capture import (
+    CaptureLog,
+    fingerprint_id,
+    token_digest,
+    tree_crc32,
+)
 from walkai_nos_tpu.obs.serving import ServingObs
 from walkai_nos_tpu.obs.slo import SloTracker
 from walkai_nos_tpu.ops.decode_attention import MAX_KERNEL_STEPS, PAGE_ROWS
@@ -316,6 +322,17 @@ class ContinuousBatcher:
     rate, and `cb_saturation` gauges — read them via `slo_stats()` /
     `attrib_stats()` / `debug_state()` and the `saturation` /
     `slo_ok` properties.
+
+    `capture` (a directory path or an `obs/capture.CaptureLog`) arms
+    the deterministic capture plane: every accepted request's inputs
+    (prompt, knobs, EFFECTIVE seed, arrival offset) and every
+    completion's token stream + digest are recorded to a bounded
+    rotating on-disk ring behind the engine's config fingerprint
+    (`config_fingerprint()` — every determinism-relevant knob plus a
+    weights digest), and `sim/replay.py` / `cmd/replay.py` re-execute
+    the capture token-identically offline. Completion records then
+    carry `fingerprint` (the short id) so any logged completion can
+    be matched to the capture that can replay it.
     """
 
     def __init__(
@@ -343,7 +360,29 @@ class ContinuousBatcher:
         obs: ServingObs | bool = True,
         slo_window_s: float = 30.0,
         slo_objectives: dict | None = None,
+        capture: CaptureLog | str | None = None,
     ) -> None:
+        # Config-fingerprint snapshot of the CALLER's config, taken
+        # before any replace (ragged/paged wiring, cache_len, the
+        # head-replicated kv expansion at tp > kv_heads): replay
+        # rebuilds from exactly these fields and the engine re-derives
+        # the rest itself (`sim/replay.py`). The excluded fields are
+        # the ones this constructor owns.
+        self._fp_cfg = {
+            f.name: getattr(cfg, f.name)
+            for f in dataclasses.fields(cfg)
+            if f.name not in (
+                "ragged_decode", "paged_decode", "paged_blocks",
+                "cache_len",
+            )
+        }
+        self._fingerprint: dict | None = None
+        # Capture-argument validation up FRONT (the engine build
+        # below is minutes on a real model — a bad argument must not
+        # cost it); the log attaches at the end of the build, once
+        # the fingerprint's weight digest can cover the tree the
+        # engine actually serves.
+        self._capture = CaptureLog.coerce(capture)
         cache_len = cache_len or cfg.max_seq_len
         if prompt_bucket > cache_len:
             raise ValueError(
@@ -669,6 +708,18 @@ class ContinuousBatcher:
             self._build_paged_programs()
         else:
             self._build_dense_programs()
+
+        # Deterministic capture plane (obs/capture.py), validated at
+        # constructor entry: armed here — after the build — so its
+        # header fingerprint (incl. the weights digest of the tree
+        # the engine actually serves, post-quantization/expansion)
+        # is pinned before the first request. capture=None (default)
+        # records nothing and computes no fingerprint (the weights
+        # digest is a full host gather).
+        if self._capture is not None:
+            self._capture.attach(
+                self.config_fingerprint(), obs=self.obs
+            )
 
     # -- compiled programs ---------------------------------------------
 
@@ -1288,6 +1339,26 @@ class ContinuousBatcher:
             rid, req.submitted_at, len(prompt), max_new_tokens,
             trace_id=req.trace_id,
         )
+        if self._capture is not None:
+            # The capture's submit record pins the EXACT inputs the
+            # determinism invariant quantifies over — note the
+            # EFFECTIVE seed (an unset seed defaulted to the request
+            # id above), so a replay under fresh rids reproduces the
+            # original PRNG streams bit for bit.
+            self._capture.record_submit(
+                rid=rid,
+                trace_id=req.trace_id,
+                prompt=prompt.tolist(),
+                max_new_tokens=max_new_tokens,
+                eos_id=eos_id,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                seed=req.seed,
+                arrival_s=round(
+                    self._capture.arrival_offset(req.submitted_at), 6
+                ),
+            )
         return rid
 
     def _reject(self, reason: str, message: str) -> ValueError:
@@ -1389,14 +1460,20 @@ class ContinuousBatcher:
         THE one warm-up discipline; the demo server and the fleet
         router's replica adapters both call it. Warm-up prompts are
         single tokens (no full 128-row block), so prefix-cache
-        tallies stay untouched."""
-        width = 1
-        widest = min(self.slots, self.prefill_lanes)
-        while width <= widest:
-            for _ in range(width):
-                self.submit([1], max_new_tokens=max_new_tokens)
-            self.run()
-            width *= 2
+        tallies stay untouched. The capture plane is suspended for
+        the warm-up: synthetic compile traffic is not production
+        traffic, and replaying it would just re-warm."""
+        cap, self._capture = self._capture, None
+        try:
+            width = 1
+            widest = min(self.slots, self.prefill_lanes)
+            while width <= widest:
+                for _ in range(width):
+                    self.submit([1], max_new_tokens=max_new_tokens)
+                self.run()
+                width *= 2
+        finally:
+            self._capture = cap
 
     def drain(self) -> None:
         """Enter drain mode: reject every further `submit()` with the
@@ -1459,6 +1536,10 @@ class ContinuousBatcher:
                 # direct engine users) — lets a client match its
                 # record to the fleet /debug/trace timeline.
                 "trace_id": r.trace_id,
+                # The engine's config-fingerprint id (None while no
+                # capture armed it): any logged completion can be
+                # matched to the capture that can replay it.
+                "fingerprint": self.fingerprint_id,
             }
             for rid, r in self._requests.items()
             if r.done
@@ -1763,6 +1844,7 @@ class ContinuousBatcher:
             "loop": self.loop_stats(),
             "quant": self.quant_stats(),
             "tp": self.tp_stats(),
+            "capture": self.capture_stats(),
             "attrib": self.attrib_stats(),
             "slo": self.slo_stats(),
         }
@@ -1824,6 +1906,87 @@ class ContinuousBatcher:
         record(self.cfg)
         if self._spec:
             record(self._draft_cfg)
+
+    def config_fingerprint(self) -> dict:
+        """The engine's config fingerprint: every determinism-relevant
+        knob the serving invariant quantifies over — the caller's
+        LMConfig fields (dtypes, tp, rope/norm/mlp family, quant
+        modes), the batcher's own knobs (slots, cache/pool/bucket
+        geometry, chunk/loop/spec/prefix settings), and a CRC-32
+        digest of the weight tree the engine actually serves (and the
+        draft's, when spec is on). Written as the header of every
+        capture file; `sim/replay.py` rebuilds an engine from it (or
+        from it plus explicit overrides) and the short `id` rides
+        every completion record so a logged completion can be matched
+        to the capture that can replay it.
+
+        Computed lazily and cached: the weights digest gathers the
+        full tree to host once (sharded leaves included)."""
+        if self._fingerprint is not None:
+            return self._fingerprint
+        fp = {
+            "version": 1,
+            "cfg": dict(self._fp_cfg),
+            "engine": {
+                "slots": self.slots,
+                "cache_len": self.cache_len,
+                "prompt_bucket": self.prompt_bucket,
+                "chunk_steps": self.chunk_steps,
+                "loop_steps": self.loop_steps,
+                "paged": self.paged,
+                "pool_blocks": self.pool_blocks,
+                "prefill_chunk": getattr(self, "prefill_chunk", 0),
+                "prefill_lanes": getattr(self, "prefill_lanes", 0),
+                "prefix_cache": self._prefix is not None,
+                "spec": self._spec,
+                "spec_k": self._spec_k,
+                "spec_min_accept": self._spec_min_accept,
+                "spec_warmup_rounds": self._spec_warmup,
+                "spec_ema_alpha": self._spec_alpha,
+            },
+            "weights_crc32": tree_crc32(self.params),
+        }
+        if self._spec:
+            fp["draft"] = {
+                "weights_crc32": tree_crc32(self.draft_params),
+                "num_layers": self._draft_cfg.num_layers,
+                "hidden_dim": self._draft_cfg.hidden_dim,
+                "num_heads": self._draft_cfg.num_heads,
+                "vocab_size": self._draft_cfg.vocab_size,
+                "max_seq_len": self._draft_cfg.max_seq_len,
+            }
+        fp["id"] = fingerprint_id(fp)
+        self._fingerprint = fp
+        return fp
+
+    @property
+    def fingerprint_id(self) -> str | None:
+        """Short id of the computed config fingerprint; None until
+        `config_fingerprint()` ran (it runs at build when capture is
+        armed — an un-armed engine never pays the weights gather)."""
+        return (
+            self._fingerprint["id"]
+            if self._fingerprint is not None else None
+        )
+
+    @property
+    def capture(self) -> CaptureLog | None:
+        """The armed capture log (None when capture is off) — the
+        demo server's `/debug/capture` rotate/download surface."""
+        return self._capture
+
+    def capture_stats(self) -> dict:
+        """Capture-plane status — the `/debug/capture` payload and
+        the `debug_state()` `capture` block: armed/dir/file ring,
+        record and byte tallies, drop counts, and the fingerprint id
+        completion records carry."""
+        if self._capture is None:
+            return {"enabled": False, "fingerprint": None}
+        return {
+            "enabled": True,
+            "fingerprint": self.fingerprint_id,
+            **self._capture.stats(),
+        }
 
     def quant_stats(self) -> dict:
         """Quantization telemetry — the `/stats` `cb_quant` section
@@ -2309,6 +2472,25 @@ class ContinuousBatcher:
                         / (len(req.tokens) - 1)
                     )
                 obs.trace.done(req.rid, now, reason, len(req.tokens))
+                if self._capture is not None:
+                    # The commit seam is the ONE completion path the
+                    # plain chunk, the spec round, and the device-
+                    # resident loop share — so every capture gets its
+                    # done record exactly once, with the same clock
+                    # reads drain_done_records() reports.
+                    self._capture.record_done(
+                        rid=req.rid,
+                        trace_id=req.trace_id,
+                        tokens=list(req.tokens),
+                        n_tokens=len(req.tokens),
+                        digest=token_digest(req.tokens),
+                        ttft_s=round(
+                            req.first_token_at - req.submitted_at, 6
+                        ),
+                        wall_s=round(now - req.submitted_at, 6),
+                        truncated=req.truncated,
+                        reason=reason,
+                    )
                 if self._slot_req[s] is req:
                     self._slot_req[s] = None
                     self._budget[s] = 0

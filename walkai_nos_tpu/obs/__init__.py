@@ -13,6 +13,10 @@
 - `obs.slo` — sliding-window (ring-of-buckets) SLO views over the
   cumulative histograms, burn-rate gauges, and the composed
   `cb_saturation` scale signal.
+- `obs.capture` — the deterministic capture plane: a bounded rotating
+  on-disk recorder of request inputs + completion digests behind an
+  engine config fingerprint, replayable token-identically by
+  `sim/replay.py`.
 - `obs.catalog` — declarative list of every exported metric
   (`hack/metrics_lint.py` holds it and docs/observability.md to each
   other).
@@ -29,6 +33,12 @@ trace/profile how-to.
 from walkai_nos_tpu.obs.anomaly import (  # noqa: F401
     AnomalyDetector,
     FlightRecorder,
+)
+from walkai_nos_tpu.obs.capture import (  # noqa: F401
+    CaptureLog,
+    fingerprint_id,
+    token_digest,
+    tree_crc32,
 )
 from walkai_nos_tpu.obs.attrib import (  # noqa: F401
     DispatchAttribution,

@@ -309,6 +309,30 @@ CATALOG: tuple[MetricSpec, ...] = (
         "cb_device_time_seconds_total, not here)",
         attr="quant_seconds",
     ),
+    # -- capture/replay plane (obs/capture.py) -------------------------
+    MetricSpec(
+        "cb_capture_records_total", "counter",
+        "Capture-log records written to the on-disk ring, by record "
+        "kind (submit = accepted request inputs, done = completion "
+        "token stream + digest)",
+        labels=("kind",),  # submit | done
+        attr="capture_records",
+    ),
+    MetricSpec(
+        "cb_capture_bytes_total", "counter",
+        "Capture-log bytes written (headers included; rotation may "
+        "later prune whole files — this counts what was written, "
+        "cb_capture_dropped_total counts what rotation lost)",
+        attr="capture_bytes",
+    ),
+    MetricSpec(
+        "cb_capture_dropped_total", "counter",
+        "Capture records lost, by reason: a capture that silently "
+        "lost records would masquerade as a complete incident record",
+        labels=("reason",),  # rotated (pruned with an expired file) |
+        # write_error (disk write failed; serving continues)
+        attr="capture_dropped",
+    ),
     MetricSpec(
         "cb_last_dispatch_unixtime_seconds", "gauge",
         "Unix time of the most recent engine dispatch (scrape-side "

@@ -68,6 +68,11 @@ import zlib
 import numpy as np
 
 from walkai_nos_tpu.obs.anomaly import AnomalyDetector, FlightRecorder
+from walkai_nos_tpu.obs.capture import (
+    CaptureLog,
+    fingerprint_id,
+    token_digest,
+)
 from walkai_nos_tpu.obs.federation import federate, merge_fleet_trace
 from walkai_nos_tpu.obs.router import RouterObs
 from walkai_nos_tpu.obs.trace import RouterTrace
@@ -133,6 +138,7 @@ class FleetRouter:
         fleet_refresh_s: float = 1.0,
         flight: FlightRecorder | None = None,
         flight_dir: str | None = None,
+        capture: CaptureLog | str | None = None,
     ):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(
@@ -200,6 +206,27 @@ class FleetRouter:
             )
             if provider is not None else None
         )
+        # Fleet-level capture plane (obs/capture.py): records routed
+        # traffic at the router's own submit/collect seams — done
+        # records add the routed replica. The fleet capture's header
+        # has no engine fingerprint (replicas own those; an engine
+        # capture is the token-exact replay artifact) — its records
+        # pin WHAT arrived and WHERE it went, the incident timeline
+        # the per-replica captures are replayed against. Caveat: an
+        # unseeded sampled request's effective seed is assigned
+        # replica-side (the local rid), so only the replica's own
+        # capture pins it.
+        self._capture = CaptureLog.coerce(capture)
+        if self._capture is not None:
+            fp = {
+                "version": 1,
+                "router": {
+                    "policy": policy,
+                    "replicas": [h.name for h in self._handles],
+                },
+            }
+            fp["id"] = fingerprint_id(fp)
+            self._capture.attach(fp)
         self._set_replica_gauges()
 
     # -- fleet membership ----------------------------------------------
@@ -374,6 +401,24 @@ class FleetRouter:
             t_routed=t_routed, replica=handle.name, policy=arm,
             t_enqueue=enqueued_at, affinity_key=key,
         )
+        if self._capture is not None:
+            self._capture.record_submit(
+                rid=rid,
+                trace_id=trace_id,
+                prompt=np.asarray(prompt).reshape(-1).tolist(),
+                replica=handle.name,
+                policy=arm,
+                arrival_s=round(
+                    self._capture.arrival_offset(t_submit), 6
+                ),
+                **{
+                    k: kwargs.get(k)
+                    for k in (
+                        "max_new_tokens", "eos_id", "temperature",
+                        "top_k", "top_p", "seed",
+                    )
+                },
+            )
         return rid
 
     # -- the drive loop ------------------------------------------------
@@ -393,6 +438,28 @@ class FleetRouter:
             if route is not None:
                 record["trace_id"] = route[2]
             self.trace.collected(rid, time.monotonic())
+            if self._capture is not None:
+                # A FAILED replica request (tokens None + error) must
+                # not masquerade as a clean zero-token completion:
+                # tokens/digest stay null and the error rides along —
+                # the incident timeline is what this capture is FOR.
+                tokens = record.get("tokens")
+                self._capture.record_done(
+                    rid=rid,
+                    trace_id=record.get("trace_id"),
+                    replica=handle.name,
+                    tokens=list(tokens) if tokens is not None else None,
+                    n_tokens=len(tokens) if tokens is not None else 0,
+                    digest=(
+                        token_digest(tokens)
+                        if tokens is not None else None
+                    ),
+                    ttft_s=record.get("ttft_s"),
+                    wall_s=record.get("wall_s"),
+                    truncated=record.get("truncated", False),
+                    fingerprint=record.get("fingerprint"),
+                    error=record.get("error"),
+                )
             self._done[rid] = record
 
     def step(self) -> bool:
@@ -702,6 +769,25 @@ class FleetRouter:
             hits += h
             lookups += lk
         return hits / lookups if lookups else None
+
+    @property
+    def capture(self) -> CaptureLog | None:
+        """The fleet capture log (None when not armed) — the
+        serverouter `/debug/capture` surface."""
+        return self._capture
+
+    def capture_stats(self) -> dict:
+        """Fleet capture status — the serverouter `/debug/capture`
+        payload (same shape as the engine's `capture_stats()`; the
+        fleet header fingerprint id stands in for the engine's)."""
+        if self._capture is None:
+            return {"enabled": False, "fingerprint": None}
+        fp = self._capture.fingerprint or {}
+        return {
+            "enabled": True,
+            "fingerprint": fp.get("id"),
+            **self._capture.stats(),
+        }
 
     def scale_events(self) -> dict[str, int]:
         return {

@@ -163,8 +163,9 @@ class HttpReplica:
     parks each response as a finished record, so the router's submit
     path never blocks on a remote generation. Records carry the same
     keys the engine's `drain_done_records()` produces ("tokens",
-    "ttft_s", "wall_s", "truncated", "trace_id") plus "error" on
-    failure, so the router's completion path is adapter-agnostic.
+    "ttft_s", "wall_s", "truncated", "trace_id", "fingerprint") plus
+    "error" on failure, so the router's completion path is
+    adapter-agnostic.
     """
 
     # The remote server drives its own engine; a driver fronting only
@@ -286,6 +287,11 @@ class HttpReplica:
                     ),
                     "truncated": out.get("truncated", False),
                     "trace_id": out.get("trace_id", trace_id),
+                    # The replica engine's config-fingerprint id
+                    # (capture-armed pods only): matches this
+                    # completion to the replica capture that can
+                    # replay it.
+                    "fingerprint": out.get("fingerprint"),
                 }
             except Exception as e:  # noqa: BLE001 — per-request failure
                 record = {
