@@ -639,7 +639,13 @@ def router_benchmark() -> dict:
     `router_obs_overhead_pct` — the fleet observability plane
     (router registry + request spans + per-step anomaly scoring) on
     vs off on the same trace, engine telemetry on in both arms —
-    gated at the same absolute < 2% budget as `obs_overhead_pct`."""
+    gated at the same absolute < 2% budget as `obs_overhead_pct`.
+    The disaggregation arm (`compare_disaggregated=True`) replays
+    the same trace through a role-split prefill/decode fleet with
+    block shipping and through a no-shipping colocated baseline,
+    emitting `router_disagg_ttft_p99` (absent_ok band, same ceiling
+    as the surge key), `router_disagg_prefix_hit_rate` and
+    `router_noship_prefix_hit_rate`."""
     from walkai_nos_tpu.router.autoscale import ScalePolicy
     from walkai_nos_tpu.sim.trafficbench import (
         measure_router_obs_overhead,
@@ -653,6 +659,7 @@ def router_benchmark() -> dict:
         templates=8,
         ticks=48,
         slots=4,
+        compare_disaggregated=True,
         scale_policy=ScalePolicy(
             up_saturation=0.6, breach_ticks=3,
             idle_ticks=12, cooldown_ticks=16,
@@ -735,6 +742,7 @@ def main() -> None:
             "obs_overhead_pct", "capture_overhead_pct",
             "cb_capture_bytes_per_request",
             "router_ttft_p99_under_surge", "router_prefix_hit_rate",
+            "router_disagg_ttft_p99",
             "router_scale_events_total", "router_obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )

@@ -205,7 +205,7 @@ def engine_health(engine, alive: bool) -> dict | None:
         return None
     age = engine.seconds_since_last_dispatch
     saturation = engine.saturation
-    return {
+    payload = {
         "alive": bool(alive),
         "queue_depth": engine.queue_depth,
         "seconds_since_last_dispatch": (
@@ -223,6 +223,14 @@ def engine_health(engine, alive: bool) -> dict | None:
         ),
         "slo_ok": engine.slo_ok,
     }
+    drain_stats = getattr(engine, "drain_stats", None)
+    if drain_stats is not None:
+        # Drain-down PROGRESS, not just the flag: resident slots,
+        # queued/prefilling counts, and the KV blocks live requests
+        # still hold — the numbers an operator (or the reconciler)
+        # watches converge to zero while a drain runs.
+        payload["drain"] = drain_stats()
+    return payload
 
 
 def request_trace_id(*candidates) -> str:
@@ -713,6 +721,25 @@ def main() -> None:
                             )
                             while True:
                                 prompt, max_new, knobs, holder = item
+                                if (
+                                    isinstance(prompt, str)
+                                    and prompt == "__job__"
+                                ):
+                                    # Engine-plane job (the /blocks
+                                    # transfer endpoint): runs on THE
+                                    # thread that owns the engine, so
+                                    # export/import never races a
+                                    # step. `max_new` carries the
+                                    # callable.
+                                    try:
+                                        holder["result"] = max_new(
+                                            cb_engine
+                                        )
+                                    except Exception as err:  # noqa: BLE001
+                                        holder["error"] = str(err)
+                                    holder["done"].set()
+                                    item = cb_queue.get_nowait()
+                                    continue
                                 try:
                                     rid = cb_engine.submit(
                                         prompt, max_new_tokens=max_new,
@@ -909,6 +936,9 @@ def main() -> None:
             if self.path == "/generate":
                 self._generate()
                 return
+            if self.path == "/blocks":
+                self._blocks()
+                return
             if self.path == "/debug/capture":
                 # Capture-plane actions: {"action": "rotate"} closes
                 # the current capture file and opens a fresh one (to
@@ -976,6 +1006,66 @@ def main() -> None:
                     "slice": slice_id,
                 },
             )
+
+        def _blocks(self):
+            """KV block-transfer endpoint (the fleet router's ship
+            seam over HTTP): {"action": "export", "hashes": [...]}
+            serializes the named prefix blocks out of this pod's
+            trie; {"action": "import", "payload": {...}} lands a
+            peer's export in the pool + trie. Both run as
+            driver-thread jobs — the transfer never races an engine
+            step."""
+            if cb_engine is None or not cb_enabled[0]:
+                self.send_error(404, "continuous batching not enabled")
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                action = body.get("action")
+                if action == "export":
+                    hashes = body.get("hashes")
+                    if not isinstance(hashes, list):
+                        raise ValueError("hashes must be a list")
+
+                    def job(eng, hashes=hashes):
+                        return eng.export_blocks(hashes)
+                elif action == "import":
+                    payload = body.get("payload")
+                    if not isinstance(payload, dict):
+                        raise ValueError(
+                            "payload must be a JSON object"
+                        )
+
+                    def job(eng, payload=payload):
+                        return eng.import_blocks(payload)
+                else:
+                    raise ValueError(
+                        "action must be 'export' or 'import'"
+                    )
+            except (TypeError, ValueError) as e:
+                self.send_error(400, str(e))
+                return
+            holder = {"done": threading.Event()}
+            cb_queue.put(("__job__", job, None, holder))
+            t0 = time.perf_counter()
+            while not holder["done"].wait(timeout=1.0):
+                if not cb_enabled[0]:
+                    self.send_error(503, "batch engine failed; retry")
+                    return
+                if time.perf_counter() - t0 > 120.0:
+                    self.send_error(503, "block transfer timed out")
+                    return
+            if holder.get("error"):
+                self.send_error(400, holder["error"])
+                return
+            if "result" not in holder:
+                # The driver died mid-job (its death drain sets done
+                # without a result).
+                self.send_error(503, "batch engine failed; retry")
+                return
+            self._json(200, holder["result"])
 
         def _generate(self):
             if lm_generate is None:

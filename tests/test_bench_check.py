@@ -137,6 +137,42 @@ class TestCheckLogic:
             "router_obs_overhead_pct" in f for f in failures
         )
 
+    def test_repo_baseline_gates_disagg_ttft(self):
+        """The disaggregated serving arm is held to the SAME loose
+        TTFT ceiling as the colocated surge key
+        (`router_disagg_ttft_p99`, trafficbench's role-split
+        prefill/decode replay): absent is a skip note; once emitted,
+        a p99 past the band (value 2.0, lower-better, tolerance 1.0
+        => fail above 4.0 s) fails — the first-token stage handoff
+        must not cost the fleet its TTFT envelope."""
+        with open(_ROOT / "BASELINE.json") as f:
+            baseline = json.load(f)
+        spec = baseline["published"]["router_disagg_ttft_p99"]
+        assert spec["value"] == 2.0
+        assert spec["direction"] == "lower"
+        assert spec["tolerance"] == 1.0
+        assert spec["absent_ok"] is True
+        failures, notes = bench_check.check({}, baseline)
+        assert not any(
+            "router_disagg_ttft_p99" in f for f in failures
+        )
+        assert any(
+            "router_disagg_ttft_p99" in n and "absent" in n
+            for n in notes
+        )
+        failures, _ = bench_check.check(
+            {"router_disagg_ttft_p99": 0.8}, baseline
+        )
+        assert not any(
+            "router_disagg_ttft_p99" in f for f in failures
+        )
+        failures, _ = bench_check.check(
+            {"router_disagg_ttft_p99": 4.5}, baseline
+        )
+        assert any(
+            "router_disagg_ttft_p99" in f for f in failures
+        )
+
     def test_repo_baseline_gates_capture_keys(self):
         """The capture plane is held to the SAME absolute < 2%
         budget as the obs bundle (`capture_overhead_pct`,

@@ -1,0 +1,261 @@
+"""KV block transfer + live request migration (`models/serve.py`).
+
+Tier-1 surface for the disaggregated-serving engine seams: a block
+exported by content hash and imported into a peer's pool must be
+indistinguishable from a locally-prefilled block — matchable (serving
+an identical prompt over the imported prefix is token-identical to a
+cold engine), refcounted (a local reader pins it exactly like a local
+sharer), evictable (it parks at refcount 0 and LRU-evicts under
+pressure), and an import must NEVER overflow the pool — with the free
+list dry it competes through the same evict-under-pressure seam as
+admission. Live migration must preserve the stream bit-for-bit: a
+request exported mid-decode and re-imported elsewhere (greedy AND
+sampled — the per-slot PRNG key rides along) finishes with the exact
+tokens an uninterrupted engine emits, and a partial export (`only=`)
+leaves the other residents decoding untouched. Deliberately NOT in
+conftest's `_SLOW_FILES`: tiny 2-layer config, few-token budgets.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.block_key import chain_hashes
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+    max_seq_len=512,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG.vocab_size, n).astype(np.int32)
+
+
+def _expected(params, prompt, max_new):
+    gen = make_generate_fn(CFG)
+    out = gen(params, jnp.asarray(prompt[None]), max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _engine(params, **kw):
+    base = dict(
+        slots=2, cache_len=384, chunk_steps=3, prefill_chunk=32,
+        prefill_lanes=2,
+    )
+    base.update(kw)
+    return ContinuousBatcher(CFG, params, **base)
+
+
+class TestBlockExportImport:
+    def test_imported_prefix_parity_and_divergent_tail(self, params):
+        """Blocks shipped by hash from a warm engine land matchable:
+        the importer serves the SAME prompt with 2 block hits and the
+        exact cold-engine tokens; a prompt diverging AFTER the
+        imported prefix is also token-identical to cold (imported
+        prefix + local suffix — the fleet-cache correctness claim);
+        re-importing the same payload is a per-block dup reject."""
+        src = _engine(params)
+        p = _prompt(300, seed=31)
+        want = _expected(params, p, 10)
+        r0 = src.submit(p, max_new_tokens=10)
+        assert src.run()[r0] == want
+        hashes = chain_hashes(p)
+        assert len(hashes) == 2  # 300 tokens -> 2 full shareable blocks
+        payload = src.export_blocks(hashes)
+        # Trie-side path hashes ARE the prompt-side chain hashes: the
+        # router can name another engine's blocks from tokens alone.
+        assert [b["hash"] for b in payload["blocks"]] == hashes
+
+        dst = _engine(params)
+        assert dst.import_blocks(payload) == {
+            "imported": 2, "rejected": {},
+        }
+        r1 = dst.submit(p, max_new_tokens=10)
+        assert dst.run()[r1] == want, "imported-prefix decode mismatch"
+        assert dst.prefix_stats()["block_hits"] == 2
+        # Divergent tail over the imported prefix.
+        p2 = np.concatenate([p[:256], _prompt(30, seed=77)])
+        r2 = dst.submit(p2, max_new_tokens=8)
+        assert dst.run()[r2] == _expected(params, p2, 8)
+        assert dst.import_blocks(src.export_blocks(hashes)) == {
+            "imported": 0, "rejected": {"dup": 2},
+        }
+
+    def test_import_with_free_list_dry_evicts_lru(self, params):
+        """With every allocatable block parked, an import competes
+        through evict-under-pressure: the LRU parked prefix is
+        evicted (never a pool overflow), the payload lands whole,
+        and the newer local prefix survives."""
+        dst = _engine(params, slots=1)  # pool: 3 allocatable blocks
+        p_old = _prompt(130, seed=101)
+        p_new = _prompt(130, seed=102)
+        for p in (p_old, p_new):
+            dst.submit(p, max_new_tokens=2)
+            dst.run()
+        assert dst._prefix.parked_blocks == 2
+
+        src = _engine(params)
+        p3 = _prompt(300, seed=55)
+        src.submit(p3, max_new_tokens=2)
+        src.run()
+        res = dst.import_blocks(src.export_blocks(chain_hashes(p3)))
+        assert res["imported"] == 2, res
+        assert int(dst.obs.prefix_evictions.value()) >= 1
+        assert dst._prefix.match(p_old) == []  # LRU victim gone
+        # The import is fully resident and serves.
+        rid = dst.submit(p3, max_new_tokens=4)
+        assert dst.run()[rid] == _expected(params, p3, 4)
+
+    def test_refcount_shared_by_local_reader_of_import(self, params):
+        """A local request matching an IMPORTED block pins it exactly
+        like a local sharer: refcount 1 while the reader decodes (the
+        block is not freeable), 0 + parked after it finishes — then
+        it is evictable like any cached prefix."""
+        src = _engine(params)
+        p = _prompt(200, seed=9)  # 1 shareable block
+        src.submit(p, max_new_tokens=2)
+        src.run()
+        dst = _engine(params)
+        assert dst.import_blocks(
+            src.export_blocks(chain_hashes(p))
+        )["imported"] == 1
+        node = dst._prefix.match(p)[0]
+        assert node.ready and node.refcount == 0
+        assert dst._prefix.parked_blocks == 1
+        rid = dst.submit(p, max_new_tokens=24)
+        records = {}
+        while dst.has_work and node.refcount == 0:
+            dst.step()
+        assert node.refcount == 1  # live local reader of the import
+        assert node.block not in dst._free_blocks
+        while dst.has_work:
+            dst.step()
+            records.update(dst.drain_done_records())
+        assert records[rid]["tokens"] == _expected(params, p, 24)
+        assert node.refcount == 0
+        assert dst._prefix.parked_blocks == 1
+        assert dst._prefix.evict_lru() == node.block
+
+    def test_incompatible_header_rejects_whole(self, params):
+        """A payload whose compatibility header disagrees (here:
+        kv_dtype) rejects WHOLE — nothing lands, and the rejection
+        reason names the first mismatching field."""
+        src = _engine(params)
+        p = _prompt(200, seed=3)
+        src.submit(p, max_new_tokens=2)
+        src.run()
+        payload = src.export_blocks(chain_hashes(p))
+        payload["kv_dtype"] = "int4"
+        dst = _engine(params)
+        res = dst.import_blocks(payload)
+        assert res == {"imported": 0, "rejected": {"kv_dtype": 1}}
+        assert dst._prefix.match(p) == []
+
+
+class TestLiveMigration:
+    @pytest.mark.parametrize("knobs", [
+        {},
+        {"temperature": 0.9, "top_k": 16, "top_p": 0.95, "seed": 123},
+    ], ids=["greedy", "sampled"])
+    def test_midstream_migration_is_token_exact(self, params, knobs):
+        """A request exported a few tokens into decode and imported
+        into a peer finishes with EXACTLY the tokens an uninterrupted
+        engine emits — greedy and sampled (the slot's per-step PRNG
+        key migrates with the stream, so sampling resumes on the same
+        draw sequence)."""
+        src = _engine(params)
+        q = _prompt(140, seed=7)
+        rc = src.submit(q, max_new_tokens=40, **knobs)
+        while not src._requests[rc].tokens:
+            src.step()
+        for _ in range(3):
+            src.step()
+        payload = src.export_resident()
+        assert not src.has_work  # evacuated, not copied
+        assert len(payload["migrate"]) == 1 and not payload["resubmit"]
+        dst = _engine(params)
+        out = dst.import_resident(payload)
+        assert out[0]["migrated"] is True
+        got = dst.run()[out[0]["rid"]]
+        if knobs:
+            ref_engine = _engine(params)
+            rr = ref_engine.submit(q, max_new_tokens=40, **knobs)
+            ref = ref_engine.run()[rr]
+        else:
+            ref = _expected(params, q, 40)
+        assert got == ref
+
+    def test_queued_and_prefilling_export_as_resubmits(self, params):
+        """Work without a committed token (queued, mid-prefill) has no
+        KV worth shipping: it exports as resubmit state and replays
+        from scratch on the importer, token-identical."""
+        src = _engine(params, slots=1)
+        q1, q2 = _prompt(300, seed=11), _prompt(20, seed=12)
+        src.submit(q1, max_new_tokens=6)
+        src.submit(q2, max_new_tokens=5)
+        src.step()  # q1 mid-prefill, q2 still queued
+        payload = src.export_resident()
+        assert not src.has_work
+        assert len(payload["resubmit"]) == 2 and not payload["migrate"]
+        dst = _engine(params)
+        out = dst.import_resident(payload)
+        res = dst.run()
+        got = sorted(tuple(res[o["rid"]]) for o in out)
+        assert got == sorted(map(tuple, [
+            _expected(params, q1, 6), _expected(params, q2, 5),
+        ]))
+
+    def test_partial_export_leaves_other_streams_serving(self, params):
+        """`only=[rid]` ships ONE live stream (the two-stage decode
+        handoff); the other resident keeps decoding on the source and
+        BOTH finish token-identical to uninterrupted runs."""
+        src = _engine(params)
+        qa, qb = _prompt(140, seed=21), _prompt(150, seed=22)
+        ra = src.submit(qa, max_new_tokens=20)
+        rb = src.submit(qb, max_new_tokens=20)
+        while not (src._requests[ra].tokens and src._requests[rb].tokens):
+            src.step()
+        payload = src.export_resident(only=[ra])
+        assert len(payload["migrate"]) == 1 and not payload["resubmit"]
+        assert list(payload["migrate"][0]["prompt"]) == list(qa)
+        assert src.has_work  # rb still resident and decoding
+        dst = _engine(params)
+        out = dst.import_resident(payload)
+        moved = dst.run()[out[0]["rid"]]
+        stayed = None
+        while src.has_work:
+            src.step()
+            stayed = {**(stayed or {}), **src.drain_done_records()}
+        assert moved == _expected(params, qa, 20)
+        assert stayed[rb]["tokens"] == _expected(params, qb, 20)
+
+    def test_drain_stats_counts_down_to_empty(self, params):
+        """`drain_stats()` (the /healthz drain block) reports the
+        evacuation's progress: resident slots + blocks remaining
+        while work is live, zeros once the export empties the
+        engine."""
+        engine = _engine(params)
+        rid = engine.submit(_prompt(140, seed=5), max_new_tokens=30)
+        while not engine._requests[rid].tokens:
+            engine.step()
+        engine.drain()
+        st = engine.drain_stats()
+        assert st["draining"] is True
+        assert st["resident_slots"] == 1
+        assert st["blocks_remaining"] >= 1
+        engine.export_resident()
+        st = engine.drain_stats()
+        assert st["resident_slots"] == 0
+        assert st["queued"] == 0
+        assert st["blocks_remaining"] == 0

@@ -519,11 +519,22 @@ class TestDrainSeam:
         spec.loader.exec_module(mod)
         _, make = fleet
         engine = make("drain1").engine
-        assert mod.engine_health(engine, True)["draining"] is False
+        before = mod.engine_health(engine, True)
+        assert before["draining"] is False
+        assert before["drain"]["draining"] is False
         engine.drain()
         payload = mod.engine_health(engine, True)
         assert payload["draining"] is True
         assert payload["has_work"] is False
+        # The drain-progress block (drain_stats): an operator polling
+        # /healthz watches these count down to zero.
+        assert payload["drain"] == {
+            "draining": True,
+            "resident_slots": 0,
+            "prefilling": 0,
+            "queued": 0,
+            "blocks_remaining": 0,
+        }
 
 
 class TestFleetEndToEnd:
@@ -638,6 +649,256 @@ class TestFleetEndToEnd:
         assert router_out[late] == expected[0]
 
 
+class TestDisaggregatedFleet:
+    """KV block shipping + two-stage prefill/decode placement: the
+    router ships CACHED BLOCKS to wherever it routes a request (the
+    fleet-global prefix cache), hands first-token streams from
+    prefill-role to decode-role replicas, and evacuates a draining
+    replica's residents to a peer — all without changing a single
+    emitted token."""
+
+    def test_affinity_key_is_the_trie_block_key(self):
+        """Satellite pin: the router's affinity key and the engine
+        trie's block identity share ONE key function — same block
+        granularity (BLOCK_TOKENS == PAGE_ROWS), same sub-block
+        None, and `prefix_key` IS `route_key`."""
+        from walkai_nos_tpu.models.block_key import (
+            BLOCK_TOKENS,
+            chain_hashes,
+            route_key,
+        )
+
+        assert BLOCK_TOKENS == PAGE_ROWS
+        p = _template(0)
+        assert prefix_key(p) == route_key(p)
+        assert prefix_key(p[: PAGE_ROWS - 1]) is None
+        # One full block -> one path hash; the router can name an
+        # engine's trie blocks from the prompt alone.
+        assert len(chain_hashes(p)) == 1
+
+    def test_repoint_ships_blocks_ahead_of_the_request(self, fleet):
+        """The global-cache win, pinned deterministically: a template
+        warm on r0 whose affinity re-points to r1 has its blocks
+        SHIPPED to r1 before the request is submitted there — r1's
+        admission hits on a block it never prefilled, and the tokens
+        are identical to the warm replica's."""
+        _, make = fleet
+        router = FleetRouter([make("ship0"), make("ship1")], seed=0)
+        p = _template(0)  # 136 tokens -> 1 shareable block
+        key = prefix_key(p)
+        first = router.submit(p, max_new_tokens=4)
+        out0 = router.run()
+        home = router._block_home[key]
+        cold = next(
+            h for h in router.active_handles() if h is not home
+        )
+        assert cold.replica.engine.prefix_stats()["block_hits"] == 0
+        router._affinity[key] = cold  # forced re-point
+        second = router.submit(p, max_new_tokens=4)
+        out1 = router.run()
+        assert out1[second] == out0[first]
+        assert int(router.obs.xfer_ships.value(
+            labels={"outcome": "ok"}
+        )) == 1
+        assert int(router.obs.xfer_blocks_shipped.value()) == 1
+        # The cold replica hit on a block it never prefilled.
+        assert cold.replica.engine.prefix_stats()["block_hits"] == 1
+
+    def test_transfer_plane_is_noop_for_bare_replicas(self):
+        """Replicas without the export/import surface (HTTP pods
+        behind old servers, scripted fakes) opt out silently: the
+        ship path never fires and routing is unchanged."""
+        fakes = [FakeReplica("bare0"), FakeReplica("bare1")]
+        router = FleetRouter(fakes, seed=0)
+        p = _template(3)
+        router.submit(p, max_new_tokens=4)
+        h1 = next(
+            h for h in router.active_handles()
+            if h.replica is fakes[1]
+        )
+        router._affinity[prefix_key(p)] = h1
+        router.submit(p, max_new_tokens=4)
+        router.run()
+        for outcome in ("ok", "empty", "error"):
+            assert router.obs.xfer_ships.value(
+                labels={"outcome": outcome}
+            ) == 0
+        # Drain with migration requested is equally a no-op.
+        router.start_drain(h1, migrate=True)
+        assert h1.replica.draining
+
+    def test_two_stage_handoff_token_identity(self, fleet):
+        """Role-split fleet (1 prefill + 1 decode): every prompt
+        lands on the prefill replica, its stream moves to the decode
+        replica at the first committed token, and the finished
+        records — collected from the DECODE replica under the
+        original router rids — are token-identical to one engine."""
+        _, make = fleet
+        single = make("tsref").engine
+        prompts = [_template(40 + i) for i in range(2)]
+        expected = {}
+        for i, p in enumerate(prompts):
+            rid = single.submit(p, max_new_tokens=12)
+            expected[i] = single.run()[rid]
+        pf, dc = make("pf0"), make("dc0")
+        router = FleetRouter(seed=0)
+        router.add_replica(pf, role="prefill")
+        router.add_replica(dc, role="decode")
+        assert router.disaggregated
+        rids = {
+            router.submit(p, max_new_tokens=12): i
+            for i, p in enumerate(prompts)
+        }
+        records = {}
+        while router.has_work:
+            router.step()
+            records.update(router.drain_done_records())
+        records.update(router.drain_done_records())
+        assert sorted(records) == sorted(rids)
+        assert int(router.obs.xfer_migrations.value(
+            labels={"outcome": "decode"}
+        )) >= 1
+        for rid, rec in records.items():
+            assert rec["tokens"] == expected[rids[rid]], (
+                "stage handoff changed a request's tokens"
+            )
+        # At least one stream finished on the decode replica.
+        assert any(r["replica"] == "dc0" for r in records.values())
+
+    def test_drain_migration_evacuates_to_peer(self, fleet):
+        """start_drain on a replica holding live streams moves them
+        to the peer instead of waiting them out: the victim is empty
+        IMMEDIATELY after the drain call, and every request finishes
+        token-identical to an uninterrupted engine."""
+        _, make = fleet
+        single = make("dmref").engine
+        prompts = [_template(60 + i) for i in range(2)]
+        expected = {}
+        for i, p in enumerate(prompts):
+            rid = single.submit(p, max_new_tokens=12)
+            expected[i] = single.run()[rid]
+        replicas = [make("dm0"), make("dm1")]
+        router = FleetRouter(replicas, seed=0)
+        rids = {
+            router.submit(p, max_new_tokens=12): i
+            for i, p in enumerate(prompts)
+        }
+        records = {}
+        for _ in range(2):
+            router.step()
+            records.update(router.drain_done_records())
+        victim = router._routes[next(iter(rids))][0]
+        assert victim.replica.has_work
+        router.start_drain(victim)
+        assert not victim.replica.has_work  # evacuated, not awaited
+        assert int(router.obs.xfer_migrations.value(
+            labels={"outcome": "moved"}
+        )) >= 1
+        while router.has_work:
+            router.step()
+            records.update(router.drain_done_records())
+        records.update(router.drain_done_records())
+        assert sorted(records) == sorted(rids)
+        for rid, rec in records.items():
+            assert rec["tokens"] == expected[rids[rid]], (
+                "drain migration changed a request's tokens"
+            )
+
+    def test_capture_digests_disagg_equals_colocated(
+        self, fleet, tmp_path
+    ):
+        """The acceptance claim through the PR-15 capture plane: a
+        disaggregated fleet (prefill/decode split + a mid-run
+        drained-replica migration) serves mixed ragged traffic with
+        per-request token digests IDENTICAL to the colocated fleet's
+        capture — the replay artifact proves migrated streams
+        bit-exact, not just the in-memory records."""
+        _, make = fleet
+        rng = np.random.default_rng(3)
+        bases = [_template(80 + t, extra=0) for t in range(2)]
+        prompts = []
+        for i in range(6):
+            tail = rng.integers(0, 64, 4 + 3 * (i % 3)).astype(
+                np.int32
+            )
+            prompts.append(np.concatenate([bases[i % 2], tail]))
+        prompts.append(_prompt_short())
+
+        def digests(capture_dir):
+            from walkai_nos_tpu.obs.capture import CaptureLog
+
+            text = CaptureLog(str(capture_dir)).read_text()
+            out = {}
+            for line in text.splitlines():
+                rec = json.loads(line)
+                if rec.get("kind") == "done":
+                    out[rec["rid"]] = rec["digest"]
+            return out
+
+        co_dir = tmp_path / "colocated"
+        router = FleetRouter(
+            [make("co0"), make("co1")], seed=0,
+            capture=str(co_dir),
+        )
+        for p in prompts:
+            router.submit(p, max_new_tokens=12)
+        router.run()
+        co = digests(co_dir)
+
+        dis_dir = tmp_path / "disagg"
+        dis = FleetRouter(seed=0, capture=str(dis_dir))
+        dis.add_replica(make("dg0"), role="prefill")
+        handles = {}
+        for name in ("dg1", "dg2"):
+            dis.add_replica(make(name), role="decode")
+        for h in dis.active_handles():
+            handles[h.name] = h
+        for p in prompts:
+            dis.submit(p, max_new_tokens=12)
+        # Step until a decode replica holds migrated streams, then
+        # drain it mid-run — its residents move AGAIN, to a peer.
+        drained = False
+        for _ in range(40):
+            dis.step()
+            if not drained:
+                busy = next(
+                    (
+                        h for h in dis.active_handles()
+                        if h.role == "decode"
+                        and h.replica.has_work
+                    ),
+                    None,
+                )
+                if busy is not None:
+                    dis.start_drain(busy)
+                    drained = True
+            if not dis.has_work:
+                break
+        assert drained, "no decode replica ever held a stream"
+        dis.run()
+        assert int(dis.obs.xfer_migrations.value(
+            labels={"outcome": "decode"}
+        )) >= 1
+        moved = dis.obs.xfer_migrations.value(
+            labels={"outcome": "moved"}
+        ) + dis.obs.xfer_migrations.value(
+            labels={"outcome": "returned"}
+        )
+        assert moved >= 1, "the drain never migrated a resident"
+        di = digests(dis_dir)
+        assert sorted(di) == sorted(co)
+        for rid, digest in co.items():
+            assert digest is not None
+            assert di[rid] == digest, (
+                f"rid {rid}: disaggregated digest diverged"
+            )
+
+
+def _prompt_short(seed=9, n=20):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 64, n).astype(np.int32)
+
+
 @pytest.mark.slow
 class TestTrafficBench:
     """The full traffic-replay harness (diurnal + flash-crowd +
@@ -664,6 +925,40 @@ class TestTrafficBench:
         assert "router_ttft_p99_under_surge" in keys
         assert keys["router_scale_events_total"] == 0
         assert len(result.per_request_tokens) == 24
+
+    def test_disagg_arm_token_identical_and_wins_hit_rate(
+        self, fleet
+    ):
+        """The disaggregation arm of the SAME replay: a role-split
+        prefill/decode fleet with block shipping completes every
+        request token-identical to the colocated arm, and the
+        fleet-global cache beats both round-robin and
+        per-replica-cache (ship_blocks=False) affinity on the Zipf
+        trace's prefix hit rate."""
+        params, _ = fleet
+        result = run_traffic_benchmark(
+            n_replicas=2, requests=24, templates=4, ticks=12,
+            slots=2, max_new=4, seed=0, cfg=CFG, params=params,
+            compare_disaggregated=True,
+        )
+        assert result.disagg_completed == result.requests == 24
+        assert (
+            result.disagg_per_request_tokens
+            == result.per_request_tokens
+        ), "disaggregation changed request tokens"
+        assert (
+            result.disagg_prefix_hit_rate > result.rr_prefix_hit_rate
+        )
+        assert (
+            result.disagg_prefix_hit_rate
+            >= result.noship_prefix_hit_rate
+        )
+        keys = result.bench_keys()
+        assert "router_disagg_ttft_p99" in keys
+        assert keys["router_disagg_prefix_hit_rate"] == pytest.approx(
+            result.disagg_prefix_hit_rate, abs=1e-4
+        )
+        assert "router_noship_prefix_hit_rate" in keys
 
 
 class TestServerouterEndpoints:

@@ -53,7 +53,10 @@ docs/compute-runtime.md.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
+
+from walkai_nos_tpu.models.block_key import block_key
 
 __all__ = ["PrefixIndex", "PrefixNode"]
 
@@ -108,8 +111,14 @@ class PrefixIndex:
         return max(0, (prompt_len - 1) // self.block_tokens)
 
     def _keys(self, prompt, n: int) -> list[bytes]:
+        # The shared key function (`models/block_key.py`): the SAME
+        # canonical bytes the router's affinity key and the
+        # block-transfer hashes are built from, so routing and
+        # transfer identity can never drift from the trie's.
         bt = self.block_tokens
-        return [prompt[i * bt:(i + 1) * bt].tobytes() for i in range(n)]
+        return [
+            block_key(prompt[i * bt:(i + 1) * bt]) for i in range(n)
+        ]
 
     def match(self, prompt) -> list[PrefixNode]:
         """Longest READY path of full prompt blocks, root-first. Pure
@@ -208,6 +217,59 @@ class PrefixIndex:
                 self._push(parent)
             return node.block
         return None
+
+    # -- block transfer (export/import) --------------------------------
+
+    def hashed_nodes(self):
+        """Yield (path_hash, node) for every node, parents before
+        children — the trie side of the transferable block identity
+        (`models/block_key.py`): each hash is the cumulative digest
+        of every key on the node's root path, so it names (absolute
+        position, entire prefix) exactly like the node itself. Used
+        by `export_blocks` to resolve requested hashes and by
+        `import_blocks` to dedup against blocks already present."""
+        stack = [(self._root, hashlib.sha1())]
+        while stack:
+            node, h = stack.pop()
+            for key, child in node.children.items():
+                ch = h.copy()
+                ch.update(key)
+                yield ch.hexdigest()[:16], child
+                stack.append((child, ch))
+
+    def graft(self, parent: PrefixNode | None, key: bytes,
+              block: int) -> PrefixNode | None:
+        """Attach ONE imported block under `parent` (None = root) as a
+        node owned by the importer (refcount 1, NOT ready — the caller
+        flips it with `mark_ready` once the K/V tiles have landed in
+        the pool, then `release`s its pin so the node parks,
+        matchable and evictable, indistinguishable from a
+        locally-prefilled-then-released block). Returns None when the
+        key is already present under `parent` (duplicate import — the
+        caller returns its grabbed block to the free list)."""
+        parent = parent or self._root
+        if key in parent.children:
+            return None
+        node = PrefixNode(key, block, parent, parent.depth + 1,
+                          self._tick())
+        node.refcount = 1
+        parent.children[key] = node
+        self._nodes += 1
+        return node
+
+    def discard(self, node: PrefixNode) -> None:
+        """Unlink a LEAF node the caller still owns (refcount 1, e.g.
+        an inserted-but-never-written node of a prefill being migrated
+        away) — the block returns to the caller, not the LRU order.
+        Children-bearing nodes must be discarded leaf-first."""
+        if node.children:
+            raise ValueError("discard requires a leaf node")
+        node.parent.children.pop(node.key, None)
+        node.parent = None
+        node.stamp += 1
+        self._nodes -= 1
+        if node.refcount == 0:
+            self._parked -= 1
 
     # -- stats ---------------------------------------------------------
 

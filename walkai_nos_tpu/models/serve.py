@@ -148,6 +148,7 @@ the serving-side engine of the TPU compute runtime.
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import functools
 import time
@@ -160,6 +161,7 @@ import numpy as np
 
 from jax.sharding import NamedSharding, PartitionSpec
 
+from walkai_nos_tpu.models.block_key import block_key
 from walkai_nos_tpu.models.block_pool import BlockPool
 from walkai_nos_tpu.models.decode import sample_rows
 from walkai_nos_tpu.models.lm import (
@@ -1496,6 +1498,26 @@ class ContinuousBatcher:
         block's drain-lifecycle bit)."""
         return self._draining
 
+    def drain_stats(self) -> dict:
+        """Drain-down progress for `/healthz` and the fleet
+        reconciler: resident slots, queued/prefilling counts, and the
+        blocks live requests still hold — the numbers that converge
+        to zero as a drain (or a resident-state migration) empties
+        the engine, watchable without a full `/stats` scrape."""
+        resident = sum(
+            1 for r in self._slot_req
+            if r is not None and not r.done
+        )
+        return {
+            "draining": self._draining,
+            "resident_slots": resident,
+            "prefilling": len(self._prefilling),
+            "queued": len(self._pending),
+            "blocks_remaining": (
+                self._blocks_allocated() if self.paged else 0
+            ),
+        }
+
     def drain_done(self) -> dict[int, list[int]]:
         """Pop and return every finished request's tokens (for callers
         driving `step()` themselves, e.g. a serving thread fulfilling
@@ -1856,6 +1878,765 @@ class ContinuousBatcher:
             self.step()
             out.update(self.drain_done())
         out.update(self.drain_done())
+        return out
+
+    # -- KV block transfer (export/import) -----------------------------
+    #
+    # The fleet's global-prefix-cache plane: full prompt blocks leave
+    # one engine and land in another BY CONTENT HASH (the shared path
+    # identity of `models/block_key.py`), making a template warmed
+    # anywhere a copy everywhere. Tiles ship dtype-tagged and
+    # normalized to the BASE kv-head count, so a tp=N engine (whose
+    # pool may hold head-replicated expansions) exchanges blocks with
+    # a tp=M one: export downselects each replicated head group to
+    # its base head, import re-expands by its own replication factor.
+    # The payload is JSON-safe (b64 tile bytes), so the in-process
+    # form IS the `/blocks` wire form.
+
+    def _xfer_header(self) -> dict:
+        """Compatibility header every transfer payload carries: the
+        fields two engines must agree on for a block's bytes to mean
+        the same thing in both pools."""
+        base = (
+            self._fp_cfg.get("num_kv_heads")
+            or self._fp_cfg["num_heads"]
+        )
+        return {
+            "version": 1,
+            "kv_dtype": str(self.cfg.kv_storage_dtype),
+            "kv_heads": int(base),
+            "head_dim": self.cfg.hidden_dim // self.cfg.num_heads,
+            "layers": self.cfg.num_layers,
+            "quant": bool(self.cfg.kv_quant),
+            "block_tokens": PAGE_ROWS,
+            "spec": self._spec,
+        }
+
+    def _check_xfer_header(self, payload: dict) -> str | None:
+        """First mismatching header field's name (the rejection
+        reason), or None when the payload is compatible."""
+        mine = self._xfer_header()
+        for field_name, value in mine.items():
+            if payload.get(field_name) != value:
+                return field_name
+        return None
+
+    @property
+    def _head_rep(self) -> int:
+        """Head-replication factor of THIS engine's pools: served
+        kv-heads over the caller's base count (1 except at
+        tp > kv_heads, where `expand_kv_heads` repeated each base
+        head `rep` times consecutively along the head axis)."""
+        base = (
+            self._fp_cfg.get("num_kv_heads")
+            or self._fp_cfg["num_heads"]
+        )
+        return self.cfg.kv_heads // int(base)
+
+    def _kv_leaves(self, cache):
+        """Flatten a cache tree; returns (leaves, treedef, [(leaf
+        index, name)] of the paged K/V pool leaves — data and scale
+        tiles — in deterministic flatten order, the order tiles are
+        serialized and paired in)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+        leaves = [leaf for _, leaf in flat]
+        kv = []
+        for i, (path, _) in enumerate(flat):
+            name = ""
+            if path:
+                last = path[-1]
+                name = getattr(
+                    last, "key", getattr(last, "name", str(last))
+                )
+            if name in shardlib._CACHE_KV_LEAVES:
+                kv.append((i, name))
+        return leaves, treedef, kv
+
+    def _gather_tiles(self, cache, bids: list[int], rep: int) -> list[dict]:
+        """Serialize pool blocks `bids` from every K/V leaf of
+        `cache`: one JSON-safe record per leaf, each an array stacked
+        over the blocks ([n, heads, PAGE_ROWS(, head_dim)]). `rep` > 1
+        downselects head-replicated pools to their base heads (every
+        rep-th head — the consecutive-repeat layout's base copy). On
+        a sharded pool the gather pulls full global heads host-side."""
+        leaves, _, kv = self._kv_leaves(cache)
+        idx = self._dev(np.asarray(bids, np.int32))
+        out = []
+        for i, name in kv:
+            tile = np.asarray(leaves[i][idx])
+            if rep > 1:
+                tile = tile[:, ::rep]
+            tile = np.ascontiguousarray(tile)
+            out.append({
+                "name": name,
+                "dtype": tile.dtype.name,
+                "shape": list(tile.shape),
+                "data": base64.b64encode(tile.tobytes()).decode("ascii"),
+            })
+        return out
+
+    @staticmethod
+    def _decode_tile(t: dict) -> np.ndarray:
+        try:
+            dt = np.dtype(str(t["dtype"]))
+        except TypeError:
+            import ml_dtypes
+            dt = np.dtype(getattr(ml_dtypes, str(t["dtype"])))
+        return np.frombuffer(
+            base64.b64decode(t["data"]), dtype=dt
+        ).reshape([int(d) for d in t["shape"]])
+
+    def _tiles_compatible(
+        self, tile_arrs: list, d_arrs: list, n: int
+    ) -> str | None:
+        """Validate decoded tile arrays against this engine's own
+        pool layout (`n` = payload block count). Returns a rejection
+        reason or None."""
+        base_heads = int(self._xfer_header()["kv_heads"])
+        leaves, _, kv = self._kv_leaves(self._state[0])
+        if len(tile_arrs) != len(kv):
+            return "shape"
+        for (i, _), arr in zip(kv, tile_arrs):
+            leaf = leaves[i]
+            if tuple(arr.shape) != (n, base_heads) + tuple(leaf.shape[2:]):
+                return "shape"
+            if arr.dtype != np.dtype(leaf.dtype):
+                return "dtype"
+        if self._spec:
+            leaves, _, kv = self._kv_leaves(self._d_cache)
+            if len(d_arrs) != len(kv):
+                return "draft"
+            for (i, _), arr in zip(kv, d_arrs):
+                leaf = leaves[i]
+                if tuple(arr.shape) != (n,) + tuple(leaf.shape[1:]):
+                    return "draft"
+                if arr.dtype != np.dtype(leaf.dtype):
+                    return "draft"
+        return None
+
+    def _scatter_tiles(self, cache, tile_arrs, rows, bids, rep: int):
+        """Land tile rows `rows` of the decoded payload arrays into
+        pool blocks `bids` of `cache` (one batched scatter per K/V
+        leaf); `rep` > 1 re-expands base heads to this engine's
+        head-replicated layout. Returns the updated cache tree."""
+        leaves, treedef, kv = self._kv_leaves(cache)
+        idx = self._dev(np.asarray(bids, np.int32))
+        for (i, _), arr in zip(kv, tile_arrs):
+            vals = arr[np.asarray(rows, np.intp)]
+            if rep > 1:
+                vals = np.repeat(vals, rep, axis=1)
+            leaves[i] = leaves[i].at[idx].set(self._dev(vals))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def export_blocks(self, hashes) -> dict:
+        """Serialize the READY prefix-index blocks named by `hashes`
+        (path hashes — `models/block_key.chain_hashes` of the prompt,
+        or another engine's `hashed_nodes`) into a JSON-safe payload:
+        per block its token bytes + parent linkage, plus the K/V (and
+        int8 scale) tiles of every layer, dtype-tagged and normalized
+        to base kv-heads. Unknown or not-yet-ready hashes are simply
+        omitted — the importer treats the payload as best-effort."""
+        if not self.paged or self._prefix is None:
+            raise RuntimeError(
+                "export_blocks requires the paged engine with "
+                "prefix_cache enabled"
+            )
+        by_hash: dict[str, object] = {}
+        by_node: dict[int, str] = {}
+        for hx, node in self._prefix.hashed_nodes():
+            by_hash[hx] = node
+            by_node[id(node)] = hx
+        records: list[dict] = []
+        bids: list[int] = []
+        seen: set[str] = set()
+        for hx in hashes:
+            node = by_hash.get(hx)
+            if node is None or not node.ready or hx in seen:
+                continue
+            seen.add(hx)
+            records.append({
+                "hash": hx,
+                "parent": by_node.get(id(node.parent)),
+                "depth": node.depth,
+                "tokens": np.frombuffer(node.key, np.int32).tolist(),
+            })
+            bids.append(node.block)
+        payload = {
+            **self._xfer_header(),
+            "kind": "blocks",
+            "blocks": records,
+            "tiles": [],
+            "draft_tiles": [],
+        }
+        if records:
+            payload["tiles"] = self._gather_tiles(
+                self._state[0], bids, self._head_rep
+            )
+            if self._spec:
+                payload["draft_tiles"] = self._gather_tiles(
+                    self._d_cache, bids, 1
+                )
+        nbytes = sum(
+            len(t["data"])
+            for t in payload["tiles"] + payload["draft_tiles"]
+        ) * 3 // 4
+        self.obs.xfer_exported.inc(len(records))
+        if nbytes:
+            self.obs.xfer_bytes.inc(nbytes, {"dir": "out"})
+        self.obs.trace.event(
+            "export_blocks", time.monotonic(), blocks=len(records)
+        )
+        return payload
+
+    def import_blocks(self, payload: dict) -> dict:
+        """Land an `export_blocks` payload in this engine's pool +
+        trie through the existing admission seams: each accepted
+        block is allocated via `grab_block` (free list first, then
+        LRU-evict-under-pressure — an import NEVER overflows the
+        pool, it competes like any admission), grafted under its
+        parent (refcount 1, not ready), written tile-by-tile, then
+        marked ready and released so it PARKS — matchable and
+        evictable, indistinguishable from a locally-prefilled block.
+        Free-list blocks an import consumes become parked blocks, so
+        `available()` — and with it the admission reservation
+        invariant — is preserved by construction.
+
+        Returns {"imported": n, "rejected": {reason: count}} with
+        reasons `dup` (already present), `orphan` (parent not
+        resident here), `dry` (pool truly exhausted), or a header
+        field name / `shape` / `dtype` / `draft` for incompatible
+        payloads (which reject whole)."""
+        if not self.paged or self._prefix is None:
+            raise RuntimeError(
+                "import_blocks requires the paged engine with "
+                "prefix_cache enabled"
+            )
+        rejected: dict[str, int] = {}
+
+        def rej(reason: str, n: int = 1) -> None:
+            rejected[reason] = rejected.get(reason, 0) + n
+
+        records = payload.get("blocks", [])
+        bad = self._check_xfer_header(payload)
+        tile_arrs: list = []
+        d_arrs: list = []
+        if bad is None and records:
+            tile_arrs = [
+                self._decode_tile(t) for t in payload.get("tiles", [])
+            ]
+            d_arrs = [
+                self._decode_tile(t)
+                for t in payload.get("draft_tiles", [])
+            ]
+            bad = self._tiles_compatible(
+                tile_arrs, d_arrs, len(records)
+            )
+        if bad is not None:
+            rej(bad, len(records))
+            for reason, n in rejected.items():
+                self.obs.xfer_rejected.inc(n, {"reason": reason})
+            return {"imported": 0, "rejected": rejected}
+        mine = dict(self._prefix.hashed_nodes())
+        row_of = {r["hash"]: j for j, r in enumerate(records)}
+        accepted: list[tuple[int, object, int]] = []
+        for r in sorted(records, key=lambda r: r["depth"]):
+            hx = r["hash"]
+            if hx in mine:
+                rej("dup")
+                continue
+            parent = None
+            if r.get("parent") is not None:
+                parent = mine.get(r["parent"])
+                if parent is None or parent.parent is None:
+                    # Unknown here — or evicted by an earlier grab
+                    # in this very import (detached nodes have
+                    # parent None).
+                    rej("orphan")
+                    continue
+            elif r["depth"] != 1:
+                rej("orphan")
+                continue
+            block = self.pool.grab_block()
+            if block is None:
+                rej("dry")
+                continue
+            node = self._prefix.graft(
+                parent, block_key(r["tokens"]), block
+            )
+            if node is None:
+                self.pool.free_blocks.append(block)
+                rej("dup")
+                continue
+            mine[hx] = node
+            accepted.append((row_of[hx], node, block))
+        if accepted:
+            rows = [a[0] for a in accepted]
+            bids = [a[2] for a in accepted]
+            cache = self._scatter_tiles(
+                self._state[0], tile_arrs, rows, bids, self._head_rep
+            )
+            self._state = (cache,) + self._state[1:]
+            if self._spec:
+                self._d_cache = self._scatter_tiles(
+                    self._d_cache, d_arrs, rows, bids, 1
+                )
+            # Visible only after the tiles landed: mark ready, then
+            # drop the import's pin so each block parks (refcount 0,
+            # LRU) exactly like a released local prefix block.
+            for _, node, _ in accepted:
+                self._prefix.mark_ready(node)
+                self._prefix.release(node)
+            self.obs.prefix_cached_tokens.set(
+                self._prefix.cached_tokens
+            )
+            self.pool.set_gauges()
+            nbytes = sum(
+                len(t["data"])
+                for t in payload.get("tiles", [])
+                + payload.get("draft_tiles", [])
+            ) * 3 // 4
+            if nbytes:
+                self.obs.xfer_bytes.inc(nbytes, {"dir": "in"})
+        self.obs.xfer_imported.inc(len(accepted))
+        for reason, n in rejected.items():
+            self.obs.xfer_rejected.inc(n, {"reason": reason})
+        self.obs.trace.event(
+            "import_blocks", time.monotonic(), blocks=len(accepted)
+        )
+        return {"imported": len(accepted), "rejected": rejected}
+
+    # -- live request migration (the drain-down path) ------------------
+
+    def decode_ready_rids(self) -> list[int]:
+        """Live requests that have committed at least one token —
+        done with prefill, migratable as full slot restorations. The
+        two-stage router's handoff probe: on a prefill-role replica
+        these are exactly the requests whose decode belongs
+        elsewhere."""
+        return [
+            req.rid
+            for req in self._slot_req
+            if req is not None and not req.done and req.tokens
+        ]
+
+    def export_resident(self, only=None) -> dict:
+        """Evacuate accepted requests into a JSON-safe payload a
+        peer engine can restore with `import_resident` — the
+        autoscaler's zero-drop drain-down: a draining replica ships
+        its resident work instead of waiting for it to finish.
+
+        `only` (a collection of rids) restricts the export to THOSE
+        live decode-ready slots, leaving queued and mid-prefill work
+        untouched — the two-stage handoff: a prefill replica ships
+        each request the moment its first token commits, keeping its
+        lanes full of prefill work only.
+
+        Requests that have emitted no host-visible token (queued,
+        mid-prefill, or flipped-but-unsynced) travel as RESUBMITS:
+        their whole stream is still a deterministic function of
+        (weights, prompt, knobs, effective seed), so the target just
+        submits them afresh. Live slots with committed tokens travel
+        as full MIGRATIONS: prompt + tokens + remaining budget +
+        sampling knobs + the slot's ACTUAL device PRNG key (the
+        per-token split protocol's surviving state — exact, not
+        reconstructed) + the K/V tiles of every block up to the write
+        head (the partial last block included; rows past the head are
+        invisible until overwritten). The source releases everything
+        it exports, so `has_work` converges without waiting."""
+        if not self.paged:
+            raise RuntimeError(
+                "export_resident requires the paged engine"
+            )
+        if self._inflight is not None:
+            self._process(*self._inflight)
+            self._inflight = None
+        now = time.monotonic()
+
+        def resubmit_state(req: _Request) -> dict:
+            return {
+                "prompt": req.prompt.tolist(),
+                "max_new_tokens": int(req.max_new_tokens),
+                "eos_id": req.eos_id,
+                "temperature": float(req.temperature),
+                "top_k": int(req.top_k),
+                "top_p": float(req.top_p),
+                "seed": int(req.seed),
+                "trace_id": req.trace_id,
+            }
+
+        resubmit: list[dict] = []
+        migrate: list[dict] = []
+        only = None if only is None else set(only)
+        while only is None and self._pending:
+            req = self._pending.popleft()
+            resubmit.append(resubmit_state(req))
+            del self._requests[req.rid]
+        if only is None:
+            self.obs.queue_depth.set(0)
+        for entry in [] if only is not None else list(self._prefilling):
+            # A mid-prefill request re-prefills at the target (its
+            # lane work here is wasted, never wrong): unlink its
+            # UNWRITTEN inserted nodes and reclaim their blocks,
+            # drop its pins on written/matched prefix nodes (they
+            # park), free its private tail, release the reservation.
+            self._prefilling.remove(entry)
+            req = entry.req
+            nm = entry.cached // PAGE_ROWS
+            ins = entry.nodes[nm:]
+            n_ready = len(ins) - len(entry.pending)
+            for node in reversed(ins[n_ready:]):
+                self._prefix.discard(node)
+                self.pool.free_blocks.append(node.block)
+            for node in ins[:n_ready] + entry.nodes[:nm]:
+                self._prefix.release(node)
+            self.pool.free_blocks.extend(entry.blocks[nm + len(ins):])
+            self.pool.reserved -= entry.resv
+            resubmit.append(resubmit_state(req))
+            del self._requests[req.rid]
+        if only is None:
+            self.obs.lane_active.set(0)
+        keys_host = np.asarray(self._state[5])
+        migrate_slots: list[int] = []
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None or req.done:
+                continue
+            if only is not None and (req.rid not in only or not req.tokens):
+                continue
+            if not req.tokens:
+                # Flipped live but no token committed yet — the
+                # stream is still fully determined by the submit
+                # inputs; ship it as a resubmit and free the slot.
+                resubmit.append(resubmit_state(req))
+                del self._requests[req.rid]
+                self._slot_req[s] = None
+                self._slot_new[s] = False
+                self._budget[s] = 0
+                self._release_slot(s)
+                continue
+            migrate_slots.append(s)
+        bids: list[int] = []
+        for s in migrate_slots:
+            req = self._slot_req[s]
+            # The write head: the LAST committed token is the next
+            # step's INPUT — it lives in the token vector, its cache
+            # row is written when it's fed. Rows [0, pos) are
+            # written; row `pos` is garbage until the target's next
+            # dispatch overwrites it (writes precede reads).
+            pos = len(req.prompt) + len(req.tokens) - 1
+            # A truncated request's budget was capped to its BACKED
+            # rows and the target must never re-back it: ship every
+            # block the capped budget's writes touch up front.
+            rows = pos + int(self._budget[s]) if req.truncated else pos
+            nblk = -(-rows // PAGE_ROWS)
+            migrate.append({
+                **resubmit_state(req),
+                "tokens": [int(t) for t in req.tokens],
+                "remaining": int(self._budget[s]),
+                "truncated": bool(req.truncated),
+                "age_s": round(now - req.submitted_at, 6),
+                "ttft_s": round(
+                    req.first_token_at - req.submitted_at, 6
+                ),
+                "key": [int(v) for v in keys_host[s]],
+                "tile_row": len(bids),
+                "n_blocks": nblk,
+            })
+            bids.extend(self.pool.slot_blocks[s][:nblk])
+        payload = {
+            **self._xfer_header(),
+            "kind": "resident",
+            "resubmit": resubmit,
+            "migrate": migrate,
+            "tiles": [],
+            "draft_tiles": [],
+        }
+        if bids:
+            payload["tiles"] = self._gather_tiles(
+                self._state[0], bids, self._head_rep
+            )
+            if self._spec:
+                payload["draft_tiles"] = self._gather_tiles(
+                    self._d_cache, bids, 1
+                )
+        # Release the migrated slots only AFTER their tiles are
+        # host-side (release parks/frees their blocks for reuse).
+        for s in migrate_slots:
+            req = self._slot_req[s]
+            del self._requests[req.rid]
+            self._slot_req[s] = None
+            self._slot_new[s] = False
+            self._budget[s] = 0
+            self._release_slot(s)
+        n = len(resubmit) + len(migrate)
+        if n:
+            self.obs.xfer_migrated.inc(n, {"dir": "out"})
+        self.obs.trace.event(
+            "export_resident", time.monotonic(),
+            requests=n, migrated=len(migrate),
+        )
+        return payload
+
+    def import_resident(self, payload: dict) -> list[dict]:
+        """Restore an `export_resident` payload: resubmit entries go
+        through the normal `submit` path (the drain gate is bypassed
+        — restoring already-accepted work is not new traffic, which
+        is what lets a router fall a failed migration back onto its
+        DRAINING source); migrate entries land in free slots with
+        their blocks, write head, sampling knobs, and PRNG key
+        restored exactly, their token lists pre-filled so the final
+        completion digest covers the WHOLE stream (the capture-digest
+        equality that proves migration changed nothing), and their
+        prompt's full blocks re-registered in the trie (matched
+        prefix blocks are reused instead of rewritten).
+
+        All-or-nothing on capacity: free slots and pool blocks are
+        pre-checked (conservatively — prefix matches only help)
+        before anything mutates, so a raise leaves this engine
+        untouched and the whole payload re-importable elsewhere.
+        Returns [{"rid", "trace_id", "migrated"}] for the router's
+        route remapping."""
+        if not self.paged:
+            raise RuntimeError(
+                "import_resident requires the paged engine"
+            )
+        bad = self._check_xfer_header(payload)
+        migrate = payload.get("migrate", [])
+        resubmit = payload.get("resubmit", [])
+        tile_arrs: list = []
+        d_arrs: list = []
+        n_rows = sum(int(m["n_blocks"]) for m in migrate)
+        if bad is None and n_rows:
+            tile_arrs = [
+                self._decode_tile(t) for t in payload.get("tiles", [])
+            ]
+            d_arrs = [
+                self._decode_tile(t)
+                for t in payload.get("draft_tiles", [])
+            ]
+            bad = self._tiles_compatible(tile_arrs, d_arrs, n_rows)
+        if bad is not None:
+            raise RuntimeError(
+                f"incompatible resident payload: {bad}"
+            )
+        busy = {p.slot for p in self._prefilling}
+        free_slots = [
+            s for s in range(self.slots)
+            if self._slot_req[s] is None and s not in busy
+        ]
+        if len(free_slots) < len(migrate):
+            raise RuntimeError(
+                f"import_resident needs {len(migrate)} free slots; "
+                f"{len(free_slots)} available"
+            )
+        need = sum(
+            self._blocks_needed(
+                len(m["prompt"]), int(m["max_new_tokens"])
+            )
+            for m in migrate
+        )
+        if migrate and self.pool.available() < need:
+            raise RuntimeError(
+                f"import_resident needs {need} blocks; "
+                f"{self.pool.available()} available"
+            )
+        out: list[dict] = []
+        rows_sel: list[int] = []
+        bids_sel: list[int] = []
+        new_slots: list[int] = []
+        pos_arr: list[int] = []
+        last_arr: list[int] = []
+        temp_arr: list[float] = []
+        topk_arr: list[int] = []
+        topp_arr: list[float] = []
+        key_arr: list[list[int]] = []
+        now = time.monotonic()
+        drain_flag, self._draining = self._draining, False
+        try:
+            for m in resubmit:
+                rid = self.submit(
+                    m["prompt"],
+                    max_new_tokens=int(m["max_new_tokens"]),
+                    eos_id=m["eos_id"],
+                    temperature=float(m["temperature"]),
+                    top_k=int(m["top_k"]),
+                    top_p=float(m["top_p"]),
+                    seed=int(m["seed"]),
+                    trace_id=m["trace_id"],
+                )
+                out.append({
+                    "rid": rid, "trace_id": m["trace_id"],
+                    "migrated": False,
+                })
+            for m in migrate:
+                s = free_slots.pop(0)
+                prompt = np.asarray(m["prompt"], np.int32)
+                tokens = [int(t) for t in m["tokens"]]
+                # Write head (see export_resident): the last token is
+                # the next input, its row unwritten until fed.
+                pos = len(prompt) + len(tokens) - 1
+                nblk = int(m["n_blocks"])
+                matched = (
+                    self._prefix.match(prompt)[:nblk]
+                    if self._prefix is not None else []
+                )
+                if self._prefix is not None:
+                    self._prefix.acquire(matched)
+                blocks = [node.block for node in matched]
+                while len(blocks) < nblk:
+                    block = self.pool.grab_block()
+                    if block is None:
+                        raise RuntimeError(
+                            "paged pool accounting violated during "
+                            "import_resident"
+                        )
+                    blocks.append(block)
+                total_blocks = self._blocks_needed(
+                    len(prompt), int(m["max_new_tokens"])
+                )
+                resv = (
+                    0 if m.get("truncated")
+                    else max(0, total_blocks - nblk)
+                )
+                nodes = list(matched)
+                if self._prefix is not None:
+                    walkable = self._prefix.matchable_blocks(
+                        len(prompt)
+                    )
+                    inserted = self._prefix.insert(
+                        prompt,
+                        matched[-1] if matched else None,
+                        blocks[len(matched):walkable],
+                    )
+                    # Ready immediately: their tiles land before
+                    # this call returns, and nothing dispatches in
+                    # between.
+                    for node in inserted:
+                        self._prefix.mark_ready(node)
+                    nodes += inserted
+                row0 = int(m["tile_row"])
+                for j in range(len(matched), nblk):
+                    rows_sel.append(row0 + j)
+                    bids_sel.append(blocks[j])
+                rid = self._next_rid
+                self._next_rid += 1
+                req = _Request(
+                    rid, prompt, int(m["max_new_tokens"]),
+                    m["eos_id"],
+                    temperature=float(m["temperature"]),
+                    top_k=int(m["top_k"]),
+                    top_p=float(m["top_p"]),
+                    seed=int(m["seed"]),
+                    submitted_at=now - float(m["age_s"]),
+                    trace_id=m["trace_id"],
+                )
+                req.tokens = tokens
+                req.streamed = len(tokens)
+                req.first_token_at = (
+                    req.submitted_at + float(m["ttft_s"])
+                )
+                req.truncated = bool(m.get("truncated"))
+                self._requests[rid] = req
+                self._slot_req[s] = req
+                self._slot_new[s] = False
+                self._budget[s] = int(m["remaining"])
+                self.pool.bind_slot(s, blocks, nodes, resv, pos)
+                self.pool.reserved += resv
+                new_slots.append(s)
+                pos_arr.append(pos)
+                last_arr.append(tokens[-1])
+                temp_arr.append(float(m["temperature"]))
+                topk_arr.append(int(m["top_k"]))
+                topp_arr.append(float(m["top_p"]))
+                key_arr.append([int(v) for v in m["key"]])
+                if self._capture is not None:
+                    # A fresh-submit record with the EFFECTIVE seed:
+                    # replaying it re-executes the request from the
+                    # prompt and reproduces the SAME full stream the
+                    # done record (whole-stream digest) pins.
+                    self._capture.record_submit(
+                        rid=rid,
+                        trace_id=req.trace_id,
+                        prompt=prompt.tolist(),
+                        max_new_tokens=int(m["max_new_tokens"]),
+                        eos_id=m["eos_id"],
+                        temperature=float(m["temperature"]),
+                        top_k=int(m["top_k"]),
+                        top_p=float(m["top_p"]),
+                        seed=int(m["seed"]),
+                        arrival_s=round(
+                            self._capture.arrival_offset(
+                                req.submitted_at
+                            ), 6,
+                        ),
+                    )
+                self.obs.trace.submit(
+                    rid, req.submitted_at, len(prompt),
+                    int(m["max_new_tokens"]), trace_id=req.trace_id,
+                )
+                out.append({
+                    "rid": rid, "trace_id": req.trace_id,
+                    "migrated": True,
+                })
+        finally:
+            self._draining = drain_flag
+        if new_slots:
+            sl = self._dev(np.asarray(new_slots, np.int32))
+            posv = self._dev(np.asarray(pos_arr, np.int32))
+            cache = self._state[0]
+            if rows_sel:
+                cache = self._scatter_tiles(
+                    cache, tile_arrs, rows_sel, bids_sel,
+                    self._head_rep,
+                )
+            cache = jax.tree.map(
+                lambda leaf: (
+                    leaf.at[sl].set(posv) if leaf.ndim == 1 else leaf
+                ),
+                cache,
+            )
+            self._state = (
+                cache,
+                self._state[1].at[sl].set(
+                    self._dev(np.asarray(last_arr, np.int32))
+                ),
+                self._state[2].at[sl].set(
+                    self._dev(np.asarray(temp_arr, np.float32))
+                ),
+                self._state[3].at[sl].set(
+                    self._dev(np.asarray(topk_arr, np.int32))
+                ),
+                self._state[4].at[sl].set(
+                    self._dev(np.asarray(topp_arr, np.float32))
+                ),
+                self._state[5].at[sl].set(
+                    self._dev(np.asarray(key_arr, np.uint32))
+                ),
+            )
+            if self._spec:
+                d_cache = self._d_cache
+                if rows_sel:
+                    d_cache = self._scatter_tiles(
+                        d_cache, d_arrs, rows_sel, bids_sel, 1
+                    )
+                self._d_cache = jax.tree.map(
+                    lambda leaf: (
+                        leaf.at[sl].set(posv)
+                        if leaf.ndim == 1 else leaf
+                    ),
+                    d_cache,
+                )
+            if self._prefix is not None:
+                self.obs.prefix_cached_tokens.set(
+                    self._prefix.cached_tokens
+                )
+            self.pool.set_gauges()
+        if out:
+            self.obs.xfer_migrated.inc(len(out), {"dir": "in"})
+        self.obs.trace.event(
+            "import_resident", time.monotonic(),
+            requests=len(out), migrated=len(new_slots),
+        )
         return out
 
     # -- internals -----------------------------------------------------

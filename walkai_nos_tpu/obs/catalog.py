@@ -463,6 +463,46 @@ CATALOG: tuple[MetricSpec, ...] = (
         labels=("signal",),
         attr="saturation_component",
     ),
+    # -- KV block transfer plane (models/serve.py export/import) -------
+    MetricSpec(
+        "cb_xfer_exported_blocks_total", "counter",
+        "Prefix blocks serialized out of this engine by "
+        "export_blocks (ready trie nodes only; unknown or unready "
+        "hashes are omitted, not counted)",
+        attr="xfer_exported",
+    ),
+    MetricSpec(
+        "cb_xfer_imported_blocks_total", "counter",
+        "Prefix blocks landed in this engine's pool + trie by "
+        "import_blocks (each grafted, tile-written, then parked — "
+        "matchable exactly like a locally-prefilled block)",
+        attr="xfer_imported",
+    ),
+    MetricSpec(
+        "cb_xfer_import_rejected_total", "counter",
+        "Imported blocks not landed, by reason",
+        # dup (already resident) | orphan (parent block not resident)
+        # | dry (pool exhausted even after LRU eviction) | a header
+        # field name / shape / dtype / draft (incompatible payload,
+        # rejects whole)
+        labels=("reason",),
+        attr="xfer_rejected",
+    ),
+    MetricSpec(
+        "cb_xfer_bytes_total", "counter",
+        "Decoded K/V tile bytes moved by the block-transfer plane, "
+        "by direction",
+        labels=("dir",),  # in | out
+        attr="xfer_bytes",
+    ),
+    MetricSpec(
+        "cb_xfer_migrated_requests_total", "counter",
+        "Resident requests evacuated (dir=out, export_resident) or "
+        "restored (dir=in, import_resident) by live migration — "
+        "resubmitted and slot-restored requests both count",
+        labels=("dir",),  # in | out
+        attr="xfer_migrated",
+    ),
     # -- fleet router (walkai_nos_tpu/router via obs/router.py) --------
     MetricSpec(
         "router_requests_total", "counter",
@@ -578,6 +618,42 @@ CATALOG: tuple[MetricSpec, ...] = (
         labels=("trigger",),  # anomaly | slo_breach
         component="router",
         attr="flight_dumps",
+    ),
+    # -- router block-shipping / migration (router/core.py) ------------
+    MetricSpec(
+        "router_xfer_ships_total", "counter",
+        "Block-shipping transfers the router brokered (one source "
+        "export landed in one destination import), by outcome",
+        labels=("outcome",),  # ok | empty (nothing to ship) | error
+        component="router",
+        attr="xfer_ships",
+    ),
+    MetricSpec(
+        "router_xfer_blocks_shipped_total", "counter",
+        "Prefix blocks the destination replica reported imported "
+        "across all router-brokered ships",
+        component="router",
+        attr="xfer_blocks_shipped",
+    ),
+    MetricSpec(
+        "router_xfer_failures_total", "counter",
+        "Router-brokered transfers that raised on either side, by "
+        "kind",
+        labels=("kind",),  # ship (prefix blocks) | migrate (resident)
+        component="router",
+        attr="xfer_failures",
+    ),
+    MetricSpec(
+        "router_xfer_migrations_total", "counter",
+        "Resident requests the router moved between replicas via "
+        "export_resident/import_resident, by outcome",
+        # moved (drain evacuation landed on a peer) | returned (no
+        # peer could take them; re-imported into the draining
+        # source) | decode (two-stage handoff: a prefill replica's
+        # first-token stream moved to its decode placement)
+        labels=("outcome",),
+        component="router",
+        attr="xfer_migrations",
     ),
     # -- kube binaries (kube/runtime.py via health.Metrics) ------------
     MetricSpec(

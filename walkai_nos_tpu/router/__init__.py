@@ -2,9 +2,14 @@
 partitioner control plane (ROADMAP item 4).
 
 - `router.core` — `FleetRouter`: prefix-affinity routing (first
-  128-token block hashed to the replica whose radix trie holds it)
-  with a power-of-two-choices load fallback, behind a single-engine-
-  shaped `submit()`/`step()`/`drain_done_records()` surface.
+  128-token block hashed to the replica whose radix trie holds it —
+  `models/block_key.route_key`, the trie's own block identity) with
+  a power-of-two-choices load fallback, behind a single-engine-
+  shaped `submit()`/`step()`/`drain_done_records()` surface; KV
+  block shipping makes the prefix cache fleet-global, and
+  `add_replica(role="prefill"|"decode")` turns placement two-stage
+  (disaggregated serving with first-token stream handoff and
+  migrate-first drain-down — docs/serving-router.md).
 - `router.replica` — `EngineReplica` (in-process `ContinuousBatcher`,
   CI and single host) and `HttpReplica` (remote demo-server pod) —
   one interface, two deployment shapes.

@@ -63,10 +63,10 @@ from __future__ import annotations
 
 import random
 import time
-import zlib
 
 import numpy as np
 
+from walkai_nos_tpu.models.block_key import chain_hashes, route_key
 from walkai_nos_tpu.obs.anomaly import AnomalyDetector, FlightRecorder
 from walkai_nos_tpu.obs.capture import (
     CaptureLog,
@@ -85,15 +85,14 @@ __all__ = ["FleetRouter", "prefix_key"]
 def prefix_key(prompt) -> int | None:
     """Routing key: CRC-32 of the prompt's first full 128-token block
     (PAGE_ROWS — the prefix trie's share granularity), None when the
-    prompt has no full block to share. Stable across processes (no
-    PYTHONHASHSEED dependence), so a router restart re-derives the
-    same template keys."""
-    prompt = np.asarray(prompt).reshape(-1)
-    if len(prompt) < PAGE_ROWS:
-        return None
-    return zlib.crc32(
-        prompt[:PAGE_ROWS].astype(np.int64).tobytes()
-    )
+    prompt has no full block to share. Delegates to
+    `models/block_key.route_key` so the router's affinity key and the
+    trie's block identity derive from ONE serialization of the same
+    tokens (`block_key`) — the key a block ships under is the key
+    traffic routes under. Stable across processes (no PYTHONHASHSEED
+    dependence), so a router restart re-derives the same template
+    keys."""
+    return route_key(prompt)
 
 
 class _Handle:
@@ -103,13 +102,20 @@ class _Handle:
     error counts already reflected into the counter, and the
     SLO-breach edge detector the flight recorder triggers on)."""
 
-    def __init__(self, replica, name: str):
+    def __init__(self, replica, name: str, role: str = "both"):
         self.replica = replica
         self.name = name
+        self.role = role
         self.routed = 0
         self.anomaly: dict = {"score": 0.0, "flagged": False}
         self.scrape_seen: dict[str, int] = {}
         self.slo_was_false = False
+
+    def can_prefill(self) -> bool:
+        return self.role in ("both", "prefill")
+
+    def can_decode(self) -> bool:
+        return self.role in ("both", "decode")
 
     def prefix_tallies(self) -> tuple[int, int]:
         stats = self.replica.prefix_stats() or {}
@@ -130,6 +136,7 @@ class FleetRouter:
         policy: str = "affinity",
         affinity_overload: float = 0.9,
         affinity_imbalance: float = 0.25,
+        ship_blocks: bool = True,
         seed: int = 0,
         obs: RouterObs | bool = True,
         trace: RouterTrace | None = None,
@@ -148,6 +155,10 @@ class FleetRouter:
         self.policy = policy
         self.affinity_overload = affinity_overload
         self.affinity_imbalance = affinity_imbalance
+        # Block shipping on placement moves (the fleet-global prefix
+        # cache). ship_blocks=False reverts to per-replica caches —
+        # the bench's baseline arm for the fleet-hit-rate comparison.
+        self.ship_blocks = ship_blocks
         if isinstance(obs, RouterObs):
             self.obs = obs
         else:
@@ -188,6 +199,18 @@ class FleetRouter:
         # template key -> handle (affinity map); entries for retired
         # handles are dropped lazily at lookup.
         self._affinity: dict[int, _Handle] = {}
+        # template key -> handle whose trie last received the
+        # template's blocks (by local prefill OR by an import): the
+        # fleet-global prefix-cache directory the block-shipping plane
+        # consults. In colocated mode it shadows the affinity map and
+        # only diverges on a re-point (where the ship happens); in
+        # disaggregated mode it is the only record of block locality.
+        self._block_home: dict[int, _Handle] = {}
+        # template key -> decode-stage handle (disaggregated mode):
+        # decode placement is prefix-affine even though prefill
+        # placement is pure load, so a template's shipped blocks pool
+        # on one decode replica instead of spraying the fleet.
+        self._decode_affinity: dict[int, _Handle] = {}
         self._rr_next = 0
         self._next_rid = 0
         # router rid -> (handle, local rid, trace id); completed
@@ -195,6 +218,10 @@ class FleetRouter:
         self._routes: dict[int, tuple[_Handle, int, str]] = {}
         self._local: dict[tuple[int, int], int] = {}
         self._done: dict[int, dict] = {}
+        # router rid -> affinity key, held while the request is in
+        # flight: the disaggregated decode stage places a stream by
+        # its template key at handoff time.
+        self._decode_key: dict[int, int | None] = {}
         # Prefix tallies of replicas already retired, so the fleet hit
         # rate never loses history when a slice is returned.
         self._retired_hits = 0
@@ -231,18 +258,44 @@ class FleetRouter:
 
     # -- fleet membership ----------------------------------------------
 
-    def add_replica(self, replica) -> None:
+    def add_replica(self, replica, role: str = "both") -> None:
+        """Admit a replica. `role` splits the fleet into serving
+        stages: "both" (the colocated default), "prefill" (takes new
+        requests, hands streams off at first token), or "decode"
+        (receives migrated streams only, never a cold submit). Any
+        non-"both" member flips the router into disaggregated
+        two-stage placement."""
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode'; "
+                f"got {role!r}"
+            )
         name = getattr(replica, "name", None) or f"r{self._seq}"
         self._seq += 1
-        self._handles.append(_Handle(replica, name))
+        self._handles.append(_Handle(replica, name, role=role))
         self._set_replica_gauges()
 
-    def start_drain(self, handle: _Handle) -> None:
+    @property
+    def disaggregated(self) -> bool:
+        return any(h.role != "both" for h in self._handles)
+
+    def start_drain(self, handle: _Handle, migrate: bool = True) -> None:
         """Stop routing to `handle` and ask its replica to drain
         (resident work finishes; the reconciler retires it once
-        `has_work` goes False)."""
+        `has_work` goes False). When the replica supports live
+        migration (in-process engines), its resident requests — mid-
+        decode slots, mid-prefill entries, queued work — are
+        evacuated to a peer immediately instead of running the drain
+        down, so a scale-down stops paying for the victim the moment
+        the decision lands; streams continue token-identically on the
+        destination. Replicas without the seam (HTTP pods, fakes)
+        keep the classic finish-resident-work drain."""
         handle.replica.drain()
         self._set_replica_gauges()
+        if migrate and getattr(
+            handle.replica, "supports_migration", False
+        ):
+            self._migrate_residents(handle)
 
     def retire(self, handle: _Handle) -> None:
         """Remove a fully drained handle from the fleet, folding its
@@ -255,6 +308,14 @@ class FleetRouter:
         self._handles.remove(handle)
         self._affinity = {
             k: h for k, h in self._affinity.items() if h is not handle
+        }
+        self._block_home = {
+            k: h for k, h in self._block_home.items()
+            if h is not handle
+        }
+        self._decode_affinity = {
+            k: h for k, h in self._decode_affinity.items()
+            if h is not handle
         }
         # Drop EVERY per-replica series of the retired member (and
         # its federated cb_* series vanish with the handle): the last
@@ -303,6 +364,15 @@ class FleetRouter:
 
     def _pick(self, key: int | None) -> tuple[_Handle, str]:
         candidates = self.active_handles()
+        if self.disaggregated:
+            # Two-stage placement, stage one: new requests land on
+            # prefill-capable members by pure load — affinity is the
+            # DECODE stage's concern (the stream follows its blocks
+            # there at first token); pinning prefill too would
+            # serialize a hot template's prefills on one replica for
+            # no cache gain the block-shipping plane doesn't already
+            # provide.
+            candidates = [h for h in candidates if h.can_prefill()]
         if not candidates:
             self.obs.failed.inc(labels={"reason": "no_replica"})
             raise RuntimeError(
@@ -312,7 +382,7 @@ class FleetRouter:
             handle = candidates[self._rr_next % len(candidates)]
             self._rr_next += 1
             return handle, "round_robin"
-        if key is not None:
+        if key is not None and not self.disaggregated:
             handle = self._affinity.get(key)
             if handle is not None and handle in candidates:
                 load = self._load(handle)
@@ -341,7 +411,7 @@ class FleetRouter:
         # key (if any) points here so the template's stream follows
         # the blocks it is about to warm.
         handle = self._two_choices(candidates)
-        if key is not None:
+        if key is not None and not self.disaggregated:
             self._affinity[key] = handle
         return handle, "p2c"
 
@@ -379,6 +449,27 @@ class FleetRouter:
         t_submit = time.monotonic()
         key = prefix_key(prompt)
         handle, arm = self._pick(key)
+        if (
+            key is not None
+            and self.ship_blocks
+            and self.policy != "round_robin"
+        ):
+            # Ship KV blocks, not requests: when placement moves a
+            # template off the replica whose trie holds its blocks
+            # (an affinity re-point in colocated mode; any load-pick
+            # divergence in disaggregated mode), the router brokers
+            # an export/import of the prompt's READY prefix blocks
+            # BEFORE the submit lands — the destination admits the
+            # request against a warm trie and skips the cold
+            # prefill.
+            home = self._block_home.get(key)
+            if (
+                home is not None
+                and home is not handle
+                and home in self._handles
+            ):
+                self._ship(home, handle, prompt)
+            self._block_home[key] = handle
         rid = self._next_rid
         if trace_id is None:
             trace_id = f"{self._trace_prefix}-{rid:08x}"
@@ -393,6 +484,7 @@ class FleetRouter:
         self._next_rid += 1
         self._routes[rid] = (handle, local, trace_id)
         self._local[(id(handle), local)] = rid
+        self._decode_key[rid] = key
         handle.routed += 1
         self.obs.submitted.inc()
         self.obs.routed.inc(labels={"policy": arm})
@@ -421,6 +513,205 @@ class FleetRouter:
             )
         return rid
 
+    # -- block shipping & live migration -------------------------------
+
+    @staticmethod
+    def _supports_blocks(handle: _Handle) -> bool:
+        return (
+            getattr(handle.replica, "export_blocks", None) is not None
+            and getattr(handle.replica, "import_blocks", None)
+            is not None
+        )
+
+    def _ship(self, src: _Handle, dst: _Handle, prompt) -> None:
+        """Broker one prefix-block transfer: export the prompt's
+        chain of block hashes from `src`, import into `dst`. Best
+        effort — a replica pair without the seam (fakes, old pods) or
+        a source whose blocks were evicted ships nothing, and a
+        transport error never fails the request the ship was
+        for (the destination just pays the cold prefill the ship
+        would have saved)."""
+        if not (
+            self._supports_blocks(src) and self._supports_blocks(dst)
+        ):
+            return
+        t0 = time.monotonic()
+        try:
+            payload = src.replica.export_blocks(chain_hashes(prompt))
+            if not payload.get("blocks"):
+                self.obs.xfer_ships.inc(labels={"outcome": "empty"})
+                return
+            result = dst.replica.import_blocks(payload)
+        except Exception as err:  # noqa: BLE001 — transport seam
+            self.obs.xfer_ships.inc(labels={"outcome": "error"})
+            self.obs.xfer_failures.inc(labels={"kind": "ship"})
+            self.trace.event(
+                "ship_failed", time.monotonic(), src=src.name,
+                dst=dst.name, error=str(err),
+            )
+            return
+        imported = int(result.get("imported", 0))
+        self.obs.xfer_ships.inc(labels={"outcome": "ok"})
+        self.obs.xfer_blocks_shipped.inc(imported)
+        self.trace.event(
+            "ship_blocks", t0, src=src.name, dst=dst.name,
+            offered=len(payload["blocks"]), imported=imported,
+        )
+
+    def _remap(self, src: _Handle, dst: _Handle, landed) -> None:
+        """Re-point in-flight routes after a migration: each landed
+        entry (the destination's `import_resident` return) is matched
+        to its router rid by the trace id the router minted at
+        submit, then the route and the reverse local-rid map move to
+        the destination. Records, capture rows and `/generate`
+        responses keep flowing under the SAME router rid — the caller
+        never learns the stream moved."""
+        by_trace = {
+            tid: rid
+            for rid, (h, _local, tid) in self._routes.items()
+            if h is src
+        }
+        for entry in landed:
+            rid = by_trace.get(entry.get("trace_id"))
+            if rid is None:
+                continue
+            _old_handle, old_local, tid = self._routes[rid]
+            self._local.pop((id(src), old_local), None)
+            self._routes[rid] = (dst, entry["rid"], tid)
+            self._local[(id(dst), entry["rid"])] = rid
+
+    def _migrate_residents(self, handle: _Handle) -> None:
+        """Drain-down evacuation: export EVERYTHING the draining
+        replica owns (queued, mid-prefill, mid-decode) and land it on
+        the least-loaded migration-capable peer. If no peer can take
+        the payload (capacity precheck raises), it re-imports into
+        the SOURCE — `import_resident` bypasses the drain gate — so a
+        failed migration degrades to the classic finish-resident-work
+        drain with zero dropped requests."""
+        replica = handle.replica
+        try:
+            payload = replica.export_resident()
+        except Exception:  # noqa: BLE001
+            self.obs.xfer_failures.inc(labels={"kind": "migrate"})
+            return
+        moved = len(payload.get("migrate", ())) + len(
+            payload.get("resubmit", ())
+        )
+        if not moved:
+            return
+        targets = sorted(
+            (
+                h for h in self._handles
+                if h is not handle
+                and not h.replica.draining
+                and getattr(h.replica, "supports_migration", False)
+            ),
+            key=self._load,
+        )
+        for dst in targets:
+            try:
+                landed = dst.replica.import_resident(payload)
+            except RuntimeError:
+                continue
+            self._remap(handle, dst, landed)
+            self.obs.xfer_migrations.inc(
+                len(landed), labels={"outcome": "moved"}
+            )
+            self.trace.event(
+                "migrate_residents", time.monotonic(),
+                src=handle.name, dst=dst.name, requests=len(landed),
+            )
+            return
+        # No peer could take it: put the work back where it was.
+        landed = replica.import_resident(payload)
+        self._remap(handle, handle, landed)
+        self.obs.xfer_migrations.inc(
+            len(landed), labels={"outcome": "returned"}
+        )
+        self.trace.event(
+            "migrate_returned", time.monotonic(), src=handle.name,
+            requests=len(landed),
+        )
+
+    def _pick_decode(self, key: int | None) -> _Handle | None:
+        """Stage-two placement: decode-capable, non-draining,
+        migration-capable members, prefix-affine with the same
+        overload/imbalance yield as stage-agnostic affinity — a hot
+        decode replica sheds templates to a meaningfully cooler one
+        and the map re-points."""
+        candidates = [
+            h for h in self._handles
+            if h.can_decode()
+            and not h.replica.draining
+            and getattr(h.replica, "supports_migration", False)
+        ]
+        if not candidates:
+            return None
+        if key is not None:
+            handle = self._decode_affinity.get(key)
+            if handle is not None and handle in candidates:
+                load = self._load(handle)
+                if load < self.affinity_overload:
+                    return handle
+                alt = self._two_choices(candidates)
+                if load - self._load(alt) >= self.affinity_imbalance:
+                    self._decode_affinity[key] = alt
+                    return alt
+                return handle
+        handle = self._two_choices(candidates)
+        if key is not None:
+            self._decode_affinity[key] = handle
+        return handle
+
+    def _decode_handoff(self) -> None:
+        """Stage boundary of the disaggregated fleet, run every
+        step: each prefill-only replica's decode-ready streams (first
+        token committed — prefill work done) are exported one request
+        at a time and imported into their decode placement, KV blocks
+        and sampler state riding the payload; the route re-points so
+        the stream's record flows from the decode replica under the
+        original router rid. A failed import leaves the stream
+        decoding on the prefill replica — correctness never depends
+        on the handoff."""
+        for handle in self._handles:
+            if handle.role != "prefill":
+                continue
+            replica = handle.replica
+            if not getattr(replica, "supports_migration", False):
+                continue
+            for local in replica.decode_ready_rids():
+                rid = self._local.get((id(handle), local))
+                if rid is None:
+                    continue  # submitted around the router
+                dst = self._pick_decode(self._decode_key.get(rid))
+                if dst is None or dst is handle:
+                    continue
+                payload = replica.export_resident(only=[local])
+                if not payload.get("migrate"):
+                    continue
+                try:
+                    landed = dst.replica.import_resident(payload)
+                except RuntimeError:
+                    # Destination had no capacity: the stream is
+                    # already off the source's slots, so it goes
+                    # straight back (import_resident bypasses any
+                    # drain gate) and finishes where it started.
+                    self.obs.xfer_failures.inc(
+                        labels={"kind": "migrate"}
+                    )
+                    landed = replica.import_resident(payload)
+                    self._remap(handle, handle, landed)
+                    continue
+                self._remap(handle, dst, landed)
+                self.obs.xfer_migrations.inc(
+                    len(landed), labels={"outcome": "decode"}
+                )
+                self.trace.event(
+                    "decode_handoff", time.monotonic(),
+                    src=handle.name, dst=dst.name,
+                    trace_id=self._routes[rid][2],
+                )
+
     # -- the drive loop ------------------------------------------------
 
     def _collect(self, handle: _Handle) -> None:
@@ -429,6 +720,7 @@ class FleetRouter:
             if rid is None:
                 continue  # a request submitted around the router
             route = self._routes.pop(rid, None)
+            self._decode_key.pop(rid, None)
             record = dict(record)
             record["replica"] = handle.name
             # The router's minted id is authoritative (a replica that
@@ -470,6 +762,8 @@ class FleetRouter:
         for handle in list(self._handles):
             handle.replica.step()
             self._collect(handle)
+        if self.disaggregated:
+            self._decode_handoff()
         if self._reconciler is not None:
             self._reconciler.tick(self)
         self._refresh_gauges()

@@ -89,6 +89,33 @@ class EngineReplica:
     def drain_done_records(self) -> dict[int, dict]:
         return self.engine.drain_done_records()
 
+    # -- KV block transfer / live migration ----------------------------
+
+    # In-process engines carry the full transfer plane: prefix blocks
+    # ship between tries, and resident requests (KV + sampler state)
+    # migrate wholesale. HTTP replicas ship blocks over /blocks but
+    # never migrate requests — the response socket lives on the
+    # source pod.
+    supports_migration = True
+
+    def export_blocks(self, hashes) -> dict:
+        return self.engine.export_blocks(hashes)
+
+    def import_blocks(self, payload) -> dict:
+        return self.engine.import_blocks(payload)
+
+    def export_resident(self, only=None) -> dict:
+        return self.engine.export_resident(only=only)
+
+    def import_resident(self, payload) -> list[dict]:
+        return self.engine.import_resident(payload)
+
+    def decode_ready_rids(self) -> list[int]:
+        return self.engine.decode_ready_rids()
+
+    def drain_stats(self) -> dict:
+        return self.engine.drain_stats()
+
     # -- scale signals -------------------------------------------------
 
     @property
@@ -311,6 +338,40 @@ class HttpReplica:
 
     def step(self) -> None:
         """No-op: the remote server drives its own engine."""
+
+    # -- KV block transfer (POST /blocks) ------------------------------
+
+    # Prefix blocks ship fine over HTTP (content-addressed, b64 tiles)
+    # but resident-request migration stays in-process only: the
+    # response socket for an in-flight /generate lives on the source
+    # pod, so moving its stream would orphan the client.
+    supports_migration = False
+
+    def _post_blocks(self, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}/blocks",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(
+            req, timeout=self._timeout_s
+        ) as resp:
+            return json.loads(resp.read())
+
+    def export_blocks(self, hashes) -> dict:
+        """Ask the pod to serialize the named prefix blocks (the
+        engine's `export_blocks` payload, JSON-clean by
+        construction)."""
+        return self._post_blocks(
+            {"action": "export", "hashes": list(hashes)}
+        )
+
+    def import_blocks(self, payload) -> dict:
+        """Land an exported payload in the pod's pool + trie; returns
+        the engine's `{"imported": n, "rejected": {...}}` result."""
+        return self._post_blocks(
+            {"action": "import", "payload": payload}
+        )
 
     def drain_done_records(self) -> dict[int, dict]:
         with self._lock:

@@ -70,6 +70,16 @@ class TrafficBenchResult:
     scale_down_events: int
     replicas_final: int
     per_request_tokens: dict = field(default_factory=dict)
+    # Disaggregation arm (compare_disaggregated=True): the SAME trace
+    # through a role-split prefill/decode fleet with block shipping
+    # (the fleet-global prefix cache), and through a colocated
+    # affinity fleet with shipping OFF (per-replica caches — the
+    # pre-disaggregation baseline the global cache must beat).
+    disagg_ttft_p99_s: float | None = None
+    disagg_prefix_hit_rate: float | None = None
+    disagg_completed: int | None = None
+    noship_prefix_hit_rate: float | None = None
+    disagg_per_request_tokens: dict = field(default_factory=dict)
 
     def bench_keys(self) -> dict:
         """The headline-key view `bench.py` merges into its one JSON
@@ -100,6 +110,18 @@ class TrafficBenchResult:
         if self.rr_prefix_hit_rate is not None:
             out["router_rr_prefix_hit_rate"] = round(
                 self.rr_prefix_hit_rate, 4
+            )
+        if self.disagg_ttft_p99_s is not None:
+            out["router_disagg_ttft_p99"] = round(
+                self.disagg_ttft_p99_s, 4
+            )
+        if self.disagg_prefix_hit_rate is not None:
+            out["router_disagg_prefix_hit_rate"] = round(
+                self.disagg_prefix_hit_rate, 4
+            )
+        if self.noship_prefix_hit_rate is not None:
+            out["router_noship_prefix_hit_rate"] = round(
+                self.noship_prefix_hit_rate, 4
             )
         return out
 
@@ -258,6 +280,7 @@ def run_traffic_benchmark(
     max_new: int = 6,
     seed: int = 0,
     compare_round_robin: bool = True,
+    compare_disaggregated: bool = False,
     scale_policy=None,
     cfg=None,
     params=None,
@@ -265,7 +288,16 @@ def run_traffic_benchmark(
     """Replay one deterministic trace through a prefix-affinity fleet
     (optionally autoscaling over `spare_replicas` provider-held
     spares) and, for the hit-rate comparison, through a fresh
-    round-robin fleet on the SAME trace and weights."""
+    round-robin fleet on the SAME trace and weights.
+
+    `compare_disaggregated=True` adds two more arms on the same
+    trace: a role-split fleet (half the replicas prefill-only, half
+    decode-only; streams migrate at first token, KV blocks ship with
+    placement — the fleet-global prefix cache), and a colocated
+    affinity fleet with block shipping OFF (per-replica caches, the
+    pre-disaggregation baseline). Emitted as
+    `router_disagg_ttft_p99`, `router_disagg_prefix_hit_rate` and
+    `router_noship_prefix_hit_rate`."""
     from walkai_nos_tpu.router.autoscale import StaticSliceProvider
     from walkai_nos_tpu.router.core import FleetRouter
 
@@ -327,6 +359,54 @@ def run_traffic_benchmark(
         _replay(rr_router, trace, surge_ticks)
         rr_rate = rr_router.prefix_hit_rate
 
+    disagg_ttft = None
+    disagg_rate = None
+    disagg_completed = None
+    disagg_tokens: dict = {}
+    noship_rate = None
+    if compare_disaggregated and n_replicas >= 2:
+        # Role-split fleet: prefill-only members take every new
+        # request (pure load placement), decode-only members receive
+        # each stream at first token (KV blocks + sampler state ride
+        # the migration payload). Block shipping keeps the prefill
+        # tries warm wherever placement lands a template.
+        n_prefill = (n_replicas + 1) // 2
+        dis_router = FleetRouter(seed=seed, anomaly=False)
+        for i in range(n_replicas):
+            replica = factory(f"d{i}")
+            _warm(replica)
+            dis_router.add_replica(
+                replica,
+                role="prefill" if i < n_prefill else "decode",
+            )
+        dis_records, _ticks, _err = _replay(
+            dis_router, trace, surge_ticks
+        )
+        dis_ttft = sorted(
+            r["ttft_s"] for r in dis_records.values()
+            if r.get("ttft_s") is not None
+        )
+        disagg_ttft = percentile(dis_ttft, 99)
+        disagg_rate = dis_router.prefix_hit_rate
+        disagg_completed = len(dis_records)
+        disagg_tokens = {
+            rid: rec["tokens"] for rid, rec in dis_records.items()
+        }
+        # The per-replica-cache baseline: same colocated affinity
+        # policy, shipping OFF — every replica pays its own cold
+        # prefill per template.
+        ns_replicas = [
+            factory(f"ns{i}") for i in range(n_replicas)
+        ]
+        for replica in ns_replicas:
+            _warm(replica)
+        ns_router = FleetRouter(
+            ns_replicas, policy="affinity", ship_blocks=False,
+            seed=seed, anomaly=False,
+        )
+        _replay(ns_router, trace, surge_ticks)
+        noship_rate = ns_router.prefix_hit_rate
+
     return TrafficBenchResult(
         requests=sum(len(a) for a in trace),
         completed=len(records),
@@ -341,6 +421,11 @@ def run_traffic_benchmark(
         per_request_tokens={
             rid: rec["tokens"] for rid, rec in records.items()
         },
+        disagg_ttft_p99_s=disagg_ttft,
+        disagg_prefix_hit_rate=disagg_rate,
+        disagg_completed=disagg_completed,
+        noship_prefix_hit_rate=noship_rate,
+        disagg_per_request_tokens=disagg_tokens,
     )
 
 
