@@ -649,6 +649,7 @@ def router_benchmark() -> dict:
     from walkai_nos_tpu.router.autoscale import ScalePolicy
     from walkai_nos_tpu.sim.trafficbench import (
         measure_router_obs_overhead,
+        run_long_context_benchmark,
         run_traffic_benchmark,
     )
 
@@ -667,6 +668,13 @@ def router_benchmark() -> dict:
     )
     out = r.bench_keys()
     out.update(measure_router_obs_overhead())
+    # Bimodal long-context arm (sequence-parallel prefill lane): one
+    # CPU-scaled "100k" prompt beside a short-prompt stream, sp on vs
+    # off — `cb_prefill_100k_ttft_s` (long TTFT, must improve) and
+    # `cb_short_p99_under_long_load` (short p99, must hold within a
+    # few percent of `cb_short_p99_sp_off`). absent_ok bands in
+    # BASELINE.json.
+    out.update(run_long_context_benchmark())
     return out
 
 
@@ -743,6 +751,7 @@ def main() -> None:
             "cb_capture_bytes_per_request",
             "router_ttft_p99_under_surge", "router_prefix_hit_rate",
             "router_disagg_ttft_p99",
+            "cb_prefill_100k_ttft_s", "cb_short_p99_under_long_load",
             "router_scale_events_total", "router_obs_overhead_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )

@@ -664,6 +664,18 @@ def main() -> None:
                 prefill_chunk=int(
                     os.environ.get("WALKAI_CB_PFCHUNK", "64")
                 ),
+                # Sequence-parallel prefill lane (WALKAI_CB_SP=1):
+                # prompts at least WALKAI_CB_SP_MIN tokens spread
+                # their chunk windows across up to WALKAI_CB_SP_SPAN
+                # lane rows per dispatch, and admission holds a long
+                # prompt while another is prefilling so short-prompt
+                # decode tails keep their lane slots. Token-identical
+                # to sp off.
+                sp_prefill=os.environ.get("WALKAI_CB_SP") == "1",
+                sp_min_tokens=int(
+                    os.environ.get("WALKAI_CB_SP_MIN", "2048")
+                ),
+                sp_span=int(os.environ.get("WALKAI_CB_SP_SPAN", "0")),
                 # Shared-prefix KV reuse (models/prefix_cache.py):
                 # templated prompts share refcounted prefix blocks and
                 # skip their prefill. 0 restores the exclusive pool
@@ -1483,6 +1495,7 @@ def main() -> None:
                     payload["cb_loop"] = cb_engine.loop_stats()
                     payload["cb_quant"] = cb_engine.quant_stats()
                     payload["cb_tp"] = cb_engine.tp_stats()
+                    payload["cb_sp"] = cb_engine.sp_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

@@ -493,6 +493,48 @@ class TestCheckLogic:
         )
         assert any("router_prefix_hit_rate" in f for f in failures)
 
+    def test_repo_baseline_gates_long_context_keys(self):
+        """BASELINE.json carries the bimodal long-context arm's two
+        headline keys (sequence-parallel prefill lane,
+        run_long_context_benchmark) as absent_ok lower-is-better
+        bands and they PARSE through the comparator:
+        `cb_prefill_100k_ttft_s` is the long prompt's TTFT with sp
+        ON, `cb_short_p99_under_long_load` the short-prompt p99
+        beside it (the fairness half). Absent from the bench output
+        is a skip note; a value past its band (value 2.0, tolerance
+        1.0 => fail above 4.0 s) fails once emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        keys = (
+            "cb_prefill_100k_ttft_s", "cb_short_p99_under_long_load",
+        )
+        for key in keys:
+            spec = published[key]
+            assert spec["direction"] == "lower"
+            assert spec["tolerance"] == 1.0
+            assert spec["absent_ok"] is True
+            assert spec["value"] == 2.0
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert sum("absent" in n for n in notes) == 2
+        failures, _ = bench_check.check(
+            {"cb_prefill_100k_ttft_s": 0.7,
+             "cb_short_p99_under_long_load": 0.3},
+            base,
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"cb_prefill_100k_ttft_s": 4.5,
+             "cb_short_p99_under_long_load": 4.2},
+            base,
+        )
+        assert len(failures) == 2
+        assert any("cb_prefill_100k_ttft_s" in f for f in failures)
+        assert any(
+            "cb_short_p99_under_long_load" in f for f in failures
+        )
+
     def test_repo_baseline_activates_roofline_gate(self):
         """The device-resident-loop PR activates the long-deferred
         decode_gqa_roofline_fraction gate: an absent_ok acceptance

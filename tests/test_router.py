@@ -704,6 +704,43 @@ class TestDisaggregatedFleet:
         # The cold replica hit on a block it never prefilled.
         assert cold.replica.engine.prefix_stats()["block_hits"] == 1
 
+    def test_ship_accounts_wire_bytes_by_dtype(self, fleet):
+        """Every shipped payload lands in
+        `router_xfer_bytes_total{dtype}` (decoded tile bytes, not
+        b64 envelope) and in `router.stats()['xfer_bytes']` — the
+        capacity-planning ledger behind the disaggregation plane:
+        per-dtype so an int8-KV fleet's wire savings are visible.
+        The tally must equal the payload's own decoded tile sizes."""
+        _, make = fleet
+        router = FleetRouter([make("wb0"), make("wb1")], seed=0)
+        assert router.stats()["xfer_bytes"] == {}
+        p = _template(9)
+        router.submit(p, max_new_tokens=4)
+        router.run()
+        key = prefix_key(p)
+        home = router._block_home[key]
+        cold = next(
+            h for h in router.active_handles() if h is not home
+        )
+        # The expected wire size, from the exporter's own payload.
+        from walkai_nos_tpu.models.block_key import chain_hashes
+
+        payload = home.replica.export_blocks(chain_hashes(p))
+        want: dict = {}
+        for t in payload["tiles"] + payload.get("draft_tiles", []):
+            dt = str(t["dtype"])
+            want[dt] = want.get(dt, 0) + len(t["data"]) * 3 // 4
+        assert want and all(v > 0 for v in want.values())
+        router._affinity[key] = cold  # forced re-point -> ship
+        router.submit(p, max_new_tokens=4)
+        router.run()
+        got = router.stats()["xfer_bytes"]
+        assert got == want
+        for dt, nbytes in want.items():
+            assert int(router.obs.xfer_bytes.value(
+                labels={"dtype": dt}
+            )) == nbytes
+
     def test_transfer_plane_is_noop_for_bare_replicas(self):
         """Replicas without the export/import surface (HTTP pods
         behind old servers, scripted fakes) opt out silently: the
@@ -1306,6 +1343,87 @@ class TestStragglerDetection:
         assert router.obs.replica_anomaly_score.value(
             labels={"replica": "bad"}
         ) is None
+
+
+    def test_anomaly_evacuation_fires_without_idle_window(
+        self, tmp_path
+    ):
+        """A flagged replica is auto-drained NOW — the reconciler's
+        evacuation step, not the idle scale-down: the fleet sits at
+        moderate load (neither idle nor pressured, so neither
+        hysteresis counter can ever fire) and the drain must still
+        start within a few ticks of the flag, migrate-first through
+        the normal `start_drain` seam, with reason='anomaly' on the
+        trace ring."""
+        from walkai_nos_tpu.router.autoscale import (
+            ScalePolicy,
+            StaticSliceProvider,
+        )
+
+        good0 = FleetFake("good0", sat=0.5, dispatch_p99=0.01)
+        good1 = FleetFake("good1", sat=0.5, dispatch_p99=0.011)
+        bad = FleetFake("bad", sat=0.5, dispatch_p99=0.1)
+        router = FleetRouter(
+            [good0, good1, bad], seed=0, fleet_refresh_s=0.0,
+            provider=StaticSliceProvider([]),
+            flight=FlightRecorder(
+                str(tmp_path), min_interval_s=0.0
+            ),
+            # idle_ticks far beyond the loop below: if the drain
+            # fires, it can only be the evacuation step.
+            scale_policy=ScalePolicy(
+                min_replicas=1, max_replicas=3,
+                idle_ticks=10_000, breach_ticks=10_000,
+                cooldown_ticks=2,
+            ),
+        )
+        for _ in range(20):
+            router.step()
+            if bad.draining:
+                break
+        assert bad.draining is True
+        assert not good0.draining and not good1.draining
+        events = {
+            e["name"]: e for e in router.trace.ring.snapshot()
+        }
+        drain = events["drain_start"]
+        assert drain["args"]["replica"] == "bad"
+        assert drain["args"]["reason"] == "anomaly"
+        assert router.scale_events()["down"] == 1
+
+    def test_anomaly_evacuation_respects_min_replicas(
+        self, tmp_path
+    ):
+        """min_replicas floors the evacuation exactly like a
+        scale-down: with the whole fleet at the floor, a flagged
+        replica keeps serving (the detector still penalizes its
+        routing share) rather than shrinking the fleet below
+        policy."""
+        from walkai_nos_tpu.router.autoscale import (
+            ScalePolicy,
+            StaticSliceProvider,
+        )
+
+        good0 = FleetFake("good0", sat=0.5, dispatch_p99=0.01)
+        good1 = FleetFake("good1", sat=0.5, dispatch_p99=0.011)
+        bad = FleetFake("bad", sat=0.5, dispatch_p99=0.1)
+        router = FleetRouter(
+            [good0, good1, bad], seed=0, fleet_refresh_s=0.0,
+            provider=StaticSliceProvider([]),
+            flight=FlightRecorder(
+                str(tmp_path), min_interval_s=0.0
+            ),
+            scale_policy=ScalePolicy(
+                min_replicas=3, max_replicas=3,
+                idle_ticks=10_000, breach_ticks=10_000,
+                cooldown_ticks=2,
+            ),
+        )
+        for _ in range(12):
+            router.step()
+        assert router.anomaly_flagged_names() == ["bad"]
+        assert not bad.draining
+        assert router.scale_events()["down"] == 0
 
 
 class TestReconcilerTraceEvents:

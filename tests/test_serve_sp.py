@@ -1,0 +1,311 @@
+"""Sequence-parallel prefill lane + length-aware admission
+(`models/serve.py` `sp_prefill`).
+
+Tier-1 surface for the long-context serving lane: a LONG prompt
+(>= `sp_min_tokens`) fans its chunk window across spare lane rows in
+ONE dispatch, and that fan-out must be TOKEN-IDENTICAL to the serial
+lane — greedy and sampled, prefix cache on and off, tp 1 and 2 on the
+emulated mesh, prompts crossing the 128-row block boundary, and with
+shorts admitted mid-prefill beside the live sp entry. The admission
+side has its own contract: at most one sp entry prefills at a time,
+a held long is jumped by the first short behind it (never the other
+way round), and the holds/requests/rows counters feed the fairness
+bench. The capture plane closes the loop: a capture recorded sp-on
+must replay token-identically sp-off (PR 15's digest check is the
+machine proof the mode changes scheduling, not tokens).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.decode import make_generate_fn
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+from walkai_nos_tpu.sim.replay import (
+    ENGINE_KNOBS,
+    load_capture,
+    replay_capture,
+)
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+    max_seq_len=512,
+)
+
+# fp32 twin for the tp=2 arm (same rationale as test_serve_tp.py:
+# bf16 ulp noise under the psum's changed reduction order could flip
+# a near-tied argmax).
+CFG_TP = LMConfig(
+    vocab_size=128, hidden_dim=64, num_layers=2, num_heads=4,
+    num_kv_heads=2, max_seq_len=256, dtype="float32",
+    norm="rmsnorm", mlp="swiglu", mlp_dim=128, rope=True,
+    use_bias=False, head_bias=False,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def params_tp():
+    return DecoderLM(CFG_TP).init_params(jax.random.PRNGKey(0))
+
+
+def _prompt(n, seed=0, vocab=CFG.vocab_size):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, n).astype(np.int32)
+
+
+def _expected(params, prompt, max_new, cfg=CFG):
+    gen = make_generate_fn(cfg)
+    out = gen(params, jnp.asarray(prompt[None]), max_new_tokens=max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _engine(params, sp, **kw):
+    """Engine with the long lane armed low enough that tiny-config
+    prompts exercise it: 96-token threshold, 32-token chunks, span 3
+    so a long claims up to 2 spare rows per dispatch."""
+    base = dict(
+        slots=3, cache_len=384, chunk_steps=3, paged=True,
+        prefill_chunk=32, prefill_lanes=4,
+        sp_prefill=sp, sp_min_tokens=96, sp_span=3,
+    )
+    base.update(kw)
+    return ContinuousBatcher(CFG, params, **base)
+
+
+def _run_specs(eng, specs, **submit_kw):
+    rids = {
+        eng.submit(_prompt(n, seed=n), max_new_tokens=m, **submit_kw):
+            (n, m)
+        for n, m in specs
+    }
+    res = eng.run()
+    return {rids[r]: toks for r, toks in res.items()}
+
+
+class TestSpParity:
+    # Two longs (140 crosses the 128-row block edge mid-prefill, 300
+    # spans three blocks), one boundary-threshold long (97 just over
+    # sp_min_tokens), one short riding beside them.
+    SPECS = [(140, 9), (20, 12), (300, 8), (97, 11)]
+
+    @pytest.mark.parametrize("prefix", [True, False],
+                             ids=["prefix-on", "prefix-off"])
+    def test_greedy_identity_sp_on_off(self, params, prefix):
+        """sp-on == sp-off == standalone generation, token for token,
+        with the fan-out PROVABLY engaged (rows_total > requests_total
+        would be vacuous parity otherwise)."""
+        outs = {}
+        for sp in (True, False):
+            eng = _engine(params, sp, prefix_cache=prefix)
+            outs[sp] = _run_specs(eng, self.SPECS)
+            if sp:
+                st = eng.sp_stats()
+                assert st["requests_total"] == 3  # 140, 300, 97
+                assert st["rows_total"] > st["requests_total"]
+                assert st["active"] == 0  # all drained
+        for n, m in self.SPECS:
+            want = _expected(params, _prompt(n, seed=n), m)
+            assert outs[True][(n, m)] == want, (n, m)
+            assert outs[False][(n, m)] == want, (n, m)
+
+    def test_sampled_identity_sp_on_off(self, params):
+        """(prompt, knobs, seed) fully determines sampled output in
+        both modes — the span's finishing row must seed the slot PRNG
+        exactly like the serial lane's final chunk."""
+        specs = [(140, 8), (20, 8)]
+        outs = {}
+        for sp in (True, False):
+            eng = _engine(params, sp)
+            outs[sp] = _run_specs(
+                eng, specs, temperature=0.9, top_k=16, top_p=0.95,
+                seed=123,
+            )
+        assert outs[True] == outs[False]
+
+    def test_block_boundary_prompts(self, params):
+        """Lengths straddling the 128-row page edge (127/128/129) and
+        an exact two-page prompt: the span's per-row scatter must land
+        each window in the right block with no off-by-one at the
+        seam."""
+        specs = [(127, 6), (128, 6), (129, 6), (256, 5)]
+        eng = _engine(params, True, slots=2, cache_len=384)
+        outs = _run_specs(eng, specs)
+        for n, m in specs:
+            want = _expected(params, _prompt(n, seed=n), m)
+            assert outs[(n, m)] == want, (n, m)
+        assert eng.sp_stats()["requests_total"] == 4
+
+    def test_mid_prefill_admission_beside_live_sp_lane(self, params):
+        """Shorts submitted AFTER the long's fan-out is in flight
+        admit onto the remaining lane rows and decode beside it —
+        and everyone's tokens still match standalone generation."""
+        eng = _engine(params, True)
+        long_rid = eng.submit(_prompt(300, seed=300), max_new_tokens=8)
+        eng.step()  # long admitted, span dispatched
+        assert eng.sp_stats()["active"] == 1
+        short_rids = {
+            eng.submit(_prompt(n, seed=n), max_new_tokens=7): n
+            for n in (30, 45)
+        }
+        saw_concurrent = False
+        out = {}
+        while eng.has_work:
+            eng.step()
+            if (eng.sp_stats()["active"] == 1
+                    and len(eng._prefilling) >= 2):
+                saw_concurrent = True
+            out.update(eng.drain_done())
+        assert saw_concurrent
+        assert out[long_rid] == _expected(
+            params, _prompt(300, seed=300), 8
+        )
+        for rid, n in short_rids.items():
+            assert out[rid] == _expected(params, _prompt(n, seed=n), 7)
+
+    def test_tp2_mesh_identity(self, params_tp):
+        """The sp lane composes with tensor parallelism: sp-on tp=2
+        (emulated model-axis mesh) == sp-off tp=2 == sp-off tp=1."""
+        specs = [(137, 8), (7, 8)]
+        outs = {}
+        for sp, tp in ((True, 2), (False, 2), (False, 1)):
+            cfg = dataclasses.replace(CFG_TP, tp_devices=tp)
+            eng = ContinuousBatcher(
+                cfg, params_tp, slots=2, cache_len=256, chunk_steps=4,
+                paged=True, prefill_chunk=32, prefill_lanes=4,
+                sp_prefill=sp, sp_min_tokens=96, sp_span=2,
+            )
+            rids = {
+                eng.submit(
+                    _prompt(n, seed=n, vocab=CFG_TP.vocab_size),
+                    max_new_tokens=m,
+                ): (n, m)
+                for n, m in specs
+            }
+            res = eng.run()
+            outs[(sp, tp)] = {rids[r]: t for r, t in res.items()}
+            if sp:
+                assert eng.sp_stats()["requests_total"] == 1
+        assert outs[(True, 2)] == outs[(False, 2)] == outs[(False, 1)]
+
+    def test_stream_seam_token_identical(self, params, monkeypatch):
+        """WALKAI_SP_STREAM=1 swaps the dense reference tail for the
+        streamed online-softmax fold inside the span's attend — same
+        tokens required (the off-TPU CI form of the on-TPU default)."""
+        monkeypatch.setenv("WALKAI_SP_STREAM", "1")
+        specs = [(140, 9), (20, 12)]
+        eng = _engine(params, True)
+        outs = _run_specs(eng, specs)
+        for n, m in specs:
+            want = _expected(params, _prompt(n, seed=n), m)
+            assert outs[(n, m)] == want, (n, m)
+
+
+class TestLengthAwareAdmission:
+    def test_second_long_held_and_short_jumps(self, params):
+        """One sp entry at a time: with a long already prefilling, a
+        queued long is HELD (holds_total counts the turn) while the
+        short behind it admits — the starvation guard's whole point.
+        Both longs still finish with the right tokens."""
+        eng = _engine(params, True)
+        specs = [(140, 6), (150, 6), (20, 6)]
+        rids = {
+            eng.submit(_prompt(n, seed=n), max_new_tokens=m): (n, m)
+            for n, m in specs
+        }
+        eng.step()
+        st = eng.sp_stats()
+        assert st["active"] == 1
+        assert st["requests_total"] == 1  # 150 held, not admitted
+        assert st["holds_total"] >= 1
+        # The short jumped the held long: only the second long is
+        # still queued (the short admitted and is prefilling or
+        # already decoding on its slot).
+        assert [len(r.prompt) for r in eng._pending] == [150]
+        out = {rids[r]: t for r, t in eng.run().items()}
+        assert eng.sp_stats()["requests_total"] == 2
+        for n, m in specs:
+            assert out[(n, m)] == _expected(
+                params, _prompt(n, seed=n), m
+            ), (n, m)
+
+    def test_short_only_traffic_never_touches_sp(self, params):
+        """With sp on but every prompt under the threshold, behavior
+        is byte-for-byte the serial lane: no sp admissions, no rows,
+        no holds."""
+        eng = _engine(params, True)
+        outs = _run_specs(eng, [(20, 8), (40, 8), (64, 8)])
+        st = eng.sp_stats()
+        assert st["requests_total"] == 0
+        assert st["rows_total"] == 0
+        assert st["holds_total"] == 0
+        for n, m in [(20, 8), (40, 8), (64, 8)]:
+            assert outs[(n, m)] == _expected(
+                params, _prompt(n, seed=n), m
+            )
+
+
+class TestSpContract:
+    def test_requires_paged_engine(self, params):
+        with pytest.raises(ValueError, match="requires the paged"):
+            ContinuousBatcher(
+                CFG, params, slots=2, cache_len=256, paged=False,
+                sp_prefill=True,
+            )
+
+    def test_knob_validation(self, params):
+        with pytest.raises(ValueError, match="sp_min_tokens"):
+            _engine(params, True, sp_min_tokens=0)
+        with pytest.raises(ValueError, match="sp_span"):
+            _engine(params, True, sp_span=-1)
+
+    def test_span_auto_sizes_and_surfaces(self, params):
+        """sp_span=0 auto-sizes (>= 2); the knobs show up in
+        sp_stats, debug_state's `sp` block, and the capture
+        fingerprint, and all three sp knobs are replayable engine
+        knobs."""
+        eng = _engine(params, True, sp_span=0)
+        assert eng.sp_span >= 2
+        st = eng.sp_stats()
+        assert st["enabled"] is True
+        assert st["sp_min_tokens"] == 96
+        assert st["sp_span"] == eng.sp_span
+        assert eng.debug_state()["sp"] == st
+        fp = eng.config_fingerprint()["engine"]
+        assert fp["sp_prefill"] is True
+        assert fp["sp_min_tokens"] == 96
+        assert fp["sp_span"] == eng.sp_span
+        for knob in ("sp_prefill", "sp_min_tokens", "sp_span"):
+            assert knob in ENGINE_KNOBS
+
+
+class TestSpCaptureDigest:
+    def test_sp_on_capture_replays_sp_off(self, params, tmp_path):
+        """PR 15's digest check as the machine parity proof: a
+        capture recorded with the fan-out live must replay with zero
+        divergences on the serial lane (and vice versa via the
+        override), because sp changes scheduling, never tokens."""
+        d = str(tmp_path)
+        eng = _engine(params, True, capture=d)
+        eng.submit(_prompt(140, seed=140), max_new_tokens=6)
+        eng.submit(_prompt(20, seed=20), max_new_tokens=6)
+        eng.submit(
+            _prompt(97, seed=97), max_new_tokens=5, temperature=0.9,
+            top_k=16, seed=7,
+        )
+        live = eng.run()
+        assert eng.sp_stats()["requests_total"] == 2
+        cap = load_capture(d)
+        assert cap.fingerprint["engine"]["sp_prefill"] is True
+        assert {r.rid: r.tokens for r in cap.records} == live
+        for overrides in (None, {"sp_prefill": False}):
+            report = replay_capture(cap, params, overrides=overrides)
+            assert report.ok, report.summary()

@@ -1,0 +1,164 @@
+"""Sequence-parallel prefill ops (`ops/sp_prefill.py`) +
+`parallel/sharding.seq_shard_bounds`.
+
+Tier-1 surface for the long-context lane's device-level pieces:
+
+- `streamed_cache_attention` must match the dense reference tail
+  (`models/lm._masked_cache_attention`, ragged) numerically — MHA and
+  GQA, ragged per-row offsets, a cache length that is not a tile
+  multiple, and tile sizes that force multiple online-softmax folds —
+  because on TPU it REPLACES the reference inside the paged prefill
+  scatter+attend (`_sp_stream_backend_ok`), so any drift would change
+  served tokens;
+- `sp_ring_prefill` must match single-device causal attention over an
+  emulated ring mesh (the conftest's 8 virtual CPU devices) and
+  reject a sequence the axis can't shard evenly;
+- `seq_shard_bounds` must cover [0, length) exactly once with
+  contiguous, balanced shards — every consumer of the SP plane
+  agrees on which global positions a shard owns through this one
+  rule.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.lm import _masked_cache_attention
+from walkai_nos_tpu.ops.sp_prefill import (
+    sp_ring_prefill,
+    streamed_cache_attention,
+)
+from walkai_nos_tpu.parallel.mesh import MeshAxes, build_mesh
+from walkai_nos_tpu.parallel.sharding import seq_shard_bounds
+
+
+def _qkv_cache(rng, batch, heads, kv_heads, steps, cache_len, d):
+    q = jnp.asarray(
+        rng.standard_normal((batch, heads, steps, d)), jnp.float32
+    )
+    k = jnp.asarray(
+        rng.standard_normal((batch, kv_heads, cache_len, d)),
+        jnp.float32,
+    )
+    v = jnp.asarray(
+        rng.standard_normal((batch, kv_heads, cache_len, d)),
+        jnp.float32,
+    )
+    return q, k, v
+
+
+class TestStreamedCacheAttention:
+    @pytest.mark.parametrize(
+        "heads,kv_heads", [(2, 2), (4, 2)],
+        ids=["mha", "gqa"],
+    )
+    def test_matches_dense_reference_ragged(self, heads, kv_heads):
+        """Streamed == dense for ragged per-row offsets (each batch
+        row at a different write position), MHA and GQA, with a tile
+        small enough that every row's visible window spans several
+        folds."""
+        rng = np.random.default_rng(0)
+        q, k, v = _qkv_cache(rng, 3, heads, kv_heads, 8, 96, 16)
+        idx = jnp.asarray([0, 37, 85], jnp.int32)
+        ref = _masked_cache_attention(q, k, v, idx, True)
+        out = streamed_cache_attention(q, k, v, idx, tile=16)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_non_multiple_cache_len_and_tile_cap(self):
+        """A cache length the tile doesn't divide is padded, and the
+        padding must be invisible (masked by `k_pos < cache_len`);
+        a tile larger than the cache clamps to one fold."""
+        rng = np.random.default_rng(1)
+        q, k, v = _qkv_cache(rng, 2, 2, 2, 4, 57, 8)
+        idx = jnp.asarray([10, 56], jnp.int32)
+        ref = _masked_cache_attention(q, k, v, idx, True)
+        for tile in (13, 57, 4096):
+            out = streamed_cache_attention(q, k, v, idx, tile=tile)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5,
+                err_msg=f"tile={tile}",
+            )
+
+    def test_future_tile_skip_changes_nothing(self):
+        """Rows near position 0 leave most tiles wholly future
+        (the `lax.cond` skip path): the result must still equal the
+        reference — the skip is an optimization, never a truncation."""
+        rng = np.random.default_rng(2)
+        q, k, v = _qkv_cache(rng, 2, 2, 2, 2, 128, 8)
+        idx = jnp.asarray([0, 3], jnp.int32)
+        ref = _masked_cache_attention(q, k, v, idx, True)
+        out = streamed_cache_attention(q, k, v, idx, tile=8)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+
+class TestSpRingPrefill:
+    def test_matches_single_device_causal(self):
+        """Sequence sharded over a 4-way ring on the emulated mesh ==
+        single-device causal attention (the device-level form of the
+        serving lane's schedule)."""
+        mesh = build_mesh(
+            jax.devices()[:4], axes=MeshAxes(model=4)
+        )
+        rng = np.random.default_rng(3)
+        b, h, s, d = 1, 2, 64, 16
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+            for _ in range(3)
+        )
+        out = sp_ring_prefill(q, k, v, mesh)
+        scale = d ** -0.5
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, -1e30)
+        ref = jnp.einsum(
+            "bhqk,bhkd->bhqd", jax.nn.softmax(logits, axis=-1), v
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_uneven_sequence_rejected(self):
+        mesh = build_mesh(
+            jax.devices()[:4], axes=MeshAxes(model=4)
+        )
+        rng = np.random.default_rng(4)
+        q, k, v = (
+            jnp.asarray(
+                rng.standard_normal((1, 2, 66, 16)), jnp.float32
+            )
+            for _ in range(3)
+        )
+        with pytest.raises(ValueError, match="equal shards"):
+            sp_ring_prefill(q, k, v, mesh)
+
+
+class TestSeqShardBounds:
+    def test_partition_covers_exactly_once(self):
+        for n_shards in (1, 2, 3, 4, 7):
+            for length in (0, 1, 5, 64, 129):
+                spans = [
+                    seq_shard_bounds(i, n_shards, length)
+                    for i in range(n_shards)
+                ]
+                # Contiguous, ordered, covering [0, length).
+                assert spans[0][0] == 0
+                assert spans[-1][1] == length
+                for (a, b), (c, d) in zip(spans, spans[1:]):
+                    assert b == c
+                    assert a <= b and c <= d
+                # Balanced: sizes differ by at most 1, remainder
+                # dealt to the leading shards.
+                sizes = [b - a for a, b in spans]
+                assert max(sizes) - min(sizes) <= 1
+                assert sorted(sizes, reverse=True) == sizes
+
+    def test_out_of_range_shard_rejected(self):
+        with pytest.raises(ValueError, match="out of range"):
+            seq_shard_bounds(2, 2, 10)
+        with pytest.raises(ValueError, match="out of range"):
+            seq_shard_bounds(-1, 2, 10)

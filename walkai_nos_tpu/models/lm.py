@@ -661,7 +661,19 @@ def _paged_scatter_and_attend(
         else:
             k_all = gather_paged_cache(k_pool, table)
             v_all = gather_paged_cache(v_pool, table)
-        o = _masked_cache_attention(q, k_all, v_all, idx, True)
+        if _sp_stream_backend_ok():
+            # Streamed wide tail: the sequence-parallel prefill lane
+            # widens the table (span windows of one long prompt per
+            # dispatch), and the dense tail's [rows, table*128] score
+            # block grows with it — the ring-scheduled online-softmax
+            # stream keeps per-tile memory flat.
+            from walkai_nos_tpu.ops.sp_prefill import (
+                streamed_cache_attention,
+            )
+
+            o = streamed_cache_attention(q, k_all, v_all, idx)
+        else:
+            o = _masked_cache_attention(q, k_all, v_all, idx, True)
     return o, k_pool, v_pool, ks, vs
 
 
@@ -822,6 +834,18 @@ def _fused_qkv_backend_ok() -> bool:
     engine's whole decode path under them would change what they
     measure."""
     if os.environ.get("WALKAI_FUSED_QKV") == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _sp_stream_backend_ok() -> bool:
+    """Host-side routing gate for the streamed (online-softmax)
+    wide-prefill attention tail (`ops/sp_prefill.py`): real TPU, or
+    the explicit opt-in. Mirrors `_fused_qkv_backend_ok` — off-TPU
+    the dense reference tail stays the default, so the CPU parity
+    suites pin the sequence-parallel lane bit-identical to the serial
+    lane, and WALKAI_SP_STREAM=1 exercises the streamed seam."""
+    if os.environ.get("WALKAI_SP_STREAM") == "1":
         return True
     return jax.default_backend() == "tpu"
 

@@ -229,7 +229,9 @@ class _Prefill:
     below it. `pending` holds this request's own inserted index
     nodes awaiting their writing chunk's dispatch; `resv` is the
     worst-case decode blocks still unallocated (virtual reservation,
-    see `_admit_paged`)."""
+    see `_admit_paged`). `sp` marks a sequence-parallel (long-prompt)
+    entry: it rides the dedicated long lane and may claim several
+    lane rows per dispatch (`_prepare_lane`'s fan-out)."""
     req: _Request
     slot: int
     blocks: list
@@ -238,6 +240,7 @@ class _Prefill:
     nodes: list = field(default_factory=list)
     pending: list = field(default_factory=list)
     resv: int = 0
+    sp: bool = False
 
 
 class ContinuousBatcher:
@@ -351,6 +354,9 @@ class ContinuousBatcher:
         pool_blocks: int | None = None,
         prefill_chunk: int = 64,
         prefill_lanes: int = 4,
+        sp_prefill: bool = False,
+        sp_min_tokens: int = 2048,
+        sp_span: int = 0,
         prefix_cache: bool = True,
         spec: bool = False,
         spec_k: int = 4,
@@ -430,6 +436,14 @@ class ContinuousBatcher:
                 )
             self.prefill_chunk = max(1, min(prefill_chunk, cache_len))
             self.prefill_lanes = max(1, prefill_lanes)
+            if sp_min_tokens < 1:
+                raise ValueError(
+                    f"sp_min_tokens must be >= 1; got {sp_min_tokens}"
+                )
+            if sp_span < 0:
+                raise ValueError(
+                    f"sp_span must be >= 0 (0 = auto); got {sp_span}"
+                )
             self.cfg = dataclasses.replace(
                 cfg, ragged_decode=True, cache_len=cache_len,
                 paged_decode=True, paged_blocks=self.pool_blocks,
@@ -476,6 +490,25 @@ class ContinuousBatcher:
                 )
             self._mesh = serving_mesh(self.tp)
             self._repl = NamedSharding(self._mesh, PartitionSpec())
+        # Sequence-parallel prefill lane (the long-context serving
+        # mode): prompts of `sp_min_tokens` tokens or more become LONG
+        # entries — admission keeps at most ONE in the lane (the
+        # dedicated long lane; shorts keep FIFO among themselves and
+        # may jump a held long head) and `_prepare_lane` fans the long
+        # entry's dispatch out over up to `sp_span` lane rows, one
+        # chunk window per row, so one dispatch advances the prompt
+        # span*W tokens instead of W. sp_span=0 auto-sizes to the
+        # mesh degree (>= 2) — the fanned rows are exactly what the
+        # TP machinery head-shards across the ICI mesh.
+        if sp_prefill and not paged:
+            raise ValueError(
+                "sp_prefill requires the paged engine (the "
+                "sequence-parallel lane is a fan-out of the chunked "
+                "prefill lane; the dense path has no lane)"
+            )
+        self.sp_prefill = bool(sp_prefill)
+        self.sp_min_tokens = int(sp_min_tokens)
+        self.sp_span = int(sp_span) or max(2, self.tp)
         self._model = DecoderLM(self.cfg, self._mesh)
         # Speculative serving (paged only): the draft holds its own
         # paged pool with the SAME block count, addressed through the
@@ -1851,6 +1884,7 @@ class ContinuousBatcher:
                 "consumed": p.consumed,
                 "prompt_len": len(p.req.prompt),
                 "cached": p.cached,
+                "sp": p.sp,
             }
             for p in list(self._prefilling)
         ]
@@ -1866,6 +1900,7 @@ class ContinuousBatcher:
             "loop": self.loop_stats(),
             "quant": self.quant_stats(),
             "tp": self.tp_stats(),
+            "sp": self.sp_stats(),
             "capture": self.capture_stats(),
             "attrib": self.attrib_stats(),
             "slo": self.slo_stats(),
@@ -2718,6 +2753,9 @@ class ContinuousBatcher:
                 "pool_blocks": self.pool_blocks,
                 "prefill_chunk": getattr(self, "prefill_chunk", 0),
                 "prefill_lanes": getattr(self, "prefill_lanes", 0),
+                "sp_prefill": self.sp_prefill,
+                "sp_min_tokens": self.sp_min_tokens,
+                "sp_span": self.sp_span,
                 "prefix_cache": self._prefix is not None,
                 "spec": self._spec,
                 "spec_k": self._spec_k,
@@ -2818,6 +2856,27 @@ class ContinuousBatcher:
             "kv_shard_bytes_per_token": self._kv_shard_bytes_per_token,
             "ici_bytes_per_token": tp_ici_bytes_per_token(self.cfg),
             "ici_bytes_per_step": self.obs.ici_step_bytes.value(),
+        }
+
+    def sp_stats(self) -> dict:
+        """Sequence-parallel prefill telemetry — the `/stats` `cb_sp`
+        section and the `/debug/state` `sp` block: the lane knobs in
+        force, the live long-entry count, and the registry's sp
+        counters (admitted long requests, fanned lane rows, admission
+        turns a long prompt was held for the dedicated lane). Same
+        shape + `obs_disabled` with telemetry off (the PR 3
+        convention)."""
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "enabled": self.sp_prefill,
+            "sp_min_tokens": self.sp_min_tokens,
+            "sp_span": self.sp_span,
+            "active": sum(
+                1 for p in getattr(self, "_prefilling", ()) if p.sp
+            ),
+            "requests_total": int(self.obs.sp_requests.value()),
+            "rows_total": int(self.obs.sp_rows.value()),
+            "holds_total": int(self.obs.sp_holds.value()),
         }
 
     # Pool bookkeeping lives in `models/block_pool.py`; these views
@@ -2942,8 +3001,7 @@ class ContinuousBatcher:
         t0 = time.monotonic()
         dec_table = self._dev(self._table)
         if self._prefilling:
-            lane_rows = len(self._prefilling)
-            pf, finished = self._prepare_lane(t0)
+            pf, finished, lane_rows = self._prepare_lane(t0)
             return t0, dec_table, pf, True, finished, resident, lane_rows
         return t0, dec_table, (), False, [], resident, 0
 
@@ -3022,20 +3080,52 @@ class ContinuousBatcher:
     def _prepare_lane(self, t0: float):
         """Host-side prefill-lane assembly for one dispatch: the
         [P, W] token/table arrays, the finishing-row scatter operands,
-        and the prefix-index ready marks. Returns (pf, finished) —
-        shared by the plain and speculative dispatch paths."""
-        # Lane utilization: rows carrying a real admission vs the
-        # configured lane width, summed over lane dispatches.
-        self.obs.lane_rows.inc(len(self._prefilling))
-        self.obs.lane_capacity.inc(self.prefill_lanes)
+        and the prefix-index ready marks. Returns (pf, finished,
+        n_rows) — shared by the plain and speculative dispatch paths.
+
+        Sequence-parallel fan-out: a long (`sp`) entry claims up to
+        `sp_span` lane rows in ONE dispatch, row j carrying the
+        entry's j-th next chunk window — the serial lane's per-
+        dispatch window rule applied span times within one dispatch.
+        Correctness rides the step program's write-before-read order:
+        `scatter_paged_rows` lands EVERY row's fresh K/V at each
+        layer before any row's attention reads, and all rows share
+        the entry's physical blocks, so window j+1's layer-l gather
+        sees window j's layer-l writes and the causal mask makes the
+        attention exact — per-row computation is identical to the
+        serial schedule bit for bit (the batch-composition invariance
+        the engine already quantifies over covers the rest). Only the
+        entry's LAST row ever carries the finishing-scatter operands,
+        so first-token logits and the PRNG protocol are untouched."""
         W = self.prefill_chunk
         finished: list[_Prefill] = []
-        # Lane batch sized to ACTIVE admissions (rounded up to a
-        # power of two, capped at prefill_lanes, so compile
-        # signatures stay bounded): idle lane rows would pay whole
-        # transformer forwards for scratch-block garbage.
+        # Row plan: every admission gets one row first (short entries
+        # are never crowded out of the lane), then a sequence-parallel
+        # entry claims up to sp_span - 1 EXTRA rows from the lane's
+        # spare width — never more than its remaining chunk windows.
+        spans = [1] * len(self._prefilling)
+        spare = self.prefill_lanes - len(self._prefilling)
+        for i, entry in enumerate(self._prefilling):
+            if not entry.sp or spare <= 0:
+                continue
+            windows = -(
+                -(len(entry.req.prompt) - entry.consumed) // W
+            )
+            extra = min(self.sp_span - 1, spare, windows - 1)
+            if extra > 0:
+                spans[i] += extra
+                spare -= extra
+        n_rows = sum(spans)
+        # Lane utilization: rows carrying a real admission vs the
+        # configured lane width, summed over lane dispatches.
+        self.obs.lane_rows.inc(n_rows)
+        self.obs.lane_capacity.inc(self.prefill_lanes)
+        # Lane batch sized to ACTIVE rows (rounded up to a power of
+        # two, capped at prefill_lanes, so compile signatures stay
+        # bounded): idle lane rows would pay whole transformer
+        # forwards for scratch-block garbage.
         P = 1
-        while P < len(self._prefilling):
+        while P < n_rows:
             P *= 2
         P = min(P, self.prefill_lanes)
         pf_tok = np.zeros((P, W), np.int32)
@@ -3050,38 +3140,47 @@ class ContinuousBatcher:
         pf_topp = np.ones(P, np.float32)
         pf_seed = np.zeros(P, np.int32)
         lane_end = W  # highest position any lane row touches
-        for r, entry in enumerate(self._prefilling):
+        row = 0
+        for entry, span in zip(self._prefilling, spans):
             req = entry.req
             true_len = len(req.prompt)
-            remaining = true_len - entry.consumed
-            if remaining > W:
-                start = entry.consumed
-                entry.consumed += W
-            else:
-                # Final chunk: align its END to the prompt's end
-                # (re-writing up to W-remaining already-written
-                # rows with identical values — identical because
-                # each row is a deterministic per-position
-                # function of the prefix) so the last true
-                # token's logits sit inside this chunk, clamped
-                # to the CACHED prefix boundary: rows below
-                # `entry.cached` live in shared index blocks this
-                # request must never write (another sharer may be
-                # reading them in this very dispatch).
-                start = max(entry.cached, true_len - W)
-                entry.consumed = true_len
-                finished.append(entry)
-                pf_fslot[r] = entry.slot
-                pf_true[r] = true_len
-                pf_temp[r] = req.temperature
-                pf_topk[r] = req.top_k
-                pf_topp[r] = req.top_p
-                pf_seed[r] = req.seed
-            seg = req.prompt[start:start + W]
-            pf_tok[r, :len(seg)] = seg
-            pf_start[r] = start
-            pf_tbl[r, :len(entry.blocks)] = entry.blocks
-            lane_end = max(lane_end, start + W)
+            if entry.sp and span > 1:
+                self.obs.sp_rows.inc(span)
+            for _ in range(span):
+                r = row
+                row += 1
+                remaining = true_len - entry.consumed
+                if remaining > W:
+                    start = entry.consumed
+                    entry.consumed += W
+                else:
+                    # Final chunk: align its END to the prompt's end
+                    # (re-writing up to W-remaining already-written
+                    # rows with identical values — identical because
+                    # each row is a deterministic per-position
+                    # function of the prefix, which also makes the
+                    # duplicate in-dispatch scatter writes a fanned
+                    # final row shares with its predecessor row
+                    # order-independent) so the last true
+                    # token's logits sit inside this chunk, clamped
+                    # to the CACHED prefix boundary: rows below
+                    # `entry.cached` live in shared index blocks this
+                    # request must never write (another sharer may be
+                    # reading them in this very dispatch).
+                    start = max(entry.cached, true_len - W)
+                    entry.consumed = true_len
+                    finished.append(entry)
+                    pf_fslot[r] = entry.slot
+                    pf_true[r] = true_len
+                    pf_temp[r] = req.temperature
+                    pf_topk[r] = req.top_k
+                    pf_topp[r] = req.top_p
+                    pf_seed[r] = req.seed
+                seg = req.prompt[start:start + W]
+                pf_tok[r, :len(seg)] = seg
+                pf_start[r] = start
+                pf_tbl[r, :len(entry.blocks)] = entry.blocks
+                lane_end = max(lane_end, start + W)
             # Own inserted index nodes become matchable once the
             # chunk writing their rows is dispatched: any later
             # reader's chunks dispatch strictly after this one,
@@ -3113,7 +3212,7 @@ class ContinuousBatcher:
                 pf_true, pf_temp, pf_topk, pf_topp, pf_seed,
             )
         )
-        return pf, finished
+        return pf, finished, n_rows
 
     def _flip_finished(self, finished: list[_Prefill]) -> None:
         """Flip requests whose final prefill chunk just dispatched
@@ -3131,6 +3230,9 @@ class ContinuousBatcher:
                 len(entry.req.prompt),
             )
         self.obs.lane_active.set(len(self._prefilling))
+        self.obs.sp_active.set(
+            sum(1 for p in self._prefilling if p.sp)
+        )
 
     def _ensure_decode_blocks(self, window: int, *, advance: bool) -> None:
         """Back every live slot's next `window` cache writes,
@@ -3613,16 +3715,34 @@ class ContinuousBatcher:
         case, so those later grabs can always be backed (at worst by
         evicting parked cache blocks). Head-of-line: a request that
         does not fit waits for completions/evictions rather than
-        being jumped."""
+        being jumped — with ONE exception under `sp_prefill`:
+        prompt-length-aware admission. A LONG prompt (>=
+        `sp_min_tokens`) only admits while the dedicated long lane is
+        free (at most one sequence-parallel entry prefills at a
+        time), and a long head the lane cannot take is jumped by the
+        first admissible short behind it — one 100k prefill must not
+        starve every 1k-prompt decode tail queued behind it. Shorts
+        never jump shorts, and a long never jumps anything."""
         busy = {p.slot for p in self._prefilling}
+        held_long = False
         for s in range(self.slots):
             if len(self._prefilling) >= self.prefill_lanes:
-                return
+                break
             if not self._pending:
-                return
+                break
             if self._slot_req[s] is not None or s in busy:
                 continue
-            req = self._pending[0]
+            long_busy = any(p.sp for p in self._prefilling)
+            pick = None
+            for i, cand in enumerate(self._pending):
+                if self._is_long(cand) and long_busy:
+                    held_long = True
+                    continue
+                pick = i
+                break
+            if pick is None:
+                break
+            req = self._pending[pick]
             true_len = len(req.prompt)
             total = self._blocks_needed(true_len, req.max_new_tokens)
             matched = (
@@ -3636,8 +3756,8 @@ class ContinuousBatcher:
             if self.pool.available(
                 excluding_parked=matched_parked
             ) < new_need:
-                return
-            self._pending.popleft()
+                break
+            del self._pending[pick]
             cached = len(matched) * PAGE_ROWS
             blocks = [n.block for n in matched]
             if self._prefix is not None:
@@ -3660,6 +3780,7 @@ class ContinuousBatcher:
             entry = _Prefill(
                 req, s, blocks, consumed=cached, cached=cached,
                 nodes=list(matched), resv=new_need - new_now,
+                sp=self._is_long(req),
             )
             if self._prefix is not None:
                 # Register this prompt's remaining full blocks so
@@ -3684,13 +3805,30 @@ class ContinuousBatcher:
             self.pool.reserved += entry.resv
             self._prefilling.append(entry)
             busy.add(s)
+            if entry.sp:
+                self.obs.sp_requests.inc()
             self.obs.queue_depth.set(len(self._pending))
             self.obs.lane_active.set(len(self._prefilling))
+            self.obs.sp_active.set(
+                sum(1 for p in self._prefilling if p.sp)
+            )
             self._set_pool_gauges()
             self.obs.trace.admitted(
                 req.rid, time.monotonic(), s, len(blocks),
                 cached=cached,
             )
+        if held_long:
+            # One count per admission turn in which a long prompt
+            # waited for the dedicated long lane (however many slots
+            # this turn scanned) — the starvation-protection events
+            # the fairness bench reads.
+            self.obs.sp_holds.inc()
+
+    def _is_long(self, req: _Request) -> bool:
+        """Prompt-length-aware admission class: True when the
+        sequence-parallel lane is on and the prompt meets the
+        `sp_min_tokens` threshold."""
+        return self.sp_prefill and len(req.prompt) >= self.sp_min_tokens
 
     def _admit_dense(self) -> None:
         for s in range(self.slots):

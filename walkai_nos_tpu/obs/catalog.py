@@ -98,6 +98,33 @@ CATALOG: tuple[MetricSpec, ...] = (
         "dispatches (utilization denominator)",
         attr="lane_capacity",
     ),
+    # -- sequence-parallel prefill lane (models/serve.py sp mode) ------
+    MetricSpec(
+        "cb_prefill_sp_requests_total", "counter",
+        "Long prompts (>= sp_min_tokens) admitted onto the dedicated "
+        "sequence-parallel prefill lane",
+        attr="sp_requests",
+    ),
+    MetricSpec(
+        "cb_prefill_sp_rows_total", "counter",
+        "Lane rows claimed by sequence-parallel fan-out (each row one "
+        "chunk window of a long prompt), summed over lane dispatches "
+        "in which a long entry fanned wider than one row",
+        attr="sp_rows",
+    ),
+    MetricSpec(
+        "cb_prefill_sp_active", "gauge",
+        "Sequence-parallel (long-prompt) entries currently "
+        "prefilling — 0 or 1 under the dedicated-long-lane policy",
+        attr="sp_active",
+    ),
+    MetricSpec(
+        "cb_prefill_sp_holds_total", "counter",
+        "Admission turns in which a long prompt waited for the "
+        "dedicated long lane while shorter prompts admitted around "
+        "it (the length-aware starvation protection firing)",
+        attr="sp_holds",
+    ),
     MetricSpec(
         "cb_kv_pool_blocks", "gauge",
         "Paged KV pool blocks by state (scratch block excluded)",
@@ -634,6 +661,17 @@ CATALOG: tuple[MetricSpec, ...] = (
         "across all router-brokered ships",
         component="router",
         attr="xfer_blocks_shipped",
+    ),
+    MetricSpec(
+        "router_xfer_bytes_total", "counter",
+        "Decoded K/V tile payload bytes moved by router-brokered "
+        "block ships, by tile storage dtype (int8 pools ship their "
+        "data tiles at ~2x fewer bytes than bf16; their f32 scale "
+        "tiles count under their own dtype) — the wire-saving "
+        "measurement for quantized shipping",
+        labels=("dtype",),
+        component="router",
+        attr="xfer_bytes",
     ),
     MetricSpec(
         "router_xfer_failures_total", "counter",

@@ -30,6 +30,31 @@ from walkai_nos_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ
 _NEG_INF = -1e30
 
 
+def online_fold(acc, m_prev, l_prev, s, v):
+    """One online-softmax fold: merge a score block `s` ([..., q, k],
+    already masked, f32) and its value block `v` ([..., k, d]) into the
+    running (acc, m, l) accumulator. This is the associative merge every
+    ring hop performs — factored out so the paged engine's streamed
+    wide-prefill tail (`ops/sp_prefill.py`), whose "ring" is over HBM
+    cache tiles instead of ICI neighbors, folds with the exact same
+    math. Returns (acc, m_new, l_new)."""
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return acc, m_new, l_new
+
+
+def online_finish(acc, l):
+    """Normalize an online-softmax accumulator into attention output."""
+    return acc / l[..., None]
+
+
 def infer_batch_axes(
     mesh: Mesh, axis_name: str, batch_size: int
 ) -> tuple[str, ...]:
@@ -78,16 +103,7 @@ def _ring_body(i, carry, *, axis_name, axis_size, q, causal, q_off, sk,
     def _accumulate(operands):
         acc, m_prev, l_prev = operands
         s = _local_block(q, k_cur, v_cur, q_off, k_off, causal, align)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[..., None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        return acc, m_new, l_new
+        return online_fold(acc, m_prev, l_prev, s, v_cur)
 
     if causal:
         # A ring step whose whole incoming shard lies in the future

@@ -1,0 +1,139 @@
+"""Sequence-parallel prefill attention (the long-context serving lane).
+
+Two pieces, both exact attention:
+
+- `streamed_cache_attention` — the paged engine's wide-prefill tail as
+  an online-softmax STREAM over PAGE_ROWS-sized cache tiles: the ring
+  schedule of `ops/ring_attention.py` with the ICI neighbor hop
+  replaced by an HBM tile fetch, folding each tile with the SAME
+  `online_fold` merge the ring uses, so the [rows, table_width*128]
+  score block the dense reference materializes never exists. Fully
+  future tiles are skipped exactly like the ring's fully masked hops.
+  Routed in `models/lm.py` behind `_sp_stream_backend_ok()` (real TPU
+  or `WALKAI_SP_STREAM=1`); off-TPU the dense reference
+  (`_masked_cache_attention`) stays the default so CPU parity tests
+  pin exact token identity.
+
+- `sp_ring_prefill` — exact sequence-parallel prefill attention over a
+  mesh axis (`ring_attention` aimed at the serving mesh's `model`
+  axis): each shard holds a contiguous sequence slice
+  (`parallel/sharding.seq_shard_bounds`) and K/V rotate around the
+  ring, for prompts bigger than one shard's HBM. The serving engine's
+  scheduler-level fan-out (`models/serve.py` sp lane) spreads a long
+  prompt's chunk windows across lane rows that the TP machinery
+  already head-shards (Ulysses-form with the all_to_all elided); this
+  wrapper is the device-level form of the same schedule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from walkai_nos_tpu.ops.ring_attention import (
+    _NEG_INF,
+    online_finish,
+    online_fold,
+    ring_attention,
+)
+from walkai_nos_tpu.parallel.mesh import AXIS_MODEL
+
+__all__ = ["sp_ring_prefill", "streamed_cache_attention"]
+
+
+def streamed_cache_attention(q, k_all, v_all, idx, *, tile: int = 128):
+    """Masked attention over a full cache view, streamed tile by tile.
+
+    Same contract as the dense reference (`models/lm.py`
+    `_masked_cache_attention` with ragged per-row offsets): q
+    [batch, heads, steps, d]; k/v_all [batch, kv_heads, cache_len, d];
+    idx [batch] — position p visible to query row r iff p <= idx + r.
+    GQA queries group onto their KV head exactly like the reference
+    (the cache streams once at kv_heads width). The cache axis is
+    consumed in `tile`-row blocks through an online-softmax
+    accumulator (`online_fold`, shared with the ring), with fully
+    future tiles skipped under `lax.cond` — per-tile peak memory is
+    [rows, tile] instead of [rows, cache_len]."""
+    batch, heads, steps, head_dim = q.shape
+    kv_heads = k_all.shape[1]
+    cache_len = k_all.shape[2]
+    tile = max(1, min(int(tile), cache_len))
+    pad = (-cache_len) % tile
+    if pad:
+        grow = ((0, 0), (0, 0), (0, pad), (0, 0))
+        k_all = jnp.pad(k_all, grow)
+        v_all = jnp.pad(v_all, grow)
+    ntiles = (cache_len + pad) // tile
+    scale = head_dim ** -0.5
+    group = heads // kv_heads
+    rows = group * steps
+    # Grouped layout ([b*kv_heads] batch cells, group*steps query rows
+    # each) — the reference's GQA reshape, so K/V stream once in their
+    # storage dtype with f32 MXU accumulation.
+    qg = q.reshape(batch * kv_heads, rows, head_dim)
+    kg = k_all.reshape(batch * kv_heads, -1, head_dim)
+    vg = v_all.reshape(batch * kv_heads, -1, head_dim)
+    q_pos = idx[:, None] + jnp.arange(steps)  # [batch, steps]
+    q_pos_g = jnp.broadcast_to(
+        q_pos[:, None, None, :], (batch, kv_heads, group, steps)
+    ).reshape(batch * kv_heads, rows)
+    horizon = jnp.max(q_pos)  # newest position any row may see
+
+    acc0 = jnp.zeros((batch * kv_heads, rows, head_dim), jnp.float32)
+    m0 = jnp.full((batch * kv_heads, rows), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch * kv_heads, rows), jnp.float32)
+
+    def body(t, carry):
+        acc, m, l = carry
+        k_t = jax.lax.dynamic_slice_in_dim(kg, t * tile, tile, axis=1)
+        v_t = jax.lax.dynamic_slice_in_dim(vg, t * tile, tile, axis=1)
+        k_pos = t * tile + jnp.arange(tile)
+
+        def fold(operands):
+            acc, m, l = operands
+            s = jnp.einsum(
+                "xrd,xkd->xrk", qg, k_t,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            visible = (
+                (k_pos[None, None, :] <= q_pos_g[:, :, None])
+                & (k_pos[None, None, :] < cache_len)
+            )
+            s = jnp.where(visible, s, _NEG_INF)
+            return online_fold(acc, m, l, s, v_t)
+
+        # A tile wholly in every row's future contributes nothing —
+        # the ring's fully-masked-hop skip, over HBM tiles.
+        return jax.lax.cond(
+            t * tile > horizon, lambda operands: operands, fold,
+            (acc, m, l),
+        )
+
+    acc, _m, l = jax.lax.fori_loop(0, ntiles, body, (acc0, m0, l0))
+    out = online_finish(acc, l).astype(q.dtype)
+    return out.reshape(batch, heads, steps, head_dim)
+
+
+def sp_ring_prefill(
+    q, k, v, mesh: Mesh, *,
+    causal: bool = True,
+    axis_name: str = AXIS_MODEL,
+):
+    """Exact sequence-parallel prefill attention over `mesh`'s
+    `axis_name` ring — `ring_attention` on the SERVING mesh (whose
+    only axis is `model`), batch replicated. Inputs are global
+    [batch, heads, seq, head_dim] arrays with seq divisible by the
+    axis size (equal shards are the ring's contract); each shard
+    computes its `seq_shard_bounds` slice and K/V make one full ring
+    rotation."""
+    n = int(dict(mesh.shape).get(axis_name, 1))
+    if n > 1 and q.shape[2] % n:
+        raise ValueError(
+            f"sp_ring_prefill: seq={q.shape[2]} must divide the "
+            f"{axis_name!r} axis size {n} into equal shards"
+        )
+    return ring_attention(
+        q, k, v, mesh, causal=causal, axis_name=axis_name,
+        batch_axes=(),
+    )

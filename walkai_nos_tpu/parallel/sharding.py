@@ -191,6 +191,25 @@ def params_shard_bytes(params) -> int:
     return total
 
 
+def seq_shard_bounds(
+    shard: int, n_shards: int, length: int
+) -> tuple[int, int]:
+    """Contiguous [start, stop) sequence slice owned by `shard` of
+    `n_shards`: even split with the remainder dealt to the leading
+    shards. The one host-side slicing rule of the sequence-parallel
+    prefill plane — `ops/sp_prefill.py` shards ride it, and tests use
+    it to slice reference activations — so every consumer agrees on
+    which global positions a shard owns."""
+    if not 0 <= shard < n_shards:
+        raise ValueError(
+            f"shard {shard} out of range for {n_shards} shards"
+        )
+    base, rem = divmod(max(0, length), n_shards)
+    start = shard * base + min(shard, rem)
+    stop = start + base + (1 if shard < rem else 0)
+    return start, stop
+
+
 def batch_sharding(mesh: Mesh, *, seq_axis: int | None = None) -> NamedSharding:
     """Sharding for a batch: batch dim over (data, fsdp), optional sequence
     dim over the seq axis (sequence/context parallelism for long inputs)."""

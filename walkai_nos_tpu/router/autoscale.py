@@ -25,6 +25,14 @@ piece in between. Each `tick()`:
    produces exactly one scale-up and one scale-down instead of
    thrashing partitioner plans.
 
+Between hysteresis and the scale steps sits **anomaly evacuation**:
+when the fleet's AnomalyDetector flags a replica
+(`fleet.anomaly_flagged_names()`), the reconciler auto-triggers its
+migrate-first drain (`fleet.start_drain` — resident KV ships to
+healthy peers before retirement) without waiting for an idle window,
+gated only by the cooldown, `min_replicas`, and any drain already in
+flight; the trace event carries `reason="anomaly"`.
+
 Scale-up asks the provider for a slice-backed replica and admits it
 to the fleet (power-of-two-choices routing favors it immediately —
 it is the least-loaded candidate). Scale-down picks the
@@ -194,12 +202,45 @@ class Reconciler:
                     handle.name,
                 )
         active = fleet.active_handles()
+        flagged_names: set[str] = set()
+        flagged_of = getattr(fleet, "anomaly_flagged_names", None)
+        if flagged_of is not None:
+            flagged_names = set(flagged_of())
         # 2. Consecutive-tick hysteresis counters.
         pressured = self._pressured(active)
         self._over = self._over + 1 if pressured else 0
         self._under = self._under + 1 if self._idle(active) else 0
         if self._tick < self._cooldown_until:
             return
+        # 2b. Anomaly evacuation: a replica the fleet's AnomalyDetector
+        # flagged is rotated out NOW — `start_drain` is migrate-first
+        # (PR 16), so its resident KV ships to healthy peers instead of
+        # finishing on the sick chip. No hysteresis (the detector's own
+        # window IS the debounce), but the cooldown gate above still
+        # rate-limits to one evacuation per window, min_replicas is
+        # respected, and an in-flight drain defers the next victim.
+        if (
+            flagged_names
+            and len(active) > self.policy.min_replicas
+            and not fleet.draining_handles()
+        ):
+            flagged = [h for h in active if h.name in flagged_names]
+            if flagged:
+                victim = max(
+                    flagged, key=lambda h: replica_load(h.replica)
+                )
+                fleet.start_drain(victim)
+                self._event("down")
+                self._trace_event(
+                    "drain_start", replica=victim.name,
+                    reason="anomaly",
+                    signals=self._signal_snapshot(active),
+                )
+                logger.info(
+                    "router: anomaly evacuation draining replica %s",
+                    victim.name,
+                )
+                return
         # 3a. Scale up.
         if (
             self._over >= self.policy.breach_ticks
@@ -244,12 +285,6 @@ class Reconciler:
             self._under >= self.policy.idle_ticks
             and len(active) > self.policy.min_replicas
         ):
-            flagged_names = set()
-            flagged_of = getattr(
-                fleet, "anomaly_flagged_names", None
-            )
-            if flagged_of is not None:
-                flagged_names = set(flagged_of())
             pool = [
                 h for h in active if h.name in flagged_names
             ] or active

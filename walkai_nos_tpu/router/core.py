@@ -218,6 +218,10 @@ class FleetRouter:
         self._routes: dict[int, tuple[_Handle, int, str]] = {}
         self._local: dict[tuple[int, int], int] = {}
         self._done: dict[int, dict] = {}
+        # Wire bytes shipped through block transfers, keyed by tile
+        # dtype — the router-side ledger behind
+        # `router_xfer_bytes_total{dtype}`.
+        self._xfer_bytes: dict[str, int] = {}
         # router rid -> affinity key, held while the request is in
         # flight: the disaggregated decode stage places a stream by
         # its template key at handoff time.
@@ -523,6 +527,31 @@ class FleetRouter:
             is not None
         )
 
+    def _count_xfer_bytes(self, payload: dict) -> None:
+        """Wire-byte accounting for one brokered transfer payload:
+        decoded tile bytes per storage dtype (b64 carries 4 chars per
+        3 bytes), into `router_xfer_bytes_total{dtype}` and the
+        `stats()` tally — the measurement behind the int8 pools'
+        claimed ~2x wire saving (scale-f32 tiles count under their
+        own `float32` dtype, the honest denominator)."""
+        per_dtype: dict[str, int] = {}
+        for t in payload.get("tiles", []) + payload.get(
+            "draft_tiles", []
+        ):
+            dtype_name = str(t.get("dtype", "unknown"))
+            per_dtype[dtype_name] = (
+                per_dtype.get(dtype_name, 0)
+                + len(t.get("data", "")) * 3 // 4
+            )
+        for dtype_name, nbytes in per_dtype.items():
+            if nbytes:
+                self.obs.xfer_bytes.inc(
+                    nbytes, labels={"dtype": dtype_name}
+                )
+                self._xfer_bytes[dtype_name] = (
+                    self._xfer_bytes.get(dtype_name, 0) + nbytes
+                )
+
     def _ship(self, src: _Handle, dst: _Handle, prompt) -> None:
         """Broker one prefix-block transfer: export the prompt's
         chain of block hashes from `src`, import into `dst`. Best
@@ -541,6 +570,9 @@ class FleetRouter:
             if not payload.get("blocks"):
                 self.obs.xfer_ships.inc(labels={"outcome": "empty"})
                 return
+            # Bytes count at the export/import seam: the payload has
+            # left the source whatever the import's fate.
+            self._count_xfer_bytes(payload)
             result = dst.replica.import_blocks(payload)
         except Exception as err:  # noqa: BLE001 — transport seam
             self.obs.xfer_ships.inc(labels={"outcome": "error"})
@@ -599,6 +631,7 @@ class FleetRouter:
         )
         if not moved:
             return
+        self._count_xfer_bytes(payload)
         targets = sorted(
             (
                 h for h in self._handles
@@ -689,6 +722,7 @@ class FleetRouter:
                 payload = replica.export_resident(only=[local])
                 if not payload.get("migrate"):
                     continue
+                self._count_xfer_bytes(payload)
                 try:
                     landed = dst.replica.import_resident(payload)
                 except RuntimeError:
@@ -1132,6 +1166,7 @@ class FleetRouter:
             ),
             "scale_events": self.scale_events(),
             "in_flight": len(self._routes),
+            "xfer_bytes": dict(self._xfer_bytes),
             "anomaly_flagged": self.anomaly_flagged_names(),
             "flight_dir": (
                 self.flight.dir if self.flight is not None else None

@@ -69,6 +69,7 @@ ENGINE_KNOBS = (
     "loop_steps", "paged", "pool_blocks", "prefill_chunk",
     "prefill_lanes", "prefix_cache", "spec", "spec_k",
     "spec_min_accept", "spec_warmup_rounds", "spec_ema_alpha",
+    "sp_prefill", "sp_min_tokens", "sp_span",
 )
 
 

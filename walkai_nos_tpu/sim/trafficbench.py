@@ -26,6 +26,11 @@ Headline keys (gated absent_ok in BASELINE.json, emitted by
   on ONE replica instead of sprayed across all);
 - `router_scale_events_total` — reconciler actions during the
   replay (up + down) when autoscaling is enabled;
+- `cb_prefill_100k_ttft_s` / `cb_short_p99_under_long_load` — the
+  bimodal long-context arm (`run_long_context_benchmark`): one very
+  long prompt beside a short-prompt stream through the sequence-
+  parallel prefill lane, sp-on vs sp-off — long TTFT must improve,
+  short p99 must hold;
 - `router_obs_overhead_pct` — the fleet observability plane's cost
   (`measure_router_obs_overhead`: the same trace replayed with the
   router-side plane on vs off, engine telemetry on in both arms),
@@ -53,6 +58,7 @@ __all__ = [
     "TrafficBenchResult",
     "make_trace",
     "measure_router_obs_overhead",
+    "run_long_context_benchmark",
     "run_traffic_benchmark",
 ]
 
@@ -427,6 +433,120 @@ def run_traffic_benchmark(
         noship_prefix_hit_rate=noship_rate,
         disagg_per_request_tokens=disagg_tokens,
     )
+
+
+def run_long_context_benchmark(
+    *,
+    slots: int = 4,
+    short_requests: int = 12,
+    short_tokens: int = 24,
+    long_tokens: int = 320,
+    sp_min_tokens: int = 256,
+    sp_span: int = 0,
+    prefill_chunk: int = 64,
+    prefill_lanes: int = 4,
+    cache_len: int = 512,
+    max_new: int = 4,
+    shorts_per_step: int = 2,
+    seed: int = 0,
+    cfg=None,
+    params=None,
+) -> dict:
+    """Bimodal 1k/100k arm for the sequence-parallel prefill lane:
+    ONE long prompt (`long_tokens`, >= `sp_min_tokens` — the CPU-
+    scaled stand-in for a 100k-token context) submitted ahead of a
+    stream of short prompts, replayed through two otherwise-identical
+    engines — sp ON and sp OFF — on the same deterministic prompts.
+
+    Headline keys (absent_ok in BASELINE.json):
+
+    - `cb_prefill_100k_ttft_s` — the long prompt's TTFT with sp ON
+      (its chunk windows fan out across lane rows, so prefill takes
+      ~windows/span dispatches instead of one per window);
+    - `cb_short_p99_under_long_load` — p99 TTFT of the short prompts
+      admitted WHILE the long prompt prefills, sp ON: the fairness
+      half of the contract (length-aware admission must keep short-
+      prompt latency within a few percent of the sp-off engine even
+      as the long prompt takes its spare rows);
+    - `cb_prefill_100k_ttft_sp_off_s` / `cb_short_p99_sp_off` — the
+      same two numbers from the sp-OFF arm, the comparison floor.
+    """
+    import jax
+
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+    from walkai_nos_tpu.ops.decode_attention import PAGE_ROWS
+
+    if cfg is None:
+        cfg = LMConfig(
+            vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+            max_seq_len=max(512, cache_len),
+        )
+    if params is None:
+        params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    long_prompt = rng.integers(
+        0, cfg.vocab_size, long_tokens
+    ).astype(np.int32)
+    shorts = [
+        rng.integers(0, cfg.vocab_size, short_tokens).astype(np.int32)
+        for _ in range(short_requests)
+    ]
+    pool_blocks = slots * -(-cache_len // PAGE_ROWS) + 1 + 8
+
+    def one_arm(sp: bool) -> tuple[float | None, float | None]:
+        eng = ContinuousBatcher(
+            cfg, params, slots=slots, cache_len=cache_len,
+            paged=True, pool_blocks=pool_blocks,
+            prefill_chunk=prefill_chunk,
+            prefill_lanes=prefill_lanes,
+            sp_prefill=sp, sp_min_tokens=sp_min_tokens,
+            sp_span=sp_span,
+            # The arm measures prefill COMPUTE fan-out; with the
+            # cache on, the warm pass below would turn the timed
+            # long prompt into a full prefix hit and measure nothing.
+            prefix_cache=False,
+        )
+        eng.warm()
+        # warm() covers the admission-burst widths but not the
+        # multi-window lane shapes a long prompt drives (nor the sp
+        # span fan-out); run the same prompt shapes through once,
+        # discarded, so the timed phase measures steps, not XLA.
+        eng.submit(long_prompt, max_new_tokens=1)
+        eng.submit(shorts[0], max_new_tokens=1)
+        eng.run()
+        eng.drain_done_records()
+        records: dict[int, dict] = {}
+        long_rid = eng.submit(long_prompt, max_new_tokens=max_new)
+        pending = list(shorts)
+        while pending or eng.has_work:
+            for _ in range(shorts_per_step):
+                if pending:
+                    eng.submit(
+                        pending.pop(0), max_new_tokens=max_new
+                    )
+            eng.step()
+            records.update(eng.drain_done_records())
+        records.update(eng.drain_done_records())
+        long_ttft = records.get(long_rid, {}).get("ttft_s")
+        short_ttfts = sorted(
+            r["ttft_s"] for rid, r in records.items()
+            if rid != long_rid and r.get("ttft_s") is not None
+        )
+        return long_ttft, percentile(short_ttfts, 99)
+
+    off_long, off_short = one_arm(False)
+    on_long, on_short = one_arm(True)
+    out: dict = {}
+    if on_long is not None:
+        out["cb_prefill_100k_ttft_s"] = round(on_long, 4)
+    if on_short is not None:
+        out["cb_short_p99_under_long_load"] = round(on_short, 4)
+    if off_long is not None:
+        out["cb_prefill_100k_ttft_sp_off_s"] = round(off_long, 4)
+    if off_short is not None:
+        out["cb_short_p99_sp_off"] = round(off_short, 4)
+    return out
 
 
 def measure_router_obs_overhead(
