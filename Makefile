@@ -4,7 +4,7 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check metrics-lint replay-check dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check metrics-lint replay-check canary-check dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
@@ -61,6 +61,16 @@ metrics-lint:
 # on any token divergence. Also tier-1 via tests/test_capture_replay.py.
 replay-check:
 	python hack/replay_check.py
+
+# Shadow/canary plane gate: a tiny in-process fleet mirrors 100% of
+# a deterministic run to a same-config canary (must PROMOTE with
+# zero digest divergences — exit 0), then to an injected-weights
+# canary, which must exit NONZERO by rejecting and naming the first
+# divergent request/token with a flight bundle. Also tier-1 via
+# tests/test_canary.py.
+canary-check:
+	python hack/canary_check.py
+	! python hack/canary_check.py --inject-divergence
 
 dryrun:
 	python __graft_entry__.py

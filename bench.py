@@ -648,6 +648,7 @@ def router_benchmark() -> dict:
     `router_noship_prefix_hit_rate`."""
     from walkai_nos_tpu.router.autoscale import ScalePolicy
     from walkai_nos_tpu.sim.trafficbench import (
+        measure_canary_overhead,
         measure_router_obs_overhead,
         run_long_context_benchmark,
         run_traffic_benchmark,
@@ -668,6 +669,13 @@ def router_benchmark() -> dict:
     )
     out = r.bench_keys()
     out.update(measure_router_obs_overhead())
+    # Shadow-plane A/B (`measure_canary_overhead`): the same trace
+    # with a same-config canary mirroring 100% of submits vs no
+    # canary — `router_canary_divergence_total` must be 0 (the
+    # mirror seam itself may not change tokens) and
+    # `router_canary_overhead_pct` (the router-plane tax, engine
+    # compute billed to the engines) shares the < 2% budget.
+    out.update(measure_canary_overhead())
     # Bimodal long-context arm (sequence-parallel prefill lane): one
     # CPU-scaled "100k" prompt beside a short-prompt stream, sp on vs
     # off — `cb_prefill_100k_ttft_s` (long TTFT, must improve) and
@@ -676,6 +684,57 @@ def router_benchmark() -> dict:
     # BASELINE.json.
     out.update(run_long_context_benchmark())
     return out
+
+
+def autotune_benchmark() -> dict:
+    """Replay autotune seed (`walkai_nos_tpu/sim/autotune.py`): a
+    tiny engine serves a deterministic mixed greedy/sampled window
+    with the capture plane armed, then the capture is replayed once
+    per single-knob override arm (loop_steps / prefill_chunk
+    neighbors around the captured config), every arm digest-verified
+    against the captured token streams. Headline key
+    `autotune_capacity_gain_pct` — the best VERIFIED arm's replayed
+    tokens/s gain over the capture's own config (absent_ok,
+    higher-better, floored at 0: the baseline config is always on
+    the menu). `autotune_divergent_arms` rides along and must be 0:
+    every grid axis is a determinism-preserving replay override, so
+    a divergent arm means the purity invariant broke."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+    from walkai_nos_tpu.sim.autotune import autotune_capture
+    from walkai_nos_tpu.sim.replay import load_capture
+
+    cfg = LMConfig(
+        vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+        max_seq_len=320, dtype="float32",
+    )
+    params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+    capture_dir = tempfile.mkdtemp(prefix="walkai-autotune-")
+    engine = ContinuousBatcher(
+        cfg, params, slots=2, cache_len=256, prompt_bucket=16,
+        chunk_steps=2, capture=capture_dir,
+    )
+    rng = np.random.default_rng(0)
+    for plen, temperature in (
+        (3, 0.0), (40, 0.0), (5, 1.0), (9, 1.0), (30, 1.0), (4, 0.0),
+        (60, 0.0), (12, 1.0),
+    ):
+        engine.submit(
+            rng.integers(0, cfg.vocab_size, plen).tolist(),
+            max_new_tokens=int(rng.integers(3, 9)), eos_id=3,
+            temperature=temperature,
+        )
+    while engine.has_work:
+        engine.step()
+        engine.drain_done_records()
+    engine.drain_done_records()
+    report = autotune_capture(load_capture(capture_dir), params)
+    return report.summary()
 
 
 def obs_overhead_benchmark() -> dict:
@@ -721,6 +780,10 @@ def main() -> None:
     except Exception as e:
         err = (err + "; " if err else "") + f"router: {e}"
     try:
+        result.update(autotune_benchmark())
+    except Exception as e:
+        err = (err + "; " if err else "") + f"autotune: {e}"
+    try:
         result.update(scheduling_benchmark())
     except Exception as e:
         err = (err + "; " if err else "") + f"scheduling: {e}"
@@ -753,6 +816,9 @@ def main() -> None:
             "router_disagg_ttft_p99",
             "cb_prefill_100k_ttft_s", "cb_short_p99_under_long_load",
             "router_scale_events_total", "router_obs_overhead_pct",
+            "router_canary_overhead_pct",
+            "router_canary_divergence_total",
+            "autotune_capacity_gain_pct",
             "noisy_neighbor_no_degradation", "spec_speedup",
         )
         if k in result

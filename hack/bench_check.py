@@ -73,13 +73,23 @@ def check(
             )
             continue
         if direction == "higher" and got < base * (1 - tol):
+            # A zero baseline is a hard floor (e.g. divergence
+            # counts): no relative % exists for it.
+            rel = (
+                f"{100 * (1 - got / base):.1f}% below" if base
+                else "below"
+            )
             failures.append(
-                f"{key}: {got} is {100 * (1 - got / base):.1f}% below "
+                f"{key}: {got} is {rel} "
                 f"baseline {base} (tolerance {tol:.0%})"
             )
         elif direction == "lower" and got > base * (1 + tol):
+            rel = (
+                f"{100 * (got / base - 1):.1f}% above" if base
+                else "above"
+            )
             failures.append(
-                f"{key}: {got} is {100 * (got / base - 1):.1f}% above "
+                f"{key}: {got} is {rel} "
                 f"baseline {base} (tolerance {tol:.0%}, lower is better)"
             )
         else:

@@ -1,0 +1,153 @@
+"""Shadow/canary plane gate (`make canary-check`, tier-1 via
+tests/test_canary.py).
+
+Builds a tiny in-process fleet (two primaries + one canary, 100%
+mirror fraction), drives deterministic mixed greedy/seeded-sampled
+traffic through the router, and exits 0 only when the same-config
+canary reaches the PROMOTE verdict with ZERO digest divergences —
+the end-to-end proof that the mirror seam does not change tokens and
+the verdict machine converges. With `--inject-divergence` the canary
+serves the same config over DIFFERENT weights (the failure class the
+digest gate exists for: a config delta cannot explain it) and the
+exit code must be NONZERO: 1 when the gate tripped as designed
+(REJECT verdict naming the first divergent request/token, flight
+bundle on disk), 2 when the divergence was mishandled — the gate
+itself is broken. `make canary-check` runs both arms.
+
+CPU-pinned and hardware-free: verdicts ride the purity invariant,
+which is exact on every backend, so the cheapest backend gates it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def run_fleet(inject_divergence: bool):
+    """Drive one canary-armed fleet to a terminal verdict; returns
+    (router.canary_stats(), completed primary records)."""
+    import jax
+    import numpy as np
+
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.router.core import PAGE_ROWS, FleetRouter
+    from walkai_nos_tpu.sim.trafficbench import default_engine_factory
+
+    cfg = LMConfig(
+        vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+        max_seq_len=512,
+    )
+    _, _, factory = default_engine_factory(cfg, None, slots=2)
+    replicas = [factory(f"r{i}") for i in range(2)]
+    router = FleetRouter(
+        replicas, seed=0, canary_mirror=1.0,
+        canary_opts={"min_compared": 4, "promote_ticks": 2},
+    )
+    canary_params = (
+        DecoderLM(cfg).init_params(jax.random.PRNGKey(99))
+        if inject_divergence else None
+    )
+    _, _, canary_factory = default_engine_factory(
+        cfg, canary_params, slots=2
+    )
+    canary = canary_factory("canary0")
+    for replica in replicas + [canary]:
+        replica.warm()
+    router.add_replica(canary, role="canary")
+
+    rng = np.random.default_rng(0)
+    n = 10
+    records: dict[int, dict] = {}
+    for i in range(n):
+        prompt = rng.integers(
+            0, cfg.vocab_size, PAGE_ROWS + 8
+        ).astype(np.int32)
+        temperature = 1.0 if i % 3 == 0 else 0.0
+        router.submit(
+            prompt, max_new_tokens=5, temperature=temperature
+        )
+    for _ in range(80):
+        router.step()
+        records.update(router.drain_done_records())
+        if len(records) >= n and not router.has_work:
+            break
+    # Verdict ticks keep running after traffic drains (promote needs
+    # consecutive clean evaluations; reject is already terminal).
+    for _ in range(6):
+        router.step()
+    return router.canary_stats(), records
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--inject-divergence", action="store_true",
+        help="canary serves different WEIGHTS under the same config; "
+             "the gate then requires a REJECT verdict",
+    )
+    args = parser.parse_args(argv)
+
+    stats, records = run_fleet(args.inject_divergence)
+    state = stats["state"]
+    print(
+        f"canary-check: state={state} gate={stats['gate']} "
+        f"mirrored={stats['mirrored']} compared={stats['compared']} "
+        f"divergences={stats['divergences']} "
+        f"primaries_completed={len(records)}"
+    )
+    if args.inject_divergence:
+        # This arm must exit NONZERO: 1 = the gate tripped as
+        # designed, 2 = the divergence was mishandled (the gate
+        # itself is broken — the worse failure).
+        first = stats["first_divergence"]
+        if state != "reject" or not first:
+            print(
+                "canary-check FAILED: injected-weights canary must "
+                f"REJECT with a first divergence (state={state}, "
+                f"first_divergence={first})"
+            )
+            return 2
+        print(
+            f"injected divergence localized: request {first['rid']} "
+            f"token {first['token_index']} expected "
+            f"{first['expected_token']} got {first['got_token']}; "
+            f"flight bundle {first['bundle_path']}"
+        )
+        if not (
+            first["bundle_path"]
+            and os.path.isfile(first["bundle_path"])
+        ):
+            print(
+                "canary-check FAILED: no flight bundle on disk for "
+                "the divergence"
+            )
+            return 2
+        print(
+            "canary-check: injected-divergence arm tripped the gate "
+            "as designed"
+        )
+        return 1
+    if state != "promote" or stats["divergences"] != 0:
+        print(
+            "canary-check FAILED: same-config canary must PROMOTE "
+            f"with zero divergences (state={state}, "
+            f"divergences={stats['divergences']}, "
+            f"reason={stats['verdict_reason']})"
+        )
+        return 1
+    print(
+        f"promoted: {stats['verdict_reason']} "
+        f"(winning fingerprint {stats['winning_fingerprint']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -616,3 +616,90 @@ class TestRepoArtifacts:
         text = (_ROOT / "Makefile").read_text()
         assert "replay-check:" in text
         assert "hack/replay_check.py" in text
+
+    def test_makefile_has_canary_check_target(self):
+        # The shadow/canary plane gate (hack/canary_check.py) —
+        # pinned fast in tests/test_canary.py.
+        text = (_ROOT / "Makefile").read_text()
+        assert "canary-check:" in text
+        assert "hack/canary_check.py" in text
+
+    def test_repo_baseline_gates_canary_keys(self):
+        """The shadow plane's two bench keys: the router-side tax is
+        held to the SAME absolute < 2% budget as
+        `router_obs_overhead_pct`, and a same-config mirror must
+        produce ZERO digest divergences — a nonzero count means the
+        mirror seam itself changes tokens, which would invalidate
+        every real canary verdict."""
+        with open(_ROOT / "BASELINE.json") as f:
+            baseline = json.load(f)
+        spec = baseline["published"]["router_canary_overhead_pct"]
+        assert spec["value"] == 2.0
+        assert spec["direction"] == "lower"
+        assert spec["tolerance"] == 0.0
+        assert spec["absent_ok"] is True
+        spec = baseline["published"]["router_canary_divergence_total"]
+        assert spec["value"] == 0.0
+        assert spec["direction"] == "lower"
+        assert spec["tolerance"] == 0.0
+        assert spec["absent_ok"] is True
+        failures, notes = bench_check.check({}, baseline)
+        assert not any("router_canary" in f for f in failures)
+        assert any(
+            "router_canary_divergence_total" in n and "absent" in n
+            for n in notes
+        )
+        failures, _ = bench_check.check(
+            {
+                "router_canary_overhead_pct": 1.3,
+                "router_canary_divergence_total": 0,
+            },
+            baseline,
+        )
+        assert not any("router_canary" in f for f in failures)
+        failures, _ = bench_check.check(
+            {
+                "router_canary_overhead_pct": 2.4,
+                "router_canary_divergence_total": 3,
+            },
+            baseline,
+        )
+        assert any(
+            "router_canary_overhead_pct" in f for f in failures
+        )
+        assert any(
+            "router_canary_divergence_total" in f for f in failures
+        )
+
+    def test_repo_baseline_gates_autotune_gain(self):
+        """The replay autotune seed's headline
+        (`autotune_capacity_gain_pct`, sim/autotune.py): floored at 0
+        by construction (keeping the captured config is always on the
+        menu), higher-better, absent is a skip note."""
+        with open(_ROOT / "BASELINE.json") as f:
+            baseline = json.load(f)
+        spec = baseline["published"]["autotune_capacity_gain_pct"]
+        assert spec["value"] == 0.0
+        assert spec["direction"] == "higher"
+        assert spec["tolerance"] == 0.0
+        assert spec["absent_ok"] is True
+        failures, notes = bench_check.check({}, baseline)
+        assert not any(
+            "autotune_capacity_gain_pct" in f for f in failures
+        )
+        assert any(
+            "autotune_capacity_gain_pct" in n and "absent" in n
+            for n in notes
+        )
+        failures, _ = bench_check.check(
+            {"autotune_capacity_gain_pct": 7.5}, baseline
+        )
+        assert not any(
+            "autotune_capacity_gain_pct" in f for f in failures
+        )
+        failures, _ = bench_check.check(
+            {"autotune_capacity_gain_pct": -1.0}, baseline
+        )
+        assert any(
+            "autotune_capacity_gain_pct" in f for f in failures
+        )

@@ -1,0 +1,729 @@
+"""Shadow/canary serving plane (router/core.py canary role,
+obs/canary.py, cmd/serverouter.py /debug/canary, hack/canary_check.py).
+
+The acceptance contract: a canary-armed router mirrors a sampled
+fraction of live submits to a candidate-config replica — same prompt,
+knobs, and effective seed — while the primary serves the user and the
+mirror stays invisible to routing, admission pressure, and every
+scale signal. A same-config canary at 100% mirror must reach the
+PROMOTE verdict with zero digest divergences; an injected-weights
+canary must REJECT naming the exact first divergent (request, token)
+with a readable flight bundle. Both verdicts run end-to-end through
+serverouter's HTTP surface, and the `make canary-check` gate is
+pinned fast here.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.obs.anomaly import FlightRecorder
+from walkai_nos_tpu.obs.canary import CanaryController
+from walkai_nos_tpu.obs.router import RouterObs
+from walkai_nos_tpu.router.core import PAGE_ROWS, FleetRouter
+from walkai_nos_tpu.sim.replay import (
+    classify_config_delta,
+    first_divergence,
+    load_capture,
+)
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+class FakeReplica:
+    """Scripted replica: submits are recorded with their kwargs,
+    records complete on the next step with scripted tokens — the
+    no-jax seam the mirror-fork and invisibility tests drive."""
+
+    def __init__(self, name, tokens=(1, 2, 3), queue=0):
+        self.name = name
+        self.tokens = list(tokens)
+        self.submits: list[dict] = []
+        self.fail_submits = False
+        self._rid = 0
+        self._pending = {}
+        self._draining = False
+        self._queue = queue
+
+    def submit(self, prompt, **kwargs):
+        if self._draining:
+            raise ValueError("draining")
+        if self.fail_submits:
+            raise RuntimeError("scripted submit failure")
+        rid = self._rid
+        self._rid += 1
+        self.submits.append(dict(kwargs))
+        self._pending[rid] = {
+            "tokens": list(self.tokens), "ttft_s": 0.01,
+            "wall_s": 0.03, "truncated": False,
+            "trace_id": kwargs.get("trace_id"),
+        }
+        return rid
+
+    def step(self):
+        pass
+
+    def drain_done_records(self):
+        done, self._pending = self._pending, {}
+        return done
+
+    saturation = 0.0
+    slo_ok = None
+    slots = 4
+
+    @property
+    def queue_depth(self):
+        return self._queue
+
+    @property
+    def has_work(self):
+        return bool(self._pending)
+
+    def drain(self):
+        self._draining = True
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def prefix_stats(self):
+        return {}
+
+
+def _template(seed, extra=8):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 64, PAGE_ROWS + extra).astype(np.int32)
+
+
+def _rec(tokens, *, ttft=0.01, wall=0.05, truncated=False, **extra):
+    return {
+        "tokens": list(tokens), "ttft_s": ttft, "wall_s": wall,
+        "truncated": truncated, **extra,
+    }
+
+
+class TestConfigDeltaClassification:
+    """The up-front gate decision: which config deltas demand
+    byte-identical tokens and which only allow latency comparison."""
+
+    def _fp(self, cfg=None, engine=None):
+        return {"cfg": dict(cfg or {}), "engine": dict(engine or {})}
+
+    def test_identical_configs_are_token_preserving(self):
+        fp = self._fp({"hidden_dim": 32}, {"loop_steps": 1})
+        out = classify_config_delta(fp, fp)
+        assert out["token_preserving"] is True
+        assert out["delta"] == []
+
+    def test_engine_knob_delta_keeps_the_gate_armed(self):
+        a = self._fp({}, {"loop_steps": 1, "prefill_chunk": 64})
+        b = self._fp({}, {"loop_steps": 8, "prefill_chunk": 32})
+        out = classify_config_delta(a, b)
+        assert out["token_preserving"] is True
+        assert len(out["delta"]) == 2
+
+    def test_model_dim_delta_moves_the_function(self):
+        a = self._fp({"hidden_dim": 32}, {})
+        b = self._fp({"hidden_dim": 64}, {})
+        out = classify_config_delta(a, b)
+        assert out["token_preserving"] is False
+        assert out["moving_fields"] == ["cfg.hidden_dim"]
+
+    def test_int8_sim_preserves_real_int8_moves(self):
+        a = self._fp({"kv_dtype": "model"}, {})
+        sim = self._fp({"kv_dtype": "int8-sim"}, {})
+        real = self._fp({"kv_dtype": "int8"}, {})
+        assert classify_config_delta(a, sim)["token_preserving"]
+        out = classify_config_delta(a, real)
+        assert out["token_preserving"] is False
+        assert out["moving_fields"] == ["cfg.kv_dtype"]
+
+    def test_first_divergence_prefix_rule(self):
+        assert first_divergence([1, 2, 3], [1, 2, 9]) == 2
+        assert first_divergence([1, 2], [1, 2, 3]) == 2  # prefix end
+
+
+class TestControllerVerdicts:
+    """The verdict machine on scripted completion pairs — no router,
+    no engines; the controller owns no side effects."""
+
+    def _ctrl(self, **kw):
+        kw.setdefault("min_compared", 2)
+        kw.setdefault("promote_ticks", 2)
+        kw.setdefault("reject_ticks", 2)
+        return CanaryController(obs=RouterObs(), **kw)
+
+    def _feed_match(self, ctrl, rid, now=0.0):
+        ctrl.on_mirrored()
+        ctrl.on_primary(rid, _rec([1, 2, 3]), now)
+        ctrl.on_mirror(rid, _rec([1, 2, 3]), now)
+
+    def test_promote_hysteresis(self):
+        ctrl = self._ctrl()
+        assert ctrl.state == "warming"
+        self._feed_match(ctrl, 0)
+        assert ctrl.evaluate(1.0) == "warming"  # below min_compared
+        self._feed_match(ctrl, 1)
+        assert ctrl.evaluate(2.0) == "observing"
+        assert ctrl.evaluate(3.0) == "promote"  # 2 clean ticks
+        # Terminal verdicts are sticky.
+        ctrl.on_primary(2, _rec([7]), 4.0)
+        ctrl.on_mirror(2, _rec([8]), 4.0)
+        assert ctrl.evaluate(5.0) == "promote"
+
+    def test_digest_divergence_rejects_immediately(self):
+        ctrl = self._ctrl()
+        self._feed_match(ctrl, 0)
+        ctrl.on_primary(5, _rec([1, 2, 3, 4]), 1.0)
+        ctrl.on_mirror(5, _rec([1, 2, 9, 4]), 1.0)
+        assert ctrl.state == "reject"  # no vote, no window
+        assert ctrl.divergences == 1
+        first = ctrl.first_divergence
+        assert first["rid"] == 5
+        assert first["token_index"] == 2
+        assert first["expected_token"] == 3
+        assert first["got_token"] == 9
+
+    def test_truncated_streams_compare_by_common_prefix(self):
+        ctrl = self._ctrl()
+        ctrl.on_primary(0, _rec([1, 2, 3], truncated=True), 0.0)
+        ctrl.on_mirror(0, _rec([1, 2, 3, 4, 5]), 0.0)
+        assert ctrl.state == "warming"  # prefix match, no divergence
+        assert ctrl.divergences == 0
+        ctrl.on_primary(1, _rec([1, 9], truncated=True), 0.5)
+        ctrl.on_mirror(1, _rec([1, 2, 3]), 0.5)
+        assert ctrl.state == "reject"  # value moved INSIDE the prefix
+
+    def test_moving_config_delta_gates_latency_only(self):
+        ctrl = self._ctrl()
+        ctrl.set_fingerprints(
+            {"cfg": {"hidden_dim": 32}, "engine": {}},
+            {"cfg": {"hidden_dim": 64}, "engine": {}},
+        )
+        assert ctrl.gate_armed is False
+        ctrl.on_primary(0, _rec([1, 2, 3]), 0.0)
+        ctrl.on_mirror(0, _rec([9, 9, 9]), 0.0)  # declared drift
+        assert ctrl.divergences == 0
+        assert ctrl.state == "warming"
+        assert ctrl.stats()["gate"] == "latency_only"
+        assert ctrl.stats()["config_delta"]["moving_fields"] == [
+            "cfg.hidden_dim"
+        ]
+
+    def test_sustained_latency_breach_rejects(self):
+        ctrl = self._ctrl(latency_budget_pct=20.0, window_s=300.0)
+        ctrl.set_fingerprints(
+            {"cfg": {"kv_dtype": "model"}, "engine": {}},
+            {"cfg": {"kv_dtype": "int8"}, "engine": {}},
+        )
+        for rid in range(4):
+            ctrl.on_mirrored()
+            ctrl.on_primary(rid, _rec([1, 2, 3], ttft=0.01), 1.0)
+            ctrl.on_mirror(
+                rid, _rec([1, 2, 3], ttft=0.5, wall=2.0), 1.0
+            )
+        assert ctrl.evaluate(2.0) == "observing"
+        assert ctrl.evaluate(3.0) == "reject"  # 2 breached ticks
+        assert "latency regression" in ctrl.verdict_reason
+        delta = ctrl.stats()["latency_delta_pct"]["ttft_p99"]
+        assert delta is not None and delta > 20.0
+
+    def test_engine_knob_delta_keeps_digest_gate(self):
+        ctrl = self._ctrl()
+        ctrl.set_fingerprints(
+            {"cfg": {}, "engine": {"loop_steps": 1}},
+            {"cfg": {}, "engine": {"loop_steps": 8}},
+        )
+        assert ctrl.gate_armed is True
+        assert ctrl.stats()["gate"] == "digest_exact"
+
+    def test_mirror_error_never_promotes_past(self):
+        ctrl = self._ctrl()
+        ctrl.on_primary(0, _rec([1, 2, 3]), 0.0)
+        ctrl.on_mirror(0, {"error": "boom", "tokens": None}, 0.0)
+        assert ctrl.mirror_errors == 1
+        assert ctrl.divergences == 0
+
+    def test_divergence_dumps_flight_bundle(self, tmp_path):
+        flight = FlightRecorder(str(tmp_path), min_interval_s=0.0)
+        ctrl = CanaryController(
+            obs=RouterObs(), flight=flight, min_compared=2,
+        )
+        ctrl.set_fingerprints(
+            {"id": "aaa", "cfg": {}, "engine": {}},
+            {"id": "bbb", "cfg": {}, "engine": {}},
+        )
+        ctrl.on_primary(3, _rec([1, 2, 3], trace_id="t-3"), 0.0)
+        ctrl.on_mirror(3, _rec([1, 5, 3]), 0.0)
+        path = ctrl.first_divergence["bundle_path"]
+        assert path and pathlib.Path(path).is_file()
+        with open(path) as f:
+            bundle = json.load(f)
+        payload = bundle.get("payload", bundle)
+        assert payload["verdict"]["rid"] == 3
+        assert payload["verdict"]["token_index"] == 1
+        assert payload["verdict"]["expected_token"] == 2
+        assert payload["verdict"]["got_token"] == 5
+        assert payload["record"]["primary_tokens"] == [1, 2, 3]
+        assert payload["record"]["mirror_tokens"] == [1, 5, 3]
+        assert payload["primary_fingerprint"]["id"] == "aaa"
+        assert payload["canary_fingerprint"]["id"] == "bbb"
+
+
+class TestMirrorForkAndInvisibility:
+    """The router half on scripted fakes: the fork's sampling, seed
+    pinning, and the canary's invisibility to routing, admission
+    pressure, and scale signals."""
+
+    def _fleet(self, canary_queue=0, **router_kw):
+        a, b = FakeReplica("a"), FakeReplica("b")
+        canary = FakeReplica("c", queue=canary_queue)
+        router = FleetRouter([a, b], seed=0, **router_kw)
+        router.add_replica(canary, role="canary")
+        return router, (a, b), canary
+
+    def test_full_mirror_and_primary_records_unchanged(self):
+        router, (a, b), canary = self._fleet(canary_mirror=1.0)
+        rids = [
+            router.submit(_template(i), max_new_tokens=3)
+            for i in range(6)
+        ]
+        router.step()
+        records = router.drain_done_records()
+        assert sorted(records) == sorted(rids)
+        # The user's records come from primaries; every submit also
+        # reached the canary, whose routed count never moves.
+        assert all(
+            records[r]["replica"] in ("a", "b") for r in rids
+        )
+        assert len(canary.submits) == 6
+        assert router.canary_stats()["mirrored"] == 6
+        assert router.canary_stats()["compared"] == 6
+        assert router.canary_stats()["divergences"] == 0
+
+    def test_sampled_mirror_fraction_is_deterministic(self):
+        router, _, canary = self._fleet(canary_mirror=0.5)
+        for i in range(10):
+            router.submit(_template(i), max_new_tokens=3)
+        assert len(canary.submits) == 5  # Bresenham: exactly N*f
+
+    def test_sampled_seed_pinned_for_both_streams(self):
+        router, (a, b), canary = self._fleet(canary_mirror=1.0)
+        rid = router.submit(
+            _template(0), max_new_tokens=3, temperature=1.0,
+        )
+        primary_kwargs = (a.submits + b.submits)[0]
+        mirror_kwargs = canary.submits[0]
+        assert primary_kwargs["seed"] == rid % (2 ** 31)
+        assert mirror_kwargs["seed"] == primary_kwargs["seed"]
+        # Greedy needs no pin: the record stays replayable as-is.
+        router.submit(_template(1), max_new_tokens=3)
+        assert canary.submits[1].get("seed") is None
+
+    def test_canary_invisible_to_routing_and_signals(self):
+        router, (a, b), canary = self._fleet(
+            canary_queue=7, canary_mirror=1.0, fleet_refresh_s=0.0,
+        )
+        assert {h.name for h in router.active_handles()} == {"a", "b"}
+        for i in range(8):
+            router.submit(_template(i % 2), max_new_tokens=3)
+        router.step()
+        # Affinity and block-home maps never point at the canary.
+        assert all(
+            h.name != "c" for h in router._affinity.values()
+        )
+        assert all(
+            h.name != "c" for h in router._block_home.values()
+        )
+        # Admission pressure: the canary's queue (7) is invisible.
+        assert router.obs.queue_depth.value() == 0
+        # Capacity signal: 2 active x 4 slots, not 12.
+        assert router.obs.fleet_capacity.value() == 8
+        # The canary handle took no ROUTED traffic.
+        canary_handle = next(
+            h for h in router._handles if h.name == "c"
+        )
+        assert canary_handle.routed == 0
+
+    def test_second_canary_rejected(self):
+        router, _, _ = self._fleet()
+        with pytest.raises(ValueError, match="already has a canary"):
+            router.add_replica(FakeReplica("c2"), role="canary")
+
+    def test_promote_flips_to_serving_role(self):
+        router, _, canary = self._fleet(
+            canary_mirror=1.0,
+            canary_opts={"min_compared": 2, "promote_ticks": 2},
+        )
+        for i in range(4):
+            router.submit(_template(i), max_new_tokens=3)
+        for _ in range(4):
+            router.step()
+            router.drain_done_records()
+        stats = router.canary_stats()
+        assert stats["state"] == "promote"
+        assert stats["armed"] is False
+        assert {h.name for h in router.active_handles()} == {
+            "a", "b", "c",
+        }
+
+    def test_reject_drains_with_canary_reject_reason(self):
+        router, _, canary = self._fleet(
+            canary_mirror=1.0,
+            canary_opts={"min_compared": 2},
+        )
+        canary.tokens = [9, 9, 9]  # scripted divergence
+        router.submit(_template(0), max_new_tokens=3)
+        router.step()
+        router.drain_done_records()
+        router.step()
+        stats = router.canary_stats()
+        assert stats["state"] == "reject"
+        assert "divergence" in stats["verdict_reason"]
+        assert canary.draining
+        # The drain carries the canary_reject trace reason.
+        events = [
+            e for e in router.trace.ring.snapshot()
+            if e.get("name") == "drain_start"
+        ]
+        assert any(
+            e["args"].get("reason") == "canary_reject" for e in events
+        )
+        # Once drained the router retires it (no reconciler here).
+        router.step()
+        assert all(h.name != "c" for h in router._handles)
+        # The terminal verdict stays readable after retirement.
+        assert router.canary_stats()["state"] == "reject"
+
+    def test_mirror_failure_is_operational_not_divergent(self):
+        router, _, canary = self._fleet(canary_mirror=1.0)
+        canary.fail_submits = True  # mirror submits now raise
+        router.submit(_template(0), max_new_tokens=3)
+        router.step()
+        records = router.drain_done_records()
+        assert len(records) == 1  # the user is never failed
+        stats = router.canary_stats()
+        assert stats["mirror_errors"] == 1
+        assert stats["divergences"] == 0
+
+    def test_mirrored_capture_rows_skipped_by_default(self, tmp_path):
+        capture_dir = str(tmp_path / "cap")
+        router, _, canary = self._fleet(
+            canary_mirror=1.0, capture=capture_dir,
+        )
+        rids = [
+            router.submit(_template(i), max_new_tokens=3)
+            for i in range(4)
+        ]
+        router.step()
+        router.drain_done_records()
+        cap = load_capture(capture_dir)
+        assert [r.rid for r in cap.records] == sorted(rids)
+        assert cap.mirrored_skipped == 4
+        assert not any(r.mirrored for r in cap.records)
+        full = load_capture(capture_dir, include_mirrored=True)
+        assert len(full.records) == 8
+        assert sum(1 for r in full.records if r.mirrored) == 4
+        assert full.mirrored_skipped == 0
+
+
+import jax  # noqa: E402,F401 — conftest pins the CPU backend
+
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig  # noqa: E402
+from walkai_nos_tpu.sim.trafficbench import (  # noqa: E402
+    default_engine_factory,
+)
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+    max_seq_len=512,
+)
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """(params, engine-replica factory) — tiny engines sharing one
+    weight set, the canary e2e's primaries."""
+    _, params, make = default_engine_factory(CFG, None, slots=2)
+    return params, make
+
+
+@pytest.fixture(scope="module")
+def injected_make():
+    """A factory over DIFFERENT weights under the SAME config — the
+    failure class the digest gate exists for."""
+    bad = DecoderLM(CFG).init_params(jax.random.PRNGKey(99))
+    _, _, make = default_engine_factory(CFG, bad, slots=2)
+    return make
+
+
+def _drive(router, n=10, sampled=True):
+    rids = []
+    for i in range(n):
+        kwargs = {"max_new_tokens": 5}
+        if sampled and i % 3 == 0:
+            kwargs["temperature"] = 1.0
+        rids.append(router.submit(_template(100 + i), **kwargs))
+    records = {}
+    for _ in range(80):
+        router.step()
+        records.update(router.drain_done_records())
+        if len(records) >= n and not router.has_work:
+            break
+    for _ in range(6):  # verdict ticks after traffic drains
+        router.step()
+    return rids, records
+
+
+class TestCanaryEndToEnd:
+    def test_same_config_mirror_token_identity_promotes(
+        self, fleet, tmp_path
+    ):
+        """The acceptance scenario, primary half: a same-config
+        canary at 100% mirror sees token-identical streams (greedy
+        AND seeded-sampled) and reaches PROMOTE; the capture carries
+        the mirrored shadow rows marked and skippable."""
+        _, make = fleet
+        replicas = [make("p0"), make("p1")]
+        canary = make("cny-same")
+        for replica in replicas + [canary]:
+            replica.warm()
+        capture_dir = str(tmp_path / "cap")
+        router = FleetRouter(
+            replicas, seed=0, canary_mirror=1.0,
+            capture=capture_dir,
+            canary_opts={"min_compared": 4, "promote_ticks": 2},
+        )
+        router.add_replica(canary, role="canary")
+        rids, records = _drive(router)
+        assert sorted(records) == sorted(rids)  # users all served
+        stats = router.canary_stats()
+        assert stats["state"] == "promote"
+        assert stats["gate"] == "digest_exact"
+        assert stats["divergences"] == 0
+        assert stats["mirrored"] == len(rids)
+        assert stats["winning_fingerprint"]
+        # The promoted canary now serves.
+        assert "cny-same" in {
+            h.name for h in router.active_handles()
+        }
+        # Mirrored rows ride the capture marked, skipped by default.
+        cap = load_capture(capture_dir)
+        assert cap.mirrored_skipped > 0
+        assert not any(r.mirrored for r in cap.records)
+
+    def test_injected_weights_reject_names_first_divergence(
+        self, fleet, injected_make, tmp_path
+    ):
+        """The acceptance scenario, reject half: same config over
+        different weights — the delta classifier arms the digest
+        gate, the first mirrored pair diverges, and the verdict names
+        the exact (request, token) with a readable flight bundle."""
+        _, make = fleet
+        replicas = [make("q0"), make("q1")]
+        canary = injected_make("cny-bad")
+        for replica in replicas + [canary]:
+            replica.warm()
+        router = FleetRouter(
+            replicas, seed=0, canary_mirror=1.0,
+            flight_dir=str(tmp_path / "flight"),
+            canary_opts={"min_compared": 4},
+        )
+        router.add_replica(canary, role="canary")
+        rids, records = _drive(router)
+        assert sorted(records) == sorted(rids)  # users unaffected
+        stats = router.canary_stats()
+        assert stats["state"] == "reject"
+        assert stats["gate"] == "digest_exact"  # same config!
+        assert stats["divergences"] >= 1
+        first = stats["first_divergence"]
+        assert first["rid"] in rids
+        assert isinstance(first["token_index"], int)
+        assert first["expected_token"] != first["got_token"]
+        with open(first["bundle_path"]) as f:
+            bundle = json.load(f)
+        payload = bundle.get("payload", bundle)
+        idx = payload["verdict"]["token_index"]
+        assert payload["record"]["primary_tokens"][idx] == (
+            payload["verdict"]["expected_token"]
+        )
+        assert payload["record"]["mirror_tokens"][idx] == (
+            payload["verdict"]["got_token"]
+        )
+        assert payload["config_delta"]["token_preserving"] is True
+
+
+class TestServerouterCanary:
+    """The same verdicts through the real binary surface: POST
+    /generate drives traffic, GET /debug/canary serves the verdict,
+    /metrics federates the canary's engine series."""
+
+    def _serve(self, router):
+        from walkai_nos_tpu.cmd.serverouter import (
+            RouterDriver,
+            RouterServer,
+            make_handler,
+        )
+
+        driver = RouterDriver(router, idle_tick_s=0.01)
+        httpd = RouterServer(
+            ("127.0.0.1", 0),
+            make_handler(driver, router.obs),
+        )
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        return base, driver, httpd
+
+    def _generate(self, base, prompt, n=3):
+        body = json.dumps({
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": n,
+        }).encode()
+        req = urllib.request.Request(
+            f"{base}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    def _poll_verdict(self, base, terminal, tries=400):
+        import time as _time
+
+        payload = None
+        for _ in range(tries):
+            with urllib.request.urlopen(
+                f"{base}/debug/canary", timeout=10
+            ) as resp:
+                payload = json.loads(resp.read())["canary"]
+            if payload["state"] in terminal:
+                return payload
+            _time.sleep(0.05)
+        return payload
+
+    def test_debug_canary_404_when_unarmed(self):
+        router = FleetRouter(
+            [FakeReplica("a"), FakeReplica("b")], seed=0,
+        )
+        base, driver, httpd = self._serve(router)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"{base}/debug/canary", timeout=10
+                )
+            assert err.value.code == 404
+        finally:
+            httpd.shutdown()
+            driver.stop()
+
+    def test_promote_and_reject_through_http(
+        self, fleet, injected_make, tmp_path
+    ):
+        _, make = fleet
+        # --- promote arm: same config at 100% mirror -------------
+        replicas = [make("s0"), make("s1")]
+        canary = make("s-cny")
+        for replica in replicas + [canary]:
+            replica.warm()
+        router = FleetRouter(
+            replicas, seed=0, canary_mirror=1.0,
+            canary_opts={"min_compared": 3, "promote_ticks": 2},
+        )
+        router.add_replica(canary, role="canary")
+        base, driver, httpd = self._serve(router)
+        try:
+            for i in range(4):
+                out = self._generate(base, _template(200 + i))
+                assert out["tokens"]
+                assert out["replica"] in ("s0", "s1")
+            payload = self._poll_verdict(
+                base, ("promote", "reject")
+            )
+            assert payload["state"] == "promote"
+            assert payload["gate"] == "digest_exact"
+            assert payload["divergences"] == 0
+            assert payload["mirrored"] >= 3
+            assert payload["winning_fingerprint"]
+            # Federation carries the canary's engine series.
+            with urllib.request.urlopen(
+                f"{base}/metrics", timeout=30
+            ) as resp:
+                text = resp.read().decode()
+            assert (
+                'cb_requests_submitted_total{replica="s-cny"}' in text
+            )
+            assert "router_canary_mirrored_total" in text
+        finally:
+            httpd.shutdown()
+            driver.stop()
+        # --- reject arm: injected weights, same config -----------
+        replicas = [make("t0"), make("t1")]
+        canary = injected_make("t-cny")
+        for replica in replicas + [canary]:
+            replica.warm()
+        router = FleetRouter(
+            replicas, seed=0, canary_mirror=1.0,
+            flight_dir=str(tmp_path / "flight"),
+            canary_opts={"min_compared": 3},
+        )
+        router.add_replica(canary, role="canary")
+        base, driver, httpd = self._serve(router)
+        try:
+            for i in range(3):
+                out = self._generate(base, _template(300 + i))
+                assert out["tokens"]  # the user is never failed
+            payload = self._poll_verdict(base, ("reject",))
+            assert payload["state"] == "reject"
+            first = payload["first_divergence"]
+            assert first is not None
+            assert isinstance(first["rid"], int)
+            assert isinstance(first["token_index"], int)
+            assert first["expected_token"] != first["got_token"]
+            assert pathlib.Path(first["bundle_path"]).is_file()
+        finally:
+            httpd.shutdown()
+            driver.stop()
+
+
+class TestServerouterFlags:
+    def test_canary_flags_inproc_only(self):
+        from walkai_nos_tpu.cmd.serverouter import parse_args
+
+        args = parse_args(
+            ["--inproc", "2", "--canary",
+             "--canary-override", "loop_steps=4"]
+        )
+        assert args.canary is True
+        assert args.canary_override == [("loop_steps", 4)]
+        assert args.canary_mirror == 1.0
+        with pytest.raises(SystemExit):
+            parse_args(
+                ["--replica", "http://x:1", "--canary"]
+            )
+        with pytest.raises(SystemExit):
+            parse_args(["--canary-replica", "http://x:1"])
+        with pytest.raises(SystemExit):
+            parse_args(["--inproc", "2", "--canary-mirror", "1.5"])
+
+
+class TestCanaryCheckGate:
+    def test_canary_check_is_green(self, fleet):
+        """`make canary-check` pinned fast: exit 0 on the same-config
+        arm (promote, zero divergences), exit 1 — the designed trip —
+        on the injected-divergence arm."""
+        spec = importlib.util.spec_from_file_location(
+            "walkai_canary_check", _ROOT / "hack" / "canary_check.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["walkai_canary_check"] = mod
+        spec.loader.exec_module(mod)
+        assert mod.main([]) == 0
+        assert mod.main(["--inject-divergence"]) == 1

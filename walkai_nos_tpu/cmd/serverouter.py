@@ -43,6 +43,18 @@ Endpoints (the router's own, on `--port`):
   fleet capture plane's status / rotate / download
   (`WALKAI_CAPTURE_DIR` arms it; `obs/capture.py`, done records name
   the routed replica).
+- `GET /debug/canary` -> the shadow/canary plane's status: gate
+  (digest_exact vs latency_only), mirrored/compared/divergence
+  counters, verdict state + reason, windowed latency deltas, and the
+  first divergence's coordinates + flight-bundle path (404 until a
+  canary is armed).
+
+Canary knobs (`--canary-*`, env `WALKAI_CANARY_*`): `--canary` arms
+an in-process candidate replica built from the same weights under
+`--canary-override KEY=VALUE` engine knobs (repeatable;
+`WALKAI_CANARY_OVERRIDES` comma-separates them), `--canary-replica
+URL` registers a remote pod as the canary in HTTP mode, and
+`--canary-mirror` sets the sampled mirror fraction (default 1.0).
 
 A single driver thread owns the fleet (the same one-owner discipline
 as the demo server's cb_driver): it drains submissions, steps every
@@ -147,9 +159,15 @@ def build_inproc_replicas(n: int, *, slots: int | None = None):
         DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
     )
 
-    def factory(name: str):
+    def factory(name: str, **engine_kwargs):
+        # Extra engine kwargs are the canary seam: the candidate
+        # replica shares the fleet's weights and config but takes
+        # `--canary-override` knobs (ENGINE_KNOBS axes only).
         return EngineReplica(
-            ContinuousBatcher(cfg, params, slots=slots),
+            ContinuousBatcher(
+                cfg, params, slots=engine_kwargs.pop("slots", slots),
+                **engine_kwargs,
+            ),
             name=name,
         )
 
@@ -402,6 +420,20 @@ def make_handler(driver: RouterDriver, obs: RouterObs):
                 # replica's Chrome export, clock-aligned) — load it
                 # straight into Perfetto.
                 self._json(200, driver.router.fleet_trace())
+            elif self.path == "/debug/canary":
+                # The shadow plane's status, read from the driver's
+                # whole-snapshot like /healthz (handler threads never
+                # touch live router state): stale by at most one idle
+                # tick, which a rollout decision can afford.
+                canary = driver.fleet_stats().get("canary")
+                if canary is None:
+                    self.send_error(
+                        404,
+                        "no canary armed (--canary / "
+                        "--canary-replica / WALKAI_CANARY=1)",
+                    )
+                    return
+                self._json(200, {"canary": canary})
             elif self.path == "/debug/flight":
                 flight = driver.router.flight
                 self._json(200, {
@@ -477,7 +509,14 @@ def build(args) -> tuple[RouterDriver, RouterObs]:
     capture = CaptureLog.from_env()
     if args.replica:
         replicas = [HttpReplica(url) for url in args.replica]
-        router = FleetRouter(replicas, obs=obs, capture=capture)
+        router = FleetRouter(
+            replicas, obs=obs, capture=capture,
+            canary_mirror=args.canary_mirror,
+        )
+        if args.canary_replica:
+            router.add_replica(
+                HttpReplica(args.canary_replica), role="canary"
+            )
     else:
         policy = ScalePolicy(
             min_replicas=(
@@ -502,8 +541,21 @@ def build(args) -> tuple[RouterDriver, RouterObs]:
         )
         router = FleetRouter(
             replicas, provider=provider, scale_policy=policy, obs=obs,
-            capture=capture,
+            capture=capture, canary_mirror=args.canary_mirror,
         )
+        if args.canary or args.canary_override:
+            from walkai_nos_tpu.sim.replay import ENGINE_KNOBS
+
+            overrides = dict(args.canary_override)
+            bad = sorted(set(overrides) - set(ENGINE_KNOBS))
+            if bad:
+                raise ValueError(
+                    f"--canary-override knob(s) {bad} are not engine "
+                    f"knobs; valid axes: {ENGINE_KNOBS}"
+                )
+            canary = factory("canary0", **overrides)
+            canary.warm()
+            router.add_replica(canary, role="canary")
     return RouterDriver(router), obs
 
 
@@ -536,6 +588,39 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--max-replicas", type=int, default=None,
         help="autoscaler ceiling, default 8 (in-process mode only)",
     )
+    from walkai_nos_tpu.cmd.replay import parse_override
+
+    parser.add_argument(
+        "--canary", action="store_true",
+        default=os.environ.get("WALKAI_CANARY") == "1",
+        help="arm an in-process candidate-config canary replica "
+             "(in-process mode only; WALKAI_CANARY=1)",
+    )
+    parser.add_argument(
+        "--canary-override", action="append",
+        type=parse_override, metavar="KEY=VALUE",
+        default=[
+            parse_override(item)
+            for item in os.environ.get(
+                "WALKAI_CANARY_OVERRIDES", ""
+            ).split(",") if item.strip()
+        ],
+        help="canary engine knob override, repeatable (implies "
+             "--canary; WALKAI_CANARY_OVERRIDES=k=v,k=v)",
+    )
+    parser.add_argument(
+        "--canary-replica", default=os.environ.get(
+            "WALKAI_CANARY_REPLICA"
+        ),
+        help="HTTP canary pod base URL (HTTP mode only; "
+             "WALKAI_CANARY_REPLICA)",
+    )
+    parser.add_argument(
+        "--canary-mirror", type=float,
+        default=float(os.environ.get("WALKAI_CANARY_MIRROR", "1.0")),
+        help="fraction of live submits mirrored to the canary "
+             "(default 1.0; WALKAI_CANARY_MIRROR)",
+    )
     args = parser.parse_args(argv)
     if args.replica and (
         args.spares > 0
@@ -548,6 +633,24 @@ def parse_args(argv=None) -> argparse.Namespace:
         parser.error(
             "--spares/--min-replicas/--max-replicas require "
             "in-process mode (no --replica)"
+        )
+    if args.replica and (args.canary or args.canary_override):
+        # Same no-silent-ignore rule: an in-process canary cannot be
+        # built against remote pods' weights — HTTP mode points at a
+        # candidate pod instead.
+        parser.error(
+            "--canary/--canary-override require in-process mode; "
+            "use --canary-replica URL with --replica"
+        )
+    if args.canary_replica and not args.replica:
+        parser.error(
+            "--canary-replica requires HTTP mode (--replica); "
+            "use --canary in-process"
+        )
+    if not 0.0 <= args.canary_mirror <= 1.0:
+        parser.error(
+            f"--canary-mirror must be in [0, 1]; "
+            f"got {args.canary_mirror}"
         )
     return args
 

@@ -1,0 +1,417 @@
+"""CanaryController: the digest-gated config-rollout verdict machine.
+
+ROADMAP item 4b: config changes used to roll out blind — the fleet
+could capture traffic and replay it offline (PR 15), but nothing
+watched a CANDIDATE config serve real traffic and proved it correct
+before promotion. The router's canary role fixes that: a
+candidate-config replica receives a mirrored copy of a sampled
+fraction of live submits (same prompt, knobs, and EFFECTIVE seed —
+the PR 15 rid-defaulting rule pins the PRNG stream, so a sampled
+mirror is as deterministic as a greedy one), the primary's response
+serves the user, and this controller compares the two streams at the
+completion seam.
+
+The comparison is MATHEMATICAL where the configs allow it, and
+statistical only where they don't:
+
+- **Digest-exact gate**: `sim/replay.classify_config_delta` inspects
+  the primary-vs-canary fingerprint delta up front. Every field
+  within the token-preserving set (all `ENGINE_KNOBS` replay axes,
+  `tp_devices`, dtype moves within {"model", "int8-sim"}) — or an
+  empty delta — arms the gate: the candidate MUST produce
+  byte-identical token streams, verified per request by crc32 token
+  digest (truncated completions compare by common prefix, the PR 15
+  rule: a truncation point is pool pressure, not the serving
+  function). One divergence is a REJECT — no vote, no window —
+  because a violated purity invariant never becomes acceptable with
+  more samples. The divergence dumps a flight-recorder bundle in the
+  replay-triage format: both fingerprints, the offending record, and
+  expected/got at the first divergent token.
+- **Latency windows** (always, and the only verdict input when a
+  delta field moves the serving function — e.g. a real-int8
+  candidate, where token drift is declared and expected): primary
+  and mirror TTFT/TPOT land in per-side histograms read through
+  `BucketRing` windows; the p99 deltas must stay within
+  `latency_budget_pct` for the promote path and sustained regression
+  past it rejects.
+
+Verdicts are hysteretic — warming (until `min_compared` pairs) ->
+observing -> promote after `promote_ticks` consecutive clean
+evaluation ticks / reject on a digest divergence (immediate) or
+`reject_ticks` consecutive breached ones. The router applies the
+verdict: promote flips the canary to a full serving role and records
+the winning fingerprint; reject drains it migrate-first with trace
+reason `canary_reject`.
+
+The controller is deliberately router-agnostic: `on_primary` /
+`on_mirror` feed completion records, `evaluate(now)` advances the
+machine, and the router (or a test scripting fakes) owns every side
+effect. Metrics flow through the RouterObs bundle handed in
+(`router_canary_*` catalog family); no literal metric names here.
+"""
+
+from __future__ import annotations
+
+import time
+
+from walkai_nos_tpu.obs.metrics import Histogram, Registry
+from walkai_nos_tpu.obs.slo import BucketRing
+
+__all__ = ["CanaryController"]
+
+# Verdict-machine states, in lifecycle order.
+STATES = ("warming", "observing", "promote", "reject")
+
+
+def _tpot_s(record: dict) -> float | None:
+    """Per-output-token latency of one completion record (the
+    engine's record-derived TPOT): decode wall after the first token
+    over the tokens it produced. None under two tokens — a
+    single-token completion has no decode cadence."""
+    tokens = record.get("tokens")
+    ttft = record.get("ttft_s")
+    wall = record.get("wall_s")
+    if tokens is None or ttft is None or wall is None:
+        return None
+    n = len(tokens)
+    if n < 2:
+        return None
+    return max(0.0, float(wall) - float(ttft)) / (n - 1)
+
+
+class CanaryController:
+    """Pairs primary/mirror completions, diffs streams, holds the
+    verdict machine. One controller per canary replica."""
+
+    def __init__(
+        self,
+        *,
+        obs=None,
+        trace=None,
+        flight=None,
+        canary_name: str = "canary",
+        min_compared: int = 8,
+        promote_ticks: int = 3,
+        reject_ticks: int = 3,
+        latency_budget_pct: float = 20.0,
+        window_s: float = 30.0,
+        buckets: int = 15,
+    ):
+        self.canary_name = canary_name
+        self.min_compared = int(min_compared)
+        self.promote_ticks = int(promote_ticks)
+        self.reject_ticks = int(reject_ticks)
+        self.latency_budget_pct = float(latency_budget_pct)
+        self._obs = obs
+        self._trace = trace
+        self._flight = flight
+        # Armed digest gate until fingerprints say otherwise: a canary
+        # whose fingerprint never arrives (bare fakes) is held to the
+        # exact standard — silence must not relax the gate.
+        self.gate_armed = True
+        self.delta: dict = {
+            "delta": [], "token_preserving": True, "moving_fields": [],
+        }
+        self._fingerprints: dict = {"primary": None, "canary": None}
+        self.state = "warming"
+        self.mirrored = 0
+        self.compared = 0
+        self.divergences = 0
+        self.mirror_errors = 0
+        self._clean_ticks = 0
+        self._breach_ticks = 0
+        self.verdict_reason: str | None = None
+        self.first_divergence: dict | None = None
+        self.winning_fingerprint_id: str | None = None
+        # rid -> {"primary": record, "mirror": record}; compared and
+        # dropped once both sides land.
+        self._pending: dict[int, dict] = {}
+        self._latency_delta: dict[str, float | None] = {
+            "ttft_p99": None, "tpot_p99": None,
+        }
+        # Per-side latency windows: own private registry (these
+        # histograms are comparison scratch, not exported series —
+        # the DELTA is the exported gauge).
+        scratch = Registry(enabled=True)
+        self._hists: dict[str, Histogram] = {}
+        self._rings: dict[str, BucketRing] = {}
+        for side in ("primary", "mirror"):
+            for kind in ("ttft", "tpot"):
+                key = f"{side}_{kind}"
+                hist = scratch.histogram(
+                    f"canary_{key}_s", "canary comparison scratch"
+                )
+                self._hists[key] = hist
+                self._rings[key] = BucketRing(
+                    hist, window_s=window_s, buckets=buckets
+                )
+
+    # -- configuration --------------------------------------------------
+
+    def set_fingerprints(self, primary: dict | None, canary: dict | None):
+        """Classify the config delta and set the gate. Either side
+        None (a replica without the fingerprint surface) leaves the
+        gate ARMED — the conservative default."""
+        from walkai_nos_tpu.sim.replay import classify_config_delta
+
+        self._fingerprints = {"primary": primary, "canary": canary}
+        if primary is not None and canary is not None:
+            self.delta = classify_config_delta(primary, canary)
+            self.gate_armed = bool(self.delta["token_preserving"])
+
+    # -- recording (router driver thread) -------------------------------
+
+    def on_mirrored(self) -> None:
+        """One live submit was mirrored to the canary."""
+        self.mirrored += 1
+        if self._obs is not None:
+            self._obs.canary_mirrored.inc()
+
+    def on_primary(self, rid: int, record: dict, now=None) -> None:
+        self._observe("primary", record, now)
+        slot = self._pending.setdefault(rid, {})
+        slot["primary"] = record
+        if "mirror" in slot:
+            self._compare(rid, self._pending.pop(rid), now)
+
+    def on_mirror(self, rid: int, record: dict, now=None) -> None:
+        self._observe("mirror", record, now)
+        slot = self._pending.setdefault(rid, {})
+        slot["mirror"] = record
+        if "primary" in slot:
+            self._compare(rid, self._pending.pop(rid), now)
+
+    def _observe(self, side: str, record: dict, now=None) -> None:
+        now = time.monotonic() if now is None else now
+        if record.get("error") is not None and side == "mirror":
+            self.mirror_errors += 1
+            if self._obs is not None:
+                self._obs.canary_mirror_errors.inc()
+        ttft = record.get("ttft_s")
+        if ttft is not None:
+            self._hists[f"{side}_ttft"].observe(float(ttft))
+        tpot = _tpot_s(record)
+        if tpot is not None:
+            self._hists[f"{side}_tpot"].observe(tpot)
+        for kind in ("ttft", "tpot"):
+            self._rings[f"{side}_{kind}"].advance(now)
+
+    # -- the diff -------------------------------------------------------
+
+    def _compare(self, rid: int, pair: dict, now=None) -> None:
+        primary, mirror = pair["primary"], pair["mirror"]
+        self.compared += 1
+        if mirror.get("error") is not None:
+            # A mirror-side failure (canary rejected the submit, pod
+            # error) is operational, not a token divergence: counted,
+            # never promoted past.
+            self._count_compare("mirror_error")
+            return
+        if not self.gate_armed:
+            self._count_compare("latency_only")
+            return
+        p_tokens = primary.get("tokens")
+        m_tokens = mirror.get("tokens")
+        if p_tokens is None or m_tokens is None:
+            self._count_compare("mirror_error")
+            return
+        expected = list(map(int, p_tokens))
+        got = list(map(int, m_tokens))
+        if primary.get("truncated") or mirror.get("truncated"):
+            # PR 15 rule: a truncation point is pool pressure, not
+            # the serving function — compare the common prefix.
+            n = min(len(expected), len(got))
+            match = expected[:n] == got[:n]
+        else:
+            match = expected == got
+        if match:
+            self._count_compare("match")
+            return
+        self._count_compare("divergent")
+        self.divergences += 1
+        if self._obs is not None:
+            self._obs.canary_divergence.inc()
+        self._record_divergence(rid, primary, mirror, expected, got, now)
+        self._set_state(
+            "reject",
+            f"digest divergence on request {rid}",
+            now,
+        )
+
+    def _count_compare(self, result: str) -> None:
+        if self._obs is not None:
+            self._obs.canary_compared.inc(labels={"result": result})
+
+    def _record_divergence(
+        self, rid, primary, mirror, expected, got, now=None
+    ) -> None:
+        from walkai_nos_tpu.sim.replay import first_divergence
+
+        idx = first_divergence(expected, got)
+        self.first_divergence = {
+            "rid": rid,
+            "trace_id": primary.get("trace_id"),
+            "token_index": idx,
+            "expected_token": (
+                expected[idx] if idx < len(expected) else None
+            ),
+            "got_token": got[idx] if idx < len(got) else None,
+        }
+        if self._trace is not None:
+            self._trace.event(
+                "canary_divergence",
+                time.monotonic() if now is None else now,
+                rid=rid,
+                canary=self.canary_name,
+                token_index=idx,
+            )
+        if self._flight is not None:
+            # The replay-triage bundle shape (PR 15): everything a
+            # human needs to re-derive the verdict offline.
+            bundle = {
+                "verdict": dict(self.first_divergence),
+                "canary": self.canary_name,
+                "primary_fingerprint": self._fingerprints["primary"],
+                "canary_fingerprint": self._fingerprints["canary"],
+                "config_delta": dict(self.delta),
+                "record": {
+                    "rid": rid,
+                    "trace_id": primary.get("trace_id"),
+                    "primary_tokens": expected,
+                    "mirror_tokens": got,
+                    "primary_replica": primary.get("replica"),
+                    "mirror_replica": mirror.get("replica"),
+                },
+            }
+            path = self._flight.dump("canary_divergence", bundle)
+            self.first_divergence["bundle_path"] = path
+            if path is not None and self._obs is not None:
+                self._obs.flight_dumps.inc(
+                    labels={"trigger": "canary_divergence"}
+                )
+
+    # -- the verdict machine --------------------------------------------
+
+    def _refresh_latency(self, now: float) -> dict[str, float | None]:
+        """Windowed p99 deltas, percent over primary, None when
+        either side's window is empty (no evidence either way)."""
+        deltas: dict[str, float | None] = {}
+        for kind in ("ttft", "tpot"):
+            p = self._rings[f"primary_{kind}"].quantile(0.99, now)
+            m = self._rings[f"mirror_{kind}"].quantile(0.99, now)
+            if p is None or m is None or p <= 0:
+                deltas[f"{kind}_p99"] = None
+                continue
+            pct = round(100.0 * (m - p) / p, 2)
+            deltas[f"{kind}_p99"] = pct
+            if self._obs is not None:
+                self._obs.canary_latency_delta.set(
+                    pct, labels={"metric": f"{kind}_p99"}
+                )
+        self._latency_delta = deltas
+        return deltas
+
+    def _set_state(self, state: str, reason: str, now=None) -> None:
+        if self.state in ("promote", "reject"):
+            return  # terminal verdicts are sticky
+        prev = self.state
+        self.state = state
+        self.verdict_reason = reason
+        if state == "promote":
+            fp = self._fingerprints["canary"] or {}
+            self.winning_fingerprint_id = fp.get("id")
+        if self._obs is not None:
+            for s in STATES:
+                self._obs.canary_verdict.set(
+                    1.0 if s == state else 0.0, labels={"state": s}
+                )
+        if self._trace is not None and prev != state:
+            self._trace.event(
+                "canary_verdict",
+                time.monotonic() if now is None else now,
+                canary=self.canary_name,
+                state=state,
+                reason=reason,
+            )
+
+    def evaluate(self, now=None) -> str:
+        """One evaluation tick (the router's throttled fleet refresh
+        cadence). Advances warming -> observing on sample count, then
+        counts consecutive clean / breached ticks toward the
+        promote / reject thresholds. Returns the current state."""
+        now = time.monotonic() if now is None else now
+        if self.state in ("promote", "reject"):
+            return self.state
+        if self._obs is not None and self.state == "warming":
+            # Publish the warming state before the first transition
+            # so the gauge family is never silent while a canary runs.
+            self._obs.canary_verdict.set(
+                1.0, labels={"state": "warming"}
+            )
+        deltas = self._refresh_latency(now)
+        if self.compared < self.min_compared:
+            return self.state
+        if self.state == "warming":
+            self._set_state("observing", "min_compared reached", now)
+        measured = [v for v in deltas.values() if v is not None]
+        breached = any(
+            v > self.latency_budget_pct for v in measured
+        )
+        if breached:
+            self._breach_ticks += 1
+            self._clean_ticks = 0
+        else:
+            self._clean_ticks += 1
+            self._breach_ticks = 0
+        if self._breach_ticks >= self.reject_ticks:
+            worst = max(measured)
+            self._set_state(
+                "reject",
+                f"latency regression {worst:+.1f}% past "
+                f"{self.latency_budget_pct:.0f}% budget for "
+                f"{self._breach_ticks} ticks",
+                now,
+            )
+        elif self._clean_ticks >= self.promote_ticks:
+            self._set_state(
+                "promote",
+                f"{self.compared} compared, {self.divergences} "
+                f"divergences, latency within budget for "
+                f"{self._clean_ticks} ticks",
+                now,
+            )
+        return self.state
+
+    # -- reading --------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The `/debug/canary` payload + `router.stats()["canary"]`
+        block: gate, counters, verdict, latency deltas, and the first
+        divergence (if any) with its flight-bundle path."""
+        return {
+            "canary": self.canary_name,
+            "state": self.state,
+            "gate": (
+                "digest_exact" if self.gate_armed else "latency_only"
+            ),
+            "config_delta": {
+                "token_preserving": self.delta["token_preserving"],
+                "moving_fields": list(self.delta["moving_fields"]),
+                "fields": [
+                    f"{d['section']}.{d['field']}"
+                    for d in self.delta["delta"]
+                ],
+            },
+            "mirrored": self.mirrored,
+            "compared": self.compared,
+            "pending": len(self._pending),
+            "divergences": self.divergences,
+            "mirror_errors": self.mirror_errors,
+            "latency_delta_pct": dict(self._latency_delta),
+            "verdict_reason": self.verdict_reason,
+            "first_divergence": (
+                dict(self.first_divergence)
+                if self.first_divergence is not None else None
+            ),
+            "winning_fingerprint": self.winning_fingerprint_id,
+        }

@@ -693,6 +693,61 @@ CATALOG: tuple[MetricSpec, ...] = (
         component="router",
         attr="xfer_migrations",
     ),
+    # -- shadow/canary plane (router/core.py via obs/canary.py) --------
+    MetricSpec(
+        "router_canary_mirrored_total", "counter",
+        "Live submits mirrored to the canary replica (the sampled "
+        "shadow copies; the primary's response serves the user)",
+        component="router",
+        attr="canary_mirrored",
+    ),
+    MetricSpec(
+        "router_canary_compared_total", "counter",
+        "Primary/mirror completion pairs compared at the completion "
+        "seam, by result",
+        # match (digest-identical streams) | divergent (token values
+        # differ inside the common prefix) | latency_only (config
+        # delta declares the serving function moved; no digest gate)
+        # | mirror_error (the canary side failed — operational, not a
+        # divergence)
+        labels=("result",),
+        component="router",
+        attr="canary_compared",
+    ),
+    MetricSpec(
+        "router_canary_divergence_total", "counter",
+        "Mirrored completions whose token stream diverged from the "
+        "primary's under an armed digest-exact gate — each one dumps "
+        "a flight bundle and rejects the canary",
+        component="router",
+        attr="canary_divergence",
+    ),
+    MetricSpec(
+        "router_canary_mirror_errors_total", "counter",
+        "Mirror submits or completions that failed on the canary "
+        "side (submit rejected, replica error) — counted apart from "
+        "divergences because a sick canary is operational news, not "
+        "a correctness verdict",
+        component="router",
+        attr="canary_mirror_errors",
+    ),
+    MetricSpec(
+        "router_canary_verdict", "gauge",
+        "Canary verdict machine state (1 on the current state, 0 on "
+        "the rest)",
+        labels=("state",),  # warming | observing | promote | reject
+        component="router",
+        attr="canary_verdict",
+    ),
+    MetricSpec(
+        "router_canary_latency_delta_pct", "gauge",
+        "Windowed canary-minus-primary latency delta as a percent of "
+        "the primary's quantile (positive = canary slower), per "
+        "latency metric",
+        labels=("metric",),  # ttft_p99 | tpot_p99
+        component="router",
+        attr="canary_latency_delta",
+    ),
     # -- kube binaries (kube/runtime.py via health.Metrics) ------------
     MetricSpec(
         "nos_reconcile_total", "counter",

@@ -57,6 +57,22 @@ scores each replica's windowed signals against the fleet for
 straggler detection (`obs/anomaly.py`; the score feeds routing as a
 load penalty and the reconciler as a drain hint), and dumps a
 flight-recorder bundle on anomaly flips and SLO-breach edges.
+
+The SHADOW/CANARY plane (ROADMAP 4b) rides the same loop:
+`add_replica(role="canary")` registers ONE candidate-config replica
+that receives a mirrored copy of a sampled fraction of live submits
+(`canary_mirror`) — same prompt, knobs, and effective seed (pinned
+router-side for unseeded sampled requests, so both streams draw the
+same PRNG sequence). The primary's response serves the user; the
+mirror is INVISIBLE to every placement and scale decision (excluded
+from `active_handles()`, the affinity/block-home maps, queue-depth
+and capacity gauges, anomaly peer scoring, and migration targets) but
+federates its `cb_*` series and exports `router_canary_*` like any
+member. `obs/canary.CanaryController` diffs the paired completions
+(digest-exact when the config delta is token-preserving) and holds
+the verdict machine; the router applies it each step — promote flips
+the canary to a full serving role, reject drains it migrate-first
+with trace reason `canary_reject`.
 """
 
 from __future__ import annotations
@@ -68,6 +84,7 @@ import numpy as np
 
 from walkai_nos_tpu.models.block_key import chain_hashes, route_key
 from walkai_nos_tpu.obs.anomaly import AnomalyDetector, FlightRecorder
+from walkai_nos_tpu.obs.canary import CanaryController
 from walkai_nos_tpu.obs.capture import (
     CaptureLog,
     fingerprint_id,
@@ -146,6 +163,8 @@ class FleetRouter:
         flight: FlightRecorder | None = None,
         flight_dir: str | None = None,
         capture: CaptureLog | str | None = None,
+        canary_mirror: float = 1.0,
+        canary_opts: dict | None = None,
     ):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(
@@ -230,6 +249,24 @@ class FleetRouter:
         # rate never loses history when a slice is returned.
         self._retired_hits = 0
         self._retired_lookups = 0
+        # The shadow/canary plane: at most one canary handle, its
+        # controller, the mirror sampling state (a deterministic
+        # Bresenham accumulator over `canary_mirror`), and the
+        # mirror-side bookkeeping — mirror locals map to (router rid,
+        # capture rid) OUTSIDE self._local so mirror completions
+        # never reach the user-facing _done.
+        self.canary_mirror = float(canary_mirror)
+        if not 0.0 <= self.canary_mirror <= 1.0:
+            raise ValueError(
+                f"canary_mirror must be in [0, 1]; "
+                f"got {canary_mirror}"
+            )
+        self._canary_opts = dict(canary_opts or {})
+        self._canary: _Handle | None = None
+        self.canary_controller: CanaryController | None = None
+        self._mirror_seen = 0
+        self._mirror_local: dict[tuple[int, int], tuple[int, int]] = {}
+        self._mirrored_rids: set[int] = set()
         self._reconciler = (
             Reconciler(
                 provider, scale_policy, obs=self.obs,
@@ -267,21 +304,87 @@ class FleetRouter:
         stages: "both" (the colocated default), "prefill" (takes new
         requests, hands streams off at first token), or "decode"
         (receives migrated streams only, never a cold submit). Any
-        non-"both" member flips the router into disaggregated
-        two-stage placement."""
-        if role not in ("both", "prefill", "decode"):
+        prefill/decode member flips the router into disaggregated
+        two-stage placement.
+
+        `role="canary"` registers the candidate-config replica of the
+        shadow plane: it receives mirrored submits only (sampled at
+        `canary_mirror`), is invisible to routing and every scale
+        signal, and its paired completions feed the
+        `CanaryController` verdict machine — at most one canary at a
+        time (a rollout compares ONE candidate; the verdict retires
+        or promotes it before the next)."""
+        if role not in ("both", "prefill", "decode", "canary"):
             raise ValueError(
-                f"role must be 'both', 'prefill' or 'decode'; "
-                f"got {role!r}"
+                f"role must be 'both', 'prefill', 'decode' or "
+                f"'canary'; got {role!r}"
+            )
+        if role == "canary" and self._canary is not None:
+            raise ValueError(
+                "fleet already has a canary replica "
+                f"({self._canary.name}); resolve its verdict first"
             )
         name = getattr(replica, "name", None) or f"r{self._seq}"
         self._seq += 1
-        self._handles.append(_Handle(replica, name, role=role))
+        handle = _Handle(replica, name, role=role)
+        self._handles.append(handle)
+        if role == "canary":
+            self._canary = handle
+            self.canary_controller = CanaryController(
+                obs=self.obs,
+                trace=self.trace,
+                flight=self.flight,
+                canary_name=name,
+                **self._canary_opts,
+            )
+            self.canary_controller.set_fingerprints(
+                self._primary_fingerprint(),
+                self._replica_fingerprint(replica),
+            )
+            self.trace.event(
+                "canary_armed", time.monotonic(), canary=name,
+                mirror=self.canary_mirror,
+                gate=(
+                    "digest_exact"
+                    if self.canary_controller.gate_armed
+                    else "latency_only"
+                ),
+            )
         self._set_replica_gauges()
+
+    @staticmethod
+    def _replica_fingerprint(replica) -> dict | None:
+        """The replica's engine config fingerprint (PR 15), read
+        through whichever surface the adapter has — None for adapters
+        without one (bare fakes, old pods), which leaves the canary
+        gate ARMED (the conservative default)."""
+        read = getattr(replica, "config_fingerprint", None)
+        if read is None:
+            engine = getattr(replica, "engine", None)
+            read = getattr(engine, "config_fingerprint", None)
+        if read is None:
+            return None
+        try:
+            return read()
+        except Exception:  # noqa: BLE001 — telemetry read
+            return None
+
+    def _primary_fingerprint(self) -> dict | None:
+        """First serving member's fingerprint — the baseline the
+        canary's config delta is classified against."""
+        for h in self._handles:
+            if h.role == "canary":
+                continue
+            fp = self._replica_fingerprint(h.replica)
+            if fp is not None:
+                return fp
+        return None
 
     @property
     def disaggregated(self) -> bool:
-        return any(h.role != "both" for h in self._handles)
+        return any(
+            h.role in ("prefill", "decode") for h in self._handles
+        )
 
     def start_drain(self, handle: _Handle, migrate: bool = True) -> None:
         """Stop routing to `handle` and ask its replica to drain
@@ -336,14 +439,28 @@ class FleetRouter:
         if self._anomaly is not None:
             self._anomaly.forget(handle.name)
         self._penalty.pop(handle.name, None)
+        if handle is self._canary:
+            # The controller outlives the handle: its terminal
+            # verdict (and any divergence bundle path) stays readable
+            # through stats()/debug surfaces after the reject drain.
+            self._canary = None
+            self._mirror_local = {
+                k: v for k, v in self._mirror_local.items()
+                if k[0] != id(handle)
+            }
         self.trace.event(
             "retire", time.monotonic(), replica=handle.name
         )
         self._set_replica_gauges()
 
     def active_handles(self) -> list[_Handle]:
+        """Serving members: non-draining, canary excluded — the ONE
+        candidate set behind routing picks, reconciler pressure/idle
+        signals, and fleet-capacity accounting, so shadow load is
+        invisible to every scale decision by construction."""
         return [
-            h for h in self._handles if not h.replica.draining
+            h for h in self._handles
+            if not h.replica.draining and h.role != "canary"
         ]
 
     def draining_handles(self) -> list[_Handle]:
@@ -477,6 +594,23 @@ class FleetRouter:
         rid = self._next_rid
         if trace_id is None:
             trace_id = f"{self._trace_prefix}-{rid:08x}"
+        canary_live = (
+            self._canary is not None
+            and not self._canary.replica.draining
+        )
+        if (
+            canary_live
+            and kwargs.get("temperature")
+            and kwargs.get("seed") is None
+        ):
+            # Mirror determinism: an unseeded sampled request's
+            # effective seed is minted REPLICA-side (the local rid —
+            # the PR 15 rid-defaulting rule), which primary and
+            # mirror would mint differently. Pin it router-side while
+            # a canary is armed so both streams draw the same PRNG
+            # sequence; the capture records the pinned value, so
+            # replays stay bit-exact.
+            kwargs["seed"] = rid % (2 ** 31)
         try:
             local = handle.replica.submit(
                 prompt, trace_id=trace_id, **kwargs
@@ -515,7 +649,81 @@ class FleetRouter:
                     )
                 },
             )
+        if canary_live and self._mirror_due():
+            self._mirror_submit(rid, prompt, trace_id, kwargs)
         return rid
+
+    # -- the shadow/canary plane ----------------------------------------
+
+    def _mirror_due(self) -> bool:
+        """Deterministic sampling at `canary_mirror`: a Bresenham
+        accumulator (mirror when the running fraction's integer part
+        advances) — exactly fraction*N of N submits mirror, with no
+        RNG draw perturbing the routing rng's sequence."""
+        f = self.canary_mirror
+        if f <= 0.0:
+            return False
+        n = self._mirror_seen
+        self._mirror_seen += 1
+        return int((n + 1) * f) > int(n * f)
+
+    def _mirror_submit(
+        self, rid: int, prompt, trace_id: str, kwargs: dict
+    ) -> None:
+        """Fork the shadow copy: same prompt and knobs (the effective
+        seed already pinned), its own trace id suffix so replica-side
+        spans stay distinguishable, completion routed to the
+        CanaryController instead of the user. A mirror failure never
+        fails the primary — it lands as a mirror_error comparison."""
+        canary = self._canary
+        ctrl = self.canary_controller
+        t0 = time.monotonic()
+        try:
+            local = canary.replica.submit(
+                prompt, trace_id=f"{trace_id}-m", **kwargs
+            )
+        except Exception as err:  # noqa: BLE001 — shadow path
+            ctrl.on_mirrored()
+            ctrl.on_mirror(rid, {"error": str(err), "tokens": None})
+            self._mirrored_rids.add(rid)
+            self.trace.event(
+                "canary_mirror_failed", t0, rid=rid,
+                canary=canary.name, error=str(err),
+            )
+            return
+        # The mirror's capture rows need their OWN rid (submit rows
+        # key by rid at load; reusing the primary's would overwrite
+        # it) — drawn from the same counter, marked mirrored so
+        # load_capture drops them by default.
+        mirror_rid = self._next_rid
+        self._next_rid += 1
+        self._mirror_local[(id(canary), local)] = (rid, mirror_rid)
+        self._mirrored_rids.add(rid)
+        ctrl.on_mirrored()
+        self.trace.event(
+            "canary_mirror", t0, rid=rid, canary=canary.name,
+            trace_id=f"{trace_id}-m",
+        )
+        if self._capture is not None:
+            self._capture.record_submit(
+                rid=mirror_rid,
+                trace_id=f"{trace_id}-m",
+                prompt=np.asarray(prompt).reshape(-1).tolist(),
+                replica=canary.name,
+                policy="canary",
+                mirrored=True,
+                mirror_of=rid,
+                arrival_s=round(
+                    self._capture.arrival_offset(t0), 6
+                ),
+                **{
+                    k: kwargs.get(k)
+                    for k in (
+                        "max_new_tokens", "eos_id", "temperature",
+                        "top_k", "top_p", "seed",
+                    )
+                },
+            )
 
     # -- block shipping & live migration -------------------------------
 
@@ -637,6 +845,9 @@ class FleetRouter:
                 h for h in self._handles
                 if h is not handle
                 and not h.replica.draining
+                # Never evacuate real traffic ONTO the canary —
+                # shadow capacity is not serving capacity.
+                and h.role != "canary"
                 and getattr(h.replica, "supports_migration", False)
             ),
             key=self._load,
@@ -749,6 +960,9 @@ class FleetRouter:
     # -- the drive loop ------------------------------------------------
 
     def _collect(self, handle: _Handle) -> None:
+        if handle.role == "canary":
+            self._collect_mirror(handle)
+            return
         for local, record in handle.replica.drain_done_records().items():
             rid = self._local.pop((id(handle), local), None)
             if rid is None:
@@ -787,6 +1001,85 @@ class FleetRouter:
                     error=record.get("error"),
                 )
             self._done[rid] = record
+            if (
+                self.canary_controller is not None
+                and rid in self._mirrored_rids
+            ):
+                self._mirrored_rids.discard(rid)
+                self.canary_controller.on_primary(rid, record)
+
+    def _collect_mirror(self, handle: _Handle) -> None:
+        """Completion seam of the shadow plane: the canary's records
+        feed the CanaryController (and the capture, marked mirrored)
+        — never `self._done`, so a mirror completion can never reach
+        the user."""
+        ctrl = self.canary_controller
+        for local, record in handle.replica.drain_done_records().items():
+            pair = self._mirror_local.pop((id(handle), local), None)
+            if pair is None:
+                continue
+            rid, mirror_rid = pair
+            record = dict(record)
+            record["replica"] = handle.name
+            if self._capture is not None:
+                tokens = record.get("tokens")
+                self._capture.record_done(
+                    rid=mirror_rid,
+                    trace_id=record.get("trace_id"),
+                    replica=handle.name,
+                    mirrored=True,
+                    tokens=list(tokens) if tokens is not None else None,
+                    n_tokens=len(tokens) if tokens is not None else 0,
+                    digest=(
+                        token_digest(tokens)
+                        if tokens is not None else None
+                    ),
+                    ttft_s=record.get("ttft_s"),
+                    wall_s=record.get("wall_s"),
+                    truncated=record.get("truncated", False),
+                    fingerprint=record.get("fingerprint"),
+                    error=record.get("error"),
+                )
+            if ctrl is not None:
+                ctrl.on_mirror(rid, record)
+
+    def _canary_tick(self) -> None:
+        """Apply the verdict machine's output each step: evaluate on
+        live pairs, then promote (flip to a full serving role, record
+        the winning fingerprint) or reject (migrate-first drain with
+        trace reason `canary_reject`; retired here once empty when no
+        reconciler owns retirement)."""
+        canary, ctrl = self._canary, self.canary_controller
+        if canary is None or ctrl is None:
+            return
+        if canary.replica.draining:
+            # Reject drain in flight. The reconciler retires drained
+            # members when one exists; without one the router must,
+            # or a rejected canary haunts the handle list forever.
+            if (
+                self._reconciler is None
+                and not canary.replica.has_work
+            ):
+                self.retire(canary)
+            return
+        state = ctrl.evaluate()
+        if state == "promote":
+            canary.role = "both"
+            self._canary = None
+            self.trace.event(
+                "canary_promote", time.monotonic(),
+                canary=canary.name,
+                fingerprint=ctrl.winning_fingerprint_id,
+                reason=ctrl.verdict_reason,
+            )
+            self._set_replica_gauges()
+        elif state == "reject":
+            self.trace.event(
+                "drain_start", time.monotonic(),
+                replica=canary.name, reason="canary_reject",
+                verdict=ctrl.verdict_reason,
+            )
+            self.start_drain(canary)
 
     def step(self) -> bool:
         """One fleet turn: advance every replica (draining ones
@@ -800,6 +1093,7 @@ class FleetRouter:
             self._decode_handoff()
         if self._reconciler is not None:
             self._reconciler.tick(self)
+        self._canary_tick()
         self._refresh_gauges()
         return self.has_work
 
@@ -831,19 +1125,29 @@ class FleetRouter:
     # -- telemetry -----------------------------------------------------
 
     def _set_replica_gauges(self) -> None:
-        active = [h for h in self._handles if not h.replica.draining]
+        active = [
+            h for h in self._handles
+            if not h.replica.draining and h.role != "canary"
+        ]
+        draining = [
+            h for h in self._handles if h.replica.draining
+        ]
         self.obs.replicas_gauge.set(
             len(active), labels={"state": "active"}
         )
         self.obs.replicas_gauge.set(
-            len(self._handles) - len(active),
-            labels={"state": "draining"},
+            len(draining), labels={"state": "draining"},
         )
 
     def _refresh_gauges(self) -> None:
         self._set_replica_gauges()
         self.obs.queue_depth.set(
-            sum(h.replica.queue_depth for h in self._handles)
+            # Shadow load is invisible: the canary's mirrored queue
+            # must not read as fleet admission pressure.
+            sum(
+                h.replica.queue_depth for h in self._handles
+                if h.role != "canary"
+            )
         )
         for handle in self._handles:
             sat = handle.replica.saturation
@@ -873,10 +1177,18 @@ class FleetRouter:
         # only: a draining member serves no traffic, so its skewed
         # tail windows must neither flag it (a flight bundle per
         # scale-down) nor contaminate the leave-one-out peer median
-        # the healthy replicas are judged against. Scrape-error
-        # accounting below still covers every handle — a flapping
-        # pod's history matters through its drain.
-        active = [h for h in handles if not h.replica.draining]
+        # the healthy replicas are judged against. The canary is
+        # excluded the same way: its candidate config's different
+        # timing profile must neither count as fleet capacity nor
+        # contaminate the peer median a straggler verdict compares
+        # against (the canary plane's latency windows are the right
+        # place to judge it). Scrape-error accounting below still
+        # covers every handle — a flapping pod's history matters
+        # through its drain.
+        active = [
+            h for h in handles
+            if not h.replica.draining and h.role != "canary"
+        ]
         self.obs.fleet_capacity.set(sum(
             int(getattr(h.replica, "slots", 0) or 0) for h in active
         ))
@@ -1142,6 +1454,7 @@ class FleetRouter:
             "replicas": [
                 {
                     "name": h.name,
+                    "role": h.role,
                     "draining": h.replica.draining,
                     "saturation": h.replica.saturation,
                     "slo_ok": h.replica.slo_ok,
@@ -1171,4 +1484,20 @@ class FleetRouter:
             "flight_dir": (
                 self.flight.dir if self.flight is not None else None
             ),
+            "canary": self.canary_stats(),
+        }
+
+    def canary_stats(self) -> dict | None:
+        """The shadow plane's status — the serverouter `/debug/canary`
+        payload (the controller's view plus the router-side mirror
+        fraction and whether the canary handle still exists). Survives
+        the canary's retirement: the terminal verdict, counters, and
+        any divergence bundle path stay readable; None only when no
+        canary was ever armed."""
+        if self.canary_controller is None:
+            return None
+        return {
+            "mirror_fraction": self.canary_mirror,
+            "armed": self._canary is not None,
+            **self.canary_controller.stats(),
         }

@@ -1,0 +1,237 @@
+"""Replay-driven engine autotune seed: one captured serving window
+re-executed across a grid of determinism-preserving engine overrides,
+every arm digest-verified before its throughput counts.
+
+The capture plane (`obs/capture.py` + `sim/replay.py`) records live
+traffic as a pure-function workload — (weights, prompt, knobs, seed)
+per request, plus the token digests the original engine produced.
+That makes a capture the safest possible tuning corpus: an override
+arm that changes token values is not a "different quality point", it
+is WRONG (every grid axis here is an ENGINE_KNOBS axis, proven
+token-preserving by the replay matrix), so `autotune_capture` replays
+the same window once per arm, verifies every completion against the
+captured digests, and only digest-clean arms compete on replayed
+throughput.
+
+The output is a seed, not a closed loop: a Pareto table over
+(replayed tokens/s up, divergent requests down) plus the headline
+`autotune_capacity_gain_pct` — the best VERIFIED arm's throughput
+gain over the capture's own config. Wiring the winning overrides into
+a restart (or a canary: `serverouter --canary-override KEY=VALUE`
+mirrors live traffic through the candidate config with the digest
+gate armed, `obs/canary.py`) stays an operator decision.
+
+Grid axes (`default_grid`): `loop_steps` (host<->device chat cadence),
+`prefill_chunk` (prefill slice size), and — when the capture ran
+speculative decoding — `spec_k` (draft depth). Neighbor values around
+the captured config, one knob per arm: an axis sweep localizes any
+win/regression to a single knob, which is what an operator acting on
+the table needs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+__all__ = [
+    "ArmResult",
+    "AutotuneReport",
+    "autotune_capture",
+    "default_grid",
+]
+
+
+@dataclass
+class ArmResult:
+    """One override arm's replay outcome. `ok` means every completed
+    request matched the captured digests — only ok arms are eligible
+    for the capacity headline."""
+
+    overrides: dict
+    wall_s: float = 0.0
+    tokens: int = 0
+    tokens_per_s: float = 0.0
+    verified: int = 0
+    divergent: int = 0
+    ok: bool = False
+    error: str | None = None  # arm could not run (bad override)
+
+    def label(self) -> str:
+        if not self.overrides:
+            return "baseline"
+        return ",".join(
+            f"{k}={v}" for k, v in sorted(self.overrides.items())
+        )
+
+
+@dataclass
+class AutotuneReport:
+    fingerprint_id: str | None
+    arms: list[ArmResult] = field(default_factory=list)  # [0]=baseline
+    replay_wall_s: float = 0.0
+
+    @property
+    def baseline(self) -> ArmResult:
+        return self.arms[0]
+
+    def pareto(self) -> list[ArmResult]:
+        """Arms no other arm dominates on (tokens/s up, divergent
+        down). Errored arms never make the frontier."""
+        ran = [a for a in self.arms if a.error is None]
+        front = []
+        for a in ran:
+            dominated = any(
+                b is not a
+                and b.tokens_per_s >= a.tokens_per_s
+                and b.divergent <= a.divergent
+                and (
+                    b.tokens_per_s > a.tokens_per_s
+                    or b.divergent < a.divergent
+                )
+                for b in ran
+            )
+            if not dominated:
+                front.append(a)
+        return front
+
+    def best(self) -> ArmResult | None:
+        """Highest-throughput arm among those that digest-verified."""
+        ok = [a for a in self.arms if a.ok]
+        return max(ok, key=lambda a: a.tokens_per_s) if ok else None
+
+    def capacity_gain_pct(self) -> float | None:
+        """Best verified arm's replayed-throughput gain over the
+        capture's own config; None when the baseline itself failed to
+        verify (nothing to gain against). 0.0 when no override beats
+        the baseline — never negative: shipping the captured config
+        unchanged is always on the menu."""
+        if not self.arms or not self.baseline.ok:
+            return None
+        best = self.best()
+        base = self.baseline.tokens_per_s
+        if best is None or base <= 0:
+            return None
+        return max(
+            0.0, round(100.0 * (best.tokens_per_s - base) / base, 2)
+        )
+
+    def table(self) -> str:
+        """The Pareto table, one printable line per arm."""
+        front = {id(a) for a in self.pareto()}
+        rows = [
+            f"{'arm':<28} {'tok/s':>8} {'verified':>8} "
+            f"{'divergent':>9} {'ok':>3} {'pareto':>6}"
+        ]
+        for a in self.arms:
+            if a.error is not None:
+                rows.append(f"{a.label():<28} ERROR: {a.error}")
+                continue
+            rows.append(
+                f"{a.label():<28} {a.tokens_per_s:>8.1f} "
+                f"{a.verified:>8d} {a.divergent:>9d} "
+                f"{'y' if a.ok else 'n':>3} "
+                f"{'*' if id(a) in front else '':>6}"
+            )
+        return "\n".join(rows)
+
+    def summary(self) -> dict:
+        """The headline-key view `bench.py` merges into its one JSON
+        line (names match BASELINE.json's published specs)."""
+        best = self.best()
+        gain = self.capacity_gain_pct()
+        out = {
+            "autotune_arms": len(self.arms),
+            "autotune_divergent_arms": sum(
+                1 for a in self.arms if a.error is None and not a.ok
+            ),
+            "autotune_baseline_tokens_per_s": round(
+                self.baseline.tokens_per_s, 1
+            ) if self.arms else None,
+            "autotune_best_overrides": (
+                dict(best.overrides) if best else None
+            ),
+            "autotune_wall_s": round(self.replay_wall_s, 2),
+        }
+        if gain is not None:
+            out["autotune_capacity_gain_pct"] = gain
+        return out
+
+
+def default_grid(fingerprint: dict) -> list[dict]:
+    """Single-knob neighbor arms around the capture's own engine
+    config: loop_steps and prefill_chunk at half/double the captured
+    value, spec_k +/-2 when the capture ran speculative decoding.
+    Arms equal to the captured value are dropped (the baseline
+    already covers them)."""
+    engine = dict((fingerprint or {}).get("engine") or {})
+    arms: list[dict] = []
+
+    def neighbors(knob, values, floor=1):
+        current = engine.get(knob)
+        if current is None:
+            return
+        for value in values:
+            value = max(floor, int(value))
+            if value != current:
+                arm = {knob: value}
+                if arm not in arms:
+                    arms.append(arm)
+
+    loop = int(engine.get("loop_steps") or 1)
+    neighbors("loop_steps", (loop // 2, loop * 2))
+    chunk = engine.get("prefill_chunk")
+    if chunk:
+        neighbors("prefill_chunk", (chunk // 2, chunk * 2), floor=8)
+    if engine.get("spec"):
+        k = int(engine.get("spec_k") or 1)
+        neighbors("spec_k", (k - 2, k + 2))
+    return arms
+
+
+def autotune_capture(
+    capture,
+    params,
+    *,
+    arms: list[dict] | None = None,
+) -> AutotuneReport:
+    """Replay `capture` once per override arm (plus the no-override
+    baseline), digest-verify every arm, and rank. Each arm rebuilds
+    its engine from the capture's fingerprint + overrides — the same
+    construction path `cmd/replay.py` uses, so an arm's verdict here
+    predicts a `--override` replay's verdict exactly. An arm whose
+    override the engine rejects (e.g. a prefill_chunk the pool cannot
+    back) is kept in the table as an ERROR row, never silently
+    dropped."""
+    from walkai_nos_tpu.sim.replay import replay_capture
+
+    if arms is None:
+        arms = default_grid(capture.fingerprint)
+    report = AutotuneReport(fingerprint_id=capture.fingerprint_id)
+    t0 = time.monotonic()
+    for overrides in [{}] + list(arms):
+        arm = ArmResult(overrides=dict(overrides))
+        try:
+            t_arm = time.monotonic()
+            rep = replay_capture(
+                capture, params, overrides=overrides, timing="asap",
+            )
+            arm.wall_s = time.monotonic() - t_arm
+        except (ValueError, RuntimeError) as bad:
+            arm.error = str(bad)
+            report.arms.append(arm)
+            continue
+        arm.tokens = sum(
+            len(o.tokens)
+            for o in rep.outcomes.values()
+            if o.tokens is not None
+        )
+        arm.verified = rep.n_verified
+        arm.divergent = len(rep.divergent)
+        arm.ok = rep.ok and rep.n_verified > 0
+        arm.tokens_per_s = (
+            arm.tokens / arm.wall_s if arm.wall_s > 0 else 0.0
+        )
+        report.arms.append(arm)
+    report.replay_wall_s = time.monotonic() - t0
+    return report

@@ -57,6 +57,8 @@ __all__ = [
     "ReplayReport",
     "build_config",
     "build_engine",
+    "classify_config_delta",
+    "first_divergence",
     "load_capture",
     "replay_capture",
     "triage_divergence",
@@ -71,6 +73,68 @@ ENGINE_KNOBS = (
     "spec_min_accept", "spec_warmup_rounds", "spec_ema_alpha",
     "sp_prefill", "sp_min_tokens", "sp_span",
 )
+
+# LMConfig fields whose change preserves token VALUES (the purity
+# invariant the replay plane pins): tp degree is proven
+# token-identical to tp=1; kv_dtype/w_dtype count separately because
+# only SOME transitions preserve tokens (see classify_config_delta).
+TOKEN_PRESERVING_CFG_FIELDS = ("tp_devices",)
+
+# Dtype values whose pairwise transitions keep the serving function:
+# "int8-sim" runs identity quantization with unit scales, so it is
+# token-identical to "model" by construction; real "int8" rounds.
+_TOKEN_PRESERVING_DTYPES = frozenset({"model", "int8-sim"})
+
+
+def classify_config_delta(fp_a: dict, fp_b: dict) -> dict:
+    """Classify the config delta between two engine fingerprints —
+    the canary plane's up-front gate decision (`obs/canary.py`): is
+    the candidate config expected to produce IDENTICAL token streams
+    (digest-exact gate armed) or does a delta field move the serving
+    function (latency-only comparison)?
+
+    Compares the fingerprints' `cfg` and `engine` sections
+    field-by-field — NOT the weights digest: the digest gate exists
+    precisely to catch a weights change the config delta cannot
+    explain (same knobs, different checkpoint -> gate armed ->
+    divergence -> reject). A delta field is token-preserving when it
+    is an engine knob (every ENGINE_KNOBS axis is a
+    determinism-preserving replay override), a known-safe LMConfig
+    field (`tp_devices`), or a kv_dtype/w_dtype transition within
+    {"model", "int8-sim"}; anything else — model dims, vocab, real
+    int8 — declares the configs different functions.
+
+    Returns `{"delta": [{"section", "field", "a", "b"}, ...],
+    "token_preserving": bool, "moving_fields": [...]}`; an empty
+    delta (identical configs) is trivially token-preserving."""
+    delta: list[dict] = []
+    moving: list[str] = []
+    for section in ("cfg", "engine"):
+        a = dict((fp_a or {}).get(section) or {})
+        b = dict((fp_b or {}).get(section) or {})
+        for field_name in sorted(set(a) | set(b)):
+            va, vb = a.get(field_name), b.get(field_name)
+            if va == vb:
+                continue
+            delta.append({
+                "section": section, "field": field_name,
+                "a": va, "b": vb,
+            })
+            if section == "engine":
+                if field_name in ENGINE_KNOBS:
+                    continue
+            elif field_name in TOKEN_PRESERVING_CFG_FIELDS:
+                continue
+            elif field_name in ("kv_dtype", "w_dtype") and {
+                va, vb
+            } <= _TOKEN_PRESERVING_DTYPES:
+                continue
+            moving.append(f"{section}.{field_name}")
+    return {
+        "delta": delta,
+        "token_preserving": not moving,
+        "moving_fields": moving,
+    }
 
 
 @dataclass
@@ -97,6 +161,12 @@ class CaptureRecord:
     truncated: bool = False
     reason: str | None = None
     error: str | None = None  # fleet captures: failed replica request
+    # Fleet captures: True on the shadow copy a canary-armed router
+    # mirrored to its candidate replica. Mirrored rows never represent
+    # user traffic — load_capture drops them by default so a replay
+    # of a canary-armed window does not double-count every sampled
+    # request.
+    mirrored: bool = False
 
 
 @dataclass
@@ -107,13 +177,19 @@ class Capture:
     files: list[str]
     runs: int = 1  # engine runs found in the file set
     run: int = 0  # which run this Capture holds (0-based)
+    mirrored_skipped: int = 0  # canary shadow rows dropped at load
 
     @property
     def fingerprint_id(self) -> str | None:
         return (self.fingerprint or {}).get("id")
 
 
-def load_capture(path: str, *, run: int | None = None) -> Capture:
+def load_capture(
+    path: str,
+    *,
+    run: int | None = None,
+    include_mirrored: bool = False,
+) -> Capture:
     """Parse a capture file, or a directory of rotated capture files
     (oldest first — each file is self-contained behind its own
     header). Malformed lines are skipped and counted, never fatal: a
@@ -131,7 +207,13 @@ def load_capture(path: str, *, run: int | None = None) -> Capture:
     rotates through; a restart stamps a new one). `run` selects
     which run to load (0-based, negative from the end); default the
     LATEST — the incident-relevant one. `Capture.runs` says how many
-    were found so callers can surface the choice."""
+    were found so callers can surface the choice.
+
+    A canary-armed fleet's capture carries each sampled request TWICE
+    — the primary row serving the user plus a `mirrored: true` shadow
+    row — so mirrored records are dropped by default (counted in
+    `Capture.mirrored_skipped`); `include_mirrored=True` keeps them
+    for shadow-side forensics."""
     if os.path.isdir(path):
         files = sorted(glob.glob(os.path.join(path, "capture-*.jsonl")))
     else:
@@ -208,16 +290,22 @@ def load_capture(path: str, *, run: int | None = None) -> Capture:
     )
     known = {f.name for f in CaptureRecord.__dataclass_fields__.values()}
     records = []
+    mirrored_skipped = 0
     for rid in sorted(
         submits, key=lambda r: (submits[r].get("arrival_s", 0.0), r)
     ):
         merged = {**submits[rid], **(dones.get(rid) or {})}
-        records.append(CaptureRecord(**{
+        rec = CaptureRecord(**{
             k: v for k, v in merged.items() if k in known
-        }))
+        })
+        if rec.mirrored and not include_mirrored:
+            mirrored_skipped += 1
+            continue
+        records.append(rec)
     return Capture(
         bucket["header"], records, skipped, files,
         runs=len(buckets), run=idx,
+        mirrored_skipped=mirrored_skipped,
     )
 
 
@@ -351,14 +439,19 @@ class ReplayReport:
         }
 
 
-def _first_divergence(expected: list, got: list) -> int:
-    """Index of the first divergent token between the captured and
-    replayed streams (a stream that is a strict prefix of the other
-    diverges at the shorter length)."""
+def first_divergence(expected: list, got: list) -> int:
+    """Index of the first divergent token between two streams (a
+    stream that is a strict prefix of the other diverges at the
+    shorter length). Shared by replay verification and the canary
+    plane's per-request digest diff (`obs/canary.py`)."""
     for i, (a, b) in enumerate(zip(expected, got)):
         if int(a) != int(b):
             return i
     return min(len(expected), len(got))
+
+
+# Backward-compatible private alias (pre-canary internal name).
+_first_divergence = first_divergence
 
 
 def _submit_record(engine, rec: CaptureRecord) -> int:
@@ -469,7 +562,7 @@ def replay_capture(
                 out.match = expected == replayed
             report.n_verified += 1
             if not out.match:
-                out.first_divergent_token = _first_divergence(
+                out.first_divergent_token = first_divergence(
                     expected, replayed
                 )
         report.outcomes[rec.rid] = out

@@ -35,7 +35,14 @@ Headline keys (gated absent_ok in BASELINE.json, emitted by
   (`measure_router_obs_overhead`: the same trace replayed with the
   router-side plane on vs off, engine telemetry on in both arms),
   gated at the same absolute < 2% budget as the engine's
-  `obs_overhead_pct`.
+  `obs_overhead_pct`;
+- `router_canary_overhead_pct` / `router_canary_divergence_total` —
+  the shadow plane's cost and correctness proof
+  (`measure_canary_overhead`: the same trace replayed with a
+  same-config canary mirroring 100% of submits vs no canary, arms
+  interleaved per repeat; a same-weights mirror MUST produce zero
+  digest divergences, and the primary-path tax is gated at the same
+  absolute < 2% budget).
 
 The trace is tick-based, not wall-clock-based: arrivals land at
 router-step boundaries by largest-remainder apportionment of a
@@ -57,6 +64,7 @@ from walkai_nos_tpu.utils.stats import percentile
 __all__ = [
     "TrafficBenchResult",
     "make_trace",
+    "measure_canary_overhead",
     "measure_router_obs_overhead",
     "run_long_context_benchmark",
     "run_traffic_benchmark",
@@ -616,4 +624,119 @@ def measure_router_obs_overhead(
         ),
         "router_obs_on_wall_s": round(on, 4),
         "router_obs_off_wall_s": round(off, 4),
+    }
+
+
+def measure_canary_overhead(
+    *,
+    n_replicas: int = 2,
+    requests: int = 48,
+    templates: int = 4,
+    ticks: int = 24,
+    slots: int = 4,
+    max_new: int = 6,
+    repeats: int = 3,
+    seed: int = 0,
+    cfg=None,
+    params=None,
+) -> dict:
+    """A/B of the shadow plane's primary-path cost AND its
+    correctness invariant in one measurement: the same deterministic
+    trace replayed through fresh fleets with a SAME-CONFIG canary
+    mirroring 100% of submits vs no canary at all, arms interleaved
+    per repeat, median wall seconds each. The canary replica serves
+    the same weights and knobs as the fleet, so the digest gate is
+    armed and every mirrored pair must match token-for-token —
+    `router_canary_divergence_total` is emitted and MUST be 0 (a
+    nonzero value means the mirror seam itself changes tokens, which
+    would make every real canary verdict meaningless).
+
+    The budgeted key is the ROUTER-PLANE tax only (mirror submit +
+    capture bookkeeping on the submit path, pairing + crc32 compare
+    at the completion seam, per-step verdict evaluation). In
+    production engine compute rides accelerators — the canary's on a
+    device that serves no user traffic — but this in-process harness
+    steps every engine serially inside `router.step()`, so engine
+    `step()` time is measured separately (timed wrappers on every
+    replica, both arms) and subtracted: overhead =
+    (on_plane_wall - off_plane_wall) / off_total_wall, where
+    plane_wall = total_wall - engine_step_wall. Without the
+    subtraction the key would mostly measure the canary's decode
+    compute and the idle primary steps taken while the drain loop
+    waits for the last mirrors — neither exists on real hardware.
+    Gated at the same absolute < 2% budget as
+    `router_obs_overhead_pct`."""
+    cfg, params, factory = default_engine_factory(
+        cfg, params, slots=slots
+    )
+    trace, _ = make_trace(
+        requests=requests, templates=templates, ticks=ticks,
+        max_new=max_new, vocab=cfg.vocab_size, seed=seed,
+    )
+    from walkai_nos_tpu.router.core import FleetRouter
+
+    seq = [0]
+    divergences = [0]
+    compared = [0]
+
+    def one_replay(mirrored: bool) -> tuple[float, float]:
+        arm = "on" if mirrored else "off"
+        replicas = [
+            factory(f"cny-{arm}{seq[0]}-{i}")
+            for i in range(n_replicas)
+        ]
+        canary = factory(f"cny-{arm}{seq[0]}-c") if mirrored else None
+        seq[0] += 1
+        engine_step_s = [0.0]
+
+        def timed(replica):
+            # Bill engine compute to the engines (accelerators in
+            # production, serial host work here), both arms.
+            orig_step = replica.step
+
+            def timed_step():
+                t = time.perf_counter()
+                orig_step()
+                engine_step_s[0] += time.perf_counter() - t
+
+            replica.step = timed_step
+            return replica
+
+        for replica in replicas + ([canary] if canary else []):
+            _warm(replica)
+            timed(replica)
+        router = FleetRouter(
+            replicas, policy="affinity", seed=seed,
+            canary_mirror=1.0,
+        )
+        if canary is not None:
+            router.add_replica(canary, role="canary")
+        t0 = time.perf_counter()
+        _replay(router, trace, set())
+        wall = time.perf_counter() - t0
+        if canary is not None:
+            stats = router.canary_stats()
+            divergences[0] += stats["divergences"]
+            compared[0] += stats["compared"]
+        return wall - engine_step_s[0], wall
+
+    plane: dict[bool, list[float]] = {True: [], False: []}
+    total: dict[bool, list[float]] = {True: [], False: []}
+    for _ in range(max(1, repeats)):
+        for mirrored in (True, False):
+            plane_wall, wall = one_replay(mirrored)
+            plane[mirrored].append(plane_wall)
+            total[mirrored].append(wall)
+    on = sorted(plane[True])[len(plane[True]) // 2]
+    off = sorted(plane[False])[len(plane[False]) // 2]
+    off_total = sorted(total[False])[len(total[False]) // 2]
+    return {
+        "router_canary_overhead_pct": round(
+            100.0 * (on - off) / max(off_total, 1e-9), 2
+        ),
+        "router_canary_divergence_total": divergences[0],
+        "router_canary_compared_total": compared[0],
+        "router_canary_on_plane_wall_s": round(on, 4),
+        "router_canary_off_plane_wall_s": round(off, 4),
+        "router_canary_off_wall_s": round(off_total, 4),
     }
