@@ -4,7 +4,7 @@
 IMG ?= ghcr.io/walkai/nos-tpu:latest
 KIND_CLUSTER ?= walkai-nos
 
-.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check metrics-lint replay-check canary-check dryrun docker-build kind-cluster deploy undeploy clean
+.PHONY: all test test-fast test-slow smoke e2e e2e-kind native bench bench-check metrics-lint replay-check replay-corpus-check canary-check dryrun docker-build kind-cluster deploy undeploy clean
 
 all: native test
 
@@ -61,6 +61,15 @@ metrics-lint:
 # on any token divergence. Also tier-1 via tests/test_capture_replay.py.
 replay-check:
 	python hack/replay_check.py
+
+# Rotating-corpus determinism gate (ROADMAP 4(c)): maintain a
+# size-bounded corpus of the last N captures — here a self-contained
+# demo corpus holding a base run AND a multi-LoRA run (the synthetic
+# recipe in the fingerprint makes the LoRA replay digest-exact) —
+# and replay every entry through cmd/replay.py, exit nonzero on any
+# divergence. Also tier-1 via tests/test_replay_corpus.py.
+replay-corpus-check:
+	python hack/replay_corpus.py
 
 # Shadow/canary plane gate: a tiny in-process fleet mirrors 100% of
 # a deterministic run to a same-config canary (must PROMOTE with

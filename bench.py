@@ -588,6 +588,7 @@ def cb_serving_benchmark() -> dict:
     `cb_spec_accepted_per_round` reports the amortization per verify
     dispatch."""
     from bench_lm import (
+        measure_cb_lora_serving,
         measure_cb_prefix_reuse,
         measure_cb_quant_serving,
         measure_cb_serving,
@@ -617,6 +618,14 @@ def cb_serving_benchmark() -> dict:
     # it; the CPU arm emulates the mesh and proves serving, not
     # speedup).
     out.update(measure_cb_tp_serving(
+        baseline_capacity=out.get("cb_serving_capacity_tokens_per_s"),
+    ))
+    # Multi-LoRA arm (WALKAI_CB_LORA, models/lora.py): K=4 synthetic
+    # adapters resident, requests fanned across {base..4} so every
+    # batch mixes tenants, this run's base capacity as the anchor —
+    # `cb_lora_overhead_pct` is budgeted <= 10% in BASELINE.json
+    # (near-base throughput is the Punica/S-LoRA acceptance bar).
+    out.update(measure_cb_lora_serving(
         baseline_capacity=out.get("cb_serving_capacity_tokens_per_s"),
     ))
     return out

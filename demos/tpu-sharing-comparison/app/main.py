@@ -628,6 +628,27 @@ def main() -> None:
             from walkai_nos_tpu.obs.capture import CaptureLog
 
             cb_capture = CaptureLog.from_env()
+            # Batched multi-LoRA serving (WALKAI_CB_LORA=K,
+            # models/lora.py): arm the paged batcher with K synthetic
+            # low-rank adapters (rank bucket WALKAI_CB_LORA_RANK) so
+            # /generate requests can pick a fine-tuned variant with
+            # an `adapter` body field. Synthetic demo adapters: the
+            # capture fingerprint records their recipe + digests, so
+            # a LoRA-armed capture replays digest-exact with zero
+            # stored adapter weights. Real adapter trees hot-load at
+            # runtime via the engine's load_adapter seam.
+            cb_lora_kwargs = {}
+            cb_lora_k = int(os.environ.get("WALKAI_CB_LORA", "0"))
+            if cb_lora_k > 0:
+                from walkai_nos_tpu.models.lora import AdapterSet
+
+                cb_lora_kwargs["adapters"] = AdapterSet.synthetic(
+                    cb_cfg,
+                    k=cb_lora_k,
+                    rank=int(
+                        os.environ.get("WALKAI_CB_LORA_RANK", "4")
+                    ),
+                )
             cb_engine = ContinuousBatcher(
                 cb_cfg,
                 lm_params,
@@ -685,6 +706,7 @@ def main() -> None:
                 ) == "1",
                 **cb_spec_kwargs,
                 **cb_slo_kwargs,
+                **cb_lora_kwargs,
                 obs=obs,
             )
             # Compile prefill + chunk step (and, with loop_steps > 1,
@@ -794,6 +816,7 @@ def main() -> None:
                             waiter["truncated"] = rec.get(
                                 "truncated", False
                             )
+                            waiter["adapter"] = rec.get("adapter", 0)
                             if waiter.get("queue") is not None:
                                 waiter["queue"].put(None)  # end of stream
                             waiter["done"].set()
@@ -1128,6 +1151,11 @@ def main() -> None:
                 }
                 if body.get("seed") is not None:
                     knobs["seed"] = int(body["seed"])
+                if body.get("adapter") is not None:
+                    # Multi-LoRA adapter selection (WALKAI_CB_LORA):
+                    # validated engine-side — an unknown id fails only
+                    # this request (bad_request -> 400).
+                    knobs["adapter"] = int(body["adapter"])
                 req_max_new = (
                     int(body["max_new_tokens"])
                     if body.get("max_new_tokens") is not None else None
@@ -1154,6 +1182,10 @@ def main() -> None:
                 or knobs["top_k"] != 0
                 or knobs["top_p"] != 1.0
                 or "seed" in knobs
+                # Adapter routing exists only on the batched engine —
+                # the serialized fallback would silently serve BASE
+                # weights for a fine-tuned tenant's request.
+                or "adapter" in knobs
                 # Per-request budget/EOS/streaming ride the slot pool:
                 # the one-shot paths compile per max_new signature,
                 # have no EOS scan, and produce tokens all at once.
@@ -1256,6 +1288,9 @@ def main() -> None:
                         # fewer tokens than requested is then a
                         # capacity signal, not a natural completion.
                         "truncated": waiter.get("truncated", False),
+                        # Which LoRA adapter served it (0 = base) —
+                        # per-tenant attribution for router captures.
+                        "adapter": waiter.get("adapter", 0),
                     }, headers={"X-Walkai-Trace": trace_id})
                 except (BrokenPipeError, ConnectionResetError):
                     # Client gave up before the response: the work was
@@ -1380,6 +1415,7 @@ def main() -> None:
                                 "truncated": waiter.get(
                                     "truncated", False
                                 ),
+                                "adapter": waiter.get("adapter", 0),
                             })
                         return
                     event({"tokens": item})
@@ -1496,6 +1532,7 @@ def main() -> None:
                     payload["cb_quant"] = cb_engine.quant_stats()
                     payload["cb_tp"] = cb_engine.tp_stats()
                     payload["cb_sp"] = cb_engine.sp_stats()
+                    payload["cb_lora"] = cb_engine.lora_stats()
                 self._json(200, payload)
             else:
                 self.send_error(404)

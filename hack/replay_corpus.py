@@ -1,0 +1,287 @@
+"""Rotating capture-corpus replay gate (`make replay-corpus-check`,
+tier-1 via tests/test_replay_corpus.py; ROADMAP item 4(c)).
+
+`hack/replay_check.py` proves ONE fresh capture replays clean;
+incidents regress against OLD captures — a config/weights change that
+silently moves the serving function on traffic recorded weeks ago.
+This gate maintains a size-bounded corpus directory of the last N
+captures (each entry one rotated capture set under `NNNN-name/`,
+oldest pruned first by count then by total bytes) and replays EVERY
+entry through `cmd/replay.py` — the operator CLI — exiting nonzero on
+the first divergence.
+
+Run modes:
+
+    python hack/replay_corpus.py
+        self-contained gate (the make target / tier-1 pin): build a
+        temp corpus from two deterministic runs — a base engine and a
+        multi-LoRA-armed engine serving mixed adapter ids (the
+        fingerprint's synthetic recipe + per-adapter digests make the
+        LoRA replay digest-exact with zero stored adapter weights) —
+        then replay the whole corpus.
+
+    python hack/replay_corpus.py CORPUS_DIR [--add CAPTURE] ...
+        operator mode: optionally rotate a fresh capture in (pruning
+        to --max-captures / --max-bytes), then replay every entry.
+
+CPU-pinned and hardware-free, like every determinism gate here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+DEFAULT_MAX_CAPTURES = 8
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+
+def corpus_entries(corpus_dir: str) -> list[str]:
+    """Corpus entries oldest-first: the `NNNN-name` subdirectories
+    (zero-padded rotation sequence, so lexical order IS arrival
+    order)."""
+    if not os.path.isdir(corpus_dir):
+        return []
+    return sorted(
+        os.path.join(corpus_dir, d)
+        for d in os.listdir(corpus_dir)
+        if os.path.isdir(os.path.join(corpus_dir, d))
+        and d[:4].isdigit()
+    )
+
+
+def _entry_bytes(entry: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(entry):
+        for fname in files:
+            total += os.path.getsize(os.path.join(root, fname))
+    return total
+
+
+def prune_corpus(
+    corpus_dir: str,
+    *,
+    max_captures: int = DEFAULT_MAX_CAPTURES,
+    max_bytes: int = DEFAULT_MAX_BYTES,
+) -> list[str]:
+    """Drop oldest entries while over the count bound, then while
+    over the byte bound — but never the newest entry (an oversized
+    latest capture must stay replayable rather than empty the
+    corpus). Returns the pruned entry paths."""
+    pruned: list[str] = []
+    entries = corpus_entries(corpus_dir)
+    while len(entries) > max(1, max_captures):
+        pruned.append(entries.pop(0))
+    sizes = {e: _entry_bytes(e) for e in entries}
+    while len(entries) > 1 and sum(sizes.values()) > max_bytes:
+        victim = entries.pop(0)
+        sizes.pop(victim)
+        pruned.append(victim)
+    for entry in pruned:
+        shutil.rmtree(entry, ignore_errors=True)
+    return pruned
+
+
+def add_capture(
+    corpus_dir: str,
+    capture_path: str,
+    *,
+    name: str = "capture",
+    max_captures: int = DEFAULT_MAX_CAPTURES,
+    max_bytes: int = DEFAULT_MAX_BYTES,
+) -> str:
+    """Rotate one capture (a capture-*.jsonl file or the directory
+    holding a rotated set) into the corpus as the newest entry, then
+    prune. Returns the new entry path."""
+    if not os.path.exists(capture_path):
+        raise FileNotFoundError(f"no capture at {capture_path!r}")
+    os.makedirs(corpus_dir, exist_ok=True)
+    entries = corpus_entries(corpus_dir)
+    seq = (
+        int(os.path.basename(entries[-1]).split("-", 1)[0]) + 1
+        if entries else 0
+    )
+    entry = os.path.join(corpus_dir, f"{seq:04d}-{name}")
+    os.makedirs(entry)
+    if os.path.isdir(capture_path):
+        for fname in sorted(os.listdir(capture_path)):
+            if fname.startswith("capture-") and fname.endswith(".jsonl"):
+                shutil.copy2(
+                    os.path.join(capture_path, fname), entry
+                )
+    else:
+        shutil.copy2(capture_path, entry)
+    prune_corpus(
+        corpus_dir, max_captures=max_captures, max_bytes=max_bytes
+    )
+    return entry
+
+
+def replay_corpus(
+    corpus_dir: str, *, init_seed: int = 0
+) -> tuple[int, list[tuple[str, int]]]:
+    """Replay every corpus entry through `cmd/replay.py`. Returns
+    (worst exit code, [(entry, rc), ...])."""
+    from walkai_nos_tpu.cmd.replay import main as replay_main
+
+    results: list[tuple[str, int]] = []
+    for entry in corpus_entries(corpus_dir):
+        rc = replay_main([entry, "--init-seed", str(init_seed)])
+        results.append((entry, rc))
+    worst = max((rc for _e, rc in results), default=0)
+    return worst, results
+
+
+def record_lora_traffic(capture_dir: str):
+    """One deterministic multi-LoRA traffic run through a
+    capture-armed tiny engine: three resident adapters (synthetic
+    recipe — the fingerprint carries k/rank/seed/scale so replay
+    rebuilds the EXACT adapter weights from the recipe, plus
+    per-adapter digests to prove it), requests fanned across adapter
+    ids 0/1/2 with mixed greedy/sampled knobs. Returns the completed
+    {rid: tokens}."""
+    import numpy as np
+
+    import jax
+
+    from walkai_nos_tpu.models.lm import DecoderLM, LMConfig
+    from walkai_nos_tpu.models.lora import AdapterSet
+    from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+    cfg = LMConfig(
+        vocab_size=64, hidden_dim=32, num_layers=1, num_heads=2,
+        max_seq_len=320, dtype="float32",
+    )
+    params = DecoderLM(cfg).init_params(jax.random.PRNGKey(0))
+    adapters = AdapterSet.synthetic(cfg, k=3, rank=2, seed=0, scale=0.5)
+    engine = ContinuousBatcher(
+        cfg, params, slots=2, cache_len=256, prompt_bucket=16,
+        chunk_steps=2, paged=True, capture=capture_dir,
+        adapters=adapters,
+    )
+    rng = np.random.default_rng(1)
+    for plen, temperature, adapter in (
+        (3, 0.0, 1), (140, 0.0, 2), (5, 1.0, 0),
+        (9, 1.0, 1), (130, 1.0, 2), (4, 0.0, 0),
+    ):
+        engine.submit(
+            rng.integers(0, cfg.vocab_size, plen).tolist(),
+            max_new_tokens=int(rng.integers(3, 9)),
+            eos_id=3,
+            temperature=temperature,
+            adapter=adapter,
+        )
+    return engine.run()
+
+
+def build_demo_corpus(
+    corpus_dir: str,
+    *,
+    max_captures: int = DEFAULT_MAX_CAPTURES,
+    max_bytes: int = DEFAULT_MAX_BYTES,
+) -> list[str]:
+    """Record the two deterministic runs (base + multi-LoRA) and
+    rotate both into the corpus. Returns the entry paths."""
+    import importlib.util
+
+    # hack/ is scripts, not a package — load the sibling by path.
+    spec = importlib.util.spec_from_file_location(
+        "walkai_replay_check",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "replay_check.py"),
+    )
+    replay_check = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(replay_check)
+    record_traffic = replay_check.record_traffic
+
+    entries = []
+    for name, recorder in (
+        ("base", record_traffic), ("lora", record_lora_traffic),
+    ):
+        with tempfile.TemporaryDirectory(
+            prefix=f"walkai-corpus-{name}-"
+        ) as capture_dir:
+            results = recorder(capture_dir)
+            print(f"recorded {len(results)} request(s) [{name}]")
+            entries.append(add_capture(
+                corpus_dir, capture_dir, name=name,
+                max_captures=max_captures, max_bytes=max_bytes,
+            ))
+    return entries
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=(
+            "maintain a rotating corpus of serving captures and "
+            "replay every entry through cmd/replay.py"
+        )
+    )
+    parser.add_argument(
+        "corpus", nargs="?", default=None,
+        help="corpus directory (default: self-contained temp corpus "
+             "seeded with two deterministic demo runs)",
+    )
+    parser.add_argument(
+        "--add", action="append", default=[], metavar="CAPTURE",
+        help="rotate a capture file/dir into the corpus first "
+             "(repeatable)",
+    )
+    parser.add_argument(
+        "--max-captures", type=int, default=DEFAULT_MAX_CAPTURES,
+    )
+    parser.add_argument(
+        "--max-bytes", type=int, default=DEFAULT_MAX_BYTES,
+    )
+    parser.add_argument("--init-seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    def run(corpus_dir: str) -> int:
+        for capture in args.add:
+            entry = add_capture(
+                corpus_dir, capture,
+                max_captures=args.max_captures,
+                max_bytes=args.max_bytes,
+            )
+            print(f"rotated {capture} -> {entry}")
+        if args.corpus is None:
+            build_demo_corpus(
+                corpus_dir, max_captures=args.max_captures,
+                max_bytes=args.max_bytes,
+            )
+        entries = corpus_entries(corpus_dir)
+        if not entries:
+            print("replay-corpus-check: corpus is empty; nothing to replay")
+            return 0
+        worst, results = replay_corpus(
+            corpus_dir, init_seed=args.init_seed
+        )
+        for entry, rc in results:
+            print(
+                f"  {os.path.basename(entry)}: "
+                + ("token-identical" if rc == 0 else "DIVERGENT")
+            )
+        if worst:
+            print("replay-corpus-check FAILED: divergent capture(s)")
+        else:
+            print(f"replay-corpus-check ok ({len(results)} capture(s))")
+        return worst
+
+    if args.corpus is not None:
+        return run(args.corpus)
+    with tempfile.TemporaryDirectory(
+        prefix="walkai-replay-corpus-"
+    ) as corpus_dir:
+        return run(corpus_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -617,6 +617,68 @@ class TestRepoArtifacts:
         assert "replay-check:" in text
         assert "hack/replay_check.py" in text
 
+    def test_repo_baseline_gates_lora_serving_keys(self):
+        """BASELINE.json carries the multi-LoRA serving keys and they
+        PARSE through the comparator: the capacity key is an
+        absent_ok floor at 0.9x the r5 base capacity anchor
+        (tolerance 0), the overhead key an absent_ok <= 10% budget —
+        the Punica/S-LoRA near-base-throughput bar for K=4 resident
+        adapters with mixed-tenant traffic. Absent from the bench
+        output is a skip note; a capacity under the floor or an
+        overhead past the budget fails once emitted."""
+        with open(_ROOT / "BASELINE.json") as f:
+            published = json.load(f)["published"]
+        cap = published["cb_lora_capacity_tokens_per_s"]
+        assert cap["direction"] == "higher"
+        assert cap["tolerance"] == 0.0
+        assert cap["absent_ok"] is True
+        # Anchored at 0.9x the r5 base capacity (the 10% budget).
+        base_cap = published[
+            "cb_serving_capacity_tokens_per_s"
+        ]["value"]
+        assert abs(cap["value"] - 0.9 * base_cap) < 0.1
+        ovh = published["cb_lora_overhead_pct"]
+        assert ovh["direction"] == "lower"
+        assert ovh["tolerance"] == 0.0
+        assert ovh["absent_ok"] is True
+        assert ovh["value"] == 10.0
+        keys = (
+            "cb_lora_capacity_tokens_per_s", "cb_lora_overhead_pct",
+        )
+        base = {"published": {k: published[k] for k in keys}}
+        failures, notes = bench_check.check({}, base)
+        assert failures == []
+        assert sum("absent" in n for n in notes) == 2
+        failures, _ = bench_check.check(
+            {"cb_lora_capacity_tokens_per_s": cap["value"] * 1.05,
+             "cb_lora_overhead_pct": 4.2},
+            base,
+        )
+        assert failures == []
+        # A NEGATIVE overhead (noise floor: the armed arm measured
+        # faster) passes — the budget only caps the upside.
+        failures, _ = bench_check.check(
+            {"cb_lora_overhead_pct": -0.8}, base
+        )
+        assert failures == []
+        failures, _ = bench_check.check(
+            {"cb_lora_capacity_tokens_per_s": cap["value"] * 0.9,
+             "cb_lora_overhead_pct": 14.0},
+            base,
+        )
+        assert len(failures) == 2
+        assert any(
+            "cb_lora_capacity_tokens_per_s" in f for f in failures
+        )
+        assert any("cb_lora_overhead_pct" in f for f in failures)
+
+    def test_makefile_has_replay_corpus_check_target(self):
+        # The rotating-corpus determinism gate (hack/replay_corpus.py)
+        # — pinned fast in tests/test_replay_corpus.py.
+        text = (_ROOT / "Makefile").read_text()
+        assert "replay-corpus-check:" in text
+        assert "hack/replay_corpus.py" in text
+
     def test_makefile_has_canary_check_target(self):
         # The shadow/canary plane gate (hack/canary_check.py) —
         # pinned fast in tests/test_canary.py.

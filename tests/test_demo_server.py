@@ -575,6 +575,19 @@ class TestContinuousBatchingEndpoint:
         state = get_json(f"{cb_server}/debug/state")["engine"]
         assert state["tp"]["tp_devices"] == 1
 
+    def test_stats_expose_lora_section_disabled(self, cb_server):
+        """/stats carries the multi-LoRA view (`cb_lora`,
+        `ContinuousBatcher.lora_stats()`) — this fixture runs without
+        WALKAI_CB_LORA, so the feature reads disabled and an adapter
+        body field is a 400, never a silent base-weights serve."""
+        assert get_json(f"{cb_server}/stats")["cb_lora"] == {
+            "enabled": False
+        }
+        status, _ = self._post(
+            cb_server, {"prompt": [1, 2, 3], "adapter": 1}
+        )
+        assert status == 400
+
     def test_metrics_prometheus_exposition(self, cb_server):
         """/metrics serves valid Prometheus text with the serving
         registry's series after traffic."""
@@ -724,3 +737,71 @@ class TestContinuousBatchingEndpoint:
         except urllib.error.HTTPError as e:
             raised = e.code
         assert raised == 404
+
+
+class TestMultiLoraEndpoint:
+    """WALKAI_CB_LORA=K arms the batcher with K synthetic adapters
+    (deterministic recipe — the same weights `sim/replay.py` rebuilds
+    from a capture fingerprint): /generate routes an `adapter` body
+    field through the batched path, responses echo the id for
+    attribution, and /stats `cb_lora` carries the registry view."""
+
+    @pytest.fixture(scope="class")
+    def lora_server(self):
+        proc, base = spawn_server(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "WALKAI_DEMO_MODEL": "tiny",
+                "WALKAI_DEMO_LM": "1",
+                "WALKAI_LM_MAX_NEW": "6",
+                "WALKAI_CB_SLOTS": "2",
+                "WALKAI_CB_CHUNK": "2",
+                "WALKAI_MAX_BATCH": "8",
+                "WALKAI_WARM_BUCKETS": "1",
+                "WALKAI_CALIB_WINDOW_S": "0.2",
+                "WALKAI_CB_LORA": "3",
+                "WALKAI_CB_LORA_RANK": "2",
+            },
+            startup_timeout_s=300.0,
+            poll_s=0.25,
+        )
+        yield base
+        kill_server(proc)
+
+    _post = TestGenerateEndpoint._post
+
+    def test_adapter_requests_serve_and_echo(self, lora_server):
+        for adapter in (0, 1, 2):
+            status, out = self._post(
+                lora_server, {"prompt": [1, 2, 3], "adapter": adapter}
+            )
+            assert status == 200, (adapter, out)
+            assert out["adapter"] == adapter
+            assert out.get("batched") is True
+            assert len(out["tokens"]) == 6
+        # Omitting the field serves the base and says so.
+        status, out = self._post(lora_server, {"prompt": [1, 2, 3]})
+        assert status == 200
+        assert out["adapter"] == 0
+
+    def test_unknown_adapter_is_400(self, lora_server):
+        status, _ = self._post(
+            lora_server, {"prompt": [1, 2, 3], "adapter": 7}
+        )
+        assert status == 400
+
+    def test_stats_expose_lora_registry(self, lora_server):
+        st = get_json(f"{lora_server}/stats")["cb_lora"]
+        assert st["enabled"] is True
+        assert st["capacity"] == 3
+        assert st["rank"] == 2
+        assert sorted(st["adapters"]) == ["0", "1", "2"]
+        for aid, meta in st["adapters"].items():
+            if aid != "0":
+                assert meta["rank"] >= 1
+        assert set(st["requests_total"]) == {"0", "1", "2"}
+        # The engine fingerprint behind /debug/capture carries the
+        # synthetic recipe, so captures from this server replay
+        # without shipping adapter weights.
+        fp = get_json(f"{lora_server}/debug/state")["engine"]
+        assert fp["lora"]["enabled"] is True

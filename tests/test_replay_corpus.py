@@ -1,0 +1,120 @@
+"""Rotating capture-corpus replay gate (hack/replay_corpus.py,
+`make replay-corpus-check` — ROADMAP item 4(c)).
+
+Rotation/pruning is plain-file logic (pinned with synthetic entries);
+the gate itself is pinned on the tiny self-contained corpus: a base
+run AND a multi-LoRA run recorded through real engines, every entry
+replayed through cmd/replay.py — the LoRA entry proving a LoRA-armed
+capture replays digest-exact from its fingerprint recipe alone. A
+tampered capture must turn the gate red.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+spec = importlib.util.spec_from_file_location(
+    "replay_corpus", _ROOT / "hack" / "replay_corpus.py"
+)
+replay_corpus = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(replay_corpus)
+
+
+def _fake_entry(tmp_path, name: str, nbytes: int = 8) -> str:
+    """One pretend capture file rotated into the corpus."""
+    src = tmp_path / f"src-{name}"
+    src.mkdir()
+    (src / "capture-000001.jsonl").write_bytes(b"x" * nbytes)
+    return str(src)
+
+
+class TestCorpusRotation:
+    def test_entries_sequence_and_order(self, tmp_path):
+        corpus = str(tmp_path / "corpus")
+        for i in range(3):
+            replay_corpus.add_capture(
+                corpus, _fake_entry(tmp_path, f"c{i}"), name=f"c{i}"
+            )
+        entries = replay_corpus.corpus_entries(corpus)
+        assert [os.path.basename(e) for e in entries] == [
+            "0000-c0", "0001-c1", "0002-c2",
+        ]
+
+    def test_prune_keeps_last_n(self, tmp_path):
+        corpus = str(tmp_path / "corpus")
+        for i in range(5):
+            replay_corpus.add_capture(
+                corpus, _fake_entry(tmp_path, f"c{i}"), name=f"c{i}",
+                max_captures=3,
+            )
+        entries = replay_corpus.corpus_entries(corpus)
+        # Last 3 survive; the sequence keeps counting (no id reuse —
+        # "last N" stays meaningful across prunes).
+        assert [os.path.basename(e) for e in entries] == [
+            "0002-c2", "0003-c3", "0004-c4",
+        ]
+
+    def test_prune_by_bytes_never_drops_newest(self, tmp_path):
+        corpus = str(tmp_path / "corpus")
+        for i in range(3):
+            replay_corpus.add_capture(
+                corpus, _fake_entry(tmp_path, f"c{i}", nbytes=100),
+                name=f"c{i}", max_bytes=150,
+            )
+        entries = replay_corpus.corpus_entries(corpus)
+        # 3x100 bytes over a 150 budget: oldest two pruned, the
+        # newest stays even though it alone fits the budget exactly.
+        assert [os.path.basename(e) for e in entries] == ["0002-c2"]
+        # An entry bigger than the whole budget still survives alone.
+        replay_corpus.prune_corpus(corpus, max_bytes=10)
+        assert len(replay_corpus.corpus_entries(corpus)) == 1
+
+    def test_add_missing_capture_raises(self, tmp_path):
+        import pytest
+
+        with pytest.raises(FileNotFoundError):
+            replay_corpus.add_capture(
+                str(tmp_path / "corpus"), str(tmp_path / "nope")
+            )
+
+
+class TestReplayCorpusGate:
+    def test_gate_is_green_on_demo_corpus(self, capsys):
+        """The `make replay-corpus-check` flow in-process: build the
+        self-contained demo corpus (a base capture AND a multi-LoRA
+        capture — adapters rebuilt from the fingerprint's synthetic
+        recipe, digest-exact by construction) and replay every entry
+        through cmd/replay.py. rc 0 is the whole contract."""
+        assert replay_corpus.main([]) == 0
+        out = capsys.readouterr().out
+        assert "0000-base: token-identical" in out
+        assert "0001-lora: token-identical" in out
+
+    def test_gate_is_red_on_tampered_capture(self, tmp_path, capsys):
+        """Flip one captured token and the gate must exit nonzero —
+        a corpus gate that can't fail is decoration."""
+        capture_dir = tmp_path / "cap"
+        capture_dir.mkdir()
+        replay_corpus.record_lora_traffic(str(capture_dir))
+        fname = next(
+            f for f in sorted(os.listdir(capture_dir))
+            if f.startswith("capture-")
+        )
+        path = capture_dir / fname
+        lines = path.read_text().splitlines()
+        for i, line in enumerate(lines):
+            obj = json.loads(line)
+            if obj.get("kind") == "done" and obj.get("tokens"):
+                obj["tokens"][0] = (obj["tokens"][0] + 1) % 64
+                lines[i] = json.dumps(obj)
+                break
+        else:
+            raise AssertionError("no done record to tamper with")
+        path.write_text("\n".join(lines) + "\n")
+        corpus = str(tmp_path / "corpus")
+        replay_corpus.add_capture(corpus, str(capture_dir), name="bad")
+        assert replay_corpus.main([corpus]) != 0
+        assert "DIVERGENT" in capsys.readouterr().out

@@ -1,0 +1,428 @@
+"""Batched multi-LoRA serving parity + contract tests (tier-1).
+
+K fine-tuned adapters ride ONE paged continuous batcher
+(`models/lora.py` + the engine's adapter plane): every request carries
+an adapter id, each step applies the per-slot low-rank deltas as
+batched gather-einsums, and adapter 0 is the base identity by
+construction. The matrix pinned here:
+
+- adapter-0 traffic on a LoRA-ARMED engine is token-identical to a
+  LoRA-free engine across greedy/sampled × spec on/off × loop 1/8
+  (the identity invariant — arming the engine must not perturb base
+  serving by even one ulp);
+- a MIXED-adapter ragged batch reproduces each adapter's solo
+  streams exactly (batch composition never leaks across slots), and
+  spec / the device-resident loop preserve tokens over any mix;
+- adapters hot-load/unload at the dispatch sync seam, refused while
+  in-flight requests reference the slot;
+- the prefix trie never shares cached KV across adapters for the
+  same prompt bytes;
+- unknown ids reject through the bad_request taxonomy — never a
+  silent base fallback;
+- tensor-parallel serving applies the same deltas (A/B ride the
+  existing psums): tp=2 armed == tp=1 armed.
+
+Synthetic adapters at scale=0.5 for divergence assertions (the
+default 0.02 perturbation is too small to flip a tiny model's
+argmax); fp32 configs keep pinned streams stable."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from walkai_nos_tpu.models.checkpoint import (
+    load_lora_adapter,
+    save_lora_adapter,
+)
+from walkai_nos_tpu.models.lm import DecoderLM, LMConfig, draft_config
+from walkai_nos_tpu.models.lora import AdapterSet, adapter_tag
+from walkai_nos_tpu.models.serve import ContinuousBatcher
+
+CFG = LMConfig(
+    vocab_size=64, hidden_dim=32, num_layers=2, num_heads=2,
+    max_seq_len=512, dtype="float32",
+)
+
+# Mixed ragged prompts: one crossing the 128-row block boundary so
+# multi-chunk prefill + a second pool block run under adapter deltas.
+PROMPTS = [
+    list(range(1, 8)),
+    [(i % 60) + 1 for i in range(137)],
+    [5, 9, 2],
+]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return DecoderLM(CFG).init_params(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def draft():
+    dcfg = draft_config(CFG)
+    return dcfg, DecoderLM(dcfg).init_params(jax.random.PRNGKey(1))
+
+
+def _adapters(scale: float) -> AdapterSet:
+    # Fresh set per engine: engines share one program, not one
+    # registry (hot-load tests mutate theirs).
+    return AdapterSet.synthetic(CFG, k=4, rank=4, seed=0, scale=scale)
+
+
+def _serve(params, *, arm=None, ids=(0, 0, 0, 0), tp=1,
+           spec_draft=None, **kw):
+    """One engine + the shared 3-greedy + 1-sampled workload with
+    per-request adapter ids. Returns (tokens per request, engine)."""
+    cfg = dataclasses.replace(CFG, tp_devices=tp) if tp > 1 else CFG
+    if arm is not None:
+        kw["adapters"] = _adapters(0.5 if arm == "hot" else 0.02)
+    if spec_draft is not None:
+        dcfg, dparams = spec_draft
+        kw.update(
+            spec=True, spec_k=2, draft_cfg=dcfg, draft_params=dparams,
+            spec_min_accept=0.0,
+        )
+    eng = ContinuousBatcher(
+        cfg, params, slots=3, cache_len=384, chunk_steps=3,
+        prefill_chunk=32, **kw,
+    )
+    rids = [
+        eng.submit(p, max_new_tokens=12, adapter=a)
+        for p, a in zip(PROMPTS, ids)
+    ]
+    rids.append(eng.submit(
+        [2, 4, 6], max_new_tokens=10, temperature=0.9, seed=7,
+        adapter=ids[3],
+    ))
+    out = eng.run()
+    return [out[r] for r in rids], eng
+
+
+# Memoized arms: every engine build costs a serving-program compile;
+# several tests compare the same pair of runs.
+_RUNS: dict = {}
+
+
+def _serve_cached(params, *, arm=None, ids=(0, 0, 0, 0), tp=1,
+                  spec_draft=None, **kw):
+    key = (
+        arm, ids, tp, spec_draft is not None,
+        tuple(sorted(kw.items())),
+    )
+    if key not in _RUNS:
+        _RUNS[key] = _serve(
+            params, arm=arm, ids=ids, tp=tp, spec_draft=spec_draft,
+            **kw,
+        )
+    return _RUNS[key]
+
+
+MIXED = (1, 2, 0, 3)
+
+
+class TestAdapterZeroIdentity:
+    """Arming the engine must not move base traffic: all-adapter-0
+    runs on a LoRA-armed engine (nonzero deltas resident in slots
+    1..3) == the LoRA-free engine, token for token."""
+
+    def test_plain(self, params):
+        base, _ = _serve_cached(params)
+        armed, eng = _serve_cached(params, arm="mild")
+        assert armed == base
+        assert eng.lora_stats()["enabled"]
+
+    def test_loop8(self, params):
+        base, _ = _serve_cached(params, loop_steps=8)
+        armed, eng = _serve_cached(params, arm="mild", loop_steps=8)
+        assert armed == base
+        assert eng.loop_stats()["dispatches"] > 0
+
+    def test_spec(self, params, draft):
+        base, _ = _serve_cached(params, spec_draft=draft)
+        armed, eng = _serve_cached(params, arm="mild", spec_draft=draft)
+        assert armed == base
+        assert eng.spec_stats()["verify_dispatches"] > 0
+
+    def test_spec_loop8(self, params, draft):
+        base, _ = _serve_cached(params, spec_draft=draft, loop_steps=8)
+        armed, _ = _serve_cached(
+            params, arm="mild", spec_draft=draft, loop_steps=8
+        )
+        assert armed == base
+
+
+class TestMixedBatchParity:
+    """A ragged batch mixing adapters 0/1/2/3 must reproduce each
+    request's SOLO stream — slot composition never bleeds across the
+    gather — and every execution mode preserves the mixed tokens."""
+
+    def test_adapters_actually_diverge(self, params):
+        base, _ = _serve_cached(params)
+        mixed, _ = _serve_cached(params, arm="hot", ids=MIXED)
+        # Adapter-carrying requests move away from base; the one
+        # adapter-0 request in the mix does not.
+        assert mixed[0] != base[0]
+        assert mixed[1] != base[1]
+        assert mixed[2] == base[2]
+        assert mixed[3] != base[3]
+
+    def test_mixed_equals_solo_streams(self, params):
+        mixed, _ = _serve_cached(params, arm="hot", ids=MIXED)
+        for idx, adapter in ((0, 1), (1, 2)):
+            eng = ContinuousBatcher(
+                CFG, params, slots=3, cache_len=384, chunk_steps=3,
+                prefill_chunk=32, adapters=_adapters(0.5),
+            )
+            rid = eng.submit(
+                PROMPTS[idx], max_new_tokens=12, adapter=adapter
+            )
+            assert eng.run()[rid] == mixed[idx]
+        eng = ContinuousBatcher(
+            CFG, params, slots=3, cache_len=384, chunk_steps=3,
+            prefill_chunk=32, adapters=_adapters(0.5),
+        )
+        rid = eng.submit(
+            [2, 4, 6], max_new_tokens=10, temperature=0.9, seed=7,
+            adapter=3,
+        )
+        assert eng.run()[rid] == mixed[3]
+
+    def test_spec_preserves_mixed_tokens(self, params, draft):
+        """The base-model draft proposes, each slot's ADAPTER
+        verifies — acceptance must leave every stream exactly the
+        spec-off stream."""
+        mixed, _ = _serve_cached(params, arm="hot", ids=MIXED)
+        spec, eng = _serve_cached(
+            params, arm="hot", ids=MIXED, spec_draft=draft
+        )
+        assert spec == mixed
+        assert eng.spec_stats()["verify_dispatches"] > 0
+
+    def test_loop8_preserves_mixed_tokens(self, params):
+        mixed, _ = _serve_cached(params, arm="hot", ids=MIXED)
+        loop, _ = _serve_cached(
+            params, arm="hot", ids=MIXED, loop_steps=8
+        )
+        assert loop == mixed
+
+    def test_prefix_off_preserves_mixed_tokens(self, params):
+        mixed, _ = _serve_cached(params, arm="hot", ids=MIXED)
+        off, _ = _serve_cached(
+            params, arm="hot", ids=MIXED, prefix_cache=False
+        )
+        assert off == mixed
+
+    def test_tp2_preserves_mixed_tokens(self, params):
+        """A/B shard per parallel/sharding.py and the delta rides the
+        block's existing psum: tp=2 armed == tp=1 armed."""
+        mixed, _ = _serve_cached(params, arm="hot", ids=MIXED)
+        tp2, eng = _serve_cached(params, arm="hot", ids=MIXED, tp=2)
+        assert tp2 == mixed
+        assert eng.tp == 2
+
+
+def _delta_tree(rng, *, rank=2, scale=0.5):
+    """A partial adapter tree (missing blocks/projections stay
+    identity) with seeded values big enough to flip argmax."""
+    d = CFG.hidden_dim
+    return {
+        "block0": {
+            "qkv": {
+                "a": rng.standard_normal((d, rank)).astype(np.float32)
+                / np.sqrt(d),
+                "b": rng.standard_normal((rank, 3 * d)).astype(
+                    np.float32
+                ) * scale,
+            }
+        },
+    }
+
+
+class TestHotSwap:
+    def test_hot_load_mid_traffic(self, params):
+        """Swapping slot 1's weights between drains changes slot-1
+        streams to exactly what an engine BUILT with those weights
+        serves — and leaves the other residents untouched."""
+        aset = _adapters(0.5)
+        eng = ContinuousBatcher(
+            CFG, params, slots=3, cache_len=384, chunk_steps=3,
+            prefill_chunk=32, adapters=aset,
+        )
+        r1 = eng.submit(PROMPTS[0], max_new_tokens=12, adapter=1)
+        before = eng.run()[r1]
+
+        tree = _delta_tree(np.random.default_rng(42))
+        eng.load_adapter(1, tree, name="swapped")
+        r1b = eng.submit(PROMPTS[0], max_new_tokens=12, adapter=1)
+        r2 = eng.submit(PROMPTS[2], max_new_tokens=12, adapter=2)
+        out = eng.run()
+        assert out[r1b] != before
+
+        cold_set = _adapters(0.5)
+        cold_set.load(1, _delta_tree(np.random.default_rng(42)),
+                      name="swapped")
+        cold = ContinuousBatcher(
+            CFG, params, slots=3, cache_len=384, chunk_steps=3,
+            prefill_chunk=32, adapters=cold_set,
+        )
+        c1 = cold.submit(PROMPTS[0], max_new_tokens=12, adapter=1)
+        c2 = cold.submit(PROMPTS[2], max_new_tokens=12, adapter=2)
+        cout = cold.run()
+        assert out[r1b] == cout[c1]
+        assert out[r2] == cout[c2]
+
+    def test_swap_refused_while_in_flight(self, params):
+        eng = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=384, chunk_steps=3,
+            prefill_chunk=32, adapters=_adapters(0.02),
+        )
+        eng.submit(PROMPTS[2], max_new_tokens=4, adapter=1)
+        with pytest.raises(RuntimeError, match="in-flight"):
+            eng.unload_adapter(1)
+        with pytest.raises(RuntimeError, match="in-flight"):
+            eng.load_adapter(
+                1, _delta_tree(np.random.default_rng(7)), name="x"
+            )
+        eng.run()  # drain
+        eng.unload_adapter(1)
+        with pytest.raises(ValueError, match="not loaded"):
+            eng.submit(PROMPTS[2], max_new_tokens=4, adapter=1)
+        # The freed id reloads and serves again.
+        eng.load_adapter(
+            1, _delta_tree(np.random.default_rng(7)), name="back"
+        )
+        rid = eng.submit(PROMPTS[2], max_new_tokens=4, adapter=1)
+        assert len(eng.run()[rid]) > 0
+
+
+class TestRejection:
+    def test_unarmed_engine_rejects_adapter_requests(self, params):
+        """No adapter set -> adapter ids are bad_request, never a
+        silent base fallback."""
+        eng = ContinuousBatcher(CFG, params, slots=1, cache_len=128)
+        with pytest.raises(ValueError, match="no adapter set"):
+            eng.submit(PROMPTS[2], max_new_tokens=4, adapter=1)
+        assert eng.obs.errors.value(
+            labels={"reason": "bad_request"}
+        ) == 1
+        assert not eng.has_work
+        assert eng.lora_stats() == {"enabled": False}
+
+    def test_unknown_adapter_rejected(self, params):
+        eng = ContinuousBatcher(
+            CFG, params, slots=1, cache_len=128,
+            adapters=_adapters(0.02),
+        )
+        with pytest.raises(ValueError, match="not loaded"):
+            eng.submit(PROMPTS[2], max_new_tokens=4, adapter=9)
+        assert eng.obs.errors.value(
+            labels={"reason": "bad_request"}
+        ) == 1
+
+    def test_incompatible_set_rejected_at_build(self, params):
+        other = LMConfig(
+            vocab_size=64, hidden_dim=16, num_layers=2, num_heads=2,
+            max_seq_len=512, dtype="float32",
+        )
+        with pytest.raises(ValueError, match="do not match"):
+            ContinuousBatcher(
+                CFG, params, slots=1, cache_len=128,
+                adapters=AdapterSet.synthetic(other, k=2),
+            )
+
+    def test_dense_engine_rejected(self, params):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatcher(
+                CFG, params, slots=1, cache_len=128, paged=False,
+                adapters=_adapters(0.02),
+            )
+
+
+class TestTrieIsolation:
+    def test_no_cross_adapter_prefix_sharing(self, params):
+        """The same >=129-token prompt under two adapters must never
+        share cached KV (adapter deltas rewrite every row); each
+        adapter reuses its OWN parked blocks."""
+        eng = ContinuousBatcher(
+            CFG, params, slots=2, cache_len=384, chunk_steps=3,
+            prefill_chunk=32, adapters=_adapters(0.5),
+        )
+        p = [(i % 60) + 1 for i in range(300)]  # 2 shareable blocks
+        r0 = eng.submit(p, max_new_tokens=8, adapter=0)
+        base = eng.run()[r0]
+        assert eng.prefix_stats()["block_hits"] == 0
+
+        r1 = eng.submit(p, max_new_tokens=8, adapter=1)
+        first = eng.run()[r1]
+        # Adapter 1's lookup saw adapter 0's parked blocks and
+        # matched NONE of them.
+        assert eng.prefix_stats()["block_hits"] == 0
+        assert first != base
+
+        r1b = eng.submit(p, max_new_tokens=8, adapter=1)
+        again = eng.run()[r1b]
+        # ... while its own parked blocks DO hit, token-identically.
+        assert eng.prefix_stats()["block_hits"] == 2
+        assert again == first
+
+    def test_adapter_tag_layout(self):
+        assert adapter_tag(0) == b""
+        assert adapter_tag(3) == np.int32(-3).tobytes()
+        tags = {adapter_tag(k) for k in range(4)}
+        assert len(tags) == 4
+
+
+class TestCheckpointRoundTrip:
+    def test_npz_round_trip_is_digest_exact(self, params, tmp_path):
+        """save_lora_adapter/load_lora_adapter preserve the exact
+        argument triple, so a reloaded adapter's effective slices are
+        digest-identical to the original load."""
+        tree = {
+            "block0": _delta_tree(np.random.default_rng(5))["block0"],
+            "block1": {
+                "fc2": {
+                    "a": np.random.default_rng(6).standard_normal(
+                        (CFG.mlp_width, 2)
+                    ).astype(np.float32),
+                    "b": np.random.default_rng(7).standard_normal(
+                        (2, CFG.hidden_dim)
+                    ).astype(np.float32),
+                }
+            },
+        }
+        path = tmp_path / "adapter.npz"
+        save_lora_adapter(path, tree, name="tuned", alpha=8.0)
+        loaded_tree, name, alpha = load_lora_adapter(path)
+        assert (name, alpha) == ("tuned", 8.0)
+
+        direct, reloaded = AdapterSet(CFG), AdapterSet(CFG)
+        direct.load(1, tree, name="tuned", alpha=8.0)
+        reloaded.load(1, loaded_tree, name=name, alpha=alpha)
+        assert direct.digests() == reloaded.digests()
+        assert direct.resident() == reloaded.resident()
+
+
+class TestStatsAndFingerprint:
+    def test_lora_stats_contract(self, params):
+        _, eng = _serve_cached(params, arm="hot", ids=MIXED)
+        st = eng.lora_stats()
+        assert st["enabled"] and st["capacity"] == 4 and st["rank"] == 4
+        assert sorted(st["adapters"]) == ["0", "1", "2", "3"]
+        # MIXED routes one request each to 1/2/0 and the sampled one
+        # to 3.
+        assert st["requests_total"] == {
+            "0": 1, "1": 1, "2": 1, "3": 1,
+        }
+        assert st["gather_dispatches_total"] > 0
+
+    def test_fingerprint_carries_lora_section(self, params):
+        _, eng = _serve_cached(params, arm="hot", ids=MIXED)
+        fp = eng.config_fingerprint()
+        lora = fp["lora"]
+        assert sorted(lora["digests"]) == ["1", "2", "3"]
+        assert lora["recipe"]["kind"] == "synthetic"
+        assert lora["recipe"]["scale"] == 0.5
+        base_fp = _serve_cached(params)[1].config_fingerprint()
+        assert "lora" not in base_fp

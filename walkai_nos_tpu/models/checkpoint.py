@@ -110,3 +110,54 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._manager.close()
+
+
+# -- LoRA adapter save/load (models/lora.py trees) ---------------------
+#
+# Adapters are tiny (two rank-R factors per projection — KBs to a few
+# MBs where the base checkpoint is GBs) and hot-load mid-traffic
+# through `ContinuousBatcher.load_adapter`, so they get a plain
+# single-file .npz format instead of an orbax run: no manager, no
+# async machinery, trivially rsync-able, loadable on a serving host
+# that never imports the training stack.
+
+def save_lora_adapter(
+    path: str | Path, tree: dict, *, name: str = "",
+    alpha: float | None = None,
+) -> None:
+    """Write one adapter tree ({"block{i}": {proj: {"a": [in, r],
+    "b": [r, out]}}}) as a flat .npz ("block0/qkv/a" keys) with its
+    name/alpha metadata. The stored factors are the RAW checkpoint
+    factors — alpha folds into B at load time (AdapterSet.load), not
+    on disk."""
+    flat: dict[str, np.ndarray] = {}
+    for blk, projs in tree.items():
+        for proj, pair in projs.items():
+            flat[f"{blk}/{proj}/a"] = np.asarray(pair["a"], np.float32)
+            flat[f"{blk}/{proj}/b"] = np.asarray(pair["b"], np.float32)
+    flat["__name__"] = np.array(str(name))
+    if alpha is not None:
+        flat["__alpha__"] = np.asarray(float(alpha), np.float32)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, **flat)
+
+
+def load_lora_adapter(
+    path: str | Path,
+) -> tuple[dict, str, float | None]:
+    """Read a `save_lora_adapter` file back: (tree, name, alpha) —
+    the exact argument triple `AdapterSet.load` / `register` take."""
+    with np.load(Path(path), allow_pickle=False) as z:
+        tree: dict[str, dict] = {}
+        name, alpha = "", None
+        for key in z.files:
+            if key == "__name__":
+                name = str(z[key])
+                continue
+            if key == "__alpha__":
+                alpha = float(z[key])
+                continue
+            blk, proj, ab = key.rsplit("/", 2)
+            tree.setdefault(blk, {}).setdefault(proj, {})[ab] = z[key]
+    return tree, name, alpha

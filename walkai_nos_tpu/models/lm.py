@@ -495,6 +495,23 @@ def _dense(cfg: LMConfig, features: int, name: str):
 _QUANT_DENSE_NAMES = ("qkv", "out_proj", "gate", "fc1", "fc2")
 
 
+def _apply_lora(y, x, adapters, name: str):
+    """Add the batched multi-LoRA contribution for projection `name`
+    (`models/lora.py`): `adapters` is (stacked A/B tree for this
+    block, per-row adapter ids) or None. Adapter id 0's B slice is
+    all zeros, so base rows add an exact zero — one program serves
+    mixed batches with no masking."""
+    if adapters is None:
+        return y
+    tree, ids = adapters
+    proj = None if tree is None else tree.get(name)
+    if proj is None:
+        return y
+    from walkai_nos_tpu.models.lora import lora_delta
+
+    return y + lora_delta(x, proj, ids).astype(y.dtype)
+
+
 def quantize_lm_params(params, cfg: LMConfig):
     """Transform a full-precision param tree for `cfg.w_dtype`.
 
@@ -855,7 +872,8 @@ class CausalAttention(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False, block_table=None):
+    def __call__(self, x, *, decode: bool = False, block_table=None,
+                 adapters=None):
         c = self.cfg
         d = c.hidden_dim
         head_dim = d // c.num_heads
@@ -863,16 +881,19 @@ class CausalAttention(nn.Module):
         kv_dim = kv_heads * head_dim
         if (
             decode and c.paged_decode and c.fused_qkv
+            and adapters is None
             and x.shape[1] <= MAX_KERNEL_STEPS
             and not self.is_initializing()
             and _fused_qkv_backend_ok()
         ):
             # Fused QKV + rotary + paged attention: the projection
             # runs inside the streamed kernel, so q/k/v never bounce
-            # through HBM between projection and attention. Init and
-            # non-TPU backends take the unfused path below (which
-            # also creates the `qkv` Dense params the fused path
-            # reads).
+            # through HBM between projection and attention. Init,
+            # non-TPU backends, and LoRA-armed applies take the
+            # unfused path below (which also creates the `qkv` Dense
+            # params the fused path reads; the per-slot adapter
+            # deltas must add onto the projection OUTPUT, which the
+            # fused kernel never materializes).
             o = self._fused_paged_decode(x, block_table)
             o = o.transpose(0, 2, 1, 3).reshape(
                 x.shape[0], x.shape[1], d
@@ -881,7 +902,9 @@ class CausalAttention(nn.Module):
         # Fused projection: [q | k | v] channel blocks. With GQA the
         # K/V blocks are kv_heads wide; at kv_heads == num_heads this
         # is the same 3d-channel kernel (and layout) as always.
-        qkv = _dense(c, d + 2 * kv_dim, "qkv")(x)
+        qkv = _apply_lora(
+            _dense(c, d + 2 * kv_dim, "qkv")(x), x, adapters, "qkv"
+        )
         b, s = x.shape[0], x.shape[1]
         q = qkv[..., :d].reshape(
             b, s, c.num_heads, head_dim
@@ -911,7 +934,9 @@ class CausalAttention(nn.Module):
                 v = jnp.repeat(v, c.num_heads // kv_heads, axis=1)
             o = self._sequence_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
-        return _dense(c, d, "out_proj")(o)
+        return _apply_lora(
+            _dense(c, d, "out_proj")(o), o, adapters, "out_proj"
+        )
 
     def _sequence_attention(self, q, k, v):
         c = self.cfg
@@ -1295,11 +1320,12 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False, block_table=None):
+    def __call__(self, x, *, decode: bool = False, block_table=None,
+                 adapters=None):
         c = self.cfg
         x = x + CausalAttention(c, self.mesh, name="attn")(
             _make_norm(c, "norm1")(x), decode=decode,
-            block_table=block_table,
+            block_table=block_table, adapters=adapters,
         )
         h = _make_norm(c, "norm2")(x)
         if self.use_moe:
@@ -1316,13 +1342,21 @@ class DecoderBlock(nn.Module):
                 name="moe",
             )(h)
         if c.mlp == "swiglu":
-            gate = _dense(c, c.mlp_width, "gate")(h)
-            up = _dense(c, c.mlp_width, "fc1")(h)
+            gate = _apply_lora(
+                _dense(c, c.mlp_width, "gate")(h), h, adapters, "gate"
+            )
+            up = _apply_lora(
+                _dense(c, c.mlp_width, "fc1")(h), h, adapters, "fc1"
+            )
             h = nn.silu(gate) * up
         else:
-            h = _dense(c, c.mlp_width, "fc1")(h)
+            h = _apply_lora(
+                _dense(c, c.mlp_width, "fc1")(h), h, adapters, "fc1"
+            )
             h = nn.gelu(h)
-        return x + _dense(c, c.hidden_dim, "fc2")(h)
+        return x + _apply_lora(
+            _dense(c, c.hidden_dim, "fc2")(h), h, adapters, "fc2"
+        )
 
 
 class DecoderLM(nn.Module):
@@ -1330,7 +1364,8 @@ class DecoderLM(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, tokens, *, decode: bool = False, block_table=None):
+    def __call__(self, tokens, *, decode: bool = False, block_table=None,
+                 adapters=None):
         """tokens: [batch, seq] int32 -> logits [batch, seq, vocab].
 
         With `decode=True` the blocks run in KV-cache mode (mutable
@@ -1339,7 +1374,11 @@ class DecoderLM(nn.Module):
         `paged_decode`, `block_table` ([batch, max_logical_blocks]
         int32 pool-block ids) must accompany every decode apply — the
         serving engine owns it host-side, so it is an argument, not a
-        cache variable.
+        cache variable. `adapters` is the multi-LoRA apply pair
+        (stacked per-block A/B tree from `models/lora.py`, per-row
+        adapter ids [batch] int32) or None — an argument for the same
+        reason the block table is: the serving engine owns the stack
+        host-side and hot-swaps it between dispatches.
         """
         c = self.cfg
         x = nn.Embed(
@@ -1391,8 +1430,13 @@ class DecoderLM(nn.Module):
         for i in range(c.num_layers):
             use_moe = c.num_experts > 0 and (i + 1) % c.moe_every == 0
             block = block_cls(c, self.mesh, use_moe, name=f"block{i}")
+            adp = (
+                None if adapters is None
+                else (adapters[0].get(f"block{i}"), adapters[1])
+            )
             x = block(x) if use_remat else block(
-                x, decode=decode, block_table=block_table
+                x, decode=decode, block_table=block_table,
+                adapters=adp,
             )
         x = _make_norm(c, "norm")(x)
         return nn.Dense(

@@ -1,0 +1,349 @@
+"""Batched multi-LoRA adapter registry for the serving engine.
+
+Punica / S-LoRA style multi-tenant serving: K fine-tuned low-rank
+variants of one base model ride ONE continuous batcher. Every targeted
+projection (qkv / out_proj / gate / fc1 / fc2 — exactly the `_dense`
+call sites `quantize_lm_params` targets) carries a stacked pair of
+device arrays
+
+    lora_a: [K, in_features, R]      lora_b: [K, R, out_features]
+
+and the decode/prefill programs apply
+
+    y += (x @ lora_a[ids]) @ lora_b[ids]
+
+as one batched gather-einsum per projection, where `ids` is the
+per-slot (or per-lane-row) adapter id vector. Three invariants make
+this cheap and exact:
+
+- **Adapter 0 is the identity.** Its `lora_b` slice is all zeros, so
+  base traffic pays two skinny einsums whose result is exactly zero —
+  token streams are identical to a LoRA-free engine — and a mixed
+  batch needs no masking or regrouping.
+- **Ragged ranks pad to one rank bucket.** An adapter of rank r < R
+  stores A in columns [:r] and B in rows [:r] with zero padding;
+  A @ B is unchanged, and every adapter shares one program signature
+  (swapping adapter WEIGHTS never recompiles — only changing the
+  set's capacity or rank bucket would).
+- **alpha/r folds into B at load time.** The classic LoRA scale is a
+  per-adapter constant, so it multiplies into the stored `lora_b`
+  slice once and the apply path stays a pure two-einsum chain.
+
+Under tensor parallelism the split follows the base kernel's Megatron
+layout (parallel/sharding.py): column-parallel projections keep A
+replicated (the rank never divides the model axis) and shard B's
+output dim; row-parallel projections shard A's input dim — the
+low-rank contraction then produces a partial sum that rides the
+block's EXISTING psum — and keep B replicated. No new collectives.
+
+This module is registry + builders only: the engine (`models/serve.py`)
+owns device placement and the per-slot id plumbing; `models/lm.py`
+calls `lora_delta` at its projection sites.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from walkai_nos_tpu.obs.capture import tree_crc32
+
+__all__ = [
+    "AdapterSet",
+    "adapter_tag",
+    "lora_delta",
+    "lora_proj_dims",
+]
+
+
+def lora_proj_dims(cfg) -> dict[str, tuple[int, int]]:
+    """(in_features, out_features) per targeted projection for `cfg`,
+    mirroring the head-replicated kv expansion the TP engine applies
+    (tp > kv_heads expands the qkv K/V column blocks to tp heads), so
+    an AdapterSet built from the CALLER's config always matches the
+    engine's post-expansion kernels."""
+    d = cfg.hidden_dim
+    head_dim = d // cfg.num_heads
+    kv_heads = cfg.kv_heads
+    tp = getattr(cfg, "tp_devices", 1)
+    if tp > 1 and kv_heads < tp:
+        kv_heads = tp
+    kv_dim = kv_heads * head_dim
+    dims = {
+        "qkv": (d, d + 2 * kv_dim),
+        "out_proj": (d, d),
+        "fc1": (d, cfg.mlp_width),
+        "fc2": (cfg.mlp_width, d),
+    }
+    if cfg.mlp == "swiglu":
+        dims["gate"] = (d, cfg.mlp_width)
+    return dims
+
+
+def lora_delta(x, proj, ids):
+    """The batched per-row LoRA contribution for one projection:
+    `(x @ A[ids]) @ B[ids]`, two skinny einsums around a leading-axis
+    gather. `x` is [batch, steps, in], `ids` [batch] int32; the result
+    is [batch, steps, out] in f32 (the caller casts onto its output).
+    alpha/r is already folded into the stored B slices."""
+    a = jnp.take(proj["lora_a"], ids, axis=0)
+    b = jnp.take(proj["lora_b"], ids, axis=0)
+    h = jnp.einsum("bsi,bir->bsr", x.astype(a.dtype), a)
+    return jnp.einsum("bsr,bro->bso", h, b)
+
+
+def adapter_tag(adapter: int) -> bytes:
+    """Prefix-trie key tag for an adapter id: adapter 0 tags empty
+    (base keys stay byte-identical to a LoRA-free engine, so router
+    affinity and block-transfer identity are unchanged for base
+    traffic); adapter k > 0 tags the int32 bytes of -k. Every trie key
+    under the tag then differs from every other adapter's keys for the
+    SAME prompt, so cross-adapter prompt collisions can never share KV
+    — an adapter rewrites every cached row through its own deltas. The
+    tag is int32-aligned on purpose: `export_blocks` serializes node
+    keys as int32 token lists, and a negative leading "token" (real
+    ids are >= 0) round-trips the tag through export/import re-keying
+    bit for bit."""
+    if adapter == 0:
+        return b""
+    return np.int32(-adapter).tobytes()
+
+
+class AdapterSet:
+    """Registry of up to `capacity` adapters (id 0 = the base-model
+    identity) over stacked host arrays, one [K, in, R] / [K, R, out]
+    pair per (block, projection). Static shapes: registering,
+    hot-loading, or unloading an adapter swaps VALUES only, so the
+    engine's compiled programs never re-trace."""
+
+    def __init__(self, cfg, *, capacity: int = 4, rank: int = 4):
+        if capacity < 2:
+            raise ValueError(
+                f"capacity must be >= 2 (id 0 is the base identity); "
+                f"got {capacity}"
+            )
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1; got {rank}")
+        self.capacity = int(capacity)
+        self.rank = int(rank)
+        self.num_layers = int(cfg.num_layers)
+        self._dims = lora_proj_dims(cfg)
+        self._host: dict[str, dict] = {}
+        for i in range(self.num_layers):
+            blk = {}
+            for proj, (din, dout) in self._dims.items():
+                blk[proj] = {
+                    "lora_a": np.zeros(
+                        (self.capacity, din, self.rank), np.float32
+                    ),
+                    "lora_b": np.zeros(
+                        (self.capacity, self.rank, dout), np.float32
+                    ),
+                }
+            self._host[f"block{i}"] = blk
+        # id -> {"name", "rank", "alpha"}; id 0 is always resident.
+        self._meta: dict[int, dict] = {
+            0: {"name": "base", "rank": 0, "alpha": 0.0}
+        }
+        self._digests: dict[str, int] = {}
+        # Set by `synthetic()` — lets a capture fingerprint carry a
+        # reconstruction recipe instead of full adapter weights.
+        self.recipe: dict | None = None
+
+    # -- registry ------------------------------------------------------
+
+    def has(self, adapter: int) -> bool:
+        return adapter in self._meta
+
+    def resident(self) -> dict[str, dict]:
+        """{id: {"name", "rank", "alpha"}} for every resident adapter
+        (id 0, the base identity, included)."""
+        return {
+            str(aid): dict(meta)
+            for aid, meta in sorted(self._meta.items())
+        }
+
+    def register(self, name: str, tree: dict, *,
+                 alpha: float | None = None) -> int:
+        """Load `tree` into the lowest free id and return it. `tree`
+        maps "block{i}" -> projection -> {"a": [in, r], "b": [r, out]}
+        with any subset of blocks/projections (missing entries stay
+        identity). Raises when the set is full."""
+        for aid in range(1, self.capacity):
+            if aid not in self._meta:
+                self.load(aid, tree, name=name, alpha=alpha)
+                return aid
+        raise ValueError(
+            f"adapter set is full ({self.capacity - 1} loadable ids)"
+        )
+
+    def load(self, adapter: int, tree: dict, *, name: str,
+             alpha: float | None = None) -> None:
+        """(Re)load adapter `adapter` from `tree` — ragged rank r <=
+        the set's rank bucket zero-pads; alpha (default r, i.e. unit
+        scale) folds into the stored B slices."""
+        if not 1 <= adapter < self.capacity:
+            raise ValueError(
+                f"adapter id must be in [1, {self.capacity}); "
+                f"got {adapter} (id 0 is the reserved base identity)"
+            )
+        rank_seen = 0
+        staged: list[tuple[str, str, np.ndarray, np.ndarray]] = []
+        for blk, projs in tree.items():
+            if blk not in self._host:
+                raise ValueError(f"unknown block {blk!r}")
+            for proj, pair in projs.items():
+                if proj not in self._dims:
+                    raise ValueError(
+                        f"unknown projection {proj!r} (targets: "
+                        f"{sorted(self._dims)})"
+                    )
+                din, dout = self._dims[proj]
+                a = np.asarray(pair["a"], np.float32)
+                b = np.asarray(pair["b"], np.float32)
+                r = a.shape[-1]
+                if a.shape != (din, r) or b.shape != (r, dout):
+                    raise ValueError(
+                        f"{blk}/{proj}: A {a.shape} / B {b.shape} do "
+                        f"not factor ({din}, {dout}) at a shared rank"
+                    )
+                if r > self.rank:
+                    raise ValueError(
+                        f"{blk}/{proj}: rank {r} exceeds the set's "
+                        f"rank bucket {self.rank}"
+                    )
+                rank_seen = max(rank_seen, r)
+                staged.append((blk, proj, a, b))
+        # Validation complete — now mutate (a bad tree must not leave
+        # the slot half-written).
+        self._wipe(adapter)
+        eff_rank = rank_seen or self.rank
+        scale = (alpha if alpha is not None else float(eff_rank))
+        for blk, proj, a, b in staged:
+            r = a.shape[-1]
+            pair = self._host[blk][proj]
+            pair["lora_a"][adapter, :, :r] = a
+            pair["lora_b"][adapter, :r, :] = b * (scale / r)
+        self._meta[adapter] = {
+            "name": str(name),
+            "rank": int(eff_rank),
+            "alpha": float(scale),
+        }
+        self._digests.pop(str(adapter), None)
+
+    def unload(self, adapter: int) -> None:
+        """Zero adapter `adapter` back to the identity and free its
+        id. Id 0 is not unloadable."""
+        if adapter == 0:
+            raise ValueError("adapter 0 is the base identity")
+        if adapter not in self._meta:
+            raise ValueError(f"adapter {adapter} is not resident")
+        self._wipe(adapter)
+        del self._meta[adapter]
+        self._digests.pop(str(adapter), None)
+
+    def _wipe(self, adapter: int) -> None:
+        for blk in self._host.values():
+            for pair in blk.values():
+                pair["lora_a"][adapter] = 0.0
+                pair["lora_b"][adapter] = 0.0
+
+    # -- engine surface ------------------------------------------------
+
+    def host_tree(self) -> dict:
+        """The stacked host arrays, shaped for device placement (the
+        engine device_puts / shards this tree and passes it to every
+        step program as an operand)."""
+        return self._host
+
+    def compatible(self, cfg) -> bool:
+        """True when `cfg`'s projection dims match the dims this set
+        was built against — the engine's constructor guard."""
+        return (
+            lora_proj_dims(cfg) == self._dims
+            and int(cfg.num_layers) == self.num_layers
+        )
+
+    def digests(self) -> dict[str, int]:
+        """Per-adapter `tree_crc32` over the EFFECTIVE (padded,
+        alpha-folded) A/B slices — what the capture fingerprint pins
+        so a LoRA-armed capture replays digest-exact. Cached until the
+        adapter is reloaded/unloaded."""
+        for aid in self._meta:
+            if aid == 0 or str(aid) in self._digests:
+                continue
+            sub = {
+                blk: {
+                    proj: {
+                        "lora_a": pair["lora_a"][aid],
+                        "lora_b": pair["lora_b"][aid],
+                    }
+                    for proj, pair in projs.items()
+                }
+                for blk, projs in self._host.items()
+            }
+            self._digests[str(aid)] = tree_crc32(sub)
+        return {
+            str(aid): self._digests[str(aid)]
+            for aid in sorted(self._meta)
+            if aid != 0
+        }
+
+    def fingerprint(self) -> dict:
+        """The capture fingerprint's "lora" block: geometry, per-
+        adapter digests, and (for synthetic sets) the deterministic
+        reconstruction recipe `sim/replay.py` rebuilds from."""
+        fp = {
+            "capacity": self.capacity,
+            "rank": self.rank,
+            "adapters": self.resident(),
+            "digests": self.digests(),
+        }
+        if self.recipe is not None:
+            fp["recipe"] = dict(self.recipe)
+        return fp
+
+    # -- builders ------------------------------------------------------
+
+    @classmethod
+    def synthetic(cls, cfg, *, k: int = 4, rank: int = 4,
+                  seed: int = 0, scale: float = 0.02) -> "AdapterSet":
+        """Deterministic synthetic set: capacity `k`, ids 1..k-1
+        loaded with seeded Gaussian A/B pairs of RAGGED rank
+        (adapter i gets rank `1 + (i - 1) % rank`, so the bench and
+        parity tests exercise the rank-bucket padding for free), id 0
+        the identity. Seeded per (seed, adapter, block, projection) —
+        the same recipe always rebuilds bit-identical adapters, which
+        is what lets a capture fingerprint carry `recipe` instead of
+        weights."""
+        out = cls(cfg, capacity=k, rank=rank)
+        proj_order = sorted(out._dims)
+        for aid in range(1, k):
+            r = 1 + (aid - 1) % rank
+            tree: dict[str, dict] = {}
+            for i in range(out.num_layers):
+                blk = {}
+                for j, proj in enumerate(proj_order):
+                    din, dout = out._dims[proj]
+                    rng = np.random.default_rng(
+                        [int(seed), aid, i, j]
+                    )
+                    blk[proj] = {
+                        "a": rng.standard_normal(
+                            (din, r), np.float32
+                        ) / np.sqrt(din),
+                        "b": rng.standard_normal(
+                            (r, dout), np.float32
+                        ) * scale,
+                    }
+                tree[f"block{i}"] = blk
+            out.load(aid, tree, name=f"synthetic-{aid}")
+        out.recipe = {
+            "kind": "synthetic",
+            "k": int(k),
+            "rank": int(rank),
+            "seed": int(seed),
+            "scale": float(scale),
+        }
+        return out

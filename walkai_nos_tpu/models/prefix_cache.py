@@ -110,23 +110,31 @@ class PrefixIndex:
         first output token)."""
         return max(0, (prompt_len - 1) // self.block_tokens)
 
-    def _keys(self, prompt, n: int) -> list[bytes]:
+    def _keys(self, prompt, n: int, tag: bytes = b"") -> list[bytes]:
         # The shared key function (`models/block_key.py`): the SAME
         # canonical bytes the router's affinity key and the
         # block-transfer hashes are built from, so routing and
-        # transfer identity can never drift from the trie's.
+        # transfer identity can never drift from the trie's. `tag`
+        # (the serving engine's adapter tag, `models/lora.py`)
+        # prefixes EVERY key, so requests under different adapters can
+        # never share a node for the same prompt — their K/V rows are
+        # functions of different deltas. The empty tag (base traffic)
+        # keeps keys byte-identical to an untagged index.
         bt = self.block_tokens
         return [
-            block_key(prompt[i * bt:(i + 1) * bt]) for i in range(n)
+            tag + block_key(prompt[i * bt:(i + 1) * bt])
+            for i in range(n)
         ]
 
-    def match(self, prompt) -> list[PrefixNode]:
+    def match(self, prompt, tag: bytes = b"") -> list[PrefixNode]:
         """Longest READY path of full prompt blocks, root-first. Pure
         probe: refcounts and LRU order are untouched until
         `acquire`."""
         out: list[PrefixNode] = []
         node = self._root
-        for key in self._keys(prompt, self.matchable_blocks(len(prompt))):
+        for key in self._keys(
+            prompt, self.matchable_blocks(len(prompt)), tag
+        ):
             child = node.children.get(key)
             if child is None or not child.ready:
                 break
@@ -149,17 +157,18 @@ class PrefixIndex:
             node.last_used = t
 
     def insert(self, prompt, parent: PrefixNode | None,
-               blocks: list[int]) -> list[PrefixNode]:
+               blocks: list[int], tag: bytes = b"") -> list[PrefixNode]:
         """Register the prompt's next full blocks after `parent` (None
         = root) as new nodes owned by the caller (refcount 1, NOT
         ready — `mark_ready` flips each once its writing chunk is
         dispatched). Stops at the first already-present child: another
         in-flight request is writing the same content, its copy wins
-        and the caller's remaining blocks stay private."""
+        and the caller's remaining blocks stay private. `tag` must
+        match the `match` probe's tag for the same request."""
         parent = parent or self._root
         t = self._tick()
         out: list[PrefixNode] = []
-        keys = self._keys(prompt, parent.depth + len(blocks))
+        keys = self._keys(prompt, parent.depth + len(blocks), tag)
         for key, block in zip(keys[parent.depth:], blocks):
             if key in parent.children:
                 break

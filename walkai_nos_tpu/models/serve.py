@@ -170,6 +170,7 @@ from walkai_nos_tpu.models.lm import (
     expand_kv_heads,
     quantize_lm_params,
 )
+from walkai_nos_tpu.models.lora import AdapterSet, adapter_tag
 from walkai_nos_tpu.parallel import sharding as shardlib
 from walkai_nos_tpu.parallel.mesh import serving_mesh
 from walkai_nos_tpu.models.prefix_cache import PrefixIndex
@@ -216,6 +217,24 @@ class _Request:
     # Cross-process correlation id (the fleet router's
     # X-Walkai-Trace); rides the trace span and the completion record.
     trace_id: str | None = None
+    # Multi-LoRA adapter id (0 = the base model; models/lora.py):
+    # threads submit -> slot state -> every step program's gather.
+    adapter: int = 0
+
+
+def _split_state(state):
+    """Device-state tuple -> (the 6 base leaves, per-slot adapter ids
+    or None). A LoRA-armed engine appends the [slots] int32 adapter-id
+    vector as a 7th element; unarmed engines keep the historical
+    6-tuple — and therefore today's program signatures — bit for
+    bit."""
+    if len(state) == 7:
+        return state[:6], state[6]
+    return state, None
+
+
+def _join_state(base, aids):
+    return base if aids is None else base + (aids,)
 
 
 @dataclass
@@ -369,6 +388,7 @@ class ContinuousBatcher:
         slo_window_s: float = 30.0,
         slo_objectives: dict | None = None,
         capture: CaptureLog | str | None = None,
+        adapters: AdapterSet | None = None,
     ) -> None:
         # Config-fingerprint snapshot of the CALLER's config, taken
         # before any replace (ragged/paged wiring, cache_len, the
@@ -509,6 +529,27 @@ class ContinuousBatcher:
         self.sp_prefill = bool(sp_prefill)
         self.sp_min_tokens = int(sp_min_tokens)
         self.sp_span = int(sp_span) or max(2, self.tp)
+        # Batched multi-LoRA serving (models/lora.py): K stacked
+        # low-rank adapter pairs per projection ride every step
+        # program as ONE trailing operand, applied per slot via a
+        # batched gather-einsum. Paged-only: the per-slot id vector
+        # is slot state, and the dense path has no slot-state scatter
+        # seam to thread it through.
+        if adapters is not None:
+            if not paged:
+                raise ValueError(
+                    "adapters require the paged engine (per-slot "
+                    "adapter ids ride the paged slot state)"
+                )
+            if not adapters.compatible(self.cfg):
+                raise ValueError(
+                    "AdapterSet dimensions do not match the engine "
+                    "config (build the set from the same LMConfig "
+                    "handed to the engine — lora_proj_dims mirrors "
+                    "the TP kv-head expansion)"
+                )
+        self._adapters = adapters
+        self._lora_device = None
         self._model = DecoderLM(self.cfg, self._mesh)
         # Speculative serving (paged only): the draft holds its own
         # paged pool with the SAME block count, addressed through the
@@ -620,6 +661,9 @@ class ContinuousBatcher:
                 self.draft_params = jax.device_put(
                     self.draft_params, self._repl
                 )
+        if self._adapters is not None:
+            self._upload_adapters()
+            self.obs.lora_resident.set(len(self._adapters.resident()))
         self._record_kv_backing_bytes()
         # Device-time attribution (obs/attrib.py): every dispatch's
         # blocked device sync vs host assembly, classified by
@@ -719,6 +763,12 @@ class ContinuousBatcher:
             jnp.ones(slots, jnp.float32),        # top_p
             jax.random.split(jax.random.PRNGKey(0), slots),
         )
+        if self._adapters is not None:
+            # Per-slot adapter ids, appended ONLY on armed engines so
+            # unarmed program signatures (and their donation layout)
+            # stay byte-identical to a LoRA-free build. Idle slots
+            # hold 0 — the identity adapter.
+            self._state += (jnp.zeros(slots, jnp.int32),)
         if self._mesh is not None:
             self._state = (cache,) + tuple(
                 jax.device_put(leaf, self._repl)
@@ -758,23 +808,29 @@ class ContinuousBatcher:
 
     # -- compiled programs ---------------------------------------------
 
-    def _decode_scan(self, params, state, dec_table):
+    def _decode_scan(self, params, state, dec_table, lora=None):
         """Scan `chunk_steps` decode steps over every slot — the ONE
         definition of the per-step sampling/key protocol both cache
         layouts compile (dense passes dec_table=None). Returns the new
         state and [slots, 1 + chunk_steps] tokens: column 0 is the
         chunk's INPUT token per slot (how the host learns a newly
         admitted slot's first token without its own fetch), the rest
-        are the generated tokens."""
+        are the generated tokens. On a LoRA-armed engine `lora` is
+        the stacked adapter tree and the state carries the per-slot
+        id vector; every step then adds the batched gather-einsum
+        deltas (adapter 0 adds exact zeros)."""
         model = self._model
-        cache, tokens, temps, topks, topps, keys = state
+        (cache, tokens, temps, topks, topps, keys), aids = _split_state(
+            state
+        )
+        adp = None if lora is None else (lora, aids)
 
         def one(carry, _):
             cache, tok, keys = carry
             logits, variables = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None], decode=True, block_table=dec_table,
-                mutable=["cache"],
+                adapters=adp, mutable=["cache"],
             )
             split = jax.vmap(jax.random.split)(keys)
             nxt = sample_rows(
@@ -789,13 +845,15 @@ class ContinuousBatcher:
         emitted = jnp.concatenate(
             [tokens[:, None], out.transpose(1, 0)], axis=1
         )
-        return (cache, last, temps, topks, topps, keys), emitted
+        return _join_state(
+            (cache, last, temps, topks, topps, keys), aids
+        ), emitted
 
     def _build_paged_programs(self) -> None:
         model = self._model
         decode_scan = self._decode_scan
 
-        def target_lane(params, state, pf):
+        def target_lane(params, state, pf, lora=None):
             """Prefill lane over the TARGET model: [P, W] prompt
             tokens, each row its own slot/segment. Rows that FINISH
             their prompt this dispatch carry their slot id in
@@ -804,10 +862,17 @@ class ContinuousBatcher:
             updates are the old admit program, expressed as dropped
             scatters: index leaves <- true_len, first token into the
             token vector, knobs + PRNG key into slot state. Shared by
-            the plain step program and the speculative round."""
-            cache, last, temps, topks, topps, keys = state
+            the plain step program and the speculative round. On a
+            LoRA-armed engine pf carries a 10th array — per-row
+            adapter ids — so prefilled K/V rows reflect the row's
+            adapter, and a finishing row scatters its id into the
+            per-slot id vector beside the sampling knobs."""
+            (cache, last, temps, topks, topps, keys), aids = (
+                _split_state(state)
+            )
             (pf_tok, pf_start, pf_tbl, pf_fslot, pf_true,
-             pf_temp, pf_topk, pf_topp, pf_seed) = pf
+             pf_temp, pf_topk, pf_topp, pf_seed) = pf[:9]
+            pf_adapter = pf[9] if len(pf) > 9 else None
             lane_cache = jax.tree.map(
                 lambda leaf: pf_start if leaf.ndim == 1 else leaf,
                 cache,
@@ -815,6 +880,9 @@ class ContinuousBatcher:
             pf_logits, lane_vars = model.apply(
                 {"params": params, "cache": lane_cache},
                 pf_tok, decode=True, block_table=pf_tbl,
+                adapters=(
+                    None if lora is None else (lora, pf_adapter)
+                ),
                 mutable=["cache"],
             )
             cache = jax.tree.map(
@@ -842,14 +910,18 @@ class ContinuousBatcher:
             topks = topks.at[pf_fslot].set(pf_topk, mode="drop")
             topps = topps.at[pf_fslot].set(pf_topp, mode="drop")
             keys = keys.at[pf_fslot].set(pf_keys[:, 0], mode="drop")
-            return (cache, last, temps, topks, topps, keys)
+            if aids is not None and pf_adapter is not None:
+                aids = aids.at[pf_fslot].set(pf_adapter, mode="drop")
+            return _join_state(
+                (cache, last, temps, topks, topps, keys), aids
+            )
 
         self._target_lane = target_lane
 
         @functools.partial(
             jax.jit, static_argnames=("lane",), donate_argnums=(1,)
         )
-        def step_chunk(params, state, dec_table, pf, lane: bool):
+        def step_chunk(params, state, dec_table, pf, lora, lane: bool):
             """Advance every slot `chunk_steps` tokens (`_decode_scan`),
             then run the prefill lane.
 
@@ -860,9 +932,9 @@ class ContinuousBatcher:
             at the scratch block, so the two lanes touch disjoint
             pool blocks.
             """
-            state, emitted = decode_scan(params, state, dec_table)
+            state, emitted = decode_scan(params, state, dec_table, lora)
             if lane:
-                state = target_lane(params, state, pf)
+                state = target_lane(params, state, pf, lora)
             return state, emitted
 
         self._step_fn = step_chunk
@@ -905,13 +977,17 @@ class ContinuousBatcher:
         L = self.loop_steps
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def loop_chunks(params, state, dec_table, live, eos, owed, backed):
+        def loop_chunks(
+            params, state, dec_table, live, eos, owed, backed, lora
+        ):
             buf0 = jnp.zeros((self.slots, 1 + L * cs), jnp.int32)
             buf0 = buf0.at[:, 0].set(state[1])
 
             def body(carry):
                 state, buf, t, code = carry
-                state, emitted = decode_scan(params, state, dec_table)
+                state, emitted = decode_scan(
+                    params, state, dec_table, lora
+                )
                 buf = jax.lax.dynamic_update_slice(
                     buf, emitted[:, 1:], (0, 1 + t * cs)
                 )
@@ -947,7 +1023,8 @@ class ContinuousBatcher:
         target_lane = self._target_lane
         slots = self.slots
 
-        def spec_core(params, state, d_params, d_cache, dec_table, k):
+        def spec_core(params, state, d_params, d_cache, dec_table, k,
+                      lora=None):
             """One batched draft-and-verify round over every slot —
             the jit-free core BOTH spec programs trace (the
             synchronous per-round dispatch below and the
@@ -975,8 +1052,18 @@ class ContinuousBatcher:
             emitted column 0 is the round's INPUT token (a freshly
             flipped slot's first token, like the plain program's
             input column), columns 1..k+1 the chosen chain of which
-            the first n_emit[s] are committed."""
-            cache, last, temps, topks, topps, keys = state
+            the first n_emit[s] are committed.
+
+            LoRA applies to the TARGET only: the draft proposes from
+            the base model for every slot, and the exact-match
+            acceptance rule guarantees the committed stream is the
+            target's regardless of what the draft proposed — a
+            base-model draft against adapter-k verification costs
+            acceptance rate, never correctness."""
+            (cache, last, temps, topks, topps, keys), aids = (
+                _split_state(state)
+            )
+            adp = None if lora is None else (lora, aids)
             idx0 = cache_positions(cache)  # [slots] write heads
 
             def draft_step(carry, _):
@@ -1007,7 +1094,7 @@ class ContinuousBatcher:
             t_logits, t_vs = model.apply(
                 {"params": params, "cache": cache},
                 t_in, decode=True, block_table=dec_table,
-                mutable=["cache"],
+                adapters=adp, mutable=["cache"],
             )
             cache = t_vs["cache"]
 
@@ -1033,7 +1120,9 @@ class ContinuousBatcher:
             cache = rewind_cache(cache, new_index)
             d_cache = rewind_cache(d_cache, new_index)
 
-            state = (cache, last, temps, topks, topps, keys)
+            state = _join_state(
+                (cache, last, temps, topks, topps, keys), aids
+            )
             emitted = jnp.concatenate([t_in[:, :1], chosen], axis=1)
             return state, d_cache, emitted, n_emit
 
@@ -1044,17 +1133,17 @@ class ContinuousBatcher:
             donate_argnums=(1, 3),
         )
         def spec_round(
-            params, state, d_params, d_cache, dec_table, pf,
+            params, state, d_params, d_cache, dec_table, pf, lora,
             k: int, lane: bool,
         ):
             """The synchronous per-round spec dispatch: `spec_core`
             plus, when admissions ride along, the prefill lane and
             its draft-pool mirror."""
             state, d_cache, emitted, n_emit = spec_core(
-                params, state, d_params, d_cache, dec_table, k
+                params, state, d_params, d_cache, dec_table, k, lora
             )
             if lane:
-                state = target_lane(params, state, pf)
+                state = target_lane(params, state, pf, lora)
                 # Mirror the lane into the draft pool: block b holds
                 # the same prompt rows in both caches, so the slot is
                 # draft-warm (and its blocks prefix-shareable for
@@ -1104,7 +1193,7 @@ class ContinuousBatcher:
         )
         def loop_spec(
             params, state, d_params, d_cache, dec_table,
-            live, eos, owed, backed, k: int,
+            live, eos, owed, backed, lora, k: int,
         ):
             width = 1 + L * (k + 1)
             buf0 = jnp.zeros((slots, width), jnp.int32)
@@ -1115,7 +1204,7 @@ class ContinuousBatcher:
             def body(carry):
                 state, d_cache, buf, off, rc, t, code = carry
                 state, d_cache, emitted, n_emit = spec_core(
-                    params, state, d_params, d_cache, dec_table, k
+                    params, state, d_params, d_cache, dec_table, k, lora
                 )
                 chosen = emitted[:, 1:]  # [slots, k+1] chosen chain
                 valid = win < n_emit[:, None]
@@ -1233,8 +1322,15 @@ class ContinuousBatcher:
         top_p: float = 1.0,
         seed: int | None = None,
         trace_id: str | None = None,
+        adapter: int = 0,
     ) -> int:
         """Queue a generation; returns a request id.
+
+        `adapter` selects which resident LoRA adapter
+        (`models/lora.py`) the request decodes under; 0 (default) is
+        the base model. Nonzero ids require an armed engine
+        (`adapters=` at construction) and a loaded slot — unknown ids
+        are `bad_request` rejections, never silent base fallbacks.
 
         `trace_id` is an opaque cross-process correlation id (the
         fleet router mints one per request and propagates it here via
@@ -1289,6 +1385,26 @@ class ContinuousBatcher:
                 "bad_request",
                 f"max_new_tokens must be >= 1; got {max_new_tokens}",
             )
+        # None means "not specified" to JSON-borne callers (router
+        # capture rows, demo bodies) — same as omitting: the base.
+        adapter = int(adapter) if adapter else 0
+        if adapter:
+            # Unknown ids fail HERE, per request: the device gather
+            # would silently clamp the id onto a resident adapter's
+            # deltas — a wrong-model completion, the one failure mode
+            # a multi-tenant adapter server must never have.
+            if self._adapters is None:
+                raise self._reject(
+                    "bad_request",
+                    f"adapter {adapter} requested but the engine has "
+                    f"no adapter set (construct with adapters=)",
+                )
+            if not self._adapters.has(adapter):
+                raise self._reject(
+                    "bad_request",
+                    f"adapter {adapter} is not loaded (resident: "
+                    f"{sorted(self._adapters.resident())})",
+                )
         prompt = np.asarray(prompt).reshape(-1)
         if len(prompt) == 0:
             raise self._reject("bad_request", "empty prompt")
@@ -1363,10 +1479,15 @@ class ContinuousBatcher:
             trace_id=(
                 None if trace_id is None else str(trace_id)[:64]
             ),
+            adapter=int(adapter),
         )
         self._requests[rid] = req
         self._pending.append(req)
         self.obs.submitted.inc()
+        if self._adapters is not None:
+            self.obs.lora_requests.inc(
+                labels={"adapter": str(req.adapter)}
+            )
         self.obs.queue_depth.set(len(self._pending))
         # The span clock is the request's own stored timestamp, so
         # trace-derived ttft/wall equal drain_done_records exactly.
@@ -1392,6 +1513,13 @@ class ContinuousBatcher:
                 seed=req.seed,
                 arrival_s=round(
                     self._capture.arrival_offset(req.submitted_at), 6
+                ),
+                # Armed engines pin the adapter id (replay must route
+                # the request through the same deltas); unarmed
+                # captures stay byte-identical to pre-LoRA ones.
+                **(
+                    {"adapter": req.adapter}
+                    if self._adapters is not None else {}
                 ),
             )
         return rid
@@ -1595,6 +1723,9 @@ class ContinuousBatcher:
                 # capture armed it): any logged completion can be
                 # matched to the capture that can replay it.
                 "fingerprint": self.fingerprint_id,
+                # Which LoRA adapter served the request (0 = base) —
+                # multi-tenant clients bill/attribute by this.
+                "adapter": r.adapter,
             }
             for rid, r in self._requests.items()
             if r.done
@@ -1904,6 +2035,7 @@ class ContinuousBatcher:
             "capture": self.capture_stats(),
             "attrib": self.attrib_stats(),
             "slo": self.slo_stats(),
+            "lora": self.lora_stats(),
         }
 
     def run(self) -> dict[int, list[int]]:
@@ -1945,6 +2077,19 @@ class ContinuousBatcher:
             "quant": bool(self.cfg.kv_quant),
             "block_tokens": PAGE_ROWS,
             "spec": self._spec,
+            # Adapter-set identity (JSON-stable string, None when
+            # unarmed): a K/V block written under adapter a only
+            # means the same thing at an engine whose adapter a holds
+            # the SAME deltas.
+            "lora": (
+                None if self._adapters is None
+                else ",".join(
+                    f"{aid}:{crc}"
+                    for aid, crc in sorted(
+                        self._adapters.digests().items()
+                    )
+                )
+            ),
         }
 
     def _check_xfer_header(self, payload: dict) -> str | None:
@@ -2297,6 +2442,7 @@ class ContinuousBatcher:
                 "top_p": float(req.top_p),
                 "seed": int(req.seed),
                 "trace_id": req.trace_id,
+                "adapter": int(req.adapter),
             }
 
         resubmit: list[dict] = []
@@ -2485,6 +2631,7 @@ class ContinuousBatcher:
         topk_arr: list[int] = []
         topp_arr: list[float] = []
         key_arr: list[list[int]] = []
+        adp_arr: list[int] = []
         now = time.monotonic()
         drain_flag, self._draining = self._draining, False
         try:
@@ -2498,6 +2645,7 @@ class ContinuousBatcher:
                     top_p=float(m["top_p"]),
                     seed=int(m["seed"]),
                     trace_id=m["trace_id"],
+                    adapter=int(m.get("adapter", 0)),
                 )
                 out.append({
                     "rid": rid, "trace_id": m["trace_id"],
@@ -2511,8 +2659,10 @@ class ContinuousBatcher:
                 # the next input, its row unwritten until fed.
                 pos = len(prompt) + len(tokens) - 1
                 nblk = int(m["n_blocks"])
+                m_adapter = int(m.get("adapter", 0))
+                m_tag = adapter_tag(m_adapter)
                 matched = (
-                    self._prefix.match(prompt)[:nblk]
+                    self._prefix.match(prompt, m_tag)[:nblk]
                     if self._prefix is not None else []
                 )
                 if self._prefix is not None:
@@ -2542,6 +2692,7 @@ class ContinuousBatcher:
                         prompt,
                         matched[-1] if matched else None,
                         blocks[len(matched):walkable],
+                        m_tag,
                     )
                     # Ready immediately: their tiles land before
                     # this call returns, and nothing dispatches in
@@ -2564,6 +2715,7 @@ class ContinuousBatcher:
                     seed=int(m["seed"]),
                     submitted_at=now - float(m["age_s"]),
                     trace_id=m["trace_id"],
+                    adapter=m_adapter,
                 )
                 req.tokens = tokens
                 req.streamed = len(tokens)
@@ -2584,6 +2736,7 @@ class ContinuousBatcher:
                 topk_arr.append(int(m["top_k"]))
                 topp_arr.append(float(m["top_p"]))
                 key_arr.append([int(v) for v in m["key"]])
+                adp_arr.append(m_adapter)
                 if self._capture is not None:
                     # A fresh-submit record with the EFFECTIVE seed:
                     # replaying it re-executes the request from the
@@ -2618,6 +2771,9 @@ class ContinuousBatcher:
         if new_slots:
             sl = self._dev(np.asarray(new_slots, np.int32))
             posv = self._dev(np.asarray(pos_arr, np.int32))
+            aids_prev = (
+                self._state[6] if self._adapters is not None else None
+            )
             cache = self._state[0]
             if rows_sel:
                 cache = self._scatter_tiles(
@@ -2648,6 +2804,14 @@ class ContinuousBatcher:
                     self._dev(np.asarray(key_arr, np.uint32))
                 ),
             )
+            if aids_prev is not None:
+                # Armed engines carry the per-slot adapter-id leaf;
+                # restore the migrated slots' ids alongside.
+                self._state += (
+                    aids_prev.at[sl].set(
+                        self._dev(np.asarray(adp_arr, np.int32))
+                    ),
+                )
             if self._spec:
                 d_cache = self._d_cache
                 if rows_sel:
@@ -2774,6 +2938,11 @@ class ContinuousBatcher:
                 "vocab_size": self._draft_cfg.vocab_size,
                 "max_seq_len": self._draft_cfg.max_seq_len,
             }
+        if self._adapters is not None:
+            # Adapter-set identity (models/lora.py): geometry,
+            # per-adapter delta digests, and — for synthetic sets —
+            # the recipe replay rebuilds the exact same deltas from.
+            fp["lora"] = self._adapters.fingerprint()
         fp["id"] = fingerprint_id(fp)
         self._fingerprint = fp
         return fp
@@ -2877,6 +3046,112 @@ class ContinuousBatcher:
             "requests_total": int(self.obs.sp_requests.value()),
             "rows_total": int(self.obs.sp_rows.value()),
             "holds_total": int(self.obs.sp_holds.value()),
+        }
+
+    # -- multi-LoRA adapter plane (models/lora.py) ---------------------
+
+    def _upload_adapters(self) -> None:
+        """Re-place the adapter set's host tree on device — the ONE
+        device-upload seam of the adapter plane, called at build and
+        after every load/unload. The stacked tree is a plain trailing
+        jit operand, so a fresh upload swaps the VALUES every
+        subsequent dispatch computes with; program signatures (and
+        their compiled executables) never change. Under TP the tree
+        shards per `parallel/sharding.py`'s lora rules (A/B split
+        riding the block's existing psum)."""
+        host = self._adapters.host_tree()
+        if self._mesh is not None:
+            self._lora_device = shardlib.shard_params(host, self._mesh)
+        else:
+            self._lora_device = jax.device_put(host)
+
+    def load_adapter(
+        self, adapter: int, tree, *, name: str = "",
+        alpha: float | None = None,
+    ) -> None:
+        """Hot-load low-rank deltas into adapter slot `adapter`
+        mid-traffic, at the dispatch sync seam: the caller's thread is
+        the driver thread, so no step program is in flight while the
+        host tree mutates and re-uploads — requests admitted after
+        this call decode under the new deltas, requests already
+        resident keep the id they carry (slots referencing a reloaded
+        id would silently switch models mid-stream, so that is
+        refused)."""
+        if self._adapters is None:
+            raise RuntimeError(
+                "engine is not adapter-armed (construct with adapters=)"
+            )
+        self._require_adapter_idle(adapter)
+        t0 = time.monotonic()
+        self._adapters.load(adapter, tree, name=name, alpha=alpha)
+        self._upload_adapters()
+        self.obs.lora_load_seconds.inc(time.monotonic() - t0)
+        self.obs.lora_resident.set(len(self._adapters.resident()))
+        # The fingerprint pins the adapter digests: recompute lazily.
+        self._fingerprint = None
+        self.obs.trace.event(
+            "lora_load", time.monotonic(), adapter=adapter,
+            adapter_name=name,
+        )
+
+    def unload_adapter(self, adapter: int) -> None:
+        """Evict an adapter slot (back to the all-zero identity).
+        Refused while any resident request still decodes under it."""
+        if self._adapters is None:
+            raise RuntimeError(
+                "engine is not adapter-armed (construct with adapters=)"
+            )
+        self._require_adapter_idle(adapter)
+        self._adapters.unload(adapter)
+        self._upload_adapters()
+        self.obs.lora_resident.set(len(self._adapters.resident()))
+        self._fingerprint = None
+        self.obs.trace.event(
+            "lora_unload", time.monotonic(), adapter=adapter,
+        )
+
+    def _require_adapter_idle(self, adapter: int) -> None:
+        """Guard a load/unload: no queued, prefilling, or live
+        request may reference the slot being swapped."""
+        in_use = any(
+            r.adapter == adapter
+            for r in self._requests.values()
+            if not r.done
+        )
+        if in_use:
+            raise RuntimeError(
+                f"adapter {adapter} has in-flight requests; drain "
+                f"them before swapping its weights"
+            )
+
+    def lora_stats(self) -> dict:
+        """Multi-LoRA serving telemetry — the `/stats` `cb_lora`
+        section and the `/debug/state` `lora` block: the set
+        geometry, resident ids with names/ranks, and the registry's
+        per-adapter request + gather counters. Same shape +
+        `obs_disabled` with telemetry off (the PR 3 convention)."""
+        if self._adapters is None:
+            return {"enabled": False}
+        aset = self._adapters
+        adapters = aset.resident()  # {str(id): {"name","rank","alpha"}}
+        return {
+            **({} if self.obs.enabled else {"obs_disabled": True}),
+            "enabled": True,
+            "capacity": aset.capacity,
+            "rank": aset.rank,
+            "adapters": adapters,
+            "requests_total": {
+                aid: int(
+                    self.obs.lora_requests.value({"adapter": aid})
+                )
+                for aid in adapters
+            },
+            "gather_dispatches_total": int(
+                self.obs.lora_gather.value()
+            ),
+            "load_seconds_total": round(
+                float(self.obs.lora_load_seconds.value()), 6
+            ),
         }
 
     # Pool bookkeeping lives in `models/block_pool.py`; these views
@@ -2998,6 +3273,12 @@ class ContinuousBatcher:
         self._ensure_decode_blocks(steps, advance=advance)
         resident = self._record_kv_snapshot()
         self.obs.profile.on_dispatch()
+        if self._adapters is not None:
+            # One count per armed dispatch: every step program gathers
+            # the adapter stacks once per projection, whatever the
+            # batch's adapter mix — the flat-overhead claim the bench's
+            # cb_lora_overhead_pct quantifies.
+            self.obs.lora_gather.inc()
         t0 = time.monotonic()
         dec_table = self._dev(self._table)
         if self._prefilling:
@@ -3041,7 +3322,8 @@ class ContinuousBatcher:
             self.chunk_steps, advance=True
         )
         self._state, emitted = self._step_fn(
-            self.params, self._state, dec_table, pf, lane
+            self.params, self._state, dec_table, pf,
+            self._lora_device, lane
         )
         snapshot, fresh = self._paged_epilogue(
             finished, t0, self.chunk_steps
@@ -3065,7 +3347,8 @@ class ContinuousBatcher:
         )
         out = self._spec_fn(
             self.params, self._state, self.draft_params,
-            self._d_cache, dec_table, pf, k=self._k_now, lane=lane,
+            self._d_cache, dec_table, pf, self._lora_device,
+            k=self._k_now, lane=lane,
         )
         self._state, self._d_cache, emitted, n_emit = out
         snapshot, fresh = self._paged_epilogue(
@@ -3139,6 +3422,12 @@ class ContinuousBatcher:
         pf_topk = np.zeros(P, np.int32)
         pf_topp = np.ones(P, np.float32)
         pf_seed = np.zeros(P, np.int32)
+        # Per-row adapter ids (armed engines only): EVERY chunk of a
+        # prompt runs under its request's adapter — the K/V rows it
+        # writes are functions of the adapter's deltas — and the
+        # finishing row's id is scattered into the state's per-slot
+        # id vector by the lane program. Idle rows stay 0 (identity).
+        pf_adapter = np.zeros(P, np.int32)
         lane_end = W  # highest position any lane row touches
         row = 0
         for entry, span in zip(self._prefilling, spans):
@@ -3149,6 +3438,7 @@ class ContinuousBatcher:
             for _ in range(span):
                 r = row
                 row += 1
+                pf_adapter[r] = req.adapter
                 remaining = true_len - entry.consumed
                 if remaining > W:
                     start = entry.consumed
@@ -3206,12 +3496,13 @@ class ContinuousBatcher:
         while nlog < need:
             nlog *= 2
         nlog = min(nlog, self._nlog)
-        pf = tuple(
-            self._dev(a) for a in (
-                pf_tok, pf_start, pf_tbl[:, :nlog], pf_fslot,
-                pf_true, pf_temp, pf_topk, pf_topp, pf_seed,
-            )
+        operands = (
+            pf_tok, pf_start, pf_tbl[:, :nlog], pf_fslot,
+            pf_true, pf_temp, pf_topk, pf_topp, pf_seed,
         )
+        if self._adapters is not None:
+            operands += (pf_adapter,)
+        pf = tuple(self._dev(a) for a in operands)
         return pf, finished, n_rows
 
     def _flip_finished(self, finished: list[_Prefill]) -> None:
@@ -3373,6 +3664,10 @@ class ContinuousBatcher:
                         wall_s=round(now - req.submitted_at, 6),
                         truncated=req.truncated,
                         reason=reason,
+                        **(
+                            {"adapter": req.adapter}
+                            if self._adapters is not None else {}
+                        ),
                     )
                 if self._slot_req[s] is req:
                     self._slot_req[s] = None
@@ -3513,6 +3808,8 @@ class ContinuousBatcher:
         self._ensure_decode_blocks(window, advance=False)
         resident = self._record_kv_snapshot()
         self.obs.profile.on_dispatch()
+        if self._adapters is not None:
+            self.obs.lora_gather.inc()
         live_mask = np.array(
             [r is not None and not r.done for r in self._slot_req],
             bool,
@@ -3547,7 +3844,7 @@ class ContinuousBatcher:
         dec_table = self._dev(pool.table)
         args = (
             self._dev(live_mask), self._dev(eos),
-            self._dev(owed), self._dev(backed),
+            self._dev(owed), self._dev(backed), self._lora_device,
         )
         counts = None
         if spec:
@@ -3745,8 +4042,14 @@ class ContinuousBatcher:
             req = self._pending[pick]
             true_len = len(req.prompt)
             total = self._blocks_needed(true_len, req.max_new_tokens)
+            # Adapter-tagged trie keys (`models/lora.py`): K/V rows
+            # are functions of the serving adapter's deltas, so the
+            # same prompt under two adapters must never share a node
+            # — the tag namespaces the whole path. Base traffic's
+            # empty tag keeps the index byte-identical to pre-LoRA.
+            tag = adapter_tag(req.adapter)
             matched = (
-                self._prefix.match(req.prompt)
+                self._prefix.match(req.prompt, tag)
                 if self._prefix is not None else []
             )
             new_need = total - len(matched)
@@ -3792,6 +4095,7 @@ class ContinuousBatcher:
                     req.prompt,
                     matched[-1] if matched else None,
                     blocks[len(matched):walkable],
+                    tag,
                 )
                 entry.nodes += inserted
                 entry.pending = list(inserted)

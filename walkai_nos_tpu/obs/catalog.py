@@ -125,6 +125,36 @@ CATALOG: tuple[MetricSpec, ...] = (
         "it (the length-aware starvation protection firing)",
         attr="sp_holds",
     ),
+    # -- batched multi-LoRA serving (models/lora.py via serve.py) ------
+    MetricSpec(
+        "cb_lora_requests_total", "counter",
+        "Requests accepted by a LoRA-armed engine, by serving "
+        "adapter id (0 = the base model) — the multi-tenant traffic "
+        "mix; only written on armed engines",
+        labels=("adapter",),
+        attr="lora_requests",
+    ),
+    MetricSpec(
+        "cb_lora_resident_adapters", "gauge",
+        "Adapters resident in the engine's stacked device arrays, "
+        "the base identity (id 0) included; moves on hot load/unload",
+        attr="lora_resident",
+    ),
+    MetricSpec(
+        "cb_lora_gather_dispatches_total", "counter",
+        "Step-program dispatches that carried the batched "
+        "adapter-gather einsums (one count per armed dispatch, "
+        "whatever the batch's adapter mix — the flat-overhead "
+        "denominator behind the bench's cb_lora_overhead_pct)",
+        attr="lora_gather",
+    ),
+    MetricSpec(
+        "cb_lora_adapter_load_seconds_total", "counter",
+        "Cumulative host seconds spent hot-loading adapter weights "
+        "(validate + fold alpha into B + re-upload of the stacked "
+        "tree)",
+        attr="lora_load_seconds",
+    ),
     MetricSpec(
         "cb_kv_pool_blocks", "gauge",
         "Paged KV pool blocks by state (scratch block excluded)",

@@ -38,6 +38,19 @@ _PARAM_RULES: list[tuple[str, P]] = [
     (r"(qkv|query|key|value|fc1|gate|up)/kernel", P(AXIS_FSDP, AXIS_MODEL)),
     # Row-parallel: attention output proj + MLP down-projection.
     (r"(out_proj|proj|fc2|down)/kernel", P(AXIS_MODEL, AXIS_FSDP)),
+    # Multi-LoRA adapter stacks (models/lora.py; leaves are
+    # [K, in, R] `lora_a` / [K, R, out] `lora_b`): the split follows
+    # the base kernel's layout. Column-parallel projections keep A
+    # replicated (the rank bucket never divides the model axis) and
+    # shard B's OUTPUT dim, so the delta lands pre-sharded beside the
+    # kernel's output; row-parallel projections shard A's INPUT dim —
+    # the low-rank contraction becomes a partial sum riding the
+    # block's existing psum — and keep B replicated. No new
+    # collectives either way.
+    (r"(qkv|query|key|value|fc1|gate|up)/lora_a", P()),
+    (r"(qkv|query|key|value|fc1|gate|up)/lora_b", P(None, None, AXIS_MODEL)),
+    (r"(out_proj|proj|fc2|down)/lora_a", P(None, AXIS_MODEL)),
+    (r"(out_proj|proj|fc2|down)/lora_b", P()),
     # Detection/classifier heads: column-parallel.
     (r"(class_head|box_head|head)/.*kernel", P(AXIS_FSDP, AXIS_MODEL)),
     # Biases of column-parallel layers follow their kernel's output split.

@@ -645,7 +645,7 @@ class FleetRouter:
                     k: kwargs.get(k)
                     for k in (
                         "max_new_tokens", "eos_id", "temperature",
-                        "top_k", "top_p", "seed",
+                        "top_k", "top_p", "seed", "adapter",
                     )
                 },
             )
@@ -720,7 +720,7 @@ class FleetRouter:
                     k: kwargs.get(k)
                     for k in (
                         "max_new_tokens", "eos_id", "temperature",
-                        "top_k", "top_p", "seed",
+                        "top_k", "top_p", "seed", "adapter",
                     )
                 },
             )
@@ -998,6 +998,7 @@ class FleetRouter:
                     wall_s=record.get("wall_s"),
                     truncated=record.get("truncated", False),
                     fingerprint=record.get("fingerprint"),
+                    adapter=record.get("adapter"),
                     error=record.get("error"),
                 )
             self._done[rid] = record
@@ -1038,6 +1039,7 @@ class FleetRouter:
                     wall_s=record.get("wall_s"),
                     truncated=record.get("truncated", False),
                     fingerprint=record.get("fingerprint"),
+                    adapter=record.get("adapter"),
                     error=record.get("error"),
                 )
             if ctrl is not None:

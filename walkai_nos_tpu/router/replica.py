@@ -270,7 +270,7 @@ class HttpReplica:
         body = {"prompt": [int(t) for t in prompt]}
         for key in (
             "max_new_tokens", "eos_id", "temperature", "top_k",
-            "top_p", "seed",
+            "top_p", "seed", "adapter",
         ):
             if kwargs.get(key) is not None:
                 body[key] = kwargs[key]
@@ -319,6 +319,9 @@ class HttpReplica:
                     # completion to the replica capture that can
                     # replay it.
                     "fingerprint": out.get("fingerprint"),
+                    # Which LoRA adapter served it (0/absent = base)
+                    # — the per-tenant attribution seam (item 2(b)).
+                    "adapter": out.get("adapter"),
                 }
             except Exception as e:  # noqa: BLE001 — per-request failure
                 record = {

@@ -130,6 +130,18 @@ def classify_config_delta(fp_a: dict, fp_b: dict) -> dict:
             } <= _TOKEN_PRESERVING_DTYPES:
                 continue
             moving.append(f"{section}.{field_name}")
+    # The multi-LoRA plane (fp["lora"], adapter digests + recipe): a
+    # differing adapter set computes a different function for every
+    # request routed at the changed ids — conservatively
+    # function-moving, like a weights delta.
+    lora_a = (fp_a or {}).get("lora")
+    lora_b = (fp_b or {}).get("lora")
+    if lora_a != lora_b:
+        delta.append({
+            "section": "lora", "field": "adapters",
+            "a": lora_a, "b": lora_b,
+        })
+        moving.append("lora.adapters")
     return {
         "delta": delta,
         "token_preserving": not moving,
@@ -151,6 +163,7 @@ class CaptureRecord:
     top_k: int = 0
     top_p: float = 1.0
     seed: int | None = None
+    adapter: int = 0  # multi-LoRA adapter id (0 = base model)
     arrival_s: float = 0.0
     trace_id: str | None = None
     replica: str | None = None
@@ -354,6 +367,7 @@ def build_engine(
     draft_seed: int = 0,
     obs=False,
     capture=None,
+    adapters=None,
 ):
     """Rebuild a ContinuousBatcher from a capture fingerprint (plus
     overrides). `params` is the caller's weight tree — captures store
@@ -362,10 +376,30 @@ def build_engine(
     builds an UNTRAINED draft (draft_config + init): speculative
     serving is token-identical to spec-off for ANY draft weights, so
     an untrained draft is a correct replay axis, not an
-    approximation."""
+    approximation.
+
+    A LoRA-armed capture (fingerprint carries a `lora` section) is
+    replayed with a rebuilt adapter plane: a synthetic recipe in the
+    fingerprint reconstructs the EXACT adapter set from its seed, so
+    the replay is digest-exact with zero stored weights; a capture of
+    real (recipe-less) adapters needs the caller to pass `adapters`
+    (an AdapterSet matching the recorded digests) — rebuilding that
+    from a digest alone is as impossible as rebuilding base weights."""
     from walkai_nos_tpu.models.serve import ContinuousBatcher
 
     cfg, eng = build_config(fingerprint, overrides)
+    lora_fp = (fingerprint or {}).get("lora")
+    if adapters is None and lora_fp:
+        recipe = dict(lora_fp.get("recipe") or {})
+        if recipe.pop("kind", None) != "synthetic":
+            raise ValueError(
+                "capture fingerprint records real LoRA adapters "
+                f"(digests {lora_fp.get('digests')}); pass adapters= "
+                "with the matching AdapterSet to replay it"
+            )
+        from walkai_nos_tpu.models.lora import AdapterSet
+
+        adapters = AdapterSet.synthetic(cfg, **recipe)
     kwargs = {
         k: eng[k] for k in ENGINE_KNOBS
         if k in eng and k not in ("spec",)
@@ -392,6 +426,8 @@ def build_engine(
         kwargs.update(
             spec=True, draft_cfg=draft_cfg, draft_params=draft_params,
         )
+    if adapters is not None:
+        kwargs["adapters"] = adapters
     return ContinuousBatcher(
         cfg, params, obs=obs, capture=capture, **kwargs
     )
@@ -463,6 +499,7 @@ def _submit_record(engine, rec: CaptureRecord) -> int:
         top_k=rec.top_k,
         top_p=rec.top_p,
         seed=rec.seed,
+        adapter=rec.adapter,
     )
 
 
@@ -478,6 +515,7 @@ def replay_capture(
     draft_params=None,
     draft_seed: int = 0,
     obs=False,
+    adapters=None,
 ) -> ReplayReport:
     """Re-execute a capture and verify every completion. Pass either
     a prebuilt `engine` or the weight tree `params` (the engine is
@@ -494,7 +532,7 @@ def replay_capture(
         engine = build_engine(
             capture.fingerprint, params, overrides=overrides,
             draft_cfg=draft_cfg, draft_params=draft_params,
-            draft_seed=draft_seed, obs=obs,
+            draft_seed=draft_seed, obs=obs, adapters=adapters,
         )
     report = ReplayReport(
         fingerprint_id=capture.fingerprint_id,
@@ -592,6 +630,7 @@ def triage_divergence(
     draft_seed: int = 0,
     flight=None,
     flight_dir: str | None = None,
+    adapters=None,
 ) -> dict | None:
     """First-divergence triage: isolate the earliest divergent
     request, re-run it SOLO on a fresh engine (same replay config) to
@@ -608,7 +647,7 @@ def triage_divergence(
     solo_engine = build_engine(
         capture.fingerprint, params, overrides=overrides,
         draft_cfg=draft_cfg, draft_params=draft_params,
-        draft_seed=draft_seed, obs=False,
+        draft_seed=draft_seed, obs=False, adapters=adapters,
     )
     solo_tokens: list | None = None
     solo_error: str | None = None
@@ -676,6 +715,7 @@ def triage_divergence(
             "max_new_tokens": rec.max_new_tokens,
             "eos_id": rec.eos_id, "temperature": rec.temperature,
             "top_k": rec.top_k, "top_p": rec.top_p, "seed": rec.seed,
+            "adapter": rec.adapter,
             "arrival_s": rec.arrival_s, "trace_id": rec.trace_id,
             "captured_tokens": rec.tokens,
             "captured_digest": rec.digest,
